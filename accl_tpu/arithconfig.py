@@ -1,0 +1,87 @@
+"""Datapath dtype policy — the TPU re-expression of ``ArithConfig``.
+
+The reference attaches an ``ArithConfig`` to every call: for a pair of
+(uncompressed, compressed) datatypes it records element widths, the
+compression ratio, which HLS lane performs the cast, and which arithmetic
+lane performs each reduce function
+(``driver/xrt/include/accl/arithconfig.hpp:32-119``).
+
+On TPU there are no switch lanes; what remains semantically is the **dtype
+policy**: the HBM-resident compute dtype, the wire dtype used on inter-chip
+hops when ``ETH_COMPRESSED`` is set, and which reduction functions are
+supported for the pair. The "TDEST" routing ids become keys into the Pallas
+plugin registry (:mod:`accl_tpu.ops.registry`).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+from .constants import dataType, dtype_size, reduceFunction
+
+
+@dataclasses.dataclass(frozen=True)
+class ArithConfig:
+    """Policy for one (uncompressed, compressed) dtype pair.
+
+    Mirrors ``ArithConfig`` fields (arithconfig.hpp:34-76): element sizes,
+    elems-per-word ratio, and the supported reduce functions. ``arith_is_
+    compressed`` — whether reductions run in the compressed dtype (true for
+    same-dtype pairs) or the uncompressed dtype (true for casting pairs, which
+    decompress before reducing, matching the reference default map).
+    """
+
+    uncompressed: dataType
+    compressed: dataType
+    supported_functions: Tuple[reduceFunction, ...] = (
+        reduceFunction.SUM,
+        reduceFunction.MAX,
+    )
+    arith_is_compressed: bool = True
+
+    @property
+    def uncompressed_bytes(self) -> int:
+        return dtype_size(self.uncompressed)
+
+    @property
+    def compressed_bytes(self) -> int:
+        return dtype_size(self.compressed)
+
+    @property
+    def ratio(self) -> float:
+        """Wire compression ratio (elems of compressed per uncompressed)."""
+        return self.uncompressed_bytes / self.compressed_bytes
+
+    @property
+    def is_compressing(self) -> bool:
+        return self.uncompressed != self.compressed
+
+    def supports(self, fn: reduceFunction) -> bool:
+        return fn in self.supported_functions
+
+
+def _same(dt: dataType) -> ArithConfig:
+    return ArithConfig(dt, dt, arith_is_compressed=True)
+
+
+#: Default policy map, keyed by (uncompressed, compressed) — the analog of
+#: ``DEFAULT_ARITH_CONFIG`` (arithconfig.hpp:96-119): every supported dtype
+#: paired with itself, plus the casting pairs. The reference ships f32<->f16;
+#: on TPU the natural wire dtype is bf16, so both casting pairs exist.
+DEFAULT_ARITH_CONFIG: Dict[Tuple[dataType, dataType], ArithConfig] = {
+    (dt, dt): _same(dt)
+    for dt in (
+        dataType.float16,
+        dataType.bfloat16,
+        dataType.float32,
+        dataType.float64,
+        dataType.int32,
+        dataType.int64,
+    )
+}
+DEFAULT_ARITH_CONFIG[(dataType.float32, dataType.float16)] = ArithConfig(
+    dataType.float32, dataType.float16, arith_is_compressed=False
+)
+DEFAULT_ARITH_CONFIG[(dataType.float32, dataType.bfloat16)] = ArithConfig(
+    dataType.float32, dataType.bfloat16, arith_is_compressed=False
+)

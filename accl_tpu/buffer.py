@@ -1,0 +1,188 @@
+"""Device buffer manager over ``jax.Array``.
+
+Replaces the reference's buffer hierarchy (``driver/xrt/include/accl/
+buffer.hpp:32-203`` and its FPGA/Sim/Coyote implementations): a ``Buffer``
+owns per-rank device memory for ``count`` elements plus an optional host
+staging array, with ``sync_to_device`` / ``sync_from_device`` bounce
+semantics (fpgabuffer.hpp) and ``slice`` views.
+
+TPU representation: one *global* ``jax.Array`` of shape ``(world, count)``
+sharded one-shard-per-rank along axis 0 of the communicator's mesh — rank
+r's device memory is shard r. Collectives are shard_map programs over this
+array; data therefore never round-trips through the host (the north-star
+requirement), and ``sync_*`` only moves data when the user explicitly works
+with host numpy like the reference tests do.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import constants
+from .communicator import Communicator
+from .constants import dataType
+
+
+class BaseBuffer:
+    """Common interface (buffer.hpp:32-120 analog)."""
+
+    def __init__(self, count: int, dtype: dataType, comm: Communicator):
+        self.count = int(count)
+        self.dtype = dataType(dtype)
+        self.comm = comm
+
+    @property
+    def size_bytes(self) -> int:
+        if self.dtype == dataType.none:
+            return 0
+        return self.count * constants.dtype_size(self.dtype)
+
+    @property
+    def jnp_dtype(self):
+        return constants.to_jax_dtype(self.dtype)
+
+    @property
+    def is_dummy(self) -> bool:
+        return False
+
+    # device data access — implemented by subclasses
+    def device_view(self) -> jax.Array:
+        raise NotImplementedError
+
+    def device_store(self, value: jax.Array) -> None:
+        raise NotImplementedError
+
+
+class Buffer(BaseBuffer):
+    """Owning buffer: (world, count) device array + (world, count) host array."""
+
+    def __init__(
+        self,
+        count: int,
+        dtype: dataType,
+        comm: Communicator,
+        host_data: Optional[np.ndarray] = None,
+    ):
+        super().__init__(count, dtype, comm)
+        np_dtype = np.dtype(self.jnp_dtype)
+        if host_data is not None:
+            host_data = np.asarray(host_data, dtype=np_dtype)
+            if host_data.shape != (comm.world_size, count):
+                raise ValueError(
+                    f"host data shape {host_data.shape} != "
+                    f"({comm.world_size}, {count})"
+                )
+            self.host = np.array(host_data)
+        else:
+            self.host = np.zeros((comm.world_size, count), dtype=np_dtype)
+        self._device: Optional[jax.Array] = None
+
+    # ---- host <-> device bounce (fpgabuffer.hpp sync semantics) ----------
+
+    def sync_to_device(self) -> None:
+        """Host staging -> per-rank device shards (BaseBuffer::sync_to_device).
+
+        The host array is copied: on the CPU backend ``device_put`` may alias
+        the numpy buffer, which would let later host writes mutate the
+        "device" data — breaking the immutable-snapshot guarantee the
+        send/recv engine and in-flight programs rely on.
+        """
+        self._device = jax.device_put(np.array(self.host), self.comm.sharding())
+
+    def sync_from_device(self) -> None:
+        """Device shards -> host staging (BaseBuffer::sync_from_device)."""
+        if self._device is not None:
+            self.host = np.asarray(jax.block_until_ready(self._device))
+
+    def sync_bo_to_device(self) -> None:  # alias kept for ported tests
+        self.sync_to_device()
+
+    def sync_bo_from_device(self) -> None:
+        self.sync_from_device()
+
+    # ---- device access ---------------------------------------------------
+
+    @property
+    def data(self) -> jax.Array:
+        """The global (world, count) device array, materializing on demand."""
+        if self._device is None:
+            self.sync_to_device()
+        return self._device
+
+    def device_view(self) -> jax.Array:
+        return self.data
+
+    def device_store(self, value: jax.Array) -> None:
+        self._device = value
+
+    # ---- views -----------------------------------------------------------
+
+    def slice(self, start: int, end: int) -> "BufferSlice":
+        """Sub-range view sharing device memory (BaseBuffer::slice)."""
+        if not (0 <= start <= end <= self.count):
+            raise ValueError(f"bad slice [{start}:{end}] of count {self.count}")
+        return BufferSlice(self, start, end)
+
+    def rank_host(self, rank: int) -> np.ndarray:
+        """Rank r's host staging view (what an MPI process would own)."""
+        return self.host[rank]
+
+    def __repr__(self) -> str:
+        return f"Buffer(count={self.count}, dtype={self.dtype.name}, world={self.comm.world_size})"
+
+
+class BufferSlice(BaseBuffer):
+    """Non-owning sub-range of a :class:`Buffer` (zero-copy on device)."""
+
+    def __init__(self, parent: Buffer, start: int, end: int):
+        super().__init__(end - start, parent.dtype, parent.comm)
+        self.parent = parent
+        self.start = start
+        self.end = end
+
+    @property
+    def host(self) -> np.ndarray:
+        return self.parent.host[:, self.start : self.end]
+
+    def sync_to_device(self) -> None:
+        # writing a sub-range back requires the parent's device array
+        full = self.parent.data
+        upd = jnp.asarray(self.parent.host[:, self.start : self.end])
+        self.parent.device_store(
+            jax.lax.dynamic_update_slice(full, upd.astype(full.dtype), (0, self.start))
+        )
+
+    def sync_from_device(self) -> None:
+        self.parent.sync_from_device()
+
+    def device_view(self) -> jax.Array:
+        return self.parent.data[:, self.start : self.end]
+
+    def device_store(self, value: jax.Array) -> None:
+        full = self.parent.data
+        self.parent.device_store(
+            jax.lax.dynamic_update_slice(full, value.astype(full.dtype), (0, self.start))
+        )
+
+    def slice(self, start: int, end: int) -> "BufferSlice":
+        return BufferSlice(self.parent, self.start + start, self.start + end)
+
+
+class DummyBuffer(BaseBuffer):
+    """Placeholder for unused operands (dummybuffer.hpp — address-0 analog)."""
+
+    def __init__(self, comm: Communicator):
+        super().__init__(0, dataType.none, comm)
+
+    @property
+    def is_dummy(self) -> bool:
+        return True
+
+    def device_view(self) -> jax.Array:  # pragma: no cover - never read
+        raise RuntimeError("DummyBuffer has no device data")
+
+    def device_store(self, value: jax.Array) -> None:  # pragma: no cover
+        raise RuntimeError("DummyBuffer cannot be written")

@@ -1,0 +1,177 @@
+"""Communicator: rank group = device mesh axis + per-pair sequence state.
+
+The reference's ``Communicator`` writes a rank table — (ip, port, inbound seq,
+outbound seq, session, max segment size) per rank — into CCLO exchange memory
+(``driver/xrt/src/communicator.cpp:25-117``, layout
+``ccl_offload_control.h:297-323``). On TPU the "address" of a rank is its
+position on a :class:`jax.sharding.Mesh`; sessions/ports dissolve into the
+mesh definition, and what remains is:
+
+* the ordered device list (the rank table),
+* per-peer monotonic sequence numbers, read/updated per message like the
+  DMP does in exchange memory (``dma_mover.cpp:581-610,635-657``) — used by
+  the two-sided send/recv engine for ordered matching,
+* the per-rank max segment size used to chunk pipelined collectives.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import constants
+
+
+@dataclasses.dataclass
+class Rank:
+    """One row of the rank table (communicator.cpp:25-52 analog)."""
+
+    index: int
+    device: jax.Device
+    max_segment_size: int = constants.DEFAULT_SEGMENT_SIZE
+    #: session id analog: position of this rank's device in the parent mesh
+    session: int = 0
+
+
+class Communicator:
+    """A group of ranks ordered on a 1-D mesh axis.
+
+    ``AXIS`` is the canonical collective axis name used by every compiled
+    program; sub-communicators reuse it (program caches key on world size and
+    device list, not the name).
+    """
+
+    AXIS = "accl"
+
+    def __init__(
+        self,
+        devices: Sequence[jax.Device],
+        max_segment_size: int = constants.DEFAULT_SEGMENT_SIZE,
+        _parent: Optional["Communicator"] = None,
+        _parent_indices: Optional[Sequence[int]] = None,
+    ):
+        if len(devices) < 1:
+            raise ValueError("communicator needs at least one rank")
+        self._devices = list(devices)
+        self.mesh = Mesh(np.array(self._devices), (self.AXIS,))
+        self.ranks: List[Rank] = [
+            Rank(index=i, device=d, max_segment_size=max_segment_size, session=i)
+            for i, d in enumerate(self._devices)
+        ]
+        self._parent = _parent
+        self._parent_indices = list(_parent_indices) if _parent_indices else None
+        # per-pair monotonic sequence numbers, exchange-memory analog:
+        # outbound[(src, dst)] counts messages posted src->dst,
+        # inbound[(src, dst)] counts messages consumed at dst from src.
+        self._outbound_seq: Dict[Tuple[int, int], int] = {}
+        self._inbound_seq: Dict[Tuple[int, int], int] = {}
+
+    # ---- rank table ------------------------------------------------------
+
+    @property
+    def world_size(self) -> int:
+        return len(self._devices)
+
+    @property
+    def devices(self) -> List[jax.Device]:
+        return list(self._devices)
+
+    def device(self, rank: int) -> jax.Device:
+        return self._devices[rank]
+
+    def sharding(self, spec: Optional[P] = None) -> NamedSharding:
+        """Sharding that places axis 0 of a (world, ...) array one-shard-per-rank."""
+        return NamedSharding(self.mesh, spec if spec is not None else P(self.AXIS))
+
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    # ---- sequence numbers (dma_mover exchange-memory analog) -------------
+
+    def next_outbound_seq(self, src: int, dst: int) -> int:
+        s = self._outbound_seq.get((src, dst), 0)
+        self._outbound_seq[(src, dst)] = s + 1
+        return s
+
+    def next_inbound_seq(self, src: int, dst: int) -> int:
+        s = self._inbound_seq.get((src, dst), 0)
+        self._inbound_seq[(src, dst)] = s + 1
+        return s
+
+    def peek_inbound_seq(self, src: int, dst: int) -> int:
+        return self._inbound_seq.get((src, dst), 0)
+
+    def peek_outbound_seq(self, src: int, dst: int) -> int:
+        return self._outbound_seq.get((src, dst), 0)
+
+    def reset_sequences(self) -> None:
+        """Zero all per-pair counters (part of soft_reset: the reference's
+        sw-reset clears rx state and seq tracking together,
+        ccl_offload_control.c:2249-2261)."""
+        self._outbound_seq.clear()
+        self._inbound_seq.clear()
+
+    # ---- topology helpers ------------------------------------------------
+
+    def mesh2d(self, rows: int, cols: int, axis_names=("accl_y", "accl_x")) -> Mesh:
+        """2-D mesh over the same ranks, for hierarchical collectives.
+
+        Rank i sits at (i // cols, i % cols); row-major so that a flat ring
+        over ``ranks`` equals raster order over the 2-D mesh.
+        """
+        if rows * cols != self.world_size:
+            raise ValueError(f"{rows}x{cols} != world {self.world_size}")
+        devs = np.array(self._devices).reshape(rows, cols)
+        return Mesh(devs, axis_names)
+
+    def split(self, indices: Sequence[int]) -> "Communicator":
+        """Sub-communicator from a subset of ranks.
+
+        Analog of ``ACCL::create_communicator`` on a rank subset
+        (accl.cpp; exercised by the multi-communicator tests,
+        test/host/xrt/src/test.cpp:621-752). Rank i of the child is
+        ``self`` rank ``indices[i]``.
+        """
+        idx = list(indices)
+        if len(set(idx)) != len(idx):
+            raise ValueError("duplicate ranks in split")
+        for i in idx:
+            if not (0 <= i < self.world_size):
+                raise ValueError(f"rank {i} out of range")
+        return Communicator(
+            [self._devices[i] for i in idx],
+            max_segment_size=self.ranks[0].max_segment_size,
+            _parent=self,
+            _parent_indices=idx,
+        )
+
+    @property
+    def parent(self) -> Optional["Communicator"]:
+        return self._parent
+
+    @property
+    def parent_indices(self) -> Optional[List[int]]:
+        return list(self._parent_indices) if self._parent_indices else None
+
+    # ---- introspection (communicator.cpp:80-116 dump analog) -------------
+
+    def dump(self) -> str:
+        lines = [f"Communicator world={self.world_size} axis={self.AXIS!r}"]
+        for r in self.ranks:
+            lines.append(
+                f"  rank {r.index}: device={r.device} session={r.session} "
+                f"max_seg={r.max_segment_size}"
+            )
+        pairs = sorted(set(self._outbound_seq) | set(self._inbound_seq))
+        for (s, d) in pairs:
+            lines.append(
+                f"  seq {s}->{d}: outbound={self._outbound_seq.get((s, d), 0)} "
+                f"inbound={self._inbound_seq.get((s, d), 0)}"
+            )
+        return "\n".join(lines)
+
+    def __repr__(self) -> str:
+        return f"Communicator(world={self.world_size})"

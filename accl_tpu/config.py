@@ -1,0 +1,82 @@
+"""Runtime configuration for ACCL-TPU.
+
+Replaces the reference's three config tiers (SURVEY.md §5):
+
+* build-time Makefile/Tcl flags (``kernels/cclo/Makefile:18-26`` —
+  STACK_TYPE, EN_DMA/EN_ARITH/EN_COMPRESS/EN_EXT_KRNL) → feature booleans;
+* init-time exchange-memory writes (rx-buffer ring, flat-tree tuning
+  registers, ``accl.cpp:1214-1224``) → threshold fields;
+* runtime config calls (``cfgFunc`` set_timeout/eager-max/rendezvous-max,
+  ``ccl_offload_control.c:2416-2451``) → mutable setters on :class:`ACCL`.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+from . import constants
+
+
+class TransportBackend(enum.Enum):
+    """Stand-in for the reference's STACK_TYPE build flag (UDP/TCP/RDMA).
+
+    On TPU the transport is the interconnect, selected by where the mesh axis
+    lives: ``ICI`` for intra-slice axes, ``DCN`` for multi-slice axes, ``SIM``
+    for the CPU-simulated mesh (the emulator rung of the test ladder).
+    """
+
+    SIM = "sim"
+    ICI = "ici"
+    DCN = "dcn"
+
+
+class Algorithm(enum.Enum):
+    """Selectable collective algorithm families (SURVEY.md §2.6)."""
+
+    AUTO = "auto"          # runtime selection by size/world thresholds
+    XLA = "xla"            # delegate to XLA's native collective (fastest path)
+    RING = "ring"          # chunked/pipelined ppermute ring
+    TREE = "tree"          # binary tree (recursive doubling/halving)
+    FLAT = "flat"          # flat tree (root-centric fan-in/out)
+    HIERARCHICAL = "hier"  # 2D-mesh reduce -> bcast composition
+
+
+@dataclasses.dataclass
+class ACCLConfig:
+    """Tunable parameters.
+
+    The threshold fields mirror the CCLO tuning registers written at init
+    (``accl.cpp:1214-1224`` → exchange mem 0x1FC4-0x1FFC) and the firmware's
+    compile-time maxima (``ccl_offload_control.c:816,1533``).
+    """
+
+    # eager vs rendezvous split (ccl_offload_control.c:27-28)
+    max_eager_size: int = constants.DEFAULT_MAX_EAGER_SIZE
+    max_rendezvous_size: int = constants.DEFAULT_MAX_RENDEZVOUS_SIZE
+
+    # segmentation: chunk size for pipelined collectives (rx-buffer size analog)
+    segment_size: int = constants.DEFAULT_SEGMENT_SIZE
+
+    # flat-tree maxima (BCAST_FLAT_TREE_MAX_RANKS etc.,
+    # ccl_offload_control.c:816,1533; fan-in throttle :1144-1206)
+    bcast_flat_tree_max_ranks: int = 8
+    reduce_flat_tree_max_ranks: int = 8
+    reduce_flat_tree_max_count: int = 64 * 1024
+    gather_flat_tree_max_fanin: int = 8
+
+    # timeout for request waits, in seconds (HOUSEKEEP_TIMEOUT analog)
+    timeout: float = 60.0
+
+    # feature gates (EN_ARITH / EN_COMPRESS analog; always on by default)
+    enable_arith: bool = True
+    enable_compression: bool = True
+
+    # use Pallas kernels for reductions/casts where profitable; when False,
+    # plain jnp ops are used (XLA fuses them anyway — this is a debug switch)
+    use_pallas: bool = True
+
+    # default algorithm policy
+    algorithm: Algorithm = Algorithm.AUTO
+
+    def replace(self, **kw) -> "ACCLConfig":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,218 @@
+"""Core enums, flags and error codes for the ACCL-TPU framework.
+
+TPU-native re-expression of the reference's constant tables
+(``driver/xrt/include/accl/constants.hpp:1-405``): the collective opcode set,
+config functions, compression/stream/host flags, and the 27-bit error bitmask
+raised back to Python exceptions (``driver/xrt/src/accl.cpp:1226-1250``).
+
+Register maps, XRT arg IDs and exchange-memory offsets have no TPU analog and
+are intentionally absent — the equivalent state lives in
+:class:`accl_tpu.communicator.Communicator` / :class:`accl_tpu.config.ACCLConfig`.
+"""
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+# 512-bit datapath granularity of the reference CCLO (accl_hls.h:29). On TPU the
+# analogous granularity is the lane width: we keep segment sizes multiples of it.
+DATA_WIDTH_BITS = 512
+
+#: Default threshold between the eager (segmented, staged) and rendezvous
+#: (single fused zero-copy collective) paths — ``ccl_offload_control.c:27-28``.
+DEFAULT_MAX_EAGER_SIZE = 32 * 1024  # bytes (1 << 15)
+DEFAULT_MAX_RENDEZVOUS_SIZE = 1 << 30  # effectively unbounded
+
+#: Default segment size for chunked/pipelined collectives — plays the role of
+#: the rx-buffer size / ``max_seg_size`` per rank (accl.cpp eager rx buffers).
+DEFAULT_SEGMENT_SIZE = 4 * 1024 * 1024  # bytes
+
+
+class operation(enum.IntEnum):
+    """Collective scenario ids (constants.hpp:191-210 ``operation`` enum)."""
+
+    config = 0
+    copy = 1
+    combine = 2
+    send = 3
+    recv = 4
+    bcast = 5
+    scatter = 6
+    gather = 7
+    reduce = 8
+    allgather = 9
+    allreduce = 10
+    reduce_scatter = 11
+    barrier = 12
+    alltoall = 13
+    put = 14  # one-sided stream_put (accl.hpp stream_put)
+    nop = 255
+
+
+class cfgFunc(enum.IntEnum):
+    """Housekeeping / configuration calls (constants.hpp:179-185)."""
+
+    reset_periph = 0
+    enable_pkt = 1
+    set_timeout = 2
+    open_port = 3
+    open_con = 4
+    set_max_eager_size = 5
+    set_max_rendezvous_size = 6
+    close_con = 7
+
+
+class reduceFunction(enum.IntEnum):
+    """Elementwise reduction functions (constants.hpp reduceFunction)."""
+
+    SUM = 0
+    MAX = 1
+
+
+class dataType(enum.IntEnum):
+    """Wire/compute datatypes (constants.hpp dataType).
+
+    ``bfloat16`` is a TPU-native addition: it is the natural wire-compression
+    dtype on TPU, standing in for the reference's f32<->f16 HLS casting plugin
+    (kernels/plugins/hp_compression).
+    """
+
+    none = 0
+    int8 = 1
+    float16 = 2
+    float32 = 3
+    float64 = 4
+    int32 = 5
+    int64 = 6
+    bfloat16 = 7
+
+
+_DTYPE_TO_JAX = {
+    dataType.int8: jnp.int8,
+    dataType.float16: jnp.float16,
+    dataType.float32: jnp.float32,
+    dataType.float64: jnp.float64,
+    dataType.int32: jnp.int32,
+    dataType.int64: jnp.int64,
+    dataType.bfloat16: jnp.bfloat16,
+}
+
+_JAX_TO_DTYPE = {np.dtype(v): k for k, v in _DTYPE_TO_JAX.items()}
+
+_DTYPE_SIZE = {
+    dataType.int8: 1,
+    dataType.float16: 2,
+    dataType.bfloat16: 2,
+    dataType.float32: 4,
+    dataType.int32: 4,
+    dataType.float64: 8,
+    dataType.int64: 8,
+}
+
+
+def to_jax_dtype(dt: dataType):
+    """Map a :class:`dataType` to the corresponding jnp dtype."""
+    return _DTYPE_TO_JAX[dt]
+
+
+def from_jax_dtype(dt) -> dataType:
+    """Map a numpy/jax dtype to :class:`dataType`."""
+    return _JAX_TO_DTYPE[np.dtype(dt)]
+
+
+def dtype_size(dt: dataType) -> int:
+    """Bytes per element (constants.hpp ``dataTypeSize``)."""
+    return _DTYPE_SIZE[dt]
+
+
+class errorCode(enum.IntFlag):
+    """Per-call error bitmask (constants.hpp:355-387).
+
+    Codes tied to FPGA DMA/packetizer internals keep their names so ported
+    tests and tooling recognise them, but on TPU they are raised by the
+    runtime's own checks (shape/dtype validation, timeouts, matching errors).
+    """
+
+    COLLECTIVE_OP_SUCCESS = 0
+    DMA_MISMATCH_ERROR = 1 << 0
+    DMA_TRANSACTION_ERROR = 1 << 1
+    DMA_BUTT_ERROR = 1 << 2
+    RX_BUFFER_NOT_READY = 1 << 3
+    INVALID_BUFFER_SIZE = 1 << 4
+    COMPRESSION_ERROR = 1 << 5
+    KERNEL_NOT_REGISTERED = 1 << 6
+    RECEIVE_OFFSET_ERROR = 1 << 7
+    COLLECTIVE_NOT_IMPLEMENTED = 1 << 8
+    RECEIVE_OFFCHIP_ERROR = 1 << 9
+    OPEN_PORT_NOT_SUCCEEDED = 1 << 10
+    OPEN_CON_NOT_SUCCEEDED = 1 << 11
+    DMA_SIZE_ERROR = 1 << 12
+    ARITH_ERROR = 1 << 13
+    PACK_TIMEOUT_STS_ERROR = 1 << 14
+    PACK_SEQ_NUMBER_ERROR = 1 << 15
+    COMPRESSION_NOT_SUPPORTED = 1 << 16
+    KRNL_TIMEOUT_STS_ERROR = 1 << 17
+    KRNL_STS_COUNT_ERROR = 1 << 18
+    SEGMENTER_EXPECTED_BTT_ERROR = 1 << 19
+    DMA_NOT_EXPECTED_BTT_ERROR = 1 << 20
+    CONFIG_ERROR = 1 << 21
+    NOT_READY_ERROR = 1 << 22
+    TIMEOUT_ERROR = 1 << 23
+
+
+class streamFlags(enum.IntFlag):
+    """Operand stream flags (constants.hpp streamFlags)."""
+
+    NO_STREAM = 0
+    OP0_STREAM = 1 << 0
+    RES_STREAM = 1 << 1
+
+
+class compressionFlags(enum.IntFlag):
+    """Per-operand compression flags (constants.hpp compressionFlags).
+
+    ``ETH_COMPRESSED`` means "compress on the wire only": operands stay in the
+    uncompressed dtype in HBM, and every inter-chip hop carries the compressed
+    dtype (the TPU analog of compressing before the ethernet packetizer).
+    """
+
+    NO_COMPRESSION = 0
+    OP0_COMPRESSED = 1 << 0
+    OP1_COMPRESSED = 1 << 1
+    RES_COMPRESSED = 1 << 2
+    ETH_COMPRESSED = 1 << 3
+
+
+class hostFlags(enum.IntFlag):
+    """Operand host-residency flags (constants.hpp hostFlags)."""
+
+    NO_HOST = 0
+    OP0_HOST = 1 << 0
+    OP1_HOST = 1 << 1
+    RES_HOST = 1 << 2
+
+
+#: Any-source / any-tag wildcards (constants.hpp TAG_ANY).
+TAG_ANY = 0xFFFF_FFFF
+ANY_SOURCE = -1
+
+
+class ACCLError(Exception):
+    """Raised when a call returns a non-zero :class:`errorCode` bitmask.
+
+    Mirrors ``ACCL::check_return_value`` (accl.cpp:1226-1250) which decodes the
+    bitmask into human-readable messages.
+    """
+
+    def __init__(self, code: errorCode, context: str = ""):
+        self.code = errorCode(code)
+        names = [f.name for f in errorCode if f and f in self.code]
+        msg = f"ACCL call failed ({context}): {'|'.join(names) or hex(code)}"
+        super().__init__(msg)
+
+
+class ACCLTimeoutError(ACCLError):
+    def __init__(self, context: str = ""):
+        super().__init__(errorCode.TIMEOUT_ERROR, context)

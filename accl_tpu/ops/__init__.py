@@ -1,0 +1,3 @@
+from .registry import combine, compress, decompress, reduce_axis0
+
+__all__ = ["combine", "compress", "decompress", "reduce_axis0"]

@@ -1,0 +1,76 @@
+"""Arithmetic / compression plugin registry.
+
+The reference routes payload through HLS plugin lanes selected by TDEST ids
+recorded in the ArithConfig: ``reduce_ops`` (512-bit SIMD SUM/MAX per dtype,
+``kernels/plugins/reduce_ops/reduce_ops.cpp:31-107``) and ``hp_compression``
+(f32<->f16 casting, ``kernels/plugins/hp_compression/hp_compression.cpp:30-144``).
+
+Here the registry maps ``(function, dtype)`` -> an elementwise combine
+callable and ``(src_dtype, dst_dtype)`` -> cast callables. Inside jitted
+collective programs these are ordinary traceable functions, so XLA fuses them
+into the surrounding collective schedule (the "plugin fused into the
+datapath" property). The Pallas implementations in
+:mod:`accl_tpu.ops.reduce_ops` / :mod:`accl_tpu.ops.compression` register
+themselves here when enabled; the jnp fallbacks below are always available
+and are what XLA fuses on CPU-simulated meshes.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax.numpy as jnp
+
+from ..constants import dataType, reduceFunction, to_jax_dtype
+
+# (function, dataType) -> combine(a, b) -> a ⊕ b
+_COMBINE_REGISTRY: Dict[Tuple[reduceFunction, dataType], Callable] = {}
+# (src dataType, dst dataType) -> cast(x) -> x.astype(dst)
+_CAST_REGISTRY: Dict[Tuple[dataType, dataType], Callable] = {}
+
+
+def register_combine(fn: reduceFunction, dt: dataType, impl: Callable) -> None:
+    _COMBINE_REGISTRY[(fn, dt)] = impl
+
+
+def register_cast(src: dataType, dst: dataType, impl: Callable) -> None:
+    _CAST_REGISTRY[(src, dst)] = impl
+
+
+def combine(a, b, fn: reduceFunction, dt: dataType):
+    """Elementwise a ⊕ b (reduce_ops plugin analog)."""
+    impl = _COMBINE_REGISTRY.get((fn, dt))
+    if impl is not None:
+        return impl(a, b)
+    if fn == reduceFunction.SUM:
+        return a + b
+    if fn == reduceFunction.MAX:
+        return jnp.maximum(a, b)
+    raise ValueError(f"unsupported reduce function {fn}")
+
+
+def reduce_axis0(x, fn: reduceFunction, dt: dataType):
+    """Reduce a (world, ...) stack in ascending rank order.
+
+    Rank-ordered folding keeps float reductions bit-identical to the
+    reference's ring/daisy-chain accumulation order (SURVEY.md §7
+    "bit-exactness" hard part): result = (((r0 ⊕ r1) ⊕ r2) ⊕ ...).
+    """
+    acc = x[0]
+    for i in range(1, x.shape[0]):
+        acc = combine(acc, x[i], fn, dt)
+    return acc
+
+
+def compress(x, src: dataType, dst: dataType):
+    """Cast toward the wire dtype (hp_compression compress lane analog)."""
+    if src == dst:
+        return x
+    impl = _CAST_REGISTRY.get((src, dst))
+    if impl is not None:
+        return impl(x)
+    return x.astype(to_jax_dtype(dst))
+
+
+def decompress(x, src: dataType, dst: dataType):
+    """Cast back from the wire dtype (hp_compression decompress lane)."""
+    return compress(x, src, dst)

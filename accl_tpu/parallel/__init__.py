@@ -1,0 +1,4 @@
+from .compiler import ProgramCache
+from . import primitives
+
+__all__ = ["ProgramCache", "primitives"]

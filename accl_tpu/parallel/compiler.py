@@ -1,0 +1,40 @@
+"""Compiled-program cache for host-launched collectives.
+
+The reference pays one MMIO round-trip per call; the TPU analog's fixed cost
+is tracing+compiling an XLA program. To make host-driven per-op dispatch fast
+(SURVEY.md §7 "hard parts"), every collective program is cached keyed on
+``(op, communicator, shape, dtype, static params)`` — the same role the
+firmware's cached communicator/arithcfg lookups play
+(``ccl_offload_control.c:2330-2360``).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Hashable, Tuple
+
+
+class ProgramCache:
+    """Key -> jitted callable, with hit/miss counters for observability."""
+
+    def __init__(self):
+        self._cache: Dict[Hashable, Callable] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: Hashable, builder: Callable[[], Callable]) -> Callable:
+        fn = self._cache.get(key)
+        if fn is None:
+            self.misses += 1
+            fn = builder()
+            self._cache[key] = fn
+        else:
+            self.hits += 1
+        return fn
+
+    def clear(self) -> None:
+        self._cache.clear()
+
+    def __len__(self) -> int:
+        return len(self._cache)
+
+    def stats(self) -> Tuple[int, int, int]:
+        return (len(self._cache), self.hits, self.misses)

@@ -1,0 +1,214 @@
+"""Request objects and the in-flight call queue.
+
+Mirrors the reference's request machinery (``driver/xrt/include/accl/
+acclrequest.hpp:39-211``): every call returns a request handle carrying
+status, return code and duration; ``wait(timeout)`` blocks on completion,
+``test()`` polls. The reference serializes one op on the device at a time
+through ``FPGAQueue``; here JAX's async dispatch plays that role — programs
+are enqueued in issue order on each device stream — so the queue tracks
+bookkeeping (status, timing, completion callbacks) rather than scheduling.
+
+When the native C++ runtime is available (:mod:`accl_tpu.native`) the queue
+and timing counters are backed by it, matching the reference's C++ host
+driver; otherwise a pure-Python fallback is used.
+"""
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+import jax
+
+from .constants import ACCLTimeoutError, ACCLError, errorCode
+
+
+class requestStatus(enum.Enum):
+    """acclrequest.hpp operationStatus analog."""
+
+    QUEUED = 0
+    EXECUTING = 1
+    COMPLETED = 2
+    ERROR = 3
+
+
+class Request:
+    """Handle for one in-flight collective call (BaseRequest analog)."""
+
+    _next_id = 0
+    _id_lock = threading.Lock()
+
+    def __init__(self, scenario: str, outputs: Any = None,
+                 finalizer: Optional[Callable[["Request"], None]] = None,
+                 external: bool = False,
+                 on_complete: Optional[Callable[["Request"], None]] = None):
+        with Request._id_lock:
+            Request._next_id += 1
+            self.id = Request._next_id
+        self.scenario = scenario
+        self.status = requestStatus.QUEUED
+        self.retcode = errorCode.COLLECTIVE_OP_SUCCESS
+        self._outputs = outputs          # jax arrays to block on
+        self._finalizer = finalizer      # post-completion host work (syncs)
+        #: externally-completed requests (e.g. an unmatched recv waiting for a
+        #: future send) only finish when fulfill()/ _complete() is called —
+        #: the NOT_READY retry-queue analog (ccl_offload_control.c:2460-2478)
+        self._external = external
+        self._on_complete = on_complete
+        self._start_ns = time.monotonic_ns()
+        self._duration_ns: Optional[int] = None
+        self._cv = threading.Condition()
+        self._done = False
+        self._error: Optional[BaseException] = None
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def _complete(self, error: Optional[BaseException] = None) -> None:
+        with self._cv:
+            if self._done:
+                return
+            self._duration_ns = time.monotonic_ns() - self._start_ns
+            self._error = error
+            if error is None:
+                self.status = requestStatus.COMPLETED
+            else:
+                self.status = requestStatus.ERROR
+                if isinstance(error, ACCLError):
+                    self.retcode = error.code
+            self._done = True
+            self._cv.notify_all()
+        if self._on_complete is not None:
+            cb, self._on_complete = self._on_complete, None
+            cb(self)
+
+    def fulfill(self, outputs: Any = None,
+                error: Optional[BaseException] = None) -> None:
+        """Complete an externally-completed request (deferred recv delivery)."""
+        with self._cv:
+            if outputs is not None:
+                self._outputs = outputs
+            self._external = False
+            self._cv.notify_all()
+        if error is not None:
+            self._complete(error)
+
+    def cancel(self, error: Optional[BaseException] = None) -> None:
+        """Abort an externally-completed request (soft_reset dropping the
+        retry queue). A later wait() raises the cancellation error."""
+        with self._cv:
+            self._external = False
+        self._complete(error or ACCLError(
+            errorCode.NOT_READY_ERROR, f"{self.scenario} cancelled"))
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Block until done (CCLO::wait / BaseRequest::wait analog)."""
+        if self._external:
+            # wait for fulfill() from a future matching post
+            with self._cv:
+                if not self._cv.wait_for(
+                    lambda: self._done or not self._external, timeout=timeout
+                ):
+                    raise ACCLTimeoutError(self.scenario)
+        if not self._done:
+            try:
+                if self._outputs is not None:
+                    jax.block_until_ready(self._outputs)
+                if self._finalizer is not None:
+                    fin, self._finalizer = self._finalizer, None
+                    fin(self)
+                self._complete()
+            except BaseException as e:  # noqa: BLE001 - surfaced via retcode
+                self._complete(e)
+        with self._cv:
+            if not self._done and not self._cv.wait_for(
+                lambda: self._done, timeout=timeout
+            ):
+                raise ACCLTimeoutError(self.scenario)
+        if self._error is not None:
+            raise self._error
+
+    def test(self) -> bool:
+        """Non-blocking completion poll (CCLO::test analog)."""
+        if self._done:
+            return True
+        if self._external:
+            return False
+        if self._outputs is None:
+            return True
+        # jax arrays expose is_ready on the committed data
+        try:
+            leaves = jax.tree_util.tree_leaves(self._outputs)
+            return all(
+                getattr(x, "is_ready", lambda: True)() for x in leaves
+            )
+        except Exception:  # pragma: no cover
+            return False
+
+    def get_retcode(self) -> errorCode:
+        return self.retcode
+
+    def get_duration_ns(self) -> int:
+        """Per-call duration (FPGADevice::get_duration / PERFCNT analog)."""
+        if self._duration_ns is None:
+            return time.monotonic_ns() - self._start_ns
+        return self._duration_ns
+
+    def __repr__(self) -> str:
+        return f"Request(id={self.id}, op={self.scenario}, status={self.status.name})"
+
+
+class RequestQueue:
+    """Bookkeeping FIFO of issued requests (FPGAQueue analog).
+
+    Keeps a bounded history for introspection/debug dumps and lets callers
+    drain all outstanding work (used by barrier and deinit).
+    """
+
+    def __init__(self, history: int = 256):
+        self._lock = threading.Lock()
+        self._inflight: List[Request] = []
+        self._history: List[Request] = []
+        self._max_history = history
+
+    def push(self, req: Request) -> Request:
+        with self._lock:
+            self._inflight.append(req)
+        return req
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Wait for everything issued so far (flush, like barrier's retry-queue
+        flush in ccl_offload_control.c:2081-2090). Requests already failed or
+        cancelled are skipped — their error surfaces on the caller's wait()."""
+        with self._lock:
+            pending = list(self._inflight)
+        for r in pending:
+            if r.status == requestStatus.ERROR:
+                continue
+            r.wait(timeout=timeout)
+        with self._lock:
+            for r in pending:
+                if r in self._inflight:
+                    self._inflight.remove(r)
+                    self._history.append(r)
+            del self._history[: -self._max_history]
+
+    def retire(self, req: Request) -> None:
+        with self._lock:
+            if req in self._inflight:
+                self._inflight.remove(req)
+                self._history.append(req)
+                del self._history[: -self._max_history]
+
+    def cancel_externals(self) -> None:
+        """Cancel parked externally-completed requests (unmatched async recvs);
+        cancellation triggers their on_complete retirement."""
+        with self._lock:
+            parked = [r for r in self._inflight if r._external]
+        for r in parked:
+            r.cancel()
+
+    @property
+    def inflight(self) -> List[Request]:
+        with self._lock:
+            return list(self._inflight)

@@ -1,0 +1,153 @@
+"""Two-sided tag-matched send/recv on an SPMD machine.
+
+The hardest capability gap between the reference and SPMD TPU programming
+(SURVEY.md §7 "hard parts"): ACCL gives MPI two-sided semantics — a send is
+matched to a recv by ``(source, tag | TAG_ANY, sequence number)`` in the
+rx-buffer seek engine (``kernels/cclo/hls/rxbuf_offload/rxbuf_seek.cpp:
+20-78``), with per-peer monotonic sequence numbers giving ordered delivery
+(``dma_mover.cpp:581-610``) and unmatched traffic parked in pending queues
+(``ccl_offload_control.c:154-410`` rendezvous pending FIFO).
+
+TPU re-expression: the single controller plays the role of both ranks'
+firmware. A **send post** snapshots the sender's immutable device shard (a
+``jax.Array`` reference — zero-copy, and by construction safe against later
+writes, which is exactly what the eager protocol's copy into rx buffers buys
+the reference). A **recv post** consumes the matching send post and executes
+one compiled move program — a single-pair ``ppermute`` writing straight into
+the receiver's buffer shard, the analog of the rendezvous one-sided RDMA
+WRITE (``:604-612``). Whichever side posts first parks in a pending store;
+matching is (src, tag|ANY, seqn==expected-inbound), same predicate as
+``rxbuf_seek``. The pending stores are backed by the native C++ runtime when
+available (:mod:`accl_tpu.native`).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+
+from .communicator import Communicator
+from .constants import TAG_ANY, ACCLError, errorCode
+from .utils.logging import get_logger
+
+log = get_logger("sendrecv")
+
+
+@dataclasses.dataclass
+class SendPost:
+    """A posted-but-unmatched send (rx-buffer notification analog)."""
+
+    src: int
+    dst: int
+    tag: int
+    data: jax.Array         # (world, count) global snapshot; only shard src valid
+    count: int
+    seqn: int = -1          # assigned by the matching engine at post time
+    on_matched: Optional[Callable] = None  # completes the sender's request
+
+
+@dataclasses.dataclass
+class RecvPost:
+    """A posted-but-unmatched recv (rendezvous address announcement analog)."""
+
+    src: int
+    dst: int
+    tag: int
+    count: int
+    deliver: Callable[[SendPost], None]   # executes the move into the recv buffer
+
+
+class MatchingEngine:
+    """Per-communicator pending stores + matching (rxbuf_seek analog)."""
+
+    def __init__(self, comm: Communicator):
+        self.comm = comm
+        self._pending_sends: List[SendPost] = []
+        self._pending_recvs: List[RecvPost] = []
+
+    # -- matching predicate (rxbuf_seek.cpp:50-66) -------------------------
+
+    def _send_matches(self, s: SendPost, src: int, dst: int, tag: int) -> bool:
+        if s.src != src or s.dst != dst:
+            return False
+        if tag != TAG_ANY and s.tag != tag and s.tag != TAG_ANY:
+            return False
+        # ordered delivery: only the next expected message from src is eligible
+        return s.seqn == self.comm.peek_inbound_seq(src, dst)
+
+    def post_send(self, post: SendPost) -> bool:
+        """Assign the outbound seqn, then deliver into a waiting recv or park.
+        Returns True if delivered immediately.
+
+        Count validation happens *before* the seqn is consumed, so a rejected
+        send leaves the pair's ordering state untouched.
+        """
+        prospective = self.comm.peek_outbound_seq(post.src, post.dst)
+        candidate = None
+        for i, r in enumerate(self._pending_recvs):
+            if r.src == post.src and r.dst == post.dst \
+                    and self._tag_ok(r.tag, post.tag) \
+                    and prospective == self.comm.peek_inbound_seq(post.src, post.dst):
+                candidate = (i, r)
+                break
+        if candidate is not None and candidate[1].count != post.count:
+            raise ACCLError(errorCode.INVALID_BUFFER_SIZE,
+                            f"recv count {candidate[1].count} != send count {post.count}")
+        post.seqn = self.comm.next_outbound_seq(post.src, post.dst)
+        if candidate is not None:
+            i, r = candidate
+            self._pending_recvs.pop(i)
+            self.comm.next_inbound_seq(post.src, post.dst)
+            r.deliver(post)
+            if post.on_matched:
+                post.on_matched()
+            return True
+        self._pending_sends.append(post)
+        return False
+
+    def post_recv(self, post: RecvPost) -> bool:
+        """Try to consume a parked send; else park the recv. Returns True if
+        a send was consumed (data delivered)."""
+        for i, s in enumerate(self._pending_sends):
+            if self._send_matches(s, post.src, post.dst, post.tag):
+                if s.count != post.count:
+                    raise ACCLError(errorCode.INVALID_BUFFER_SIZE,
+                                    f"recv count {post.count} != send count {s.count}")
+                self._pending_sends.pop(i)
+                self.comm.next_inbound_seq(post.src, post.dst)
+                post.deliver(s)
+                if s.on_matched:
+                    s.on_matched()
+                return True
+        self._pending_recvs.append(post)
+        return False
+
+    def remove_recv(self, post: RecvPost) -> None:
+        """Un-park a recv (used when a sync recv fails NOT_READY, so the
+        failed call doesn't steal a future send)."""
+        if post in self._pending_recvs:
+            self._pending_recvs.remove(post)
+
+    def clear(self) -> None:
+        self._pending_sends.clear()
+        self._pending_recvs.clear()
+
+    @staticmethod
+    def _tag_ok(recv_tag: int, send_tag: int) -> bool:
+        return recv_tag == TAG_ANY or send_tag == TAG_ANY or recv_tag == send_tag
+
+    # -- introspection (dump_eager_rx_buffers analog) ----------------------
+
+    def dump(self) -> str:
+        lines = [f"MatchingEngine: {len(self._pending_sends)} pending sends, "
+                 f"{len(self._pending_recvs)} pending recvs"]
+        for s in self._pending_sends:
+            lines.append(f"  send {s.src}->{s.dst} tag={s.tag} seqn={s.seqn} count={s.count}")
+        for r in self._pending_recvs:
+            lines.append(f"  recv {r.dst}<-{r.src} tag={r.tag} count={r.count}")
+        return "\n".join(lines)
+
+    @property
+    def n_pending(self) -> Tuple[int, int]:
+        return (len(self._pending_sends), len(self._pending_recvs))

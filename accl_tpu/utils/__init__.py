@@ -1,0 +1,4 @@
+from .timing import Timer
+from .logging import get_logger, set_log_level
+
+__all__ = ["Timer", "get_logger", "set_log_level"]

@@ -1,0 +1,25 @@
+"""Leveled logger (test/log/log.hpp:29-131 + ACCL_DEBUG host logging analog)."""
+from __future__ import annotations
+
+import logging
+import os
+
+_LOGGER_NAME = "accl_tpu"
+
+
+def get_logger(child: str | None = None) -> logging.Logger:
+    name = _LOGGER_NAME if child is None else f"{_LOGGER_NAME}.{child}"
+    logger = logging.getLogger(name)
+    if not logging.getLogger(_LOGGER_NAME).handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter("[%(levelname)s %(name)s] %(message)s")
+        )
+        root = logging.getLogger(_LOGGER_NAME)
+        root.addHandler(handler)
+        root.setLevel(os.environ.get("ACCL_LOG_LEVEL", "WARNING").upper())
+    return logger
+
+
+def set_log_level(level: str) -> None:
+    logging.getLogger(_LOGGER_NAME).setLevel(level.upper())

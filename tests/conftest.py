@@ -1,0 +1,49 @@
+"""Test configuration: CPU-simulated 8-device mesh.
+
+This is the "emulator" rung of the reference's test ladder (SURVEY.md §4):
+ACCL runs its real firmware natively against a ZMQ fabric; we run the real
+framework against XLA's CPU backend with 8 virtual devices
+(``--xla_force_host_platform_device_count=8``). The same suite runs unchanged
+on real TPU meshes.
+"""
+import os
+
+# Must be set before the first JAX backend initialization.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The environment may pin JAX_PLATFORMS to a TPU plugin (e.g. "axon");
+# the config update below overrides it for the test process.
+jax.config.update("jax_platforms", "cpu")
+# float64/int64 collectives are part of the ported matrix (the reference's
+# arith plugin covers f64/i64); on CPU we test them at full width.
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+import accl_tpu  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def world_size() -> int:
+    return 8
+
+
+@pytest.fixture(scope="session")
+def accl() -> accl_tpu.ACCL:
+    """Session-wide ACCL instance over the 8-device CPU mesh (TestEnvironment
+    fixture analog, test/host/xrt/include/fixture.hpp:48-104)."""
+    inst = accl_tpu.ACCL(devices=jax.devices()[:8])
+    yield inst
+    inst.deinit()
+
+
+@pytest.fixture()
+def rng() -> np.random.Generator:
+    return np.random.default_rng(42)

@@ -1,0 +1,259 @@
+"""Collective correctness matrix, ported from the reference gtest suite
+(``test/host/xrt/src/test.cpp:30-1032``): every collective over roots x
+reduce functions x dtypes x segmentation-edge counts, verified elementwise
+against host-computed expectations (``is_close`` for floats, exact for ints,
+``utility.hpp:66-70``).
+"""
+import numpy as np
+import pytest
+
+from accl_tpu import dataType, reduceFunction
+
+WORLD = 8
+# counts chosen like the reference's segmentation edge cases (count around
+# buffer-size boundaries, test.cpp:265): tiny, odd, page-ish, odd-large.
+COUNTS = [1, 25, 257]
+DTYPES = [dataType.float32, dataType.int32, dataType.float64, dataType.int64]
+ROOTS = [0, 3, WORLD - 1]
+FUNCS = [reduceFunction.SUM, reduceFunction.MAX]
+
+
+def _np_dtype(dt):
+    import accl_tpu.constants as c
+    return np.dtype(c.to_jax_dtype(dt))
+
+
+def _fill(rng, shape, dt):
+    nd = _np_dtype(dt)
+    if np.issubdtype(nd, np.floating):
+        return rng.standard_normal(shape).astype(nd)
+    return rng.integers(-100, 100, shape).astype(nd)
+
+
+def _expect_reduce(data, func):
+    """Rank-ordered fold, matching ops.reduce_axis0 / the reference's
+    accumulation order."""
+    acc = data[0].copy()
+    for i in range(1, data.shape[0]):
+        if func == reduceFunction.SUM:
+            acc = acc + data[i]
+        else:
+            acc = np.maximum(acc, data[i])
+    return acc
+
+
+def _assert_close(actual, expected, dt):
+    nd = _np_dtype(dt)
+    if np.issubdtype(nd, np.floating):
+        np.testing.assert_allclose(actual, expected, rtol=1e-5, atol=1e-6)
+    else:
+        np.testing.assert_array_equal(actual, expected)
+
+
+@pytest.mark.parametrize("count", COUNTS)
+@pytest.mark.parametrize("dt", [dataType.float32, dataType.int32])
+def test_copy(accl, rng, count, dt):
+    src = accl.create_buffer(count, dt)
+    dst = accl.create_buffer(count, dt)
+    src.host[:] = _fill(rng, (WORLD, count), dt)
+    accl.copy(src, dst, count)
+    _assert_close(dst.host, src.host, dt)
+
+
+@pytest.mark.parametrize("func", FUNCS)
+@pytest.mark.parametrize("dt", [dataType.float32, dataType.int32])
+def test_combine(accl, rng, func, dt):
+    count = 64
+    a = accl.create_buffer(count, dt)
+    b = accl.create_buffer(count, dt)
+    r = accl.create_buffer(count, dt)
+    a.host[:] = _fill(rng, (WORLD, count), dt)
+    b.host[:] = _fill(rng, (WORLD, count), dt)
+    accl.combine(count, func, a, b, r)
+    if func == reduceFunction.SUM:
+        _assert_close(r.host, a.host + b.host, dt)
+    else:
+        _assert_close(r.host, np.maximum(a.host, b.host), dt)
+
+
+@pytest.mark.parametrize("root", ROOTS)
+@pytest.mark.parametrize("count", COUNTS)
+def test_bcast(accl, rng, root, count):
+    dt = dataType.float32
+    buf = accl.create_buffer(count, dt)
+    buf.host[:] = _fill(rng, (WORLD, count), dt)
+    rootdata = buf.host[root].copy()
+    accl.bcast(buf, count, root)
+    for r in range(WORLD):
+        _assert_close(buf.host[r], rootdata, dt)
+
+
+@pytest.mark.parametrize("dt", [dataType.int32, dataType.int64])
+def test_bcast_int(accl, rng, dt):
+    buf = accl.create_buffer(33, dt)
+    buf.host[:] = _fill(rng, (WORLD, 33), dt)
+    rootdata = buf.host[5].copy()
+    accl.bcast(buf, 33, 5)
+    for r in range(WORLD):
+        _assert_close(buf.host[r], rootdata, dt)
+
+
+@pytest.mark.parametrize("root", ROOTS)
+@pytest.mark.parametrize("count", COUNTS)
+def test_scatter(accl, rng, root, count):
+    dt = dataType.float32
+    send = accl.create_buffer(count * WORLD, dt)
+    recv = accl.create_buffer(count, dt)
+    send.host[:] = _fill(rng, (WORLD, count * WORLD), dt)
+    accl.scatter(send, recv, count, root)
+    for r in range(WORLD):
+        _assert_close(recv.host[r], send.host[root, r * count:(r + 1) * count], dt)
+
+
+@pytest.mark.parametrize("root", ROOTS)
+@pytest.mark.parametrize("count", COUNTS)
+def test_gather(accl, rng, root, count):
+    dt = dataType.float32
+    send = accl.create_buffer(count, dt)
+    recv = accl.create_buffer(count * WORLD, dt)
+    send.host[:] = _fill(rng, (WORLD, count), dt)
+    prior = _fill(rng, (WORLD, count * WORLD), dt)
+    recv.host[:] = prior
+    accl.gather(send, recv, count, root)
+    _assert_close(recv.host[root], send.host.reshape(-1), dt)
+    # non-root recv buffers untouched (reference semantics)
+    for r in range(WORLD):
+        if r != root:
+            _assert_close(recv.host[r], prior[r], dt)
+
+
+@pytest.mark.parametrize("count", COUNTS)
+@pytest.mark.parametrize("dt", [dataType.float32, dataType.int32])
+def test_allgather(accl, rng, count, dt):
+    send = accl.create_buffer(count, dt)
+    recv = accl.create_buffer(count * WORLD, dt)
+    send.host[:] = _fill(rng, (WORLD, count), dt)
+    accl.allgather(send, recv, count)
+    for r in range(WORLD):
+        _assert_close(recv.host[r], send.host.reshape(-1), dt)
+
+
+@pytest.mark.parametrize("root", ROOTS)
+@pytest.mark.parametrize("func", FUNCS)
+@pytest.mark.parametrize("dt", [dataType.float32, dataType.int32])
+def test_reduce(accl, rng, root, func, dt):
+    count = 67
+    send = accl.create_buffer(count, dt)
+    recv = accl.create_buffer(count, dt)
+    send.host[:] = _fill(rng, (WORLD, count), dt)
+    prior = _fill(rng, (WORLD, count), dt)
+    recv.host[:] = prior
+    accl.reduce(send, recv, count, root, func)
+    _assert_close(recv.host[root], _expect_reduce(send.host, func), dt)
+    for r in range(WORLD):
+        if r != root:
+            _assert_close(recv.host[r], prior[r], dt)
+
+
+@pytest.mark.parametrize("count", COUNTS)
+@pytest.mark.parametrize("func", FUNCS)
+@pytest.mark.parametrize("dt", DTYPES)
+def test_allreduce(accl, rng, count, func, dt):
+    send = accl.create_buffer(count, dt)
+    recv = accl.create_buffer(count, dt)
+    send.host[:] = _fill(rng, (WORLD, count), dt)
+    accl.allreduce(send, recv, count, func)
+    expect = _expect_reduce(send.host, func)
+    for r in range(WORLD):
+        _assert_close(recv.host[r], expect, dt)
+
+
+@pytest.mark.parametrize("count", COUNTS)
+@pytest.mark.parametrize("func", FUNCS)
+def test_reduce_scatter(accl, rng, count, func):
+    dt = dataType.float32
+    send = accl.create_buffer(count * WORLD, dt)
+    recv = accl.create_buffer(count, dt)
+    send.host[:] = _fill(rng, (WORLD, count * WORLD), dt)
+    accl.reduce_scatter(send, recv, count, func)
+    for r in range(WORLD):
+        chunk = send.host[:, r * count:(r + 1) * count]
+        _assert_close(recv.host[r], _expect_reduce(chunk, func), dt)
+
+
+@pytest.mark.parametrize("count", [1, 25])
+@pytest.mark.parametrize("dt", [dataType.float32, dataType.int32])
+def test_alltoall(accl, rng, count, dt):
+    send = accl.create_buffer(count * WORLD, dt)
+    recv = accl.create_buffer(count * WORLD, dt)
+    send.host[:] = _fill(rng, (WORLD, count * WORLD), dt)
+    accl.alltoall(send, recv, count)
+    for r in range(WORLD):
+        for q in range(WORLD):
+            _assert_close(
+                recv.host[r, q * count:(q + 1) * count],
+                send.host[q, r * count:(r + 1) * count],
+                dt,
+            )
+
+
+def test_barrier(accl):
+    accl.barrier()
+
+
+# ---- compressed variants (ETH_COMPRESSED analog, test.cpp compressed tests)
+
+@pytest.mark.parametrize("count", [64])
+def test_bcast_compressed(accl, rng, count):
+    dt = dataType.float32
+    buf = accl.create_buffer(count, dt)
+    buf.host[:] = _fill(rng, (WORLD, count), dt)
+    rootdata = buf.host[2].copy()
+    accl.bcast(buf, count, 2, compress_dtype=dataType.bfloat16)
+    # payload traveled as bf16: expectation is the bf16-rounded root data
+    import jax.numpy as jnp
+    expect = np.asarray(jnp.asarray(rootdata).astype(jnp.bfloat16).astype(jnp.float32))
+    for r in range(WORLD):
+        np.testing.assert_allclose(buf.host[r], expect, rtol=1e-2, atol=1e-2)
+
+
+def test_allreduce_compressed(accl, rng):
+    count, dt = 64, dataType.float32
+    send = accl.create_buffer(count, dt)
+    recv = accl.create_buffer(count, dt)
+    send.host[:] = _fill(rng, (WORLD, count), dt)
+    accl.allreduce(send, recv, count, reduceFunction.SUM,
+                   compress_dtype=dataType.bfloat16)
+    expect = _expect_reduce(send.host, reduceFunction.SUM)
+    for r in range(WORLD):
+        np.testing.assert_allclose(recv.host[r], expect, rtol=0.05, atol=0.5)
+
+
+def test_unsupported_compression_pair(accl):
+    import pytest as _pytest
+    from accl_tpu import ACCLError, errorCode
+    buf = accl.create_buffer(8, dataType.int32)
+    with _pytest.raises(ACCLError) as e:
+        accl.bcast(buf, 8, 0, compress_dtype=dataType.float16)
+    assert errorCode.COMPRESSION_NOT_SUPPORTED in e.value.code
+
+
+# ---- multi-communicator (test.cpp:621-752 analog)
+
+def test_collectives_on_subcommunicator(accl, rng):
+    sub = accl.create_communicator([1, 2, 5, 6])
+    count, dt = 32, dataType.float32
+    send = accl.create_buffer(count, dt, comm=sub)
+    recv = accl.create_buffer(count, dt, comm=sub)
+    send.host[:] = _fill(rng, (4, count), dt)
+    accl.allreduce(send, recv, count, reduceFunction.SUM, comm=sub)
+    expect = _expect_reduce(send.host, reduceFunction.SUM)
+    for r in range(4):
+        _assert_close(recv.host[r], expect, dt)
+
+    buf = accl.create_buffer(count, dt, comm=sub)
+    buf.host[:] = _fill(rng, (4, count), dt)
+    rootdata = buf.host[3].copy()
+    accl.bcast(buf, count, 3, comm=sub)
+    for r in range(4):
+        _assert_close(buf.host[r], rootdata, dt)

@@ -1,0 +1,144 @@
+"""Two-sided send/recv + one-sided put tests.
+
+Ports the reference's send/recv matrix (test.cpp sendrecv basic/bo/
+segmentation/stream variants) onto the single-controller model: the
+controller issues posts on behalf of every rank; matching follows
+rxbuf_seek semantics (src, tag|ANY, seqn order).
+"""
+import numpy as np
+import pytest
+
+from accl_tpu import ACCLError, TAG_ANY, dataType, errorCode
+
+WORLD = 8
+
+
+def _fill(rng, shape, dt=np.float32):
+    return rng.standard_normal(shape).astype(dt)
+
+
+def test_sendrecv_basic(accl, rng):
+    count = 64
+    src = accl.create_buffer(count, dataType.float32)
+    dst = accl.create_buffer(count, dataType.float32)
+    src.host[:] = _fill(rng, (WORLD, count))
+    accl.send(src, count, src=0, dst=1, tag=5)
+    accl.recv(dst, count, src=0, dst=1, tag=5)
+    np.testing.assert_array_equal(dst.host[1], src.host[0])
+    # other ranks' recv buffer untouched
+    np.testing.assert_array_equal(dst.host[0], np.zeros(count, np.float32))
+
+
+def test_sendrecv_ping_pong(accl, rng):
+    """BASELINE.json config 1: ping-pong between two ranks."""
+    count = 128
+    a = accl.create_buffer(count, dataType.float32)
+    b = accl.create_buffer(count, dataType.float32)
+    a.host[:] = _fill(rng, (WORLD, count))
+    # rank0 -> rank1
+    accl.send(a, count, src=0, dst=1, tag=0)
+    accl.recv(b, count, src=0, dst=1, tag=0)
+    # rank1 -> rank0 (echo what it received)
+    accl.send(b, count, src=1, dst=0, tag=1, from_device=True)
+    accl.recv(a, count, src=1, dst=0, tag=1)
+    np.testing.assert_array_equal(a.host[0], a.host[0])
+    np.testing.assert_array_equal(b.host[1], a.host[0])
+
+
+def test_recv_before_send(accl, rng):
+    """Rendezvous-style: receiver announces first (async), sender completes."""
+    count = 32
+    src = accl.create_buffer(count, dataType.float32)
+    dst = accl.create_buffer(count, dataType.float32)
+    src.host[:] = _fill(rng, (WORLD, count))
+    req = accl.recv(dst, count, src=3, dst=4, tag=9, run_async=True)
+    accl.send(src, count, src=3, dst=4, tag=9)
+    req.wait()
+    dst.sync_from_device()
+    np.testing.assert_array_equal(dst.host[4], src.host[3])
+
+
+def test_recv_no_match_raises(accl):
+    dst = accl.create_buffer(16, dataType.float32)
+    with pytest.raises(ACCLError) as e:
+        accl.recv(dst, 16, src=6, dst=7, tag=1234)
+    assert errorCode.NOT_READY_ERROR in e.value.code
+    # clean up the parked recv so later tests aren't affected
+    accl.soft_reset()
+
+
+def test_tag_any(accl, rng):
+    count = 16
+    src = accl.create_buffer(count, dataType.float32)
+    dst = accl.create_buffer(count, dataType.float32)
+    src.host[:] = _fill(rng, (WORLD, count))
+    accl.send(src, count, src=2, dst=3, tag=77)
+    accl.recv(dst, count, src=2, dst=3, tag=TAG_ANY)
+    np.testing.assert_array_equal(dst.host[3], src.host[2])
+
+
+def test_ordered_delivery(accl, rng):
+    """Per-pair seqn ordering: two sends same pair, recvs get them in order."""
+    count = 8
+    s1 = accl.create_buffer(count, dataType.float32)
+    s2 = accl.create_buffer(count, dataType.float32)
+    d1 = accl.create_buffer(count, dataType.float32)
+    d2 = accl.create_buffer(count, dataType.float32)
+    s1.host[:] = _fill(rng, (WORLD, count))
+    s2.host[:] = _fill(rng, (WORLD, count))
+    accl.send(s1, count, src=4, dst=5, tag=1)
+    accl.send(s2, count, src=4, dst=5, tag=1)
+    accl.recv(d1, count, src=4, dst=5, tag=1)
+    accl.recv(d2, count, src=4, dst=5, tag=1)
+    np.testing.assert_array_equal(d1.host[5], s1.host[4])
+    np.testing.assert_array_equal(d2.host[5], s2.host[4])
+
+
+def test_send_snapshot_semantics(accl, rng):
+    """Sender may overwrite its buffer right after send() returns (buffered
+    send): the posted payload must be the at-post snapshot."""
+    count = 16
+    src = accl.create_buffer(count, dataType.float32)
+    dst = accl.create_buffer(count, dataType.float32)
+    src.host[:] = _fill(rng, (WORLD, count))
+    original = src.host[0].copy()
+    accl.send(src, count, src=0, dst=7, tag=3)
+    src.host[:] = 0.0
+    src.sync_to_device()
+    accl.recv(dst, count, src=0, dst=7, tag=3)
+    np.testing.assert_array_equal(dst.host[7], original)
+
+
+def test_put_one_sided(accl, rng):
+    count = 48
+    src = accl.create_buffer(count, dataType.float32)
+    dst = accl.create_buffer(count, dataType.float32)
+    src.host[:] = _fill(rng, (WORLD, count))
+    accl.put(src, dst, count, src=1, dst=6)
+    np.testing.assert_array_equal(dst.host[6], src.host[1])
+    np.testing.assert_array_equal(dst.host[0], np.zeros(count, np.float32))
+
+
+def test_sendrecv_on_slices(accl, rng):
+    """Segmentation analog: send from / recv into sub-ranges."""
+    src = accl.create_buffer(100, dataType.float32)
+    dst = accl.create_buffer(100, dataType.float32)
+    src.host[:] = _fill(rng, (WORLD, 100))
+    src.sync_to_device()
+    sl_src = src.slice(20, 52)
+    sl_dst = dst.slice(40, 72)
+    accl.send(sl_src, 32, src=0, dst=2, tag=8, from_device=True)
+    accl.recv(sl_dst, 32, src=0, dst=2, tag=8)
+    dst.sync_from_device()
+    np.testing.assert_array_equal(dst.host[2, 40:72], src.host[0, 20:52])
+    np.testing.assert_array_equal(dst.host[2, :40], np.zeros(40, np.float32))
+
+
+def test_sendrecv_int_dtype(accl, rng):
+    count = 31
+    src = accl.create_buffer(count, dataType.int32)
+    dst = accl.create_buffer(count, dataType.int32)
+    src.host[:] = rng.integers(-50, 50, (WORLD, count)).astype(np.int32)
+    accl.send(src, count, src=5, dst=0, tag=2)
+    accl.recv(dst, count, src=5, dst=0, tag=2)
+    np.testing.assert_array_equal(dst.host[0], src.host[5])

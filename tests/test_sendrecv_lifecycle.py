@@ -1,0 +1,94 @@
+"""Lifecycle regressions for the send/recv matching engine and request queue:
+failed-recv cleanup, seqn consistency across soft_reset, deferred async recv
+completion, count-mismatch atomicity, queue retirement.
+"""
+import numpy as np
+import pytest
+
+import accl_tpu
+from accl_tpu import ACCLError, dataType, errorCode, requestStatus
+
+
+@pytest.fixture()
+def fresh(accl):
+    """Snapshot-clean matching state around each lifecycle test."""
+    accl.soft_reset()
+    yield accl
+    accl.soft_reset()
+
+
+def test_failed_sync_recv_does_not_steal_send(fresh, rng):
+    acc = fresh
+    d = acc.create_buffer(8, dataType.float32)
+    s = acc.create_buffer(8, dataType.float32)
+    s.host[:] = rng.standard_normal((8, 8)).astype(np.float32)
+    with pytest.raises(ACCLError):
+        acc.recv(d, 8, src=2, dst=3, tag=9)
+    # the failed recv must not be parked: this send parks instead of matching
+    acc.send(s, 8, src=2, dst=3, tag=9)
+    assert acc.matcher().n_pending == (1, 0)
+    # and a retried recv gets it
+    acc.recv(d, 8, src=2, dst=3, tag=9)
+    np.testing.assert_array_equal(d.host[3], s.host[2])
+
+
+def test_soft_reset_realigns_sequences(fresh, rng):
+    acc = fresh
+    s = acc.create_buffer(8, dataType.float32)
+    d = acc.create_buffer(8, dataType.float32)
+    s.host[:] = rng.standard_normal((8, 8)).astype(np.float32)
+    acc.send(s, 8, src=0, dst=1, tag=1)     # seqn 0, parked
+    acc.soft_reset()                         # dropped; counters must realign
+    acc.send(s, 8, src=0, dst=1, tag=1)     # must get seqn 0 again
+    acc.recv(d, 8, src=0, dst=1, tag=1)     # must match
+    np.testing.assert_array_equal(d.host[1], s.host[0])
+
+
+def test_async_recv_not_complete_until_send(fresh, rng):
+    acc = fresh
+    s = acc.create_buffer(8, dataType.float32)
+    d = acc.create_buffer(8, dataType.float32)
+    s.host[:] = rng.standard_normal((8, 8)).astype(np.float32)
+    req = acc.recv(d, 8, src=4, dst=5, tag=2, run_async=True)
+    assert not req.test()                    # nothing delivered yet
+    assert req.status == requestStatus.QUEUED
+    acc.send(s, 8, src=4, dst=5, tag=2)
+    req.wait(timeout=5)
+    assert req.status == requestStatus.COMPLETED
+    np.testing.assert_array_equal(d.host[5], s.host[4])
+
+
+def test_async_recv_wait_times_out_unmatched(fresh):
+    acc = fresh
+    d = acc.create_buffer(8, dataType.float32)
+    req = acc.recv(d, 8, src=6, dst=7, tag=3, run_async=True)
+    with pytest.raises(accl_tpu.ACCLTimeoutError):
+        req.wait(timeout=0.05)
+
+
+def test_count_mismatch_preserves_seq_state(fresh, rng):
+    acc = fresh
+    s8 = acc.create_buffer(8, dataType.float32)
+    s16 = acc.create_buffer(16, dataType.float32)
+    d8 = acc.create_buffer(8, dataType.float32)
+    s8.host[:] = rng.standard_normal((8, 8)).astype(np.float32)
+    s16.host[:] = rng.standard_normal((8, 16)).astype(np.float32)
+    req = acc.recv(d8, 8, src=0, dst=2, tag=4, run_async=True)
+    with pytest.raises(ACCLError) as e:
+        acc.send(s16, 16, src=0, dst=2, tag=4)
+    assert errorCode.INVALID_BUFFER_SIZE in e.value.code
+    # the rejected send consumed no seqn: a correct send still matches
+    acc.send(s8, 8, src=0, dst=2, tag=4)
+    req.wait(timeout=5)
+    np.testing.assert_array_equal(d8.host[2], s8.host[0])
+
+
+def test_async_requests_retire_from_queue(fresh, rng):
+    acc = fresh
+    a = acc.create_buffer(32, dataType.float32)
+    b = acc.create_buffer(32, dataType.float32)
+    a.host[:] = rng.standard_normal((8, 32)).astype(np.float32)
+    reqs = [acc.copy(a, b, 32, run_async=True) for _ in range(5)]
+    for r in reqs:
+        r.wait()
+    assert len(acc._queue.inflight) == 0
