@@ -37,7 +37,7 @@ from .constants import (
     operation,
     reduceFunction,
 )
-from .parallel import primitives
+from .parallel import algorithms, primitives
 from .parallel.compiler import ProgramCache
 from .request import Request, RequestQueue
 from .sendrecv import MatchingEngine, RecvPost, SendPost
@@ -436,15 +436,20 @@ class ACCL:
         run_async: bool = False,
         comm: Optional[Communicator] = None,
         compress_dtype: Optional[dataType] = None,
+        algorithm: Optional[Algorithm] = None,
     ) -> Optional[Request]:
         """``ACCL::bcast`` (accl.cpp; fw :798-990)."""
         comm = comm or self.comms[0]
         self._check_count(buf, count, "bcast")
         arith = self._arith(buf.dtype, compress_dtype)
+        algo = algorithms.select(
+            operation.bcast, count * constants.dtype_size(buf.dtype),
+            comm, self.config, algorithm)
         x = self._input(buf, count, from_device)
         prog = self._programs.get(
-            self._key(comm, operation.bcast, count, buf.dtype, root, compress_dtype),
-            lambda: primitives.build_bcast(comm, root, arith),
+            self._key(comm, operation.bcast, count, buf.dtype, root,
+                      compress_dtype, algo),
+            lambda: algorithms.build_bcast(comm, root, algo, arith),
         )
         y = prog(x)
         self._store(buf, count, y)
@@ -516,6 +521,7 @@ class ACCL:
         run_async: bool = False,
         comm: Optional[Communicator] = None,
         compress_dtype: Optional[dataType] = None,
+        algorithm: Optional[Algorithm] = None,
     ) -> Optional[Request]:
         """``ACCL::allgather`` (fw :1299-1505)."""
         comm = comm or self.comms[0]
@@ -523,10 +529,14 @@ class ACCL:
         self._check_count(sendbuf, count, "allgather send")
         self._check_count(recvbuf, count * world, "allgather recv")
         arith = self._arith(sendbuf.dtype, compress_dtype)
+        algo = algorithms.select(
+            operation.allgather, count * constants.dtype_size(sendbuf.dtype),
+            comm, self.config, algorithm)
         x = self._input(sendbuf, count, from_device)
         prog = self._programs.get(
-            self._key(comm, operation.allgather, count, sendbuf.dtype, compress_dtype),
-            lambda: primitives.build_allgather(comm, arith),
+            self._key(comm, operation.allgather, count, sendbuf.dtype,
+                      compress_dtype, algo),
+            lambda: algorithms.build_allgather(comm, algo, arith),
         )
         y = prog(x).astype(recvbuf.jnp_dtype)
         self._store(recvbuf, count * world, y)
@@ -544,6 +554,7 @@ class ACCL:
         run_async: bool = False,
         comm: Optional[Communicator] = None,
         compress_dtype: Optional[dataType] = None,
+        algorithm: Optional[Algorithm] = None,
     ) -> Optional[Request]:
         """``ACCL::reduce`` (fw :1509-1744)."""
         comm = comm or self.comms[0]
@@ -552,12 +563,16 @@ class ACCL:
         arith = self._arith(sendbuf.dtype, compress_dtype)
         if arith is not None and not arith.supports(function):
             raise ACCLError(errorCode.ARITH_ERROR, f"{function} unsupported")
+        algo = algorithms.select(
+            operation.reduce, count * constants.dtype_size(sendbuf.dtype),
+            comm, self.config, algorithm)
         x = self._input(sendbuf, count, from_device)
         r = self._input(recvbuf, count, True)
         prog = self._programs.get(
             self._key(comm, operation.reduce, count, sendbuf.dtype, root, function,
-                      compress_dtype),
-            lambda: primitives.build_reduce(comm, root, function, sendbuf.dtype, arith),
+                      compress_dtype, algo),
+            lambda: algorithms.build_reduce(
+                comm, root, function, sendbuf.dtype, algo, arith),
         )
         y = prog(x, r)
         self._store(recvbuf, count, y)
@@ -574,6 +589,7 @@ class ACCL:
         run_async: bool = False,
         comm: Optional[Communicator] = None,
         compress_dtype: Optional[dataType] = None,
+        algorithm: Optional[Algorithm] = None,
     ) -> Optional[Request]:
         """``ACCL::allreduce`` (accl.cpp:796-842; fw :1855-2075) — the hot path."""
         comm = comm or self.comms[0]
@@ -582,11 +598,15 @@ class ACCL:
         arith = self._arith(sendbuf.dtype, compress_dtype)
         if arith is not None and not arith.supports(function):
             raise ACCLError(errorCode.ARITH_ERROR, f"{function} unsupported")
+        algo = algorithms.select(
+            operation.allreduce, count * constants.dtype_size(sendbuf.dtype),
+            comm, self.config, algorithm)
         x = self._input(sendbuf, count, from_device)
         prog = self._programs.get(
             self._key(comm, operation.allreduce, count, sendbuf.dtype, function,
-                      compress_dtype),
-            lambda: primitives.build_allreduce(comm, function, sendbuf.dtype, arith),
+                      compress_dtype, algo),
+            lambda: algorithms.build_allreduce(
+                comm, function, sendbuf.dtype, algo, arith),
         )
         y = prog(x).astype(recvbuf.jnp_dtype)
         self._store(recvbuf, count, y)
@@ -603,6 +623,7 @@ class ACCL:
         run_async: bool = False,
         comm: Optional[Communicator] = None,
         compress_dtype: Optional[dataType] = None,
+        algorithm: Optional[Algorithm] = None,
     ) -> Optional[Request]:
         """``ACCL::reduce_scatter``: ``count*world`` in, ``count`` out per rank
         (fw :1748-1852)."""
@@ -611,11 +632,16 @@ class ACCL:
         self._check_count(sendbuf, count * world, "reduce_scatter send")
         self._check_count(recvbuf, count, "reduce_scatter recv")
         arith = self._arith(sendbuf.dtype, compress_dtype)
+        algo = algorithms.select(
+            operation.reduce_scatter,
+            count * world * constants.dtype_size(sendbuf.dtype),
+            comm, self.config, algorithm)
         x = self._input(sendbuf, count * world, from_device)
         prog = self._programs.get(
             self._key(comm, operation.reduce_scatter, count, sendbuf.dtype, function,
-                      compress_dtype),
-            lambda: primitives.build_reduce_scatter(comm, function, sendbuf.dtype, arith),
+                      compress_dtype, algo),
+            lambda: algorithms.build_reduce_scatter(
+                comm, function, sendbuf.dtype, algo, arith),
         )
         y = prog(x).astype(recvbuf.jnp_dtype)
         self._store(recvbuf, count, y)

@@ -1,0 +1,129 @@
+"""Runtime algorithm selection (SURVEY.md §2.6 "selectable strategies").
+
+The reference firmware picks flat vs binary-tree vs ring per call from
+size/world thresholds held in tuning registers (``ccl_offload_control.c:
+816,1533``; written at init from ``accl.cpp:1214-1224``). This module is
+that selector for the TPU build: given (operation, payload bytes, world,
+config) it returns the algorithm family, and dispatches to the matching
+program builder.
+
+Defaults: XLA-native single-shot programs for small/medium payloads (XLA's
+own collectives are the latency-optimal "rendezvous single move" path on
+ICI), explicit chunked ring for large payloads where fixed reduction order
+and per-hop compression matter, hierarchical 2-D for very large payloads on
+composite world sizes. Every family remains force-selectable per call —
+the tuning-register analog.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..arithconfig import ArithConfig
+from ..communicator import Communicator
+from ..config import ACCLConfig, Algorithm
+from ..constants import dataType, operation, reduceFunction
+from . import hierarchical, primitives, ring, tree
+
+#: payload size above which AUTO prefers the explicit ring (bytes)
+RING_THRESHOLD = 4 * 1024 * 1024
+#: payload size above which AUTO prefers hierarchical 2D on composite worlds
+HIER_THRESHOLD = 64 * 1024 * 1024
+
+_SUPPORTED = {
+    operation.bcast: {Algorithm.XLA, Algorithm.FLAT, Algorithm.TREE, Algorithm.RING},
+    operation.reduce: {Algorithm.XLA, Algorithm.FLAT, Algorithm.TREE, Algorithm.RING},
+    operation.allreduce: {Algorithm.XLA, Algorithm.FLAT, Algorithm.TREE,
+                          Algorithm.RING, Algorithm.HIERARCHICAL},
+    operation.allgather: {Algorithm.XLA, Algorithm.RING},
+    operation.reduce_scatter: {Algorithm.XLA, Algorithm.RING},
+    operation.scatter: {Algorithm.XLA},
+    operation.gather: {Algorithm.XLA},
+    operation.alltoall: {Algorithm.XLA},
+}
+
+
+def supported(op: operation, algo: Algorithm) -> bool:
+    return algo in _SUPPORTED.get(op, {Algorithm.XLA})
+
+
+def select(
+    op: operation,
+    nbytes: int,
+    comm: Communicator,
+    cfg: ACCLConfig,
+    requested: Optional[Algorithm] = None,
+) -> Algorithm:
+    """Resolve the algorithm for one call (threshold logic analog of
+    fw bcast/reduce `... <= *_FLAT_TREE_MAX_RANKS` selection)."""
+    algo = requested or cfg.algorithm
+    if algo != Algorithm.AUTO:
+        if not supported(op, algo):
+            raise ValueError(f"{algo} not supported for {op.name}")
+        return algo
+    world = comm.world_size
+    if world == 1:
+        return Algorithm.XLA
+    if op == operation.allreduce and nbytes >= HIER_THRESHOLD \
+            and hierarchical.factor2d(world) is not None:
+        return Algorithm.HIERARCHICAL
+    if op in (operation.allreduce, operation.allgather, operation.reduce_scatter) \
+            and nbytes >= RING_THRESHOLD:
+        return Algorithm.RING
+    if op in (operation.bcast, operation.reduce) \
+            and comm.world_size > cfg.bcast_flat_tree_max_ranks \
+            and nbytes > cfg.max_eager_size:
+        return Algorithm.TREE
+    return Algorithm.XLA
+
+
+# ---------------------------------------------------------------------------
+# builder dispatch
+# ---------------------------------------------------------------------------
+
+def build_bcast(comm, root: int, algo: Algorithm,
+                arith: Optional[ArithConfig]) -> Callable:
+    if algo == Algorithm.TREE:
+        return tree.build_tree_bcast(comm, root, arith)
+    if algo == Algorithm.RING:
+        return ring.build_ring_bcast(comm, root, arith)
+    return primitives.build_bcast(comm, root, arith)  # XLA / FLAT one-shot
+
+
+def build_reduce(comm, root: int, func: reduceFunction, dt: dataType,
+                 algo: Algorithm, arith: Optional[ArithConfig]) -> Callable:
+    if algo == Algorithm.TREE:
+        return tree.build_tree_reduce(comm, root, func, dt, arith)
+    if algo == Algorithm.RING:
+        return ring.build_ring_reduce(comm, root, func, dt, arith)
+    return primitives.build_reduce(comm, root, func, dt, arith)
+
+
+def build_allreduce(comm, func: reduceFunction, dt: dataType, algo: Algorithm,
+                    arith: Optional[ArithConfig]) -> Callable:
+    if algo == Algorithm.RING:
+        return ring.build_ring_allreduce(comm, func, dt, arith)
+    if algo == Algorithm.TREE:
+        return tree.build_tree_allreduce(comm, func, dt, arith)
+    if algo == Algorithm.HIERARCHICAL:
+        rc = hierarchical.factor2d(comm.world_size)
+        if rc is None:
+            raise ValueError(
+                f"hierarchical allreduce needs a composite world, got {comm.world_size}"
+            )
+        return hierarchical.build_hier_allreduce(comm, rc[0], rc[1], func, dt, arith)
+    return primitives.build_allreduce(comm, func, dt, arith)
+
+
+def build_allgather(comm, algo: Algorithm,
+                    arith: Optional[ArithConfig]) -> Callable:
+    if algo == Algorithm.RING:
+        return ring.build_ring_allgather(comm, arith)
+    return primitives.build_allgather(comm, arith)
+
+
+def build_reduce_scatter(comm, func: reduceFunction, dt: dataType,
+                         algo: Algorithm,
+                         arith: Optional[ArithConfig]) -> Callable:
+    if algo == Algorithm.RING:
+        return ring.build_ring_reduce_scatter(comm, func, dt, arith)
+    return primitives.build_reduce_scatter(comm, func, dt, arith)

@@ -1,0 +1,134 @@
+"""Hierarchical 2-D mesh collectives (BASELINE.json config 5).
+
+The reference selects flat vs tree vs ring by size/world thresholds; the
+north star adds a **hierarchical reduce->bcast all-reduce over a 2D ICI
+mesh**. On a TPU torus, a 2-D decomposition keeps every hop on a physical
+ICI link of its own axis and multiplies effective bisection bandwidth:
+
+  phase 1: reduce-scatter within each row   (payload n -> n/cols per rank)
+  phase 2: all-reduce across columns        (on the n/cols shard)
+  phase 3: all-gather within each row       (shard -> full payload)
+
+Implementation: the program reshapes the communicator's 1-D (world, n)
+array onto a true 2-D ``Mesh`` (``Communicator.mesh2d``, rank r at
+(r // cols, r % cols), raster order — so the reshape is layout-preserving
+and costs no data movement) and runs each phase as an XLA collective over
+one named mesh axis. This is exactly how a multi-axis ICI torus is meant to
+be driven: per-axis collectives, XLA scheduling the overlap.
+
+The latency-oriented variant (reduce to rank 0 then broadcast, literally
+"reduce->bcast") is :func:`build_hier_reduce_bcast`.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..arithconfig import ArithConfig
+from ..communicator import Communicator
+from ..constants import dataType, reduceFunction
+from .primitives import _unwire, _wire
+
+ROW_AXIS = "accl_y"  # which row (changes along a column)
+COL_AXIS = "accl_x"  # which column (changes along a row)
+
+
+def factor2d(world: int) -> Optional[Tuple[int, int]]:
+    """Most-square (rows, cols) factorization, None if world is prime/1."""
+    best = None
+    for rows in range(2, int(world ** 0.5) + 1):
+        if world % rows == 0:
+            best = (rows, world // rows)
+    return best
+
+
+def _smap2d(comm: Communicator, rows: int, cols: int, body) -> Callable:
+    """jit(reshape -> shard_map over the 2-D mesh -> reshape back)."""
+    mesh2 = comm.mesh2d(rows, cols, axis_names=(ROW_AXIS, COL_AXIS))
+    inner = shard_map(
+        body, mesh=mesh2,
+        in_specs=P(ROW_AXIS, COL_AXIS, None),
+        out_specs=P(ROW_AXIS, COL_AXIS, None),
+    )
+
+    @jax.jit
+    def prog(x):  # x: (world, n) sharded along the 1-D communicator axis
+        n = x.shape[-1]
+        out = inner(x.reshape(rows, cols, n))
+        return out.reshape(rows * cols, -1)
+
+    return prog
+
+
+def build_hier_allreduce(
+    comm: Communicator,
+    rows: int,
+    cols: int,
+    func: reduceFunction,
+    dt: dataType,
+    arith: Optional[ArithConfig] = None,
+) -> Callable:
+    """2D reduce-scatter / cross-axis all-reduce / all-gather (bandwidth
+    variant): per-link traffic ~ n/cols on the row axis + n/cols on the
+    column axis, vs ~n for a flat 1-D ring."""
+    if rows * cols != comm.world_size:
+        raise ValueError(f"{rows}x{cols} != world {comm.world_size}")
+
+    def body(v):  # (1, 1, n)
+        n = v.shape[-1]
+        pad = (-n) % cols
+        x = jnp.pad(v[0, 0], (0, pad))
+        w = _wire(x, arith)
+        if func == reduceFunction.SUM:
+            shard = lax.psum_scatter(
+                w.reshape(cols, -1), COL_AXIS, scatter_dimension=0, tiled=False
+            )
+            shard = lax.psum(shard, ROW_AXIS)
+            full = lax.all_gather(shard, COL_AXIS, tiled=True)
+        elif func == reduceFunction.MAX:
+            full = lax.pmax(lax.pmax(w, COL_AXIS), ROW_AXIS)
+        else:
+            raise ValueError(func)
+        out = _unwire(full, arith, v.dtype)
+        return out[:n][None, None, :] if pad else out[None, None, :]
+
+    return _smap2d(comm, rows, cols, body)
+
+
+def build_hier_reduce_bcast(
+    comm: Communicator,
+    rows: int,
+    cols: int,
+    func: reduceFunction,
+    dt: dataType,
+    arith: Optional[ArithConfig] = None,
+) -> Callable:
+    """Hierarchical reduce->bcast allreduce (latency variant, the literal
+    BASELINE.json "hierarchical reduce->bcast" config): reduce within rows to
+    the row leader (column 0), reduce leaders across rows, broadcast back."""
+    if rows * cols != comm.world_size:
+        raise ValueError(f"{rows}x{cols} != world {comm.world_size}")
+
+    def body(v):  # (1, 1, n)
+        x = v[0, 0]
+        w = _wire(x, arith)
+        col = lax.axis_index(COL_AXIS)
+        if func == reduceFunction.SUM:
+            row_tot = lax.psum(w, COL_AXIS)
+            # only the leader column carries the row total upward
+            contrib = jnp.where(col == 0, row_tot, jnp.zeros_like(row_tot))
+            tot = lax.psum(contrib, ROW_AXIS)      # global at column 0
+            leader_val = jnp.where(col == 0, tot, jnp.zeros_like(tot))
+            total = lax.psum(leader_val, COL_AXIS)  # bcast across the row
+        elif func == reduceFunction.MAX:
+            total = lax.pmax(lax.pmax(w, COL_AXIS), ROW_AXIS)
+        else:
+            raise ValueError(func)
+        out = _unwire(total, arith, v.dtype)
+        return out[None, None, :]
+
+    return _smap2d(comm, rows, cols, body)

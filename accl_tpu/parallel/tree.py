@@ -1,0 +1,139 @@
+"""Binary-tree collectives (recursive doubling/halving, SURVEY.md §2.6).
+
+Re-expresses the reference's tree algorithms — binary-tree broadcast with
+doubling senders (``ccl_offload_control.c:816-869``) and binary-tree reduce
+with fused combine+send (``:1603-1728``) — as log2(P) masked ``ppermute``
+steps. Each step's (src, dst) pair list is static (root is a compile-time
+constant, like the reference's per-call root argument baked into the move
+sequence), so XLA sees a fixed log-depth communication schedule.
+
+Latency-optimal for small payloads: log2(P) hops vs the ring's P-1.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..arithconfig import ArithConfig
+from ..communicator import Communicator
+from ..constants import dataType, reduceFunction
+from .. import ops
+from .primitives import AXIS, _smap
+
+
+def _ceil_log2(n: int) -> int:
+    return max(1, math.ceil(math.log2(n))) if n > 1 else 0
+
+
+def _maybe_compress(buf, arith: Optional[ArithConfig]):
+    if arith is not None and arith.is_compressing:
+        return ops.compress(buf, arith.uncompressed, arith.compressed)
+    return buf
+
+
+def _maybe_decompress(buf, arith: Optional[ArithConfig], dtype):
+    if arith is not None and arith.is_compressing:
+        return ops.decompress(buf, arith.compressed, arith.uncompressed).astype(dtype)
+    return buf
+
+
+def build_tree_bcast(comm: Communicator, root: int,
+                     arith: Optional[ArithConfig] = None) -> Callable:
+    """Binary-tree broadcast, doubling senders each round (fw :816-869).
+
+    Round k: ranks at relative position < 2^k forward to relative
+    position + 2^k. After ceil(log2(P)) rounds everyone holds root's data.
+    """
+    world = comm.world_size
+    rounds = _ceil_log2(world)
+
+    def body(x):
+        rank = lax.axis_index(AXIS)
+        rel = jnp.mod(rank - root, world)
+        buf = x[0]
+        for k in range(rounds):
+            half = 1 << k
+            perm = [
+                ((root + i) % world, (root + i + half) % world)
+                for i in range(half)
+                if i + half < world
+            ]
+            wire = _maybe_compress(buf, arith)
+            moved = _maybe_decompress(
+                lax.ppermute(wire, AXIS, perm), arith, buf.dtype
+            )
+            is_receiver = (rel >= half) & (rel < 2 * half)
+            buf = jnp.where(is_receiver, moved, buf)
+        return buf[None, :]
+
+    return _smap(comm, body, 1)
+
+
+def build_tree_reduce(comm: Communicator, root: int, func: reduceFunction,
+                      dt: dataType,
+                      arith: Optional[ArithConfig] = None) -> Callable:
+    """Binary-tree reduce, halving senders each round (fw :1603-1728).
+
+    Round k: ranks whose relative position is an odd multiple of 2^k send
+    their partial to relative position - 2^k, which folds it in (the fused
+    combine+send of the reference, kept stateless per step like :1626-1628).
+    """
+    world = comm.world_size
+    rounds = _ceil_log2(world)
+
+    def body(send, recv):
+        rank = lax.axis_index(AXIS)
+        rel = jnp.mod(rank - root, world)
+        acc = send[0]
+        for k in range(rounds):
+            half = 1 << k
+            perm = [
+                ((root + i) % world, (root + i - half) % world)
+                for i in range(world)
+                if i % (2 * half) == half
+            ]
+            wire = _maybe_compress(acc, arith)
+            moved = _maybe_decompress(
+                lax.ppermute(wire, AXIS, perm), arith, acc.dtype
+            )
+            is_receiver = (jnp.mod(rel, 2 * half) == 0) & (rel + half < world)
+            acc = jnp.where(is_receiver, ops.combine(acc, moved, func, dt), acc)
+        out = jnp.where(rel == 0, acc.astype(recv.dtype), recv[0])
+        return out[None, :]
+
+    return _smap(comm, body, 2)
+
+
+def build_tree_allreduce(comm: Communicator, func: reduceFunction,
+                         dt: dataType,
+                         arith: Optional[ArithConfig] = None) -> Callable:
+    """Reduce-to-0 + broadcast-from-0 composition — the reference's
+    rendezvous allreduce (``:1878-1887`` reduce(root 0) then bcast)."""
+    world = comm.world_size
+    rounds = _ceil_log2(world)
+
+    def body(x):
+        rank = lax.axis_index(AXIS)
+        acc = x[0]
+        # reduce to rank 0
+        for k in range(rounds):
+            half = 1 << k
+            perm = [(i, i - half) for i in range(world) if i % (2 * half) == half]
+            wire = _maybe_compress(acc, arith)
+            moved = _maybe_decompress(lax.ppermute(wire, AXIS, perm), arith, acc.dtype)
+            is_receiver = (jnp.mod(rank, 2 * half) == 0) & (rank + half < world)
+            acc = jnp.where(is_receiver, ops.combine(acc, moved, func, dt), acc)
+        # broadcast from rank 0
+        for k in range(rounds):
+            half = 1 << k
+            perm = [(i, i + half) for i in range(half) if i + half < world]
+            wire = _maybe_compress(acc, arith)
+            moved = _maybe_decompress(lax.ppermute(wire, AXIS, perm), arith, acc.dtype)
+            is_receiver = (rank >= half) & (rank < 2 * half)
+            acc = jnp.where(is_receiver, moved, acc)
+        return acc[None, :]
+
+    return _smap(comm, body, 1)

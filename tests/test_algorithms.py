@@ -1,0 +1,182 @@
+"""Algorithmic collectives v2: explicit ring / tree / hierarchical variants
+must agree with the XLA-delegating reference implementations (and with host
+expectations) — the algorithm-inventory parity matrix of SURVEY.md §2.6.
+"""
+import numpy as np
+import pytest
+
+from accl_tpu import Algorithm, dataType, reduceFunction
+from accl_tpu.parallel import algorithms
+from accl_tpu.parallel.hierarchical import factor2d
+from accl_tpu.constants import operation
+
+WORLD = 8
+ALGOS_ALLREDUCE = [Algorithm.XLA, Algorithm.RING, Algorithm.TREE,
+                   Algorithm.HIERARCHICAL]
+
+
+def _fill(rng, shape, dt):
+    import accl_tpu.constants as c
+    nd = np.dtype(c.to_jax_dtype(dt))
+    if np.issubdtype(nd, np.floating):
+        return rng.standard_normal(shape).astype(nd)
+    return rng.integers(-100, 100, shape).astype(nd)
+
+
+@pytest.mark.parametrize("algo", ALGOS_ALLREDUCE)
+@pytest.mark.parametrize("func", [reduceFunction.SUM, reduceFunction.MAX])
+@pytest.mark.parametrize("count", [1, 25, 256])
+def test_allreduce_algorithms(accl, rng, algo, func, count):
+    dt = dataType.int32  # int: every algorithm must be exactly equal
+    send = accl.create_buffer(count, dt)
+    recv = accl.create_buffer(count, dt)
+    send.host[:] = _fill(rng, (WORLD, count), dt)
+    accl.allreduce(send, recv, count, func, algorithm=algo)
+    if func == reduceFunction.SUM:
+        expect = send.host.sum(0)
+    else:
+        expect = send.host.max(0)
+    for r in range(WORLD):
+        np.testing.assert_array_equal(recv.host[r], expect)
+
+
+@pytest.mark.parametrize("algo", ALGOS_ALLREDUCE)
+def test_allreduce_algorithms_float(accl, rng, algo):
+    count, dt = 96, dataType.float32
+    send = accl.create_buffer(count, dt)
+    recv = accl.create_buffer(count, dt)
+    send.host[:] = _fill(rng, (WORLD, count), dt)
+    accl.allreduce(send, recv, count, reduceFunction.SUM, algorithm=algo)
+    expect = send.host.astype(np.float64).sum(0)
+    for r in range(WORLD):
+        np.testing.assert_allclose(recv.host[r], expect, rtol=1e-4, atol=1e-5)
+
+
+def test_ring_allreduce_deterministic(accl, rng):
+    """Fixed ring order -> bit-identical results across runs (the
+    reproducibility guarantee the reference's fixed traversal gives)."""
+    count, dt = 64, dataType.float32
+    send = accl.create_buffer(count, dt)
+    send.host[:] = _fill(rng, (WORLD, count), dt)
+    results = []
+    for _ in range(2):
+        recv = accl.create_buffer(count, dt)
+        accl.allreduce(send, recv, count, reduceFunction.SUM,
+                       algorithm=Algorithm.RING)
+        results.append(recv.host.copy())
+    np.testing.assert_array_equal(results[0], results[1])
+
+
+@pytest.mark.parametrize("algo", [Algorithm.XLA, Algorithm.TREE, Algorithm.RING])
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_bcast_algorithms(accl, rng, algo, root):
+    count, dt = 40, dataType.float32
+    buf = accl.create_buffer(count, dt)
+    buf.host[:] = _fill(rng, (WORLD, count), dt)
+    rootdata = buf.host[root].copy()
+    accl.bcast(buf, count, root, algorithm=algo)
+    for r in range(WORLD):
+        np.testing.assert_array_equal(buf.host[r], rootdata)
+
+
+@pytest.mark.parametrize("algo", [Algorithm.XLA, Algorithm.TREE, Algorithm.RING])
+@pytest.mark.parametrize("root", [0, 5])
+@pytest.mark.parametrize("func", [reduceFunction.SUM, reduceFunction.MAX])
+def test_reduce_algorithms(accl, rng, algo, root, func):
+    count, dt = 48, dataType.int32
+    send = accl.create_buffer(count, dt)
+    recv = accl.create_buffer(count, dt)
+    send.host[:] = _fill(rng, (WORLD, count), dt)
+    prior = _fill(rng, (WORLD, count), dt)
+    recv.host[:] = prior
+    accl.reduce(send, recv, count, root, func, algorithm=algo)
+    expect = send.host.sum(0) if func == reduceFunction.SUM else send.host.max(0)
+    np.testing.assert_array_equal(recv.host[root], expect)
+    for r in range(WORLD):
+        if r != root:
+            np.testing.assert_array_equal(recv.host[r], prior[r])
+
+
+@pytest.mark.parametrize("algo", [Algorithm.XLA, Algorithm.RING])
+def test_allgather_algorithms(accl, rng, algo):
+    count, dt = 33, dataType.float32
+    send = accl.create_buffer(count, dt)
+    recv = accl.create_buffer(count * WORLD, dt)
+    send.host[:] = _fill(rng, (WORLD, count), dt)
+    accl.allgather(send, recv, count, algorithm=algo)
+    for r in range(WORLD):
+        np.testing.assert_array_equal(recv.host[r], send.host.reshape(-1))
+
+
+@pytest.mark.parametrize("algo", [Algorithm.XLA, Algorithm.RING])
+@pytest.mark.parametrize("func", [reduceFunction.SUM, reduceFunction.MAX])
+def test_reduce_scatter_algorithms(accl, rng, algo, func):
+    count, dt = 16, dataType.int32
+    send = accl.create_buffer(count * WORLD, dt)
+    recv = accl.create_buffer(count, dt)
+    send.host[:] = _fill(rng, (WORLD, count * WORLD), dt)
+    accl.reduce_scatter(send, recv, count, func, algorithm=algo)
+    for r in range(WORLD):
+        chunk = send.host[:, r * count:(r + 1) * count]
+        expect = chunk.sum(0) if func == reduceFunction.SUM else chunk.max(0)
+        np.testing.assert_array_equal(recv.host[r], expect)
+
+
+def test_ring_allreduce_compressed_per_hop(accl, rng):
+    """Wire compression applies per ring hop (ETH_COMPRESSED analog)."""
+    count, dt = 64, dataType.float32
+    send = accl.create_buffer(count, dt)
+    recv = accl.create_buffer(count, dt)
+    send.host[:] = _fill(rng, (WORLD, count), dt)
+    accl.allreduce(send, recv, count, reduceFunction.SUM,
+                   compress_dtype=dataType.bfloat16, algorithm=Algorithm.RING)
+    expect = send.host.astype(np.float64).sum(0)
+    # bf16 rounding accumulates over 2(P-1) hops: loose tolerance
+    for r in range(WORLD):
+        np.testing.assert_allclose(recv.host[r], expect, rtol=0.1, atol=1.0)
+
+
+def test_hier_reduce_bcast_variant(accl, rng):
+    """The literal reduce->bcast hierarchical variant (BASELINE config 5)."""
+    from accl_tpu.parallel.hierarchical import build_hier_reduce_bcast
+    import jax
+    count, dt = 64, dataType.float32
+    comm = accl.global_comm()
+    prog = build_hier_reduce_bcast(comm, 2, 4, reduceFunction.SUM, dt)
+    data = _fill(rng, (WORLD, count), dt)
+    x = jax.device_put(data, comm.sharding())
+    y = np.asarray(prog(x))
+    expect = data.astype(np.float64).sum(0)
+    for r in range(WORLD):
+        np.testing.assert_allclose(y[r], expect, rtol=1e-4, atol=1e-5)
+
+
+def test_factor2d():
+    assert factor2d(8) == (2, 4)
+    assert factor2d(16) == (4, 4)
+    assert factor2d(7) is None
+    assert factor2d(1) is None
+
+
+def test_auto_selection_thresholds(accl):
+    cfg = accl.config
+    comm = accl.global_comm()
+    # small payload -> XLA
+    assert algorithms.select(operation.allreduce, 1024, comm, cfg) == Algorithm.XLA
+    # large payload -> RING
+    assert algorithms.select(
+        operation.allreduce, 8 * 1024 * 1024, comm, cfg) == Algorithm.RING
+    # huge payload on composite world -> HIERARCHICAL
+    assert algorithms.select(
+        operation.allreduce, 128 * 1024 * 1024, comm, cfg) == Algorithm.HIERARCHICAL
+    # explicit request wins
+    assert algorithms.select(
+        operation.allreduce, 1024, comm, cfg, Algorithm.TREE) == Algorithm.TREE
+
+
+def test_unsupported_algorithm_rejected(accl):
+    import pytest as _pytest
+    from accl_tpu.constants import operation as op
+    with _pytest.raises(ValueError):
+        algorithms.select(op.scatter, 1024, accl.global_comm(), accl.config,
+                          Algorithm.RING)
