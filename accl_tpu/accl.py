@@ -291,9 +291,12 @@ class ACCL:
             raise ACCLError(errorCode.ARITH_ERROR, "combine operand dtype mismatch")
         a = self._input(val1, count, val1_from_device)
         b = self._input(val2, count, val2_from_device)
+        use_pallas = self.config.use_pallas and self.config.enable_arith
         prog = self._programs.get(
-            self._key(comm, operation.combine, count, val1.dtype, function),
-            lambda: primitives.build_combine(comm, function, val1.dtype),
+            self._key(comm, operation.combine, count, val1.dtype, function,
+                      use_pallas),
+            lambda: primitives.build_combine(comm, function, val1.dtype,
+                                             use_pallas=use_pallas),
         )
         y = prog(a, b).astype(result.jnp_dtype)
         self._store(result, count, y)

@@ -1,0 +1,123 @@
+"""Pallas dtype-cast kernels — the ``hp_compression`` plugin.
+
+The reference compresses f32 streams to f16 at 2:1 width in a dedicated HLS
+lane in front of the packetizer (``kernels/plugins/hp_compression/
+hp_compression.cpp:30-144``, TDEST 0 = compress, 1 = decompress, with
+keep-mask handling for ragged tails). On TPU the wire dtype of choice is
+bf16 (same exponent range as f32 — safer for gradients than f16); both
+bf16 and f16 lanes are provided, plus a stochastic-rounding compress
+variant for repeated-compression workloads (ragged tails are handled by
+grid padding instead of keep-masks).
+
+As with the reduction lanes, the registry's default stays the plain
+``astype`` so XLA fuses the cast into the collective schedule; the Pallas
+kernels are the explicit standalone lanes.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..constants import dataType, to_jax_dtype
+
+_LANES = 128
+_BLOCK_ROWS = 256
+
+#: supported (src, dst) cast lanes
+CAST_PAIRS = (
+    (dataType.float32, dataType.bfloat16),
+    (dataType.bfloat16, dataType.float32),
+    (dataType.float32, dataType.float16),
+    (dataType.float16, dataType.float32),
+)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _cast_kernel(x_ref, o_ref, *, dst):
+    o_ref[:] = x_ref[:].astype(dst)
+
+
+@functools.partial(jax.jit, static_argnames=("dst",))
+def _pallas_cast_2d(x, dst):
+    m = x.shape[0]
+    grid = (pl.cdiv(m, _BLOCK_ROWS),)
+    in_spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM)
+    out_spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        functools.partial(_cast_kernel, dst=dst),
+        out_shape=jax.ShapeDtypeStruct(x.shape, dst),
+        grid=grid,
+        in_specs=[in_spec],
+        out_specs=out_spec,
+        interpret=_interpret(),
+    )(x)
+
+
+def pallas_cast(x, dst_dtype):
+    """Cast via the Pallas lane, any shape (pads to the tile grid)."""
+    shape = x.shape
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    tile = _BLOCK_ROWS * _LANES
+    pad = (-n) % tile
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    out = _pallas_cast_2d(flat.reshape(-1, _LANES), dst_dtype).reshape(-1)
+    if pad:
+        out = out[:n]
+    return out.reshape(shape)
+
+
+def _sr_kernel(x_ref, seed_ref, o_ref, *, dst):
+    pltpu.prng_seed(seed_ref[0])
+    bits = pltpu.bitcast(pltpu.prng_random_bits(x_ref.shape), jnp.uint32)
+    o_ref[:] = pltpu.stochastic_round(x_ref[:], bits, target_dtype=dst)
+
+
+def pallas_compress_stochastic(x, dst_dtype, seed: int = 0):
+    """f32 -> bf16 compress with stochastic rounding: unbiased under the
+    repeated compress/reduce cycles of multi-hop ring collectives (TPU-only;
+    no reference analog — the FPGA lane truncates)."""
+    if jax.default_backend() != "tpu":  # stochastic_round is TPU-only
+        return x.astype(dst_dtype)
+    shape = x.shape
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    tile = _BLOCK_ROWS * _LANES
+    pad = (-n) % tile
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    x2 = flat.reshape(-1, _LANES)
+    m = x2.shape[0]
+    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(_sr_kernel, dst=dst_dtype),
+        out_shape=jax.ShapeDtypeStruct(x2.shape, dst_dtype),
+        grid=(pl.cdiv(m, _BLOCK_ROWS),),
+        in_specs=[spec, pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=spec,
+    )(x2, jnp.array([seed], dtype=jnp.int32)).reshape(-1)
+    if pad:
+        out = out[:n]
+    return out.reshape(shape)
+
+
+def make_cast(src: dataType, dst: dataType):
+    """Registry-compatible cast impl for one (src, dst) lane."""
+    dst_jnp = to_jax_dtype(dst)
+
+    def impl(x):
+        return pallas_cast(x, dst_jnp)
+
+    impl.__name__ = f"pallas_cast_{src.name}_to_{dst.name}"
+    return impl

@@ -39,12 +39,15 @@ AXIS = Communicator.AXIS
 
 def _smap(comm: Communicator, fn, n_in: int, out_specs=None):
     in_specs = tuple(P(AXIS) for _ in range(n_in))
+    # check_vma=False: Pallas plugin kernels inside program bodies don't carry
+    # varying-mesh-axis annotations; our programs manage replication manually.
     return jax.jit(
         shard_map(
             fn,
             mesh=comm.mesh,
             in_specs=in_specs if n_in > 1 else in_specs[0],
             out_specs=out_specs if out_specs is not None else P(AXIS),
+            check_vma=False,
         )
     )
 
@@ -76,9 +79,22 @@ def build_copy(comm: Communicator) -> Callable:
     return _smap(comm, lambda x: x + 0, 1)
 
 
-def build_combine(comm: Communicator, func: reduceFunction, dt: dataType) -> Callable:
-    """``ACCL::combine`` — per-rank elementwise reduce of two operands
-    (routes through the reduce_ops plugin registry)."""
+def build_combine(comm: Communicator, func: reduceFunction, dt: dataType,
+                  use_pallas: bool = False) -> Callable:
+    """``ACCL::combine`` — per-rank elementwise reduce of two operands.
+
+    ``use_pallas`` routes through the explicit Pallas reduce_ops lane
+    (standalone VMEM-tiled kernel, the plugin-architecture analog);
+    otherwise the registry's fused jnp path.
+    """
+    if use_pallas:
+        from ..ops import reduce_ops
+
+        if dt in reduce_ops.PALLAS_DTYPES:
+            def body(a, b):
+                return reduce_ops.pallas_combine(a, b, func)
+
+            return _smap(comm, body, 2)
 
     def body(a, b):
         return ops.combine(a, b, func, dt)

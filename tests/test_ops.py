@@ -1,0 +1,91 @@
+"""Pallas plugin lane tests (reduce_ops + hp_compression analogs).
+
+On the CPU mesh the kernels run in interpreter mode — functional parity with
+the fused jnp path; the TPU-compiled path is exercised by bench.py on real
+hardware.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accl_tpu import ACCLConfig, dataType, reduceFunction
+from accl_tpu.ops import compression, reduce_ops, registry
+
+
+@pytest.mark.parametrize("func", [reduceFunction.SUM, reduceFunction.MAX])
+@pytest.mark.parametrize("dt", [jnp.float32, jnp.bfloat16, jnp.int32])
+@pytest.mark.parametrize("n", [7, 128, 1000, 256 * 128 + 3])
+def test_pallas_combine_matches_jnp(rng, func, dt, n):
+    a = jnp.asarray(rng.standard_normal(n) * 10).astype(dt)
+    b = jnp.asarray(rng.standard_normal(n) * 10).astype(dt)
+    got = reduce_ops.pallas_combine(a, b, func)
+    want = a + b if func == reduceFunction.SUM else jnp.maximum(a, b)
+    assert got.dtype == a.dtype
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_pallas_combine_2d_shape(rng):
+    a = jnp.asarray(rng.standard_normal((3, 77)).astype(np.float32))
+    b = jnp.asarray(rng.standard_normal((3, 77)).astype(np.float32))
+    got = reduce_ops.pallas_combine(a, b, reduceFunction.SUM)
+    assert got.shape == (3, 77)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(a + b))
+
+
+@pytest.mark.parametrize("src,dst", [(jnp.float32, jnp.bfloat16),
+                                     (jnp.bfloat16, jnp.float32),
+                                     (jnp.float32, jnp.float16),
+                                     (jnp.float16, jnp.float32)])
+@pytest.mark.parametrize("n", [5, 1024, 40000])
+def test_pallas_cast_matches_astype(rng, src, dst, n):
+    x = jnp.asarray(rng.standard_normal(n)).astype(src)
+    got = compression.pallas_cast(x, dst)
+    assert got.dtype == dst
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(x.astype(dst)))
+
+
+def test_cast_roundtrip_widening_is_exact(rng):
+    """bf16 -> f32 -> bf16 must be lossless (the decompress lane contract)."""
+    x = jnp.asarray(rng.standard_normal(512)).astype(jnp.bfloat16)
+    up = compression.pallas_cast(x, jnp.float32)
+    back = compression.pallas_cast(up, jnp.bfloat16)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+
+
+def test_stochastic_compress_cpu_fallback(rng):
+    x = jnp.asarray(rng.standard_normal(100).astype(np.float32))
+    out = compression.pallas_compress_stochastic(x, jnp.bfloat16)
+    assert out.dtype == jnp.bfloat16  # deterministic astype off-TPU
+
+
+def test_combine_via_accl_pallas_lane(accl, rng):
+    """ACCL.combine with use_pallas routes through the Pallas lane and
+    agrees with the fused path."""
+    count = 300
+    a = accl.create_buffer(count, dataType.float32)
+    b = accl.create_buffer(count, dataType.float32)
+    r = accl.create_buffer(count, dataType.float32)
+    a.host[:] = rng.standard_normal((8, count)).astype(np.float32)
+    b.host[:] = rng.standard_normal((8, count)).astype(np.float32)
+    assert accl.config.use_pallas
+    accl.combine(count, reduceFunction.SUM, a, b, r)
+    np.testing.assert_allclose(r.host, a.host + b.host, rtol=1e-6)
+
+
+def test_registry_custom_lane_roundtrip():
+    """Plugin registration analog of the arith_tdest table: a registered lane
+    overrides the fallback and can be removed."""
+    calls = []
+
+    def lane(a, b):
+        calls.append(1)
+        return a + b
+
+    key = (reduceFunction.SUM, dataType.int8)
+    registry.register_combine(reduceFunction.SUM, dataType.int8, lane)
+    try:
+        out = registry.combine(jnp.ones(4, jnp.int8), jnp.ones(4, jnp.int8),
+                               reduceFunction.SUM, dataType.int8)
+        assert calls and np.all(np.asarray(out) == 2)
+    finally:
+        registry._COMBINE_REGISTRY.pop(key, None)
