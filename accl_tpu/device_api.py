@@ -1,0 +1,135 @@
+"""Device-initiated collectives — the PL-kernel binding analog.
+
+The reference lets FPGA compute kernels invoke collectives with **zero host
+involvement**: ``ACCLCommand`` issues the 15-word call stream and
+``ACCLData`` pushes/pulls the payload directly from kernel streams
+(``driver/hls/accl_hls.h:82-541``; example ``kernels/plugins/vadd_put/
+vadd_put.cpp:20-86``; arbitration ``client_arbiter.cpp:21-51``).
+
+The TPU re-expression: these functions are called *inside* jitted/shard_map
+compute, so the collective becomes part of the compiled program — XLA fuses
+compute and communication into one schedule, which is strictly stronger
+than the reference's stream hand-off (no arbiter needed: the program **is**
+the schedule). "Stream operands" (OP0_STREAM / RES_STREAM) are simply
+values flowing between traced ops rather than buffers.
+
+Use inside a ``shard_map`` body over a communicator's mesh axis::
+
+    from accl_tpu import device_api as dapi
+
+    def kernel(x):                       # runs per-rank, fully on device
+        y = x + 1.0                      # compute
+        z = dapi.put_next(y)             # stream_put to rank+1 (vadd_put)
+        return dapi.allreduce(z)         # fused collective
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .communicator import Communicator
+from .constants import dataType, reduceFunction, to_jax_dtype
+from . import ops
+
+AXIS = Communicator.AXIS
+
+
+def rank(axis: str = AXIS):
+    """This rank's index on the collective axis (``ACCL::rank`` analog)."""
+    return lax.axis_index(axis)
+
+
+def world(axis: str = AXIS) -> int:
+    """Number of ranks on the collective axis."""
+    return lax.axis_size(axis)
+
+
+def _wire_pair(compress_dtype: Optional[dataType], x):
+    if compress_dtype is None:
+        return x, None
+    src = x.dtype
+    return x.astype(to_jax_dtype(compress_dtype)), src
+
+
+def allreduce(x, func: reduceFunction = reduceFunction.SUM, axis: str = AXIS,
+              compress_dtype: Optional[dataType] = None):
+    """In-kernel allreduce (``ACCLCommand::all_reduce`` analog)."""
+    w, orig = _wire_pair(compress_dtype, x)
+    red = lax.psum(w, axis) if func == reduceFunction.SUM else lax.pmax(w, axis)
+    return red.astype(orig) if orig is not None else red
+
+
+def reduce_to(x, root: int, func: reduceFunction = reduceFunction.SUM,
+              axis: str = AXIS):
+    """In-kernel rooted reduce: result valid at ``root``, zeros elsewhere."""
+    red = allreduce(x, func, axis)
+    return jnp.where(lax.axis_index(axis) == root, red, jnp.zeros_like(red))
+
+def bcast(x, root: int, axis: str = AXIS):
+    """In-kernel broadcast of ``root``'s value (``ACCLCommand::bcast``)."""
+    contrib = jnp.where(lax.axis_index(axis) == root, x, jnp.zeros_like(x))
+    return lax.psum(contrib, axis)
+
+
+def all_gather(x, axis: str = AXIS, tiled: bool = True):
+    """In-kernel allgather along the last axis (``ACCLCommand::all_gather``)."""
+    return lax.all_gather(x, axis, axis=x.ndim - 1 if tiled else 0, tiled=tiled)
+
+
+def reduce_scatter(x, func: reduceFunction = reduceFunction.SUM,
+                   axis: str = AXIS):
+    """In-kernel reduce-scatter over the last axis
+    (``ACCLCommand::reduce_scatter``)."""
+    if func == reduceFunction.SUM:
+        return lax.psum_scatter(x, axis, scatter_dimension=x.ndim - 1, tiled=True)
+    P = lax.axis_size(axis)
+    chunks = x.reshape(x.shape[:-1] + (P, x.shape[-1] // P))
+    swapped = lax.all_to_all(chunks, axis, split_axis=x.ndim - 1,
+                             concat_axis=x.ndim - 1)
+    return jnp.max(swapped, axis=x.ndim - 1)
+
+
+def all_to_all(x, axis: str = AXIS):
+    """In-kernel all-to-all over the last axis (chunk q -> rank q)."""
+    P = lax.axis_size(axis)
+    chunks = x.reshape(x.shape[:-1] + (P, x.shape[-1] // P))
+    swapped = lax.all_to_all(chunks, axis, split_axis=x.ndim - 1,
+                             concat_axis=x.ndim - 1)
+    return swapped.reshape(x.shape)
+
+
+def put_next(x, axis: str = AXIS, offset: int = 1):
+    """One-sided put to rank+offset on the ring — the ``stream_put`` analog
+    (vadd_put.cpp:26-86 sends its stream to the next rank)."""
+    # static permutation: world size is known at trace time
+    P = lax.axis_size(axis)
+    perm = [(i, (i + offset) % P) for i in range(P)]
+    return lax.ppermute(x, axis, perm)
+
+
+def get_prev(x, axis: str = AXIS, offset: int = 1):
+    """Receive what rank-offset put to us (identical wire op, reader view)."""
+    return put_next(x, axis, offset)
+
+
+def send_recv(x, pairs: Sequence[Tuple[int, int]], axis: str = AXIS):
+    """Explicit pairwise exchange: each (src, dst) moves src's value to dst;
+    ranks not named as a dst receive zeros (device-side two-sided analog)."""
+    return lax.ppermute(x, axis, list(pairs))
+
+
+def combine(a, b, func: reduceFunction = reduceFunction.SUM,
+            dt: Optional[dataType] = None):
+    """In-kernel elementwise combine through the plugin registry."""
+    from .constants import from_jax_dtype
+    return ops.combine(a, b, func, dt or from_jax_dtype(a.dtype))
+
+
+def barrier(axis: str = AXIS):
+    """In-kernel barrier token: returns a scalar whose value depends on all
+    ranks (data-dependency barrier, the XLA-semantics analog of the
+    zero-byte notification exchange)."""
+    return lax.psum(jnp.ones((), jnp.int32), axis)

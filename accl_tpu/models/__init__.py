@@ -1,0 +1,3 @@
+from . import mlp, vadd
+
+__all__ = ["mlp", "vadd"]
