@@ -1,0 +1,174 @@
+"""ctypes bindings for the native C++ runtime (csrc/acclrt.cpp).
+
+The reference's host driver is C++ (driver/xrt, ~4.3k LoC); this package is
+its TPU-native counterpart's native core: matching engine, sequence
+counters, request registry and timer live in ``libacclrt.so``, built
+on demand with g++ (no pybind11 in the image — plain C ABI + ctypes).
+
+``load()`` returns the bound library or None; callers (``sendrecv.
+MatchingEngine``) fall back to the pure-Python implementation so the
+framework works without a toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+_SRC = Path(__file__).resolve().parent.parent.parent / "csrc" / "acclrt.cpp"
+_BUILD_DIR = Path(__file__).resolve().parent / "_build"
+_LIB = _BUILD_DIR / "libacclrt.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+#: match result sentinels (keep in sync with acclrt.cpp)
+NO_MATCH = -1
+ERR_COUNT_MISMATCH = -2
+
+
+def _compile() -> bool:
+    if not _SRC.exists():
+        return False
+    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    try:
+        src_mtime = _SRC.stat().st_mtime
+        if _LIB.exists() and _LIB.stat().st_mtime >= src_mtime:
+            return True
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+             str(_SRC), "-o", str(_LIB)],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    lib.accl_engine_create.restype = c.c_void_p
+    lib.accl_engine_destroy.argtypes = [c.c_void_p]
+    for name in ("accl_post_send", "accl_post_recv"):
+        fn = getattr(lib, name)
+        fn.restype = c.c_int64
+        fn.argtypes = [c.c_void_p, c.c_int32, c.c_int32, c.c_int64,
+                       c.c_int64, c.POINTER(c.c_int64)]
+    lib.accl_remove_recv.restype = c.c_int32
+    lib.accl_remove_recv.argtypes = [c.c_void_p, c.c_int64]
+    lib.accl_clear.argtypes = [c.c_void_p]
+    for name in ("accl_pending_sends", "accl_pending_recvs"):
+        fn = getattr(lib, name)
+        fn.restype = c.c_int64
+        fn.argtypes = [c.c_void_p]
+    for name in ("accl_outbound_seq", "accl_inbound_seq"):
+        fn = getattr(lib, name)
+        fn.restype = c.c_int64
+        fn.argtypes = [c.c_void_p, c.c_int32, c.c_int32]
+    lib.accl_req_create.restype = c.c_int64
+    lib.accl_req_create.argtypes = [c.c_void_p]
+    lib.accl_req_complete.argtypes = [c.c_void_p, c.c_int64, c.c_int32]
+    lib.accl_req_duration_ns.restype = c.c_uint64
+    lib.accl_req_duration_ns.argtypes = [c.c_void_p, c.c_int64]
+    lib.accl_req_status.restype = c.c_int32
+    lib.accl_req_status.argtypes = [c.c_void_p, c.c_int64]
+    lib.accl_req_free.argtypes = [c.c_void_p, c.c_int64]
+    lib.accl_now_ns.restype = c.c_uint64
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Compile (once) and load libacclrt.so; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("ACCL_NO_NATIVE"):
+            return None
+        if _compile():
+            try:
+                _lib = _bind(ctypes.CDLL(str(_LIB)))
+            except OSError:
+                _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+class NativeEngine:
+    """Thin RAII wrapper over one native engine instance."""
+
+    def __init__(self):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._h = ctypes.c_void_p(lib.accl_engine_create())
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.accl_engine_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    # matching ----------------------------------------------------------
+    def post_send(self, src: int, dst: int, tag: int, count: int):
+        out = ctypes.c_int64(NO_MATCH)
+        sid = self._lib.accl_post_send(self._h, src, dst, tag, count,
+                                       ctypes.byref(out))
+        return sid, out.value
+
+    def post_recv(self, src: int, dst: int, tag: int, count: int):
+        out = ctypes.c_int64(NO_MATCH)
+        rid = self._lib.accl_post_recv(self._h, src, dst, tag, count,
+                                       ctypes.byref(out))
+        return rid, out.value
+
+    def remove_recv(self, rid: int) -> bool:
+        return bool(self._lib.accl_remove_recv(self._h, rid))
+
+    def clear(self) -> None:
+        self._lib.accl_clear(self._h)
+
+    def pending(self):
+        return (self._lib.accl_pending_sends(self._h),
+                self._lib.accl_pending_recvs(self._h))
+
+    def outbound_seq(self, src: int, dst: int) -> int:
+        return self._lib.accl_outbound_seq(self._h, src, dst)
+
+    def inbound_seq(self, src: int, dst: int) -> int:
+        return self._lib.accl_inbound_seq(self._h, src, dst)
+
+    # requests ----------------------------------------------------------
+    def req_create(self) -> int:
+        return self._lib.accl_req_create(self._h)
+
+    def req_complete(self, rid: int, retcode: int = 0) -> None:
+        self._lib.accl_req_complete(self._h, rid, retcode)
+
+    def req_duration_ns(self, rid: int) -> int:
+        return self._lib.accl_req_duration_ns(self._h, rid)
+
+    def req_status(self, rid: int) -> int:
+        return self._lib.accl_req_status(self._h, rid)
+
+    def req_free(self, rid: int) -> None:
+        self._lib.accl_req_free(self._h, rid)
+
+
+def now_ns() -> int:
+    lib = load()
+    if lib is None:
+        import time
+        return time.monotonic_ns()
+    return lib.accl_now_ns()

@@ -59,12 +59,31 @@ class RecvPost:
 
 
 class MatchingEngine:
-    """Per-communicator pending stores + matching (rxbuf_seek analog)."""
+    """Per-communicator pending stores + matching (rxbuf_seek analog).
 
-    def __init__(self, comm: Communicator):
+    Two interchangeable backends: the native C++ engine
+    (:mod:`accl_tpu.native`, the reference-parity C++ host runtime) when the
+    toolchain is available, else the pure-Python store. Payload snapshots
+    always stay in Python as ``jax.Array`` references; the backend owns
+    matching decisions and sequence counters.
+    """
+
+    def __init__(self, comm: Communicator, use_native: Optional[bool] = None):
         self.comm = comm
+        if use_native is None:
+            from . import native as _n
+            use_native = _n.available()
+        self._native = None
+        if use_native:
+            from .native import NativeEngine
+            self._native = NativeEngine()
+        self._posts: Dict[int, object] = {}   # native id -> post
         self._pending_sends: List[SendPost] = []
         self._pending_recvs: List[RecvPost] = []
+
+    @property
+    def is_native(self) -> bool:
+        return self._native is not None
 
     # -- matching predicate (rxbuf_seek.cpp:50-66) -------------------------
 
@@ -83,6 +102,25 @@ class MatchingEngine:
         Count validation happens *before* the seqn is consumed, so a rejected
         send leaves the pair's ordering state untouched.
         """
+        if self._native is not None:
+            from . import native as _n
+            sid, matched = self._native.post_send(
+                post.src, post.dst, post.tag, post.count)
+            if sid == _n.ERR_COUNT_MISMATCH:
+                raise ACCLError(
+                    errorCode.INVALID_BUFFER_SIZE,
+                    f"send {post.src}->{post.dst} count {post.count} does not "
+                    f"match the pending recv's count")
+            post.seqn = self._native.outbound_seq(post.src, post.dst) - 1
+            if matched >= 0:
+                r = self._posts.pop(matched)
+                r.deliver(post)
+                if post.on_matched:
+                    post.on_matched()
+                return True
+            self._posts[sid] = post
+            post._native_id = sid
+            return False
         prospective = self.comm.peek_outbound_seq(post.src, post.dst)
         candidate = None
         for i, r in enumerate(self._pending_recvs):
@@ -109,6 +147,24 @@ class MatchingEngine:
     def post_recv(self, post: RecvPost) -> bool:
         """Try to consume a parked send; else park the recv. Returns True if
         a send was consumed (data delivered)."""
+        if self._native is not None:
+            from . import native as _n
+            rid, matched = self._native.post_recv(
+                post.src, post.dst, post.tag, post.count)
+            if rid == _n.ERR_COUNT_MISMATCH:
+                raise ACCLError(
+                    errorCode.INVALID_BUFFER_SIZE,
+                    f"recv {post.dst}<-{post.src} count {post.count} does not "
+                    f"match the pending send's count")
+            if matched >= 0:
+                s = self._posts.pop(matched)
+                post.deliver(s)
+                if s.on_matched:
+                    s.on_matched()
+                return True
+            self._posts[rid] = post
+            post._native_id = rid
+            return False
         for i, s in enumerate(self._pending_sends):
             if self._send_matches(s, post.src, post.dst, post.tag):
                 if s.count != post.count:
@@ -126,10 +182,18 @@ class MatchingEngine:
     def remove_recv(self, post: RecvPost) -> None:
         """Un-park a recv (used when a sync recv fails NOT_READY, so the
         failed call doesn't steal a future send)."""
+        if self._native is not None:
+            rid = getattr(post, "_native_id", None)
+            if rid is not None and self._native.remove_recv(rid):
+                self._posts.pop(rid, None)
+            return
         if post in self._pending_recvs:
             self._pending_recvs.remove(post)
 
     def clear(self) -> None:
+        if self._native is not None:
+            self._native.clear()
+            self._posts.clear()
         self._pending_sends.clear()
         self._pending_recvs.clear()
 
@@ -140,14 +204,22 @@ class MatchingEngine:
     # -- introspection (dump_eager_rx_buffers analog) ----------------------
 
     def dump(self) -> str:
-        lines = [f"MatchingEngine: {len(self._pending_sends)} pending sends, "
-                 f"{len(self._pending_recvs)} pending recvs"]
-        for s in self._pending_sends:
+        ns, nr = self.n_pending
+        backend = "native" if self._native is not None else "python"
+        lines = [f"MatchingEngine[{backend}]: {ns} pending sends, "
+                 f"{nr} pending recvs"]
+        sends = [p for p in self._posts.values() if isinstance(p, SendPost)] \
+            if self._native is not None else self._pending_sends
+        recvs = [p for p in self._posts.values() if isinstance(p, RecvPost)] \
+            if self._native is not None else self._pending_recvs
+        for s in sends:
             lines.append(f"  send {s.src}->{s.dst} tag={s.tag} seqn={s.seqn} count={s.count}")
-        for r in self._pending_recvs:
+        for r in recvs:
             lines.append(f"  recv {r.dst}<-{r.src} tag={r.tag} count={r.count}")
         return "\n".join(lines)
 
     @property
     def n_pending(self) -> Tuple[int, int]:
+        if self._native is not None:
+            return self._native.pending()
         return (len(self._pending_sends), len(self._pending_recvs))

@@ -1,0 +1,266 @@
+// acclrt: native host runtime for ACCL-TPU.
+//
+// TPU-native equivalent of the reference's C++ host driver machinery
+// (driver/xrt): the two-sided matching engine (rxbuf_seek.cpp:20-78
+// predicate), per-pair monotonic sequence counters (dma_mover.cpp:581-610
+// exchange-memory seqn), the request registry with per-call duration
+// (acclrequest.hpp:39-211 + PERFCNT), and a monotonic timer (timing.hpp).
+//
+// Payload stays in Python as jax.Array references; this library owns the
+// control-plane state and matching decisions. Exposed through a plain C ABI
+// consumed via ctypes (no pybind11 in the image).
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 acclrt.cpp -o libacclrt.so
+
+#include <chrono>
+#include <cstdint>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int64_t kTagAny = 0xFFFFFFFFLL;  // constants.hpp TAG_ANY
+constexpr int64_t kNoMatch = -1;
+constexpr int64_t kErrCountMismatch = -2;
+
+struct Post {
+  int64_t id;
+  int32_t src;
+  int32_t dst;
+  int64_t tag;
+  int64_t count;
+  int64_t seqn;  // sends only
+};
+
+struct PairKey {
+  int32_t src, dst;
+  bool operator<(const PairKey& o) const {
+    return src != o.src ? src < o.src : dst < o.dst;
+  }
+};
+
+struct Request {
+  uint64_t start_ns;
+  uint64_t duration_ns = 0;
+  int32_t status = 0;  // 0=queued 1=completed 2=error
+  int32_t retcode = 0;
+};
+
+uint64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+bool tag_ok(int64_t recv_tag, int64_t send_tag) {
+  return recv_tag == kTagAny || send_tag == kTagAny || recv_tag == send_tag;
+}
+
+class Engine {
+ public:
+  // ---- matching (rxbuf_seek analog) ----------------------------------
+
+  // Post a send. Assigns the outbound seqn (after validating any matched
+  // recv's count, so errors consume no state). Returns the send post id;
+  // *matched_recv out-param is the delivered recv's id or -1 if parked.
+  int64_t post_send(int32_t src, int32_t dst, int64_t tag, int64_t count,
+                    int64_t* matched_recv) {
+    std::lock_guard<std::mutex> g(mu_);
+    *matched_recv = kNoMatch;
+    int64_t prospective = outbound_[{src, dst}];
+    // candidate recv: same pair, compatible tag, and this send is the next
+    // expected message for the pair
+    size_t idx = pending_recvs_.size();
+    if (prospective == inbound_[{src, dst}]) {
+      for (size_t i = 0; i < pending_recvs_.size(); ++i) {
+        const Post& r = pending_recvs_[i];
+        if (r.src == src && r.dst == dst && tag_ok(r.tag, tag)) {
+          idx = i;
+          break;
+        }
+      }
+    }
+    if (idx != pending_recvs_.size() &&
+        pending_recvs_[idx].count != count) {
+      return kErrCountMismatch;  // nothing consumed
+    }
+    Post s{next_id_++, src, dst, tag, count, outbound_[{src, dst}]++};
+    if (idx != pending_recvs_.size()) {
+      *matched_recv = pending_recvs_[idx].id;
+      pending_recvs_.erase(pending_recvs_.begin() + idx);
+      inbound_[{src, dst}]++;
+      return s.id;
+    }
+    pending_sends_.push_back(s);
+    return s.id;
+  }
+
+  // Post a recv. Returns recv post id; *matched_send is the consumed send's
+  // id or -1 if the recv parked. kErrCountMismatch on count conflict.
+  int64_t post_recv(int32_t src, int32_t dst, int64_t tag, int64_t count,
+                    int64_t* matched_send) {
+    std::lock_guard<std::mutex> g(mu_);
+    *matched_send = kNoMatch;
+    int64_t expected = inbound_[{src, dst}];
+    size_t idx = pending_sends_.size();
+    for (size_t i = 0; i < pending_sends_.size(); ++i) {
+      const Post& s = pending_sends_[i];
+      if (s.src == src && s.dst == dst && tag_ok(tag, s.tag) &&
+          s.seqn == expected) {
+        idx = i;
+        break;
+      }
+    }
+    if (idx != pending_sends_.size() && pending_sends_[idx].count != count) {
+      return kErrCountMismatch;
+    }
+    Post r{next_id_++, src, dst, tag, count, -1};
+    if (idx != pending_sends_.size()) {
+      *matched_send = pending_sends_[idx].id;
+      pending_sends_.erase(pending_sends_.begin() + idx);
+      inbound_[{src, dst}]++;
+      return r.id;
+    }
+    pending_recvs_.push_back(r);
+    return r.id;
+  }
+
+  bool remove_recv(int64_t id) {
+    std::lock_guard<std::mutex> g(mu_);
+    for (size_t i = 0; i < pending_recvs_.size(); ++i) {
+      if (pending_recvs_[i].id == id) {
+        pending_recvs_.erase(pending_recvs_.begin() + i);
+        return true;
+      }
+    }
+    return false;
+  }
+
+  void clear() {
+    std::lock_guard<std::mutex> g(mu_);
+    pending_sends_.clear();
+    pending_recvs_.clear();
+    outbound_.clear();
+    inbound_.clear();
+  }
+
+  int64_t pending_sends() {
+    std::lock_guard<std::mutex> g(mu_);
+    return (int64_t)pending_sends_.size();
+  }
+  int64_t pending_recvs() {
+    std::lock_guard<std::mutex> g(mu_);
+    return (int64_t)pending_recvs_.size();
+  }
+  int64_t outbound_seq(int32_t src, int32_t dst) {
+    std::lock_guard<std::mutex> g(mu_);
+    return outbound_[{src, dst}];
+  }
+  int64_t inbound_seq(int32_t src, int32_t dst) {
+    std::lock_guard<std::mutex> g(mu_);
+    return inbound_[{src, dst}];
+  }
+
+  // ---- request registry (acclrequest.hpp + PERFCNT analog) ------------
+
+  int64_t req_create() {
+    std::lock_guard<std::mutex> g(mu_);
+    int64_t id = next_id_++;
+    requests_[id] = Request{now_ns()};
+    return id;
+  }
+
+  void req_complete(int64_t id, int32_t retcode) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = requests_.find(id);
+    if (it == requests_.end()) return;
+    it->second.duration_ns = now_ns() - it->second.start_ns;
+    it->second.status = retcode == 0 ? 1 : 2;
+    it->second.retcode = retcode;
+  }
+
+  uint64_t req_duration_ns(int64_t id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = requests_.find(id);
+    if (it == requests_.end()) return 0;
+    if (it->second.status == 0) return now_ns() - it->second.start_ns;
+    return it->second.duration_ns;
+  }
+
+  int32_t req_status(int64_t id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = requests_.find(id);
+    return it == requests_.end() ? -1 : it->second.status;
+  }
+
+  void req_free(int64_t id) {
+    std::lock_guard<std::mutex> g(mu_);
+    requests_.erase(id);
+  }
+
+ private:
+  std::mutex mu_;
+  int64_t next_id_ = 1;
+  std::deque<Post> pending_sends_;
+  std::deque<Post> pending_recvs_;
+  std::map<PairKey, int64_t> outbound_;
+  std::map<PairKey, int64_t> inbound_;
+  std::unordered_map<int64_t, Request> requests_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* accl_engine_create() { return new Engine(); }
+void accl_engine_destroy(void* e) { delete static_cast<Engine*>(e); }
+
+int64_t accl_post_send(void* e, int32_t src, int32_t dst, int64_t tag,
+                       int64_t count, int64_t* matched_recv) {
+  return static_cast<Engine*>(e)->post_send(src, dst, tag, count, matched_recv);
+}
+
+int64_t accl_post_recv(void* e, int32_t src, int32_t dst, int64_t tag,
+                       int64_t count, int64_t* matched_send) {
+  return static_cast<Engine*>(e)->post_recv(src, dst, tag, count, matched_send);
+}
+
+int32_t accl_remove_recv(void* e, int64_t id) {
+  return static_cast<Engine*>(e)->remove_recv(id) ? 1 : 0;
+}
+
+void accl_clear(void* e) { static_cast<Engine*>(e)->clear(); }
+
+int64_t accl_pending_sends(void* e) {
+  return static_cast<Engine*>(e)->pending_sends();
+}
+int64_t accl_pending_recvs(void* e) {
+  return static_cast<Engine*>(e)->pending_recvs();
+}
+int64_t accl_outbound_seq(void* e, int32_t src, int32_t dst) {
+  return static_cast<Engine*>(e)->outbound_seq(src, dst);
+}
+int64_t accl_inbound_seq(void* e, int32_t src, int32_t dst) {
+  return static_cast<Engine*>(e)->inbound_seq(src, dst);
+}
+
+int64_t accl_req_create(void* e) { return static_cast<Engine*>(e)->req_create(); }
+void accl_req_complete(void* e, int64_t id, int32_t retcode) {
+  static_cast<Engine*>(e)->req_complete(id, retcode);
+}
+uint64_t accl_req_duration_ns(void* e, int64_t id) {
+  return static_cast<Engine*>(e)->req_duration_ns(id);
+}
+int32_t accl_req_status(void* e, int64_t id) {
+  return static_cast<Engine*>(e)->req_status(id);
+}
+void accl_req_free(void* e, int64_t id) {
+  static_cast<Engine*>(e)->req_free(id);
+}
+
+uint64_t accl_now_ns() { return now_ns(); }
+
+}  // extern "C"

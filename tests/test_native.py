@@ -1,0 +1,129 @@
+"""Native C++ runtime tests: the matching/seqn/request engine in
+csrc/acclrt.cpp must behave identically to the pure-Python backend, and the
+MatchingEngine must work on both."""
+import numpy as np
+import pytest
+
+from accl_tpu import Communicator, TAG_ANY, dataType
+from accl_tpu import native
+from accl_tpu.sendrecv import MatchingEngine, RecvPost, SendPost
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="g++/native runtime unavailable"
+)
+
+
+@pytest.fixture()
+def eng():
+    return native.NativeEngine()
+
+
+def test_send_then_recv_matches(eng):
+    sid, m = eng.post_send(0, 1, 5, 64)
+    assert m == native.NO_MATCH
+    rid, matched = eng.post_recv(0, 1, 5, 64)
+    assert matched == sid
+    assert eng.pending() == (0, 0)
+
+
+def test_recv_then_send_matches(eng):
+    rid, m = eng.post_recv(2, 3, TAG_ANY, 16)
+    assert m == native.NO_MATCH
+    sid, matched = eng.post_send(2, 3, 9, 16)
+    assert matched == rid
+
+
+def test_ordered_delivery_by_seqn(eng):
+    s1, _ = eng.post_send(0, 1, 1, 8)
+    s2, _ = eng.post_send(0, 1, 1, 8)
+    _, m1 = eng.post_recv(0, 1, 1, 8)
+    _, m2 = eng.post_recv(0, 1, 1, 8)
+    assert (m1, m2) == (s1, s2)
+
+
+def test_out_of_order_seqn_blocks(eng):
+    """A send that is not the next expected message cannot match."""
+    s1, _ = eng.post_send(0, 1, 7, 8)   # seqn 0, parked
+    s2, _ = eng.post_send(0, 1, 8, 8)   # seqn 1, parked
+    # recv for tag 8: candidate s2 has seqn 1 != expected 0 -> parks
+    rid, m = eng.post_recv(0, 1, 8, 8)
+    assert m == native.NO_MATCH
+    # recv for tag 7 consumes s1 (seqn 0) ...
+    _, m = eng.post_recv(0, 1, 7, 8)
+    assert m == s1
+    # ... which unblocks nothing automatically, but a fresh recv now sees s2
+    _, m = eng.post_recv(0, 1, 8, 8)
+    assert m == s2
+
+
+def test_count_mismatch_error_consumes_nothing(eng):
+    rid, _ = eng.post_recv(0, 2, 4, 8)
+    res, _ = eng.post_send(0, 2, 4, 16)
+    assert res == native.ERR_COUNT_MISMATCH
+    assert eng.outbound_seq(0, 2) == 0          # seqn not consumed
+    sid, matched = eng.post_send(0, 2, 4, 8)    # correct count matches
+    assert matched == rid
+
+
+def test_remove_recv_and_clear(eng):
+    rid, _ = eng.post_recv(5, 6, 1, 4)
+    assert eng.pending() == (0, 1)
+    assert eng.remove_recv(rid)
+    assert eng.pending() == (0, 0)
+    eng.post_send(5, 6, 1, 4)
+    eng.clear()
+    assert eng.pending() == (0, 0)
+    assert eng.outbound_seq(5, 6) == 0          # clear resets sequences
+
+
+def test_request_registry(eng):
+    rid = eng.req_create()
+    assert eng.req_status(rid) == 0
+    d0 = eng.req_duration_ns(rid)
+    assert d0 >= 0
+    eng.req_complete(rid, 0)
+    assert eng.req_status(rid) == 1
+    assert eng.req_duration_ns(rid) > 0
+    eng.req_free(rid)
+    assert eng.req_status(rid) == -1
+
+
+def test_now_ns_monotonic():
+    a = native.now_ns()
+    b = native.now_ns()
+    assert b >= a
+
+
+# ---- backend parity: same flow through MatchingEngine, both backends -----
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_matching_engine_backend_parity(accl, use_native):
+    import jax
+
+    comm = Communicator(jax.devices()[:8])
+    eng = MatchingEngine(comm, use_native=use_native)
+    assert eng.is_native == use_native
+    log = []
+
+    def mk_send(src, dst, tag):
+        return SendPost(src=src, dst=dst, tag=tag, data=None, count=4)
+
+    def mk_recv(src, dst, tag):
+        return RecvPost(src=src, dst=dst, tag=tag, count=4,
+                        deliver=lambda s: log.append((s.src, s.dst, s.tag)))
+
+    # send-first then recv
+    assert not eng.post_send(mk_send(0, 1, 11))
+    assert eng.post_recv(mk_recv(0, 1, 11))
+    # recv-first then send
+    assert not eng.post_recv(mk_recv(3, 4, TAG_ANY))
+    assert eng.post_send(mk_send(3, 4, 22))
+    assert log == [(0, 1, 11), (3, 4, 22)]
+    assert eng.n_pending == (0, 0)
+    # dump works on both
+    assert "pending" in eng.dump()
+
+
+def test_session_engine_uses_native(accl):
+    """With the toolchain present, the session ACCL's engines are native."""
+    assert accl.matcher().is_native
