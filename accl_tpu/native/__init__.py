@@ -34,16 +34,23 @@ ERR_COUNT_MISMATCH = -2
 def _compile() -> bool:
     if not _SRC.exists():
         return False
-    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
     try:
+        _BUILD_DIR.mkdir(parents=True, exist_ok=True)
         src_mtime = _SRC.stat().st_mtime
         if _LIB.exists() and _LIB.stat().st_mtime >= src_mtime:
             return True
-        subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-             str(_SRC), "-o", str(_LIB)],
-            check=True, capture_output=True, timeout=120,
-        )
+        # build to a process-private path, then rename atomically so a
+        # concurrent process can never dlopen a partially written library
+        tmp = _BUILD_DIR / f".libacclrt.{os.getpid()}.so"
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                 str(_SRC), "-o", str(tmp)],
+                check=True, capture_output=True, timeout=120,
+            )
+            os.replace(tmp, _LIB)
+        finally:
+            tmp.unlink(missing_ok=True)
         return True
     except (subprocess.SubprocessError, OSError):
         return False
@@ -53,11 +60,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     c = ctypes
     lib.accl_engine_create.restype = c.c_void_p
     lib.accl_engine_destroy.argtypes = [c.c_void_p]
-    for name in ("accl_post_send", "accl_post_recv"):
-        fn = getattr(lib, name)
-        fn.restype = c.c_int64
-        fn.argtypes = [c.c_void_p, c.c_int32, c.c_int32, c.c_int64,
-                       c.c_int64, c.POINTER(c.c_int64)]
+    lib.accl_post_send.restype = c.c_int64
+    lib.accl_post_send.argtypes = [c.c_void_p, c.c_int32, c.c_int32,
+                                   c.c_int64, c.c_int64,
+                                   c.POINTER(c.c_int64), c.POINTER(c.c_int64)]
+    lib.accl_post_recv.restype = c.c_int64
+    lib.accl_post_recv.argtypes = [c.c_void_p, c.c_int32, c.c_int32,
+                                   c.c_int64, c.c_int64, c.POINTER(c.c_int64)]
     lib.accl_remove_recv.restype = c.c_int32
     lib.accl_remove_recv.argtypes = [c.c_void_p, c.c_int64]
     lib.accl_clear.argtypes = [c.c_void_p]
@@ -122,10 +131,12 @@ class NativeEngine:
 
     # matching ----------------------------------------------------------
     def post_send(self, src: int, dst: int, tag: int, count: int):
+        """Returns (send id, matched recv id or NO_MATCH, assigned seqn)."""
         out = ctypes.c_int64(NO_MATCH)
+        seqn = ctypes.c_int64(-1)
         sid = self._lib.accl_post_send(self._h, src, dst, tag, count,
-                                       ctypes.byref(out))
-        return sid, out.value
+                                       ctypes.byref(out), ctypes.byref(seqn))
+        return sid, out.value, seqn.value
 
     def post_recv(self, src: int, dst: int, tag: int, count: int):
         out = ctypes.c_int64(NO_MATCH)

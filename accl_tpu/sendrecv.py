@@ -104,14 +104,14 @@ class MatchingEngine:
         """
         if self._native is not None:
             from . import native as _n
-            sid, matched = self._native.post_send(
+            sid, matched, seqn = self._native.post_send(
                 post.src, post.dst, post.tag, post.count)
             if sid == _n.ERR_COUNT_MISMATCH:
                 raise ACCLError(
                     errorCode.INVALID_BUFFER_SIZE,
                     f"send {post.src}->{post.dst} count {post.count} does not "
                     f"match the pending recv's count")
-            post.seqn = self._native.outbound_seq(post.src, post.dst) - 1
+            post.seqn = seqn
             if matched >= 0:
                 r = self._posts.pop(matched)
                 r.deliver(post)

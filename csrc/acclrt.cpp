@@ -65,11 +65,14 @@ class Engine {
 
   // Post a send. Assigns the outbound seqn (after validating any matched
   // recv's count, so errors consume no state). Returns the send post id;
-  // *matched_recv out-param is the delivered recv's id or -1 if parked.
+  // *matched_recv out-param is the delivered recv's id or -1 if parked;
+  // *assigned_seqn is the seqn consumed by this send (atomic with the
+  // assignment — callers must not re-derive it from outbound_seq()).
   int64_t post_send(int32_t src, int32_t dst, int64_t tag, int64_t count,
-                    int64_t* matched_recv) {
+                    int64_t* matched_recv, int64_t* assigned_seqn) {
     std::lock_guard<std::mutex> g(mu_);
     *matched_recv = kNoMatch;
+    *assigned_seqn = -1;
     int64_t prospective = outbound_[{src, dst}];
     // candidate recv: same pair, compatible tag, and this send is the next
     // expected message for the pair
@@ -88,6 +91,7 @@ class Engine {
       return kErrCountMismatch;  // nothing consumed
     }
     Post s{next_id_++, src, dst, tag, count, outbound_[{src, dst}]++};
+    *assigned_seqn = s.seqn;
     if (idx != pending_recvs_.size()) {
       *matched_recv = pending_recvs_[idx].id;
       pending_recvs_.erase(pending_recvs_.begin() + idx);
@@ -219,8 +223,10 @@ void* accl_engine_create() { return new Engine(); }
 void accl_engine_destroy(void* e) { delete static_cast<Engine*>(e); }
 
 int64_t accl_post_send(void* e, int32_t src, int32_t dst, int64_t tag,
-                       int64_t count, int64_t* matched_recv) {
-  return static_cast<Engine*>(e)->post_send(src, dst, tag, count, matched_recv);
+                       int64_t count, int64_t* matched_recv,
+                       int64_t* assigned_seqn) {
+  return static_cast<Engine*>(e)->post_send(src, dst, tag, count, matched_recv,
+                                            assigned_seqn);
 }
 
 int64_t accl_post_recv(void* e, int32_t src, int32_t dst, int64_t tag,

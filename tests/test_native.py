@@ -19,8 +19,9 @@ def eng():
 
 
 def test_send_then_recv_matches(eng):
-    sid, m = eng.post_send(0, 1, 5, 64)
+    sid, m, seqn0 = eng.post_send(0, 1, 5, 64)
     assert m == native.NO_MATCH
+    assert seqn0 == 0
     rid, matched = eng.post_recv(0, 1, 5, 64)
     assert matched == sid
     assert eng.pending() == (0, 0)
@@ -29,13 +30,14 @@ def test_send_then_recv_matches(eng):
 def test_recv_then_send_matches(eng):
     rid, m = eng.post_recv(2, 3, TAG_ANY, 16)
     assert m == native.NO_MATCH
-    sid, matched = eng.post_send(2, 3, 9, 16)
+    sid, matched, _ = eng.post_send(2, 3, 9, 16)
     assert matched == rid
 
 
 def test_ordered_delivery_by_seqn(eng):
-    s1, _ = eng.post_send(0, 1, 1, 8)
-    s2, _ = eng.post_send(0, 1, 1, 8)
+    s1, _, q1 = eng.post_send(0, 1, 1, 8)
+    s2, _, q2 = eng.post_send(0, 1, 1, 8)
+    assert (q1, q2) == (0, 1)  # seqn returned atomically with assignment
     _, m1 = eng.post_recv(0, 1, 1, 8)
     _, m2 = eng.post_recv(0, 1, 1, 8)
     assert (m1, m2) == (s1, s2)
@@ -43,8 +45,8 @@ def test_ordered_delivery_by_seqn(eng):
 
 def test_out_of_order_seqn_blocks(eng):
     """A send that is not the next expected message cannot match."""
-    s1, _ = eng.post_send(0, 1, 7, 8)   # seqn 0, parked
-    s2, _ = eng.post_send(0, 1, 8, 8)   # seqn 1, parked
+    s1, _, q1 = eng.post_send(0, 1, 7, 8)   # seqn 0, parked
+    s2, _, q2 = eng.post_send(0, 1, 8, 8)   # seqn 1, parked
     # recv for tag 8: candidate s2 has seqn 1 != expected 0 -> parks
     rid, m = eng.post_recv(0, 1, 8, 8)
     assert m == native.NO_MATCH
@@ -58,10 +60,10 @@ def test_out_of_order_seqn_blocks(eng):
 
 def test_count_mismatch_error_consumes_nothing(eng):
     rid, _ = eng.post_recv(0, 2, 4, 8)
-    res, _ = eng.post_send(0, 2, 4, 16)
+    res, _, _ = eng.post_send(0, 2, 4, 16)
     assert res == native.ERR_COUNT_MISMATCH
     assert eng.outbound_seq(0, 2) == 0          # seqn not consumed
-    sid, matched = eng.post_send(0, 2, 4, 8)    # correct count matches
+    sid, matched, _ = eng.post_send(0, 2, 4, 8)    # correct count matches
     assert matched == rid
 
 
