@@ -81,6 +81,11 @@ class ACCL:
         """accl.cpp:1082-1130 analog."""
         if self._initialized:
             return
+        if self.config.transport is None:
+            from .utils.bringup import detect_backend
+
+            self.config = self.config.replace(
+                transport=detect_backend(self._devices))
         _ = self.parse_hwid()
         comm = Communicator(
             self._devices, max_segment_size=self.config.segment_size
@@ -96,6 +101,8 @@ class ACCL:
         return {
             "platform": plat,
             "world_size": len(self._devices),
+            "transport": (self.config.transport.value
+                          if self.config.transport else "auto"),
             "arith_enabled": self.config.enable_arith,
             "compression_enabled": self.config.enable_compression,
             "device_kind": getattr(self._devices[0], "device_kind", plat)

@@ -1,0 +1,3 @@
+"""Benchmark harness package (bench.cpp + parse_bench_results.py analogs)."""
+from .harness import SweepRow, run_sweep, write_csv  # noqa: F401
+from .models import efficiency, ideal_duration  # noqa: F401

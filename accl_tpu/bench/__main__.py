@@ -1,0 +1,65 @@
+"""CLI for the sweep harness: ``python -m accl_tpu.bench``.
+
+Mirrors the reference benchmark binary's TCLAP flags (``bench.cpp:63-129``)
+with argparse; defaults reproduce its 2^4..2^19 fp32 sweep.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="accl_tpu.bench",
+        description="Collective sweep benchmark (bench.cpp analog)")
+    ap.add_argument("--ops", default="sendrecv,bcast,scatter,gather,"
+                    "allgather,reduce,allreduce,reduce_scatter",
+                    help="comma-separated collective names")
+    ap.add_argument("--min-pow", type=int, default=4)
+    ap.add_argument("--max-pow", type=int, default=19)
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--function", default="SUM", choices=["SUM", "MAX"])
+    ap.add_argument("--algorithm", default="XLA",
+                    choices=["XLA", "RING", "TREE", "FLAT", "HIERARCHICAL"])
+    ap.add_argument("--reps", type=int, default=9)
+    ap.add_argument("--mode", default="auto", choices=["auto", "block", "chain"],
+                    help="auto = chain on tpu, block elsewhere")
+    ap.add_argument("--cpu-devices", type=int, default=0,
+                    help="force an N-device virtual CPU mesh (emulator rung)")
+    ap.add_argument("--out", default="-", help="CSV path, - for stdout")
+    args = ap.parse_args(argv)
+
+    if args.cpu_devices:
+        from accl_tpu.utils import bringup
+
+        bringup.simulated_devices(args.cpu_devices)
+
+    import jax
+
+    import accl_tpu
+    from accl_tpu import Algorithm, dataType, reduceFunction
+    from . import harness
+
+    acc = accl_tpu.ACCL()
+    comm = acc.global_comm()
+    mode = args.mode
+    if mode == "auto":
+        mode = "chain" if jax.default_backend() == "tpu" else "block"
+    rows = harness.run_sweep(
+        comm,
+        ops=[o.strip() for o in args.ops.split(",") if o.strip()],
+        dt=dataType[args.dtype],
+        func=reduceFunction[args.function],
+        algorithm=Algorithm[args.algorithm],
+        min_pow=args.min_pow,
+        max_pow=args.max_pow,
+        reps=args.reps,
+        mode=mode,
+    )
+    harness.write_csv(rows, sys.stdout if args.out == "-" else args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

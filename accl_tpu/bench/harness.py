@@ -1,0 +1,258 @@
+"""CSV sweep benchmark harness — the ``bench.cpp`` analog.
+
+The reference sweeps 2^4..2^19 fp32 elements over every collective and
+logs ``Test,Param,Cycles`` CSV rows, timing with the CCLO's device cycle
+counter so host dispatch is excluded (``test/host/xrt/src/bench.cpp:25-61``,
+``fixture.hpp:76-133``). This harness reproduces that matrix over the
+compiled collective programs with two timing modes:
+
+* ``block`` — per-call wall time around ``block_until_ready`` + a scalar
+  readback; accurate on the CPU emulator rung where dispatch is synchronous.
+* ``chain`` — dependent-op chains of two lengths with one forced readback;
+  per_op = (t_long - t_short)/(k_long - k_short). This amortizes dispatch
+  and readback RTT away — the PERFCNT-equivalent accounting — and is the
+  right mode for real TPUs reached through an asynchronous tunnel.
+
+Run as a module::
+
+    python -m accl_tpu.bench --ops allreduce,bcast --min-pow 4 --max-pow 19
+
+Each row records the measured duration plus the analytic ideal-model
+efficiency (``models.ideal_duration``), mirroring
+``parse_bench_results.py``.
+"""
+from __future__ import annotations
+
+import csv
+import dataclasses
+import sys
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..communicator import Communicator
+from ..config import ACCLConfig, Algorithm
+from ..constants import dataType, dtype_size, operation, reduceFunction, to_jax_dtype
+from ..parallel import algorithms, primitives
+from . import models
+
+_pick = jax.jit(lambda v: v.ravel()[0])
+
+
+@dataclasses.dataclass
+class SweepRow:
+    op: str
+    algorithm: str
+    world: int
+    count: int
+    nbytes: int
+    duration_ns: float
+    algbw_GBps: float
+    efficiency: float
+
+
+@dataclasses.dataclass
+class _Case:
+    """One benchmarkable collective: program + input factory + chain adapter."""
+
+    op: operation
+    build: Callable[[], Callable]
+    make_inputs: Callable[[int], tuple]
+    # maps prog output back to something input-shaped so dependent chains
+    # are possible (identity for in-shape == out-shape collectives)
+    chain_adapt: Optional[Callable] = None
+    # bytes moved per rank for algbw accounting (defaults to count*dtsize)
+    payload_bytes: Optional[Callable[[int], int]] = None
+
+
+def _dev(comm: Communicator, arr: np.ndarray):
+    return jax.device_put(arr, comm.sharding())
+
+
+def _build_combine_best(comm: Communicator, func: reduceFunction,
+                        dt: dataType):
+    """combine through the Pallas reduce_ops lane on TPU, jnp elsewhere.
+    Pallas failures surface at first trace, not at build — smoke-execute
+    on tiny inputs before accepting the lane."""
+    use_pallas = jax.default_backend() == "tpu"
+    for pallas in ([True, False] if use_pallas else [False]):
+        prog = primitives.build_combine(comm, func, dt, use_pallas=pallas)
+        try:
+            tiny = _dev(comm, np.zeros((comm.world_size, 256),
+                                       np.dtype(to_jax_dtype(dt))))
+            np.asarray(_pick(prog(tiny, tiny)))
+            return prog
+        except Exception:
+            continue
+    return primitives.build_combine(comm, func, dt, use_pallas=False)
+
+
+def _cases(comm: Communicator, dt: dataType, func: reduceFunction,
+           algo: Algorithm) -> Dict[str, _Case]:
+    world = comm.world_size
+    npdt = np.dtype(to_jax_dtype(dt))
+
+    def flat(n, fill=1.0):
+        return _dev(comm, np.full((world, n), fill, npdt))
+
+    def wide(n, fill=1.0):
+        return _dev(comm, np.full((world, n * world), fill, npdt))
+
+    import jax.numpy as jnp
+
+    return {
+        "copy": _Case(
+            operation.copy,
+            lambda: primitives.build_copy(comm),
+            lambda n: (flat(n),)),
+        "combine": _Case(
+            operation.combine,
+            lambda: _build_combine_best(comm, func, dt),
+            lambda n: (flat(n), flat(n, 2.0))),
+        "sendrecv": _Case(
+            operation.send,
+            lambda: primitives.build_move(comm, 0, (1 % world)),
+            lambda n: (flat(n), flat(n, 0.0))),
+        "bcast": _Case(
+            operation.bcast,
+            lambda: algorithms.build_bcast(comm, 0, algo, None),
+            lambda n: (flat(n),)),
+        "scatter": _Case(
+            operation.scatter,
+            lambda: primitives.build_scatter(comm, 0),
+            lambda n: (wide(n),),
+            chain_adapt=lambda out: jnp.tile(out, (1, comm.world_size))),
+        "gather": _Case(
+            operation.gather,
+            lambda: primitives.build_gather(comm, 0),
+            lambda n: (flat(n), wide(n, 0.0)),
+            chain_adapt=lambda out: out[:, : out.shape[1] // comm.world_size]),
+        "allgather": _Case(
+            operation.allgather,
+            lambda: algorithms.build_allgather(comm, algo, None),
+            lambda n: (flat(n),),
+            chain_adapt=lambda out: out[:, : out.shape[1] // comm.world_size]),
+        "reduce": _Case(
+            operation.reduce,
+            lambda: algorithms.build_reduce(comm, 0, func, dt, algo, None),
+            lambda n: (flat(n), flat(n, 0.0))),
+        "allreduce": _Case(
+            operation.allreduce,
+            lambda: algorithms.build_allreduce(comm, func, dt, algo, None),
+            lambda n: (flat(n, 1e-6),)),
+        "reduce_scatter": _Case(
+            operation.reduce_scatter,
+            lambda: algorithms.build_reduce_scatter(comm, func, dt, algo, None),
+            lambda n: (wide(n, 1e-6),),
+            chain_adapt=lambda out: jnp.tile(out, (1, comm.world_size)),
+            payload_bytes=lambda n: n * comm.world_size * dtype_size(dt)),
+        "alltoall": _Case(
+            operation.alltoall,
+            lambda: primitives.build_alltoall(comm),
+            lambda n: (wide(n),),
+            payload_bytes=lambda n: n * comm.world_size * dtype_size(dt)),
+    }
+
+
+def _time_block(prog, args, reps: int) -> float:
+    """Per-call wall time; right on synchronous backends (CPU emulator)."""
+    np.asarray(_pick(jax.block_until_ready(prog(*args))))  # compile + warm
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(prog(*args))
+        np.asarray(_pick(out))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def time_chain(prog, args, adapt=None, nbytes: int = 0,
+               est_bw: float = 700e9, target_s: float = 0.5) -> float:
+    """Per-op device time from two dependent chains + one forced readback
+    each: slope = (t_long - t_short)/(k_long - k_short). The single shared
+    implementation — the repo-root ``bench.py`` headline uses it too."""
+    def run(k: int) -> None:
+        x = args[0]
+        for _ in range(k):
+            out = prog(x, *args[1:])
+            x = adapt(out) if adapt is not None else out
+        float(np.asarray(_pick(x)))  # forces execution of the whole chain
+
+    est = max(3 * nbytes / est_bw, 2e-5)
+    k_long = int(min(max(target_s / est, 64), 4096))
+    k_short = max(k_long // 8, 8)
+    run(2)  # compile + warm
+
+    t0 = time.perf_counter()
+    run(k_short)
+    t_short = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    run(k_long)
+    t_long = time.perf_counter() - t0
+    per = (t_long - t_short) / (k_long - k_short)
+    # RTT noise can swamp short sweeps; never report better than the long
+    # chain's amortized rate
+    return max(per, t_long / (k_long + 1) * 0.5, 1e-9)
+
+
+def run_sweep(
+    comm: Communicator,
+    ops: Sequence[str],
+    dt: dataType = dataType.float32,
+    func: reduceFunction = reduceFunction.SUM,
+    algorithm: Algorithm = Algorithm.XLA,
+    min_pow: int = 4,
+    max_pow: int = 19,
+    reps: int = 9,
+    mode: str = "block",
+    link_bw: float = 45e9,
+    rtt: float = 1e-6,
+    pows: Optional[Sequence[int]] = None,
+) -> List[SweepRow]:
+    """Sweep ``ops`` over 2^min_pow..2^max_pow elements (bench.cpp matrix).
+
+    ``pows`` overrides the contiguous range with an explicit list of
+    exponents (the headline bench samples a sparse sweep)."""
+    cases = _cases(comm, dt, func, algorithm)
+    unknown = [o for o in ops if o not in cases]
+    if unknown:
+        raise ValueError(f"unknown ops {unknown}; have {sorted(cases)}")
+    rows: List[SweepRow] = []
+    for name in ops:
+        case = cases[name]
+        prog = case.build()
+        for p in (pows if pows is not None else range(min_pow, max_pow + 1)):
+            n = 2 ** p
+            args = case.make_inputs(n)
+            nbytes = (case.payload_bytes(n) if case.payload_bytes
+                      else n * dtype_size(dt))
+            if mode == "chain":
+                t = time_chain(prog, args, case.chain_adapt, nbytes)
+            else:
+                t = _time_block(prog, args, reps)
+            eff = models.efficiency(case.op, comm.world_size, nbytes, t,
+                                    bw=link_bw, rtt=rtt)
+            rows.append(SweepRow(
+                op=name, algorithm=algorithm.name, world=comm.world_size,
+                count=n, nbytes=nbytes, duration_ns=t * 1e9,
+                algbw_GBps=nbytes / t / 1e9, efficiency=eff))
+    return rows
+
+
+def write_csv(rows: Sequence[SweepRow], path) -> None:
+    """CSV schema analog of ``fixture.hpp:81`` (Test,Param,Cycles + derived)."""
+    opened = isinstance(path, (str, bytes))
+    out = open(path, "w", newline="") if opened else path
+    try:
+        w = csv.writer(out)
+        w.writerow(["op", "algorithm", "world", "count", "nbytes",
+                    "duration_ns", "algbw_GBps", "efficiency"])
+        for r in rows:
+            w.writerow([r.op, r.algorithm, r.world, r.count, r.nbytes,
+                        f"{r.duration_ns:.1f}", f"{r.algbw_GBps:.4f}",
+                        f"{r.efficiency:.4f}"])
+    finally:
+        if opened:
+            out.close()

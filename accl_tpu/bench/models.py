@@ -1,0 +1,125 @@
+"""Analytic ideal-duration models per collective.
+
+The reference ships per-collective ideal-time formulas used to judge how
+close the measured sweep comes to the hardware envelope
+(``test/host/xrt/parse_bench_results.py:50-60``: e.g. bcast =
+(P-1)*M/bw on a flat tree, allreduce = ring reduce-scatter + allgather).
+These are the same alpha-beta (latency-bandwidth) models re-derived for a
+TPU mesh: ``rtt`` is the per-hop latency (ICI hop or emulator dispatch),
+``bw`` the per-link bandwidth in bytes/s.
+
+All functions return seconds for one collective of ``nbytes`` payload
+per rank across ``world`` ranks.
+"""
+from __future__ import annotations
+
+import math
+
+from ..constants import operation
+
+
+def _ring_steps(world: int) -> int:
+    return max(world - 1, 0)
+
+
+def ideal_sendrecv(world: int, nbytes: int, bw: float, rtt: float) -> float:
+    """One point-to-point message (eager pipeline, fw send :575-651)."""
+    return rtt + nbytes / bw
+
+
+def ideal_bcast(world: int, nbytes: int, bw: float, rtt: float) -> float:
+    """Binary tree above the flat-tree threshold (fw :816-869)."""
+    rounds = math.ceil(math.log2(world)) if world > 1 else 0
+    return rounds * (rtt + nbytes / bw)
+
+
+def ideal_scatter(world: int, nbytes: int, bw: float, rtt: float) -> float:
+    """Root fanout of per-rank chunks (fw :994-1125); nbytes = chunk size."""
+    return _ring_steps(world) * (rtt + nbytes / bw)
+
+
+def ideal_gather(world: int, nbytes: int, bw: float, rtt: float) -> float:
+    """Ring relay into root (fw :1207-1295)."""
+    return _ring_steps(world) * (rtt + nbytes / bw)
+
+
+def ideal_allgather(world: int, nbytes: int, bw: float, rtt: float) -> float:
+    """Ring allgather (fw :1299-1505); nbytes = per-rank contribution."""
+    return _ring_steps(world) * (rtt + nbytes / bw)
+
+
+def ideal_reduce(world: int, nbytes: int, bw: float, rtt: float) -> float:
+    """Binary-tree reduce (fw :1603-1728)."""
+    rounds = math.ceil(math.log2(world)) if world > 1 else 0
+    return rounds * (rtt + nbytes / bw)
+
+
+def ideal_allreduce(world: int, nbytes: int, bw: float, rtt: float) -> float:
+    """Segmented ring reduce-scatter + ring allgather (fw :1888-2071):
+    2(P-1)/P * M bytes per link — bandwidth-optimal."""
+    if world <= 1:
+        return rtt
+    steps = 2 * (world - 1)
+    return steps * (rtt + nbytes / world / bw)
+
+
+def ideal_reduce_scatter(world: int, nbytes: int, bw: float,
+                         rtt: float) -> float:
+    """Ring with fused recv-reduce-forward (fw :1782-1850); nbytes = full
+    input per rank (world * chunk)."""
+    if world <= 1:
+        return rtt
+    return (world - 1) * (rtt + nbytes / world / bw)
+
+
+def ideal_alltoall(world: int, nbytes: int, bw: float, rtt: float) -> float:
+    """P simultaneous flat trees (fw :2123-2218); nbytes = full send buffer."""
+    if world <= 1:
+        return rtt
+    return (world - 1) * (rtt + nbytes / world / bw)
+
+
+def ideal_barrier(world: int, nbytes: int, bw: float, rtt: float) -> float:
+    """Zero-byte gather + scatter through rank 0 (fw :2078-2120)."""
+    rounds = 2 * math.ceil(math.log2(world)) if world > 1 else 0
+    return rounds * rtt
+
+
+def ideal_local(world: int, nbytes: int, bw: float, rtt: float) -> float:
+    """Local datapath move: copy / combine (fw :533-571)."""
+    return nbytes / bw
+
+
+_MODELS = {
+    operation.copy: ideal_local,
+    operation.combine: ideal_local,
+    operation.send: ideal_sendrecv,
+    operation.recv: ideal_sendrecv,
+    operation.bcast: ideal_bcast,
+    operation.scatter: ideal_scatter,
+    operation.gather: ideal_gather,
+    operation.allgather: ideal_allgather,
+    operation.reduce: ideal_reduce,
+    operation.allreduce: ideal_allreduce,
+    operation.reduce_scatter: ideal_reduce_scatter,
+    operation.alltoall: ideal_alltoall,
+    operation.barrier: ideal_barrier,
+}
+
+
+def ideal_duration(op: operation, world: int, nbytes: int,
+                   bw: float, rtt: float = 0.0) -> float:
+    """Ideal seconds for ``op`` (parse_bench_results.py model analog)."""
+    fn = _MODELS.get(op)
+    if fn is None:
+        raise ValueError(f"no analytic model for {op}")
+    return fn(world, nbytes, bw, rtt)
+
+
+def efficiency(op: operation, world: int, nbytes: int, measured_s: float,
+               bw: float, rtt: float = 0.0) -> float:
+    """ideal/measured in [0, 1] — the sweep's figure of merit."""
+    ideal = ideal_duration(op, world, nbytes, bw, rtt)
+    if measured_s <= 0:
+        return 0.0
+    return min(ideal / measured_s, 1.0) if ideal > 0 else 0.0

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+from typing import Optional
 
 from . import constants
 
@@ -77,6 +78,10 @@ class ACCLConfig:
 
     # default algorithm policy
     algorithm: Algorithm = Algorithm.AUTO
+
+    # transport the mesh rides on (HWID stack-type analog); None means
+    # auto-detect from the device list at ACCL.initialize
+    transport: Optional[TransportBackend] = None
 
     def replace(self, **kw) -> "ACCLConfig":
         return dataclasses.replace(self, **kw)

@@ -201,6 +201,20 @@ class MatchingEngine:
     def _tag_ok(recv_tag: int, send_tag: int) -> bool:
         return recv_tag == TAG_ANY or send_tag == TAG_ANY or recv_tag == send_tag
 
+    # -- per-pair sequence counters (communicator.cpp:80-116 readback) -----
+
+    def outbound_seq(self, src: int, dst: int) -> int:
+        """Next seqn to be assigned on the (src, dst) pair."""
+        if self._native is not None:
+            return self._native.outbound_seq(src, dst)
+        return self.comm.peek_outbound_seq(src, dst)
+
+    def inbound_seq(self, src: int, dst: int) -> int:
+        """Next seqn expected for delivery on the (src, dst) pair."""
+        if self._native is not None:
+            return self._native.inbound_seq(src, dst)
+        return self.comm.peek_inbound_seq(src, dst)
+
     # -- introspection (dump_eager_rx_buffers analog) ----------------------
 
     def dump(self) -> str:

@@ -1,4 +1,15 @@
 from .timing import Timer
 from .logging import get_logger, set_log_level
+from .bringup import (
+    detect_backend,
+    generate_ranks,
+    initialize_accl,
+    mesh_shape_2d,
+    simulated_devices,
+)
 
-__all__ = ["Timer", "get_logger", "set_log_level"]
+__all__ = [
+    "Timer", "get_logger", "set_log_level",
+    "detect_backend", "generate_ranks", "initialize_accl",
+    "mesh_shape_2d", "simulated_devices",
+]

@@ -1,153 +1,66 @@
 #!/usr/bin/env python3
 """Headline benchmark — prints ONE JSON line.
 
-Metric (BASELINE.json): all-reduce algbw (GB/s/chip) + p50 latency over a
-payload sweep. On a multi-device mesh this measures the framework's ring
-allreduce (collectives v2) directly. On a single chip — the driver's bench
-rig — allreduce has no inter-chip bus traffic, so the headline falls back to
-the on-chip datapath: the fused combine (reduce_ops plugin lane), the exact
-stage the reference's 512-bit @ 250 MHz CCLO datapath envelope bounds at
+Metric (BASELINE.json): all-reduce algbw (GB/s/chip) over a payload sweep.
+On a multi-device mesh this measures the framework's ring allreduce
+(collectives v2) directly. On a single chip — the driver's bench rig —
+allreduce has no inter-chip bus traffic, so the headline falls back to the
+on-chip datapath: the combine (reduce_ops plugin lane), the exact stage
+the reference's 512-bit @ 250 MHz CCLO datapath envelope bounds at
 16 GB/s per stream (`driver/hls/accl_hls.h:29`). vs_baseline compares our
 measured stream rate against that envelope (multi-chip: against the
 100 Gbps = 12.5 GB/s line rate, `README.md:5`).
 
-Timing methodology: the TPU may be reached through a tunnel where
-`block_until_ready` does not wait for device completion, so per-op time is
-derived from two dependent-op chains of different lengths with a forced
-scalar readback at the end: per_op = (t_long - t_short) / (k_long -
-k_short). This amortizes away both dispatch overhead and the readback RTT —
-the same device-only accounting as the reference's PERFCNT cycle counter
-(`ccl_offload_control.c:2294-2303`).
+Measurement is `accl_tpu.bench.harness` in chain mode: dependent-op chains
+with forced readback, so lazy dispatch through tunneled TPU backends cannot
+fake the numbers (the PERFCNT-equivalent device-only accounting).
 """
 from __future__ import annotations
 
 import json
-import time
 
 import jax
-import numpy as np
 
 REF_DATAPATH_GBPS = 16.0  # 512 bit x 250 MHz CCLO stream (accl_hls.h:29)
 REF_LINE_GBPS = 12.5      # 100 Gbps Ethernet per card (README.md:5)
 
-SWEEP_ELEMS = [2**12, 2**16, 2**20, 2**24, 2**26]  # 16 KiB .. 256 MiB fp32
-EST_HBM_GBPS = 700.0      # only for choosing chain lengths
-MIN_OP_S = 2e-5           # dispatch floor
-TARGET_CHAIN_S = 0.8
-
-
-def _chain_lengths(nbytes: int) -> tuple:
-    est = max(3 * nbytes / (EST_HBM_GBPS * 1e9), MIN_OP_S)
-    k_long = int(min(max(TARGET_CHAIN_S / est, 64), 4096))
-    return max(k_long // 8, 8), k_long
-
-
-_pick = jax.jit(lambda v: v.ravel()[0])
-
-
-def _run_chain(step, x, k: int) -> float:
-    for _ in range(k):
-        x = step(x)
-    return float(np.asarray(_pick(x)))
-
-
-def _per_op_time(step, x, nbytes: int) -> float:
-    k_short, k_long = _chain_lengths(nbytes)
-    _run_chain(step, x, 2)  # compile + warm
-    t0 = time.perf_counter()
-    _run_chain(step, x, k_short)
-    t_short = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    _run_chain(step, x, k_long)
-    t_long = time.perf_counter() - t0
-    per = (t_long - t_short) / (k_long - k_short)
-    # RTT noise can swamp short sweeps; never report better than the long
-    # chain's amortized rate
-    return max(per, t_long / (k_long + 1) * 0.5, 1e-9)
-
-
-def bench_allreduce(comm):
-    """Multi-device: ring allreduce algbw (GB/s/chip) sweep."""
-    from accl_tpu import Algorithm, dataType, reduceFunction
-    from accl_tpu.parallel import algorithms
-
-    world = comm.world_size
-    prog = algorithms.build_allreduce(
-        comm, reduceFunction.SUM, dataType.float32, Algorithm.RING, None)
-    rows = []
-    for n in SWEEP_ELEMS:
-        x = jax.device_put(
-            np.full((world, n), 1e-6, np.float32), comm.sharding())
-        t = _per_op_time(lambda v: prog(v), x, n * 4)
-        rows.append({"bytes": n * 4, "p50_s": t,
-                     "algbw_GBps": n * 4 / t / 1e9})
-    return rows
-
-
-def bench_combine(comm):
-    """Single-chip: reduce_ops plugin lane stream throughput sweep."""
-    from accl_tpu import dataType, reduceFunction
-    from accl_tpu.parallel import primitives
-
-    use_pallas = jax.default_backend() == "tpu"
-    world = comm.world_size
-
-    def _build(pallas: bool):
-        prog = primitives.build_combine(
-            comm, reduceFunction.SUM, dataType.float32, use_pallas=pallas)
-        # Pallas failures surface at first trace/compile, not at build time —
-        # smoke-execute before accepting the lane
-        tiny = jax.device_put(np.zeros((world, 256), np.float32),
-                              comm.sharding())
-        np.asarray(prog(tiny, tiny))
-        return prog
-
-    try:
-        prog = _build(use_pallas)
-    except Exception:
-        prog = _build(False)
-
-    rows = []
-    for n in SWEEP_ELEMS:
-        a = jax.device_put(np.full((world, n), 1e-6, np.float32),
-                           comm.sharding())
-        b = jax.device_put(np.full((world, n), 1e-7, np.float32),
-                           comm.sharding())
-        t = _per_op_time(lambda v: prog(v, b), a, n * 4)
-        rows.append({"bytes": n * 4, "p50_s": t,
-                     "stream_GBps": n * 4 / t / 1e9})
-    return rows
+SWEEP_POWS = [12, 16, 20, 24, 26]  # 16 KiB .. 256 MiB fp32
 
 
 def main() -> None:
     import accl_tpu
+    from accl_tpu import Algorithm
+    from accl_tpu.bench import harness
 
-    devices = jax.devices()
-    acc = accl_tpu.ACCL(devices=devices)
+    acc = accl_tpu.ACCL()
     comm = acc.global_comm()
     world = comm.world_size
+    mode = "chain" if jax.default_backend() == "tpu" else "block"
 
     if world > 1:
-        rows = bench_allreduce(comm)
-        peak = max(r["algbw_GBps"] for r in rows)
+        rows = harness.run_sweep(comm, ["allreduce"],
+                                 algorithm=Algorithm.RING,
+                                 pows=SWEEP_POWS, mode=mode)
         metric = f"allreduce_ring_algbw_{world}dev"
         baseline = REF_LINE_GBPS
     else:
-        rows = bench_combine(comm)
-        peak = max(r["stream_GBps"] for r in rows)
+        rows = harness.run_sweep(comm, ["combine"],
+                                 pows=SWEEP_POWS, mode=mode)
         metric = "combine_reduce_ops_stream_rate"
         baseline = REF_DATAPATH_GBPS
 
+    peak = max(r.algbw_GBps for r in rows)
     print(json.dumps({
         "metric": metric,
         "value": round(peak, 3),
         "unit": "GB/s",
         "vs_baseline": round(peak / baseline, 3),
-        "p50_latency_small_us": round(rows[0]["p50_s"] * 1e6, 1),
+        "per_op_small_us": round(rows[0].duration_ns / 1e3, 1),
         "backend": jax.default_backend(),
         "world": world,
-        "sweep": [{k: (round(v, 7) if isinstance(v, float) else v)
-                   for k, v in r.items()} for r in rows],
+        "sweep": [{"bytes": r.nbytes,
+                   "per_op_us": round(r.duration_ns / 1e3, 1),
+                   "GBps": round(r.algbw_GBps, 3)} for r in rows],
     }))
 
 

@@ -1,0 +1,80 @@
+"""Sweep harness + analytic model tests (bench.cpp / parse_bench_results.py
+analogs, SURVEY.md §2.8)."""
+import io
+
+import numpy as np
+import pytest
+
+from accl_tpu import Algorithm, dataType, reduceFunction
+from accl_tpu.bench import harness, models
+from accl_tpu.constants import operation
+
+
+def test_sweep_produces_rows(accl):
+    rows = harness.run_sweep(
+        accl.global_comm(), ["allreduce", "bcast"],
+        min_pow=4, max_pow=5, reps=1)
+    assert len(rows) == 4
+    for r in rows:
+        assert r.duration_ns > 0
+        assert r.algbw_GBps > 0
+        assert 0.0 <= r.efficiency <= 1.0
+        assert r.world == accl.world_size
+
+
+def test_sweep_all_ops_one_size(accl):
+    ops = ["copy", "combine", "sendrecv", "scatter", "gather",
+           "allgather", "reduce", "reduce_scatter", "alltoall"]
+    rows = harness.run_sweep(accl.global_comm(), ops,
+                             min_pow=4, max_pow=4, reps=1)
+    assert [r.op for r in rows] == ops
+
+
+def test_sweep_ring_algorithm(accl):
+    rows = harness.run_sweep(
+        accl.global_comm(), ["allreduce"], algorithm=Algorithm.RING,
+        min_pow=4, max_pow=4, reps=1)
+    assert rows[0].algorithm == "RING"
+
+
+def test_sweep_rejects_unknown_op(accl):
+    with pytest.raises(ValueError, match="unknown ops"):
+        harness.run_sweep(accl.global_comm(), ["frobnicate"])
+
+
+def test_csv_roundtrip(accl):
+    rows = harness.run_sweep(accl.global_comm(), ["bcast"],
+                             min_pow=4, max_pow=4, reps=1)
+    buf = io.StringIO()
+    harness.write_csv(rows, buf)
+    lines = buf.getvalue().strip().splitlines()
+    assert lines[0].startswith("op,algorithm,world,count")
+    assert lines[1].startswith("bcast,")
+    assert len(lines) == 2
+
+
+def test_ideal_models_bandwidth_ordering():
+    """Ring allreduce moves 2(P-1)/P*M per link -> slower than bcast's
+    log2(P) rounds at equal payload only for small P; check exact values."""
+    bw, M, P = 100e9, 1 << 30, 8
+    ar = models.ideal_duration(operation.allreduce, P, M, bw)
+    assert ar == pytest.approx(2 * (P - 1) * (M / P) / bw)
+    bc = models.ideal_duration(operation.bcast, P, M, bw)
+    assert bc == pytest.approx(3 * M / bw)
+    rs = models.ideal_duration(operation.reduce_scatter, P, M, bw)
+    assert rs == pytest.approx((P - 1) * (M / P) / bw)
+
+
+def test_ideal_models_world1_degenerate():
+    for op in (operation.allreduce, operation.reduce_scatter,
+               operation.alltoall):
+        assert models.ideal_duration(op, 1, 1 << 20, 1e9, rtt=5e-6) == 5e-6
+
+
+def test_efficiency_bounds():
+    assert models.efficiency(operation.allreduce, 8, 1 << 20,
+                             measured_s=1e-12, bw=1e9) == 1.0
+    assert models.efficiency(operation.allreduce, 8, 1 << 20,
+                             measured_s=1e3, bw=1e9) < 1e-5
+    assert models.efficiency(operation.barrier, 1, 0,
+                             measured_s=1.0, bw=1e9) == 0.0

@@ -1,0 +1,59 @@
+"""Stress test — ring send/recv churn (test/host/xrt/src/stress.cpp:24-34).
+
+The reference hammers 2000 iterations of a send/recv ring to exercise
+rx-buffer recycling and per-pair sequence numbers. Here the analogous
+state under churn is the matching engine (native or Python) and its seqn
+counters, plus the program cache. Iteration count scales via
+``ACCL_STRESS_ITERS`` (CI default keeps the suite fast; set 2000 for the
+full reference workload).
+"""
+import os
+
+import numpy as np
+
+from accl_tpu import Algorithm, dataType, reduceFunction
+
+ITERS = int(os.environ.get("ACCL_STRESS_ITERS", "150"))
+COUNT = 64
+
+
+def test_ring_sendrecv_stress(accl, rng):
+    world = accl.world_size
+    src_buf = accl.create_buffer(COUNT, dataType.float32)
+    dst_buf = accl.create_buffer(COUNT, dataType.float32)
+    for it in range(ITERS):
+        tag = it % 17
+        src_buf.host[:] = (
+            np.arange(world * COUNT, dtype=np.float32).reshape(world, COUNT)
+            + it
+        )
+        # every rank sends its shard one hop around the ring
+        for r in range(world):
+            accl.send(src_buf, COUNT, src=r, dst=(r + 1) % world, tag=tag)
+        for r in range(world):
+            accl.recv(dst_buf, COUNT, src=r, dst=(r + 1) % world, tag=tag)
+        # after the full ring, rank r holds rank r-1's payload
+        np.testing.assert_allclose(
+            dst_buf.host, np.roll(src_buf.host, 1, axis=0))
+    # churn must leave no parked posts and intact per-pair ordering state
+    assert accl.matcher().n_pending == (0, 0)
+    m = accl.matcher()
+    for r in range(world):
+        nxt = (r + 1) % world
+        assert m.outbound_seq(r, nxt) == m.inbound_seq(r, nxt)
+        assert m.outbound_seq(r, nxt) >= ITERS
+
+
+def test_allreduce_algorithm_churn(accl, rng):
+    """Alternating algorithms every call stresses the program cache the way
+    rx-buffer recycling stresses the reference's ring descriptors."""
+    world = accl.world_size
+    send = accl.create_buffer(COUNT, dataType.float32)
+    recv = accl.create_buffer(COUNT, dataType.float32)
+    algos = [Algorithm.XLA, Algorithm.RING, Algorithm.TREE]
+    for it in range(max(ITERS // 5, 20)):
+        send.host[:] = rng.normal(size=(world, COUNT)).astype(np.float32)
+        accl.allreduce(send, recv, COUNT, reduceFunction.SUM,
+                       algorithm=algos[it % len(algos)])
+        np.testing.assert_allclose(
+            recv.host[0], send.host.sum(axis=0), rtol=1e-4, atol=1e-5)
