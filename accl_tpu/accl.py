@@ -40,6 +40,7 @@ from .constants import (
 from .parallel import algorithms, primitives
 from .parallel.compiler import ProgramCache
 from .request import Request, RequestQueue
+from .rxpool import CallQueue
 from .sendrecv import MatchingEngine, RecvPost, SendPost
 from .utils.logging import get_logger
 
@@ -70,6 +71,10 @@ class ACCL:
         self._queue = RequestQueue()
         self._matchers: dict[int, MatchingEngine] = {}
         self._arith_configs = dict(DEFAULT_ARITH_CONFIG)
+        # cooperative scheduler: parked calls resumable by current_step
+        self._sched = CallQueue()
+        self._parked_calls: dict[int, object] = {}
+        self._next_call_id = 1
         self._initialized = False
         self.initialize()
 
@@ -91,7 +96,8 @@ class ACCL:
             self._devices, max_segment_size=self.config.segment_size
         )
         self.comms.append(comm)
-        self._matchers[id(comm)] = MatchingEngine(comm)
+        self._matchers[id(comm)] = MatchingEngine(
+            comm, rx_buffer_count=self.config.eager_rx_buffer_count)
         self._initialized = True
         log.info("initialized: %s", self.parse_hwid())
 
@@ -176,7 +182,8 @@ class ACCL:
         parent = parent or self.comms[0]
         comm = parent.split(ranks)
         self.comms.append(comm)
-        self._matchers[id(comm)] = MatchingEngine(comm)
+        self._matchers[id(comm)] = MatchingEngine(
+            comm, rx_buffer_count=self.config.eager_rx_buffer_count)
         return comm
 
     def matcher(self, comm: Optional[Communicator] = None) -> MatchingEngine:
@@ -313,6 +320,35 @@ class ACCL:
     # two-sided send / recv + one-sided put
     # ------------------------------------------------------------------
 
+    def _segments(self, count: int, dt: dataType) -> List[tuple]:
+        """Eager segmentation geometry: (offset, length) element spans of
+        rx-buffer-sized chunks (fw send loop, ccl_offload_control.c:613-650).
+        """
+        seg_elems = max(self.config.eager_rx_buffer_size
+                        // constants.dtype_size(dt), 1)
+        return [(off, min(seg_elems, count - off))
+                for off in range(0, count, seg_elems)]
+
+    def _pump(self) -> None:
+        """Run the cooperative scheduler: retry parked calls, each resuming
+        from its ``current_step`` (wait_for_call round-robin + retry queue,
+        ccl_offload_control.c:2264-2288, :2460-2478)."""
+        for _ in range(len(self._parked_calls) + 1):
+            popped = self._sched.pop()
+            if popped is None:
+                return
+            call_id, step = popped
+            cont = self._parked_calls.get(call_id)
+            if cont is None:
+                continue
+            new_step = cont(step)
+            if new_step is None:
+                del self._parked_calls[call_id]
+            else:
+                self._sched.push_retry(call_id, new_step)
+                if new_step == step:
+                    return  # no progress possible; stop spinning
+
     def send(
         self,
         srcbuf: BufLike,
@@ -323,22 +359,113 @@ class ACCL:
         from_device: bool = False,
         run_async: bool = False,
         comm: Optional[Communicator] = None,
+        compress_dtype: Optional[dataType] = None,
     ) -> Optional[Request]:
         """Post a send from rank ``src`` to rank ``dst`` (``ACCL::send``;
         fw send :575-651).
 
         Unlike MPI, the rank is explicit: the single controller issues calls
-        on behalf of every rank, so ``src`` names whose shard is sent. The
-        payload is snapshotted (immutable ``jax.Array``), so the call
-        completes immediately — buffered-send semantics, like the eager
-        protocol's copy into rx buffers.
+        on behalf of every rank, so ``src`` names whose shard is sent.
+
+        Protocol split mirrors the firmware: payloads up to
+        ``max_eager_size`` go **eager** — segmented into rx-buffer-sized
+        chunks, each consuming a pool slot while parked, backpressured when
+        the pool is exhausted (sync: NOT_READY; async: parked on the retry
+        queue with ``current_step``). Larger payloads go **rendezvous** —
+        one zero-copy post, no rx buffer (:595-612). ``compress_dtype``
+        compresses the wire payload only (ETH_COMPRESSED semantics).
         """
         comm = comm or self.comms[0]
+        self._pump()
         self._check_count(srcbuf, count, "send")
         data = self._input(srcbuf, count, from_device)
-        post = SendPost(src=src, dst=dst, tag=tag, data=data, count=count)
-        self.matcher(comm).post_send(post)  # assigns seqn; may deliver now
-        return self._finish(operation.send, None, data, True, run_async)
+        arith = self._arith(srcbuf.dtype, compress_dtype)
+        if arith is not None and arith.is_compressing:
+            from . import ops as _ops
+            data = _ops.compress(data, arith.uncompressed, arith.compressed)
+        matcher = self.matcher(comm)
+        nbytes = count * constants.dtype_size(srcbuf.dtype)
+        compressing = arith is not None and arith.is_compressing
+        if nbytes > self.config.max_eager_size and not compressing:
+            # rendezvous: one zero-copy post, no rx buffer (fw :595-612;
+            # compressed messages always take the eager path, like the fw)
+            post = SendPost(src=src, dst=dst, tag=tag, data=data, count=count)
+            matcher.post_send(post)
+            return self._finish(operation.send, None, data, True, run_async)
+        return self._eager_send(matcher, data, count, srcbuf.dtype,
+                                src, dst, tag, run_async)
+
+    def _eager_send(self, matcher, data, count: int, dt: dataType,
+                    src: int, dst: int, tag: int,
+                    run_async: bool) -> Optional[Request]:
+        segs = self._segments(count, dt)
+        # validate against any parked recv upfront: a mid-message overflow
+        # would otherwise strand a half-posted message with shifted seqns
+        cap = matcher.recv_capacity(src, dst, tag)
+        if cap >= 0 and cap < count:
+            raise ACCLError(
+                errorCode.INVALID_BUFFER_SIZE,
+                f"send {src}->{dst} count {count} overflows the pending "
+                f"recv's remaining capacity {cap}")
+
+        def post_segment(i: int) -> bool:
+            """Reserve a pool slot then post segment i; False when the pool
+            is exhausted (slot released by the engine on delivery)."""
+            off, ln = segs[i]
+            slot = matcher.rx_pool.reserve(
+                src, dst, tag, matcher.outbound_seq(src, dst), ln)
+            if slot < 0:
+                return False
+            post = SendPost(src=src, dst=dst, tag=tag,
+                            data=data[:, off:off + ln], count=ln,
+                            rx_slot=slot)
+            try:
+                matcher.post_send(post)
+            except Exception:
+                # rejected before the seqn was consumed — give the slot back
+                matcher.rx_pool.release(slot)
+                raise
+            return True
+
+        if not run_async:
+            # all-or-nothing: never leave a half-posted message behind
+            if matcher.rx_pool.free_slots < len(segs):
+                raise ACCLError(
+                    errorCode.NOT_READY_ERROR,
+                    f"eager rx-buffer pool exhausted "
+                    f"({matcher.rx_pool.free_slots} free, "
+                    f"{len(segs)} segments needed); drain pending recvs or "
+                    f"raise config.eager_rx_buffer_count")
+            for i in range(len(segs)):
+                post_segment(i)
+            return self._finish(operation.send, None, data, True, False)
+
+        # async: post what fits now, park the rest with current_step
+        req = Request(operation.send.name, outputs=data, external=True,
+                      on_complete=self._queue.retire, progress=self._pump)
+        self._queue.push(req)
+
+        def continue_from(step: int) -> Optional[int]:
+            i = step
+            try:
+                while i < len(segs) and post_segment(i):
+                    i += 1
+            except Exception as e:
+                req.cancel(error=e)
+                return None
+            req.current_step = i
+            if i == len(segs):
+                req.fulfill(outputs=data)
+                return None
+            return i
+
+        first = continue_from(0)
+        if first is not None:
+            call_id = self._next_call_id
+            self._next_call_id += 1
+            self._parked_calls[call_id] = continue_from
+            self._sched.push_retry(call_id, first)
+        return req
 
     def recv(
         self,
@@ -350,58 +477,107 @@ class ACCL:
         to_device: bool = False,
         run_async: bool = False,
         comm: Optional[Communicator] = None,
+        compress_dtype: Optional[dataType] = None,
     ) -> Optional[Request]:
         """Post a recv at rank ``dst`` for a message from ``src``
         (``ACCL::recv``; fw recv :655-712).
 
-        If the matching send was already posted, the move executes now (one
-        single-pair ``ppermute`` — the rendezvous RDMA WRITE analog). If not,
-        the recv parks like a rendezvous address announcement; a sync recv
-        that cannot ever match raises ``NOT_READY_ERROR`` (the firmware's
-        retry-queue verdict surfaced as an exception, since a single
-        controller cannot be preempted by a later send).
+        Mirrors the sender's protocol split: eager messages arrive as
+        rx-buffer-sized segments consumed in seqn order (fw :680-711);
+        rendezvous messages as one zero-copy move (the RDMA WRITE analog,
+        :604-612). A sync recv that cannot match raises ``NOT_READY_ERROR``
+        (the firmware's retry verdict surfaced as an exception, since a
+        single controller cannot be preempted by a later send); an async
+        recv parks like a rendezvous address announcement and its request
+        completes on match — ``current_step`` counts delivered segments.
         """
         comm = comm or self.comms[0]
+        self._pump()
         self._check_count(dstbuf, count, "recv")
         matcher = self.matcher(comm)
-        delivered: list = []
+        _ = self._arith(dstbuf.dtype, compress_dtype)  # validate the pair
+
+        collected: list = []
+        assembled: list = []
         pending_req: list = []
 
-        def deliver(spost: SendPost) -> None:
+        def assemble() -> jax.Array:
+            """Message complete: one move program writes the receiver's
+            shard (segment concat = rx-buffer reassembly)."""
+            spost0 = collected[0]
+            wire = (collected[0].data if len(collected) == 1
+                    else jnp.concatenate([p.data for p in collected], axis=1))
             prog = self._programs.get(
-                self._key(comm, operation.send, count, dstbuf.dtype, spost.src, spost.dst),
-                lambda: primitives.build_move(comm, spost.src, spost.dst),
+                self._key(comm, operation.send, count, dstbuf.dtype,
+                          spost0.src, spost0.dst),
+                lambda: primitives.build_move(comm, spost0.src, spost0.dst),
             )
             dest = self._input(dstbuf, count, True)
-            moved = prog(spost.data.astype(dest.dtype), dest)
+            moved = prog(wire.astype(dest.dtype), dest)
             self._store(dstbuf, count, moved)
-            delivered.append(moved)
+            return moved
+
+        def deliver(spost: SendPost) -> None:
+            collected.append(spost)
             if pending_req:
-                # a parked async recv: hand it the data so wait() can finish
-                pending_req[0].fulfill(outputs=moved)
+                pending_req[0].current_step = len(collected)
+            if sum(p.count for p in collected) == count:
+                moved = assemble()
+                assembled.append(moved)
+                if pending_req:
+                    pending_req[0].fulfill(outputs=moved)
 
-        post = RecvPost(src=src, dst=dst, tag=tag, count=count, deliver=deliver)
-        matched = matcher.post_recv(post)
-        if matched:
-            return self._finish(operation.recv, dstbuf, delivered[0],
-                                to_device, run_async)
+        post = RecvPost(src=src, dst=dst, tag=tag, count=count,
+                        deliver=deliver)
+
         if not run_async:
-            # un-park so the failed call cannot steal a future send
-            matcher.remove_recv(post)
-            raise ACCLError(
-                errorCode.NOT_READY_ERROR,
-                f"recv {dst}<-{src} tag={tag}: no matching send posted",
-            )
+            done = matcher.post_recv(post)
+            # a partially-filled recv resumes as parked senders free up:
+            # each consumed segment releases a pool slot, the pump lets the
+            # blocked sender post the next segment into this parked recv
+            # (cooperative eager pipeline, fw :628-649)
+            while not done:
+                before = post.remaining
+                self._pump()
+                done = post.remaining == 0
+                if not done and post.remaining == before:
+                    break  # no progress possible
+            if not done:
+                if collected:
+                    # segments were consumed — keep the recv parked so the
+                    # delivered data is not lost; it completes (and writes
+                    # dstbuf) when the remaining segments arrive, like a
+                    # NOT_READY call resuming from current_step. Do NOT
+                    # re-post: this recv stays active.
+                    raise ACCLError(
+                        errorCode.NOT_READY_ERROR,
+                        f"recv {dst}<-{src} tag={tag}: "
+                        f"{count - post.remaining}/{count} elements arrived; "
+                        f"recv remains posted and resumes as segments arrive")
+                matcher.remove_recv(post)
+                raise ACCLError(
+                    errorCode.NOT_READY_ERROR,
+                    f"recv {dst}<-{src} tag={tag}: no matching send posted",
+                )
+            return self._finish(operation.recv, dstbuf,
+                                assembled[0] if assembled else None,
+                                to_device, False)
 
-        # rendezvous announcement: request completes when a send matches
+        # async: park; request completes when the last segment lands
         def finalizer(_req: Request) -> None:
             if not to_device:
                 dstbuf.sync_from_device()
 
         req = Request(operation.recv.name, outputs=None, finalizer=finalizer,
-                      external=True, on_complete=self._queue.retire)
+                      external=True, on_complete=self._queue.retire,
+                      progress=self._pump)
         pending_req.append(req)
-        self._queue.push(req)
+        try:
+            self._queue.push(req)
+            matcher.post_recv(post)
+        except Exception as e:
+            req.cancel(error=e)
+            raise
         return req
 
     def put(
@@ -716,3 +892,8 @@ class ACCL:
 
     def dump_communicator(self, comm: Optional[Communicator] = None) -> str:
         return (comm or self.comms[0]).dump()
+
+    def dump_eager_rx_buffers(self, comm: Optional[Communicator] = None) -> str:
+        """Per-slot pool table (``ACCL::dump_eager_rx_buffers``,
+        accl.cpp:999-1064): status / occupancy / tag / seqn per slot."""
+        return self.matcher(comm).rx_pool.dump()

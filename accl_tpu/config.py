@@ -58,6 +58,12 @@ class ACCLConfig:
     # segmentation: chunk size for pipelined collectives (rx-buffer size analog)
     segment_size: int = constants.DEFAULT_SEGMENT_SIZE
 
+    # eager protocol: rx-buffer pool geometry (ACCL::initialize defaults —
+    # 16 spare buffers; each eager message is segmented into
+    # rx-buffer-sized chunks, ccl_offload_control.c:613-650)
+    eager_rx_buffer_count: int = 16
+    eager_rx_buffer_size: int = 16 * 1024  # bytes per slot
+
     # flat-tree maxima (BCAST_FLAT_TREE_MAX_RANKS etc.,
     # ccl_offload_control.c:816,1533; fan-in throttle :1144-1206)
     bcast_flat_tree_max_ranks: int = 8

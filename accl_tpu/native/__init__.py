@@ -63,10 +63,16 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.accl_post_send.restype = c.c_int64
     lib.accl_post_send.argtypes = [c.c_void_p, c.c_int32, c.c_int32,
                                    c.c_int64, c.c_int64,
-                                   c.POINTER(c.c_int64), c.POINTER(c.c_int64)]
+                                   c.POINTER(c.c_int64), c.POINTER(c.c_int64),
+                                   c.POINTER(c.c_int64)]
     lib.accl_post_recv.restype = c.c_int64
     lib.accl_post_recv.argtypes = [c.c_void_p, c.c_int32, c.c_int32,
-                                   c.c_int64, c.c_int64, c.POINTER(c.c_int64)]
+                                   c.c_int64, c.c_int64,
+                                   c.POINTER(c.c_int64), c.c_int32,
+                                   c.POINTER(c.c_int32), c.POINTER(c.c_int64)]
+    lib.accl_recv_capacity.restype = c.c_int64
+    lib.accl_recv_capacity.argtypes = [c.c_void_p, c.c_int32, c.c_int32,
+                                       c.c_int64]
     lib.accl_remove_recv.restype = c.c_int32
     lib.accl_remove_recv.argtypes = [c.c_void_p, c.c_int64]
     lib.accl_clear.argtypes = [c.c_void_p]
@@ -87,6 +93,36 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.accl_req_status.argtypes = [c.c_void_p, c.c_int64]
     lib.accl_req_free.argtypes = [c.c_void_p, c.c_int64]
     lib.accl_now_ns.restype = c.c_uint64
+    # rx-buffer pool
+    lib.accl_pool_create.restype = c.c_void_p
+    lib.accl_pool_create.argtypes = [c.c_int32]
+    lib.accl_pool_destroy.argtypes = [c.c_void_p]
+    lib.accl_pool_reserve.restype = c.c_int32
+    lib.accl_pool_reserve.argtypes = [c.c_void_p, c.c_int32, c.c_int32,
+                                      c.c_int64, c.c_int64, c.c_int64]
+    for name in ("accl_pool_mark_reserved", "accl_pool_release"):
+        fn = getattr(lib, name)
+        fn.restype = c.c_int32
+        fn.argtypes = [c.c_void_p, c.c_int32]
+    for name in ("accl_pool_free_slots", "accl_pool_size"):
+        fn = getattr(lib, name)
+        fn.restype = c.c_int32
+        fn.argtypes = [c.c_void_p]
+    lib.accl_pool_slot_info.restype = c.c_int32
+    lib.accl_pool_slot_info.argtypes = [c.c_void_p, c.c_int32,
+                                        c.POINTER(c.c_int64)]
+    lib.accl_pool_clear.argtypes = [c.c_void_p]
+    # cooperative call queue
+    lib.accl_cq_create.restype = c.c_void_p
+    lib.accl_cq_destroy.argtypes = [c.c_void_p]
+    lib.accl_cq_push_new.argtypes = [c.c_void_p, c.c_int64]
+    lib.accl_cq_push_retry.argtypes = [c.c_void_p, c.c_int64, c.c_int64]
+    lib.accl_cq_pop.restype = c.c_int32
+    lib.accl_cq_pop.argtypes = [c.c_void_p, c.POINTER(c.c_int64),
+                                c.POINTER(c.c_int64)]
+    lib.accl_cq_depths.argtypes = [c.c_void_p, c.POINTER(c.c_int64),
+                                   c.POINTER(c.c_int64)]
+    lib.accl_cq_clear.argtypes = [c.c_void_p]
     return lib
 
 
@@ -131,18 +167,35 @@ class NativeEngine:
 
     # matching ----------------------------------------------------------
     def post_send(self, src: int, dst: int, tag: int, count: int):
-        """Returns (send id, matched recv id or NO_MATCH, assigned seqn)."""
+        """Returns (send id, matched recv id or NO_MATCH, assigned seqn,
+        matched recv's remaining element count or -1)."""
         out = ctypes.c_int64(NO_MATCH)
         seqn = ctypes.c_int64(-1)
+        rem = ctypes.c_int64(-1)
         sid = self._lib.accl_post_send(self._h, src, dst, tag, count,
-                                       ctypes.byref(out), ctypes.byref(seqn))
-        return sid, out.value, seqn.value
+                                       ctypes.byref(out), ctypes.byref(seqn),
+                                       ctypes.byref(rem))
+        return sid, out.value, seqn.value, rem.value
 
     def post_recv(self, src: int, dst: int, tag: int, count: int):
-        out = ctypes.c_int64(NO_MATCH)
+        """Returns (recv id, [consumed send ids] in seqn order, remaining).
+
+        The id buffer is sized by the number of parked sends, not the
+        element count (at most that many segments can match); the C++ side
+        stops consuming when the buffer fills, so ids are never dropped.
+        """
+        cap = max(min(int(count), self._lib.accl_pending_sends(self._h)), 1)
+        ids = (ctypes.c_int64 * cap)()
+        n = ctypes.c_int32(0)
+        rem = ctypes.c_int64(count)
         rid = self._lib.accl_post_recv(self._h, src, dst, tag, count,
-                                       ctypes.byref(out))
-        return rid, out.value
+                                       ids, cap, ctypes.byref(n),
+                                       ctypes.byref(rem))
+        return rid, list(ids[: n.value]), rem.value
+
+    def recv_capacity(self, src: int, dst: int, tag: int) -> int:
+        """Remaining elements of the first eligible parked recv, or -1."""
+        return self._lib.accl_recv_capacity(self._h, src, dst, tag)
 
     def remove_recv(self, rid: int) -> bool:
         return bool(self._lib.accl_remove_recv(self._h, rid))
@@ -175,6 +228,104 @@ class NativeEngine:
 
     def req_free(self, rid: int) -> None:
         self._lib.accl_req_free(self._h, rid)
+
+
+#: rx-buffer slot lifecycle (rxbuf_enqueue.cpp:50-74; keep in sync with
+#: acclrt.cpp SlotStatus)
+SLOT_IDLE = 0
+SLOT_ENQUEUED = 1
+SLOT_RESERVED = 2
+
+
+class NativePool:
+    """RAII wrapper over the native eager rx-buffer pool."""
+
+    def __init__(self, nslots: int):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._h = ctypes.c_void_p(lib.accl_pool_create(nslots))
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.accl_pool_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    def reserve(self, src: int, dst: int, tag: int, seqn: int,
+                count: int) -> int:
+        return self._lib.accl_pool_reserve(self._h, src, dst, tag, seqn, count)
+
+    def mark_reserved(self, slot: int) -> bool:
+        return bool(self._lib.accl_pool_mark_reserved(self._h, slot))
+
+    def release(self, slot: int) -> bool:
+        return bool(self._lib.accl_pool_release(self._h, slot))
+
+    @property
+    def free_slots(self) -> int:
+        return self._lib.accl_pool_free_slots(self._h)
+
+    @property
+    def size(self) -> int:
+        return self._lib.accl_pool_size(self._h)
+
+    def slot_info(self, i: int):
+        """(status, src, dst, tag, seqn, count) or None for a bad index."""
+        out = (ctypes.c_int64 * 6)()
+        if not self._lib.accl_pool_slot_info(self._h, i, out):
+            return None
+        return tuple(out)
+
+    def clear(self) -> None:
+        self._lib.accl_pool_clear(self._h)
+
+
+class NativeCallQueue:
+    """RAII wrapper over the native cooperative call queue."""
+
+    def __init__(self):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._h = ctypes.c_void_p(lib.accl_cq_create())
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.accl_cq_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    def push_new(self, call_id: int) -> None:
+        self._lib.accl_cq_push_new(self._h, call_id)
+
+    def push_retry(self, call_id: int, current_step: int) -> None:
+        self._lib.accl_cq_push_retry(self._h, call_id, current_step)
+
+    def pop(self):
+        """(call_id, current_step) or None when both queues are empty."""
+        cid = ctypes.c_int64()
+        step = ctypes.c_int64()
+        if not self._lib.accl_cq_pop(self._h, ctypes.byref(cid),
+                                     ctypes.byref(step)):
+            return None
+        return cid.value, step.value
+
+    @property
+    def depths(self):
+        nf = ctypes.c_int64()
+        nr = ctypes.c_int64()
+        self._lib.accl_cq_depths(self._h, ctypes.byref(nf), ctypes.byref(nr))
+        return nf.value, nr.value
+
+    def clear(self) -> None:
+        self._lib.accl_cq_clear(self._h)
 
 
 def now_ns() -> int:

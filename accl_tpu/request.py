@@ -42,7 +42,8 @@ class Request:
     def __init__(self, scenario: str, outputs: Any = None,
                  finalizer: Optional[Callable[["Request"], None]] = None,
                  external: bool = False,
-                 on_complete: Optional[Callable[["Request"], None]] = None):
+                 on_complete: Optional[Callable[["Request"], None]] = None,
+                 progress: Optional[Callable[[], None]] = None):
         with Request._id_lock:
             Request._next_id += 1
             self.id = Request._next_id
@@ -56,6 +57,13 @@ class Request:
         #: the NOT_READY retry-queue analog (ccl_offload_control.c:2460-2478)
         self._external = external
         self._on_complete = on_complete
+        #: cooperative-scheduler hook: run parked continuations while this
+        #: request waits (the firmware's retry pump; without it a wait on a
+        #: backpressured operation could never make progress)
+        self._progress = progress
+        #: resumption progress for multi-step operations (segments posted or
+        #: delivered) — the retry queue's current_step analog
+        self.current_step = 0
         self._start_ns = time.monotonic_ns()
         self._duration_ns: Optional[int] = None
         self._cv = threading.Condition()
@@ -104,11 +112,22 @@ class Request:
     def wait(self, timeout: Optional[float] = None) -> None:
         """Block until done (CCLO::wait / BaseRequest::wait analog)."""
         if self._external:
-            # wait for fulfill() from a future matching post
-            with self._cv:
-                if not self._cv.wait_for(
-                    lambda: self._done or not self._external, timeout=timeout
-                ):
+            # wait for fulfill() from a future matching post, pumping the
+            # cooperative scheduler so parked operations can finish
+            deadline = ((time.monotonic() + timeout)
+                        if timeout is not None else None)
+            while True:
+                if self._progress is not None:
+                    self._progress()
+                with self._cv:
+                    if self._cv.wait_for(
+                        lambda: self._done or not self._external,
+                        timeout=0.005 if self._progress else timeout,
+                    ):
+                        break
+                    if self._progress is None:
+                        raise ACCLTimeoutError(self.scenario)
+                if deadline is not None and time.monotonic() > deadline:
                     raise ACCLTimeoutError(self.scenario)
         if not self._done:
             try:

@@ -1,0 +1,173 @@
+"""Eager rx-buffer pool + cooperative call queue (host-side protocol state).
+
+Reference machinery being re-expressed (SURVEY.md §2.2/§2.3/§5):
+
+* the spare-buffer table with its IDLE → ENQUEUED → RESERVED lifecycle
+  (``rxbuf_enqueue.cpp:50-74``, ring descriptors
+  ``ccl_offload_control.h:287-295``) — here each slot accounts for one
+  parked eager *segment* (payload stays a ``jax.Array`` reference);
+  pool exhaustion is the backpressure that makes senders retry, the exact
+  analog of running out of rx buffers on the FPGA;
+* the dispatch loop's retry queue with ``current_step`` resumption
+  (``ccl_offload_control.c:2264-2288`` round-robin, ``:2460-2478``
+  re-enqueue) — cooperative multitasking between pending operations.
+
+Both have a native C++ backend (:mod:`accl_tpu.native`) and a pure-Python
+fallback with identical semantics.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from . import native as _native
+
+#: slot lifecycle states (keep names aligned with the reference dump)
+IDLE = _native.SLOT_IDLE
+ENQUEUED = _native.SLOT_ENQUEUED
+RESERVED = _native.SLOT_RESERVED
+
+_STATUS_NAMES = {IDLE: "IDLE", ENQUEUED: "ENQUEUED", RESERVED: "RESERVED"}
+
+
+@dataclasses.dataclass
+class _Slot:
+    status: int = IDLE
+    src: int = -1
+    dst: int = -1
+    tag: int = -1
+    seqn: int = -1
+    count: int = 0
+
+
+class RxBufPool:
+    """Bounded eager-segment accounting with the reference slot lifecycle."""
+
+    def __init__(self, nslots: int, use_native: Optional[bool] = None):
+        if use_native is None:
+            use_native = _native.available()
+        self._native = _native.NativePool(nslots) if use_native else None
+        self._slots: List[_Slot] = (
+            [] if use_native else [_Slot() for _ in range(nslots)])
+        self._nslots = nslots
+
+    @property
+    def is_native(self) -> bool:
+        return self._native is not None
+
+    @property
+    def size(self) -> int:
+        return self._nslots
+
+    def reserve(self, src: int, dst: int, tag: int, seqn: int,
+                count: int) -> int:
+        """Claim an IDLE slot for a parked segment; -1 when exhausted."""
+        if self._native is not None:
+            return self._native.reserve(src, dst, tag, seqn, count)
+        for i, s in enumerate(self._slots):
+            if s.status == IDLE:
+                self._slots[i] = _Slot(ENQUEUED, src, dst, tag, seqn, count)
+                return i
+        return -1
+
+    def mark_reserved(self, slot: int) -> bool:
+        if self._native is not None:
+            return self._native.mark_reserved(slot)
+        if 0 <= slot < self._nslots and self._slots[slot].status == ENQUEUED:
+            self._slots[slot].status = RESERVED
+            return True
+        return False
+
+    def release(self, slot: int) -> bool:
+        if self._native is not None:
+            return self._native.release(slot)
+        if 0 <= slot < self._nslots and self._slots[slot].status != IDLE:
+            self._slots[slot] = _Slot()
+            return True
+        return False
+
+    @property
+    def free_slots(self) -> int:
+        if self._native is not None:
+            return self._native.free_slots
+        return sum(1 for s in self._slots if s.status == IDLE)
+
+    def slot_info(self, i: int) -> Optional[Tuple[int, int, int, int, int, int]]:
+        if self._native is not None:
+            return self._native.slot_info(i)
+        if not (0 <= i < self._nslots):
+            return None
+        s = self._slots[i]
+        return (s.status, s.src, s.dst, s.tag, s.seqn, s.count)
+
+    def clear(self) -> None:
+        if self._native is not None:
+            self._native.clear()
+        else:
+            self._slots = [_Slot() for _ in range(self._nslots)]
+
+    def dump(self) -> str:
+        """``ACCL::dump_eager_rx_buffers`` analog (accl.cpp:999-1064)."""
+        used = self._nslots - self.free_slots
+        lines = [f"RxBufPool[{'native' if self.is_native else 'python'}]: "
+                 f"{used}/{self._nslots} in use"]
+        for i in range(self._nslots):
+            st, src, dst, tag, seqn, count = self.slot_info(i)
+            if st == IDLE:
+                continue
+            lines.append(
+                f"  slot {i}: {_STATUS_NAMES.get(st, st)} "
+                f"{src}->{dst} tag={tag} seqn={seqn} count={count}")
+        return "\n".join(lines)
+
+
+class CallQueue:
+    """Round-robin fresh/retry queues with ``current_step`` resumption."""
+
+    def __init__(self, use_native: Optional[bool] = None):
+        if use_native is None:
+            use_native = _native.available()
+        self._native = _native.NativeCallQueue() if use_native else None
+        self._fresh: List[Tuple[int, int]] = []
+        self._retry: List[Tuple[int, int]] = []
+        self._prefer_retry = True
+
+    @property
+    def is_native(self) -> bool:
+        return self._native is not None
+
+    def push_new(self, call_id: int) -> None:
+        if self._native is not None:
+            self._native.push_new(call_id)
+        else:
+            self._fresh.append((call_id, 0))
+
+    def push_retry(self, call_id: int, current_step: int) -> None:
+        if self._native is not None:
+            self._native.push_retry(call_id, current_step)
+        else:
+            self._retry.append((call_id, current_step))
+
+    def pop(self) -> Optional[Tuple[int, int]]:
+        if self._native is not None:
+            return self._native.pop()
+        queues = ([self._retry, self._fresh] if self._prefer_retry
+                  else [self._fresh, self._retry])
+        self._prefer_retry = not self._prefer_retry
+        for q in queues:
+            if q:
+                return q.pop(0)
+        return None
+
+    @property
+    def depths(self) -> Tuple[int, int]:
+        if self._native is not None:
+            return self._native.depths
+        return (len(self._fresh), len(self._retry))
+
+    def clear(self) -> None:
+        if self._native is not None:
+            self._native.clear()
+        else:
+            self._fresh.clear()
+            self._retry.clear()

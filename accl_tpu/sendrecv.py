@@ -45,17 +45,22 @@ class SendPost:
     count: int
     seqn: int = -1          # assigned by the matching engine at post time
     on_matched: Optional[Callable] = None  # completes the sender's request
+    rx_slot: int = -1       # eager rx-buffer pool slot held while parked
 
 
 @dataclasses.dataclass
 class RecvPost:
-    """A posted-but-unmatched recv (rendezvous address announcement analog)."""
+    """A posted recv for ``count`` total elements, filled incrementally by
+    send segments in seqn order (the fw recv MOVE_ON_RECV loop,
+    ccl_offload_control.c:680-711). ``deliver`` runs once per consumed
+    segment; the post stays parked until ``remaining`` hits zero."""
 
     src: int
     dst: int
     tag: int
     count: int
-    deliver: Callable[[SendPost], None]   # executes the move into the recv buffer
+    deliver: Callable[[SendPost], None]   # per-segment payload callback
+    remaining: int = -1                    # set to count at post time
 
 
 class MatchingEngine:
@@ -68,7 +73,8 @@ class MatchingEngine:
     matching decisions and sequence counters.
     """
 
-    def __init__(self, comm: Communicator, use_native: Optional[bool] = None):
+    def __init__(self, comm: Communicator, use_native: Optional[bool] = None,
+                 rx_buffer_count: int = 16):
         self.comm = comm
         if use_native is None:
             from . import native as _n
@@ -80,6 +86,8 @@ class MatchingEngine:
         self._posts: Dict[int, object] = {}   # native id -> post
         self._pending_sends: List[SendPost] = []
         self._pending_recvs: List[RecvPost] = []
+        from .rxpool import RxBufPool
+        self.rx_pool = RxBufPool(rx_buffer_count, use_native=use_native)
 
     @property
     def is_native(self) -> bool:
@@ -96,25 +104,30 @@ class MatchingEngine:
         return s.seqn == self.comm.peek_inbound_seq(src, dst)
 
     def post_send(self, post: SendPost) -> bool:
-        """Assign the outbound seqn, then deliver into a waiting recv or park.
-        Returns True if delivered immediately.
+        """Assign the outbound seqn, then fill a waiting recv or park.
+        Returns True if delivered into a recv (which may still be partially
+        filled and parked — this segment is consumed either way).
 
-        Count validation happens *before* the seqn is consumed, so a rejected
-        send leaves the pair's ordering state untouched.
+        Capacity validation happens *before* the seqn is consumed, so a
+        rejected send leaves the pair's ordering state untouched.
         """
         if self._native is not None:
             from . import native as _n
-            sid, matched, seqn = self._native.post_send(
+            sid, matched, seqn, rem = self._native.post_send(
                 post.src, post.dst, post.tag, post.count)
             if sid == _n.ERR_COUNT_MISMATCH:
                 raise ACCLError(
                     errorCode.INVALID_BUFFER_SIZE,
-                    f"send {post.src}->{post.dst} count {post.count} does not "
-                    f"match the pending recv's count")
+                    f"send {post.src}->{post.dst} segment count {post.count} "
+                    f"overflows the pending recv's remaining capacity")
             post.seqn = seqn
             if matched >= 0:
-                r = self._posts.pop(matched)
+                r = self._posts[matched]
+                r.remaining = rem
+                if rem == 0:
+                    self._posts.pop(matched)
                 r.deliver(post)
+                self._release_slot(post)
                 if post.on_matched:
                     post.on_matched()
                 return True
@@ -129,15 +142,20 @@ class MatchingEngine:
                     and prospective == self.comm.peek_inbound_seq(post.src, post.dst):
                 candidate = (i, r)
                 break
-        if candidate is not None and candidate[1].count != post.count:
-            raise ACCLError(errorCode.INVALID_BUFFER_SIZE,
-                            f"recv count {candidate[1].count} != send count {post.count}")
+        if candidate is not None and candidate[1].remaining < post.count:
+            raise ACCLError(
+                errorCode.INVALID_BUFFER_SIZE,
+                f"send segment count {post.count} overflows the pending "
+                f"recv's remaining capacity {candidate[1].remaining}")
         post.seqn = self.comm.next_outbound_seq(post.src, post.dst)
         if candidate is not None:
             i, r = candidate
-            self._pending_recvs.pop(i)
+            r.remaining -= post.count
+            if r.remaining == 0:
+                self._pending_recvs.pop(i)
             self.comm.next_inbound_seq(post.src, post.dst)
             r.deliver(post)
+            self._release_slot(post)
             if post.on_matched:
                 post.on_matched()
             return True
@@ -145,39 +163,70 @@ class MatchingEngine:
         return False
 
     def post_recv(self, post: RecvPost) -> bool:
-        """Try to consume a parked send; else park the recv. Returns True if
-        a send was consumed (data delivered)."""
+        """Greedily consume parked send segments in seqn order until the
+        recv is filled; park it with the remainder otherwise. Returns True
+        when the recv completed (``post.remaining == 0``)."""
+        post.remaining = post.count
         if self._native is not None:
             from . import native as _n
-            rid, matched = self._native.post_recv(
+            rid, matched_ids, rem = self._native.post_recv(
                 post.src, post.dst, post.tag, post.count)
             if rid == _n.ERR_COUNT_MISMATCH:
                 raise ACCLError(
                     errorCode.INVALID_BUFFER_SIZE,
-                    f"recv {post.dst}<-{post.src} count {post.count} does not "
-                    f"match the pending send's count")
-            if matched >= 0:
-                s = self._posts.pop(matched)
+                    f"recv {post.dst}<-{post.src} count {post.count} is "
+                    f"smaller than the pending send's segment")
+            post.remaining = rem
+            if rem > 0:
+                self._posts[rid] = post
+                post._native_id = rid
+            for mid in matched_ids:
+                s = self._posts.pop(mid)
                 post.deliver(s)
+                self._release_slot(s)
                 if s.on_matched:
                     s.on_matched()
-                return True
-            self._posts[rid] = post
-            post._native_id = rid
+            return rem == 0
+        consumed_any = False
+        while post.remaining > 0:
+            found = None
+            for i, s in enumerate(self._pending_sends):
+                if self._send_matches(s, post.src, post.dst, post.tag):
+                    found = (i, s)
+                    break
+            if found is None:
+                break
+            i, s = found
+            if s.count > post.remaining:
+                if not consumed_any:
+                    raise ACCLError(
+                        errorCode.INVALID_BUFFER_SIZE,
+                        f"recv count {post.count} is smaller than the "
+                        f"pending send's segment count {s.count}")
+                break  # geometry straddles this recv; leave the segment
+            consumed_any = True
+            self._pending_sends.pop(i)
+            self.comm.next_inbound_seq(post.src, post.dst)
+            post.remaining -= s.count
+            post.deliver(s)
+            self._release_slot(s)
+            if s.on_matched:
+                s.on_matched()
+        if post.remaining > 0:
+            self._pending_recvs.append(post)
             return False
-        for i, s in enumerate(self._pending_sends):
-            if self._send_matches(s, post.src, post.dst, post.tag):
-                if s.count != post.count:
-                    raise ACCLError(errorCode.INVALID_BUFFER_SIZE,
-                                    f"recv count {post.count} != send count {s.count}")
-                self._pending_sends.pop(i)
-                self.comm.next_inbound_seq(post.src, post.dst)
-                post.deliver(s)
-                if s.on_matched:
-                    s.on_matched()
-                return True
-        self._pending_recvs.append(post)
-        return False
+        return True
+
+    def recv_capacity(self, src: int, dst: int, tag: int) -> int:
+        """Remaining element capacity of the first parked recv eligible for
+        (src, dst, tag), or -1 when none — lets a sender validate a whole
+        message upfront so mid-message overflow never corrupts seqn state."""
+        if self._native is not None:
+            return self._native.recv_capacity(src, dst, tag)
+        for r in self._pending_recvs:
+            if r.src == src and r.dst == dst and self._tag_ok(r.tag, tag):
+                return r.remaining
+        return -1
 
     def remove_recv(self, post: RecvPost) -> None:
         """Un-park a recv (used when a sync recv fails NOT_READY, so the
@@ -196,6 +245,14 @@ class MatchingEngine:
             self._posts.clear()
         self._pending_sends.clear()
         self._pending_recvs.clear()
+        self.rx_pool.clear()
+
+    def _release_slot(self, s: SendPost) -> None:
+        """Delivery done: ENQUEUED -> RESERVED -> IDLE (rxbuf lifecycle)."""
+        if s.rx_slot >= 0:
+            self.rx_pool.mark_reserved(s.rx_slot)
+            self.rx_pool.release(s.rx_slot)
+            s.rx_slot = -1
 
     @staticmethod
     def _tag_ok(recv_tag: int, send_tag: int) -> bool:
@@ -230,6 +287,7 @@ class MatchingEngine:
             lines.append(f"  send {s.src}->{s.dst} tag={s.tag} seqn={s.seqn} count={s.count}")
         for r in recvs:
             lines.append(f"  recv {r.dst}<-{r.src} tag={r.tag} count={r.count}")
+        lines.append(self.rx_pool.dump())
         return "\n".join(lines)
 
     @property

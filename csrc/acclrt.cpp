@@ -31,8 +31,9 @@ struct Post {
   int32_t src;
   int32_t dst;
   int64_t tag;
-  int64_t count;
-  int64_t seqn;  // sends only
+  int64_t count;      // sends: segment elements; recvs: total message elements
+  int64_t seqn;       // sends only
+  int64_t remaining;  // recvs: elements still to be filled by segments
 };
 
 struct PairKey {
@@ -63,16 +64,21 @@ class Engine {
  public:
   // ---- matching (rxbuf_seek analog) ----------------------------------
 
-  // Post a send. Assigns the outbound seqn (after validating any matched
-  // recv's count, so errors consume no state). Returns the send post id;
-  // *matched_recv out-param is the delivered recv's id or -1 if parked;
-  // *assigned_seqn is the seqn consumed by this send (atomic with the
-  // assignment — callers must not re-derive it from outbound_seq()).
+  // Post a send segment. Assigns the outbound seqn (after validating any
+  // matched recv's capacity, so errors consume no state). A matched recv is
+  // *partially filled*: its remaining count drops by this segment's count
+  // and it stays parked until full — the MOVE_ON_RECV per-segment loop
+  // (ccl_offload_control.c:680-711) seen from the send side. Out-params:
+  // *matched_recv = filled recv's id or -1; *assigned_seqn = the seqn this
+  // segment consumed (atomic with assignment); *recv_remaining = elements
+  // the matched recv still expects (0 = complete, recv removed).
   int64_t post_send(int32_t src, int32_t dst, int64_t tag, int64_t count,
-                    int64_t* matched_recv, int64_t* assigned_seqn) {
+                    int64_t* matched_recv, int64_t* assigned_seqn,
+                    int64_t* recv_remaining) {
     std::lock_guard<std::mutex> g(mu_);
     *matched_recv = kNoMatch;
     *assigned_seqn = -1;
+    *recv_remaining = -1;
     int64_t prospective = outbound_[{src, dst}];
     // candidate recv: same pair, compatible tag, and this send is the next
     // expected message for the pair
@@ -87,49 +93,75 @@ class Engine {
       }
     }
     if (idx != pending_recvs_.size() &&
-        pending_recvs_[idx].count != count) {
-      return kErrCountMismatch;  // nothing consumed
+        pending_recvs_[idx].remaining < count) {
+      return kErrCountMismatch;  // segment overflows the recv; nothing consumed
     }
-    Post s{next_id_++, src, dst, tag, count, outbound_[{src, dst}]++};
+    Post s{next_id_++, src, dst, tag, count, outbound_[{src, dst}]++, 0};
     *assigned_seqn = s.seqn;
     if (idx != pending_recvs_.size()) {
-      *matched_recv = pending_recvs_[idx].id;
-      pending_recvs_.erase(pending_recvs_.begin() + idx);
+      Post& r = pending_recvs_[idx];
+      r.remaining -= count;
+      *matched_recv = r.id;
+      *recv_remaining = r.remaining;
       inbound_[{src, dst}]++;
+      if (r.remaining == 0)
+        pending_recvs_.erase(pending_recvs_.begin() + idx);
       return s.id;
     }
     pending_sends_.push_back(s);
     return s.id;
   }
 
-  // Post a recv. Returns recv post id; *matched_send is the consumed send's
-  // id or -1 if the recv parked. kErrCountMismatch on count conflict.
+  // Post a recv for ``count`` total elements. Greedily consumes parked send
+  // segments in seqn order until filled or none eligible (fw recv loop,
+  // :680-711). Consumed send ids land in matched_ids (up to cap);
+  // *remaining is the unfilled element count (0 = complete, recv not
+  // parked). kErrCountMismatch if the first eligible segment alone
+  // overflows the recv (nothing consumed).
   int64_t post_recv(int32_t src, int32_t dst, int64_t tag, int64_t count,
-                    int64_t* matched_send) {
+                    int64_t* matched_ids, int32_t cap, int32_t* n_matched,
+                    int64_t* remaining) {
     std::lock_guard<std::mutex> g(mu_);
-    *matched_send = kNoMatch;
-    int64_t expected = inbound_[{src, dst}];
-    size_t idx = pending_sends_.size();
-    for (size_t i = 0; i < pending_sends_.size(); ++i) {
-      const Post& s = pending_sends_[i];
-      if (s.src == src && s.dst == dst && tag_ok(tag, s.tag) &&
-          s.seqn == expected) {
-        idx = i;
-        break;
+    *n_matched = 0;
+    int64_t left = count;
+    while (left > 0) {
+      int64_t expected = inbound_[{src, dst}];
+      size_t idx = pending_sends_.size();
+      for (size_t i = 0; i < pending_sends_.size(); ++i) {
+        const Post& s = pending_sends_[i];
+        if (s.src == src && s.dst == dst && tag_ok(tag, s.tag) &&
+            s.seqn == expected) {
+          idx = i;
+          break;
+        }
       }
-    }
-    if (idx != pending_sends_.size() && pending_sends_[idx].count != count) {
-      return kErrCountMismatch;
-    }
-    Post r{next_id_++, src, dst, tag, count, -1};
-    if (idx != pending_sends_.size()) {
-      *matched_send = pending_sends_[idx].id;
+      if (idx == pending_sends_.size()) break;
+      if (pending_sends_[idx].count > left) {
+        if (*n_matched == 0) return kErrCountMismatch;
+        break;  // geometry straddles this recv; leave the segment parked
+      }
+      if (*n_matched >= cap) break;  // id buffer full; leave the rest parked
+      left -= pending_sends_[idx].count;
+      matched_ids[(*n_matched)++] = pending_sends_[idx].id;
       pending_sends_.erase(pending_sends_.begin() + idx);
       inbound_[{src, dst}]++;
-      return r.id;
     }
-    pending_recvs_.push_back(r);
+    *remaining = left;
+    Post r{next_id_++, src, dst, tag, count, -1, left};
+    if (left > 0) pending_recvs_.push_back(r);
     return r.id;
+  }
+
+  // Remaining capacity of the first parked recv eligible for (src, dst,
+  // tag), or -1 when none is parked. Lets senders validate a whole message
+  // upfront so a mid-message overflow can never corrupt seqn state.
+  int64_t recv_capacity(int32_t src, int32_t dst, int64_t tag) {
+    std::lock_guard<std::mutex> g(mu_);
+    for (const Post& r : pending_recvs_) {
+      if (r.src == src && r.dst == dst && tag_ok(r.tag, tag))
+        return r.remaining;
+    }
+    return -1;
   }
 
   bool remove_recv(int64_t id) {
@@ -215,6 +247,147 @@ class Engine {
   std::unordered_map<int64_t, Request> requests_;
 };
 
+// ---- eager rx-buffer pool (rxbuf_offload analog) ----------------------
+//
+// The reference keeps a spare-buffer table in exchange memory with an
+// IDLE -> ENQUEUED -> RESERVED lifecycle driven by rxbuf_enqueue.cpp:50-74
+// and the ring descriptors at ccl_offload_control.h:287-295. Here each slot
+// accounts for one parked eager segment (payload itself stays in Python as
+// a jax.Array reference); exhaustion is the backpressure signal that makes
+// senders retry, exactly like running out of rx buffers on the FPGA.
+
+enum SlotStatus : int32_t { kIdle = 0, kEnqueued = 1, kReserved = 2 };
+
+struct Slot {
+  int32_t status = kIdle;
+  int32_t src = -1, dst = -1;
+  int64_t tag = -1, seqn = -1, count = 0;
+};
+
+class RxBufPool {
+ public:
+  explicit RxBufPool(int32_t nslots) : slots_(nslots) {}
+
+  // Claim an IDLE slot for a parked segment -> slot index, or -1 if full.
+  int32_t reserve(int32_t src, int32_t dst, int64_t tag, int64_t seqn,
+                  int64_t count) {
+    std::lock_guard<std::mutex> g(mu_);
+    for (size_t i = 0; i < slots_.size(); ++i) {
+      if (slots_[i].status == kIdle) {
+        slots_[i] = Slot{kEnqueued, src, dst, tag, seqn, count};
+        return (int32_t)i;
+      }
+    }
+    return -1;
+  }
+
+  // ENQUEUED -> RESERVED: the segment matched; delivery in progress.
+  bool mark_reserved(int32_t slot) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (slot < 0 || slot >= (int32_t)slots_.size() ||
+        slots_[slot].status != kEnqueued)
+      return false;
+    slots_[slot].status = kReserved;
+    return true;
+  }
+
+  // back to IDLE (delivery done, or send cancelled).
+  bool release(int32_t slot) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (slot < 0 || slot >= (int32_t)slots_.size() ||
+        slots_[slot].status == kIdle)
+      return false;
+    slots_[slot] = Slot{};
+    return true;
+  }
+
+  int32_t free_slots() {
+    std::lock_guard<std::mutex> g(mu_);
+    int32_t n = 0;
+    for (const auto& s : slots_)
+      if (s.status == kIdle) ++n;
+    return n;
+  }
+
+  int32_t size() { return (int32_t)slots_.size(); }
+
+  // out[6] = {status, src, dst, tag, seqn, count}; returns 0 on bad index.
+  int32_t slot_info(int32_t i, int64_t* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (i < 0 || i >= (int32_t)slots_.size()) return 0;
+    const Slot& s = slots_[i];
+    out[0] = s.status; out[1] = s.src; out[2] = s.dst;
+    out[3] = s.tag; out[4] = s.seqn; out[5] = s.count;
+    return 1;
+  }
+
+  void clear() {
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto& s : slots_) s = Slot{};
+  }
+
+ private:
+  std::mutex mu_;
+  std::vector<Slot> slots_;
+};
+
+// ---- cooperative call queue (wait_for_call + retry analog) ------------
+//
+// The firmware dispatch loop round-robins between new calls (CMD_CALL) and
+// the retry queue (STS_CALL_RETRY), re-enqueueing NOT_READY calls with
+// their current_step for stateless resumption
+// (ccl_offload_control.c:2264-2288, :2460-2478). Descriptors here are
+// opaque call ids owned by Python; current_step travels with them.
+
+class CallQueue {
+ public:
+  void push_new(int64_t call_id) {
+    std::lock_guard<std::mutex> g(mu_);
+    fresh_.push_back({call_id, 0});
+  }
+
+  void push_retry(int64_t call_id, int64_t current_step) {
+    std::lock_guard<std::mutex> g(mu_);
+    retry_.push_back({call_id, current_step});
+  }
+
+  // Alternates retry/new like wait_for_call; returns 1 if popped.
+  int32_t pop(int64_t* call_id, int64_t* current_step) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::deque<Entry>* first = prefer_retry_ ? &retry_ : &fresh_;
+    std::deque<Entry>* second = prefer_retry_ ? &fresh_ : &retry_;
+    prefer_retry_ = !prefer_retry_;
+    for (auto* q : {first, second}) {
+      if (!q->empty()) {
+        *call_id = q->front().id;
+        *current_step = q->front().step;
+        q->pop_front();
+        return 1;
+      }
+    }
+    return 0;
+  }
+
+  void depths(int64_t* nfresh, int64_t* nretry) {
+    std::lock_guard<std::mutex> g(mu_);
+    *nfresh = (int64_t)fresh_.size();
+    *nretry = (int64_t)retry_.size();
+  }
+
+  void clear() {
+    std::lock_guard<std::mutex> g(mu_);
+    fresh_.clear();
+    retry_.clear();
+  }
+
+ private:
+  struct Entry { int64_t id; int64_t step; };
+  std::mutex mu_;
+  std::deque<Entry> fresh_;
+  std::deque<Entry> retry_;
+  bool prefer_retry_ = true;
+};
+
 }  // namespace
 
 extern "C" {
@@ -224,14 +397,20 @@ void accl_engine_destroy(void* e) { delete static_cast<Engine*>(e); }
 
 int64_t accl_post_send(void* e, int32_t src, int32_t dst, int64_t tag,
                        int64_t count, int64_t* matched_recv,
-                       int64_t* assigned_seqn) {
+                       int64_t* assigned_seqn, int64_t* recv_remaining) {
   return static_cast<Engine*>(e)->post_send(src, dst, tag, count, matched_recv,
-                                            assigned_seqn);
+                                            assigned_seqn, recv_remaining);
 }
 
 int64_t accl_post_recv(void* e, int32_t src, int32_t dst, int64_t tag,
-                       int64_t count, int64_t* matched_send) {
-  return static_cast<Engine*>(e)->post_recv(src, dst, tag, count, matched_send);
+                       int64_t count, int64_t* matched_ids, int32_t cap,
+                       int32_t* n_matched, int64_t* remaining) {
+  return static_cast<Engine*>(e)->post_recv(src, dst, tag, count, matched_ids,
+                                            cap, n_matched, remaining);
+}
+
+int64_t accl_recv_capacity(void* e, int32_t src, int32_t dst, int64_t tag) {
+  return static_cast<Engine*>(e)->recv_capacity(src, dst, tag);
 }
 
 int32_t accl_remove_recv(void* e, int64_t id) {
@@ -268,5 +447,46 @@ void accl_req_free(void* e, int64_t id) {
 }
 
 uint64_t accl_now_ns() { return now_ns(); }
+
+// ---- rx-buffer pool ---------------------------------------------------
+
+void* accl_pool_create(int32_t nslots) { return new RxBufPool(nslots); }
+void accl_pool_destroy(void* p) { delete static_cast<RxBufPool*>(p); }
+int32_t accl_pool_reserve(void* p, int32_t src, int32_t dst, int64_t tag,
+                          int64_t seqn, int64_t count) {
+  return static_cast<RxBufPool*>(p)->reserve(src, dst, tag, seqn, count);
+}
+int32_t accl_pool_mark_reserved(void* p, int32_t slot) {
+  return static_cast<RxBufPool*>(p)->mark_reserved(slot) ? 1 : 0;
+}
+int32_t accl_pool_release(void* p, int32_t slot) {
+  return static_cast<RxBufPool*>(p)->release(slot) ? 1 : 0;
+}
+int32_t accl_pool_free_slots(void* p) {
+  return static_cast<RxBufPool*>(p)->free_slots();
+}
+int32_t accl_pool_size(void* p) { return static_cast<RxBufPool*>(p)->size(); }
+int32_t accl_pool_slot_info(void* p, int32_t i, int64_t* out) {
+  return static_cast<RxBufPool*>(p)->slot_info(i, out);
+}
+void accl_pool_clear(void* p) { static_cast<RxBufPool*>(p)->clear(); }
+
+// ---- cooperative call queue -------------------------------------------
+
+void* accl_cq_create() { return new CallQueue(); }
+void accl_cq_destroy(void* q) { delete static_cast<CallQueue*>(q); }
+void accl_cq_push_new(void* q, int64_t call_id) {
+  static_cast<CallQueue*>(q)->push_new(call_id);
+}
+void accl_cq_push_retry(void* q, int64_t call_id, int64_t current_step) {
+  static_cast<CallQueue*>(q)->push_retry(call_id, current_step);
+}
+int32_t accl_cq_pop(void* q, int64_t* call_id, int64_t* current_step) {
+  return static_cast<CallQueue*>(q)->pop(call_id, current_step);
+}
+void accl_cq_depths(void* q, int64_t* nfresh, int64_t* nretry) {
+  static_cast<CallQueue*>(q)->depths(nfresh, nretry);
+}
+void accl_cq_clear(void* q) { static_cast<CallQueue*>(q)->clear(); }
 
 }  // extern "C"

@@ -1,0 +1,286 @@
+"""Eager/rendezvous protocol split, rx-buffer pool, cooperative scheduler.
+
+Covers the reference protocol machinery (SURVEY.md §2.2/§2.3/§5):
+segmented eager send/recv (fw :613-650/:680-711), rendezvous zero-copy for
+large payloads (:142-410), rx-buffer pool backpressure
+(rxbuf_enqueue.cpp:50-74), and retry-queue resumption with current_step
+(:2460-2478).
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import accl_tpu
+from accl_tpu import ACCLConfig, dataType, reduceFunction
+from accl_tpu.constants import ACCLError, errorCode
+from accl_tpu import rxpool
+
+
+@pytest.fixture()
+def small(accl):
+    """ACCL with tiny eager geometry: 16-element (64 B) rx buffers, 4 slots,
+    256 B eager threshold — forces multi-segment paths at test sizes."""
+    inst = accl_tpu.ACCL(
+        devices=jax.devices()[:4],
+        config=ACCLConfig(eager_rx_buffer_count=4,
+                          eager_rx_buffer_size=64,
+                          max_eager_size=256),
+    )
+    yield inst
+    inst.deinit()
+
+
+def _roundtrip(inst, count, tag=3, src=0, dst=1, **kw):
+    w = inst.world_size
+    s = inst.create_buffer(count, dataType.float32)
+    r = inst.create_buffer(count, dataType.float32)
+    s.host[:] = np.arange(w * count, dtype=np.float32).reshape(w, count)
+    inst.send(s, count, src=src, dst=dst, tag=tag, **kw)
+    inst.recv(r, count, src=src, dst=dst, tag=tag, **kw)
+    np.testing.assert_allclose(r.host[dst], s.host[src], rtol=1e-3)
+    return s, r
+
+
+def test_eager_multi_segment_roundtrip(small):
+    # 40 elems = 160 B <= 256 B eager max -> segments of 16/16/8 elements
+    _roundtrip(small, 40)
+    assert small.matcher().n_pending == (0, 0)
+    assert small.matcher().rx_pool.free_slots == 4
+
+
+@pytest.mark.parametrize("count", [15, 16, 17, 32, 33])
+def test_eager_segment_edge_sizes(small, count):
+    """count = rx-buffer size +/- 1 (the reference's segmentation edge
+    matrix, test.cpp:265)."""
+    _roundtrip(small, count)
+    assert small.matcher().rx_pool.free_slots == 4
+
+
+def test_rendezvous_large_message_single_post(small):
+    # 128 elems = 512 B > 256 B -> rendezvous: exactly one parked post and
+    # no rx-buffer slot consumed
+    w = small.world_size
+    s = small.create_buffer(128, dataType.float32)
+    s.host[:] = np.ones((w, 128), np.float32)
+    small.send(s, 128, src=0, dst=1)
+    assert small.matcher().n_pending == (1, 0)
+    assert small.matcher().rx_pool.free_slots == 4
+    r = small.create_buffer(128, dataType.float32)
+    small.recv(r, 128, src=0, dst=1)
+    np.testing.assert_allclose(r.host[1], s.host[0])
+
+
+def test_pool_exhaustion_sync_send_not_ready(small):
+    s = small.create_buffer(64, dataType.float32)
+    s.host[:] = 1.0
+    # each 64-elem eager send takes 4 segments = the whole pool
+    small.send(s, 64, src=0, dst=1, tag=1)
+    with pytest.raises(ACCLError) as e:
+        small.send(s, 64, src=0, dst=1, tag=2)
+    assert e.value.code == errorCode.NOT_READY_ERROR
+    # draining the first message frees the pool; the retry then succeeds
+    r = small.create_buffer(64, dataType.float32)
+    small.recv(r, 64, src=0, dst=1, tag=1)
+    small.send(s, 64, src=0, dst=1, tag=2)
+    small.recv(r, 64, src=0, dst=1, tag=2)
+    assert small.matcher().rx_pool.free_slots == 4
+
+
+def test_async_send_parks_and_resumes_via_scheduler(small):
+    """Async send beyond pool capacity parks on the retry queue with
+    current_step and completes once recvs free slots (cooperative
+    multitasking between pending operations)."""
+    s = small.create_buffer(64, dataType.float32)
+    r = small.create_buffer(64, dataType.float32)
+    s.host[:] = np.arange(4 * 64, dtype=np.float32).reshape(4, 64)
+    small.send(s, 64, src=0, dst=1, tag=1)            # fills the pool
+    req = small.send(s, 64, src=0, dst=1, tag=2, run_async=True)
+    assert not req.test()
+    assert 0 <= req.current_step < 4
+    # consume message 1 -> slots free; the next op's pump resumes the send
+    small.recv(r, 64, src=0, dst=1, tag=1)
+    small.recv(r, 64, src=0, dst=1, tag=2)
+    req.wait(timeout=10)
+    assert req.test()
+    assert req.current_step == 4
+    np.testing.assert_allclose(r.host[1], s.host[0])
+
+
+def test_compressed_send_recv_roundtrip(small):
+    """compress_dtype casts the wire payload only (ETH_COMPRESSED,
+    hp_compression.cpp): f32 buffers, f16 on the wire."""
+    w = small.world_size
+    count = 24
+    s = small.create_buffer(count, dataType.float32)
+    r = small.create_buffer(count, dataType.float32)
+    s.host[:] = np.linspace(-2, 2, w * count, dtype=np.float32).reshape(w, count)
+    small.send(s, count, src=0, dst=1, tag=9,
+               compress_dtype=dataType.float16)
+    small.recv(r, count, src=0, dst=1, tag=9,
+               compress_dtype=dataType.float16)
+    np.testing.assert_allclose(r.host[1], s.host[0], atol=2e-3)
+
+
+def test_compressed_large_message_stays_eager(small):
+    """Compressed payloads take the eager path regardless of size (the fw
+    only does rendezvous for uncompressed messages)."""
+    s = small.create_buffer(128, dataType.float32)  # 512 B > max_eager
+    s.host[:] = 1.0
+    with pytest.raises(ACCLError) as e:
+        # 128 elems -> 8 segments > 4 slots: eager backpressure proves the
+        # path taken; rendezvous would have parked a single post instead
+        small.send(s, 128, src=0, dst=1, compress_dtype=dataType.float16)
+    assert e.value.code == errorCode.NOT_READY_ERROR
+
+
+def test_dump_eager_rx_buffers(small):
+    s = small.create_buffer(16, dataType.float32)
+    s.host[:] = 1.0
+    small.send(s, 16, src=0, dst=1, tag=5)
+    dump = small.dump_eager_rx_buffers()
+    assert "1/4 in use" in dump
+    assert "ENQUEUED" in dump and "tag=5" in dump
+    r = small.create_buffer(16, dataType.float32)
+    small.recv(r, 16, src=0, dst=1, tag=5)
+    assert "0/4 in use" in small.dump_eager_rx_buffers()
+
+
+# ---- pool / queue unit parity (native vs python backends) ---------------
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_rxpool_lifecycle(use_native):
+    from accl_tpu import native
+    if use_native and not native.available():
+        pytest.skip("native runtime unavailable")
+    pool = rxpool.RxBufPool(2, use_native=use_native)
+    a = pool.reserve(0, 1, 5, 0, 16)
+    b = pool.reserve(0, 1, 5, 1, 16)
+    assert {a, b} == {0, 1}
+    assert pool.reserve(0, 1, 5, 2, 16) == -1          # exhausted
+    assert pool.slot_info(a)[0] == rxpool.ENQUEUED
+    assert pool.mark_reserved(a)
+    assert pool.slot_info(a)[0] == rxpool.RESERVED
+    assert not pool.mark_reserved(a)                    # not ENQUEUED anymore
+    assert pool.release(a)
+    assert not pool.release(a)                          # already IDLE
+    assert pool.free_slots == 1
+    pool.clear()
+    assert pool.free_slots == 2
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_callqueue_round_robin(use_native):
+    from accl_tpu import native
+    if use_native and not native.available():
+        pytest.skip("native runtime unavailable")
+    q = rxpool.CallQueue(use_native=use_native)
+    q.push_new(10)
+    q.push_new(11)
+    q.push_retry(20, 3)
+    # wait_for_call alternation: retry first, then new, then retry...
+    assert q.pop() == (20, 3)
+    assert q.pop() == (10, 0)
+    assert q.pop() == (11, 0)
+    assert q.pop() is None
+    assert q.depths == (0, 0)
+
+
+# ---- review regressions: pump cascades, mixed dtype, slot leaks ---------
+
+def test_sync_recv_completes_partially_posted_async_send(small):
+    """An async send bigger than the pool parks mid-message; a sync recv
+    must pump the scheduler between deliveries so the sender's freed slots
+    let the transfer complete (cooperative eager pipeline)."""
+    s = small.create_buffer(128, dataType.float32)
+    r = small.create_buffer(128, dataType.float32)
+    s.host[:] = np.arange(4 * 128, dtype=np.float32).reshape(4, 128)
+    # compressed -> forced eager: 8 x 16-elem segments > 4 slots
+    req = small.send(s, 128, src=0, dst=1, compress_dtype=dataType.float16,
+                     run_async=True)
+    assert req.current_step < 8
+    small.recv(r, 128, src=0, dst=1, compress_dtype=dataType.float16)
+    req.wait(timeout=10)
+    np.testing.assert_allclose(r.host[1], s.host[0], atol=0.5)
+
+
+def test_wait_drives_parked_operations(small):
+    """Request.wait() itself pumps the scheduler: waiting on parked async
+    send+recv pairs completes without any further API calls."""
+    s = small.create_buffer(128, dataType.float32)
+    r = small.create_buffer(128, dataType.float32)
+    s.host[:] = np.arange(4 * 128, dtype=np.float32).reshape(4, 128)
+    sreq = small.send(s, 128, src=0, dst=1,
+                      compress_dtype=dataType.float16, run_async=True)
+    rreq = small.recv(r, 128, src=0, dst=1,
+                      compress_dtype=dataType.float16, run_async=True)
+    rreq.wait(timeout=10)
+    sreq.wait(timeout=10)
+    np.testing.assert_allclose(r.host[1], s.host[0], atol=0.5)
+
+
+def test_mixed_dtype_recv(small):
+    """Receiver dtype differs from sender dtype: geometry is the sender's;
+    the recv counts elements and casts on delivery."""
+    s = small.create_buffer(40, dataType.float32)
+    r = small.create_buffer(40, dataType.float64)
+    s.host[:] = np.arange(4 * 40, dtype=np.float32).reshape(4, 40)
+    small.send(s, 40, src=0, dst=1, tag=2)      # eager, 3 segments
+    small.recv(r, 40, src=0, dst=1, tag=2)
+    np.testing.assert_allclose(r.host[1], s.host[0])
+
+
+def test_count_mismatch_releases_rx_slot(small):
+    """A send rejected by a too-small parked recv must give its pool slot
+    back (no leak shrinking the pool)."""
+    r = small.create_buffer(8, dataType.float32)
+    small.recv(r, 8, src=0, dst=1, run_async=True)   # parks, capacity 8
+    s = small.create_buffer(16, dataType.float32)
+    s.host[:] = 1.0
+    with pytest.raises(ACCLError):
+        small.send(s, 16, src=0, dst=1)              # 16-elem segment > 8
+    assert small.matcher().rx_pool.free_slots == 4   # slot returned
+
+
+def test_send_overflowing_parked_recv_rejected_upfront(small):
+    """A send bigger than a parked recv's capacity is rejected before any
+    segment posts — no half-posted message, seqns untouched."""
+    r = small.create_buffer(24, dataType.float32)
+    small.recv(r, 24, src=0, dst=1, run_async=True)   # parks, capacity 24
+    s = small.create_buffer(40, dataType.float32)
+    s.host[:] = 1.0
+    with pytest.raises(ACCLError) as e:
+        small.send(s, 40, src=0, dst=1)               # 40 > 24
+    assert e.value.code == errorCode.INVALID_BUFFER_SIZE
+    m = small.matcher()
+    assert m.outbound_seq(0, 1) == 0                  # nothing consumed
+    assert m.rx_pool.free_slots == 4
+
+
+def test_partial_sync_recv_keeps_data_and_completes(small):
+    """Sync recv larger than what has arrived raises NOT_READY but keeps
+    the recv parked with its delivered segments; the transfer completes
+    when the rest arrives."""
+    s = small.create_buffer(40, dataType.float32)
+    r = small.create_buffer(40, dataType.float32)
+    s.host[:] = np.arange(4 * 40, dtype=np.float32).reshape(4, 40)
+    small.send(s, 16, src=0, dst=1, tag=4)            # first 16 elements only
+    with pytest.raises(ACCLError) as e:
+        small.recv(r, 40, src=0, dst=1, tag=4)
+    assert e.value.code == errorCode.NOT_READY_ERROR
+    assert "16/40" in str(e.value)
+    # remaining 24 elements arrive; the parked recv absorbs them and
+    # writes dstbuf on the spot
+    small.send(s.slice(16, 40), 24, src=0, dst=1, tag=4)
+    r.sync_from_device()
+    np.testing.assert_allclose(r.host[1][:16], s.host[0][:16])
+    assert small.matcher().n_pending == (0, 0)
+
+
+def test_wait_timeout_zero_raises_immediately(small):
+    from accl_tpu.constants import ACCLTimeoutError
+    r = small.create_buffer(16, dataType.float32)
+    req = small.recv(r, 16, src=0, dst=1, tag=77, run_async=True)
+    with pytest.raises(ACCLTimeoutError):
+        req.wait(timeout=0)
+    req.cancel()

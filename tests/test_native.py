@@ -19,56 +19,84 @@ def eng():
 
 
 def test_send_then_recv_matches(eng):
-    sid, m, seqn0 = eng.post_send(0, 1, 5, 64)
+    sid, m, seqn0, _ = eng.post_send(0, 1, 5, 64)
     assert m == native.NO_MATCH
     assert seqn0 == 0
-    rid, matched = eng.post_recv(0, 1, 5, 64)
-    assert matched == sid
+    rid, matched, rem = eng.post_recv(0, 1, 5, 64)
+    assert matched == [sid]
+    assert rem == 0
     assert eng.pending() == (0, 0)
 
 
 def test_recv_then_send_matches(eng):
-    rid, m = eng.post_recv(2, 3, TAG_ANY, 16)
-    assert m == native.NO_MATCH
-    sid, matched, _ = eng.post_send(2, 3, 9, 16)
+    rid, m, rem = eng.post_recv(2, 3, TAG_ANY, 16)
+    assert m == [] and rem == 16
+    sid, matched, _, rrem = eng.post_send(2, 3, 9, 16)
     assert matched == rid
+    assert rrem == 0
 
 
 def test_ordered_delivery_by_seqn(eng):
-    s1, _, q1 = eng.post_send(0, 1, 1, 8)
-    s2, _, q2 = eng.post_send(0, 1, 1, 8)
+    s1, _, q1, _ = eng.post_send(0, 1, 1, 8)
+    s2, _, q2, _ = eng.post_send(0, 1, 1, 8)
     assert (q1, q2) == (0, 1)  # seqn returned atomically with assignment
-    _, m1 = eng.post_recv(0, 1, 1, 8)
-    _, m2 = eng.post_recv(0, 1, 1, 8)
-    assert (m1, m2) == (s1, s2)
+    _, m1, _ = eng.post_recv(0, 1, 1, 8)
+    _, m2, _ = eng.post_recv(0, 1, 1, 8)
+    assert (m1, m2) == ([s1], [s2])
+
+
+def test_recv_fills_from_multiple_segments(eng):
+    """One recv consumes consecutive send segments until full (the fw
+    MOVE_ON_RECV loop)."""
+    s1, _, _, _ = eng.post_send(0, 1, 4, 16)
+    s2, _, _, _ = eng.post_send(0, 1, 4, 16)
+    s3, _, _, _ = eng.post_send(0, 1, 4, 8)
+    rid, matched, rem = eng.post_recv(0, 1, 4, 40)
+    assert matched == [s1, s2, s3]
+    assert rem == 0
+
+
+def test_parked_recv_partially_filled_by_segments(eng):
+    """Recv-first: send segments drain into the parked recv, which stays
+    parked until full."""
+    rid, m, rem = eng.post_recv(0, 1, 4, 40)
+    assert rem == 40
+    _, matched, _, rrem = eng.post_send(0, 1, 4, 16)
+    assert matched == rid and rrem == 24
+    _, matched, _, rrem = eng.post_send(0, 1, 4, 16)
+    assert matched == rid and rrem == 8
+    assert eng.pending() == (0, 1)              # still parked
+    _, matched, _, rrem = eng.post_send(0, 1, 4, 8)
+    assert matched == rid and rrem == 0
+    assert eng.pending() == (0, 0)
 
 
 def test_out_of_order_seqn_blocks(eng):
     """A send that is not the next expected message cannot match."""
-    s1, _, q1 = eng.post_send(0, 1, 7, 8)   # seqn 0, parked
-    s2, _, q2 = eng.post_send(0, 1, 8, 8)   # seqn 1, parked
+    s1, _, q1, _ = eng.post_send(0, 1, 7, 8)   # seqn 0, parked
+    s2, _, q2, _ = eng.post_send(0, 1, 8, 8)   # seqn 1, parked
     # recv for tag 8: candidate s2 has seqn 1 != expected 0 -> parks
-    rid, m = eng.post_recv(0, 1, 8, 8)
-    assert m == native.NO_MATCH
+    rid, m, rem = eng.post_recv(0, 1, 8, 8)
+    assert m == [] and rem == 8
     # recv for tag 7 consumes s1 (seqn 0) ...
-    _, m = eng.post_recv(0, 1, 7, 8)
-    assert m == s1
+    _, m, _ = eng.post_recv(0, 1, 7, 8)
+    assert m == [s1]
     # ... which unblocks nothing automatically, but a fresh recv now sees s2
-    _, m = eng.post_recv(0, 1, 8, 8)
-    assert m == s2
+    _, m, _ = eng.post_recv(0, 1, 8, 8)
+    assert m == [s2]
 
 
 def test_count_mismatch_error_consumes_nothing(eng):
-    rid, _ = eng.post_recv(0, 2, 4, 8)
-    res, _, _ = eng.post_send(0, 2, 4, 16)
+    rid, _, _ = eng.post_recv(0, 2, 4, 8)
+    res, _, _, _ = eng.post_send(0, 2, 4, 16)   # segment overflows recv
     assert res == native.ERR_COUNT_MISMATCH
     assert eng.outbound_seq(0, 2) == 0          # seqn not consumed
-    sid, matched, _ = eng.post_send(0, 2, 4, 8)    # correct count matches
+    sid, matched, _, _ = eng.post_send(0, 2, 4, 8)  # fitting segment matches
     assert matched == rid
 
 
 def test_remove_recv_and_clear(eng):
-    rid, _ = eng.post_recv(5, 6, 1, 4)
+    rid, _, _ = eng.post_recv(5, 6, 1, 4)
     assert eng.pending() == (0, 1)
     assert eng.remove_recv(rid)
     assert eng.pending() == (0, 0)
