@@ -39,7 +39,7 @@ from .constants import (
 )
 from .parallel import algorithms, primitives
 from .parallel.compiler import ProgramCache
-from .request import Request, RequestQueue
+from .request import Request, RequestQueue, requestStatus
 from .rxpool import CallQueue
 from .sendrecv import MatchingEngine, RecvPost, SendPost
 from .utils.logging import get_logger
@@ -131,6 +131,11 @@ class ACCL:
         retry queue and resets peripherals). Sequence counters reset with the
         matching state or the pair ordering would desync forever."""
         self._queue.cancel_externals()
+        # drop the retry queue BEFORE matcher state: stale parked
+        # continuations must never replay tail segments of a cancelled
+        # message with fresh seqns
+        self._sched.clear()
+        self._parked_calls.clear()
         for m in self._matchers.values():
             m.clear()
         for comm in self.comms:
@@ -428,13 +433,17 @@ class ACCL:
             return True
 
         if not run_async:
-            # all-or-nothing: never leave a half-posted message behind
-            if matcher.rx_pool.free_slots < len(segs):
+            # all-or-nothing: never leave a half-posted message behind.
+            # With a full-capacity recv already parked every segment
+            # delivers immediately and its slot turns over, so one free
+            # slot suffices; otherwise all segments park at once.
+            need = 1 if cap >= count else len(segs)
+            if matcher.rx_pool.free_slots < need:
                 raise ACCLError(
                     errorCode.NOT_READY_ERROR,
                     f"eager rx-buffer pool exhausted "
                     f"({matcher.rx_pool.free_slots} free, "
-                    f"{len(segs)} segments needed); drain pending recvs or "
+                    f"{need} needed); drain pending recvs or "
                     f"raise config.eager_rx_buffer_count")
             for i in range(len(segs)):
                 post_segment(i)
@@ -446,6 +455,8 @@ class ACCL:
         self._queue.push(req)
 
         def continue_from(step: int) -> Optional[int]:
+            if req.status in (requestStatus.COMPLETED, requestStatus.ERROR):
+                return None  # cancelled/errored: do not post tail segments
             i = step
             try:
                 while i < len(segs) and post_segment(i):

@@ -74,6 +74,25 @@ def bcast(x, root: int, axis: str = AXIS):
     return lax.psum(contrib, axis)
 
 
+def scatter(x, root: int, axis: str = AXIS):
+    """In-kernel scatter: ``root``'s last axis is chunked across ranks
+    (``ACCLCommand::scatter``). Input last dim must be world * chunk."""
+    P = lax.axis_size(axis)
+    full = bcast(x, root, axis)
+    chunks = full.reshape(full.shape[:-1] + (P, full.shape[-1] // P))
+    mine = lax.dynamic_index_in_dim(chunks, lax.axis_index(axis),
+                                    axis=x.ndim - 1, keepdims=False)
+    return mine
+
+
+def gather(x, root: int, axis: str = AXIS):
+    """In-kernel gather to ``root`` along the last axis
+    (``ACCLCommand::gather``); non-root ranks get zeros."""
+    g = lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)
+    keep = lax.axis_index(axis) == root
+    return jnp.where(keep, g, jnp.zeros_like(g))
+
+
 def all_gather(x, axis: str = AXIS, tiled: bool = True):
     """In-kernel allgather along the last axis (``ACCLCommand::all_gather``)."""
     return lax.all_gather(x, axis, axis=x.ndim - 1 if tiled else 0, tiled=tiled)

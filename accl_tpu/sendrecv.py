@@ -187,7 +187,27 @@ class MatchingEngine:
                 if s.on_matched:
                     s.on_matched()
             return rem == 0
-        consumed_any = False
+        # pre-scan: refuse upfront if an eligible segment would straddle
+        # this recv's boundary (consuming a prefix then parking forever
+        # would strand data and shift the stream for later recvs)
+        left = post.count
+        seqn = self.comm.peek_inbound_seq(post.src, post.dst)
+        advanced = True
+        while left > 0 and advanced:
+            advanced = False
+            for s in self._pending_sends:
+                if s.src == post.src and s.dst == post.dst \
+                        and self._tag_ok(post.tag, s.tag) and s.seqn == seqn:
+                    if s.count > left:
+                        raise ACCLError(
+                            errorCode.INVALID_BUFFER_SIZE,
+                            f"recv count {post.count} straddles the pending "
+                            f"send's segment geometry (segment {s.count} > "
+                            f"remaining {left})")
+                    left -= s.count
+                    seqn += 1
+                    advanced = True
+                    break
         while post.remaining > 0:
             found = None
             for i, s in enumerate(self._pending_sends):
@@ -197,14 +217,6 @@ class MatchingEngine:
             if found is None:
                 break
             i, s = found
-            if s.count > post.remaining:
-                if not consumed_any:
-                    raise ACCLError(
-                        errorCode.INVALID_BUFFER_SIZE,
-                        f"recv count {post.count} is smaller than the "
-                        f"pending send's segment count {s.count}")
-                break  # geometry straddles this recv; leave the segment
-            consumed_any = True
             self._pending_sends.pop(i)
             self.comm.next_inbound_seq(post.src, post.dst)
             post.remaining -= s.count

@@ -123,6 +123,28 @@ class Engine {
                     int64_t* remaining) {
     std::lock_guard<std::mutex> g(mu_);
     *n_matched = 0;
+    // pre-scan: walking the eligible segments in seqn order, would one
+    // straddle this recv's boundary? Refuse upfront — consuming a message
+    // prefix and parking forever would strand delivered data and shift the
+    // stream for every later recv.
+    {
+      int64_t left = count;
+      int64_t seqn = inbound_[{src, dst}];
+      bool advanced = true;
+      while (left > 0 && advanced) {
+        advanced = false;
+        for (const Post& s : pending_sends_) {
+          if (s.src == src && s.dst == dst && tag_ok(tag, s.tag) &&
+              s.seqn == seqn) {
+            if (s.count > left) return kErrCountMismatch;  // straddle
+            left -= s.count;
+            ++seqn;
+            advanced = true;
+            break;
+          }
+        }
+      }
+    }
     int64_t left = count;
     while (left > 0) {
       int64_t expected = inbound_[{src, dst}];

@@ -1,0 +1,194 @@
+"""Extended reference-parity matrix: compressed variants of EVERY
+collective, stream-operand variants, and uneven-chunk int32 configs.
+
+Mirrors the remaining reference test families (SURVEY.md §4):
+* compressed variants of every collective (test.cpp compressed tests —
+  ETH_COMPRESSED: payload cast to the wire dtype on the hop only);
+* stream-operand variants (test.cpp:813-910 stream2mem / mem2stream /
+  stream2stream — here ``from_device`` / ``to_device`` flags, since a
+  "stream" operand is a device-resident value that never bounces to host);
+* "Broadcast + Scatter + Gather, uneven chunk counts, int32"
+  (BASELINE.json config 3).
+"""
+import numpy as np
+import pytest
+
+from accl_tpu import Algorithm, dataType, reduceFunction
+
+WORLD = 8
+CDT = dataType.bfloat16  # TPU-native wire dtype (hp_compression analog)
+
+
+def _bf16(x):
+    import jax.numpy as jnp
+    return np.asarray(jnp.asarray(x).astype(jnp.bfloat16).astype(jnp.float32))
+
+
+def _fill(rng, shape):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+# ---- compressed variants of every collective ----------------------------
+
+def test_scatter_compressed(accl, rng):
+    count = 32
+    send = accl.create_buffer(count * WORLD, dataType.float32)
+    recv = accl.create_buffer(count, dataType.float32)
+    send.host[:] = _fill(rng, (WORLD, count * WORLD))
+    accl.scatter(send, recv, count, 1, compress_dtype=CDT)
+    rootdata = _bf16(send.host[1])
+    for r in range(WORLD):
+        np.testing.assert_allclose(
+            recv.host[r], rootdata[r * count:(r + 1) * count],
+            rtol=1e-2, atol=1e-2)
+
+
+def test_gather_compressed(accl, rng):
+    count = 32
+    send = accl.create_buffer(count, dataType.float32)
+    recv = accl.create_buffer(count * WORLD, dataType.float32)
+    send.host[:] = _fill(rng, (WORLD, count))
+    accl.gather(send, recv, count, 2, compress_dtype=CDT)
+    np.testing.assert_allclose(
+        recv.host[2], _bf16(send.host).reshape(-1), rtol=1e-2, atol=1e-2)
+
+
+def test_allgather_compressed(accl, rng):
+    count = 32
+    send = accl.create_buffer(count, dataType.float32)
+    recv = accl.create_buffer(count * WORLD, dataType.float32)
+    send.host[:] = _fill(rng, (WORLD, count))
+    accl.allgather(send, recv, count, compress_dtype=CDT)
+    expect = _bf16(send.host).reshape(-1)
+    for r in range(WORLD):
+        np.testing.assert_allclose(recv.host[r], expect, rtol=1e-2, atol=1e-2)
+
+
+@pytest.mark.parametrize("func", [reduceFunction.SUM, reduceFunction.MAX])
+def test_reduce_compressed(accl, rng, func):
+    count = 32
+    send = accl.create_buffer(count, dataType.float32)
+    recv = accl.create_buffer(count, dataType.float32)
+    send.host[:] = _fill(rng, (WORLD, count))
+    accl.reduce(send, recv, count, 4, func, compress_dtype=CDT)
+    wire = _bf16(send.host)
+    expect = wire[0]
+    for i in range(1, WORLD):
+        expect = (expect + wire[i] if func == reduceFunction.SUM
+                  else np.maximum(expect, wire[i]))
+    np.testing.assert_allclose(recv.host[4], expect, rtol=0.05, atol=0.5)
+
+
+def test_reduce_scatter_compressed(accl, rng):
+    count = 32
+    send = accl.create_buffer(count * WORLD, dataType.float32)
+    recv = accl.create_buffer(count, dataType.float32)
+    send.host[:] = _fill(rng, (WORLD, count * WORLD))
+    accl.reduce_scatter(send, recv, count, reduceFunction.SUM,
+                        compress_dtype=CDT)
+    full = _bf16(send.host).sum(axis=0)
+    for r in range(WORLD):
+        np.testing.assert_allclose(
+            recv.host[r], full[r * count:(r + 1) * count], rtol=0.05, atol=0.5)
+
+
+def test_alltoall_compressed(accl, rng):
+    count = 16
+    send = accl.create_buffer(count * WORLD, dataType.float32)
+    recv = accl.create_buffer(count * WORLD, dataType.float32)
+    send.host[:] = _fill(rng, (WORLD, count * WORLD))
+    accl.alltoall(send, recv, count, compress_dtype=CDT)
+    wire = _bf16(send.host)
+    for r in range(WORLD):
+        for q in range(WORLD):
+            np.testing.assert_allclose(
+                recv.host[r][q * count:(q + 1) * count],
+                wire[q][r * count:(r + 1) * count], rtol=1e-2, atol=1e-2)
+
+
+def test_allreduce_ring_compressed_per_hop(accl, rng):
+    """RING algorithm compresses per hop (the faithful ETH_COMPRESSED
+    analog) — looser tolerance than the single-shot XLA path."""
+    count = 32
+    send = accl.create_buffer(count, dataType.float32)
+    recv = accl.create_buffer(count, dataType.float32)
+    send.host[:] = _fill(rng, (WORLD, count))
+    accl.allreduce(send, recv, count, reduceFunction.SUM,
+                   compress_dtype=CDT, algorithm=Algorithm.RING)
+    expect = send.host.sum(axis=0)
+    for r in range(WORLD):
+        np.testing.assert_allclose(recv.host[r], expect, rtol=0.2, atol=1.0)
+
+
+# ---- stream-operand variants (from_device / to_device flags) ------------
+
+def test_stream2stream_allreduce(accl, rng):
+    """Device-resident operands end to end: sync_to/from_device never runs
+    (the stream2stream analog, test.cpp:813-910)."""
+    count = 64
+    send = accl.create_buffer(count, dataType.float32)
+    recv = accl.create_buffer(count, dataType.float32)
+    send.host[:] = _fill(rng, (WORLD, count))
+    send.sync_to_device()
+    host_before = recv.host.copy()
+    accl.allreduce(send, recv, count, reduceFunction.SUM,
+                   from_device=True, to_device=True)
+    # host mirror untouched (result only on device)...
+    np.testing.assert_array_equal(recv.host, host_before)
+    recv.sync_from_device()
+    expect = send.host.sum(axis=0)
+    for r in range(WORLD):
+        np.testing.assert_allclose(recv.host[r], expect, rtol=1e-4, atol=1e-4)
+
+
+def test_mem2stream_then_stream2mem_chain(accl, rng):
+    """Chained collectives with the intermediate kept on device: bcast
+    (mem2stream) feeds reduce (stream2mem) without a host bounce."""
+    count = 32
+    a = accl.create_buffer(count, dataType.float32)
+    mid = accl.create_buffer(count, dataType.float32)
+    out = accl.create_buffer(count, dataType.float32)
+    a.host[:] = _fill(rng, (WORLD, count))
+    rootdata = a.host[0].copy()
+    accl.bcast(a, count, 0, to_device=True)            # result stays on device
+    accl.copy(a, mid, count, from_device=True, to_device=True)
+    accl.reduce(mid, out, count, 3, reduceFunction.SUM, from_device=True)
+    np.testing.assert_allclose(out.host[3], rootdata * WORLD,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_stream_sendrecv(accl, rng):
+    count = 48
+    s = accl.create_buffer(count, dataType.float32)
+    r = accl.create_buffer(count, dataType.float32)
+    s.host[:] = _fill(rng, (WORLD, count))
+    s.sync_to_device()
+    accl.send(s, count, src=2, dst=6, tag=1, from_device=True)
+    accl.recv(r, count, src=2, dst=6, tag=1, to_device=True)
+    r.sync_from_device()
+    np.testing.assert_allclose(r.host[6], s.host[2])
+
+
+# ---- uneven chunks, int32 (BASELINE.json config 3) ----------------------
+
+@pytest.mark.parametrize("count", [7, 13, 129])
+def test_uneven_bcast_scatter_gather_int32(accl, rng, count):
+    dt = dataType.int32
+    b = accl.create_buffer(count, dt)
+    b.host[:] = rng.integers(-1000, 1000, (WORLD, count)).astype(np.int32)
+    rootdata = b.host[5].copy()
+    accl.bcast(b, count, 5)
+    for r in range(WORLD):
+        np.testing.assert_array_equal(b.host[r], rootdata)
+
+    send = accl.create_buffer(count * WORLD, dt)
+    recv = accl.create_buffer(count, dt)
+    send.host[:] = rng.integers(-1000, 1000, (WORLD, count * WORLD)).astype(np.int32)
+    accl.scatter(send, recv, count, 0)
+    for r in range(WORLD):
+        np.testing.assert_array_equal(
+            recv.host[r], send.host[0][r * count:(r + 1) * count])
+
+    gout = accl.create_buffer(count * WORLD, dt)
+    accl.gather(recv, gout, count, 7)
+    np.testing.assert_array_equal(gout.host[7], send.host[0])

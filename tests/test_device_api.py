@@ -142,3 +142,20 @@ def test_mlp_train_step_decreases_loss(rng):
         params, loss = step(params, x, t)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.7, losses[:3] + losses[-3:]
+
+
+def test_in_kernel_scatter_gather(accl, rng):
+    """ACCLCommand::scatter / ::gather analogs inside jitted compute."""
+    comm = accl.global_comm()
+    w = comm.world_size
+    x = rng.standard_normal((w, 4 * w)).astype(np.float32)
+
+    def kernel(v):
+        mine = dapi.scatter(v, root=2)         # (1, 4) chunk per rank
+        back = dapi.gather(mine, root=2)       # (1, 4*w) at root
+        return back
+
+    prog = _smap(comm, kernel)
+    out = np.asarray(prog(_sharded(comm, x)))
+    np.testing.assert_allclose(out[2], x[2], rtol=1e-5)
+    assert np.all(out[0] == 0)                 # non-root zeros

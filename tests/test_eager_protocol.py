@@ -284,3 +284,68 @@ def test_wait_timeout_zero_raises_immediately(small):
     with pytest.raises(ACCLTimeoutError):
         req.wait(timeout=0)
     req.cancel()
+
+
+def test_straddling_recv_rejected_upfront(small):
+    """recv(24) against a parked 16/16/8-segment message must refuse loudly
+    with nothing consumed — not strand a prefix and shift the stream."""
+    s = small.create_buffer(40, dataType.float32)
+    s.host[:] = np.arange(4 * 40, dtype=np.float32).reshape(4, 40)
+    small.send(s, 40, src=0, dst=1, tag=6)           # segments 16/16/8
+    r = small.create_buffer(40, dataType.float32)
+    with pytest.raises(ACCLError) as e:
+        small.recv(r, 24, src=0, dst=1, tag=6)
+    assert e.value.code == errorCode.INVALID_BUFFER_SIZE
+    m = small.matcher()
+    assert m.inbound_seq(0, 1) == 0                  # nothing consumed
+    # the full-size recv still works
+    small.recv(r, 40, src=0, dst=1, tag=6)
+    np.testing.assert_allclose(r.host[1], s.host[0])
+
+
+def test_sync_send_larger_than_pool_with_waiting_recv(small):
+    """recv-first ordering: a sync eager send bigger than the whole pool
+    succeeds because each segment delivers immediately (slots turn over)."""
+    s = small.create_buffer(128, dataType.float32)
+    r = small.create_buffer(128, dataType.float32)
+    s.host[:] = np.arange(4 * 128, dtype=np.float32).reshape(4, 128)
+    req = small.recv(r, 128, src=0, dst=1, compress_dtype=dataType.float16,
+                     run_async=True)
+    # 8 segments > 4 slots, but the parked recv absorbs each on post
+    small.send(s, 128, src=0, dst=1, compress_dtype=dataType.float16)
+    req.wait(timeout=10)
+    np.testing.assert_allclose(r.host[1], s.host[0], atol=0.5)
+    assert small.matcher().rx_pool.free_slots == 4
+
+
+def test_soft_reset_drops_parked_continuations(small):
+    """A cancelled/reset async send must never replay its tail segments
+    with fresh seqns after the reset."""
+    s = small.create_buffer(64, dataType.float32)
+    s.host[:] = 7.0
+    small.send(s, 64, src=0, dst=1, tag=1)                   # fills pool
+    req = small.send(s, 64, src=0, dst=1, tag=2, run_async=True)
+    assert req.current_step < 4                              # parked
+    small.soft_reset()
+    # fresh exchange on the same pair: stale tail segments must not appear
+    s2 = small.create_buffer(16, dataType.float32)
+    r2 = small.create_buffer(16, dataType.float32)
+    s2.host[:] = np.arange(4 * 16, dtype=np.float32).reshape(4, 16)
+    small.send(s2, 16, src=0, dst=1, tag=9)
+    small.recv(r2, 16, src=0, dst=1, tag=9)
+    np.testing.assert_allclose(r2.host[1], s2.host[0])
+    assert small.matcher().n_pending == (0, 0)
+
+
+def test_cancelled_async_send_stops_transmitting(small):
+    s = small.create_buffer(64, dataType.float32)
+    r = small.create_buffer(64, dataType.float32)
+    s.host[:] = 1.0
+    small.send(s, 64, src=0, dst=1, tag=1)                   # fills pool
+    req = small.send(s, 64, src=0, dst=1, tag=2, run_async=True)
+    posted_before_cancel = req.current_step
+    req.cancel()
+    small.recv(r, 64, src=0, dst=1, tag=1)                   # frees slots
+    small.barrier()                                          # pumps
+    # the cancelled send posted no further segments
+    assert req.current_step == posted_before_cancel
