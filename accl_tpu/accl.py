@@ -434,10 +434,14 @@ class ACCL:
 
         if not run_async:
             # all-or-nothing: never leave a half-posted message behind.
-            # With a full-capacity recv already parked every segment
-            # delivers immediately and its slot turns over, so one free
-            # slot suffices; otherwise all segments park at once.
-            need = 1 if cap >= count else len(segs)
+            # One free slot suffices only when every segment is GUARANTEED
+            # to deliver immediately (slot turns over per segment): a
+            # full-capacity recv is parked AND no earlier undelivered send
+            # on the pair blocks seqn eligibility; otherwise all segments
+            # may park at once.
+            drained = (matcher.outbound_seq(src, dst)
+                       == matcher.inbound_seq(src, dst))
+            need = 1 if (cap >= count and drained) else len(segs)
             if matcher.rx_pool.free_slots < need:
                 raise ACCLError(
                     errorCode.NOT_READY_ERROR,
@@ -446,7 +450,13 @@ class ACCL:
                     f"{need} needed); drain pending recvs or "
                     f"raise config.eager_rx_buffer_count")
             for i in range(len(segs)):
-                post_segment(i)
+                if not post_segment(i):
+                    # unreachable by construction of the precheck; loud
+                    # guard so a logic slip can never drop tail segments
+                    raise ACCLError(
+                        errorCode.DMA_NOT_OKAY_ERROR,
+                        f"eager send {src}->{dst}: pool slot vanished at "
+                        f"segment {i}/{len(segs)}")
             return self._finish(operation.send, None, data, True, False)
 
         # async: post what fits now, park the rest with current_step
@@ -733,7 +743,8 @@ class ACCL:
         prog = self._programs.get(
             self._key(comm, operation.allgather, count, sendbuf.dtype,
                       compress_dtype, algo),
-            lambda: algorithms.build_allgather(comm, algo, arith),
+            lambda: algorithms.build_allgather(comm, algo, arith,
+                                               sendbuf.dtype),
         )
         y = prog(x).astype(recvbuf.jnp_dtype)
         self._store(recvbuf, count * world, y)

@@ -131,7 +131,7 @@ def _cases(comm: Communicator, dt: dataType, func: reduceFunction,
             chain_adapt=lambda out: out[:, : out.shape[1] // comm.world_size]),
         "allgather": _Case(
             operation.allgather,
-            lambda: algorithms.build_allgather(comm, algo, None),
+            lambda: algorithms.build_allgather(comm, algo, None, dt),
             lambda n: (flat(n),),
             chain_adapt=lambda out: out[:, : out.shape[1] // comm.world_size]),
         "reduce": _Case(

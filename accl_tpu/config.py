@@ -40,6 +40,7 @@ class Algorithm(enum.Enum):
     TREE = "tree"          # binary tree (recursive doubling/halving)
     FLAT = "flat"          # flat tree (root-centric fan-in/out)
     HIERARCHICAL = "hier"  # 2D-mesh reduce -> bcast composition
+    PALLAS = "pallas"      # Pallas ring kernels over async remote DMA
 
 
 @dataclasses.dataclass

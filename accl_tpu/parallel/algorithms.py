@@ -21,8 +21,8 @@ from typing import Callable, Optional
 from ..arithconfig import ArithConfig
 from ..communicator import Communicator
 from ..config import ACCLConfig, Algorithm
-from ..constants import dataType, operation, reduceFunction
-from . import hierarchical, primitives, ring, tree
+from ..constants import ACCLError, dataType, errorCode, operation, reduceFunction
+from . import hierarchical, pallas_ring, primitives, ring, tree
 
 #: payload size above which AUTO prefers the explicit ring (bytes)
 RING_THRESHOLD = 4 * 1024 * 1024
@@ -33,9 +33,11 @@ _SUPPORTED = {
     operation.bcast: {Algorithm.XLA, Algorithm.FLAT, Algorithm.TREE, Algorithm.RING},
     operation.reduce: {Algorithm.XLA, Algorithm.FLAT, Algorithm.TREE, Algorithm.RING},
     operation.allreduce: {Algorithm.XLA, Algorithm.FLAT, Algorithm.TREE,
-                          Algorithm.RING, Algorithm.HIERARCHICAL},
-    operation.allgather: {Algorithm.XLA, Algorithm.RING},
-    operation.reduce_scatter: {Algorithm.XLA, Algorithm.RING},
+                          Algorithm.RING, Algorithm.HIERARCHICAL,
+                          Algorithm.PALLAS},
+    operation.allgather: {Algorithm.XLA, Algorithm.RING, Algorithm.PALLAS},
+    operation.reduce_scatter: {Algorithm.XLA, Algorithm.RING,
+                               Algorithm.PALLAS},
     operation.scatter: {Algorithm.XLA},
     operation.gather: {Algorithm.XLA},
     operation.alltoall: {Algorithm.XLA},
@@ -80,6 +82,17 @@ def select(
 # builder dispatch
 # ---------------------------------------------------------------------------
 
+def _reject_pallas_compression(arith: Optional[ArithConfig]) -> None:
+    """The Pallas ring kernels move raw VMEM tiles; wire compression is not
+    plumbed through them yet — refuse loudly rather than silently sending
+    uncompressed (use RING for per-hop ETH_COMPRESSED semantics)."""
+    if arith is not None and arith.is_compressing:
+        raise ACCLError(
+            errorCode.COMPRESSION_NOT_SUPPORTED,
+            "Algorithm.PALLAS does not support wire compression; "
+            "use Algorithm.RING")
+
+
 def build_bcast(comm, root: int, algo: Algorithm,
                 arith: Optional[ArithConfig]) -> Callable:
     if algo == Algorithm.TREE:
@@ -100,6 +113,9 @@ def build_reduce(comm, root: int, func: reduceFunction, dt: dataType,
 
 def build_allreduce(comm, func: reduceFunction, dt: dataType, algo: Algorithm,
                     arith: Optional[ArithConfig]) -> Callable:
+    if algo == Algorithm.PALLAS:
+        _reject_pallas_compression(arith)
+        return pallas_ring.build_pallas_ring_allreduce(comm, func, dt)
     if algo == Algorithm.RING:
         return ring.build_ring_allreduce(comm, func, dt, arith)
     if algo == Algorithm.TREE:
@@ -115,7 +131,11 @@ def build_allreduce(comm, func: reduceFunction, dt: dataType, algo: Algorithm,
 
 
 def build_allgather(comm, algo: Algorithm,
-                    arith: Optional[ArithConfig]) -> Callable:
+                    arith: Optional[ArithConfig],
+                    dt: dataType) -> Callable:
+    if algo == Algorithm.PALLAS:
+        _reject_pallas_compression(arith)
+        return pallas_ring.build_pallas_ring_allgather(comm, dt)
     if algo == Algorithm.RING:
         return ring.build_ring_allgather(comm, arith)
     return primitives.build_allgather(comm, arith)
@@ -124,6 +144,9 @@ def build_allgather(comm, algo: Algorithm,
 def build_reduce_scatter(comm, func: reduceFunction, dt: dataType,
                          algo: Algorithm,
                          arith: Optional[ArithConfig]) -> Callable:
+    if algo == Algorithm.PALLAS:
+        _reject_pallas_compression(arith)
+        return pallas_ring.build_pallas_ring_reduce_scatter(comm, func, dt)
     if algo == Algorithm.RING:
         return ring.build_ring_reduce_scatter(comm, func, dt, arith)
     return primitives.build_reduce_scatter(comm, func, dt, arith)

@@ -1,0 +1,294 @@
+"""Pallas ring collectives over async remote DMA — the RDMA-over-ICI path.
+
+The reference's rendezvous protocol culminates in one-sided RDMA WRITEs
+issued by the rdma_sq_handler (``kernels/cclo/hls/eth_intf/rdma_*.cpp``;
+SURVEY.md §2.3: "rendezvous → one-sided remote DMA = natural
+RDMA-over-ICI analog"). This module is that analog in earnest: ring
+collectives written as Pallas TPU kernels that move payload chunks between
+neighbor chips with ``pltpu.make_async_remote_copy`` — communication
+issued *from inside the kernel*, no XLA collective in the schedule,
+payload staged through VMEM exactly like the reference streams segments
+through its 512-bit datapath:
+
+* ``build_pallas_ring_allgather`` — each rank forwards the newest block to
+  its right neighbor, P-1 hops (fw ring allgather :1316-1403);
+* ``build_pallas_ring_reduce_scatter`` — fused recv-reduce-forward per hop
+  with double-buffered send/recv VMEM staging (fw :1782-1850);
+* ``build_pallas_ring_allreduce`` — reduce-scatter + allgather composition
+  (fw :1888-2071).
+
+The same kernels run on the CPU emulator rung under Pallas TPU interpret
+mode (``pltpu.InterpretParams``), which simulates the inter-chip DMAs and
+semaphores — and can check the kernels for data races
+(``detect_races=True``), a capability the reference lacks entirely
+(SURVEY.md §5 "race detection: none formal").
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..communicator import Communicator
+from ..constants import dataType, reduceFunction, to_jax_dtype
+from .primitives import AXIS, _smap
+
+_LANES = 128
+
+
+def _interpret_params():
+    if jax.default_backend() == "tpu":
+        return None
+    return pltpu.InterpretParams()
+
+
+def _sublane(dtype) -> int:
+    return 16 if jnp.dtype(dtype).itemsize == 2 else 8
+
+
+def _pad_rows(n_elems: int, dtype) -> int:
+    rows = -(-n_elems // _LANES)
+    mult = _sublane(dtype)
+    return -(-rows // mult) * mult
+
+
+def _combine(a, b, func: reduceFunction):
+    return a + b if func == reduceFunction.SUM else jnp.maximum(a, b)
+
+
+def _neighbors(P: int):
+    my = lax.axis_index(AXIS)
+    p32 = jnp.int32(P)
+    right = lax.rem(my + jnp.int32(1), p32)
+    left = lax.rem(my + p32 - jnp.int32(1), p32)
+    return my, left, right
+
+
+def _ring_barrier(left, right):
+    """Neighbor sync before touching remote buffers (guide local_barrier):
+    guarantees both neighbors entered the kernel, so remote writes cannot
+    land in a buffer the owner has not set up yet."""
+    sem = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(sem, inc=1, device_id=left,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_signal(sem, inc=1, device_id=right,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(sem, 2)
+
+
+# ---------------------------------------------------------------------------
+# all-gather: out[j] = rank j's block after P-1 right-forward hops
+# ---------------------------------------------------------------------------
+
+def _ag_kernel(x_ref, o_ref, send_sem, recv_sem, copy_sem, *, P: int):
+    my, left, right = _neighbors(P)
+    _ring_barrier(left, right)
+    # place the local block in my output slot
+    local = pltpu.make_async_copy(x_ref, o_ref.at[my], copy_sem)
+    local.start()
+    local.wait()
+
+    def hop(s, _):
+        # forward the newest block (received at hop s-1) to the right
+        src_idx = lax.rem(my - s + jnp.int32(P), jnp.int32(P))
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=o_ref.at[src_idx],
+            dst_ref=o_ref.at[src_idx],
+            send_sem=send_sem.at[s],
+            recv_sem=recv_sem.at[s],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        return 0
+
+    lax.fori_loop(0, P - 1, hop, 0)
+
+
+
+def _rs_call(chunks, *, P: int, func: reduceFunction, rows: int, dtype):
+    """The reduce-scatter pallas_call (single definition — also used by the
+    allreduce composition)."""
+    return pl.pallas_call(
+        functools.partial(_rs_kernel, P=P, func=func),
+        out_shape=jax.ShapeDtypeStruct((rows, _LANES), dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, rows, _LANES), dtype),
+            pltpu.VMEM((2, rows, _LANES), dtype),
+            pltpu.SemaphoreType.DMA((max(P - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(P - 1, 1),)),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.REGULAR,
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=1),
+        interpret=_interpret_params(),
+    )(chunks)
+
+
+def _ag_call(block, *, P: int, rows: int, dtype):
+    """The all-gather pallas_call (single definition — also used by the
+    allreduce composition)."""
+    return pl.pallas_call(
+        functools.partial(_ag_kernel, P=P),
+        out_shape=jax.ShapeDtypeStruct((P, rows, _LANES), dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((max(P - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(P - 1, 1),)),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=0),
+        interpret=_interpret_params(),
+    )(block)
+
+
+def build_pallas_ring_allgather(comm: Communicator,
+                                dt: dataType) -> Callable:
+    """(world, n) sharded in -> (world, world*n) sharded out."""
+    P = comm.world_size
+    dtype = to_jax_dtype(dt)
+
+    def body(x):
+        n = x.shape[-1]
+        rows = _pad_rows(n, dtype)
+        xt = jnp.zeros((rows, _LANES), dtype).reshape(-1)
+        xt = lax.dynamic_update_slice(xt, x[0], (0,)).reshape(rows, _LANES)
+        out = _ag_call(xt, P=P, rows=rows, dtype=dtype)
+        return out.reshape(P, rows * _LANES)[:, :n].reshape(1, P * n)
+
+    return _smap(comm, body, 1)
+
+
+# ---------------------------------------------------------------------------
+# reduce-scatter: fused recv-reduce-forward, double-buffered staging
+# ---------------------------------------------------------------------------
+
+def _rs_kernel(x_ref, o_ref, send_buf, recv_buf, send_sem, recv_sem,
+               copy_sem, cap_sem, *, P: int, func: reduceFunction):
+    my, left, right = _neighbors(P)
+    _ring_barrier(left, right)
+    # seed the pipeline: my own chunk `my` is the first partial to forward
+    seed = pltpu.make_async_copy(x_ref.at[my], send_buf.at[0], copy_sem)
+    seed.start()
+    seed.wait()
+
+    def hop(s, _):
+        slot = lax.rem(s, 2)
+        nxt = lax.rem(s + 1, 2)
+
+        # flow control: recv_buf is only 2 deep, so writing the right
+        # neighbor's slot s%2 at hop s>=2 needs the neighbor to have
+        # consumed it at hop s-2 — a capacity credit, the VMEM analog of
+        # the eager rx-buffer pool's backpressure
+        @pl.when(s >= 2)
+        def _credit():
+            pltpu.semaphore_wait(cap_sem, 1)
+
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=send_buf.at[slot],
+            dst_ref=recv_buf.at[slot],
+            send_sem=send_sem.at[s],
+            recv_sem=recv_sem.at[s],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        # fold the received partial with the local contribution for that
+        # chunk (fused_recv_reduce, fw :718-751) and stage for the next hop
+        idx = lax.rem(my - s - jnp.int32(1) + jnp.int32(P), jnp.int32(P))
+        folded = _combine(recv_buf[slot], x_ref[idx], func)
+
+        # recv_buf[slot] is consumed: grant the left neighbor a credit for
+        # its hop s+2 (only if that hop exists)
+        @pl.when(s + 2 <= P - 2)
+        def _free():
+            pltpu.semaphore_signal(
+                cap_sem, inc=1, device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+        @pl.when(s < P - 2)
+        def _stage():
+            send_buf[nxt] = folded
+
+        @pl.when(s == P - 2)
+        def _finish():
+            o_ref[...] = folded
+
+        return 0
+
+    lax.fori_loop(0, P - 1, hop, 0, unroll=False)
+
+    @pl.when(P == 1)
+    def _trivial():
+        o_ref[...] = x_ref[0]
+
+
+def build_pallas_ring_reduce_scatter(comm: Communicator,
+                                     func: reduceFunction,
+                                     dt: dataType) -> Callable:
+    """(world, world*n) sharded in -> (world, n) sharded out; rank r ends
+    owning chunk (r+1) mod P (ring schedule); the wrapper rolls chunks so
+    rank r returns chunk r, matching the host-level API contract."""
+    P = comm.world_size
+    dtype = to_jax_dtype(dt)
+
+    def body(x):
+        total = x.shape[-1]
+        n = total // P
+        rows = _pad_rows(n, dtype)
+        chunks = jnp.zeros((P, rows * _LANES), dtype)
+        chunks = lax.dynamic_update_slice(
+            chunks, x.reshape(P, n).astype(dtype), (0, 0))
+        chunks = chunks.reshape(P, rows, _LANES)
+        out = _rs_call(chunks, P=P, func=func, rows=rows, dtype=dtype)
+        mine = out.reshape(-1)[:n]
+        # kernel leaves chunk (my+1)%P here; shift it back to chunk my
+        shifted = lax.ppermute(
+            mine, AXIS, [(i, (i + 1) % P) for i in range(P)])
+        return shifted.reshape(1, n)
+
+    return _smap(comm, body, 1)
+
+
+# ---------------------------------------------------------------------------
+# allreduce = ring reduce-scatter + ring allgather
+# ---------------------------------------------------------------------------
+
+def build_pallas_ring_allreduce(comm: Communicator, func: reduceFunction,
+                                dt: dataType) -> Callable:
+    P = comm.world_size
+    dtype = to_jax_dtype(dt)
+
+    def body(x):
+        n = x.shape[-1]
+        chunk = -(-n // P)
+        padded = jnp.zeros((P * chunk,), dtype)
+        padded = lax.dynamic_update_slice(
+            padded, x[0].astype(dtype), (0,))
+        rows = _pad_rows(chunk, dtype)
+        chunks = jnp.zeros((P, rows * _LANES), dtype)
+        chunks = lax.dynamic_update_slice(
+            chunks, padded.reshape(P, chunk), (0, 0))
+        chunks = chunks.reshape(P, rows, _LANES)
+
+        partial = _rs_call(chunks, P=P, func=func, rows=rows, dtype=dtype)
+        gathered = _ag_call(partial, P=P, rows=rows, dtype=dtype)
+        # slot j holds the partial produced at rank j = full chunk (j+1)%P;
+        # roll so slot c holds chunk c, then flatten and trim the padding
+        blocks = gathered.reshape(P, rows * _LANES)[:, :chunk]
+        ordered = jnp.roll(blocks, shift=1, axis=0)
+        return ordered.reshape(-1)[:n].astype(x.dtype).reshape(1, n)
+
+    return _smap(comm, body, 1)
