@@ -742,9 +742,10 @@ class ACCL:
         x = self._input(sendbuf, count, from_device)
         prog = self._programs.get(
             self._key(comm, operation.allgather, count, sendbuf.dtype,
-                      compress_dtype, algo),
+                      compress_dtype, algo, self.config.segment_size),
             lambda: algorithms.build_allgather(comm, algo, arith,
-                                               sendbuf.dtype),
+                                               sendbuf.dtype,
+                                               self.config.segment_size),
         )
         y = prog(x).astype(recvbuf.jnp_dtype)
         self._store(recvbuf, count * world, y)
@@ -812,9 +813,10 @@ class ACCL:
         x = self._input(sendbuf, count, from_device)
         prog = self._programs.get(
             self._key(comm, operation.allreduce, count, sendbuf.dtype, function,
-                      compress_dtype, algo),
+                      compress_dtype, algo, self.config.segment_size),
             lambda: algorithms.build_allreduce(
-                comm, function, sendbuf.dtype, algo, arith),
+                comm, function, sendbuf.dtype, algo, arith,
+                self.config.segment_size),
         )
         y = prog(x).astype(recvbuf.jnp_dtype)
         self._store(recvbuf, count, y)
@@ -847,9 +849,10 @@ class ACCL:
         x = self._input(sendbuf, count * world, from_device)
         prog = self._programs.get(
             self._key(comm, operation.reduce_scatter, count, sendbuf.dtype, function,
-                      compress_dtype, algo),
+                      compress_dtype, algo, self.config.segment_size),
             lambda: algorithms.build_reduce_scatter(
-                comm, function, sendbuf.dtype, algo, arith),
+                comm, function, sendbuf.dtype, algo, arith,
+                self.config.segment_size),
         )
         y = prog(x).astype(recvbuf.jnp_dtype)
         self._store(recvbuf, count, y)

@@ -112,10 +112,12 @@ def build_reduce(comm, root: int, func: reduceFunction, dt: dataType,
 
 
 def build_allreduce(comm, func: reduceFunction, dt: dataType, algo: Algorithm,
-                    arith: Optional[ArithConfig]) -> Callable:
+                    arith: Optional[ArithConfig],
+                    segment_bytes: Optional[int] = None) -> Callable:
     if algo == Algorithm.PALLAS:
         _reject_pallas_compression(arith)
-        return pallas_ring.build_pallas_ring_allreduce(comm, func, dt)
+        return pallas_ring.build_pallas_ring_allreduce(
+            comm, func, dt, segment_bytes)
     if algo == Algorithm.RING:
         return ring.build_ring_allreduce(comm, func, dt, arith)
     if algo == Algorithm.TREE:
@@ -132,10 +134,11 @@ def build_allreduce(comm, func: reduceFunction, dt: dataType, algo: Algorithm,
 
 def build_allgather(comm, algo: Algorithm,
                     arith: Optional[ArithConfig],
-                    dt: dataType) -> Callable:
+                    dt: dataType,
+                    segment_bytes: Optional[int] = None) -> Callable:
     if algo == Algorithm.PALLAS:
         _reject_pallas_compression(arith)
-        return pallas_ring.build_pallas_ring_allgather(comm, dt)
+        return pallas_ring.build_pallas_ring_allgather(comm, dt, segment_bytes)
     if algo == Algorithm.RING:
         return ring.build_ring_allgather(comm, arith)
     return primitives.build_allgather(comm, arith)
@@ -143,10 +146,12 @@ def build_allgather(comm, algo: Algorithm,
 
 def build_reduce_scatter(comm, func: reduceFunction, dt: dataType,
                          algo: Algorithm,
-                         arith: Optional[ArithConfig]) -> Callable:
+                         arith: Optional[ArithConfig],
+                         segment_bytes: Optional[int] = None) -> Callable:
     if algo == Algorithm.PALLAS:
         _reject_pallas_compression(arith)
-        return pallas_ring.build_pallas_ring_reduce_scatter(comm, func, dt)
+        return pallas_ring.build_pallas_ring_reduce_scatter(
+            comm, func, dt, segment_bytes)
     if algo == Algorithm.RING:
         return ring.build_ring_reduce_scatter(comm, func, dt, arith)
     return primitives.build_reduce_scatter(comm, func, dt, arith)

@@ -1,0 +1,465 @@
+"""HBM-scale segmented Pallas ring collectives (the pipelined RDMA path).
+
+The sibling :mod:`pallas_ring` kernels stage the whole payload in VMEM —
+correct for latency-sized messages but structurally unable to run the
+BASELINE.md sweep endpoint (1 GiB).  These kernels are the segmented
+analog of the reference's streaming design: the firmware never holds a
+message, it cuts it into rx-buffer-sized segments and keeps a bounded
+number of moves in flight (send loop ``ccl_offload_control.c:628-649``,
+segmented allreduce outer loop ``:1906-2071``).  Here:
+
+* payload stays in HBM (``pl.ANY`` refs); only two segments per channel
+  are resident in VMEM at any time;
+* two independent *channels* (even/odd segments) run their rings
+  concurrently — channel B's remote DMA is in flight while channel A
+  folds, the ≤3-moves-in-flight analog;
+* ``wait_send``/``wait_recv`` are split so the next transfer is issued
+  before the previous hop's data has been consumed;
+* a credit semaphore gates reuse of the two receive slots — the VMEM
+  analog of the eager rx-buffer pool's backpressure, actually enforced
+  (a writer blocks until the consumer has folded the slot's previous
+  content), not a decorative start/wait pair.
+
+Hazard accounting (validated by the interpret-mode race detector,
+``InterpretParams(detect_races=True)``):
+
+* recv slots alternate on the *global* step counter ``t = group*(P-1)+s``
+  so the credit chain spans segment-group boundaries;
+* a slot's credit is granted only after the local fold consumed it
+  (reduce-scatter) or after it was both forwarded (``wait_send``) and
+  flushed to HBM (all-gather);
+* HBM stores are asynchronous; their semaphores are consumed exactly once
+  (by the next step's slot reuse, the next group's seed, or the epilogue).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..communicator import Communicator
+from ..constants import dataType, reduceFunction, to_jax_dtype
+from .primitives import AXIS, _smap
+from . import pallas_ring as _pr
+from .pallas_ring import (_LANES, _combine, _neighbors, _pad_rows,
+                          _ring_barrier, _sublane)
+
+
+def _interpret_params():
+    # late-bound so tests patching pallas_ring._interpret_params (e.g. to
+    # enable the race detector) cover these kernels too
+    return _pr._interpret_params()
+
+#: per-segment VMEM cap — the chunked kernels keep ~10 segments resident
+#: (2 channels x {acc, 2 recv slots, local, staging}), so 1 MiB segments
+#: bound VMEM use to ~10 MiB of the ~16 MiB budget.
+VMEM_SEGMENT_CAP = 1 << 20
+
+
+def _seg_rows(segment_bytes: int, dtype) -> int:
+    """Rows (of 128 lanes) per segment, honoring sublane tiling."""
+    elems = max(segment_bytes // jnp.dtype(dtype).itemsize, _LANES)
+    rows = max(elems // _LANES, 1)
+    mult = _sublane(dtype)
+    return max(-(-rows // mult) * mult, mult)
+
+
+# ---------------------------------------------------------------------------
+# segmented ring reduce-scatter
+# ---------------------------------------------------------------------------
+
+def _chunked_rs_kernel(x_ref, o_ref, acc_buf, recv_buf, local_buf,
+                       send_sem, recv_sem, seed_sem, local_sem, store_sem,
+                       cap_sem, *, P: int, C: int, func: reduceFunction):
+    """x_ref: (P, C, Sr, 128) in HBM; o_ref: (C, Sr, 128) in HBM.
+
+    Rank ``my`` ends owning folded chunk ``(my+1) % P`` (ring schedule);
+    the wrapper rolls it back.  Two channels process segments 2g / 2g+1.
+    """
+    my, left, right = _neighbors(P)
+    _ring_barrier(left, right)
+    hops = P - 1
+    G = -(-C // 2)           # groups of two segments
+    T = [G * hops, (C // 2) * hops]   # per-channel global step counts
+
+    def seg_of(chan, g):
+        return g * 2 + chan
+
+    def wait_store(chan):
+        """Consume a store completion (descriptor recreated for its size —
+        the DMA-semaphore wait decrements by the copy's byte count)."""
+        pltpu.make_async_copy(
+            acc_buf.at[chan], o_ref.at[0], store_sem.at[chan]).wait()
+
+    def chan_step(chan, g, s, t):
+        """One hop for one channel; every async op's semaphore is consumed
+        exactly once (hazard accounting in the module docstring)."""
+        c = seg_of(chan, g)
+        slot = lax.rem(t, 2)
+        idx = lax.rem(my - s - jnp.int32(1) + jnp.int32(P), jnp.int32(P))
+
+        # credit gate: writing right's recv slot t%2 needs right to have
+        # folded the slot's step t-2 content (rx-pool backpressure analog)
+        @pl.when(t >= 2)
+        def _gate():
+            pltpu.semaphore_wait(cap_sem.at[chan], 1)
+
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=acc_buf.at[chan],
+            dst_ref=recv_buf.at[chan, slot],
+            send_sem=send_sem.at[chan],
+            recv_sem=recv_sem.at[chan, slot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+
+        # overlap the RDMA with the HBM fetch of the local fold operand
+        local = pltpu.make_async_copy(
+            x_ref.at[idx, c], local_buf.at[chan], local_sem.at[chan])
+        local.start()
+        return rdma, local
+
+    def chan_fold(chan, g, s, t, rdma, local):
+        c = seg_of(chan, g)
+        slot = lax.rem(t, 2)
+        rdma.wait_recv()
+        local.wait()
+        folded = _combine(recv_buf[chan, slot], local_buf[chan], func)
+
+        # recv slot consumed -> grant left a credit for its step t+2
+        @pl.when(t + 2 <= T[chan] - 1)
+        def _free():
+            pltpu.semaphore_signal(
+                cap_sem.at[chan], inc=1, device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+        rdma.wait_send()          # acc_buf drained -> safe to overwrite
+        acc_buf[chan] = folded    # next hop's payload (or store staging)
+
+        @pl.when(s == P - 2)
+        def _flush():
+            st = pltpu.make_async_copy(
+                acc_buf.at[chan], o_ref.at[c], store_sem.at[chan])
+            st.start()
+
+    def group(g, _):
+        def seed(chan):
+            c = seg_of(chan, g)
+            # previous group's final store still reads acc_buf[chan]
+            @pl.when(g > 0)
+            def _drain():
+                wait_store(chan)
+            ld = pltpu.make_async_copy(
+                x_ref.at[my, c], acc_buf.at[chan], seed_sem.at[chan])
+            ld.start()
+            ld.wait()
+
+        chan1 = 2 * g + 1 < C
+        seed(0)
+
+        @pl.when(chan1)
+        def _seed1():
+            seed(1)
+
+        def hop(s, _):
+            t = g * hops + s
+            r0, l0 = chan_step(0, g, s, t)
+
+            # channel 1's transfer is in flight while channel 0 folds
+            def step1():
+                return chan_step(1, g, s, t)
+
+            @pl.when(chan1)
+            def _go1():
+                r1, l1 = step1()
+                chan_fold(0, g, s, t, r0, l0)
+                chan_fold(1, g, s, t, r1, l1)
+
+            @pl.when(jnp.logical_not(chan1))
+            def _solo():
+                chan_fold(0, g, s, t, r0, l0)
+
+            return 0
+
+        lax.fori_loop(0, hops, hop, 0)
+        return 0
+
+    lax.fori_loop(0, G, group, 0)
+    # epilogue: drain the final group's stores
+    wait_store(0)
+    if C > 1:
+        wait_store(1)
+
+
+def _chunked_rs_call(x, *, P: int, C: int, sr: int, func, dtype):
+    return pl.pallas_call(
+        functools.partial(_chunked_rs_kernel, P=P, C=C, func=func),
+        out_shape=jax.ShapeDtypeStruct((C, sr, _LANES), dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((2, sr, _LANES), dtype),      # acc_buf
+            pltpu.VMEM((2, 2, sr, _LANES), dtype),   # recv_buf
+            pltpu.VMEM((2, sr, _LANES), dtype),      # local_buf
+            pltpu.SemaphoreType.DMA((2,)),           # send_sem
+            pltpu.SemaphoreType.DMA((2, 2)),         # recv_sem
+            pltpu.SemaphoreType.DMA((2,)),           # seed_sem
+            pltpu.SemaphoreType.DMA((2,)),           # local_sem
+            pltpu.SemaphoreType.DMA((2,)),           # store_sem
+            pltpu.SemaphoreType.REGULAR((2,)),       # cap_sem (per chan)
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=2),
+        interpret=_interpret_params(),
+    )(x)
+
+
+# ---------------------------------------------------------------------------
+# segmented ring all-gather
+# ---------------------------------------------------------------------------
+
+def _chunked_ag_kernel(x_ref, o_ref, buf, send_sem, recv_sem, seed_sem,
+                       store_sem, cap_sem, *, P: int, C: int):
+    """x_ref: (C, Sr, 128) own block in HBM; o_ref: (P, C, Sr, 128) HBM.
+
+    Step t: send ``buf[chan, t%2]`` right, receive block ``(my-s-1)%P``
+    into ``buf[chan, (t+1)%2]``, flush it to HBM, forward it at t+1.
+    """
+    my, left, right = _neighbors(P)
+    _ring_barrier(left, right)
+    hops = P - 1
+    G = -(-C // 2)
+    T = [G * hops, (C // 2) * hops]
+
+    def seg_of(chan, g):
+        return g * 2 + chan
+
+    def wait_store(chan, slot):
+        """Consume a store completion on the given slot (descriptor
+        recreated for its size — the wait decrements by byte count)."""
+        pltpu.make_async_copy(
+            buf.at[chan, slot], o_ref.at[0, 0],
+            store_sem.at[chan, slot]).wait()
+
+    def seed(chan, g):
+        c = seg_of(chan, g)
+        t0 = g * hops
+        slot = lax.rem(t0, 2)
+        # slot t0%2 last flushed by store(t0-1); consume that signal
+        @pl.when(g > 0)
+        def _drain():
+            wait_store(chan, slot)
+        ld = pltpu.make_async_copy(
+            x_ref.at[c], buf.at[chan, slot], seed_sem.at[chan])
+        ld.start()
+        ld.wait()
+        st = pltpu.make_async_copy(
+            buf.at[chan, slot], o_ref.at[my, c], store_sem.at[chan, slot])
+        st.start()
+
+    def chan_send(chan, g, s, t):
+        slot = lax.rem(t, 2)
+        nslot = lax.rem(t + 1, 2)
+
+        # credit: right's send(t-1) + store(t-2) must have released nslot
+        @pl.when(t >= 1)
+        def _gate():
+            pltpu.semaphore_wait(cap_sem.at[chan], 1)
+
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=buf.at[chan, slot],
+            dst_ref=buf.at[chan, nslot],
+            send_sem=send_sem.at[chan],
+            recv_sem=recv_sem.at[chan, nslot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        return rdma
+
+    def chan_finish(chan, g, s, t, rdma):
+        c = seg_of(chan, g)
+        slot = lax.rem(t, 2)
+        nslot = lax.rem(t + 1, 2)
+        src_idx = lax.rem(my - s - jnp.int32(1) + jnp.int32(P), jnp.int32(P))
+
+        rdma.wait_recv()
+        st = pltpu.make_async_copy(
+            buf.at[chan, nslot], o_ref.at[src_idx, c],
+            store_sem.at[chan, nslot])
+        st.start()
+
+        rdma.wait_send()
+        # the slot just sent was flushed by store(t-1) (or the seed store);
+        # consume that signal, then release the slot to the left writer
+        wait_store(chan, slot)
+
+        @pl.when(t <= T[chan] - 2)
+        def _release():
+            pltpu.semaphore_signal(
+                cap_sem.at[chan], inc=1, device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    def group(g, _):
+        chan1 = 2 * g + 1 < C
+        seed(0, g)
+
+        @pl.when(chan1)
+        def _seed1():
+            seed(1, g)
+
+        def hop(s, _):
+            t = g * hops + s
+            r0 = chan_send(0, g, s, t)
+
+            @pl.when(chan1)
+            def _go1():
+                r1 = chan_send(1, g, s, t)
+                chan_finish(0, g, s, t, r0)
+                chan_finish(1, g, s, t, r1)
+
+            @pl.when(jnp.logical_not(chan1))
+            def _solo():
+                chan_finish(0, g, s, t, r0)
+
+            return 0
+
+        lax.fori_loop(0, hops, hop, 0)
+        return 0
+
+    lax.fori_loop(0, G, group, 0)
+    # epilogue: final stores (slot (T)%2 per channel) are still in flight
+    wait_store(0, T[0] % 2)
+    if C > 1:
+        wait_store(1, T[1] % 2)
+
+
+def _chunked_ag_call(x, *, P: int, C: int, sr: int, dtype):
+    return pl.pallas_call(
+        functools.partial(_chunked_ag_kernel, P=P, C=C),
+        out_shape=jax.ShapeDtypeStruct((P, C, sr, _LANES), dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((2, 2, sr, _LANES), dtype),   # buf
+            pltpu.SemaphoreType.DMA((2,)),           # send_sem
+            pltpu.SemaphoreType.DMA((2, 2)),         # recv_sem
+            pltpu.SemaphoreType.DMA((2,)),           # seed_sem
+            pltpu.SemaphoreType.DMA((2, 2)),         # store_sem
+            pltpu.SemaphoreType.REGULAR((2,)),       # cap_sem
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=3),
+        interpret=_interpret_params(),
+    )(x)
+
+
+# ---------------------------------------------------------------------------
+# geometry + builders
+# ---------------------------------------------------------------------------
+
+def _geometry(chunk_elems: int, dtype, segment_bytes: int):
+    """Segments per chunk and rows per segment for a given payload."""
+    sr = _seg_rows(min(segment_bytes, VMEM_SEGMENT_CAP), dtype)
+    seg_elems = sr * _LANES
+    C = max(-(-chunk_elems // seg_elems), 1)
+    return C, sr, seg_elems
+
+
+def chunked_rs_body(x, *, P: int, func: reduceFunction, dtype,
+                    segment_bytes: int):
+    """Per-rank shard_map body: (1, world*n) -> (1, n) (HBM-scale)."""
+    total = x.shape[-1]
+    n = total // P
+    if P == 1:
+        # the kernel's hop loop is empty at world=1 and its epilogue would
+        # wait on a store that is never issued — short-circuit
+        return x[:, :n].astype(dtype).astype(x.dtype)
+    C, sr, seg_elems = _geometry(n, dtype, segment_bytes)
+    padded = jnp.zeros((P, C * seg_elems), dtype)
+    padded = lax.dynamic_update_slice(
+        padded, x.reshape(P, n).astype(dtype), (0, 0))
+    chunks = padded.reshape(P, C, sr, _LANES)
+    out = _chunked_rs_call(chunks, P=P, C=C, sr=sr, func=func, dtype=dtype)
+    mine = out.reshape(-1)[:n]
+    shifted = lax.ppermute(
+        mine, AXIS, [(i, (i + 1) % P) for i in range(P)])
+    return shifted.reshape(1, n).astype(x.dtype)
+
+
+def chunked_ag_body(x, *, P: int, dtype, segment_bytes: int):
+    """Per-rank shard_map body: (1, n) -> (1, world*n) (HBM-scale)."""
+    n = x.shape[-1]
+    if P == 1:
+        return x
+    C, sr, seg_elems = _geometry(n, dtype, segment_bytes)
+    padded = jnp.zeros((C * seg_elems,), dtype)
+    padded = lax.dynamic_update_slice(padded, x[0].astype(dtype), (0,))
+    out = _chunked_ag_call(
+        padded.reshape(C, sr, _LANES), P=P, C=C, sr=sr, dtype=dtype)
+    return (out.reshape(P, C * seg_elems)[:, :n]
+            .reshape(1, P * n).astype(x.dtype))
+
+
+def chunked_ar_body(x, *, P: int, func: reduceFunction, dtype,
+                    segment_bytes: int):
+    """Per-rank shard_map body: (1, n) -> (1, n); segmented ring RS + ring
+    AG composition (fw ``:1888-2071`` analog)."""
+    n = x.shape[-1]
+    if P == 1:
+        return x
+    chunk = -(-n // P)
+    C, sr, seg_elems = _geometry(chunk, dtype, segment_bytes)
+    # place each rank's chunk at stride C*seg_elems so the segment
+    # geometry is uniform across chunks
+    per = C * seg_elems
+    grid = jnp.zeros((P, per), dtype)
+    src = jnp.zeros((P * chunk,), dtype)
+    src = lax.dynamic_update_slice(src, x[0].astype(dtype), (0,))
+    grid = lax.dynamic_update_slice(grid, src.reshape(P, chunk), (0, 0))
+    chunks = grid.reshape(P, C, sr, _LANES)
+
+    partial = _chunked_rs_call(chunks, P=P, C=C, sr=sr, func=func,
+                               dtype=dtype)
+    gathered = _chunked_ag_call(partial, P=P, C=C, sr=sr, dtype=dtype)
+    # slot j holds folded chunk (j+1)%P; roll so slot c holds chunk c
+    blocks = gathered.reshape(P, per)[:, :chunk]
+    ordered = jnp.roll(blocks, shift=1, axis=0)
+    return ordered.reshape(-1)[:n].astype(x.dtype).reshape(1, n)
+
+
+def build_chunked_ring_reduce_scatter(comm: Communicator,
+                                      func: reduceFunction, dt: dataType,
+                                      segment_bytes: int) -> Callable:
+    """(world, world*n) sharded in -> (world, n) sharded out (HBM-scale)."""
+    P = comm.world_size
+    dtype = to_jax_dtype(dt)
+    return _smap(comm, functools.partial(
+        chunked_rs_body, P=P, func=func, dtype=dtype,
+        segment_bytes=segment_bytes), 1)
+
+
+def build_chunked_ring_allgather(comm: Communicator, dt: dataType,
+                                 segment_bytes: int) -> Callable:
+    """(world, n) sharded in -> (world, world*n) sharded out (HBM-scale)."""
+    P = comm.world_size
+    dtype = to_jax_dtype(dt)
+    return _smap(comm, functools.partial(
+        chunked_ag_body, P=P, dtype=dtype, segment_bytes=segment_bytes), 1)
+
+
+def build_chunked_ring_allreduce(comm: Communicator, func: reduceFunction,
+                                 dt: dataType,
+                                 segment_bytes: int) -> Callable:
+    """Segmented ring RS + ring AG composition (fw ``:1888-2071`` analog)."""
+    P = comm.world_size
+    dtype = to_jax_dtype(dt)
+    return _smap(comm, functools.partial(
+        chunked_ar_body, P=P, func=func, dtype=dtype,
+        segment_bytes=segment_bytes), 1)

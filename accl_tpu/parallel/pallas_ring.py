@@ -34,6 +34,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .. import constants
 from ..communicator import Communicator
 from ..constants import dataType, reduceFunction, to_jax_dtype
 from .primitives import AXIS, _smap
@@ -153,14 +154,34 @@ def _ag_call(block, *, P: int, rows: int, dtype):
     )(block)
 
 
-def build_pallas_ring_allgather(comm: Communicator,
-                                dt: dataType) -> Callable:
-    """(world, n) sharded in -> (world, world*n) sharded out."""
+#: staged bytes (world x padded block) above which the builders switch from
+#: the whole-payload VMEM kernels to the segmented HBM kernels in
+#: :mod:`pallas_chunked` — the eager/rendezvous-style size split applied to
+#: the kernel family itself
+VMEM_PAYLOAD_THRESHOLD = 4 * 1024 * 1024
+
+
+def _staged_bytes(P: int, block_elems: int, dtype) -> int:
+    rows = _pad_rows(block_elems, dtype)
+    return P * rows * _LANES * jnp.dtype(dtype).itemsize
+
+
+def build_pallas_ring_allgather(comm: Communicator, dt: dataType,
+                                segment_bytes: Optional[int] = None) -> Callable:
+    """(world, n) sharded in -> (world, world*n) sharded out.
+
+    Payloads whose staged footprint exceeds ``VMEM_PAYLOAD_THRESHOLD``
+    route to the segmented HBM kernel (``segment_bytes`` chunks)."""
     P = comm.world_size
     dtype = to_jax_dtype(dt)
+    seg = segment_bytes or constants.DEFAULT_SEGMENT_SIZE
 
     def body(x):
         n = x.shape[-1]
+        if _staged_bytes(P, n, dtype) > VMEM_PAYLOAD_THRESHOLD:
+            from . import pallas_chunked
+            return pallas_chunked.chunked_ag_body(
+                x, P=P, dtype=dtype, segment_bytes=seg)
         rows = _pad_rows(n, dtype)
         xt = jnp.zeros((rows, _LANES), dtype).reshape(-1)
         xt = lax.dynamic_update_slice(xt, x[0], (0,)).reshape(rows, _LANES)
@@ -236,17 +257,24 @@ def _rs_kernel(x_ref, o_ref, send_buf, recv_buf, send_sem, recv_sem,
 
 
 def build_pallas_ring_reduce_scatter(comm: Communicator,
-                                     func: reduceFunction,
-                                     dt: dataType) -> Callable:
+                                     func: reduceFunction, dt: dataType,
+                                     segment_bytes: Optional[int] = None) -> Callable:
     """(world, world*n) sharded in -> (world, n) sharded out; rank r ends
     owning chunk (r+1) mod P (ring schedule); the wrapper rolls chunks so
-    rank r returns chunk r, matching the host-level API contract."""
+    rank r returns chunk r, matching the host-level API contract.
+
+    HBM-scale payloads route to the segmented kernel (see allgather)."""
     P = comm.world_size
     dtype = to_jax_dtype(dt)
+    seg = segment_bytes or constants.DEFAULT_SEGMENT_SIZE
 
     def body(x):
         total = x.shape[-1]
         n = total // P
+        if _staged_bytes(P, n, dtype) > VMEM_PAYLOAD_THRESHOLD:
+            from . import pallas_chunked
+            return pallas_chunked.chunked_rs_body(
+                x, P=P, func=func, dtype=dtype, segment_bytes=seg)
         rows = _pad_rows(n, dtype)
         chunks = jnp.zeros((P, rows * _LANES), dtype)
         chunks = lax.dynamic_update_slice(
@@ -267,13 +295,19 @@ def build_pallas_ring_reduce_scatter(comm: Communicator,
 # ---------------------------------------------------------------------------
 
 def build_pallas_ring_allreduce(comm: Communicator, func: reduceFunction,
-                                dt: dataType) -> Callable:
+                                dt: dataType,
+                                segment_bytes: Optional[int] = None) -> Callable:
     P = comm.world_size
     dtype = to_jax_dtype(dt)
+    seg = segment_bytes or constants.DEFAULT_SEGMENT_SIZE
 
     def body(x):
         n = x.shape[-1]
         chunk = -(-n // P)
+        if _staged_bytes(P, chunk, dtype) > VMEM_PAYLOAD_THRESHOLD:
+            from . import pallas_chunked
+            return pallas_chunked.chunked_ar_body(
+                x, P=P, func=func, dtype=dtype, segment_bytes=seg)
         padded = jnp.zeros((P * chunk,), dtype)
         padded = lax.dynamic_update_slice(
             padded, x[0].astype(dtype), (0,))

@@ -2,17 +2,23 @@
 
 This is the "emulator" rung of the reference's test ladder (SURVEY.md §4):
 ACCL runs its real firmware natively against a ZMQ fabric; we run the real
-framework against XLA's CPU backend with 8 virtual devices
-(``--xla_force_host_platform_device_count=8``). The same suite runs unchanged
-on real TPU meshes.
+framework against XLA's CPU backend with 9 virtual devices (an 8-rank mesh
+plus one spare — see the comment below). The same suite runs unchanged on
+real TPU meshes.
 """
 import os
 
 # Must be set before the first JAX backend initialization.
+#
+# 9 devices, not 8: the suite runs 8-rank meshes, and the Pallas TPU
+# interpreter can wedge when a kernel with cross-device semaphore waits
+# occupies EVERY host device (observed with the segmented ring kernels at
+# world=8 on an 8-device host; the same kernels complete on any larger
+# host). One spare device sidesteps the interpreter scheduling artifact.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
+        _flags + " --xla_force_host_platform_device_count=9"
     ).strip()
 
 import jax  # noqa: E402

@@ -1,0 +1,151 @@
+"""Segmented HBM-scale Pallas ring collectives (pallas_chunked) on the CPU
+emulator rung: correctness across segment-count regimes (single/odd/even,
+group-crossing credit chains), the automatic VMEM->HBM kernel dispatch, and
+an interpret-mode race-detector pass over the full credit/store protocol.
+
+Reference analog: the segmented streaming design of
+``ccl_offload_control.c:628-649`` (bounded moves in flight) and the
+segmented allreduce ``:1906-2071``.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from accl_tpu import Algorithm, dataType, reduceFunction
+from accl_tpu.parallel import pallas_chunked, pallas_ring
+
+WORLD = 8
+SEG = 4096  # bytes -> 1024 f32 elements per segment
+
+
+def _put(accl, arr):
+    import jax
+    comm = accl.global_comm()
+    return jax.device_put(arr, comm.sharding())
+
+
+# C = segments per chunk: 1 (no grouping), 2 (one group, both channels),
+# 3 (channel 0 crosses groups), 4 (both channels cross groups)
+@pytest.mark.parametrize("nseg", [1, 2, 3, 4])
+def test_chunked_reduce_scatter(accl, rng, nseg):
+    comm = accl.global_comm()
+    n = 1024 * nseg  # elements per output chunk
+    x = rng.standard_normal((WORLD, WORLD * n)).astype(np.float32)
+    prog = pallas_chunked.build_chunked_ring_reduce_scatter(
+        comm, reduceFunction.SUM, dataType.float32, segment_bytes=SEG)
+    out = np.asarray(prog(_put(accl, x)))
+    ref = x.reshape(WORLD, WORLD, n).sum(0)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("nseg", [1, 2, 3, 4])
+def test_chunked_allgather(accl, rng, nseg):
+    comm = accl.global_comm()
+    n = 1024 * nseg
+    x = rng.standard_normal((WORLD, n)).astype(np.float32)
+    prog = pallas_chunked.build_chunked_ring_allgather(
+        comm, dataType.float32, segment_bytes=SEG)
+    out = np.asarray(prog(_put(accl, x)))
+    for r in range(WORLD):
+        np.testing.assert_allclose(out[r].reshape(WORLD, n), x, rtol=1e-6)
+
+
+@pytest.mark.parametrize("func", [reduceFunction.SUM, reduceFunction.MAX])
+def test_chunked_allreduce(accl, rng, func):
+    comm = accl.global_comm()
+    n = 1024 * 3 * WORLD + 77  # odd tail exercises padding
+    x = rng.standard_normal((WORLD, n)).astype(np.float32)
+    prog = pallas_chunked.build_chunked_ring_allreduce(
+        comm, func, dataType.float32, segment_bytes=SEG)
+    out = np.asarray(prog(_put(accl, x)))
+    ref = x.sum(0) if func == reduceFunction.SUM else x.max(0)
+    for r in range(WORLD):
+        np.testing.assert_allclose(out[r], ref, rtol=1e-4, atol=1e-4)
+
+
+def test_chunked_uneven_payload(accl, rng):
+    """Payload not a multiple of world * segment (tail masking)."""
+    comm = accl.global_comm()
+    n = 5000  # not divisible by 8; chunk 625 -> C=1 with 1024-elem segs
+    x = rng.standard_normal((WORLD, n)).astype(np.float32)
+    prog = pallas_chunked.build_chunked_ring_allreduce(
+        comm, reduceFunction.SUM, dataType.float32, segment_bytes=SEG)
+    out = np.asarray(prog(_put(accl, x)))
+    for r in range(WORLD):
+        np.testing.assert_allclose(out[r], x.sum(0), rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_dispatch_routes_large_payloads(accl, rng):
+    """build_pallas_ring_* auto-routes HBM-scale payloads to the chunked
+    kernels (VMEM_PAYLOAD_THRESHOLD split)."""
+    comm = accl.global_comm()
+    # staged = world * padded * 4B > 4 MiB  ->  chunk > 128K elements
+    n = (1 << 17) * WORLD + 13
+    x = rng.standard_normal((WORLD, n)).astype(np.float32)
+    prog = pallas_ring.build_pallas_ring_allreduce(
+        comm, reduceFunction.SUM, dataType.float32, segment_bytes=64 * 1024)
+    out = np.asarray(prog(_put(accl, x)))
+    np.testing.assert_allclose(out[0], x.sum(0), rtol=1e-4, atol=1e-3)
+
+
+def test_chunked_through_host_api(accl, rng):
+    """Algorithm.PALLAS through ACCL.allreduce with a payload over the
+    dispatch threshold uses the segmented path end to end."""
+    count = (1 << 17) * WORLD + 128  # staged > 4 MiB threshold (strict >)
+    send = accl.create_buffer(count, dataType.float32)
+    recv = accl.create_buffer(count, dataType.float32)
+    send.host[:] = rng.standard_normal(send.host.shape).astype(np.float32)
+    accl.allreduce(send, recv, count, reduceFunction.SUM,
+                   algorithm=Algorithm.PALLAS)
+    np.testing.assert_allclose(recv.host[0], send.host.sum(0),
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_chunked_world1_shortcircuit(rng):
+    """world=1: the chunked bodies must not enter the kernels (the hop loop
+    is empty and the epilogue would deadlock on an unissued store)."""
+    import jax
+    from accl_tpu.communicator import Communicator
+    comm = Communicator(jax.devices()[:1])
+    n = (1 << 20) + 40  # over the dispatch threshold at world=1
+    x = rng.standard_normal((1, n)).astype(np.float32)
+    prog = pallas_ring.build_pallas_ring_allreduce(
+        comm, reduceFunction.SUM, dataType.float32, segment_bytes=SEG)
+    out = np.asarray(prog(jax.device_put(x, comm.sharding())))
+    np.testing.assert_allclose(out, x, rtol=1e-6)
+
+
+def test_chunked_kernels_race_free(accl, rng, monkeypatch):
+    """Full credit/store protocol under the interpret-mode race detector."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    monkeypatch.setattr(
+        pallas_ring, "_interpret_params",
+        lambda: pltpu.InterpretParams(detect_races=True))
+    comm = accl.global_comm()
+    n = 1024 * 4 * WORLD  # C=4: both channels cross group boundaries
+    x = rng.standard_normal((WORLD, n)).astype(np.float32)
+    prog = pallas_chunked.build_chunked_ring_allreduce(
+        comm, reduceFunction.SUM, dataType.float32, segment_bytes=SEG)
+    out = np.asarray(prog(_put(accl, x)))
+    np.testing.assert_allclose(out[0], x.sum(0), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.skipif(
+    not os.environ.get("ACCL_BIG_PAYLOAD"),
+    reason="256 MiB interpret-mode run; set ACCL_BIG_PAYLOAD=1 to enable")
+def test_chunked_256mib_payload(accl):
+    """The BASELINE.md sweep endpoint regime: >=256 MiB per-rank payload
+    compiles and runs through the segmented kernels (VERDICT round-1 #2)."""
+    comm = accl.global_comm()
+    n = (256 * 1024 * 1024) // 4  # 256 MiB of f32 per rank
+    import jax.numpy as jnp
+    import jax
+    x = jnp.ones((WORLD, n), jnp.float32)
+    prog = pallas_chunked.build_chunked_ring_allreduce(
+        comm, reduceFunction.SUM, dataType.float32,
+        segment_bytes=1 << 20)
+    out = prog(jax.device_put(x, comm.sharding()))
+    assert float(out[0, 0]) == float(WORLD)
+    assert float(out[0, -1]) == float(WORLD)
