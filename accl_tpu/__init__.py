@@ -8,6 +8,12 @@ eager/rendezvous two-sided protocol becomes a tag-matched send/recv engine on
 top of single-pair ``ppermute`` moves. See SURVEY.md for the design map.
 """
 
+# Under the per-rank launcher (accl_tpu.launch — the mpirun analog), join
+# the multi-controller runtime before any JAX backend use.
+from . import multiproc as _multiproc
+
+_multiproc.ensure_initialized()
+
 from .accl import ACCL
 from .arithconfig import ArithConfig, DEFAULT_ARITH_CONFIG
 from .buffer import BaseBuffer, Buffer, BufferSlice, DummyBuffer

@@ -98,6 +98,13 @@ class ACCL:
         self.comms.append(comm)
         self._matchers[id(comm)] = MatchingEngine(
             comm, rx_buffer_count=self.config.eager_rx_buffer_count)
+        self._fabric = None
+        if comm.is_multiprocess:
+            from .multiproc import CrossProcessFabric
+
+            self._fabric = CrossProcessFabric(
+                timeout=self.config.timeout,
+                eager_window=self.config.eager_rx_buffer_count)
         self._initialized = True
         log.info("initialized: %s", self.parse_hwid())
 
@@ -148,6 +155,8 @@ class ACCL:
 
     def set_timeout(self, seconds: float) -> None:
         self.config = self.config.replace(timeout=seconds)
+        if self._fabric is not None:
+            self._fabric.timeout = seconds
 
     def set_max_eager_size(self, nbytes: int) -> None:
         self.config = self.config.replace(max_eager_size=nbytes)
@@ -354,6 +363,59 @@ class ACCL:
                 if new_step == step:
                     return  # no progress possible; stop spinning
 
+    # -- cross-process two-sided path (multiproc fabric) -------------------
+
+    def _cross_send(self, srcbuf, count, src, dst, tag, from_device,
+                    run_async, comm, compress_dtype) -> Optional[Request]:
+        """Send to a rank owned by another controller process: payload
+        travels over the coordination-service fabric with the same
+        eager/rendezvous split (multiproc.CrossProcessFabric)."""
+        if run_async:
+            raise ACCLError(
+                errorCode.CONFIG_ERROR,
+                "cross-process send is synchronous; drop run_async")
+        if not comm.rank_is_local(src):
+            raise ACCLError(
+                errorCode.CONFIG_ERROR,
+                f"process {jax.process_index()} does not own src rank {src}")
+        self._check_count(srcbuf, count, "send")
+        if not from_device:
+            srcbuf.sync_to_device()
+        data = srcbuf.read_rank_local(src, count)
+        arith = self._arith(srcbuf.dtype, compress_dtype)
+        compressing = arith is not None and arith.is_compressing
+        if compressing:
+            data = data.astype(
+                np.dtype(constants.to_jax_dtype(arith.compressed)))
+        nbytes = count * constants.dtype_size(srcbuf.dtype)
+        if nbytes > self.config.max_eager_size and not compressing:
+            self._fabric.send_rendezvous(src, dst, tag, data)
+        else:
+            seg_elems = max(self.config.eager_rx_buffer_size
+                            // constants.dtype_size(srcbuf.dtype), 1)
+            self._fabric.send_eager(src, dst, tag, data, seg_elems)
+        return self._finish(operation.send, None, data, True, False)
+
+    def _cross_recv(self, dstbuf, count, src, dst, tag, to_device,
+                    run_async, comm, compress_dtype) -> Optional[Request]:
+        """Receive from a rank owned by another controller process."""
+        if run_async:
+            raise ACCLError(
+                errorCode.CONFIG_ERROR,
+                "cross-process recv is synchronous; drop run_async")
+        if not comm.rank_is_local(dst):
+            raise ACCLError(
+                errorCode.CONFIG_ERROR,
+                f"process {jax.process_index()} does not own dst rank {dst}")
+        self._check_count(dstbuf, count, "recv")
+        _ = self._arith(dstbuf.dtype, compress_dtype)  # validate the pair
+        np_dtype = np.dtype(dstbuf.jnp_dtype)
+        # the SENDER's size/compression decide the protocol (fw :575-651);
+        # the fabric recv follows whichever the wire shows
+        vals = self._fabric.recv(src, dst, tag, count, np_dtype)
+        dstbuf.store_rank_local(dst, vals)
+        return self._finish(operation.recv, None, vals, to_device, False)
+
     def send(
         self,
         srcbuf: BufLike,
@@ -381,6 +443,11 @@ class ACCL:
         compresses the wire payload only (ETH_COMPRESSED semantics).
         """
         comm = comm or self.comms[0]
+        if comm.is_multiprocess and not (
+                comm.rank_is_local(src) and comm.rank_is_local(dst)):
+            return self._cross_send(srcbuf, count, src, dst, tag,
+                                    from_device, run_async, comm,
+                                    compress_dtype)
         self._pump()
         self._check_count(srcbuf, count, "send")
         data = self._input(srcbuf, count, from_device)
@@ -513,6 +580,11 @@ class ACCL:
         completes on match — ``current_step`` counts delivered segments.
         """
         comm = comm or self.comms[0]
+        if comm.is_multiprocess and not (
+                comm.rank_is_local(src) and comm.rank_is_local(dst)):
+            return self._cross_recv(dstbuf, count, src, dst, tag,
+                                    to_device, run_async, comm,
+                                    compress_dtype)
         self._pump()
         self._check_count(dstbuf, count, "recv")
         matcher = self.matcher(comm)
@@ -886,17 +958,31 @@ class ACCL:
 
     def barrier(self, comm: Optional[Communicator] = None) -> None:
         """``ACCL::barrier`` (fw :2078-2120): flush outstanding work, then a
-        zero-payload rendezvous exchange (scalar psum across the mesh)."""
+        zero-payload rendezvous exchange (scalar psum across the mesh).
+
+        Multi-process: adds a host-level coordination-service barrier (the
+        zero-byte notification gather/scatter analog) on top of the
+        device-level psum, which every controller enters SPMD."""
         comm = comm or self.comms[0]
         self._queue.drain(timeout=self.config.timeout)
         prog = self._programs.get(
             self._key(comm, operation.barrier),
             lambda: primitives.build_barrier(comm),
         )
-        token = jax.device_put(
-            np.ones((comm.world_size,), dtype=np.int32), comm.sharding()
-        )
+        if comm.is_multiprocess:
+            shards = [
+                jax.device_put(np.ones((1,), np.int32), comm.device(r))
+                for r in comm.local_ranks
+            ]
+            token = jax.make_array_from_single_device_arrays(
+                (comm.world_size,), comm.sharding(), shards)
+        else:
+            token = jax.device_put(
+                np.ones((comm.world_size,), dtype=np.int32), comm.sharding()
+            )
         jax.block_until_ready(prog(token))
+        if self._fabric is not None:
+            self._fabric.barrier()
 
     # ------------------------------------------------------------------
     # introspection (accl.cpp:980-1064 dump_* analogs)

@@ -89,13 +89,39 @@ class Buffer(BaseBuffer):
         the numpy buffer, which would let later host writes mutate the
         "device" data — breaking the immutable-snapshot guarantee the
         send/recv engine and in-flight programs rely on.
+
+        Multi-process: each controller uploads only the rows of the ranks it
+        owns; the global array is assembled from per-process shards
+        (``make_array_from_single_device_arrays``), every process
+        contributing its part — the MPI per-rank-buffer model.
         """
-        self._device = jax.device_put(np.array(self.host), self.comm.sharding())
+        if self.comm.is_multiprocess:
+            shards = [
+                jax.device_put(np.array(self.host[r : r + 1]),
+                               self.comm.device(r))
+                for r in self.comm.local_ranks
+            ]
+            self._device = jax.make_array_from_single_device_arrays(
+                (self.comm.world_size, self.count),
+                self.comm.sharding(), shards)
+        else:
+            self._device = jax.device_put(
+                np.array(self.host), self.comm.sharding())
 
     def sync_from_device(self) -> None:
-        """Device shards -> host staging (BaseBuffer::sync_from_device)."""
-        if self._device is not None:
-            self.host = np.asarray(jax.block_until_ready(self._device))
+        """Device shards -> host staging (BaseBuffer::sync_from_device).
+
+        Multi-process: only locally-addressable shards land in ``host`` —
+        rows of remote ranks keep their staging content (a remote process's
+        device memory is not readable here, exactly as in MPI)."""
+        if self._device is None:
+            return
+        jax.block_until_ready(self._device)
+        if self._device.is_fully_addressable:
+            self.host = np.asarray(self._device)
+        else:
+            for shard in self._device.addressable_shards:
+                self.host[shard.index] = np.asarray(shard.data)
 
     def sync_bo_to_device(self) -> None:  # alias kept for ported tests
         self.sync_to_device()
@@ -117,6 +143,37 @@ class Buffer(BaseBuffer):
 
     def device_store(self, value: jax.Array) -> None:
         self._device = value
+
+    # ---- per-rank local access (multi-process data plane) ----------------
+
+    def read_rank_local(self, rank: int, count: int) -> np.ndarray:
+        """Device bytes of rank ``rank``'s shard (must be process-local)."""
+        arr = self.data
+        for shard in arr.addressable_shards:
+            if shard.index[0].start == rank:
+                return np.asarray(shard.data).reshape(-1)[:count]
+        raise ValueError(f"rank {rank} is not local to this process")
+
+    def store_rank_local(self, rank: int, values: np.ndarray) -> None:
+        """Write into rank ``rank``'s shard (must be process-local),
+        reassembling the global array from per-process shards."""
+        arr = self.data
+        done = False
+        shards = []
+        for shard in arr.addressable_shards:
+            r = shard.index[0].start
+            if r == rank:
+                cur = np.asarray(shard.data).copy()
+                cur[0, : values.shape[-1]] = values
+                shards.append(jax.device_put(cur, shard.device))
+                done = True
+            else:
+                shards.append(shard.data)
+        if not done:
+            raise ValueError(f"rank {rank} is not local to this process")
+        self._device = jax.make_array_from_single_device_arrays(
+            (self.comm.world_size, self.count), self.comm.sharding(), shards)
+        self.host[rank, : values.shape[-1]] = values
 
     # ---- views -----------------------------------------------------------
 
@@ -157,6 +214,15 @@ class BufferSlice(BaseBuffer):
 
     def sync_from_device(self) -> None:
         self.parent.sync_from_device()
+
+    def read_rank_local(self, rank: int, count: int) -> np.ndarray:
+        return self.parent.read_rank_local(
+            rank, self.start + count)[self.start :]
+
+    def store_rank_local(self, rank: int, values: np.ndarray) -> None:
+        cur = self.parent.read_rank_local(rank, self.parent.count).copy()
+        cur[self.start : self.start + values.shape[-1]] = values
+        self.parent.store_rank_local(rank, cur)
 
     def device_view(self) -> jax.Array:
         return self.parent.data[:, self.start : self.end]

@@ -86,6 +86,24 @@ class Communicator:
         """Sharding that places axis 0 of a (world, ...) array one-shard-per-rank."""
         return NamedSharding(self.mesh, spec if spec is not None else P(self.AXIS))
 
+    # ---- multi-process topology (fixture.hpp per-rank driver analog) -----
+
+    @property
+    def is_multiprocess(self) -> bool:
+        """True when ranks span more than one controller process."""
+        me = jax.process_index()
+        return any(d.process_index != me for d in self._devices)
+
+    def rank_is_local(self, rank: int) -> bool:
+        """Whether this process owns rank ``rank``'s device."""
+        return self._devices[rank].process_index == jax.process_index()
+
+    @property
+    def local_ranks(self) -> List[int]:
+        me = jax.process_index()
+        return [i for i, d in enumerate(self._devices)
+                if d.process_index == me]
+
     def replicated_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
 

@@ -1,0 +1,351 @@
+"""Multi-process operation: one controller per rank group.
+
+The reference runs one driver process per rank under ``mpirun``, wired to
+its emulator through ZMQ (``test/host/xrt/include/fixture.hpp:48-144``,
+``test/model/zmq/zmq_server.cpp``). This module is that fabric for the TPU
+build, expressed through JAX's multi-controller runtime instead of MPI+ZMQ:
+
+* process bring-up = ``jax.distributed.initialize`` (gloo TCP collectives
+  on the CPU emulator rung; native ICI/DCN on real multi-host TPU);
+* device data plane = global ``jax.Array``s assembled from per-process
+  shards (``jax.make_array_from_single_device_arrays``) — collectives are
+  the same shard_map programs, now executed SPMD by every controller;
+* host control plane = the distributed coordination service's key-value
+  store, standing in for the ZMQ pub/sub fabric: eager segments, the
+  rendezvous address handshake, flow-control credits and barriers all ride
+  on it.
+
+Environment contract (set by :mod:`accl_tpu.launch`):
+
+``ACCL_COORDINATOR``    host:port of process 0's coordination service
+``ACCL_NUM_PROCS``      total process count
+``ACCL_PROC_ID``        this process's id (0-based)
+``ACCL_DEVS_PER_PROC``  virtual CPU devices per process (emulator rung)
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from . import constants
+from .constants import ACCLError, dataType, errorCode
+
+_ENV_COORD = "ACCL_COORDINATOR"
+_ENV_NPROCS = "ACCL_NUM_PROCS"
+_ENV_PID = "ACCL_PROC_ID"
+_ENV_DEVS = "ACCL_DEVS_PER_PROC"
+
+_initialized = False
+
+
+def launched() -> bool:
+    """True when running under the accl_tpu.launch environment."""
+    return _ENV_COORD in os.environ
+
+
+def ensure_initialized() -> None:
+    """Connect this process to the coordination service (idempotent).
+
+    Must run before the first JAX backend touch; :mod:`accl_tpu`'s package
+    ``__init__`` calls it on import when the launch env is present — the
+    analog of the reference fixture constructing one driver per rank at
+    process start (fixture.hpp:87-92).
+    """
+    global _initialized
+    if _initialized or not launched():
+        return
+    ndev = os.environ.get(_ENV_DEVS)
+    if ndev:
+        # force exactly ndev virtual devices, replacing any inherited
+        # count (e.g. a test harness's XLA_FLAGS leaking into children)
+        flags = [
+            f
+            for f in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={ndev}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+    import jax
+
+    platform = os.environ.get("ACCL_PLATFORM",
+                              os.environ.get("JAX_PLATFORMS", "cpu"))
+    if platform in ("cpu", ""):
+        # jax.config beats a sitecustomize-pinned JAX_PLATFORMS env var
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=os.environ[_ENV_COORD],
+        num_processes=int(os.environ[_ENV_NPROCS]),
+        process_id=int(os.environ[_ENV_PID]),
+    )
+    _initialized = True
+
+
+def active() -> bool:
+    """True when JAX runs multi-controller (process_count > 1)."""
+    import jax
+
+    try:
+        return jax.process_count() > 1
+    except RuntimeError:
+        return False
+
+
+def _client():
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        raise ACCLError(
+            errorCode.CONFIG_ERROR,
+            "multi-process fabric requires jax.distributed to be initialized",
+        )
+    return client
+
+
+class CrossProcessFabric:
+    """KV-store message fabric between per-rank controllers.
+
+    Protocol (mirrors the firmware's two-sided split, with the coordination
+    service playing the wire):
+
+    * **eager** (payload <= max_eager_size, or compressed): the sender
+      posts rx-buffer-sized segments immediately under keys
+      ``e/{src}.{dst}/{seq}``, throttled by a per-pair credit window of
+      ``eager_rx_buffer_count`` unconsumed segments (the rx-pool
+      backpressure, rxbuf_enqueue.cpp lifecycle); the receiver consumes
+      them in sequence order and bumps the pair's ack counter.
+    * **rendezvous** (larger): the receiver announces its posted recv under
+      ``a/{src}.{dst}/{seq}`` (the address handshake,
+      ``ccl_offload_control.c:142-150``); the sender blocks for the
+      announcement, then writes the payload in one post
+      (``r/{src}.{dst}/{seq}`` — the single RDMA WRITE analog :604-612).
+
+    Sequence numbers are per (src, dst) pair and counted independently at
+    both endpoints — identical to the exchange-memory seqn registers the
+    DMP updates on each side of the wire (dma_mover.cpp:581-610).
+    """
+
+    def __init__(self, timeout: float, eager_window: int):
+        self.timeout = timeout
+        self.eager_window = max(int(eager_window), 1)
+        self._out_seq: dict = {}
+        self._in_seq: dict = {}
+        self._sent: dict = {}
+
+    # -- key helpers -------------------------------------------------------
+
+    @staticmethod
+    def _pair(src: int, dst: int) -> str:
+        return f"{src}.{dst}"
+
+    def _next_out(self, src: int, dst: int) -> int:
+        k = (src, dst)
+        self._out_seq[k] = self._out_seq.get(k, 0) + 1
+        return self._out_seq[k]
+
+    def _next_in(self, src: int, dst: int) -> int:
+        k = (src, dst)
+        self._in_seq[k] = self._in_seq.get(k, 0) + 1
+        return self._in_seq[k]
+
+    def _timeout_ms(self) -> int:
+        return max(int(self.timeout * 1000), 1)
+
+    # -- wire format -------------------------------------------------------
+
+    @staticmethod
+    def _pack(header: dict, payload: bytes) -> bytes:
+        h = json.dumps(header).encode()
+        return len(h).to_bytes(4, "little") + h + payload
+
+    @staticmethod
+    def _unpack(blob: bytes):
+        hlen = int.from_bytes(blob[:4], "little")
+        header = json.loads(blob[4 : 4 + hlen].decode())
+        return header, blob[4 + hlen :]
+
+    # -- eager path --------------------------------------------------------
+
+    def send_eager(self, src: int, dst: int, tag: int, data: np.ndarray,
+                   seg_elems: int) -> None:
+        """Post segments immediately, bounded by the credit window."""
+        client = _client()
+        pair = self._pair(src, dst)
+        total = data.shape[-1]
+        offs = list(range(0, total, seg_elems))
+        nseg = len(offs)
+        for i, off in enumerate(offs):
+            self._await_credit(client, pair, src, dst)
+            seq = self._next_out(src, dst)
+            seg = np.ascontiguousarray(data[..., off : off + seg_elems])
+            header = {
+                "tag": tag,
+                "dtype": str(seg.dtype),
+                "count": int(seg.shape[-1]),
+                "total": int(total),
+                "seg": i,
+                "nseg": nseg,
+            }
+            client.key_value_set_bytes(
+                f"accl/e/{pair}/{seq}", self._pack(header, seg.tobytes())
+            )
+            self._sent[(src, dst)] = self._sent.get((src, dst), 0) + 1
+
+    @staticmethod
+    def _try_get(client, key: str) -> Optional[str]:
+        """try_get that treats a missing key as None (the client raises
+        NOT_FOUND rather than returning a sentinel)."""
+        try:
+            return client.key_value_try_get(key)
+        except Exception:
+            return None
+
+    @staticmethod
+    def _try_get_bytes(client, key: str) -> Optional[bytes]:
+        try:
+            return client.key_value_try_get_bytes(key)
+        except Exception:
+            return None
+
+    def _await_credit(self, client, pair: str, src: int, dst: int) -> None:
+        """Block while the unconsumed-segment window is full (rx-pool
+        backpressure: IDLE/ENQUEUED slot turnover)."""
+        sent = self._sent.get((src, dst), 0)
+        if sent < self.eager_window:
+            return
+        deadline = time.monotonic() + self.timeout
+        while True:
+            acked = self._try_get(client, f"accl/ack/{pair}") or "0"
+            if sent - int(acked) < self.eager_window:
+                return
+            if time.monotonic() > deadline:
+                raise ACCLError(
+                    errorCode.NOT_READY_ERROR,
+                    f"eager window to rank {dst} full for "
+                    f"{self.timeout}s (no recv consuming segments)",
+                )
+            time.sleep(0.002)
+
+    # -- rendezvous send ---------------------------------------------------
+
+    def send_rendezvous(self, src: int, dst: int, tag: int,
+                        data: np.ndarray) -> None:
+        """Block for the receiver's announcement, then one payload post."""
+        client = _client()
+        pair = self._pair(src, dst)
+        seq = self._next_out(src, dst)
+        try:
+            ann = client.blocking_key_value_get(
+                f"accl/a/{pair}/{seq}", self._timeout_ms())
+        except Exception as e:
+            raise ACCLError(
+                errorCode.NOT_READY_ERROR,
+                f"rendezvous send {src}->{dst}: no recv announced "
+                f"within {self.timeout}s ({e})") from e
+        ann = json.loads(ann)
+        if ann["count"] != int(data.shape[-1]):
+            raise ACCLError(
+                errorCode.INVALID_BUFFER_SIZE,
+                f"rendezvous send {src}->{dst}: recv count {ann['count']} "
+                f"!= send count {int(data.shape[-1])}")
+        header = {"tag": tag, "dtype": str(data.dtype),
+                  "count": int(data.shape[-1])}
+        client.key_value_set_bytes(
+            f"accl/r/{pair}/{seq}",
+            self._pack(header, np.ascontiguousarray(data).tobytes()))
+
+    # -- receive (protocol discovered from the wire) -----------------------
+
+    def recv(self, src: int, dst: int, tag: int, count: int,
+             np_dtype) -> np.ndarray:
+        """Receive one message, following whichever protocol the sender
+        chose.
+
+        The sender is authoritative for the eager/rendezvous split (its
+        byte count and compression decide, fw send :575-651); the receiver
+        cannot know it in advance when dtypes differ across the pair. So
+        the recv always announces itself (the rendezvous address post —
+        harmless if unused) and then waits for this sequence number to
+        materialize as either an eager segment or a rendezvous payload.
+        """
+        client = _client()
+        pair = self._pair(src, dst)
+        seq = self._next_in(src, dst)
+        client.key_value_set(
+            f"accl/a/{pair}/{seq}", json.dumps({"count": int(count)}))
+        blob, is_rendezvous = self._await_message(client, pair, seq, src, dst)
+        header, payload = self._unpack(blob)
+        if tag != constants.TAG_ANY and header["tag"] != tag:
+            raise ACCLError(
+                errorCode.RECEIVE_OFFCHIP_ERROR,
+                f"recv {dst}<-{src}: tag mismatch (got {header['tag']}, "
+                f"want {tag}) at head of pair stream")
+        if is_rendezvous:
+            client.key_value_delete(f"accl/r/{pair}/{seq}")
+            return np.frombuffer(payload, dtype=header["dtype"]).astype(
+                np_dtype, copy=False)
+
+        # eager: the announcement went unused — reclaim it
+        client.key_value_delete(f"accl/a/{pair}/{seq}")
+        # the first segment carries the message geometry; consume the
+        # remaining segments in sequence order
+        if header["total"] != count:
+            raise ACCLError(
+                errorCode.INVALID_BUFFER_SIZE,
+                f"recv {dst}<-{src}: count {count} != message total "
+                f"{header['total']}")
+        client.key_value_delete(f"accl/e/{pair}/{seq}")
+        client.key_value_increment(f"accl/ack/{pair}", 1)
+        parts = [np.frombuffer(payload, dtype=header["dtype"])]
+        got = header["count"]
+        while got < count:
+            seq = self._next_in(src, dst)
+            key = f"accl/e/{pair}/{seq}"
+            try:
+                blob = client.blocking_key_value_get_bytes(
+                    key, self._timeout_ms())
+            except Exception as e:
+                raise ACCLError(
+                    errorCode.NOT_READY_ERROR,
+                    f"recv {dst}<-{src}: segment seq={seq} never arrived "
+                    f"({e})") from e
+            header, payload = self._unpack(blob)
+            parts.append(np.frombuffer(payload, dtype=header["dtype"]))
+            got += header["count"]
+            client.key_value_delete(key)
+            client.key_value_increment(f"accl/ack/{pair}", 1)
+        return np.concatenate(parts).astype(np_dtype, copy=False)
+
+    def _await_message(self, client, pair: str, seq: int,
+                       src: int, dst: int):
+        """Poll for sequence ``seq`` arriving as an eager segment or a
+        rendezvous payload; returns (blob, is_rendezvous)."""
+        deadline = time.monotonic() + self.timeout
+        while True:
+            blob = self._try_get_bytes(client, f"accl/e/{pair}/{seq}")
+            if blob is not None:
+                return blob, False
+            blob = self._try_get_bytes(client, f"accl/r/{pair}/{seq}")
+            if blob is not None:
+                return blob, True
+            if time.monotonic() > deadline:
+                raise ACCLError(
+                    errorCode.NOT_READY_ERROR,
+                    f"recv {dst}<-{src}: no matching send within "
+                    f"{self.timeout}s")
+            time.sleep(0.002)
+
+    # -- barrier -----------------------------------------------------------
+
+    _barrier_n = 0
+
+    def barrier(self, name: str = "accl") -> None:
+        """All-process barrier (coordination-service native)."""
+        CrossProcessFabric._barrier_n += 1
+        _client().wait_at_barrier(
+            f"{name}/{CrossProcessFabric._barrier_n}", self._timeout_ms())

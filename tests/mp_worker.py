@@ -1,0 +1,140 @@
+"""Per-rank worker driven by ``python -m accl_tpu.launch`` (the mpirun rung).
+
+Each process is one controller owning a group of ranks — the analog of one
+reference test process per rank under mpirun (fixture.hpp:48-144). The
+launcher's env connects us to the coordination service on import of
+accl_tpu; from there the same public API runs SPMD.
+
+Exercises, across 2 processes x 2 devices (world=4):
+collectives (allreduce/bcast) executed by every controller; eager and
+rendezvous cross-process send/recv; compressed wire payloads; the
+in-process two-sided path between same-process ranks; barriers.
+"""
+import sys
+
+import numpy as np
+
+import accl_tpu
+from accl_tpu import Algorithm, TAG_ANY, dataType, reduceFunction
+
+import jax
+
+jax.config.update("jax_enable_x64", True)  # f64 wire test below
+
+
+def main() -> int:
+    me = jax.process_index()
+    acc = accl_tpu.ACCL()
+    comm = acc.global_comm()
+    W = acc.world_size
+    assert W == 4, f"expected world 4, got {W}"
+    assert comm.is_multiprocess
+    local = comm.local_ranks
+    print(f"[p{me}] world={W} local_ranks={local}", flush=True)
+
+    # ---- collectives: every controller calls the same program ----------
+    n = 257
+    s = acc.create_buffer(n, dataType.float32)
+    r = acc.create_buffer(n, dataType.float32)
+    for rank in range(W):
+        s.host[rank] = rank + 1  # deterministic: every process knows all rows
+    acc.allreduce(s, r, n, reduceFunction.SUM)
+    want = sum(range(1, W + 1))
+    for rank in local:
+        assert np.allclose(r.host[rank], want), (rank, r.host[rank][:4])
+    print(f"[p{me}] allreduce ok", flush=True)
+
+    b = acc.create_buffer(n, dataType.float32)
+    for rank in range(W):
+        b.host[rank] = 100 + rank
+    acc.bcast(b, n, root=0)
+    for rank in local:
+        assert np.allclose(b.host[rank], 100), b.host[rank][:4]
+    print(f"[p{me}] bcast ok", flush=True)
+
+    # ---- cross-process eager send/recv (rank 0 -> rank W-1) ------------
+    cnt = 300
+    payload = np.arange(cnt, dtype=np.float32)
+    src, dst = 0, W - 1
+    sb = acc.create_buffer(cnt, dataType.float32)
+    rb = acc.create_buffer(cnt, dataType.float32)
+    if comm.rank_is_local(src):
+        sb.host[src] = payload
+        acc.send(sb, cnt, src=src, dst=dst, tag=7)
+    if comm.rank_is_local(dst):
+        acc.recv(rb, cnt, src=src, dst=dst, tag=7)
+        assert np.allclose(rb.host[dst], payload), rb.host[dst][:8]
+        got = rb.read_rank_local(dst, cnt)  # device shard agrees
+        assert np.allclose(got, payload)
+    print(f"[p{me}] eager cross-process send/recv ok", flush=True)
+
+    # ---- cross-process rendezvous (payload > max_eager_size) -----------
+    big = acc.config.max_eager_size // 4 + 1000  # f32 elements
+    sb2 = acc.create_buffer(big, dataType.float32)
+    rb2 = acc.create_buffer(big, dataType.float32)
+    if comm.rank_is_local(src):
+        sb2.host[src] = np.arange(big, dtype=np.float32)
+        acc.send(sb2, big, src=src, dst=dst, tag=9)
+    if comm.rank_is_local(dst):
+        acc.recv(rb2, big, src=src, dst=dst, tag=9)
+        assert np.allclose(rb2.host[dst], np.arange(big, dtype=np.float32))
+    print(f"[p{me}] rendezvous cross-process send/recv ok", flush=True)
+
+    # ---- compressed wire payload cross-process -------------------------
+    if comm.rank_is_local(src):
+        acc.send(sb, cnt, src=src, dst=dst, tag=11,
+                 compress_dtype=dataType.float16)
+    if comm.rank_is_local(dst):
+        acc.recv(rb, cnt, src=src, dst=dst, tag=TAG_ANY,
+                 compress_dtype=dataType.float16)
+        assert np.allclose(rb.host[dst], payload, atol=0.5)
+    print(f"[p{me}] compressed cross-process ok", flush=True)
+
+    # ---- sender-authoritative protocol split (mixed dtypes) ------------
+    # f64 send crosses max_eager_size (rendezvous) while the f32 recv
+    # side alone would have guessed eager — the wire decides
+    mix = acc.config.max_eager_size // 8 + 500
+    sb3 = acc.create_buffer(mix, dataType.float64)
+    rb3 = acc.create_buffer(mix, dataType.float64)
+    if comm.rank_is_local(src):
+        sb3.host[src] = np.arange(mix, dtype=np.float64)
+        acc.send(sb3, mix, src=src, dst=dst, tag=13)
+    if comm.rank_is_local(dst):
+        acc.recv(rb3, mix, src=src, dst=dst, tag=13)
+        assert np.allclose(rb3.host[dst], np.arange(mix, dtype=np.float64))
+    print(f"[p{me}] rendezvous f64 cross-process ok", flush=True)
+
+    # ---- BufferSlice across processes ----------------------------------
+    half = cnt // 2
+    if comm.rank_is_local(src):
+        acc.send(sb.slice(0, half), half, src=src, dst=dst, tag=21)
+    if comm.rank_is_local(dst):
+        view = rb2.slice(10, 10 + half)
+        acc.recv(view, half, src=src, dst=dst, tag=21)
+        assert np.allclose(rb2.host[dst][10 : 10 + half], payload[:half])
+    print(f"[p{me}] slice cross-process ok", flush=True)
+
+    # ---- in-process pair still uses the matching engine ----------------
+    a, bb = local[0], local[1]
+    if comm.rank_is_local(a):
+        sb.host[a] = payload * 2
+        acc.send(sb, cnt, src=a, dst=bb, tag=3)
+        acc.recv(rb, cnt, src=a, dst=bb, tag=3)
+        assert np.allclose(rb.host[bb], payload * 2)
+    print(f"[p{me}] in-process pair ok", flush=True)
+
+    acc.barrier()
+
+    # ---- explicit-algorithm collective across controllers --------------
+    acc.allreduce(s, r, n, reduceFunction.MAX, algorithm=Algorithm.RING)
+    for rank in local:
+        assert np.allclose(r.host[rank], W), r.host[rank][:4]
+    print(f"[p{me}] ring allreduce ok", flush=True)
+
+    acc.barrier()
+    print(f"[p{me}] MP-OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

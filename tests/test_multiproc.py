@@ -1,0 +1,50 @@
+"""Multi-process operation (the mpirun rung of the test ladder, SURVEY.md
+§3.5/§4): the launcher spawns one controller process per rank group; the
+worker exercises collectives, cross-process eager/rendezvous send/recv and
+barriers over the coordination-service fabric.
+
+Reference analog: ``mpirun -np P`` against per-rank emulator processes
+(``test/host/xrt/include/fixture.hpp:48-144``, ``zmq_server.cpp``).
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_launcher(args, timeout=420):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("ACCL_COORDINATOR", None)  # never nest launch environments
+    # the launcher pins JAX_PLATFORMS=cpu in the children
+    return subprocess.run(
+        [sys.executable, "-m", "accl_tpu.launch", *args],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout)
+
+
+def test_two_process_worker():
+    """2 controllers x 2 devices: the full mp_worker scenario suite."""
+    res = _run_launcher(
+        ["-np", "2", "--devices-per-proc", "2",
+         os.path.join("tests", "mp_worker.py")])
+    sys.stdout.write(res.stdout)
+    sys.stderr.write(res.stderr)
+    assert res.returncode == 0, f"launcher rc={res.returncode}"
+    assert res.stdout.count("MP-OK") == 2
+
+
+def test_launcher_propagates_failure():
+    """A failing child aborts the job with a nonzero exit (mpirun abort
+    semantics)."""
+    res = _run_launcher(
+        ["-np", "2", "--devices-per-proc", "1",
+         sys.executable, "-c", "raise SystemExit(3)"], timeout=120)
+    assert res.returncode != 0
+
+
+def test_launcher_rejects_missing_prog():
+    res = _run_launcher(["-np", "2"], timeout=60)
+    assert res.returncode != 0
