@@ -22,7 +22,7 @@ from ..arithconfig import ArithConfig
 from ..communicator import Communicator
 from ..config import ACCLConfig, Algorithm
 from ..constants import ACCLError, dataType, errorCode, operation, reduceFunction
-from . import hierarchical, pallas_ring, primitives, ring, tree
+from . import flat, hierarchical, pallas_ring, primitives, ring, tree
 
 #: payload size above which AUTO prefers the explicit ring (bytes)
 RING_THRESHOLD = 4 * 1024 * 1024
@@ -38,9 +38,9 @@ _SUPPORTED = {
     operation.allgather: {Algorithm.XLA, Algorithm.RING, Algorithm.PALLAS},
     operation.reduce_scatter: {Algorithm.XLA, Algorithm.RING,
                                Algorithm.PALLAS},
-    operation.scatter: {Algorithm.XLA},
-    operation.gather: {Algorithm.XLA},
-    operation.alltoall: {Algorithm.XLA},
+    operation.scatter: {Algorithm.XLA, Algorithm.FLAT},
+    operation.gather: {Algorithm.XLA, Algorithm.FLAT, Algorithm.RING},
+    operation.alltoall: {Algorithm.XLA, Algorithm.FLAT},
 }
 
 
@@ -54,9 +54,11 @@ def select(
     comm: Communicator,
     cfg: ACCLConfig,
     requested: Optional[Algorithm] = None,
+    count: Optional[int] = None,
 ) -> Algorithm:
-    """Resolve the algorithm for one call (threshold logic analog of
-    fw bcast/reduce `... <= *_FLAT_TREE_MAX_RANKS` selection)."""
+    """Resolve the algorithm for one call — the tuning-register thresholds
+    of the firmware's per-collective selection (flat vs binary tree:
+    ``ccl_offload_control.c:816`` bcast, ``:1533`` reduce)."""
     algo = requested or cfg.algorithm
     if algo != Algorithm.AUTO:
         if not supported(op, algo):
@@ -71,10 +73,19 @@ def select(
     if op in (operation.allreduce, operation.allgather, operation.reduce_scatter) \
             and nbytes >= RING_THRESHOLD:
         return Algorithm.RING
-    if op in (operation.bcast, operation.reduce) \
-            and comm.world_size > cfg.bcast_flat_tree_max_ranks \
-            and nbytes > cfg.max_eager_size:
-        return Algorithm.TREE
+    if nbytes > cfg.max_eager_size:
+        # rendezvous regime: the fw picks flat vs binary tree by world size
+        # (BCAST_FLAT_TREE_MAX_RANKS, :816-869) and, for reduce, also by
+        # count (REDUCE_FLAT_TREE_MAX_COUNT, :1533-1602)
+        if op == operation.bcast:
+            return (Algorithm.FLAT
+                    if world <= cfg.bcast_flat_tree_max_ranks
+                    else Algorithm.TREE)
+        if op == operation.reduce:
+            small = count is not None and count <= cfg.reduce_flat_tree_max_count
+            return (Algorithm.FLAT
+                    if world <= cfg.reduce_flat_tree_max_ranks or small
+                    else Algorithm.TREE)
     return Algorithm.XLA
 
 

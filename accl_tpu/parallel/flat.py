@@ -1,0 +1,238 @@
+"""Flat-tree collectives — root-centric star schedules (SURVEY.md §2.6).
+
+The reference's rendezvous flat-tree family serves every peer directly
+from/to the root, out of order as addresses arrive, with a fan-in throttle
+for gather-like fan-ins:
+
+* out-of-order flat bcast     ``ccl_offload_control.c:871-921``
+* out-of-order rendezvous scatter (root-fanout)        ``:1011-1081``
+* fan-in-throttled flat gather                         ``:1144-1206``
+* flat reduce through ping-pong scratchpads            ``:1533-1602``
+* alltoall = P fused simultaneous flat trees           ``:2123-2218``
+
+SPMD re-expression: "out-of-order arrival" has no analog under a static
+schedule, but the *shape* of the tree does — every transfer is a direct
+(root, peer) edge, never a relay. Each edge is one single-pair
+``ppermute``; edges within a throttle round carry no data dependence, so
+XLA is free to overlap them, while ``lax.optimization_barrier`` between
+rounds enforces the reference's bounded fan-in/fan-out
+(``GATHER_FLAT_TREE_MAX_FANIN``): at most ``fanin`` transfers are
+schedulable concurrently at the root.
+
+Distinct from both the XLA one-shot (single fused collective) and the
+binary tree (log-depth relays) — selectable via ``Algorithm.FLAT`` and
+picked by ``algorithms.select`` from the ``*_flat_tree_*`` tuning knobs.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..arithconfig import ArithConfig
+from ..communicator import Communicator
+from ..constants import dataType, reduceFunction
+from .. import ops
+from .primitives import AXIS, _smap
+
+
+def _maybe_compress(buf, arith: Optional[ArithConfig]):
+    if arith is not None and arith.is_compressing:
+        return ops.compress(buf, arith.uncompressed, arith.compressed)
+    return buf
+
+
+def _maybe_decompress(buf, arith: Optional[ArithConfig], dtype):
+    if arith is not None and arith.is_compressing:
+        return ops.decompress(buf, arith.compressed,
+                              arith.uncompressed).astype(dtype)
+    return buf
+
+
+def _edge(buf, src: int, dst: int, arith: Optional[ArithConfig]):
+    """One direct (src, dst) edge of the star: a single-pair ppermute with
+    per-edge wire compression (ETH_COMPRESSED semantics)."""
+    wire = _maybe_compress(buf, arith)
+    return _maybe_decompress(
+        lax.ppermute(wire, AXIS, [(src, dst)]), arith, buf.dtype)
+
+
+def _rounds(world: int, root: int, fanin: int):
+    """Peers grouped into throttle rounds of at most ``fanin`` edges."""
+    peers = [(root + i) % world for i in range(1, world)]
+    fanin = max(int(fanin), 1)
+    return [peers[i : i + fanin] for i in range(0, len(peers), fanin)]
+
+
+def build_flat_bcast(comm: Communicator, root: int,
+                     arith: Optional[ArithConfig] = None,
+                     fanout: int = 0) -> Callable:
+    """Root serves every rank directly (fw :871-921). ``fanout`` bounds the
+    edges in flight per round (0 = unthrottled, one round)."""
+    world = comm.world_size
+    rounds = _rounds(world, root, fanout or world)
+
+    def body(x):
+        rank = lax.axis_index(AXIS)
+        buf = x[0]
+        for peers in rounds:
+            received = []
+            for dst in peers:
+                moved = _edge(buf, root, dst, arith)
+                received.append((dst, moved))
+            for dst, moved in received:
+                buf = jnp.where(rank == dst, moved.astype(buf.dtype), buf)
+            # round boundary: later rounds must not be hoisted across
+            buf = lax.optimization_barrier(buf)
+        return buf[None, :]
+
+    return _smap(comm, body, 1)
+
+
+def build_flat_scatter(comm: Communicator, root: int,
+                       arith: Optional[ArithConfig] = None,
+                       fanout: int = 0) -> Callable:
+    """Out-of-order rendezvous scatter (fw :1011-1081): the root sends each
+    rank its chunk directly; the self-chunk is a local copy overlapped with
+    the sends (:1040). Input (world*count,) per rank; output (count,)."""
+    world = comm.world_size
+    rounds = _rounds(world, root, fanout or world)
+
+    def body(x):
+        rank = lax.axis_index(AXIS)
+        chunks = x.reshape(world, -1)
+        out = chunks[root]  # root's self-copy; non-roots overwritten below
+        for peers in rounds:
+            received = []
+            for dst in peers:
+                moved = _edge(chunks[dst], root, dst, arith)
+                received.append((dst, moved))
+            for dst, moved in received:
+                out = jnp.where(rank == dst, moved.astype(out.dtype), out)
+            out = lax.optimization_barrier(out)
+        return out[None, :]
+
+    return _smap(comm, body, 1)
+
+
+def build_flat_gather(comm: Communicator, root: int,
+                      arith: Optional[ArithConfig] = None,
+                      fanin: int = 0) -> Callable:
+    """Fan-in-throttled flat gather (fw :1144-1206): every rank sends its
+    block straight to the root; at most ``fanin`` blocks are in flight per
+    round (GATHER_FLAT_TREE_MAX_FANIN). Non-root outputs pass through
+    unchanged (reference recvbuf semantics). Input (count,) per rank;
+    output (world*count,) defined at the root."""
+    world = comm.world_size
+    rounds = _rounds(world, root, fanin or world)
+
+    def body(x, dest):
+        rank = lax.axis_index(AXIS)
+        n = x.shape[-1]
+        out = dest.reshape(world, n)
+        out = jnp.where(rank == root,
+                        out.at[root].set(x[0]), out)
+        for peers in rounds:
+            received = []
+            for src in peers:
+                moved = _edge(x[0], src, root, arith)
+                received.append((src, moved))
+            for src, moved in received:
+                upd = out.at[src].set(moved.astype(out.dtype))
+                out = jnp.where(rank == root, upd, out)
+            out = lax.optimization_barrier(out)
+        return out.reshape(1, world * n)
+
+    return _smap(comm, body, 2)
+
+
+def build_flat_reduce(comm: Communicator, root: int, func: reduceFunction,
+                      dt: dataType,
+                      arith: Optional[ArithConfig] = None,
+                      fanin: int = 0) -> Callable:
+    """Flat reduce (fw :1533-1602): the root folds each peer's
+    contribution as it lands — the ping-pong-scratchpad accumulation,
+    expressed as a fold chain in arrival order (root+1, root+2, ...;
+    deterministic, matching the reference's fixed traversal). Non-root
+    outputs pass through unchanged."""
+    world = comm.world_size
+    rounds = _rounds(world, root, fanin or world)
+
+    def body(send, recv):
+        rank = lax.axis_index(AXIS)
+        acc = send[0]
+        for peers in rounds:
+            received = []
+            for src in peers:
+                moved = _edge(send[0], src, root, arith)
+                received.append(moved)
+            for moved in received:
+                folded = ops.combine(acc, moved, func, dt)
+                acc = jnp.where(rank == root, folded, acc)
+            acc = lax.optimization_barrier(acc)
+        out = jnp.where(rank == root, acc.astype(recv.dtype), recv[0])
+        return out[None, :]
+
+    return _smap(comm, body, 2)
+
+
+def build_flat_allreduce(comm: Communicator, func: reduceFunction,
+                         dt: dataType,
+                         arith: Optional[ArithConfig] = None,
+                         fanin: int = 0) -> Callable:
+    """Flat reduce to rank 0 + flat bcast from rank 0 — the rendezvous
+    composition (fw :1878-1887) built from the flat family."""
+    world = comm.world_size
+    red_rounds = _rounds(world, 0, fanin or world)
+
+    def body(x):
+        rank = lax.axis_index(AXIS)
+        acc = x[0]
+        for peers in red_rounds:
+            received = [_edge(x[0], src, 0, arith) for src in peers]
+            for moved in received:
+                folded = ops.combine(acc, moved, func, dt)
+                acc = jnp.where(rank == 0, folded, acc)
+            acc = lax.optimization_barrier(acc)
+        for peers in red_rounds:
+            received = [(dst, _edge(acc, 0, dst, arith)) for dst in peers]
+            for dst, moved in received:
+                acc = jnp.where(rank == dst, moved.astype(acc.dtype), acc)
+            acc = lax.optimization_barrier(acc)
+        return acc[None, :]
+
+    return _smap(comm, body, 1)
+
+
+def build_flat_alltoall(comm: Communicator,
+                        arith: Optional[ArithConfig] = None) -> Callable:
+    """Alltoall as P fused simultaneous flat trees (fw :2123-2218): at
+    rotation step s every rank sends chunk (rank+s) directly to its owner —
+    all P edges of step s are one full-rotation ppermute, so the P trees
+    genuinely overlap (the "fused" in the reference's design). Local chunk
+    is a copy overlapped with step 1 (:2139)."""
+    world = comm.world_size
+
+    def body(x):
+        rank = lax.axis_index(AXIS)
+        chunks = x.reshape(world, -1)
+        out = jnp.zeros_like(chunks)
+        # self-chunk local copy
+        mine = lax.dynamic_index_in_dim(chunks, rank, axis=0, keepdims=False)
+        out = lax.dynamic_update_index_in_dim(out, mine, rank, axis=0)
+        for s in range(1, world):
+            # rank r sends chunk (r+s)%P to rank (r+s)%P; receives chunk
+            # for slot (r-s)%P from rank (r-s)%P
+            dst_idx = jnp.mod(rank + s, world)
+            buf = lax.dynamic_index_in_dim(chunks, dst_idx, axis=0,
+                                           keepdims=False)
+            wire = _maybe_compress(buf, arith)
+            perm = [(i, (i + s) % world) for i in range(world)]
+            moved = _maybe_decompress(
+                lax.ppermute(wire, AXIS, perm), arith, buf.dtype)
+            src_idx = jnp.mod(rank - s, world)
+            out = lax.dynamic_update_index_in_dim(out, moved, src_idx, axis=0)
+        return out.reshape(1, -1)
+
+    return _smap(comm, body, 1)

@@ -185,6 +185,40 @@ def build_ring_reduce(
     return _smap(comm, body, 2)
 
 
+def build_ring_gather(comm: Communicator, root: int,
+                      arith: Optional[ArithConfig] = None) -> Callable:
+    """Ring-relay gather (fw eager gather :1207-1295): every rank sends its
+    own block then relays ``distance-to-root - 1`` further blocks toward
+    the root, which stores one arriving block per step. P-1 hops on
+    neighbor links only — no long edges, unlike the flat star. Non-root
+    outputs pass through unchanged (reference recvbuf semantics)."""
+    world = comm.world_size
+
+    def body(x, dest):
+        rank = lax.axis_index(AXIS)
+        n = x.shape[-1]
+        out = dest.reshape(world, n)
+        out = jnp.where(rank == root, out.at[root].set(x[0]), out)
+        buf = x[0]
+        perm = [(i, (i - 1) % world) for i in range(world)]  # toward root
+        for s in range(1, world):
+            wire = buf
+            if arith is not None and arith.is_compressing:
+                wire = ops.compress(wire, arith.uncompressed, arith.compressed)
+            moved = lax.ppermute(wire, AXIS, perm)
+            if arith is not None and arith.is_compressing:
+                moved = ops.decompress(
+                    moved, arith.compressed, arith.uncompressed
+                ).astype(buf.dtype)
+            buf = moved  # relay: forward what arrived this step
+            src = (root + s) % world
+            out = jnp.where(rank == root,
+                            out.at[src].set(buf.astype(out.dtype)), out)
+        return out.reshape(1, world * n)
+
+    return _smap(comm, body, 2)
+
+
 def build_ring_bcast(comm: Communicator, root: int,
                      arith: Optional[ArithConfig] = None) -> Callable:
     """Pipelined ring broadcast: root injects, every rank relays to the next
