@@ -745,6 +745,7 @@ class ACCL:
         run_async: bool = False,
         comm: Optional[Communicator] = None,
         compress_dtype: Optional[dataType] = None,
+        algorithm: Optional[Algorithm] = None,
     ) -> Optional[Request]:
         """``ACCL::scatter``: root's ``count*world`` buffer chunked over ranks
         (fw :994-1125)."""
@@ -753,10 +754,16 @@ class ACCL:
         self._check_count(sendbuf, count * world, "scatter send")
         self._check_count(recvbuf, count, "scatter recv")
         arith = self._arith(sendbuf.dtype, compress_dtype)
+        # per-edge payload (each star edge moves `count` elements), matching
+        # the gather/bcast/reduce selection convention
+        algo = algorithms.select(
+            operation.scatter, count * constants.dtype_size(sendbuf.dtype),
+            comm, self.config, algorithm)
         x = self._input(sendbuf, count * world, from_device)
         prog = self._programs.get(
-            self._key(comm, operation.scatter, count, sendbuf.dtype, root, compress_dtype),
-            lambda: primitives.build_scatter(comm, root, arith),
+            self._key(comm, operation.scatter, count, sendbuf.dtype, root,
+                      compress_dtype, algo),
+            lambda: algorithms.build_scatter(comm, root, algo, arith),
         )
         y = prog(x).astype(recvbuf.jnp_dtype)
         self._store(recvbuf, count, y)
@@ -773,6 +780,7 @@ class ACCL:
         run_async: bool = False,
         comm: Optional[Communicator] = None,
         compress_dtype: Optional[dataType] = None,
+        algorithm: Optional[Algorithm] = None,
     ) -> Optional[Request]:
         """``ACCL::gather``: concat all sends at root (fw :1130-1296)."""
         comm = comm or self.comms[0]
@@ -780,11 +788,17 @@ class ACCL:
         self._check_count(sendbuf, count, "gather send")
         self._check_count(recvbuf, count * world, "gather recv")
         arith = self._arith(sendbuf.dtype, compress_dtype)
+        algo = algorithms.select(
+            operation.gather, count * constants.dtype_size(sendbuf.dtype),
+            comm, self.config, algorithm)
+        fanin = (self.config.gather_flat_tree_max_fanin
+                 if algo == Algorithm.FLAT else 0)
         x = self._input(sendbuf, count, from_device)
         r = self._input(recvbuf, count * world, True)
         prog = self._programs.get(
-            self._key(comm, operation.gather, count, sendbuf.dtype, root, compress_dtype),
-            lambda: primitives.build_gather(comm, root, arith),
+            self._key(comm, operation.gather, count, sendbuf.dtype, root,
+                      compress_dtype, algo, fanin),
+            lambda: algorithms.build_gather(comm, root, algo, arith, fanin),
         )
         y = prog(x, r)
         self._store(recvbuf, count * world, y)
@@ -846,14 +860,16 @@ class ACCL:
             raise ACCLError(errorCode.ARITH_ERROR, f"{function} unsupported")
         algo = algorithms.select(
             operation.reduce, count * constants.dtype_size(sendbuf.dtype),
-            comm, self.config, algorithm)
+            comm, self.config, algorithm, count=count)
+        fanin = (self.config.gather_flat_tree_max_fanin
+                 if algo == Algorithm.FLAT else 0)
         x = self._input(sendbuf, count, from_device)
         r = self._input(recvbuf, count, True)
         prog = self._programs.get(
             self._key(comm, operation.reduce, count, sendbuf.dtype, root, function,
-                      compress_dtype, algo),
+                      compress_dtype, algo, fanin),
             lambda: algorithms.build_reduce(
-                comm, root, function, sendbuf.dtype, algo, arith),
+                comm, root, function, sendbuf.dtype, algo, arith, fanin),
         )
         y = prog(x, r)
         self._store(recvbuf, count, y)
@@ -883,12 +899,14 @@ class ACCL:
             operation.allreduce, count * constants.dtype_size(sendbuf.dtype),
             comm, self.config, algorithm)
         x = self._input(sendbuf, count, from_device)
+        fanin = (self.config.gather_flat_tree_max_fanin
+                 if algo == Algorithm.FLAT else 0)
         prog = self._programs.get(
             self._key(comm, operation.allreduce, count, sendbuf.dtype, function,
-                      compress_dtype, algo, self.config.segment_size),
+                      compress_dtype, algo, self.config.segment_size, fanin),
             lambda: algorithms.build_allreduce(
                 comm, function, sendbuf.dtype, algo, arith,
-                self.config.segment_size),
+                self.config.segment_size, fanin),
         )
         y = prog(x).astype(recvbuf.jnp_dtype)
         self._store(recvbuf, count, y)
@@ -940,6 +958,7 @@ class ACCL:
         run_async: bool = False,
         comm: Optional[Communicator] = None,
         compress_dtype: Optional[dataType] = None,
+        algorithm: Optional[Algorithm] = None,
     ) -> Optional[Request]:
         """``ACCL::alltoall`` (fw :2123-2218)."""
         comm = comm or self.comms[0]
@@ -947,10 +966,15 @@ class ACCL:
         self._check_count(sendbuf, count * world, "alltoall send")
         self._check_count(recvbuf, count * world, "alltoall recv")
         arith = self._arith(sendbuf.dtype, compress_dtype)
+        # per-edge payload: each of the P fused trees moves `count` elements
+        algo = algorithms.select(
+            operation.alltoall, count * constants.dtype_size(sendbuf.dtype),
+            comm, self.config, algorithm)
         x = self._input(sendbuf, count * world, from_device)
         prog = self._programs.get(
-            self._key(comm, operation.alltoall, count, sendbuf.dtype, compress_dtype),
-            lambda: primitives.build_alltoall(comm, arith),
+            self._key(comm, operation.alltoall, count, sendbuf.dtype,
+                      compress_dtype, algo),
+            lambda: algorithms.build_alltoall(comm, algo, arith),
         )
         y = prog(x).astype(recvbuf.jnp_dtype)
         self._store(recvbuf, count * world, y)

@@ -61,9 +61,12 @@ def select(
     ``ccl_offload_control.c:816`` bcast, ``:1533`` reduce)."""
     algo = requested or cfg.algorithm
     if algo != Algorithm.AUTO:
-        if not supported(op, algo):
+        if supported(op, algo):
+            return algo
+        if requested is not None:
             raise ValueError(f"{algo} not supported for {op.name}")
-        return algo
+        # a global cfg.algorithm preference that this op cannot honor falls
+        # through to AUTO resolution rather than poisoning unrelated ops
     world = comm.world_size
     if world == 1:
         return Algorithm.XLA
@@ -86,6 +89,10 @@ def select(
             return (Algorithm.FLAT
                     if world <= cfg.reduce_flat_tree_max_ranks or small
                     else Algorithm.TREE)
+        if op in (operation.scatter, operation.gather, operation.alltoall):
+            # fw rendezvous scatter/gather/alltoall are all flat-tree
+            # families (:1011-1081, :1144-1206, :2123-2218)
+            return Algorithm.FLAT
     return Algorithm.XLA
 
 
@@ -106,15 +113,43 @@ def _reject_pallas_compression(arith: Optional[ArithConfig]) -> None:
 
 def build_bcast(comm, root: int, algo: Algorithm,
                 arith: Optional[ArithConfig]) -> Callable:
+    if algo == Algorithm.FLAT:
+        return flat.build_flat_bcast(comm, root, arith)
     if algo == Algorithm.TREE:
         return tree.build_tree_bcast(comm, root, arith)
     if algo == Algorithm.RING:
         return ring.build_ring_bcast(comm, root, arith)
-    return primitives.build_bcast(comm, root, arith)  # XLA / FLAT one-shot
+    return primitives.build_bcast(comm, root, arith)
+
+
+def build_scatter(comm, root: int, algo: Algorithm,
+                  arith: Optional[ArithConfig]) -> Callable:
+    if algo == Algorithm.FLAT:
+        return flat.build_flat_scatter(comm, root, arith)
+    return primitives.build_scatter(comm, root, arith)
+
+
+def build_gather(comm, root: int, algo: Algorithm,
+                 arith: Optional[ArithConfig], fanin: int = 0) -> Callable:
+    if algo == Algorithm.FLAT:
+        return flat.build_flat_gather(comm, root, arith, fanin)
+    if algo == Algorithm.RING:
+        return ring.build_ring_gather(comm, root, arith)
+    return primitives.build_gather(comm, root, arith)
+
+
+def build_alltoall(comm, algo: Algorithm,
+                   arith: Optional[ArithConfig]) -> Callable:
+    if algo == Algorithm.FLAT:
+        return flat.build_flat_alltoall(comm, arith)
+    return primitives.build_alltoall(comm, arith)
 
 
 def build_reduce(comm, root: int, func: reduceFunction, dt: dataType,
-                 algo: Algorithm, arith: Optional[ArithConfig]) -> Callable:
+                 algo: Algorithm, arith: Optional[ArithConfig],
+                 fanin: int = 0) -> Callable:
+    if algo == Algorithm.FLAT:
+        return flat.build_flat_reduce(comm, root, func, dt, arith, fanin)
     if algo == Algorithm.TREE:
         return tree.build_tree_reduce(comm, root, func, dt, arith)
     if algo == Algorithm.RING:
@@ -124,11 +159,14 @@ def build_reduce(comm, root: int, func: reduceFunction, dt: dataType,
 
 def build_allreduce(comm, func: reduceFunction, dt: dataType, algo: Algorithm,
                     arith: Optional[ArithConfig],
-                    segment_bytes: Optional[int] = None) -> Callable:
+                    segment_bytes: Optional[int] = None,
+                    fanin: int = 0) -> Callable:
     if algo == Algorithm.PALLAS:
         _reject_pallas_compression(arith)
         return pallas_ring.build_pallas_ring_allreduce(
             comm, func, dt, segment_bytes)
+    if algo == Algorithm.FLAT:
+        return flat.build_flat_allreduce(comm, func, dt, arith, fanin)
     if algo == Algorithm.RING:
         return ring.build_ring_allreduce(comm, func, dt, arith)
     if algo == Algorithm.TREE:

@@ -14,10 +14,12 @@ SPMD re-expression: "out-of-order arrival" has no analog under a static
 schedule, but the *shape* of the tree does — every transfer is a direct
 (root, peer) edge, never a relay. Each edge is one single-pair
 ``ppermute``; edges within a throttle round carry no data dependence, so
-XLA is free to overlap them, while ``lax.optimization_barrier`` between
-rounds enforces the reference's bounded fan-in/fan-out
-(``GATHER_FLAT_TREE_MAX_FANIN``): at most ``fanin`` transfers are
-schedulable concurrently at the root.
+XLA is free to overlap them, while ``lax.optimization_barrier`` over BOTH
+the accumulator and the send operand between rounds enforces the
+reference's bounded fan-in (``GATHER_FLAT_TREE_MAX_FANIN``): at most
+``fanin`` transfers are schedulable concurrently at the root. Bcast and
+scatter are unthrottled single-round stars, matching the firmware's
+out-of-order root fanout (no fanout register exists in the reference).
 
 Distinct from both the XLA one-shot (single fused collective) and the
 binary tree (log-depth relays) — selectable via ``Algorithm.FLAT`` and
@@ -34,83 +36,57 @@ from ..arithconfig import ArithConfig
 from ..communicator import Communicator
 from ..constants import dataType, reduceFunction
 from .. import ops
-from .primitives import AXIS, _smap
-
-
-def _maybe_compress(buf, arith: Optional[ArithConfig]):
-    if arith is not None and arith.is_compressing:
-        return ops.compress(buf, arith.uncompressed, arith.compressed)
-    return buf
-
-
-def _maybe_decompress(buf, arith: Optional[ArithConfig], dtype):
-    if arith is not None and arith.is_compressing:
-        return ops.decompress(buf, arith.compressed,
-                              arith.uncompressed).astype(dtype)
-    return buf
+from .primitives import AXIS, _smap, _unwire, _wire
 
 
 def _edge(buf, src: int, dst: int, arith: Optional[ArithConfig]):
     """One direct (src, dst) edge of the star: a single-pair ppermute with
     per-edge wire compression (ETH_COMPRESSED semantics)."""
-    wire = _maybe_compress(buf, arith)
-    return _maybe_decompress(
-        lax.ppermute(wire, AXIS, [(src, dst)]), arith, buf.dtype)
+    return _unwire(lax.ppermute(_wire(buf, arith), AXIS, [(src, dst)]),
+                   arith, buf.dtype)
+
+
+def _peers(world: int, root: int):
+    return [(root + i) % world for i in range(1, world)]
 
 
 def _rounds(world: int, root: int, fanin: int):
     """Peers grouped into throttle rounds of at most ``fanin`` edges."""
-    peers = [(root + i) % world for i in range(1, world)]
+    peers = _peers(world, root)
     fanin = max(int(fanin), 1)
     return [peers[i : i + fanin] for i in range(0, len(peers), fanin)]
 
 
 def build_flat_bcast(comm: Communicator, root: int,
-                     arith: Optional[ArithConfig] = None,
-                     fanout: int = 0) -> Callable:
-    """Root serves every rank directly (fw :871-921). ``fanout`` bounds the
-    edges in flight per round (0 = unthrottled, one round)."""
+                     arith: Optional[ArithConfig] = None) -> Callable:
+    """Root serves every rank directly in one star round (fw :871-921)."""
     world = comm.world_size
-    rounds = _rounds(world, root, fanout or world)
 
     def body(x):
         rank = lax.axis_index(AXIS)
         buf = x[0]
-        for peers in rounds:
-            received = []
-            for dst in peers:
-                moved = _edge(buf, root, dst, arith)
-                received.append((dst, moved))
-            for dst, moved in received:
-                buf = jnp.where(rank == dst, moved.astype(buf.dtype), buf)
-            # round boundary: later rounds must not be hoisted across
-            buf = lax.optimization_barrier(buf)
+        for dst in _peers(world, root):
+            moved = _edge(buf, root, dst, arith)
+            buf = jnp.where(rank == dst, moved.astype(buf.dtype), buf)
         return buf[None, :]
 
     return _smap(comm, body, 1)
 
 
 def build_flat_scatter(comm: Communicator, root: int,
-                       arith: Optional[ArithConfig] = None,
-                       fanout: int = 0) -> Callable:
+                       arith: Optional[ArithConfig] = None) -> Callable:
     """Out-of-order rendezvous scatter (fw :1011-1081): the root sends each
     rank its chunk directly; the self-chunk is a local copy overlapped with
     the sends (:1040). Input (world*count,) per rank; output (count,)."""
     world = comm.world_size
-    rounds = _rounds(world, root, fanout or world)
 
     def body(x):
         rank = lax.axis_index(AXIS)
         chunks = x.reshape(world, -1)
         out = chunks[root]  # root's self-copy; non-roots overwritten below
-        for peers in rounds:
-            received = []
-            for dst in peers:
-                moved = _edge(chunks[dst], root, dst, arith)
-                received.append((dst, moved))
-            for dst, moved in received:
-                out = jnp.where(rank == dst, moved.astype(out.dtype), out)
-            out = lax.optimization_barrier(out)
+        for dst in _peers(world, root):
+            moved = _edge(chunks[dst], root, dst, arith)
+            out = jnp.where(rank == dst, moved.astype(out.dtype), out)
         return out[None, :]
 
     return _smap(comm, body, 1)
@@ -141,7 +117,9 @@ def build_flat_gather(comm: Communicator, root: int,
             for src, moved in received:
                 upd = out.at[src].set(moved.astype(out.dtype))
                 out = jnp.where(rank == root, upd, out)
-            out = lax.optimization_barrier(out)
+            # round boundary: barrier the send operand too, so the next
+            # round's edges cannot be hoisted above this one (the throttle)
+            x, out = lax.optimization_barrier((x, out))
         return out.reshape(1, world * n)
 
     return _smap(comm, body, 2)
@@ -170,7 +148,7 @@ def build_flat_reduce(comm: Communicator, root: int, func: reduceFunction,
             for moved in received:
                 folded = ops.combine(acc, moved, func, dt)
                 acc = jnp.where(rank == root, folded, acc)
-            acc = lax.optimization_barrier(acc)
+            send, acc = lax.optimization_barrier((send, acc))
         out = jnp.where(rank == root, acc.astype(recv.dtype), recv[0])
         return out[None, :]
 
@@ -194,7 +172,7 @@ def build_flat_allreduce(comm: Communicator, func: reduceFunction,
             for moved in received:
                 folded = ops.combine(acc, moved, func, dt)
                 acc = jnp.where(rank == 0, folded, acc)
-            acc = lax.optimization_barrier(acc)
+            x, acc = lax.optimization_barrier((x, acc))
         for peers in red_rounds:
             received = [(dst, _edge(acc, 0, dst, arith)) for dst in peers]
             for dst, moved in received:
@@ -227,10 +205,9 @@ def build_flat_alltoall(comm: Communicator,
             dst_idx = jnp.mod(rank + s, world)
             buf = lax.dynamic_index_in_dim(chunks, dst_idx, axis=0,
                                            keepdims=False)
-            wire = _maybe_compress(buf, arith)
             perm = [(i, (i + s) % world) for i in range(world)]
-            moved = _maybe_decompress(
-                lax.ppermute(wire, AXIS, perm), arith, buf.dtype)
+            moved = _unwire(lax.ppermute(_wire(buf, arith), AXIS, perm),
+                            arith, buf.dtype)
             src_idx = jnp.mod(rank - s, world)
             out = lax.dynamic_update_index_in_dim(out, moved, src_idx, axis=0)
         return out.reshape(1, -1)

@@ -42,12 +42,14 @@ def _fwd_perm(world: int):
     return [(i, (i + 1) % world) for i in range(world)]
 
 
-def _hop(buf: Array, world: int, arith: Optional[ArithConfig]) -> Array:
-    """One ring hop: compress -> ppermute to next rank -> decompress."""
+def _hop(buf: Array, world: int, arith: Optional[ArithConfig],
+         perm=None) -> Array:
+    """One ring hop: compress -> ppermute (next rank unless ``perm``
+    overrides the direction) -> decompress."""
     orig_dtype = buf.dtype
     if arith is not None and arith.is_compressing:
         buf = ops.compress(buf, arith.uncompressed, arith.compressed)
-    moved = lax.ppermute(buf, AXIS, _fwd_perm(world))
+    moved = lax.ppermute(buf, AXIS, perm or _fwd_perm(world))
     if arith is not None and arith.is_compressing:
         moved = ops.decompress(moved, arith.compressed, arith.uncompressed)
         moved = moved.astype(orig_dtype)
@@ -202,15 +204,7 @@ def build_ring_gather(comm: Communicator, root: int,
         buf = x[0]
         perm = [(i, (i - 1) % world) for i in range(world)]  # toward root
         for s in range(1, world):
-            wire = buf
-            if arith is not None and arith.is_compressing:
-                wire = ops.compress(wire, arith.uncompressed, arith.compressed)
-            moved = lax.ppermute(wire, AXIS, perm)
-            if arith is not None and arith.is_compressing:
-                moved = ops.decompress(
-                    moved, arith.compressed, arith.uncompressed
-                ).astype(buf.dtype)
-            buf = moved  # relay: forward what arrived this step
+            buf = _hop(buf, world, arith, perm)  # relay what arrived
             src = (root + s) % world
             out = jnp.where(rank == root,
                             out.at[src].set(buf.astype(out.dtype)), out)

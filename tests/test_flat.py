@@ -1,0 +1,195 @@
+"""Flat-tree family (SURVEY.md §2.6; VERDICT round-1 items 3/5): out-of-order
+root-centric star schedules with fan-in throttling, distinct from the XLA
+one-shot and the binary tree. Mirrors the reference's rendezvous flat-tree
+paths (``ccl_offload_control.c:871-921, :1011-1081, :1144-1206, :1533-1602,
+:2123-2218``).
+"""
+import numpy as np
+import pytest
+
+from accl_tpu import Algorithm, dataType, reduceFunction
+from accl_tpu.constants import operation
+from accl_tpu.parallel import algorithms
+
+WORLD = 8
+
+
+def _fill(rng, shape, dt):
+    import accl_tpu.constants as c
+    nd = np.dtype(c.to_jax_dtype(dt))
+    if np.issubdtype(nd, np.floating):
+        return rng.standard_normal(shape).astype(nd)
+    return rng.integers(-100, 100, shape).astype(nd)
+
+
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_flat_bcast(accl, rng, root):
+    count, dt = 40, dataType.float32
+    buf = accl.create_buffer(count, dt)
+    buf.host[:] = _fill(rng, (WORLD, count), dt)
+    rootdata = buf.host[root].copy()
+    accl.bcast(buf, count, root, algorithm=Algorithm.FLAT)
+    for r in range(WORLD):
+        np.testing.assert_array_equal(buf.host[r], rootdata)
+
+
+@pytest.mark.parametrize("root", [0, 5])
+def test_flat_scatter(accl, rng, root):
+    count, dt = 16, dataType.int32
+    send = accl.create_buffer(count * WORLD, dt)
+    recv = accl.create_buffer(count, dt)
+    send.host[:] = _fill(rng, (WORLD, count * WORLD), dt)
+    accl.scatter(send, recv, count, root, algorithm=Algorithm.FLAT)
+    for r in range(WORLD):
+        np.testing.assert_array_equal(
+            recv.host[r], send.host[root, r * count:(r + 1) * count])
+
+
+@pytest.mark.parametrize("algo", [Algorithm.FLAT, Algorithm.RING])
+@pytest.mark.parametrize("root", [0, 4])
+def test_gather_algorithms(accl, rng, algo, root):
+    """FLAT star gather and the eager ring-relay gather (fw :1207-1295)."""
+    count, dt = 24, dataType.int32
+    send = accl.create_buffer(count, dt)
+    recv = accl.create_buffer(count * WORLD, dt)
+    send.host[:] = _fill(rng, (WORLD, count), dt)
+    prior = _fill(rng, (WORLD, count * WORLD), dt)
+    recv.host[:] = prior
+    accl.gather(send, recv, count, root, algorithm=algo)
+    np.testing.assert_array_equal(recv.host[root], send.host.reshape(-1))
+    for r in range(WORLD):
+        if r != root:
+            np.testing.assert_array_equal(recv.host[r], prior[r])
+
+
+@pytest.mark.parametrize("fanin", [1, 2, 3, 8])
+def test_flat_gather_fanin_throttle(accl, rng, fanin):
+    """GATHER_FLAT_TREE_MAX_FANIN: any throttle width gives the same result."""
+    count, dt = 16, dataType.int32
+    prior = accl.config.gather_flat_tree_max_fanin
+    accl.config.gather_flat_tree_max_fanin = fanin
+    try:
+        send = accl.create_buffer(count, dt)
+        recv = accl.create_buffer(count * WORLD, dt)
+        send.host[:] = _fill(rng, (WORLD, count), dt)
+        accl.gather(send, recv, count, 2, algorithm=Algorithm.FLAT)
+        np.testing.assert_array_equal(recv.host[2], send.host.reshape(-1))
+    finally:
+        accl.config.gather_flat_tree_max_fanin = prior
+
+
+@pytest.mark.parametrize("root", [0, 6])
+@pytest.mark.parametrize("func", [reduceFunction.SUM, reduceFunction.MAX])
+def test_flat_reduce(accl, rng, root, func):
+    count, dt = 48, dataType.int32
+    send = accl.create_buffer(count, dt)
+    recv = accl.create_buffer(count, dt)
+    send.host[:] = _fill(rng, (WORLD, count), dt)
+    prior = _fill(rng, (WORLD, count), dt)
+    recv.host[:] = prior
+    accl.reduce(send, recv, count, root, func, algorithm=Algorithm.FLAT)
+    expect = send.host.sum(0) if func == reduceFunction.SUM else send.host.max(0)
+    np.testing.assert_array_equal(recv.host[root], expect)
+    for r in range(WORLD):
+        if r != root:
+            np.testing.assert_array_equal(recv.host[r], prior[r])
+
+
+@pytest.mark.parametrize("func", [reduceFunction.SUM, reduceFunction.MAX])
+def test_flat_allreduce(accl, rng, func):
+    count, dt = 32, dataType.int32
+    send = accl.create_buffer(count, dt)
+    recv = accl.create_buffer(count, dt)
+    send.host[:] = _fill(rng, (WORLD, count), dt)
+    accl.allreduce(send, recv, count, func, algorithm=Algorithm.FLAT)
+    expect = send.host.sum(0) if func == reduceFunction.SUM else send.host.max(0)
+    for r in range(WORLD):
+        np.testing.assert_array_equal(recv.host[r], expect)
+
+
+def test_flat_alltoall(accl, rng):
+    """P fused simultaneous flat trees (fw :2123-2218)."""
+    count, dt = 8, dataType.int32
+    send = accl.create_buffer(count * WORLD, dt)
+    recv = accl.create_buffer(count * WORLD, dt)
+    send.host[:] = _fill(rng, (WORLD, count * WORLD), dt)
+    accl.alltoall(send, recv, count, algorithm=Algorithm.FLAT)
+    for r in range(WORLD):
+        expect = np.concatenate(
+            [send.host[s, r * count:(r + 1) * count] for s in range(WORLD)])
+        np.testing.assert_array_equal(recv.host[r], expect)
+
+
+def test_flat_bcast_compressed(accl, rng):
+    """Per-edge wire compression (ETH_COMPRESSED) on the star edges."""
+    count, dt = 64, dataType.float32
+    buf = accl.create_buffer(count, dt)
+    buf.host[:] = _fill(rng, (WORLD, count), dt)
+    rootdata = buf.host[0].copy()
+    accl.bcast(buf, count, 0, compress_dtype=dataType.bfloat16,
+               algorithm=Algorithm.FLAT)
+    # one bf16-rounded hop root->peer
+    for r in range(WORLD):
+        np.testing.assert_allclose(buf.host[r], rootdata, rtol=0.02, atol=0.02)
+
+
+def test_flat_distinct_from_xla(accl, rng):
+    """FLAT must compile a distinct program, not alias the XLA one-shot
+    (VERDICT weak #3)."""
+    count, dt = 16, dataType.int32
+    buf = accl.create_buffer(count, dt)
+    buf.host[:] = _fill(rng, (WORLD, count), dt)
+    accl.bcast(buf, count, 0, algorithm=Algorithm.FLAT)
+    accl.bcast(buf, count, 0, algorithm=Algorithm.XLA)
+    keys = [k for k in accl._programs._cache
+            if isinstance(k, tuple) and operation.bcast in k]
+    flat_keys = [k for k in keys if Algorithm.FLAT in k]
+    xla_keys = [k for k in keys if Algorithm.XLA in k]
+    assert flat_keys and xla_keys and flat_keys != xla_keys
+
+
+def test_rendezvous_selection_flat_family(accl):
+    """AUTO in the rendezvous regime routes through the flat-tree knobs
+    (fw flat-vs-tree thresholds :816, :1533; scatter/gather/alltoall are
+    flat-tree-only in the rendezvous paths)."""
+    cfg = accl.config
+    comm = accl.global_comm()
+    big = cfg.max_eager_size + 4096  # rendezvous regime, below RING threshold
+
+    assert algorithms.select(operation.bcast, big, comm, cfg) == Algorithm.FLAT
+    assert algorithms.select(operation.scatter, big, comm, cfg) == Algorithm.FLAT
+    assert algorithms.select(operation.gather, big, comm, cfg) == Algorithm.FLAT
+    assert algorithms.select(operation.alltoall, big, comm, cfg) == Algorithm.FLAT
+
+    # above the flat-tree world limit the tree takes over (BCAST_FLAT_TREE_MAX_RANKS)
+    try:
+        cfg.bcast_flat_tree_max_ranks = 4
+        assert algorithms.select(operation.bcast, big, comm, cfg) == Algorithm.TREE
+    finally:
+        cfg.bcast_flat_tree_max_ranks = 8
+
+    # reduce: small counts go flat regardless of world (REDUCE_FLAT_TREE_MAX_COUNT)
+    try:
+        cfg.reduce_flat_tree_max_ranks = 4
+        assert algorithms.select(operation.reduce, big, comm, cfg,
+                                 count=16) == Algorithm.FLAT
+        assert algorithms.select(
+            operation.reduce, big, comm, cfg,
+            count=cfg.reduce_flat_tree_max_count + 1) == Algorithm.TREE
+    finally:
+        cfg.reduce_flat_tree_max_ranks = 8
+
+    # eager-regime small payloads stay on the XLA one-shot
+    assert algorithms.select(operation.gather, 1024, comm, cfg) == Algorithm.XLA
+
+
+def test_global_algorithm_unsupported_falls_back(accl):
+    """A global cfg.algorithm an op can't honor resolves like AUTO instead of
+    raising — only an explicit per-call request is rejected."""
+    cfg = accl.config.replace(algorithm=Algorithm.TREE)
+    comm = accl.global_comm()
+    # scatter has no TREE variant: global preference falls back, XLA for small
+    assert algorithms.select(operation.scatter, 1024, comm, cfg) == Algorithm.XLA
+    # explicit request still raises
+    with pytest.raises(ValueError):
+        algorithms.select(operation.scatter, 1024, comm, cfg, Algorithm.TREE)
