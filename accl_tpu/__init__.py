@@ -23,13 +23,12 @@ from .constants import (
     ACCLError,
     ACCLTimeoutError,
     TAG_ANY,
+    cfgFunc,
     compressionFlags,
     dataType,
     errorCode,
-    hostFlags,
     operation,
     reduceFunction,
-    streamFlags,
 )
 from .request import Request, RequestQueue, requestStatus
 from .utils import Timer
@@ -55,12 +54,11 @@ __all__ = [
     "TAG_ANY",
     "Timer",
     "TransportBackend",
+    "cfgFunc",
     "compressionFlags",
     "dataType",
     "errorCode",
-    "hostFlags",
     "operation",
     "reduceFunction",
     "requestStatus",
-    "streamFlags",
 ]

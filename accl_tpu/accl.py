@@ -98,6 +98,9 @@ class ACCL:
         self.comms.append(comm)
         self._matchers[id(comm)] = MatchingEngine(
             comm, rx_buffer_count=self.config.eager_rx_buffer_count)
+        # native per-request timing registry (PERFCNT analog) when the C++
+        # runtime backs the session
+        self._reqreg = self._matchers[id(comm)]._native
         self._fabric = None
         if comm.is_multiprocess:
             from .multiproc import CrossProcessFabric
@@ -164,6 +167,36 @@ class ACCL:
     def set_max_rendezvous_size(self, nbytes: int) -> None:
         self.config = self.config.replace(max_rendezvous_size=nbytes)
 
+    def config_call(self, function: constants.cfgFunc,
+                    value: Optional[float] = None) -> None:
+        """Housekeeping config call (``CCLO::Options.cfg_function`` →
+        fw HOUSEKEEP_* dispatch, ccl_offload_control.c:2416-2451)."""
+        cf = constants.cfgFunc
+        if function in (cf.set_timeout, cf.set_max_eager_size,
+                        cf.set_max_rendezvous_size) and value is None:
+            raise ACCLError(
+                errorCode.CONFIG_ERROR,
+                f"{function.name} requires a value")
+        if function == cf.reset_periph:
+            self.soft_reset()
+        elif function == cf.enable_pkt:
+            # packetizer/depacketizer/rx-offload engines (fw :101-122) have
+            # no TPU analog to start: transports are live once the mesh is
+            pass
+        elif function == cf.set_timeout:
+            self.set_timeout(float(value))
+        elif function == cf.set_max_eager_size:
+            self.set_max_eager_size(int(value))
+        elif function == cf.set_max_rendezvous_size:
+            self.set_max_rendezvous_size(int(value))
+        else:
+            # open_port/open_con/close_con: session management dissolved
+            # into the mesh definition (SURVEY.md §2.7) — nothing to open
+            raise ACCLError(
+                errorCode.CONFIG_ERROR,
+                f"{function.name}: transport sessions are mesh axes on TPU; "
+                "no dynamic session management exists")
+
     # ------------------------------------------------------------------
     # buffers / communicators
     # ------------------------------------------------------------------
@@ -206,6 +239,22 @@ class ACCL:
     # ------------------------------------------------------------------
     # internal op plumbing
     # ------------------------------------------------------------------
+
+    def _check_rendezvous_size(self, nbytes: int, compressing: bool,
+                               what: str) -> None:
+        """Cap rendezvous messages at ``max_rendezvous_size`` — the
+        HOUSEKEEP_RENDEZVOUS_MAX_SIZE register (fw :2442-2447): a rendezvous
+        message is a single unsegmented move, so payloads beyond the cap
+        have no protocol to ride."""
+        if compressing:
+            return  # compressed payloads take the (segmented) eager path
+        if (nbytes > self.config.max_eager_size
+                and nbytes > self.config.max_rendezvous_size):
+            raise ACCLError(
+                errorCode.INVALID_BUFFER_SIZE,
+                f"{what}: {nbytes} B exceeds max_rendezvous_size "
+                f"{self.config.max_rendezvous_size} B (raise it via "
+                f"set_max_rendezvous_size)")
 
     def _check_count(self, buf: BaseBuffer, count: int, what: str) -> None:
         if buf.is_dummy:
@@ -254,13 +303,15 @@ class ACCL:
         outputs,
         to_device: bool,
         run_async: bool,
+        comm: Optional[Communicator] = None,
     ) -> Optional[Request]:
         def finalizer(_req: Request) -> None:
             if out_buf is not None and not to_device:
                 out_buf.sync_from_device()
 
         req = Request(scenario.name, outputs=outputs, finalizer=finalizer,
-                      on_complete=self._queue.retire)
+                      on_complete=self._queue.retire, comm=comm,
+                      native_registry=self._reqreg)
         self._queue.push(req)
         if run_async:
             return req
@@ -295,7 +346,7 @@ class ACCL:
         )
         y = prog(x).astype(dstbuf.jnp_dtype)
         self._store(dstbuf, count, y)
-        return self._finish(operation.copy, dstbuf, y, to_device, run_async)
+        return self._finish(operation.copy, dstbuf, y, to_device, run_async, comm)
 
     def combine(
         self,
@@ -328,7 +379,7 @@ class ACCL:
         )
         y = prog(a, b).astype(result.jnp_dtype)
         self._store(result, count, y)
-        return self._finish(operation.combine, result, y, to_device, run_async)
+        return self._finish(operation.combine, result, y, to_device, run_async, comm)
 
     # ------------------------------------------------------------------
     # two-sided send / recv + one-sided put
@@ -343,25 +394,39 @@ class ACCL:
         return [(off, min(seg_elems, count - off))
                 for off in range(0, count, seg_elems)]
 
-    def _pump(self) -> None:
+    def _pump(self) -> bool:
         """Run the cooperative scheduler: retry parked calls, each resuming
         from its ``current_step`` (wait_for_call round-robin + retry queue,
-        ccl_offload_control.c:2264-2288, :2460-2478)."""
-        for _ in range(len(self._parked_calls) + 1):
-            popped = self._sched.pop()
-            if popped is None:
-                return
-            call_id, step = popped
-            cont = self._parked_calls.get(call_id)
-            if cont is None:
-                continue
-            new_step = cont(step)
-            if new_step is None:
-                del self._parked_calls[call_id]
-            else:
-                self._sched.push_retry(call_id, new_step)
-                if new_step == step:
-                    return  # no progress possible; stop spinning
+        ccl_offload_control.c:2264-2288, :2460-2478). Keeps making full
+        passes over the parked calls until one whole pass yields no
+        progress — a single stuck continuation must not starve the others.
+        Returns whether any continuation progressed (drives wait() backoff).
+        """
+        any_progress = False
+        while True:
+            n = len(self._parked_calls)
+            if n == 0:
+                return any_progress
+            progressed = False
+            for _ in range(n):
+                popped = self._sched.pop()
+                if popped is None:
+                    return any_progress or progressed
+                call_id, step = popped
+                cont = self._parked_calls.get(call_id)
+                if cont is None:
+                    continue
+                new_step = cont(step)
+                if new_step is None:
+                    del self._parked_calls[call_id]
+                    progressed = True
+                else:
+                    self._sched.push_retry(call_id, new_step)
+                    if new_step != step:
+                        progressed = True
+            if not progressed:
+                return any_progress
+            any_progress = True
 
     # -- cross-process two-sided path (multiproc fabric) -------------------
 
@@ -388,13 +453,14 @@ class ACCL:
             data = data.astype(
                 np.dtype(constants.to_jax_dtype(arith.compressed)))
         nbytes = count * constants.dtype_size(srcbuf.dtype)
+        self._check_rendezvous_size(nbytes, compressing, "cross-process send")
         if nbytes > self.config.max_eager_size and not compressing:
             self._fabric.send_rendezvous(src, dst, tag, data)
         else:
             seg_elems = max(self.config.eager_rx_buffer_size
                             // constants.dtype_size(srcbuf.dtype), 1)
             self._fabric.send_eager(src, dst, tag, data, seg_elems)
-        return self._finish(operation.send, None, data, True, False)
+        return self._finish(operation.send, None, data, True, False, comm)
 
     def _cross_recv(self, dstbuf, count, src, dst, tag, to_device,
                     run_async, comm, compress_dtype) -> Optional[Request]:
@@ -414,7 +480,7 @@ class ACCL:
         # the fabric recv follows whichever the wire shows
         vals = self._fabric.recv(src, dst, tag, count, np_dtype)
         dstbuf.store_rank_local(dst, vals)
-        return self._finish(operation.recv, None, vals, to_device, False)
+        return self._finish(operation.recv, None, vals, to_device, False, comm)
 
     def send(
         self,
@@ -458,12 +524,13 @@ class ACCL:
         matcher = self.matcher(comm)
         nbytes = count * constants.dtype_size(srcbuf.dtype)
         compressing = arith is not None and arith.is_compressing
+        self._check_rendezvous_size(nbytes, compressing, "send")
         if nbytes > self.config.max_eager_size and not compressing:
             # rendezvous: one zero-copy post, no rx buffer (fw :595-612;
             # compressed messages always take the eager path, like the fw)
             post = SendPost(src=src, dst=dst, tag=tag, data=data, count=count)
             matcher.post_send(post)
-            return self._finish(operation.send, None, data, True, run_async)
+            return self._finish(operation.send, None, data, True, run_async, comm)
         return self._eager_send(matcher, data, count, srcbuf.dtype,
                                 src, dst, tag, run_async)
 
@@ -490,7 +557,7 @@ class ACCL:
                 return False
             post = SendPost(src=src, dst=dst, tag=tag,
                             data=data[:, off:off + ln], count=ln,
-                            rx_slot=slot)
+                            rx_slot=slot, eom=(i == len(segs) - 1))
             try:
                 matcher.post_send(post)
             except Exception:
@@ -509,6 +576,21 @@ class ACCL:
             drained = (matcher.outbound_seq(src, dst)
                        == matcher.inbound_seq(src, dst))
             need = 1 if (cap >= count and drained) else len(segs)
+            if need > matcher.rx_pool.size:
+                # cannot succeed in THIS state: the message needs more slots
+                # than the pool owns, so retrying without a state change
+                # spins forever (large compressed sends hit this most —
+                # compression forces the eager path, fw parity). Recoverable
+                # once a full-capacity recv is posted and the pair drains
+                # (need collapses to 1), hence still NOT_READY.
+                raise ACCLError(
+                    errorCode.NOT_READY_ERROR,
+                    f"eager message needs {need} rx-buffer slots but the "
+                    f"pool only has {matcher.rx_pool.size}; this send cannot "
+                    f"proceed until a full-capacity recv is posted and the "
+                    f"pair drains — or raise config.eager_rx_buffer_count/"
+                    f"eager_rx_buffer_size, or (for uncompressed payloads) "
+                    f"lower max_eager_size to use rendezvous")
             if matcher.rx_pool.free_slots < need:
                 raise ACCLError(
                     errorCode.NOT_READY_ERROR,
@@ -524,11 +606,13 @@ class ACCL:
                         errorCode.DMA_NOT_OKAY_ERROR,
                         f"eager send {src}->{dst}: pool slot vanished at "
                         f"segment {i}/{len(segs)}")
-            return self._finish(operation.send, None, data, True, False)
+            return self._finish(operation.send, None, data, True, False,
+                                matcher.comm)
 
         # async: post what fits now, park the rest with current_step
         req = Request(operation.send.name, outputs=data, external=True,
-                      on_complete=self._queue.retire, progress=self._pump)
+                      on_complete=self._queue.retire, progress=self._pump,
+                      comm=matcher.comm, native_registry=self._reqreg)
         self._queue.push(req)
 
         def continue_from(step: int) -> Optional[int]:
@@ -593,6 +677,7 @@ class ACCL:
         collected: list = []
         assembled: list = []
         pending_req: list = []
+        parked_sync: list = []  # sync recv raised NOT_READY but stayed posted
 
         def assemble() -> jax.Array:
             """Message complete: one move program writes the receiver's
@@ -619,6 +704,12 @@ class ACCL:
                 assembled.append(moved)
                 if pending_req:
                     pending_req[0].fulfill(outputs=moved)
+                elif parked_sync and not to_device:
+                    # a sync recv that parked after partial delivery has no
+                    # request handle to run the finalizer — sync the host
+                    # mirror here so dstbuf.host is fresh on completion
+                    jax.block_until_ready(moved)
+                    dstbuf.sync_from_device()
 
         post = RecvPost(src=src, dst=dst, tag=tag, count=count,
                         deliver=deliver)
@@ -639,14 +730,20 @@ class ACCL:
                 if collected:
                     # segments were consumed — keep the recv parked so the
                     # delivered data is not lost; it completes (and writes
-                    # dstbuf) when the remaining segments arrive, like a
-                    # NOT_READY call resuming from current_step. Do NOT
-                    # re-post: this recv stays active.
+                    # dstbuf, syncing the host mirror) when the remaining
+                    # segments arrive, like a NOT_READY call resuming from
+                    # current_step. Do NOT re-post: this recv stays active.
+                    parked_sync.append(True)
+                    boundary = (" (the delivered data ends exactly at a "
+                                "message boundary — count mismatch if the "
+                                "sender is done)"
+                                if collected[-1].eom else "")
                     raise ACCLError(
                         errorCode.NOT_READY_ERROR,
                         f"recv {dst}<-{src} tag={tag}: "
                         f"{count - post.remaining}/{count} elements arrived; "
-                        f"recv remains posted and resumes as segments arrive")
+                        f"recv remains posted and resumes as segments "
+                        f"arrive{boundary}")
                 matcher.remove_recv(post)
                 raise ACCLError(
                     errorCode.NOT_READY_ERROR,
@@ -654,7 +751,7 @@ class ACCL:
                 )
             return self._finish(operation.recv, dstbuf,
                                 assembled[0] if assembled else None,
-                                to_device, False)
+                                to_device, False, comm)
 
         # async: park; request completes when the last segment lands
         def finalizer(_req: Request) -> None:
@@ -663,7 +760,8 @@ class ACCL:
 
         req = Request(operation.recv.name, outputs=None, finalizer=finalizer,
                       external=True, on_complete=self._queue.retire,
-                      progress=self._pump)
+                      progress=self._pump, comm=comm,
+                      native_registry=self._reqreg)
         pending_req.append(req)
         try:
             self._queue.push(req)
@@ -699,7 +797,7 @@ class ACCL:
         )
         moved = prog(x.astype(dest.dtype), dest)
         self._store(dstbuf, count, moved)
-        return self._finish(operation.put, dstbuf, moved, to_device, run_async)
+        return self._finish(operation.put, dstbuf, moved, to_device, run_async, comm)
 
     # ------------------------------------------------------------------
     # collectives
@@ -732,7 +830,7 @@ class ACCL:
         )
         y = prog(x)
         self._store(buf, count, y)
-        return self._finish(operation.bcast, buf, y, to_device, run_async)
+        return self._finish(operation.bcast, buf, y, to_device, run_async, comm)
 
     def scatter(
         self,
@@ -767,7 +865,7 @@ class ACCL:
         )
         y = prog(x).astype(recvbuf.jnp_dtype)
         self._store(recvbuf, count, y)
-        return self._finish(operation.scatter, recvbuf, y, to_device, run_async)
+        return self._finish(operation.scatter, recvbuf, y, to_device, run_async, comm)
 
     def gather(
         self,
@@ -802,7 +900,7 @@ class ACCL:
         )
         y = prog(x, r)
         self._store(recvbuf, count * world, y)
-        return self._finish(operation.gather, recvbuf, y, to_device, run_async)
+        return self._finish(operation.gather, recvbuf, y, to_device, run_async, comm)
 
     def allgather(
         self,
@@ -835,7 +933,7 @@ class ACCL:
         )
         y = prog(x).astype(recvbuf.jnp_dtype)
         self._store(recvbuf, count * world, y)
-        return self._finish(operation.allgather, recvbuf, y, to_device, run_async)
+        return self._finish(operation.allgather, recvbuf, y, to_device, run_async, comm)
 
     def reduce(
         self,
@@ -873,7 +971,7 @@ class ACCL:
         )
         y = prog(x, r)
         self._store(recvbuf, count, y)
-        return self._finish(operation.reduce, recvbuf, y, to_device, run_async)
+        return self._finish(operation.reduce, recvbuf, y, to_device, run_async, comm)
 
     def allreduce(
         self,
@@ -910,7 +1008,7 @@ class ACCL:
         )
         y = prog(x).astype(recvbuf.jnp_dtype)
         self._store(recvbuf, count, y)
-        return self._finish(operation.allreduce, recvbuf, y, to_device, run_async)
+        return self._finish(operation.allreduce, recvbuf, y, to_device, run_async, comm)
 
     def reduce_scatter(
         self,
@@ -946,7 +1044,7 @@ class ACCL:
         )
         y = prog(x).astype(recvbuf.jnp_dtype)
         self._store(recvbuf, count, y)
-        return self._finish(operation.reduce_scatter, recvbuf, y, to_device, run_async)
+        return self._finish(operation.reduce_scatter, recvbuf, y, to_device, run_async, comm)
 
     def alltoall(
         self,
@@ -978,7 +1076,7 @@ class ACCL:
         )
         y = prog(x).astype(recvbuf.jnp_dtype)
         self._store(recvbuf, count * world, y)
-        return self._finish(operation.alltoall, recvbuf, y, to_device, run_async)
+        return self._finish(operation.alltoall, recvbuf, y, to_device, run_async, comm)
 
     def barrier(self, comm: Optional[Communicator] = None) -> None:
         """``ACCL::barrier`` (fw :2078-2120): flush outstanding work, then a
@@ -988,7 +1086,10 @@ class ACCL:
         zero-byte notification gather/scatter analog) on top of the
         device-level psum, which every controller enters SPMD."""
         comm = comm or self.comms[0]
-        self._queue.drain(timeout=self.config.timeout)
+        # flush only THIS communicator's traffic — a sub-communicator
+        # barrier must not block on unrelated communicators (reference
+        # barrier flushes per-communicator seqn state, fw :2081-2090)
+        self._queue.drain(timeout=self.config.timeout, comm=comm)
         prog = self._programs.get(
             self._key(comm, operation.barrier),
             lambda: primitives.build_barrier(comm),

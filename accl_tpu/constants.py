@@ -162,12 +162,11 @@ class errorCode(enum.IntFlag):
     TIMEOUT_ERROR = 1 << 23
 
 
-class streamFlags(enum.IntFlag):
-    """Operand stream flags (constants.hpp streamFlags)."""
-
-    NO_STREAM = 0
-    OP0_STREAM = 1 << 0
-    RES_STREAM = 1 << 1
+# NOTE: the reference's streamFlags / hostFlags operand descriptors
+# (constants.hpp) are deliberately NOT mirrored here: a "stream" operand is
+# a device-resident value (``from_device``/``to_device`` flags and the
+# device_api in-kernel path), and host residency is the Buffer host<->device
+# mirror — both dissolved into the call signatures (SURVEY.md §7).
 
 
 class compressionFlags(enum.IntFlag):
@@ -185,18 +184,9 @@ class compressionFlags(enum.IntFlag):
     ETH_COMPRESSED = 1 << 3
 
 
-class hostFlags(enum.IntFlag):
-    """Operand host-residency flags (constants.hpp hostFlags)."""
-
-    NO_HOST = 0
-    OP0_HOST = 1 << 0
-    OP1_HOST = 1 << 1
-    RES_HOST = 1 << 2
-
-
-#: Any-source / any-tag wildcards (constants.hpp TAG_ANY).
+#: Any-tag wildcard (constants.hpp:35 TAG_ANY; the reference has no
+#: any-source wildcard — matching is always on an explicit src rank).
 TAG_ANY = 0xFFFF_FFFF
-ANY_SOURCE = -1
 
 
 class ACCLError(Exception):

@@ -43,11 +43,20 @@ class Request:
                  finalizer: Optional[Callable[["Request"], None]] = None,
                  external: bool = False,
                  on_complete: Optional[Callable[["Request"], None]] = None,
-                 progress: Optional[Callable[[], None]] = None):
+                 progress: Optional[Callable[[], None]] = None,
+                 comm: Any = None,
+                 native_registry: Any = None):
         with Request._id_lock:
             Request._next_id += 1
             self.id = Request._next_id
         self.scenario = scenario
+        #: communicator the call was issued on (comm-scoped barrier drains)
+        self.comm = comm
+        # when the native C++ runtime backs the session, per-request timing
+        # and retcode live in its request registry (the PERFCNT/RETCODE
+        # exchange-memory analog, csrc/acclrt.cpp req_*)
+        self._nreg = native_registry
+        self._nid = native_registry.req_create() if native_registry else None
         self.status = requestStatus.QUEUED
         self.retcode = errorCode.COLLECTIVE_OP_SUCCESS
         self._outputs = outputs          # jax arrays to block on
@@ -76,7 +85,6 @@ class Request:
         with self._cv:
             if self._done:
                 return
-            self._duration_ns = time.monotonic_ns() - self._start_ns
             self._error = error
             if error is None:
                 self.status = requestStatus.COMPLETED
@@ -84,6 +92,15 @@ class Request:
                 self.status = requestStatus.ERROR
                 if isinstance(error, ACCLError):
                     self.retcode = error.code
+            if self._nid is not None:
+                # native registry stamps the completion time and keeps the
+                # retcode; read the authoritative duration back from it
+                self._nreg.req_complete(self._nid, int(self.retcode))
+                self._duration_ns = self._nreg.req_duration_ns(self._nid)
+                self._nreg.req_free(self._nid)
+                self._nid = None
+            else:
+                self._duration_ns = time.monotonic_ns() - self._start_ns
             self._done = True
             self._cv.notify_all()
         if self._on_complete is not None:
@@ -113,16 +130,23 @@ class Request:
         """Block until done (CCLO::wait / BaseRequest::wait analog)."""
         if self._external:
             # wait for fulfill() from a future matching post, pumping the
-            # cooperative scheduler so parked operations can finish
+            # cooperative scheduler so parked operations can finish. The
+            # poll interval backs off exponentially while pumps make no
+            # progress (idle waits park on the CV instead of spinning) and
+            # snaps back to fast polling the moment anything moves.
             deadline = ((time.monotonic() + timeout)
                         if timeout is not None else None)
+            interval = 0.005
             while True:
                 if self._progress is not None:
-                    self._progress()
+                    if self._progress():
+                        interval = 0.005
+                    else:
+                        interval = min(interval * 2, 0.25)
                 with self._cv:
                     if self._cv.wait_for(
                         lambda: self._done or not self._external,
-                        timeout=0.005 if self._progress else timeout,
+                        timeout=interval if self._progress else timeout,
                     ):
                         break
                     if self._progress is None:
@@ -169,9 +193,23 @@ class Request:
 
     def get_duration_ns(self) -> int:
         """Per-call duration (FPGADevice::get_duration / PERFCNT analog)."""
-        if self._duration_ns is None:
+        # snapshot under the CV: _complete() frees the native id concurrently
+        with self._cv:
+            if self._duration_ns is not None:
+                return self._duration_ns
+            if self._nid is not None:
+                return self._nreg.req_duration_ns(self._nid)
             return time.monotonic_ns() - self._start_ns
-        return self._duration_ns
+
+    def __del__(self):
+        # a request observed only through test() never reaches _complete():
+        # release its native registry entry so long sessions don't leak
+        try:
+            if self._nid is not None:
+                self._nreg.req_free(self._nid)
+                self._nid = None
+        except Exception:  # pragma: no cover - interpreter teardown
+            pass
 
     def __repr__(self) -> str:
         return f"Request(id={self.id}, op={self.scenario}, status={self.status.name})"
@@ -195,12 +233,15 @@ class RequestQueue:
             self._inflight.append(req)
         return req
 
-    def drain(self, timeout: Optional[float] = None) -> None:
+    def drain(self, timeout: Optional[float] = None, comm: Any = None) -> None:
         """Wait for everything issued so far (flush, like barrier's retry-queue
         flush in ccl_offload_control.c:2081-2090). Requests already failed or
-        cancelled are skipped — their error surfaces on the caller's wait()."""
+        cancelled are skipped — their error surfaces on the caller's wait().
+        With ``comm``, only that communicator's requests are flushed — a
+        sub-communicator barrier must not block on unrelated traffic."""
         with self._lock:
-            pending = list(self._inflight)
+            pending = [r for r in self._inflight
+                       if comm is None or r.comm is None or r.comm is comm]
         for r in pending:
             if r.status == requestStatus.ERROR:
                 continue

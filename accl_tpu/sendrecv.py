@@ -46,6 +46,12 @@ class SendPost:
     seqn: int = -1          # assigned by the matching engine at post time
     on_matched: Optional[Callable] = None  # completes the sender's request
     rx_slot: int = -1       # eager rx-buffer pool slot held while parked
+    #: end-of-message marker: True for rendezvous posts and the eager tail
+    #: segment. A recv parked right after consuming an eom segment sits at a
+    #: message boundary — a likely count mismatch if the sender is done
+    #: (surfaced in the NOT_READY diagnostic; recvs MAY legally span
+    #: messages, so this is a hint, not a matching rule)
+    eom: bool = True
 
 
 @dataclasses.dataclass

@@ -1,0 +1,89 @@
+"""Config-call surface + request semantics added in the round-2 cleanup:
+``cfgFunc`` dispatch (fw HOUSEKEEP_*, ccl_offload_control.c:2416-2451),
+``max_rendezvous_size`` enforcement, comm-scoped barrier drains, and
+native-registry-backed request durations.
+"""
+import numpy as np
+import pytest
+
+from accl_tpu import ACCLError, cfgFunc, dataType, errorCode
+
+WORLD = 8
+
+
+def test_config_call_dispatch(accl):
+    orig_timeout = accl.config.timeout
+    orig_eager = accl.config.max_eager_size
+    orig_rndzv = accl.config.max_rendezvous_size
+    try:
+        accl.config_call(cfgFunc.set_timeout, 12.5)
+        assert accl.config.timeout == 12.5
+        accl.config_call(cfgFunc.set_max_eager_size, 1 << 14)
+        assert accl.config.max_eager_size == 1 << 14
+        accl.config_call(cfgFunc.set_max_rendezvous_size, 1 << 20)
+        assert accl.config.max_rendezvous_size == 1 << 20
+        accl.config_call(cfgFunc.enable_pkt)  # no-op, must not raise
+        accl.config_call(cfgFunc.reset_periph)  # routes to soft_reset
+    finally:
+        accl.set_timeout(orig_timeout)
+        accl.set_max_eager_size(orig_eager)
+        accl.set_max_rendezvous_size(orig_rndzv)
+
+
+@pytest.mark.parametrize("func", [cfgFunc.open_port, cfgFunc.open_con,
+                                  cfgFunc.close_con])
+def test_config_call_sessions_rejected(accl, func):
+    """Transport sessions dissolved into mesh axes: dynamic session calls
+    are refused loudly (SURVEY.md §2.7)."""
+    with pytest.raises(ACCLError) as ei:
+        accl.config_call(func, 0)
+    assert ei.value.code == errorCode.CONFIG_ERROR
+
+
+def test_max_rendezvous_size_enforced(accl, rng):
+    """A rendezvous message larger than max_rendezvous_size has no protocol
+    to ride — rejected up front (HOUSEKEEP_RENDEZVOUS_MAX_SIZE register)."""
+    count = 16 * 1024  # 64 KiB of f32 > 32 KiB eager threshold
+    send = accl.create_buffer(count, dataType.float32)
+    send.host[:] = rng.standard_normal((WORLD, count)).astype(np.float32)
+    orig = accl.config.max_rendezvous_size
+    accl.set_max_rendezvous_size(48 * 1024)
+    try:
+        with pytest.raises(ACCLError) as ei:
+            accl.send(send, count, src=0, dst=1, tag=5)
+        assert ei.value.code == errorCode.INVALID_BUFFER_SIZE
+        # raising the cap unblocks the same send
+        accl.set_max_rendezvous_size(orig)
+        accl.send(send, count, src=0, dst=1, tag=5)
+        recv = accl.create_buffer(count, dataType.float32)
+        accl.recv(recv, count, src=0, dst=1, tag=5)
+        np.testing.assert_array_equal(recv.host[1], send.host[0])
+    finally:
+        accl.set_max_rendezvous_size(orig)
+
+
+def test_barrier_is_comm_scoped(accl, rng):
+    """A sub-communicator barrier must not block on unrelated communicators'
+    traffic (VERDICT round-1 weak #7): with an unmatched async recv parked on
+    the global comm, barrier(sub) completes; the parked request stays alive."""
+    sub = accl.create_communicator([0, 1, 2, 3])
+    buf = accl.create_buffer(64, dataType.float32)
+    parked = accl.recv(buf, 64, src=5, dst=6, tag=77, run_async=True)
+    try:
+        assert not parked.test()
+        accl.barrier(sub)  # would deadlock/timeout if it drained globally
+        assert not parked.test()  # untouched by the scoped drain
+    finally:
+        parked.cancel()
+
+
+def test_request_duration_and_comm_tag(accl, rng):
+    """Requests carry their communicator and a positive duration (PERFCNT
+    analog — native-registry-backed when the C++ runtime is loaded)."""
+    src = accl.create_buffer(128, dataType.float32)
+    dst = accl.create_buffer(128, dataType.float32)
+    src.host[:] = rng.standard_normal((WORLD, 128)).astype(np.float32)
+    req = accl.copy(src, dst, 128, run_async=True)
+    req.wait()
+    assert req.comm is accl.global_comm()
+    assert req.get_duration_ns() > 0

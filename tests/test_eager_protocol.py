@@ -269,11 +269,14 @@ def test_partial_sync_recv_keeps_data_and_completes(small):
         small.recv(r, 40, src=0, dst=1, tag=4)
     assert e.value.code == errorCode.NOT_READY_ERROR
     assert "16/40" in str(e.value)
-    # remaining 24 elements arrive; the parked recv absorbs them and
-    # writes dstbuf on the spot
+    # the delivered 16 elements were a complete message: the diagnostic
+    # flags the possible count mismatch (eom boundary hint)
+    assert "message boundary" in str(e.value)
+    # remaining 24 elements arrive; the parked recv absorbs them, writes
+    # dstbuf AND syncs the host mirror itself (no manual sync_from_device)
     small.send(s.slice(16, 40), 24, src=0, dst=1, tag=4)
-    r.sync_from_device()
     np.testing.assert_allclose(r.host[1][:16], s.host[0][:16])
+    np.testing.assert_allclose(r.host[1][16:], s.host[0][16:])
     assert small.matcher().n_pending == (0, 0)
 
 
