@@ -674,33 +674,35 @@ class ACCL:
         matcher = self.matcher(comm)
         _ = self._arith(dstbuf.dtype, compress_dtype)  # validate the pair
 
-        collected: list = []
         assembled: list = []
         pending_req: list = []
         parked_sync: list = []  # sync recv raised NOT_READY but stayed posted
-
-        def assemble() -> jax.Array:
-            """Message complete: one move program writes the receiver's
-            shard (segment concat = rx-buffer reassembly)."""
-            spost0 = collected[0]
-            wire = (collected[0].data if len(collected) == 1
-                    else jnp.concatenate([p.data for p in collected], axis=1))
-            prog = self._programs.get(
-                self._key(comm, operation.send, count, dstbuf.dtype,
-                          spost0.src, spost0.dst),
-                lambda: primitives.build_move(comm, spost0.src, spost0.dst),
-            )
-            dest = self._input(dstbuf, count, True)
-            moved = prog(wire.astype(dest.dtype), dest)
-            self._store(dstbuf, count, moved)
-            return moved
+        seg_off = [0]           # elements delivered so far (write cursor)
+        n_delivered = [0]       # segments delivered (current_step analog)
+        last_eom = [False]      # last delivered segment ended its message
 
         def deliver(spost: SendPost) -> None:
-            collected.append(spost)
+            """One arriving segment = one move program writing it into the
+            receiver's shard at its offset (per-segment MOVE_ON_RECV +
+            MOVE_STRIDE, fw :680-711): a partially-arrived message is
+            progressively visible in dstbuf on device, which is what lets
+            the rx-pool backpressure pipeline senders into parked recvs.
+            The segment's device snapshot is dropped once written — the
+            recv holds no payload while parked."""
+            n_delivered[0] += 1
+            last_eom[0] = spost.eom
+            off, seg_off[0] = seg_off[0], seg_off[0] + spost.count
+            prog = self._programs.get(
+                self._key(comm, operation.recv, "move_at",
+                          spost.src, spost.dst),
+                lambda: primitives.build_move_at(comm, spost.src, spost.dst),
+            )
+            dest = self._input(dstbuf, count, True)
+            moved = prog(spost.data, dest, off)
+            self._store(dstbuf, count, moved)
             if pending_req:
-                pending_req[0].current_step = len(collected)
-            if sum(p.count for p in collected) == count:
-                moved = assemble()
+                pending_req[0].current_step = n_delivered[0]
+            if seg_off[0] == count:
                 assembled.append(moved)
                 if pending_req:
                     pending_req[0].fulfill(outputs=moved)
@@ -727,7 +729,7 @@ class ACCL:
                 if not done and post.remaining == before:
                     break  # no progress possible
             if not done:
-                if collected:
+                if seg_off[0] > 0:
                     # segments were consumed — keep the recv parked so the
                     # delivered data is not lost; it completes (and writes
                     # dstbuf, syncing the host mirror) when the remaining
@@ -737,7 +739,7 @@ class ACCL:
                     boundary = (" (the delivered data ends exactly at a "
                                 "message boundary — count mismatch if the "
                                 "sender is done)"
-                                if collected[-1].eom else "")
+                                if last_eom[0] else "")
                     raise ACCLError(
                         errorCode.NOT_READY_ERROR,
                         f"recv {dst}<-{src} tag={tag}: "

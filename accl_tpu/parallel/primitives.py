@@ -37,15 +37,16 @@ from .. import ops
 AXIS = Communicator.AXIS
 
 
-def _smap(comm: Communicator, fn, n_in: int, out_specs=None):
-    in_specs = tuple(P(AXIS) for _ in range(n_in))
+def _smap(comm: Communicator, fn, n_in: int, out_specs=None, in_specs=None):
+    if in_specs is None:
+        in_specs = tuple(P(AXIS) for _ in range(n_in))
     # check_vma=False: Pallas plugin kernels inside program bodies don't carry
     # varying-mesh-axis annotations; our programs manage replication manually.
     return jax.jit(
         shard_map(
             fn,
             mesh=comm.mesh,
-            in_specs=in_specs if n_in > 1 else in_specs[0],
+            in_specs=in_specs if len(in_specs) > 1 else in_specs[0],
             out_specs=out_specs if out_specs is not None else P(AXIS),
             check_vma=False,
         )
@@ -120,6 +121,29 @@ def build_move(comm: Communicator, src: int, dst: int) -> Callable:
         return jnp.where(keep, moved.astype(dest.dtype), dest)
 
     return _smap(comm, body, 2)
+
+
+def build_move_at(comm: Communicator, src: int, dst: int) -> Callable:
+    """Per-segment eager move: write ``src``'s segment into ``dst``'s shard
+    of ``dest`` at element offset ``off``.
+
+    The MOVE_STRIDE + MOVE_ON_RECV per-segment delivery of the firmware's
+    eager recv loop (``ccl_offload_control.c:680-711``): each arriving
+    segment lands in the destination buffer immediately, so a partially
+    arrived message is progressively visible on device instead of being
+    assembled in one move at completion. ``off`` is traced (one compiled
+    program serves every offset; only distinct segment shapes retrace).
+    """
+
+    def body(seg, dest, off):
+        moved = lax.ppermute(seg, AXIS, [(src, dst)])
+        off = jnp.asarray(off, jnp.int32)
+        upd = lax.dynamic_update_slice(
+            dest, moved.astype(dest.dtype), (jnp.int32(0), off))
+        keep = (_rank() == dst)
+        return jnp.where(keep, upd, dest)
+
+    return _smap(comm, body, 3, in_specs=(P(AXIS), P(AXIS), P()))
 
 
 # --------------------------------------------------------------------------
