@@ -236,6 +236,14 @@ class ACCL:
     def matcher(self, comm: Optional[Communicator] = None) -> MatchingEngine:
         return self._matchers[id(comm or self.comms[0])]
 
+    def command_list(self, comm: Optional[Communicator] = None):
+        """Record collective calls and run them as ONE device launch — the
+        hostctrl command-stream / PL-kernel chained-command analog
+        (:mod:`accl_tpu.cmdlist`): per-launch dispatch is paid once per
+        sequence instead of once per op."""
+        from .cmdlist import CommandList
+        return CommandList(self, comm)
+
     # ------------------------------------------------------------------
     # internal op plumbing
     # ------------------------------------------------------------------

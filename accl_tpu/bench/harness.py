@@ -168,6 +168,53 @@ def _time_block(prog, args, reps: int) -> float:
     return float(np.median(ts))
 
 
+def time_fused(prog, args, adapt=None, nbytes: int = 0,
+               est_bw: float = 700e9, target_s: float = 0.25) -> float:
+    """Per-op device time with the chain INSIDE one jitted program
+    (``lax.fori_loop``): one launch per measurement, so host dispatch —
+    ~100 µs/launch through a tunneled runtime — is excluded entirely.
+    This is the closest analog of the reference's PERFCNT device-cycle
+    accounting (``fpgadevice.cpp:241-248``), and the measurement mode the
+    CommandList fusion path actually runs under."""
+    from jax import lax
+
+    rest = args[1:]
+
+    def make(k: int):
+        def chained(x):
+            def body(_, v):
+                out = prog(v, *rest)
+                return adapt(out) if adapt is not None else out
+            return lax.fori_loop(0, k, body, x)
+        return jax.jit(chained)
+
+    est = max(3 * nbytes / est_bw, 2e-6)
+    k_long = int(min(max(target_s / est, 64), 8192))
+    k_short = max(k_long // 8, 8)
+    long_f, short_f = make(k_long), make(k_short)
+
+    def run(f) -> float:
+        float(np.asarray(_pick(jax.block_until_ready(f(args[0])))))  # warm
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(np.asarray(_pick(jax.block_until_ready(f(args[0])))))
+            ts.append(time.perf_counter() - t0)
+        # min, not median: each sample is one launch of a fixed device
+        # program, so the fastest observation has the least tunnel noise
+        # in it — the standard latency-floor estimator
+        return float(np.min(ts))
+
+    t_short = run(short_f)
+    t_long = run(long_f)
+    per = (t_long - t_short) / (k_long - k_short)
+    # tunnel-RTT noise can make the two chains indistinguishable; never
+    # report better than the long chain's amortized per-op rate (which
+    # still includes one launch RTT spread over k_long ops — an upper
+    # bound on true device per-op time, so reporting it is conservative)
+    return max(per, t_long / (k_long + 1), 1e-9)
+
+
 def time_chain(prog, args, adapt=None, nbytes: int = 0,
                est_bw: float = 700e9, target_s: float = 0.5) -> float:
     """Per-op device time from two dependent chains + one forced readback
@@ -230,6 +277,8 @@ def run_sweep(
                       else n * dtype_size(dt))
             if mode == "chain":
                 t = time_chain(prog, args, case.chain_adapt, nbytes)
+            elif mode == "fused":
+                t = time_fused(prog, args, case.chain_adapt, nbytes)
             else:
                 t = _time_block(prog, args, reps)
             eff = models.efficiency(case.op, comm.world_size, nbytes, t,

@@ -24,11 +24,16 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 # The environment may pin JAX_PLATFORMS to a TPU plugin (e.g. "axon");
-# the config update below overrides it for the test process.
-jax.config.update("jax_platforms", "cpu")
-# float64/int64 collectives are part of the ported matrix (the reference's
-# arith plugin covers f64/i64); on CPU we test them at full width.
-jax.config.update("jax_enable_x64", True)
+# the config update below overrides it for the test process. Setting
+# ACCL_TPU_HW=1 keeps the real TPU backend instead — the hardware rung of
+# the test ladder (tests/test_tpu_hardware.py; everything else still runs
+# wherever it can).
+if not os.environ.get("ACCL_TPU_HW"):
+    jax.config.update("jax_platforms", "cpu")
+    # float64/int64 collectives are part of the ported matrix (the
+    # reference's arith plugin covers f64/i64); on CPU we test them at
+    # full width.
+    jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,100 @@
+"""CommandList: fused multi-op sequences (hostctrl command-stream analog,
+``hostctrl.cpp:22-63`` / ``accl_hls.h:82-496`` chained ACCLCommand) — one
+device launch per recorded sequence, the dispatch-latency attack.
+"""
+import numpy as np
+import pytest
+
+from accl_tpu import ACCLError, dataType, errorCode, reduceFunction
+
+WORLD = 8
+
+
+def _ints(rng, shape):
+    return rng.integers(-50, 50, shape).astype(np.int32)
+
+
+def test_cmdlist_chain_matches_per_op_calls(accl, rng):
+    """A fused allreduce→combine→bcast→allgather chain produces exactly what
+    the per-op calls produce."""
+    x = accl.create_buffer(64, dataType.int32)
+    y = accl.create_buffer(64, dataType.int32)
+    g = accl.create_buffer(64 * WORLD, dataType.int32)
+    x0, y0 = _ints(rng, (WORLD, 64)), _ints(rng, (WORLD, 64))
+    x.host[:] = x0; x.sync_to_device()
+    y.host[:] = y0; y.sync_to_device()
+
+    cl = accl.command_list()
+    cl.allreduce(x, x, 64, reduceFunction.SUM)
+    cl.combine(64, reduceFunction.MAX, x, y, y)
+    cl.bcast(y, 64, 2)
+    cl.allgather(y, g, 64)
+    assert len(cl) == 4
+    cl.execute()
+
+    ar = np.tile(x0.sum(0), (WORLD, 1))
+    comb = np.maximum(ar, y0)
+    bc = np.tile(comb[2], (WORLD, 1))
+    np.testing.assert_array_equal(x.host, ar)
+    np.testing.assert_array_equal(y.host, bc)
+    np.testing.assert_array_equal(g.host, np.tile(bc.reshape(-1), (WORLD, 1)))
+
+
+def test_cmdlist_one_program_launch(accl, rng):
+    """The whole list is ONE cached composite program; re-execution is a
+    cache hit (the per-launch dispatch is paid once per sequence)."""
+    x = accl.create_buffer(32, dataType.float32)
+    x.host[:] = rng.standard_normal((WORLD, 32)).astype(np.float32)
+    x.sync_to_device()
+    cl = accl.command_list()
+    cl.allreduce(x, x, 32, reduceFunction.SUM)
+    cl.bcast(x, 32, 0)
+    cl.execute()
+    size0, hits0, _ = accl._programs.stats()
+    cl.execute()
+    size1, hits1, _ = accl._programs.stats()
+    assert size1 == size0            # no new programs compiled
+    assert hits1 > hits0             # composite came from the cache
+
+
+def test_cmdlist_reduce_scatter_and_reduce(accl, rng):
+    s = accl.create_buffer(16 * WORLD, dataType.int32)
+    r = accl.create_buffer(16, dataType.int32)
+    rr = accl.create_buffer(16, dataType.int32)
+    s0 = _ints(rng, (WORLD, 16 * WORLD))
+    s.host[:] = s0; s.sync_to_device()
+    rr.host[:] = 0; rr.sync_to_device()
+    cl = accl.command_list()
+    cl.reduce_scatter(s, r, 16, reduceFunction.SUM)
+    cl.reduce(r, rr, 16, 3, reduceFunction.MAX)
+    cl.execute()
+    rs = np.stack([s0[:, k * 16:(k + 1) * 16].sum(0) for k in range(WORLD)])
+    np.testing.assert_array_equal(r.host, rs)
+    np.testing.assert_array_equal(rr.host[3], rs.max(0))
+
+
+def test_cmdlist_async_execute(accl, rng):
+    x = accl.create_buffer(32, dataType.float32)
+    x.host[:] = rng.standard_normal((WORLD, 32)).astype(np.float32)
+    x.sync_to_device()
+    expect = np.tile(x.host.sum(0), (WORLD, 1))
+    cl = accl.command_list()
+    cl.allreduce(x, x, 32, reduceFunction.SUM)
+    req = cl.execute(sync=False)
+    req.wait()
+    np.testing.assert_allclose(np.asarray(x.device_view()), expect,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_cmdlist_rejects_partial_counts_and_dummies(accl):
+    x = accl.create_buffer(64, dataType.float32)
+    cl = accl.command_list()
+    with pytest.raises(ACCLError) as ei:
+        cl.bcast(x, 32, 0)
+    assert ei.value.code == errorCode.INVALID_BUFFER_SIZE
+    with pytest.raises(ACCLError):
+        cl.copy(accl.dummy_buffer(), x, 64)
+
+
+def test_cmdlist_empty_execute_is_noop(accl):
+    assert accl.command_list().execute() is None

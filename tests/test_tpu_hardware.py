@@ -1,0 +1,144 @@
+"""Hardware rung of the test ladder: real-TPU tests (SURVEY.md §4 rungs
+3-4, the axis3x / cluster analog).
+
+Run with ``ACCL_TPU_HW=1 pytest tests/test_tpu_hardware.py`` — the env var
+keeps the real TPU backend instead of the CPU emulator mesh. Tests gate
+themselves on what the attached hardware provides:
+
+* single-chip tests (Pallas plugin lanes, datapath) run on any TPU;
+* multi-chip tests (Pallas ring kernels over real ICI, transport detect,
+  device-initiated collectives) skip unless ≥2 chips are attached — the
+  suite is ready the day multi-chip hardware appears (VERDICT round-1
+  item 9); under the default CPU emulator every test here skips.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import accl_tpu
+from accl_tpu import Algorithm, TransportBackend, dataType, reduceFunction
+
+on_tpu = jax.default_backend() == "tpu"
+n_chips = len(jax.devices()) if on_tpu else 0
+
+tpu_only = pytest.mark.skipif(not on_tpu, reason="needs a real TPU backend")
+multichip = pytest.mark.skipif(
+    n_chips < 2, reason=f"needs >=2 TPU chips, have {n_chips}")
+
+
+@pytest.fixture(scope="module")
+def hw_accl():
+    inst = accl_tpu.ACCL()
+    yield inst
+    inst.deinit()
+
+
+# ---------------------------------------------------------------------------
+# single-chip: plugin lanes + datapath on real silicon
+# ---------------------------------------------------------------------------
+
+@tpu_only
+def test_pallas_reduce_lane_on_chip(hw_accl):
+    """The reduce_ops Pallas lane compiles and is exact on real TPU."""
+    w = hw_accl.world_size
+    a = hw_accl.create_buffer(4096, dataType.float32)
+    b = hw_accl.create_buffer(4096, dataType.float32)
+    r = hw_accl.create_buffer(4096, dataType.float32)
+    a.host[:] = np.random.randn(w, 4096).astype(np.float32)
+    b.host[:] = np.random.randn(w, 4096).astype(np.float32)
+    hw_accl.combine(4096, reduceFunction.SUM, a, b, r)
+    np.testing.assert_allclose(r.host, a.host + b.host, rtol=1e-6)
+
+
+@tpu_only
+def test_pallas_compression_lane_on_chip(hw_accl):
+    """The hp_compression cast lane (incl. TPU stochastic rounding path)."""
+    from accl_tpu import ops
+    x = jax.numpy.asarray(np.random.randn(8, 256).astype(np.float32))
+    y = ops.compress(x, dataType.float32, dataType.bfloat16)
+    assert y.dtype == jax.numpy.bfloat16
+    z = ops.decompress(y, dataType.bfloat16, dataType.float32)
+    np.testing.assert_allclose(np.asarray(z), np.asarray(x), atol=0.02,
+                               rtol=0.02)
+
+
+@tpu_only
+def test_transport_detected_on_chip(hw_accl):
+    assert hw_accl.config.transport in (TransportBackend.ICI,
+                                        TransportBackend.DCN)
+    assert hw_accl.parse_hwid()["platform"] == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# multi-chip: real-ICI skeletons (skip until >=2 chips are attached)
+# ---------------------------------------------------------------------------
+
+@multichip
+@pytest.mark.parametrize("algo", [Algorithm.XLA, Algorithm.RING,
+                                  Algorithm.PALLAS])
+def test_allreduce_over_real_ici(hw_accl, algo):
+    """Ring + Pallas allreduce over real ICI links — the collective_id,
+    barrier-semaphore and LOGICAL-device-id choices in pallas_ring are
+    untestable in interpret mode; this is their hardware check."""
+    w = hw_accl.world_size
+    s = hw_accl.create_buffer(8192, dataType.float32)
+    r = hw_accl.create_buffer(8192, dataType.float32)
+    s.host[:] = np.random.randn(w, 8192).astype(np.float32)
+    hw_accl.allreduce(s, r, 8192, reduceFunction.SUM, algorithm=algo)
+    expect = s.host.astype(np.float64).sum(0)
+    for k in range(w):
+        np.testing.assert_allclose(r.host[k], expect, rtol=1e-4, atol=1e-4)
+
+
+@multichip
+def test_chunked_pallas_allreduce_hbm_scale_on_ici(hw_accl):
+    """Grid-chunked double-buffered ring kernels at HBM scale on real
+    hardware (segment streaming with bounded in-flight moves)."""
+    from accl_tpu.parallel import pallas_chunked
+    w = hw_accl.world_size
+    count = 1 << 22  # 16 MiB fp32 per rank
+    comm = hw_accl.global_comm()
+    prog = pallas_chunked.build_chunked_ring_allreduce(
+        comm, reduceFunction.SUM, dataType.float32,
+        hw_accl.config.segment_size)
+    data = np.random.randn(w, count).astype(np.float32)
+    x = jax.device_put(data, comm.sharding())
+    out = np.asarray(prog(x))
+    np.testing.assert_allclose(out[0], data.astype(np.float64).sum(0),
+                               rtol=1e-3, atol=1e-3)
+
+
+@multichip
+def test_sendrecv_over_real_ici(hw_accl):
+    """Two-sided tag-matched path where the move rides a real ICI link."""
+    s = hw_accl.create_buffer(1024, dataType.float32)
+    r = hw_accl.create_buffer(1024, dataType.float32)
+    s.host[:] = np.random.randn(hw_accl.world_size, 1024).astype(np.float32)
+    hw_accl.send(s, 1024, src=0, dst=1, tag=5)
+    hw_accl.recv(r, 1024, src=0, dst=1, tag=5)
+    np.testing.assert_array_equal(r.host[1], s.host[0])
+
+
+@multichip
+def test_device_api_collective_in_kernel_on_ici(hw_accl):
+    """Device-initiated collective (vadd_put analog) on real chips."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from accl_tpu import device_api as dapi
+
+    comm = hw_accl.global_comm()
+    w = comm.world_size
+
+    def kernel(x):
+        return dapi.allreduce(x + 1.0, reduceFunction.SUM)
+
+    prog = jax.jit(shard_map(kernel, mesh=comm.mesh, in_specs=P(dapi.AXIS),
+                             out_specs=P(dapi.AXIS), check_vma=False))
+    data = np.random.randn(w, 512).astype(np.float32)
+    x = jax.device_put(data, comm.sharding())
+    out = np.asarray(prog(x))
+    np.testing.assert_allclose(out[0], (data + 1.0).sum(0), rtol=1e-4,
+                               atol=1e-4)
