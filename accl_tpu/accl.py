@@ -126,6 +126,45 @@ class ACCL:
             else "none",
         }
 
+    def scan(self) -> list:
+        """Per-device topology/memory introspection — the ``xclbin_scan``
+        analog (``driver/xrt/src/xclbin_scan.cpp``: ip_layout discovery of
+        CCLO instances and connectivity; here: device kind, ICI coords,
+        host process and live HBM stats per mesh participant)."""
+        out = []
+        for rank, d in enumerate(self._devices):
+            rec = {
+                "rank": rank,
+                "id": d.id,
+                "platform": d.platform,
+                "kind": getattr(d, "device_kind", d.platform),
+                "process_index": getattr(d, "process_index", 0),
+            }
+            coords = getattr(d, "coords", None)
+            if coords is not None:
+                rec["coords"] = tuple(coords)          # ICI topology position
+                rec["core_on_chip"] = getattr(d, "core_on_chip", 0)
+            try:
+                stats = d.memory_stats()
+                if stats:
+                    rec["bytes_in_use"] = stats.get("bytes_in_use")
+                    rec["bytes_limit"] = stats.get("bytes_limit")
+            except Exception:  # backends without memory stats (CPU)
+                pass
+            out.append(rec)
+        return out
+
+    def profile(self, log_dir: str):
+        """Device-timeline trace over a region — the tracing tier above
+        per-call ``Request.get_duration_ns`` (SURVEY.md §5: PERFCNT gives
+        per-call cycles; xprof gives the full timeline)::
+
+            with acc.profile("/tmp/trace"):
+                acc.allreduce(...)
+
+        View with TensorBoard / xprof."""
+        return jax.profiler.trace(log_dir)
+
     def deinit(self) -> None:
         """Drain outstanding work and drop state (``ACCL::deinit``, accl.cpp:71-89)."""
         self._queue.cancel_externals()
@@ -330,6 +369,95 @@ class ACCL:
         return (id(comm), op, *extra)
 
     # ------------------------------------------------------------------
+    # per-op program specs: (cache key, builder) pairs shared by the
+    # per-op call paths AND CommandList recording, so both always compile
+    # and cache the SAME program for the same logical call — one source
+    # of truth per op, no first-writer-wins divergence
+    # ------------------------------------------------------------------
+
+    def _spec_copy(self, comm, count: int, dtype: dataType):
+        return (self._key(comm, operation.copy, count, dtype),
+                lambda: primitives.build_copy(comm))
+
+    def _spec_combine(self, comm, count: int, dtype: dataType,
+                      function: reduceFunction):
+        use_pallas = self.config.use_pallas and self.config.enable_arith
+        return (self._key(comm, operation.combine, count, dtype, function,
+                          use_pallas),
+                lambda: primitives.build_combine(comm, function, dtype,
+                                                 use_pallas=use_pallas))
+
+    def _spec_bcast(self, comm, count: int, dtype: dataType, root: int,
+                    compress_dtype, algorithm):
+        arith = self._arith(dtype, compress_dtype)
+        algo = algorithms.select(
+            operation.bcast, count * constants.dtype_size(dtype),
+            comm, self.config, algorithm)
+        return (self._key(comm, operation.bcast, count, dtype, root,
+                          compress_dtype, algo),
+                lambda: algorithms.build_bcast(comm, root, algo, arith))
+
+    def _spec_allgather(self, comm, count: int, dtype: dataType,
+                        compress_dtype, algorithm):
+        arith = self._arith(dtype, compress_dtype)
+        algo = algorithms.select(
+            operation.allgather, count * constants.dtype_size(dtype),
+            comm, self.config, algorithm)
+        seg = self.config.segment_size
+        return (self._key(comm, operation.allgather, count, dtype,
+                          compress_dtype, algo, seg),
+                lambda: algorithms.build_allgather(comm, algo, arith, dtype,
+                                                   seg))
+
+    def _spec_reduce(self, comm, count: int, dtype: dataType, root: int,
+                     function: reduceFunction, compress_dtype, algorithm):
+        arith = self._arith(dtype, compress_dtype)
+        if arith is not None and not arith.supports(function):
+            raise ACCLError(errorCode.ARITH_ERROR, f"{function} unsupported")
+        algo = algorithms.select(
+            operation.reduce, count * constants.dtype_size(dtype),
+            comm, self.config, algorithm, count=count)
+        fanin = (self.config.gather_flat_tree_max_fanin
+                 if algo == Algorithm.FLAT else 0)
+        return (self._key(comm, operation.reduce, count, dtype, root,
+                          function, compress_dtype, algo, fanin),
+                lambda: algorithms.build_reduce(comm, root, function, dtype,
+                                                algo, arith, fanin))
+
+    def _spec_allreduce(self, comm, count: int, dtype: dataType,
+                        function: reduceFunction, compress_dtype, algorithm):
+        arith = self._arith(dtype, compress_dtype)
+        if arith is not None and not arith.supports(function):
+            raise ACCLError(errorCode.ARITH_ERROR, f"{function} unsupported")
+        algo = algorithms.select(
+            operation.allreduce, count * constants.dtype_size(dtype),
+            comm, self.config, algorithm)
+        fanin = (self.config.gather_flat_tree_max_fanin
+                 if algo == Algorithm.FLAT else 0)
+        seg = self.config.segment_size
+        return (self._key(comm, operation.allreduce, count, dtype, function,
+                          compress_dtype, algo, seg, fanin),
+                lambda: algorithms.build_allreduce(comm, function, dtype,
+                                                   algo, arith, seg, fanin))
+
+    def _spec_reduce_scatter(self, comm, count: int, dtype: dataType,
+                             function: reduceFunction, compress_dtype,
+                             algorithm):
+        arith = self._arith(dtype, compress_dtype)
+        if arith is not None and not arith.supports(function):
+            raise ACCLError(errorCode.ARITH_ERROR, f"{function} unsupported")
+        algo = algorithms.select(
+            operation.reduce_scatter,
+            count * comm.world_size * constants.dtype_size(dtype),
+            comm, self.config, algorithm)
+        seg = self.config.segment_size
+        return (self._key(comm, operation.reduce_scatter, count, dtype,
+                          function, compress_dtype, algo, seg),
+                lambda: algorithms.build_reduce_scatter(comm, function,
+                                                        dtype, algo, arith,
+                                                        seg))
+
+    # ------------------------------------------------------------------
     # primitives: copy / combine
     # ------------------------------------------------------------------
 
@@ -348,10 +476,7 @@ class ACCL:
         self._check_count(srcbuf, count, "copy src")
         self._check_count(dstbuf, count, "copy dst")
         x = self._input(srcbuf, count, from_device)
-        prog = self._programs.get(
-            self._key(comm, operation.copy, count, srcbuf.dtype),
-            lambda: primitives.build_copy(comm),
-        )
+        prog = self._programs.get(*self._spec_copy(comm, count, srcbuf.dtype))
         y = prog(x).astype(dstbuf.jnp_dtype)
         self._store(dstbuf, count, y)
         return self._finish(operation.copy, dstbuf, y, to_device, run_async, comm)
@@ -378,13 +503,8 @@ class ACCL:
             raise ACCLError(errorCode.ARITH_ERROR, "combine operand dtype mismatch")
         a = self._input(val1, count, val1_from_device)
         b = self._input(val2, count, val2_from_device)
-        use_pallas = self.config.use_pallas and self.config.enable_arith
         prog = self._programs.get(
-            self._key(comm, operation.combine, count, val1.dtype, function,
-                      use_pallas),
-            lambda: primitives.build_combine(comm, function, val1.dtype,
-                                             use_pallas=use_pallas),
-        )
+            *self._spec_combine(comm, count, val1.dtype, function))
         y = prog(a, b).astype(result.jnp_dtype)
         self._store(result, count, y)
         return self._finish(operation.combine, result, y, to_device, run_async, comm)
@@ -828,16 +948,10 @@ class ACCL:
         """``ACCL::bcast`` (accl.cpp; fw :798-990)."""
         comm = comm or self.comms[0]
         self._check_count(buf, count, "bcast")
-        arith = self._arith(buf.dtype, compress_dtype)
-        algo = algorithms.select(
-            operation.bcast, count * constants.dtype_size(buf.dtype),
-            comm, self.config, algorithm)
         x = self._input(buf, count, from_device)
         prog = self._programs.get(
-            self._key(comm, operation.bcast, count, buf.dtype, root,
-                      compress_dtype, algo),
-            lambda: algorithms.build_bcast(comm, root, algo, arith),
-        )
+            *self._spec_bcast(comm, count, buf.dtype, root, compress_dtype,
+                              algorithm))
         y = prog(x)
         self._store(buf, count, y)
         return self._finish(operation.bcast, buf, y, to_device, run_async, comm)
@@ -929,18 +1043,10 @@ class ACCL:
         world = comm.world_size
         self._check_count(sendbuf, count, "allgather send")
         self._check_count(recvbuf, count * world, "allgather recv")
-        arith = self._arith(sendbuf.dtype, compress_dtype)
-        algo = algorithms.select(
-            operation.allgather, count * constants.dtype_size(sendbuf.dtype),
-            comm, self.config, algorithm)
         x = self._input(sendbuf, count, from_device)
         prog = self._programs.get(
-            self._key(comm, operation.allgather, count, sendbuf.dtype,
-                      compress_dtype, algo, self.config.segment_size),
-            lambda: algorithms.build_allgather(comm, algo, arith,
-                                               sendbuf.dtype,
-                                               self.config.segment_size),
-        )
+            *self._spec_allgather(comm, count, sendbuf.dtype, compress_dtype,
+                                  algorithm))
         y = prog(x).astype(recvbuf.jnp_dtype)
         self._store(recvbuf, count * world, y)
         return self._finish(operation.allgather, recvbuf, y, to_device, run_async, comm)
@@ -963,22 +1069,11 @@ class ACCL:
         comm = comm or self.comms[0]
         self._check_count(sendbuf, count, "reduce send")
         self._check_count(recvbuf, count, "reduce recv")
-        arith = self._arith(sendbuf.dtype, compress_dtype)
-        if arith is not None and not arith.supports(function):
-            raise ACCLError(errorCode.ARITH_ERROR, f"{function} unsupported")
-        algo = algorithms.select(
-            operation.reduce, count * constants.dtype_size(sendbuf.dtype),
-            comm, self.config, algorithm, count=count)
-        fanin = (self.config.gather_flat_tree_max_fanin
-                 if algo == Algorithm.FLAT else 0)
         x = self._input(sendbuf, count, from_device)
         r = self._input(recvbuf, count, True)
         prog = self._programs.get(
-            self._key(comm, operation.reduce, count, sendbuf.dtype, root, function,
-                      compress_dtype, algo, fanin),
-            lambda: algorithms.build_reduce(
-                comm, root, function, sendbuf.dtype, algo, arith, fanin),
-        )
+            *self._spec_reduce(comm, count, sendbuf.dtype, root, function,
+                               compress_dtype, algorithm))
         y = prog(x, r)
         self._store(recvbuf, count, y)
         return self._finish(operation.reduce, recvbuf, y, to_device, run_async, comm)
@@ -1000,22 +1095,10 @@ class ACCL:
         comm = comm or self.comms[0]
         self._check_count(sendbuf, count, "allreduce send")
         self._check_count(recvbuf, count, "allreduce recv")
-        arith = self._arith(sendbuf.dtype, compress_dtype)
-        if arith is not None and not arith.supports(function):
-            raise ACCLError(errorCode.ARITH_ERROR, f"{function} unsupported")
-        algo = algorithms.select(
-            operation.allreduce, count * constants.dtype_size(sendbuf.dtype),
-            comm, self.config, algorithm)
         x = self._input(sendbuf, count, from_device)
-        fanin = (self.config.gather_flat_tree_max_fanin
-                 if algo == Algorithm.FLAT else 0)
         prog = self._programs.get(
-            self._key(comm, operation.allreduce, count, sendbuf.dtype, function,
-                      compress_dtype, algo, self.config.segment_size, fanin),
-            lambda: algorithms.build_allreduce(
-                comm, function, sendbuf.dtype, algo, arith,
-                self.config.segment_size, fanin),
-        )
+            *self._spec_allreduce(comm, count, sendbuf.dtype, function,
+                                  compress_dtype, algorithm))
         y = prog(x).astype(recvbuf.jnp_dtype)
         self._store(recvbuf, count, y)
         return self._finish(operation.allreduce, recvbuf, y, to_device, run_async, comm)
@@ -1039,19 +1122,10 @@ class ACCL:
         world = comm.world_size
         self._check_count(sendbuf, count * world, "reduce_scatter send")
         self._check_count(recvbuf, count, "reduce_scatter recv")
-        arith = self._arith(sendbuf.dtype, compress_dtype)
-        algo = algorithms.select(
-            operation.reduce_scatter,
-            count * world * constants.dtype_size(sendbuf.dtype),
-            comm, self.config, algorithm)
         x = self._input(sendbuf, count * world, from_device)
         prog = self._programs.get(
-            self._key(comm, operation.reduce_scatter, count, sendbuf.dtype, function,
-                      compress_dtype, algo, self.config.segment_size),
-            lambda: algorithms.build_reduce_scatter(
-                comm, function, sendbuf.dtype, algo, arith,
-                self.config.segment_size),
-        )
+            *self._spec_reduce_scatter(comm, count, sendbuf.dtype, function,
+                                       compress_dtype, algorithm))
         y = prog(x).astype(recvbuf.jnp_dtype)
         self._store(recvbuf, count, y)
         return self._finish(operation.reduce_scatter, recvbuf, y, to_device, run_async, comm)

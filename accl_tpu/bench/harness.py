@@ -84,7 +84,11 @@ def _build_combine_best(comm: Communicator, func: reduceFunction,
                                        np.dtype(to_jax_dtype(dt))))
             np.asarray(_pick(prog(tiny, tiny)))
             return prog
-        except Exception:
+        except Exception as e:  # noqa: BLE001 - fall back, but NEVER silently
+            # a broken Pallas lane must not quietly benchmark the jnp path
+            # under the plugin's name (the headline bench names reduce_ops)
+            print(f"WARNING: combine lane (pallas={pallas}) failed "
+                  f"({type(e).__name__}: {e}); falling back", file=sys.stderr)
             continue
     return primitives.build_combine(comm, func, dt, use_pallas=False)
 

@@ -225,9 +225,15 @@ class BufferSlice(BaseBuffer):
         self.parent.store_rank_local(rank, cur)
 
     def device_view(self) -> jax.Array:
+        if self.start == 0 and self.end == self.parent.count:
+            return self.parent.data
         return self.parent.data[:, self.start : self.end]
 
     def device_store(self, value: jax.Array) -> None:
+        if self.start == 0 and self.end == self.parent.count:
+            # whole-parent view: store directly, no re-materialization
+            self.parent.device_store(value.astype(self.parent.jnp_dtype))
+            return
         full = self.parent.data
         self.parent.device_store(
             jax.lax.dynamic_update_slice(full, value.astype(full.dtype), (0, self.start))

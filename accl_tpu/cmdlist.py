@@ -20,13 +20,14 @@ Usage::
     cl.combine(n, reduceFunction.MAX, x, y, y)
     cl.execute()          # ONE launch; buffers updated on device
 
-Semantics: operands are device-resident for the whole list (the host
-mirror is neither read nor written between ops — ``from_device`` /
-``to_device`` of every fused call is implicitly True, like a PL-kernel
-chain); ``execute(sync=True)`` syncs output buffers' host mirrors at the
-end. Lists are reusable: ``execute`` can be called repeatedly, and the
-compiled composite is cached on the session's ``ProgramCache`` keyed by
-the recorded sequence.
+Semantics mirror one fused per-op sequence: ``execute`` first syncs the
+host mirror of every buffer the list reads before writing (the
+``from_device=False`` default, applied once per list), runs all ops on
+device with no host traffic in between (like a PL-kernel chain), and with
+``sync=True`` syncs written buffers' host mirrors at the end. Lists are
+reusable: ``execute`` can be called repeatedly (picking up fresh host
+writes each time), and the compiled composite is cached on the session's
+``ProgramCache`` keyed by the recorded sequence.
 """
 from __future__ import annotations
 
@@ -38,15 +39,7 @@ import jax
 from .buffer import BaseBuffer
 from .communicator import Communicator
 from .config import Algorithm
-from .constants import (
-    ACCLError,
-    dataType,
-    dtype_size,
-    errorCode,
-    operation,
-    reduceFunction,
-)
-from .parallel import algorithms, primitives
+from .constants import ACCLError, errorCode, reduceFunction
 
 
 @dataclasses.dataclass
@@ -101,12 +94,10 @@ class CommandList:
         return self
 
     def copy(self, srcbuf, dstbuf, count: int) -> "CommandList":
-        a = self._bind(srcbuf, count, "copy src")
+        self._bind(srcbuf, count, "copy src")
         self._bind(dstbuf, count, "copy dst")
-        c, acc = self._comm, self._accl
-        return self._record(
-            acc._key(c, operation.copy, count),
-            lambda: primitives.build_copy(c), (srcbuf,), dstbuf)
+        key, build = self._accl._spec_copy(self._comm, count, srcbuf.dtype)
+        return self._record(key, build, (srcbuf,), dstbuf)
 
     def combine(self, count: int, function: reduceFunction, val1, val2,
                 result) -> "CommandList":
@@ -117,94 +108,51 @@ class CommandList:
             raise ACCLError(errorCode.ARITH_ERROR,
                             "combine operand dtype mismatch")
         self._check_arith(val1, function)
-        c, acc = self._comm, self._accl
-        use_pallas = acc.config.use_pallas and acc.config.enable_arith
-        return self._record(
-            acc._key(c, operation.combine, count, val1.dtype, function,
-                     use_pallas),
-            lambda: primitives.build_combine(c, function, val1.dtype,
-                                             use_pallas=use_pallas),
-            (val1, val2), result)
+        key, build = self._accl._spec_combine(self._comm, count, val1.dtype,
+                                              function)
+        return self._record(key, build, (val1, val2), result)
 
     def bcast(self, buf, count: int, root: int,
               algorithm: Optional[Algorithm] = None) -> "CommandList":
         self._bind(buf, count, "bcast")
-        c, acc = self._comm, self._accl
-        algo = algorithms.select(
-            operation.bcast, buf.size_bytes, c, acc.config, algorithm)
-        return self._record(
-            acc._key(c, operation.bcast, count, buf.dtype, root, None, algo),
-            lambda: algorithms.build_bcast(c, root, algo, None), (buf,), buf)
+        key, build = self._accl._spec_bcast(self._comm, count, buf.dtype,
+                                            root, None, algorithm)
+        return self._record(key, build, (buf,), buf)
 
     def reduce(self, sendbuf, recvbuf, count: int, root: int,
                function: reduceFunction,
                algorithm: Optional[Algorithm] = None) -> "CommandList":
         self._bind(sendbuf, count, "reduce send")
         self._bind(recvbuf, count, "reduce recv")
-        self._check_arith(sendbuf, function)
-        c, acc = self._comm, self._accl
-        algo = algorithms.select(operation.reduce, sendbuf.size_bytes, c,
-                                 acc.config, algorithm, count=count)
-        fanin = (acc.config.gather_flat_tree_max_fanin
-                 if algo == Algorithm.FLAT else 0)
-        return self._record(
-            acc._key(c, operation.reduce, count, sendbuf.dtype, root,
-                     function, None, algo, fanin),
-            lambda: algorithms.build_reduce(c, root, function, sendbuf.dtype,
-                                            algo, None, fanin),
-            (sendbuf, recvbuf), recvbuf)
+        key, build = self._accl._spec_reduce(
+            self._comm, count, sendbuf.dtype, root, function, None, algorithm)
+        return self._record(key, build, (sendbuf, recvbuf), recvbuf)
 
     def allreduce(self, sendbuf, recvbuf, count: int,
                   function: reduceFunction,
                   algorithm: Optional[Algorithm] = None) -> "CommandList":
         self._bind(sendbuf, count, "allreduce send")
         self._bind(recvbuf, count, "allreduce recv")
-        self._check_arith(sendbuf, function)
-        c, acc = self._comm, self._accl
-        algo = algorithms.select(operation.allreduce, sendbuf.size_bytes, c,
-                                 acc.config, algorithm)
-        fanin = (acc.config.gather_flat_tree_max_fanin
-                 if algo == Algorithm.FLAT else 0)
-        return self._record(
-            acc._key(c, operation.allreduce, count, sendbuf.dtype, function,
-                     None, algo, acc.config.segment_size, fanin),
-            lambda: algorithms.build_allreduce(
-                c, function, sendbuf.dtype, algo, None,
-                acc.config.segment_size, fanin),
-            (sendbuf,), recvbuf)
+        key, build = self._accl._spec_allreduce(
+            self._comm, count, sendbuf.dtype, function, None, algorithm)
+        return self._record(key, build, (sendbuf,), recvbuf)
 
     def allgather(self, sendbuf, recvbuf, count: int,
                   algorithm: Optional[Algorithm] = None) -> "CommandList":
         self._bind(sendbuf, count, "allgather send")
         self._bind(recvbuf, count * self._comm.world_size, "allgather recv")
-        c, acc = self._comm, self._accl
-        algo = algorithms.select(operation.allgather, sendbuf.size_bytes, c,
-                                 acc.config, algorithm)
-        return self._record(
-            acc._key(c, operation.allgather, count, sendbuf.dtype, None,
-                     algo, acc.config.segment_size),
-            lambda: algorithms.build_allgather(
-                c, algo, None, sendbuf.dtype, acc.config.segment_size),
-            (sendbuf,), recvbuf)
+        key, build = self._accl._spec_allgather(
+            self._comm, count, sendbuf.dtype, None, algorithm)
+        return self._record(key, build, (sendbuf,), recvbuf)
 
     def reduce_scatter(self, sendbuf, recvbuf, count: int,
                        function: reduceFunction,
                        algorithm: Optional[Algorithm] = None) -> "CommandList":
         self._bind(sendbuf, count * self._comm.world_size, "rs send")
         self._bind(recvbuf, count, "rs recv")
-        c, acc = self._comm, self._accl
-        self._check_arith(sendbuf, function)
-        algo = algorithms.select(
-            operation.reduce_scatter,
-            count * self._comm.world_size * dtype_size(sendbuf.dtype),
-            c, acc.config, algorithm)
-        return self._record(
-            acc._key(c, operation.reduce_scatter, count, sendbuf.dtype,
-                     function, None, algo, acc.config.segment_size),
-            lambda: algorithms.build_reduce_scatter(
-                c, function, sendbuf.dtype, algo, None,
-                acc.config.segment_size),
-            (sendbuf,), recvbuf)
+        key, build = self._accl._spec_reduce_scatter(
+            self._comm, count, sendbuf.dtype, function, None, algorithm)
+        return self._record(key, build, (sendbuf,), recvbuf)
 
     # ------------------------------------------------------------------
     # execution
@@ -233,6 +181,17 @@ class CommandList:
         acc = self._accl
         order = list(self._buffers)
         slots = {bid: i for i, bid in enumerate(order)}
+        # sync host mirrors for buffers the list READS before writing — the
+        # from_device=False default of the per-op paths, applied once per
+        # list (a later host write is picked up on every execute, whether
+        # or not the buffer was already materialized on device)
+        synced: set = set()
+        for s in self._steps:
+            for bid in s.in_ids:
+                if bid not in synced:
+                    self._buffers[bid].sync_to_device()
+                    synced.add(bid)  # sync once; list-internal flow rules after
+            synced.add(s.out_id)
         progs = [acc._programs.get(s.key, s.build) for s in self._steps]
         steps = [(progs[i], tuple(slots[b] for b in s.in_ids),
                   slots[s.out_id], s.out_dtype)

@@ -134,6 +134,29 @@ class Communicator:
 
     # ---- topology helpers ------------------------------------------------
 
+    def hosts_shape(self) -> Optional[Tuple[int, int]]:
+        """(n_hosts, devices_per_host) when the rank order is host-major
+        with equal per-host device counts; None otherwise.
+
+        This is the natural 2-D factorization for hierarchical collectives
+        on a multi-host (DCN) mesh: with ``mesh2d(n_hosts, per_host)`` each
+        row is one host, so the bandwidth-heavy phases ride intra-host ICI
+        and only the shard-sized exchange crosses the DCN — the "lay out
+        shardings so collectives ride ICI" rule made automatic."""
+        groups: List[List[int]] = []  # [process_index, count] runs
+        for d in self._devices:
+            p = getattr(d, "process_index", 0)
+            if groups and groups[-1][0] == p:
+                groups[-1][1] += 1
+            elif any(g[0] == p for g in groups):
+                return None  # not host-major contiguous
+            else:
+                groups.append([p, 1])
+        per = groups[0][1]
+        if len(groups) < 2 or per < 2 or any(g[1] != per for g in groups):
+            return None
+        return (len(groups), per)
+
     def mesh2d(self, rows: int, cols: int, axis_names=("accl_y", "accl_x")) -> Mesh:
         """2-D mesh over the same ranks, for hierarchical collectives.
 

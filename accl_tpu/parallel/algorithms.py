@@ -20,7 +20,7 @@ from typing import Callable, Optional
 
 from ..arithconfig import ArithConfig
 from ..communicator import Communicator
-from ..config import ACCLConfig, Algorithm
+from ..config import ACCLConfig, Algorithm, TransportBackend
 from ..constants import ACCLError, dataType, errorCode, operation, reduceFunction
 from . import flat, hierarchical, pallas_ring, primitives, ring, tree
 
@@ -28,6 +28,16 @@ from . import flat, hierarchical, pallas_ring, primitives, ring, tree
 RING_THRESHOLD = 4 * 1024 * 1024
 #: payload size above which AUTO prefers hierarchical 2D on composite worlds
 HIER_THRESHOLD = 64 * 1024 * 1024
+#: on a multi-host (DCN) mesh, hierarchical wins much earlier: the heavy
+#: phases stay on intra-host ICI and only the n/cols shard crosses the DCN
+DCN_HIER_THRESHOLD = 64 * 1024
+
+
+def _hier_shape(comm: Communicator):
+    """2-D factorization for hierarchical collectives: host-aligned when
+    the mesh spans hosts (rows = hosts, so DCN traffic is the small
+    phase), most-square otherwise."""
+    return comm.hosts_shape() or hierarchical.factor2d(comm.world_size)
 
 _SUPPORTED = {
     operation.bcast: {Algorithm.XLA, Algorithm.FLAT, Algorithm.TREE, Algorithm.RING},
@@ -70,8 +80,19 @@ def select(
     world = comm.world_size
     if world == 1:
         return Algorithm.XLA
+    on_dcn = cfg.transport == TransportBackend.DCN
+    if on_dcn:
+        # multi-host: long edges are expensive. Hierarchical allreduce as
+        # soon as the payload justifies it; log-depth trees for rooted
+        # rendezvous ops (a flat star would cross the DCN world-1 times)
+        if op == operation.allreduce and nbytes >= DCN_HIER_THRESHOLD \
+                and _hier_shape(comm) is not None:
+            return Algorithm.HIERARCHICAL
+        if op in (operation.bcast, operation.reduce) \
+                and nbytes > cfg.max_eager_size:
+            return Algorithm.TREE
     if op == operation.allreduce and nbytes >= HIER_THRESHOLD \
-            and hierarchical.factor2d(world) is not None:
+            and _hier_shape(comm) is not None:
         return Algorithm.HIERARCHICAL
     if op in (operation.allreduce, operation.allgather, operation.reduce_scatter) \
             and nbytes >= RING_THRESHOLD:
@@ -172,7 +193,7 @@ def build_allreduce(comm, func: reduceFunction, dt: dataType, algo: Algorithm,
     if algo == Algorithm.TREE:
         return tree.build_tree_allreduce(comm, func, dt, arith)
     if algo == Algorithm.HIERARCHICAL:
-        rc = hierarchical.factor2d(comm.world_size)
+        rc = _hier_shape(comm)
         if rc is None:
             raise ValueError(
                 f"hierarchical allreduce needs a composite world, got {comm.world_size}"

@@ -105,13 +105,18 @@ def config_uneven_rooted(quick: bool) -> dict:
         g = acc.create_buffer(count * W, dataType.int32)
         b.host[:] = rng.integers(-99, 99, (W, count))
         s.host[:] = rng.integers(-99, 99, (W, count * W))
+        # expectations captured BEFORE the calls mutate the buffers — a
+        # wrong-root bcast must fail the check, not define it
+        bcast_expect = b.host[3].copy()
+        scatter_src = s.host[2].copy()
         row = {"count": count}
         for name, call, check in (
             ("bcast", lambda: acc.bcast(b, count, 3),
-             lambda: np.array_equal(b.host, np.tile(b.host[3], (W, 1)))),
+             lambda: np.array_equal(b.host, np.tile(bcast_expect, (W, 1)))),
             ("scatter", lambda: acc.scatter(s, r, count, 2),
-             lambda: np.array_equal(
-                 r.host[0], s.host[2, :count])),
+             lambda: all(np.array_equal(
+                 r.host[k], scatter_src[k * count:(k + 1) * count])
+                 for k in range(W))),
             ("gather", lambda: acc.gather(r, g, count, 5),
              lambda: np.array_equal(g.host[5], r.host.reshape(-1))),
         ):

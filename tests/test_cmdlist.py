@@ -98,3 +98,19 @@ def test_cmdlist_rejects_partial_counts_and_dummies(accl):
 
 def test_cmdlist_empty_execute_is_noop(accl):
     assert accl.command_list().execute() is None
+
+
+def test_cmdlist_picks_up_host_writes_each_execute(accl, rng):
+    """execute() syncs read-before-write inputs from host every time, even
+    for buffers already materialized on device — same visibility rules as
+    the per-op from_device=False default."""
+    x = accl.create_buffer(32, dataType.int32)
+    y = accl.create_buffer(32, dataType.int32)
+    x.host[:] = _ints(rng, (WORLD, 32))
+    accl.copy(x, y, 32)  # materializes x on device with the first values
+    cl = accl.command_list()
+    cl.allreduce(x, y, 32, reduceFunction.SUM)
+    second = _ints(rng, (WORLD, 32))
+    x.host[:] = second   # host write AFTER device materialization
+    cl.execute()
+    np.testing.assert_array_equal(y.host, np.tile(second.sum(0), (WORLD, 1)))

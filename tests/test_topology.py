@@ -1,0 +1,99 @@
+"""Topology-aware selection + introspection: host-aligned hierarchical
+factorization for DCN meshes, the xclbin_scan-analog device scan, the
+profiler surface, and the BufferSlice whole-parent fast path.
+"""
+import glob
+import tempfile
+
+import numpy as np
+import pytest
+
+from accl_tpu import Algorithm, TransportBackend, dataType, reduceFunction
+from accl_tpu.constants import operation
+from accl_tpu.parallel import algorithms
+
+WORLD = 8
+
+
+class _FakeDev:
+    def __init__(self, process_index):
+        self.process_index = process_index
+
+
+class _FakeComm:
+    AXIS = "accl"
+
+    def __init__(self, procs):
+        self._devices = [_FakeDev(p) for p in procs]
+
+    @property
+    def world_size(self):
+        return len(self._devices)
+
+    # borrow the real implementation
+    from accl_tpu.communicator import Communicator as _C
+    hosts_shape = _C.hosts_shape
+
+
+def test_hosts_shape_detection():
+    assert _FakeComm([0, 0, 0, 0, 1, 1, 1, 1]).hosts_shape() == (2, 4)
+    assert _FakeComm([0, 0, 1, 1, 2, 2]).hosts_shape() == (3, 2)
+    # single host -> no DCN factorization
+    assert _FakeComm([0] * 8).hosts_shape() is None
+    # uneven hosts
+    assert _FakeComm([0, 0, 0, 1, 1]).hosts_shape() is None
+    # interleaved (not host-major) ordering
+    assert _FakeComm([0, 1, 0, 1]).hosts_shape() is None
+    # one device per host: nothing to keep on ICI
+    assert _FakeComm([0, 1, 2, 3]).hosts_shape() is None
+
+
+def test_dcn_selection_prefers_hierarchical_and_tree(accl):
+    """On a DCN (multi-host) mesh hierarchical engages at 64 KiB instead of
+    64 MiB, and rooted rendezvous ops go log-depth instead of flat star."""
+    comm = accl.global_comm()
+    dcn = accl.config.replace(transport=TransportBackend.DCN)
+    ici = accl.config.replace(transport=TransportBackend.ICI)
+    mid = 256 * 1024  # between DCN_HIER_THRESHOLD and RING_THRESHOLD
+
+    assert algorithms.select(operation.allreduce, mid, comm, dcn) \
+        == Algorithm.HIERARCHICAL
+    assert algorithms.select(operation.allreduce, mid, comm, ici) \
+        == Algorithm.XLA
+
+    big = dcn.max_eager_size + 4096
+    assert algorithms.select(operation.bcast, big, comm, dcn) == Algorithm.TREE
+    # same size on ICI keeps the flat-tree family (world <= flat max ranks)
+    assert algorithms.select(operation.bcast, big, comm, ici) == Algorithm.FLAT
+
+
+def test_scan_reports_every_rank(accl):
+    recs = accl.scan()
+    assert len(recs) == WORLD
+    for i, r in enumerate(recs):
+        assert r["rank"] == i
+        assert r["platform"] == "cpu"
+        assert "kind" in r and "process_index" in r
+
+
+def test_profile_writes_a_trace(accl, rng):
+    s = accl.create_buffer(128, dataType.float32)
+    r = accl.create_buffer(128, dataType.float32)
+    s.host[:] = rng.standard_normal((WORLD, 128)).astype(np.float32)
+    with tempfile.TemporaryDirectory() as td:
+        with accl.profile(td):
+            accl.allreduce(s, r, 128, reduceFunction.SUM)
+        assert glob.glob(td + "/**/*", recursive=True)
+
+
+def test_buffer_slice_full_parent_fast_path(accl, rng):
+    """A slice covering the whole parent stores directly (no
+    dynamic_update_slice re-materialization) and stays correct."""
+    b = accl.create_buffer(64, dataType.float32)
+    sl = b.slice(0, 64)
+    b.host[:] = rng.standard_normal((WORLD, 64)).astype(np.float32)
+    rootdata = b.host[0].copy()
+    accl.bcast(sl, 64, 0)
+    np.testing.assert_array_equal(b.host, np.tile(rootdata, (WORLD, 1)))
+    # device view of the full slice IS the parent's array (no copy)
+    assert sl.device_view() is b.data
