@@ -1,0 +1,149 @@
+"""Context (sequence) parallelism: ring attention + all-to-all (Ulysses)
+resharding built on the framework's collectives.
+
+The reference is the substrate below model parallelism (SURVEY.md §2.6 —
+no attention code exists in it); its scalable-payload machinery is
+segmentation + pipelining (§5), which it points at as "the building block
+that ring-attention/context-parallel layers would consume". These are
+those layers, TPU-first:
+
+* **Ring attention** (`build_ring_attention`): Q/K/V sharded over the
+  sequence axis, one block per rank. P steps of blockwise attention with
+  online-softmax accumulation; K/V blocks rotate one hop per step via
+  ``ppermute`` — the same neighbor-only ring schedule as the ring
+  collectives (fw segmented allreduce ``ccl_offload_control.c:1888-2071``),
+  so sequence length scales with the mesh while every hop stays on an ICI
+  link. Compute (two matmuls per step, MXU-bound) overlaps the next hop's
+  transfer under XLA's scheduler.
+* **Ulysses attention** (`build_ulysses_attention`): sequence-sharded
+  Q/K/V are re-sharded to head-sharded/full-sequence via one fused
+  ``lax.all_to_all``, attention runs locally per head group, and a second
+  all-to-all restores sequence sharding. Two collectives total — the
+  all-to-all sequence-parallel alternative when heads ≥ world.
+
+Both are deterministic (fixed ring order / fixed reshard) and compose with
+the rest of the framework: inputs are the communicator's (world, ...)
+sharded arrays, programs are cached jitted shard_map programs like every
+collective here.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..communicator import Communicator
+from .primitives import AXIS, _smap
+from .ring import _fwd_perm
+
+
+def _online_block(q, kb, vb, acc, m, l, qpos, kpos, causal: bool,
+                  scale: float):
+    """One blockwise-attention accumulation step (online softmax).
+
+    q: (n, d); kb/vb: (nb, d); acc: (n, d); m/l: (n,). Returns updated
+    (acc, m, l). Deterministic: the caller fixes the block order."""
+    scores = (q @ kb.T) * scale                      # (n, nb) — MXU matmul
+    if causal:
+        mask = qpos[:, None] >= kpos[None, :]
+        scores = jnp.where(mask, scores, -jnp.inf)
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    # exp(-inf - -inf) guards: a fully-masked row keeps m=-inf, p=0
+    p = jnp.exp(scores - m_new[:, None])
+    p = jnp.where(jnp.isfinite(scores), p, 0.0)
+    alpha = jnp.exp(jnp.where(jnp.isfinite(m), m - m_new, -jnp.inf))
+    alpha = jnp.where(jnp.isfinite(m), alpha, 0.0)
+    l_new = l * alpha + p.sum(axis=-1)
+    acc_new = acc * alpha[:, None] + p @ vb          # (n, d) — MXU matmul
+    return acc_new, m_new, l_new
+
+
+def build_ring_attention(comm: Communicator, causal: bool = False,
+                         scale: Optional[float] = None) -> Callable:
+    """Ring attention over the communicator's mesh.
+
+    Inputs: q, k, v of global shape (world, n, d) — rank r owns sequence
+    block [r*n, (r+1)*n). Output: (world, n, d), the exact softmax
+    attention of the full (world*n)-long sequence, accumulated online so
+    no rank ever materializes more than one remote K/V block.
+    """
+    world = comm.world_size
+    perm = _fwd_perm(world)
+
+    def body(q, k, v):
+        q, k, v = q[0], k[0], v[0]                    # (n, d) local blocks
+        n, d = q.shape
+        sc = scale if scale is not None else 1.0 / (d ** 0.5)
+        rank = lax.axis_index(AXIS)
+        qpos = rank * n + jnp.arange(n)
+        acc = jnp.zeros_like(q)
+        m = jnp.full((n,), -jnp.inf, q.dtype)
+        l = jnp.zeros((n,), q.dtype)
+        kb, vb = k, v
+        for s in range(world):
+            # after s forward hops, this rank holds block (rank - s) % P
+            src = jnp.mod(rank - s, world)
+            kpos = src * n + jnp.arange(n)
+            acc, m, l = _online_block(q, kb, vb, acc, m, l, qpos, kpos,
+                                      causal, sc)
+            if s + 1 < world:
+                # rotate K/V one hop; XLA overlaps this with the next
+                # step's matmuls where the schedule allows
+                kb = lax.ppermute(kb, AXIS, perm)
+                vb = lax.ppermute(vb, AXIS, perm)
+        safe_l = jnp.where(l > 0, l, 1.0)
+        return (acc / safe_l[:, None])[None]
+
+    return _smap(comm, body, 3)
+
+
+def build_ulysses_attention(comm: Communicator, n_heads: int,
+                            causal: bool = False,
+                            scale: Optional[float] = None) -> Callable:
+    """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism.
+
+    Inputs: q, k, v of global shape (world, n, n_heads, d) — sequence
+    sharded. One fused ``lax.all_to_all`` re-shards to (n_heads/world)
+    heads × full sequence per rank, attention runs locally (exact softmax,
+    no ring), and the inverse all-to-all restores sequence sharding.
+    ``n_heads`` must be divisible by the world size.
+    """
+    world = comm.world_size
+    if n_heads % world != 0:
+        raise ValueError(f"n_heads {n_heads} not divisible by world {world}")
+
+    def local_attn(q, k, v, sc):
+        # q/k/v: (h, S, d) — full sequence, this rank's head group
+        scores = jnp.einsum("hqd,hkd->hqk", q, k) * sc
+        if causal:
+            S = q.shape[1]
+            mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+            scores = jnp.where(mask[None], scores, -jnp.inf)
+        w = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("hqk,hkd->hqd", w, v)
+
+    def body(q, k, v):
+        n, H, d = q.shape[1:]
+        if H != n_heads:
+            raise ValueError(
+                f"input head axis {H} != declared n_heads {n_heads}")
+        sc = scale if scale is not None else 1.0 / (d ** 0.5)
+        # seq-shard (n, H, d) -> head-shard (h, world*n, d): scatter head
+        # groups, gather every rank's sequence block (in rank order, so
+        # the concat IS the global sequence)
+        qh, kh, vh = (
+            jnp.moveaxis(
+                lax.all_to_all(a[0], AXIS, split_axis=1, concat_axis=0,
+                               tiled=True),           # (world*n, h, d)
+                1, 0)                                  # (h, S, d)
+            for a in (q, k, v))
+        out = local_attn(qh, kh, vh, sc)              # (h, S, d)
+        # inverse: scatter sequence blocks back to their owners, gather
+        # every head group (in rank order = global head order)
+        back = lax.all_to_all(out, AXIS, split_axis=1, concat_axis=0,
+                              tiled=True)             # (H, n, d)
+        return jnp.moveaxis(back, 0, 1)[None]         # (1, n, H, d)
+
+    return _smap(comm, body, 3)
