@@ -14,17 +14,21 @@ those layers, TPU-first:
   collectives (fw segmented allreduce ``ccl_offload_control.c:1888-2071``),
   so sequence length scales with the mesh while every hop stays on an ICI
   link. Compute (two matmuls per step, MXU-bound) overlaps the next hop's
-  transfer under XLA's scheduler.
+  transfer under XLA's scheduler; with ``causal=True`` fully-masked future
+  blocks skip both matmuls (≈half the FLOPs as the mesh grows).
 * **Ulysses attention** (`build_ulysses_attention`): sequence-sharded
-  Q/K/V are re-sharded to head-sharded/full-sequence via one fused
-  ``lax.all_to_all``, attention runs locally per head group, and a second
-  all-to-all restores sequence sharding. Two collectives total — the
-  all-to-all sequence-parallel alternative when heads ≥ world.
+  Q/K/V are re-sharded to head-sharded/full-sequence via ONE fused
+  ``lax.all_to_all`` (q/k/v stacked), attention runs locally per head
+  group — blockwise, never materializing the (S, S) score matrix — and a
+  second all-to-all restores sequence sharding. Two collectives total —
+  the all-to-all sequence-parallel alternative when heads ≥ world.
 
-Both are deterministic (fixed ring order / fixed reshard) and compose with
-the rest of the framework: inputs are the communicator's (world, ...)
-sharded arrays, programs are cached jitted shard_map programs like every
-collective here.
+Numerics: softmax state (running max, normalizer, accumulator) is carried
+in float32 regardless of input dtype (standard flash-attention practice);
+outputs cast back to the input dtype. Both strategies are deterministic
+(fixed ring order / fixed reshard) and compose with the rest of the
+framework: inputs are the communicator's (world, ...) sharded arrays,
+programs are cached jitted shard_map programs like every collective here.
 """
 from __future__ import annotations
 
@@ -38,14 +42,18 @@ from ..communicator import Communicator
 from .primitives import AXIS, _smap
 from .ring import _fwd_perm
 
+_F32 = jnp.float32
+
 
 def _online_block(q, kb, vb, acc, m, l, qpos, kpos, causal: bool,
                   scale: float):
     """One blockwise-attention accumulation step (online softmax).
 
-    q: (n, d); kb/vb: (nb, d); acc: (n, d); m/l: (n,). Returns updated
-    (acc, m, l). Deterministic: the caller fixes the block order."""
-    scores = (q @ kb.T) * scale                      # (n, nb) — MXU matmul
+    q: (n, d); kb/vb: (nb, d); acc: (n, d) f32; m/l: (n,) f32. Returns
+    updated (acc, m, l). Deterministic: the caller fixes the block order.
+    Scores and state are f32; only the two matmuls run in the input dtype
+    with f32 accumulation (MXU-native mixed precision)."""
+    scores = jnp.matmul(q, kb.T, preferred_element_type=_F32) * scale
     if causal:
         mask = qpos[:, None] >= kpos[None, :]
         scores = jnp.where(mask, scores, -jnp.inf)
@@ -56,7 +64,8 @@ def _online_block(q, kb, vb, acc, m, l, qpos, kpos, causal: bool,
     alpha = jnp.exp(jnp.where(jnp.isfinite(m), m - m_new, -jnp.inf))
     alpha = jnp.where(jnp.isfinite(m), alpha, 0.0)
     l_new = l * alpha + p.sum(axis=-1)
-    acc_new = acc * alpha[:, None] + p @ vb          # (n, d) — MXU matmul
+    pv = jnp.matmul(p.astype(vb.dtype), vb, preferred_element_type=_F32)
+    acc_new = acc * alpha[:, None] + pv
     return acc_new, m_new, l_new
 
 
@@ -78,23 +87,34 @@ def build_ring_attention(comm: Communicator, causal: bool = False,
         sc = scale if scale is not None else 1.0 / (d ** 0.5)
         rank = lax.axis_index(AXIS)
         qpos = rank * n + jnp.arange(n)
-        acc = jnp.zeros_like(q)
-        m = jnp.full((n,), -jnp.inf, q.dtype)
-        l = jnp.zeros((n,), q.dtype)
+        acc = jnp.zeros((n, d), _F32)
+        m = jnp.full((n,), -jnp.inf, _F32)
+        l = jnp.zeros((n,), _F32)
         kb, vb = k, v
         for s in range(world):
             # after s forward hops, this rank holds block (rank - s) % P
             src = jnp.mod(rank - s, world)
             kpos = src * n + jnp.arange(n)
-            acc, m, l = _online_block(q, kb, vb, acc, m, l, qpos, kpos,
-                                      causal, sc)
+
+            def attend(carry, kb=kb, vb=vb, kpos=kpos):
+                a, mm, ll = carry
+                return _online_block(q, kb, vb, a, mm, ll, qpos, kpos,
+                                     causal, sc)
+
+            if causal:
+                # a future block (src > rank) is fully masked: skip both
+                # matmuls entirely — the rotation below still runs
+                acc, m, l = lax.cond(src <= rank, attend,
+                                     lambda c: c, (acc, m, l))
+            else:
+                acc, m, l = attend((acc, m, l))
             if s + 1 < world:
                 # rotate K/V one hop; XLA overlaps this with the next
                 # step's matmuls where the schedule allows
                 kb = lax.ppermute(kb, AXIS, perm)
                 vb = lax.ppermute(vb, AXIS, perm)
         safe_l = jnp.where(l > 0, l, 1.0)
-        return (acc / safe_l[:, None])[None]
+        return (acc / safe_l[:, None]).astype(q.dtype)[None]
 
     return _smap(comm, body, 3)
 
@@ -105,24 +125,35 @@ def build_ulysses_attention(comm: Communicator, n_heads: int,
     """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism.
 
     Inputs: q, k, v of global shape (world, n, n_heads, d) — sequence
-    sharded. One fused ``lax.all_to_all`` re-shards to (n_heads/world)
-    heads × full sequence per rank, attention runs locally (exact softmax,
-    no ring), and the inverse all-to-all restores sequence sharding.
+    sharded. One fused ``lax.all_to_all`` over the stacked q/k/v re-shards
+    to (n_heads/world) heads × full sequence per rank, attention runs
+    locally (blockwise online softmax — O(S·n) memory, never the (S, S)
+    score matrix), and the inverse all-to-all restores sequence sharding.
     ``n_heads`` must be divisible by the world size.
     """
     world = comm.world_size
     if n_heads % world != 0:
         raise ValueError(f"n_heads {n_heads} not divisible by world {world}")
 
-    def local_attn(q, k, v, sc):
-        # q/k/v: (h, S, d) — full sequence, this rank's head group
-        scores = jnp.einsum("hqd,hkd->hqk", q, k) * sc
-        if causal:
-            S = q.shape[1]
-            mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
-            scores = jnp.where(mask[None], scores, -jnp.inf)
-        w = jax.nn.softmax(scores, axis=-1)
-        return jnp.einsum("hqk,hkd->hqd", w, v)
+    # one online-softmax step vectorized over the local head group
+    _vblock = jax.vmap(_online_block,
+                       in_axes=(0, 0, 0, 0, 0, 0, None, None, None, None))
+
+    def local_attn(q, k, v, n, sc):
+        # q/k/v: (h, S, d) — full sequence, this rank's head group.
+        # Blockwise over n-sized chunks: memory O(h·S·n), not O(h·S²).
+        h, S, d = q.shape
+        qpos = jnp.arange(S)
+        acc = jnp.zeros((h, S, d), _F32)
+        m = jnp.full((h, S), -jnp.inf, _F32)
+        l = jnp.zeros((h, S), _F32)
+        for b in range(S // n):
+            kb = k[:, b * n:(b + 1) * n]
+            vb = v[:, b * n:(b + 1) * n]
+            kpos = b * n + jnp.arange(n)
+            acc, m, l = _vblock(q, kb, vb, acc, m, l, qpos, kpos, causal, sc)
+        safe_l = jnp.where(l > 0, l, 1.0)
+        return (acc / safe_l[..., None]).astype(q.dtype)
 
     def body(q, k, v):
         n, H, d = q.shape[1:]
@@ -130,16 +161,14 @@ def build_ulysses_attention(comm: Communicator, n_heads: int,
             raise ValueError(
                 f"input head axis {H} != declared n_heads {n_heads}")
         sc = scale if scale is not None else 1.0 / (d ** 0.5)
-        # seq-shard (n, H, d) -> head-shard (h, world*n, d): scatter head
-        # groups, gather every rank's sequence block (in rank order, so
-        # the concat IS the global sequence)
-        qh, kh, vh = (
-            jnp.moveaxis(
-                lax.all_to_all(a[0], AXIS, split_axis=1, concat_axis=0,
-                               tiled=True),           # (world*n, h, d)
-                1, 0)                                  # (h, S, d)
-            for a in (q, k, v))
-        out = local_attn(qh, kh, vh, sc)              # (h, S, d)
+        # ONE fused reshard for q/k/v: stack, scatter head groups, gather
+        # every rank's sequence block (in rank order, so the concat IS the
+        # global sequence)
+        qkv = jnp.stack([q[0], k[0], v[0]])           # (3, n, H, d)
+        qkv = lax.all_to_all(qkv, AXIS, split_axis=2, concat_axis=1,
+                             tiled=True)              # (3, world*n, h, d)
+        qh, kh, vh = (jnp.moveaxis(a, 1, 0) for a in qkv)  # (h, S, d) each
+        out = local_attn(qh, kh, vh, n, sc)           # (h, S, d)
         # inverse: scatter sequence blocks back to their owners, gather
         # every head group (in rank order = global head order)
         back = lax.all_to_all(out, AXIS, split_axis=1, concat_axis=0,

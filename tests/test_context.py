@@ -83,6 +83,27 @@ def test_ulysses_attention_matches_full(accl, rng, causal):
                                rtol=2e-3, atol=2e-3)
 
 
+def test_ring_attention_bf16_f32_accumulation(accl, rng):
+    """bf16 inputs: softmax state is carried in f32, so the result tracks
+    the fp64 reference to bf16-input precision (not compounding per hop)."""
+    import jax.numpy as jnp
+    comm = accl.global_comm()
+    n, d = 16, 32
+    q = rng.standard_normal((WORLD, n, d)).astype(np.float32)
+    k = rng.standard_normal((WORLD, n, d)).astype(np.float32)
+    v = rng.standard_normal((WORLD, n, d)).astype(np.float32)
+    prog = context.build_ring_attention(comm, causal=True)
+    out = np.asarray(prog(
+        _shard(comm, q.astype(jnp.bfloat16)),
+        _shard(comm, k.astype(jnp.bfloat16)),
+        _shard(comm, v.astype(jnp.bfloat16))).astype(jnp.float32))
+    expect = _ref_attention(q.reshape(-1, d), k.reshape(-1, d),
+                            v.reshape(-1, d), True)
+    # bf16 has ~3 decimal digits; the error must stay at input precision
+    np.testing.assert_allclose(out.reshape(-1, d), expect, rtol=0.05,
+                               atol=0.05)
+
+
 def test_ulysses_rejects_indivisible_heads(accl):
     with pytest.raises(ValueError):
         context.build_ulysses_attention(accl.global_comm(), n_heads=7)
