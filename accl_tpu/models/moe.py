@@ -1,0 +1,147 @@
+"""Expert parallelism (ep): a Mixture-of-Experts layer whose dispatch and
+combine ARE the framework's all-to-all.
+
+The reference's alltoall (``ccl_offload_control.c:2123-2218``, P fused
+flat trees) exists precisely for this traffic pattern: every rank sends a
+distinct block to every other rank. Here each rank owns ``E / world``
+experts; top-1-routed tokens are dispatched to their expert's rank with
+ONE tiled ``lax.all_to_all``, the expert FFNs run locally, and a second
+all-to-all returns outputs to the tokens' home ranks — the Switch-style
+capacity-bounded schedule with static shapes throughout (XLA-friendly: no
+data-dependent shapes, dropped tokens pass through on the residual path).
+
+Layout (per rank, under ``shard_map`` over the communicator's 1-D axis):
+  tokens   x: (n, d)         — token-sharded input
+  dispatch  : (n, E, C) one-hot — token t → (expert e, capacity slot c)
+  send      : (E, C, d)      — einsum(dispatch, x); row-block e goes to
+                                rank owner(e) via all_to_all
+  recv      : (E_local, world·C, d) — my experts' tokens from every rank
+  combine   : transpose of dispatch, weighted by the router probability
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import numpy as np
+
+from ..communicator import Communicator
+from ..parallel.primitives import AXIS, _smap
+
+
+class MoEParams(NamedTuple):
+    router: jax.Array  # (d, E) replicated
+    w_in: jax.Array    # (E_local, d, h) expert-sharded
+    w_out: jax.Array   # (E_local, h, d) expert-sharded
+
+
+def init_params(key, comm: Communicator, d_model: int, d_hidden: int,
+                n_experts: int) -> MoEParams:
+    """Global parameter arrays; shard with :func:`shard_params`."""
+    world = comm.world_size
+    if n_experts % world != 0:
+        raise ValueError(f"n_experts {n_experts} % world {world} != 0")
+    kr, ki, ko = jax.random.split(key, 3)
+    s_in = (2.0 / d_model) ** 0.5
+    s_out = (2.0 / d_hidden) ** 0.5
+    return MoEParams(
+        router=jax.random.normal(kr, (d_model, n_experts), jnp.float32) * 0.02,
+        w_in=jax.random.normal(
+            ki, (n_experts, d_model, d_hidden), jnp.float32) * s_in,
+        w_out=jax.random.normal(
+            ko, (n_experts, d_hidden, d_model), jnp.float32) * s_out,
+    )
+
+
+def shard_params(params: MoEParams, comm: Communicator) -> MoEParams:
+    """Experts sharded over the mesh axis; router replicated."""
+    from jax.sharding import PartitionSpec as P
+    return MoEParams(
+        router=jax.device_put(params.router, comm.replicated_sharding()),
+        w_in=jax.device_put(params.w_in, comm.sharding(P(AXIS, None, None))),
+        w_out=jax.device_put(params.w_out, comm.sharding(P(AXIS, None, None))),
+    )
+
+
+def build_moe_forward(comm: Communicator, n_experts: int,
+                      capacity: int) -> callable:
+    """Compile the expert-parallel MoE forward.
+
+    Input x: (world, n, d) token-sharded; output same shape. ``capacity``
+    is the per-(rank, expert) token budget C; tokens over budget fall back
+    to the residual path (standard Switch behavior, static shapes).
+    """
+    world = comm.world_size
+    e_local = n_experts // world
+
+    def body(params: MoEParams, x):
+        x = x[0]                                       # (n, d) local tokens
+        n, d = x.shape
+        logits = x @ params.router                     # (n, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)            # (n,) top-1
+        gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+
+        # capacity slot per (token, expert): position among same-expert
+        # tokens in order — deterministic, matches the fixed-traversal rule
+        onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.int32)  # (n, E)
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1  # (n, E): slot or -1
+        slot = pos.max(axis=1)                         # (n,) slot for chosen e
+        keep = slot < capacity                         # over-budget → residual
+
+        disp = (jax.nn.one_hot(expert, n_experts, dtype=x.dtype)[:, :, None]
+                * jax.nn.one_hot(jnp.clip(slot, 0, capacity - 1), capacity,
+                                 dtype=x.dtype)[:, None, :])
+        disp = disp * keep[:, None, None].astype(x.dtype)  # (n, E, C)
+
+        send = jnp.einsum("nec,nd->ecd", disp, x)      # (E, C, d)
+        # dispatch: expert-block e → rank e // e_local; received blocks
+        # stack in rank order along capacity → (E_local, world*C, d)
+        recv = lax.all_to_all(send, AXIS, split_axis=0, concat_axis=1,
+                              tiled=True)
+
+        # local expert FFNs (batched over my e_local experts) — MXU matmuls;
+        # w_in/w_out arrive as the (E_local, ...) shard of the global array
+        h = jax.nn.relu(jnp.einsum("ecd,edh->ech", recv, params.w_in))
+        y = jnp.einsum("ech,ehd->ecd", h, params.w_out)
+
+        # inverse all-to-all: send each rank its tokens' outputs back
+        back = lax.all_to_all(y, AXIS, split_axis=1, concat_axis=0,
+                              tiled=True)              # (E, C, d)
+        out = jnp.einsum("nec,ecd->nd", disp, back)    # gather my tokens
+        out = out * gate[:, None]
+        # over-capacity (and all) tokens keep the residual
+        return (x + out)[None]
+
+    from jax.sharding import PartitionSpec as P
+    param_specs = MoEParams(router=P(None, None),
+                            w_in=P(AXIS, None, None),
+                            w_out=P(AXIS, None, None))
+    return _smap(comm, body, 2,
+                 in_specs=(param_specs, P(AXIS, None, None)))
+
+
+def reference_moe(params: MoEParams, x: np.ndarray, n_experts: int,
+                  capacity: int) -> np.ndarray:
+    """Host reference: the same capacity-bounded top-1 MoE, computed
+    globally per rank (no parallelism) for test comparison."""
+    world, n, d = x.shape
+    out = np.array(x, dtype=np.float64)
+    router = np.asarray(params.router, np.float64)
+    w_in = np.asarray(params.w_in, np.float64)
+    w_out = np.asarray(params.w_out, np.float64)
+    for r in range(world):
+        logits = x[r].astype(np.float64) @ router
+        e_x = np.exp(logits - logits.max(-1, keepdims=True))
+        probs = e_x / e_x.sum(-1, keepdims=True)
+        expert = probs.argmax(-1)
+        counts = {e: 0 for e in range(n_experts)}
+        for t in range(n):
+            e = int(expert[t])
+            if counts[e] < capacity:
+                counts[e] += 1
+                h = np.maximum(x[r, t].astype(np.float64) @ w_in[e], 0.0)
+                out[r, t] += (h @ w_out[e]) * probs[t, e]
+    return out

@@ -1,0 +1,119 @@
+"""Pipeline parallelism (pp): stage-sharded layers with microbatches
+relayed rank-to-rank via ``ppermute`` — the neighbor-only ring-relay
+schedule (fw eager gather relay ``ccl_offload_control.c:1207-1295``)
+applied to activations instead of collective payloads.
+
+GPipe-style schedule over ``world`` stages and ``M`` microbatches, as ONE
+jitted shard_map program: at step ``s`` stage ``r`` processes microbatch
+``s - r`` (bubble steps compute on zeros and are masked out), then every
+activation hops one rank forward. ``M + world - 1`` steps total, all
+static shapes, the scan body is a single fused compute+``ppermute``
+schedule XLA can overlap.
+
+Layout:
+  stage params: (world, d, d) — rank r owns stage r's weight
+  input x:      (world, M, n, d) — rank 0's shard holds the microbatches
+  output:       (world, M, n, d) — rank world-1's shard holds the results
+"""
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import numpy as np
+
+from ..communicator import Communicator
+from ..parallel.primitives import AXIS, _smap
+from ..parallel.ring import _fwd_perm
+
+
+class StageParams(NamedTuple):
+    w: jax.Array  # (world, d, d) — stage-sharded
+    b: jax.Array  # (world, d)
+
+
+def init_params(key, comm: Communicator, d_model: int) -> StageParams:
+    kw, _ = jax.random.split(key)
+    scale = (1.0 / d_model) ** 0.5
+    return StageParams(
+        w=jax.random.normal(kw, (comm.world_size, d_model, d_model),
+                            jnp.float32) * scale,
+        b=jnp.zeros((comm.world_size, d_model), jnp.float32),
+    )
+
+
+def shard_params(params: StageParams, comm: Communicator) -> StageParams:
+    from jax.sharding import PartitionSpec as P
+    return StageParams(
+        w=jax.device_put(params.w, comm.sharding(P(AXIS, None, None))),
+        b=jax.device_put(params.b, comm.sharding(P(AXIS, None))),
+    )
+
+
+def _stage(w, b, h):
+    return jax.nn.relu(h @ w + b)
+
+
+def build_pipeline_forward(comm: Communicator, n_micro: int) -> Callable:
+    """Compile the GPipe forward over the communicator's ranks as stages.
+
+    Input x: (world, M, n, d) with rank 0's shard carrying the real
+    microbatches (other shards ignored); output (world, M, n, d) with the
+    results in rank world-1's shard (other shards zero).
+    """
+    world = comm.world_size
+    perm = _fwd_perm(world)
+    steps = n_micro + world - 1
+
+    def body(params: StageParams, x):
+        w, b = params.w[0], params.b[0]            # my stage's weights
+        x = x[0]                                   # (M, n, d); rank0's real
+        rank = lax.axis_index(AXIS)
+        M, n, d = x.shape
+        if M != n_micro:  # trace-time shape constant — fail loud, not zeros
+            raise ValueError(
+                f"input has {M} microbatches but the pipeline was compiled "
+                f"for n_micro={n_micro}")
+
+        def step(carry, s):
+            h, out = carry
+            # rank 0 injects microbatch s (zeros during drain steps);
+            # other ranks consume what arrived from the previous rank
+            mb = jnp.clip(s, 0, M - 1)
+            inject = lax.dynamic_index_in_dim(x, mb, axis=0, keepdims=False)
+            inject = jnp.where(s < M, inject, jnp.zeros_like(inject))
+            h = jnp.where(rank == 0, inject, h)
+            y = _stage(w, b, h)
+            # my microbatch index at step s is s - rank; the last stage
+            # banks finished microbatches into the output slab
+            my_mb = s - rank
+            live = (my_mb >= 0) & (my_mb < M)
+            slot = jnp.clip(my_mb, 0, M - 1)
+            banked = lax.dynamic_update_index_in_dim(
+                out, y, slot, axis=0)
+            out = jnp.where((rank == world - 1) & live, banked, out)
+            # relay every activation one stage forward (the ring hop)
+            h = lax.ppermute(y, AXIS, perm)
+            return (h, out), None
+
+        h0 = jnp.zeros((n, d), x.dtype)
+        out0 = jnp.zeros((M, n, d), x.dtype)
+        (_, out), _ = lax.scan(step, (h0, out0), jnp.arange(steps))
+        return out[None]
+
+    from jax.sharding import PartitionSpec as P
+    specs = StageParams(w=P(AXIS, None, None), b=P(AXIS, None))
+    return _smap(comm, body, 2,
+                 in_specs=(specs, P(AXIS, None, None, None)))
+
+
+def reference_pipeline(params: StageParams, x: np.ndarray) -> np.ndarray:
+    """Host reference: the stages applied sequentially to each microbatch."""
+    w = np.asarray(params.w, np.float64)
+    b = np.asarray(params.b, np.float64)
+    h = x.astype(np.float64)                       # (M, n, d)
+    for s in range(w.shape[0]):
+        h = np.maximum(h @ w[s] + b[s], 0.0)
+    return h
