@@ -1,0 +1,129 @@
+"""Flash attention as a Pallas TPU kernel — the fused-attention plugin
+lane for the context-parallel layers.
+
+Blockwise softmax attention with the canonical streaming schedule: grid
+(heads, q-blocks, k-blocks), k innermost, so for one (head, q-block) the
+running max / normalizer / accumulator persist in VMEM scratch across all
+k-blocks — scores never materialize beyond one (block_q, block_k) tile,
+both matmuls ride the MXU with f32 accumulation, and with ``causal=True``
+fully-masked k-blocks are skipped entirely (``pl.when``).
+
+This is the single-chip compute core the distributed layers compose with:
+``parallel.context.build_ulysses_attention(use_flash=True)`` runs it on
+each rank's head group after the all-to-all reshard, and on one chip it IS
+the attention. Interpret mode (CPU emulator rung) uses the same
+``InterpretParams`` seam as :mod:`..parallel.pallas_ring`.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_F32 = jnp.float32
+_NEG_INF = -1e30  # finite sentinel: keeps exp() exact-zero without nan paths
+
+
+def _interpret_params():
+    if jax.default_backend() == "tpu":
+        return None
+    return pltpu.InterpretParams()
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+            causal: bool, scale: float, block_q: int, block_k: int):
+    i = pl.program_id(1)          # q-block
+    j = pl.program_id(2)          # k-block (innermost: scratch carries)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def _block():
+        q = q_ref[0]              # (block_q, d)
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=_F32) * scale          # (bq, bk)
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_prev = m_ref[:]                                  # (bq, 128)
+        row_max = jnp.max(s, axis=-1, keepdims=True)       # (bq, 1)
+        m_new = jnp.maximum(m_prev, row_max)               # (bq, 128)
+        p = jnp.exp(s - m_new[:, :1])                      # (bq, bk)
+        alpha = jnp.exp(m_prev - m_new)                    # (bq, 128)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, -1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=_F32)                   # (bq, d)
+        acc_ref[:] = acc_ref[:] * alpha[:, :1] + pv
+        m_ref[:] = m_new
+
+    if causal:
+        # k-blocks strictly above the diagonal contribute nothing: skip
+        # both matmuls. A block is dead iff even its first column exceeds
+        # the q-block's last row — compare element ranges, not block
+        # indices (block_q and block_k may differ)
+        pl.when(j * block_k < (i + 1) * block_q)(_block)
+    else:
+        _block()
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128):
+    """Fused blockwise attention. q/k/v: (H, S, d) (or (S, d), promoted).
+
+    Constraints (kernel tiling): S divisible by block_q and block_k, d a
+    multiple of 128 lanes. Callers with other shapes use the jnp path
+    (``parallel.context``'s online-softmax blocks — same math, unfused).
+    """
+    single = q.ndim == 2
+    if single:
+        q, k, v = q[None], k[None], v[None]
+    H, S, d = q.shape
+    if S % block_q or S % block_k or d % 128:
+        raise ValueError(
+            f"flash_attention needs S % block ({S} % {block_q}/{block_k}) "
+            f"== 0 and d % 128 ({d}) == 0")
+    sc = scale if scale is not None else 1.0 / (d ** 0.5)
+    nq, nk = S // block_q, S // block_k
+
+    kernel = functools.partial(_kernel, causal=causal, scale=sc,
+                               block_q=block_q, block_k=block_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=(H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((H, S, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), _F32),     # acc
+            pltpu.VMEM((block_q, 128), _F32),   # running max (lane-replicated)
+            pltpu.VMEM((block_q, 128), _F32),   # normalizer
+        ],
+        interpret=_interpret_params() or False,
+    )(q, k, v)
+    return out[0] if single else out

@@ -121,7 +121,8 @@ def build_ring_attention(comm: Communicator, causal: bool = False,
 
 def build_ulysses_attention(comm: Communicator, n_heads: int,
                             causal: bool = False,
-                            scale: Optional[float] = None) -> Callable:
+                            scale: Optional[float] = None,
+                            use_flash: bool = False) -> Callable:
     """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism.
 
     Inputs: q, k, v of global shape (world, n, n_heads, d) — sequence
@@ -130,6 +131,11 @@ def build_ulysses_attention(comm: Communicator, n_heads: int,
     locally (blockwise online softmax — O(S·n) memory, never the (S, S)
     score matrix), and the inverse all-to-all restores sequence sharding.
     ``n_heads`` must be divisible by the world size.
+
+    ``use_flash`` runs the local attention through the fused Pallas flash
+    kernel (:mod:`accl_tpu.ops.flash`) — requires the global sequence to
+    be a multiple of its 128-wide blocks and ``d % 128 == 0``; shape
+    violations raise at first trace.
     """
     world = comm.world_size
     if n_heads % world != 0:
@@ -168,7 +174,11 @@ def build_ulysses_attention(comm: Communicator, n_heads: int,
         qkv = lax.all_to_all(qkv, AXIS, split_axis=2, concat_axis=1,
                              tiled=True)              # (3, world*n, h, d)
         qh, kh, vh = (jnp.moveaxis(a, 1, 0) for a in qkv)  # (h, S, d) each
-        out = local_attn(qh, kh, vh, n, sc)           # (h, S, d)
+        if use_flash:
+            from ..ops import flash
+            out = flash.flash_attention(qh, kh, vh, causal=causal, scale=sc)
+        else:
+            out = local_attn(qh, kh, vh, n, sc)       # (h, S, d)
         # inverse: scatter sequence blocks back to their owners, gather
         # every head group (in rank order = global head order)
         back = lax.all_to_all(out, AXIS, split_axis=1, concat_axis=0,
