@@ -29,9 +29,10 @@ _NEG_INF = -1e30  # finite sentinel: keeps exp() exact-zero without nan paths
 
 
 def _interpret_params():
-    if jax.default_backend() == "tpu":
-        return None
-    return pltpu.InterpretParams()
+    # the patchable seam shared by every Pallas kernel family (tests patch
+    # pallas_ring._interpret_params, e.g. to enable detect_races)
+    from ..parallel import pallas_ring
+    return pallas_ring._interpret_params()
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
@@ -95,6 +96,11 @@ def flash_attention(q, k, v, causal: bool = False,
     Constraints (kernel tiling): S divisible by block_q and block_k, d a
     multiple of 128 lanes. Callers with other shapes use the jnp path
     (``parallel.context``'s online-softmax blocks — same math, unfused).
+
+    **Forward/inference only**: there is no backward kernel yet.
+    ``jax.grad`` through this function raises a clear NotImplementedError;
+    training paths use the differentiable blockwise implementation
+    (``build_ulysses_attention(use_flash=False)``, the default).
     """
     single = q.ndim == 2
     if single:
@@ -105,8 +111,32 @@ def flash_attention(q, k, v, causal: bool = False,
             f"flash_attention needs S % block ({S} % {block_q}/{block_k}) "
             f"== 0 and d % 128 ({d}) == 0")
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
-    nq, nk = S // block_q, S // block_k
+    out = _flash_fwd_only(q, k, v, causal, sc, block_q, block_k)
+    return out[0] if single else out
 
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_fwd_only(q, k, v, causal, sc, block_q, block_k):
+    return _flash_call(q, k, v, causal, sc, block_q, block_k)
+
+
+def _flash_vjp_fwd(q, k, v, causal, sc, block_q, block_k):
+    return _flash_call(q, k, v, causal, sc, block_q, block_k), None
+
+
+def _flash_vjp_bwd(causal, sc, block_q, block_k, res, g):
+    raise NotImplementedError(
+        "flash_attention has no backward kernel; use the differentiable "
+        "blockwise path for training (e.g. build_ulysses_attention with "
+        "use_flash=False, the default)")
+
+
+_flash_fwd_only.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def _flash_call(q, k, v, causal, sc, block_q, block_k):
+    H, S, d = q.shape
+    nq, nk = S // block_q, S // block_k
     kernel = functools.partial(_kernel, causal=causal, scale=sc,
                                block_q=block_q, block_k=block_k)
     out = pl.pallas_call(
@@ -124,6 +154,10 @@ def flash_attention(q, k, v, causal: bool = False,
             pltpu.VMEM((block_q, 128), _F32),   # running max (lane-replicated)
             pltpu.VMEM((block_q, 128), _F32),   # normalizer
         ],
+        # heads and q-blocks are independent (megacore-splittable); only
+        # the k sweep is sequential (scratch carry)
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret_params() or False,
     )(q, k, v)
-    return out[0] if single else out
+    return out

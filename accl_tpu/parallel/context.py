@@ -135,7 +135,9 @@ def build_ulysses_attention(comm: Communicator, n_heads: int,
     ``use_flash`` runs the local attention through the fused Pallas flash
     kernel (:mod:`accl_tpu.ops.flash`) — requires the global sequence to
     be a multiple of its 128-wide blocks and ``d % 128 == 0``; shape
-    violations raise at first trace.
+    violations raise at first trace. The flash lane is **forward-only**
+    (no backward kernel yet; ``jax.grad`` raises a clear error) — keep the
+    default blockwise path for training.
     """
     world = comm.world_size
     if n_heads % world != 0:

@@ -77,6 +77,15 @@ def test_flash_rejects_bad_shapes(rng):
         flash.flash_attention(q2, q2, q2)       # d not lane-divisible
 
 
+def test_flash_backward_raises_clearly(rng):
+    """The flash lane is forward-only: jax.grad must fail with a pointed
+    NotImplementedError, not an opaque Pallas AD internal error."""
+    import jax.numpy as jnp
+    q = jnp.asarray(rng.standard_normal((1, 128, 128)).astype(np.float32))
+    with pytest.raises(NotImplementedError, match="backward kernel"):
+        jax.grad(lambda a: jnp.sum(flash.flash_attention(a, a, a)))(q)
+
+
 def test_ulysses_with_flash_local_attention(accl, rng):
     """use_flash routes the post-reshard local attention through the Pallas
     kernel; result must match the blockwise jnp path."""

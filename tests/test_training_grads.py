@@ -1,0 +1,86 @@
+"""Trainability: jax.grad flows through every parallel layer (ring and
+Ulysses attention, expert-parallel MoE, pipeline stages) with finite and —
+for ring attention — finite-difference-verified gradients. These layers
+exist to train models; forward-only would be parity theater."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accl_tpu.models import moe, pipeline
+from accl_tpu.parallel import context
+
+WORLD = 8
+
+
+def _finite(tree) -> bool:
+    return all(np.isfinite(np.asarray(g)).all()
+               for g in jax.tree_util.tree_leaves(tree))
+
+
+def test_ring_attention_grad_matches_finite_difference(accl, rng):
+    comm = accl.global_comm()
+    prog = context.build_ring_attention(comm, causal=True)
+    q = rng.standard_normal((WORLD, 4, 8)).astype(np.float32)
+
+    def loss(qq):
+        x = jax.device_put(qq, comm.sharding())
+        return jnp.sum(prog(x, x, x) ** 2)
+
+    g = np.asarray(jax.grad(loss)(jnp.asarray(q)))
+    assert np.isfinite(g).all()
+    # central finite differences on a few coordinates
+    eps = 1e-3
+    for idx in [(0, 0, 0), (3, 2, 5), (7, 3, 7)]:
+        qp, qm = q.copy(), q.copy()
+        qp[idx] += eps
+        qm[idx] -= eps
+        fd = (float(loss(jnp.asarray(qp))) - float(loss(jnp.asarray(qm)))) \
+            / (2 * eps)
+        assert abs(fd - g[idx]) < 5e-2 * max(1.0, abs(fd)), \
+            f"grad {g[idx]} vs fd {fd} at {idx}"
+
+
+def test_ulysses_attention_grad_finite(accl, rng):
+    comm = accl.global_comm()
+    uly = context.build_ulysses_attention(comm, n_heads=8, causal=True)
+    x = jax.device_put(
+        rng.standard_normal((WORLD, 8, 8, 16)).astype(np.float32),
+        comm.sharding())
+    g = jax.grad(lambda a: jnp.sum(uly(a, a, a) ** 2))(x)
+    assert _finite(g)
+    assert float(jnp.max(jnp.abs(g))) > 0.0
+
+
+def test_moe_grad_reaches_experts_and_router(accl, rng):
+    comm = accl.global_comm()
+    gp = moe.init_params(jax.random.PRNGKey(0), comm, 16, 32, 16)
+    params = moe.shard_params(gp, comm)
+    fwd = moe.build_moe_forward(comm, n_experts=16, capacity=8)
+    x = jax.device_put(rng.standard_normal((WORLD, 8, 16)).astype(np.float32),
+                       comm.sharding())
+    g = jax.grad(lambda p: jnp.sum(fwd(p, x) ** 2))(params)
+    assert _finite(g)
+    # the dispatch/combine all_to_all must transpose: expert weights AND the
+    # router both receive signal
+    assert float(jnp.max(jnp.abs(g.w_in))) > 0.0
+    assert float(jnp.max(jnp.abs(g.w_out))) > 0.0
+    assert float(jnp.max(jnp.abs(g.router))) > 0.0
+
+
+def test_pipeline_grad_reaches_every_stage(accl, rng):
+    comm = accl.global_comm()
+    gp = pipeline.init_params(jax.random.PRNGKey(1), comm, 8)
+    params = pipeline.shard_params(gp, comm)
+    pipe = pipeline.build_pipeline_forward(comm, n_micro=2)
+    xp = np.zeros((WORLD, 2, 2, 8), np.float32)
+    xp[0] = rng.standard_normal((2, 2, 8))
+    x = jax.device_put(xp, comm.sharding())
+    g = jax.grad(lambda p: jnp.sum(pipe(p, x) ** 2))(params)
+    assert _finite(g)
+    # the ppermute relay must transpose back through EVERY stage: each
+    # rank's stage weight gets nonzero gradient
+    gw = np.asarray(g.w)
+    for r in range(WORLD):
+        assert np.abs(gw[r]).max() > 0.0, f"stage {r} got no gradient"
