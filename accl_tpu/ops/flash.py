@@ -28,6 +28,13 @@ _F32 = jnp.float32
 _NEG_INF = -1e30  # finite sentinel: keeps exp() exact-zero without nan paths
 
 
+def _pad_rows(block_q: int) -> int:
+    """lse/dd slab sublanes per q-block: block_q/128 rounded up to the
+    8-sublane tile."""
+    rows = block_q // 128
+    return ((rows + 7) // 8) * 8
+
+
 def _interpret_params():
     # the patchable seam shared by every Pallas kernel family (tests patch
     # pallas_ring._interpret_params, e.g. to enable detect_races)
@@ -35,7 +42,7 @@ def _interpret_params():
     return pallas_ring._interpret_params()
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
             causal: bool, scale: float, block_q: int, block_k: int):
     i = pl.program_id(1)          # q-block
     j = pl.program_id(2)          # k-block (innermost: scratch carries)
@@ -86,6 +93,16 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         l = l_ref[:, :1]
         safe_l = jnp.where(l > 0, l, 1.0)
         o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        # log-sum-exp per row, stored per q-block in an (pad_rows, 128)
+        # lane-tiled slab (TPU blocks need tile-legal trailing dims, and a
+        # per-(h, i) block keeps VMEM O(block_q) and the q dimension
+        # megacore-parallel)
+        lse = (m_ref[:, 0] + jnp.log(safe_l[:, 0]))
+        rows = block_q // 128
+        lse_ref[0, 0, :rows] = lse.reshape(rows, 128)
+        if rows < lse_ref.shape[2]:       # zero the 8-sublane padding tail
+            lse_ref[0, 0, rows:] = jnp.zeros(
+                (lse_ref.shape[2] - rows, 128), _F32)
 
 
 def flash_attention(q, k, v, causal: bool = False,
@@ -97,49 +114,60 @@ def flash_attention(q, k, v, causal: bool = False,
     multiple of 128 lanes. Callers with other shapes use the jnp path
     (``parallel.context``'s online-softmax blocks — same math, unfused).
 
-    **Forward/inference only**: there is no backward kernel yet.
-    ``jax.grad`` through this function raises a clear NotImplementedError;
-    training paths use the differentiable blockwise implementation
-    (``build_ulysses_attention(use_flash=False)``, the default).
+    Differentiable: the custom VJP runs the canonical two-pass flash
+    backward (dK/dV kernel sweeping q-blocks, dQ kernel sweeping
+    k-blocks), recomputing probabilities from the saved log-sum-exp so
+    the (S, S) score matrix never materializes in either direction.
     """
     single = q.ndim == 2
     if single:
         q, k, v = q[None], k[None], v[None]
     H, S, d = q.shape
-    if S % block_q or S % block_k or d % 128:
+    if S % block_q or S % block_k or d % 128 or block_q % 128:
         raise ValueError(
             f"flash_attention needs S % block ({S} % {block_q}/{block_k}) "
-            f"== 0 and d % 128 ({d}) == 0")
+            f"== 0, block_q % 128 == 0 ({block_q}) and d % 128 ({d}) == 0")
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
-    out = _flash_fwd_only(q, k, v, causal, sc, block_q, block_k)
+    out = _flash(q, k, v, causal, sc, block_q, block_k)
     return out[0] if single else out
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_fwd_only(q, k, v, causal, sc, block_q, block_k):
-    return _flash_call(q, k, v, causal, sc, block_q, block_k)
+def _flash(q, k, v, causal, sc, block_q, block_k):
+    return _flash_fwd_call(q, k, v, causal, sc, block_q, block_k)[0]
 
 
 def _flash_vjp_fwd(q, k, v, causal, sc, block_q, block_k):
-    return _flash_call(q, k, v, causal, sc, block_q, block_k), None
+    out, lse = _flash_fwd_call(q, k, v, causal, sc, block_q, block_k)
+    return out, (q, k, v, out, lse)
 
 
-def _flash_vjp_bwd(causal, sc, block_q, block_k, res, g):
-    raise NotImplementedError(
-        "flash_attention has no backward kernel; use the differentiable "
-        "blockwise path for training (e.g. build_ulysses_attention with "
-        "use_flash=False, the default)")
+def _flash_vjp_bwd(causal, sc, block_q, block_k, res, do):
+    q, k, v, out, lse = res
+    H, S, _ = q.shape
+    # D_i = rowsum(dO ∘ O) — the softmax-jacobian correction term, stored
+    # in the same per-q-block lane-tiled slab layout as lse
+    nq, rows, pr = S // block_q, block_q // 128, _pad_rows(block_q)
+    dd = jnp.sum(do.astype(_F32) * out.astype(_F32), axis=-1)
+    dd = dd.reshape(H, nq, rows, 128)
+    if pr != rows:
+        dd = jnp.pad(dd, ((0, 0), (0, 0), (0, pr - rows), (0, 0)))
+    dk, dv = _flash_bwd_kv(q, k, v, do, lse, dd, causal, sc,
+                           block_q, block_k)
+    dq = _flash_bwd_q(q, k, v, do, lse, dd, causal, sc, block_q, block_k)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-_flash_fwd_only.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def _flash_call(q, k, v, causal, sc, block_q, block_k):
+def _flash_fwd_call(q, k, v, causal, sc, block_q, block_k):
     H, S, d = q.shape
     nq, nk = S // block_q, S // block_k
+    pr = _pad_rows(block_q)
     kernel = functools.partial(_kernel, causal=causal, scale=sc,
                                block_q=block_q, block_k=block_k)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(H, nq, nk),
         in_specs=[
@@ -147,17 +175,176 @@ def _flash_call(q, k, v, causal, sc, block_q, block_k):
             pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((H, S, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, 1, pr, 128), lambda h, i, j: (h, i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((H, S, d), q.dtype),
+            jax.ShapeDtypeStruct((H, nq, pr, 128), _F32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), _F32),     # acc
             pltpu.VMEM((block_q, 128), _F32),   # running max (lane-replicated)
             pltpu.VMEM((block_q, 128), _F32),   # normalizer
         ],
-        # heads and q-blocks are independent (megacore-splittable); only
-        # the k sweep is sequential (scratch carry)
+        # heads and q-blocks are independent (megacore-splittable);
+        # only the k sweep is sequential (scratch carry)
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret_params() or False,
     )(q, k, v)
-    return out
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward kernels (the canonical two-pass flash backward):
+#   p  = exp(s - lse)                      (recomputed, never stored)
+#   dV = pᵀ dO
+#   dS = p ∘ (dO Vᵀ - D) · scale,  D = rowsum(dO ∘ O)
+#   dK = dSᵀ Q     dQ = dS K
+# ---------------------------------------------------------------------------
+
+def _recompute_p_ds(q, kb, vb, do, lse, dd, i, j, causal, sc,
+                    block_q, block_k):
+    s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=_F32) * sc   # (bq, bk)
+    if causal:
+        rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(rows >= cols, s, _NEG_INF)
+    p = jnp.exp(s - lse[:, None])                               # (bq, bk)
+    dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                             preferred_element_type=_F32)       # (bq, bk)
+    ds = p * (dp - dd[:, None]) * sc
+    return p, ds
+
+
+def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+                   dk_ref, dv_ref, dk_acc, dv_acc, *,
+                   causal: bool, scale: float, block_q: int, block_k: int):
+    j = pl.program_id(1)          # k-block (this kernel's subject)
+    i = pl.program_id(2)          # q sweep (innermost: scratch carries)
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _block():
+        rows = block_q // 128
+        p, ds = _recompute_p_ds(
+            q_ref[0], k_ref[0], v_ref[0], do_ref[0].astype(_F32),
+            lse_ref[0, 0, :rows].reshape(block_q),
+            dd_ref[0, 0, :rows].reshape(block_q),
+            i, j, causal, scale, block_q, block_k)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do_ref[0].astype(_F32), (((0,), (0,)), ((), ())),
+            preferred_element_type=_F32)                        # (bk, d)
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q_ref[0].astype(_F32), (((0,), (0,)), ((), ())),
+            preferred_element_type=_F32)                        # (bk, d)
+
+    if causal:
+        pl.when(j * block_k < (i + 1) * block_q)(_block)
+    else:
+        _block()
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+                  dq_ref, dq_acc, *,
+                  causal: bool, scale: float, block_q: int, block_k: int):
+    i = pl.program_id(1)          # q-block (this kernel's subject)
+    j = pl.program_id(2)          # k sweep (innermost: scratch carries)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _block():
+        rows = block_q // 128
+        _, ds = _recompute_p_ds(
+            q_ref[0], k_ref[0], v_ref[0], do_ref[0].astype(_F32),
+            lse_ref[0, 0, :rows].reshape(block_q),
+            dd_ref[0, 0, :rows].reshape(block_q),
+            i, j, causal, scale, block_q, block_k)
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k_ref[0].astype(_F32), (((1,), (0,)), ((), ())),
+            preferred_element_type=_F32)                        # (bq, d)
+
+    if causal:
+        pl.when(j * block_k < (i + 1) * block_q)(_block)
+    else:
+        _block()
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_kv(q, k, v, do, lse, dd, causal, sc, block_q, block_k):
+    H, S, d = q.shape
+    nq, nk = S // block_q, S // block_k
+    pr = _pad_rows(block_q)
+    kernel = functools.partial(_bwd_kv_kernel, causal=causal, scale=sc,
+                               block_q=block_q, block_k=block_k)
+    return pl.pallas_call(
+        kernel,
+        grid=(H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, j, i: (h, i, 0)),  # q
+            pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0)),  # k
+            pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0)),  # v
+            pl.BlockSpec((1, block_q, d), lambda h, j, i: (h, i, 0)),  # do
+            pl.BlockSpec((1, 1, pr, 128), lambda h, j, i: (h, i, 0, 0)),
+            pl.BlockSpec((1, 1, pr, 128), lambda h, j, i: (h, i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((H, S, d), _F32),   # dk
+            jax.ShapeDtypeStruct((H, S, d), _F32),   # dv
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), _F32),
+            pltpu.VMEM((block_k, d), _F32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret_params() or False,
+    )(q, k, v, do, lse, dd)
+
+
+def _flash_bwd_q(q, k, v, do, lse, dd, causal, sc, block_q, block_k):
+    H, S, d = q.shape
+    nq, nk = S // block_q, S // block_k
+    pr = _pad_rows(block_q)
+    kernel = functools.partial(_bwd_q_kernel, causal=causal, scale=sc,
+                               block_q=block_q, block_k=block_k)
+    return pl.pallas_call(
+        kernel,
+        grid=(H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),  # q
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),  # k
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),  # v
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),  # do
+            pl.BlockSpec((1, 1, pr, 128), lambda h, i, j: (h, i, 0, 0)),
+            pl.BlockSpec((1, 1, pr, 128), lambda h, i, j: (h, i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((H, S, d), _F32),     # dq
+        scratch_shapes=[pltpu.VMEM((block_q, d), _F32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret_params() or False,
+    )(q, k, v, do, lse, dd)

@@ -133,11 +133,9 @@ def build_ulysses_attention(comm: Communicator, n_heads: int,
     ``n_heads`` must be divisible by the world size.
 
     ``use_flash`` runs the local attention through the fused Pallas flash
-    kernel (:mod:`accl_tpu.ops.flash`) — requires the global sequence to
-    be a multiple of its 128-wide blocks and ``d % 128 == 0``; shape
-    violations raise at first trace. The flash lane is **forward-only**
-    (no backward kernel yet; ``jax.grad`` raises a clear error) — keep the
-    default blockwise path for training.
+    kernel (:mod:`accl_tpu.ops.flash`, forward AND backward kernels) —
+    requires the global sequence to be a multiple of its 128-wide blocks
+    and ``d % 128 == 0``; shape violations raise at first trace.
     """
     world = comm.world_size
     if n_heads % world != 0:

@@ -77,13 +77,54 @@ def test_flash_rejects_bad_shapes(rng):
         flash.flash_attention(q2, q2, q2)       # d not lane-divisible
 
 
-def test_flash_backward_raises_clearly(rng):
-    """The flash lane is forward-only: jax.grad must fail with a pointed
-    NotImplementedError, not an opaque Pallas AD internal error."""
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_matches_autodiff_reference(rng, causal):
+    """The two-pass flash backward (custom VJP) must match jax.grad of a
+    dense jnp attention, for all three operands."""
     import jax.numpy as jnp
-    q = jnp.asarray(rng.standard_normal((1, 128, 128)).astype(np.float32))
-    with pytest.raises(NotImplementedError, match="backward kernel"):
-        jax.grad(lambda a: jnp.sum(flash.flash_attention(a, a, a)))(q)
+    H, S, d = 2, 256, 128
+    q, k, v = (jnp.asarray(rng.standard_normal((H, S, d)).astype(np.float32))
+               for _ in range(3))
+
+    def dense(q, k, v):
+        sc = 1.0 / np.sqrt(d)
+        s = jnp.einsum("hqd,hkd->hqk", q, k) * sc
+        if causal:
+            mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+            s = jnp.where(mask[None], s, -jnp.inf)
+        return jnp.einsum("hqk,hkd->hqd", jax.nn.softmax(s, -1), v)
+
+    cot = jnp.asarray(rng.standard_normal((H, S, d)).astype(np.float32))
+    loss_f = lambda f: (lambda a, b, c: jnp.sum(f(a, b, c) * cot))
+    gf = jax.grad(loss_f(
+        lambda a, b, c: flash.flash_attention(a, b, c, causal=causal)),
+        argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_f(dense), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-2, atol=2e-3,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_flash_backward_unequal_blocks(rng):
+    """Causal backward with block_q != block_k: the dead-block predicates
+    in BOTH backward kernels must compare element ranges."""
+    import jax.numpy as jnp
+    H, S, d = 1, 512, 128
+    q, k, v = (jnp.asarray(rng.standard_normal((H, S, d)).astype(np.float32))
+               for _ in range(3))
+
+    def loss(f):
+        return lambda a, b, c: jnp.sum(f(a, b, c) ** 2)
+
+    g1 = jax.grad(loss(lambda a, b, c: flash.flash_attention(
+        a, b, c, causal=True, block_q=256, block_k=128)),
+        argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss(lambda a, b, c: flash.flash_attention(
+        a, b, c, causal=True)), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-2, atol=2e-3)
 
 
 def test_ulysses_with_flash_local_attention(accl, rng):
