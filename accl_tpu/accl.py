@@ -206,6 +206,19 @@ class ACCL:
     def set_max_rendezvous_size(self, nbytes: int) -> None:
         self.config = self.config.replace(max_rendezvous_size=nbytes)
 
+    def autotune(self, pows: Optional[Sequence[int]] = None,
+                 reps: int = 3) -> None:
+        """Re-derive the AUTO-selection size thresholds by measurement on
+        the live mesh (adaptive tuning registers — see
+        :mod:`accl_tpu.bench.autotune`). Drops the program cache so later
+        calls re-select with the tuned config."""
+        from .bench import autotune as _at
+        kw = {"reps": reps}
+        if pows is not None:
+            kw["pows"] = pows
+        self.config = _at.autotune_allreduce(self, **kw)
+        self._programs.clear()
+
     def config_call(self, function: constants.cfgFunc,
                     value: Optional[float] = None) -> None:
         """Housekeeping config call (``CCLO::Options.cfg_function`` →

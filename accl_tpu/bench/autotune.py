@@ -1,0 +1,102 @@
+"""Adaptive algorithm-selection tuning — the reference's tuning registers,
+re-derived by measurement.
+
+The reference writes flat-tree/size thresholds into exchange-memory tuning
+registers once at init (``accl.cpp:1214-1224``); the right values depend
+on the fabric, so they are guesses frozen at build time. Here the same
+knobs (``ACCLConfig.ring_threshold`` / ``hier_threshold``) are re-derived
+on the LIVE mesh: measure the candidate algorithm families over a payload
+sweep and place each threshold at the first size where the heavier
+algorithm actually wins. ``ACCL.autotune()`` applies the result to the
+session config, so every later AUTO-selected call uses measured crossover
+points instead of defaults.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..config import ACCLConfig, Algorithm, TransportBackend
+from ..constants import dataType, reduceFunction, to_jax_dtype
+from ..parallel import algorithms
+
+#: threshold value meaning "this algorithm never won within the sweep —
+#: AUTO never selects it" (the firmware's degenerate 'tree always' setting)
+DISABLED = 1 << 62
+
+
+def _time_prog(prog, x, reps: int) -> float:
+    import jax
+    from .harness import _pick
+    np.asarray(_pick(jax.block_until_ready(prog(x))))  # compile + warm
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(_pick(jax.block_until_ready(prog(x))))
+        ts.append(time.perf_counter() - t0)
+    return float(np.min(ts))
+
+
+def measure_allreduce(comm, counts: Sequence[int],
+                      algos: Sequence[Algorithm],
+                      dt: dataType = dataType.float32,
+                      reps: int = 3) -> Dict[Algorithm, List[float]]:
+    """Per-algorithm best-of-`reps` wall time for each payload count."""
+    import jax
+    npdt = np.dtype(to_jax_dtype(dt))
+    out: Dict[Algorithm, List[float]] = {a: [] for a in algos}
+    for algo in algos:
+        for n in counts:
+            prog = algorithms.build_allreduce(
+                comm, reduceFunction.SUM, dt, algo, None)
+            x = jax.device_put(
+                np.full((comm.world_size, n), 1e-6, npdt), comm.sharding())
+            out[algo].append(_time_prog(prog, x, reps))
+    return out
+
+
+def _crossover(counts: Sequence[int], base: List[float],
+               cand: List[float], elem_bytes: int) -> Optional[int]:
+    """Smallest payload (bytes) from which `cand` stays faster than `base`
+    for the rest of the sweep; None if it never wins."""
+    for idx in range(len(counts)):
+        if all(c < b for c, b in zip(cand[idx:], base[idx:])):
+            return counts[idx] * elem_bytes
+    return None
+
+
+def autotune_allreduce(acc, pows: Sequence[int] = (10, 14, 18, 21),
+                       reps: int = 3,
+                       dt: dataType = dataType.float32) -> ACCLConfig:
+    """Measure XLA vs RING (vs HIERARCHICAL on composite worlds) and return
+    the session config with measured ALLREDUCE thresholds — the per-op
+    allgather/reduce_scatter knobs are deliberately untouched (their units
+    and crossovers were not measured here). An algorithm that never wins
+    gets the DISABLED sentinel, mirroring the firmware's 'tree always'
+    degenerate settings. On a DCN mesh the measurement includes the real
+    cross-host links, so the tuned value lands in ``dcn_hier_threshold``."""
+    comm = acc.global_comm()
+    counts = [2 ** p for p in pows]
+    elem = np.dtype(to_jax_dtype(dt)).itemsize
+    algos = [Algorithm.XLA, Algorithm.RING]
+    has_hier = algorithms._hier_shape(comm) is not None
+    if has_hier:
+        algos.append(Algorithm.HIERARCHICAL)
+    t = measure_allreduce(comm, counts, algos, dt, reps)
+
+    ring_at = _crossover(counts, t[Algorithm.XLA], t[Algorithm.RING], elem)
+    cfg = acc.config.replace(
+        ring_threshold=ring_at if ring_at is not None else DISABLED)
+    if has_hier:
+        # hierarchical competes with whatever wins at each size
+        best = [min(a, b) for a, b in zip(t[Algorithm.XLA],
+                                          t[Algorithm.RING])]
+        hier_at = _crossover(counts, best, t[Algorithm.HIERARCHICAL], elem)
+        hier_val = hier_at if hier_at is not None else DISABLED
+        if cfg.transport == TransportBackend.DCN:
+            cfg = cfg.replace(dcn_hier_threshold=hier_val)
+        else:
+            cfg = cfg.replace(hier_threshold=hier_val)
+    return cfg

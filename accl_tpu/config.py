@@ -72,6 +72,17 @@ class ACCLConfig:
     reduce_flat_tree_max_count: int = 64 * 1024
     gather_flat_tree_max_fanin: int = 8
 
+    # AUTO-selection size thresholds (tuning-register tier; the allreduce
+    # ones are adaptively re-derived on the live mesh by
+    # accl_tpu.bench.autotune — per-op knobs, like the reference's
+    # per-collective tuning registers, so tuning one op never perturbs
+    # another)
+    ring_threshold: int = 4 * 1024 * 1024      # allreduce: RING above (bytes)
+    hier_threshold: int = 64 * 1024 * 1024     # allreduce: HIERARCHICAL above
+    dcn_hier_threshold: int = 64 * 1024        # multi-host meshes: much lower
+    ag_ring_threshold: int = 4 * 1024 * 1024   # allgather (per-block bytes)
+    rs_ring_threshold: int = 4 * 1024 * 1024   # reduce_scatter (total bytes)
+
     # timeout for request waits, in seconds (HOUSEKEEP_TIMEOUT analog)
     timeout: float = 60.0
 

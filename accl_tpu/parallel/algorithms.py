@@ -24,12 +24,15 @@ from ..config import ACCLConfig, Algorithm, TransportBackend
 from ..constants import ACCLError, dataType, errorCode, operation, reduceFunction
 from . import flat, hierarchical, pallas_ring, primitives, ring, tree
 
-#: payload size above which AUTO prefers the explicit ring (bytes)
+#: default payload size above which AUTO prefers the explicit ring (bytes);
+#: per-session values live in ACCLConfig.ring_threshold (autotunable)
 RING_THRESHOLD = 4 * 1024 * 1024
-#: payload size above which AUTO prefers hierarchical 2D on composite worlds
+#: default payload size above which AUTO prefers hierarchical 2D on
+#: composite worlds; per-session: ACCLConfig.hier_threshold
 HIER_THRESHOLD = 64 * 1024 * 1024
-#: on a multi-host (DCN) mesh, hierarchical wins much earlier: the heavy
-#: phases stay on intra-host ICI and only the n/cols shard crosses the DCN
+#: default for ACCLConfig.dcn_hier_threshold — on a multi-host (DCN) mesh
+#: hierarchical wins much earlier: the heavy phases stay on intra-host ICI
+#: and only the n/cols shard crosses the DCN
 DCN_HIER_THRESHOLD = 64 * 1024
 
 
@@ -83,19 +86,24 @@ def select(
     on_dcn = cfg.transport == TransportBackend.DCN
     if on_dcn:
         # multi-host: long edges are expensive. Hierarchical allreduce as
-        # soon as the payload justifies it; log-depth trees for rooted
-        # rendezvous ops (a flat star would cross the DCN world-1 times)
-        if op == operation.allreduce and nbytes >= DCN_HIER_THRESHOLD \
+        # soon as the payload justifies it (cfg.dcn_hier_threshold — set
+        # by autotune when measured on the live DCN mesh); log-depth trees
+        # for rooted rendezvous ops (a flat star would cross the DCN
+        # world-1 times)
+        if op == operation.allreduce and nbytes >= cfg.dcn_hier_threshold \
                 and _hier_shape(comm) is not None:
             return Algorithm.HIERARCHICAL
         if op in (operation.bcast, operation.reduce) \
                 and nbytes > cfg.max_eager_size:
             return Algorithm.TREE
-    if op == operation.allreduce and nbytes >= HIER_THRESHOLD \
+    if op == operation.allreduce and nbytes >= cfg.hier_threshold \
             and _hier_shape(comm) is not None:
         return Algorithm.HIERARCHICAL
-    if op in (operation.allreduce, operation.allgather, operation.reduce_scatter) \
-            and nbytes >= RING_THRESHOLD:
+    if op == operation.allreduce and nbytes >= cfg.ring_threshold:
+        return Algorithm.RING
+    if op == operation.allgather and nbytes >= cfg.ag_ring_threshold:
+        return Algorithm.RING
+    if op == operation.reduce_scatter and nbytes >= cfg.rs_ring_threshold:
         return Algorithm.RING
     if nbytes > cfg.max_eager_size:
         # rendezvous regime: the fw picks flat vs binary tree by world size

@@ -1,0 +1,59 @@
+"""Adaptive tuning registers: measured crossover thresholds replace the
+static defaults, and AUTO selection honors them."""
+import numpy as np
+import pytest
+
+import jax
+
+import accl_tpu
+from accl_tpu import Algorithm, dataType, reduceFunction
+from accl_tpu.bench import autotune
+from accl_tpu.constants import operation
+from accl_tpu.parallel import algorithms
+
+WORLD = 8
+
+
+def test_crossover_logic():
+    counts = [16, 64, 256, 1024]
+    # candidate wins from index 2 on
+    base = [1.0, 1.0, 1.0, 1.0]
+    cand = [2.0, 1.5, 0.5, 0.4]
+    assert autotune._crossover(counts, base, cand, 4) == 256 * 4
+    # never wins
+    assert autotune._crossover(counts, base, [3.0] * 4, 4) is None
+    # wins early then loses -> crossover is where it stays ahead
+    assert autotune._crossover(counts, base, [0.5, 2.0, 0.4, 0.4], 4) \
+        == 256 * 4
+
+
+def test_autotune_produces_honored_config(accl):
+    tuned = autotune.autotune_allreduce(accl, pows=(6, 9), reps=1)
+    assert tuned.ring_threshold > 0
+    assert tuned.hier_threshold > 0
+    # the tuned config changes AUTO selection consistently with the values
+    comm = accl.global_comm()
+    below = tuned.ring_threshold - 4
+    at = tuned.ring_threshold
+    if below > tuned.max_eager_size:  # stay out of the rendezvous regime
+        assert algorithms.select(operation.allreduce, below, comm, tuned) \
+            != Algorithm.RING or below >= tuned.ring_threshold
+    if at < tuned.hier_threshold:
+        assert algorithms.select(operation.allreduce, at, comm, tuned) \
+            == Algorithm.RING
+
+
+def test_accl_autotune_applies_and_clears_cache(accl, rng):
+    orig = accl.config
+    try:
+        accl.autotune(pows=(6, 9), reps=1)
+        assert accl.config.ring_threshold != 0
+        # collectives still correct with the tuned config in place
+        s = accl.create_buffer(64, dataType.int32)
+        r = accl.create_buffer(64, dataType.int32)
+        s.host[:] = rng.integers(-50, 50, (WORLD, 64)).astype(np.int32)
+        accl.allreduce(s, r, 64, reduceFunction.SUM)
+        np.testing.assert_array_equal(
+            r.host, np.tile(s.host.sum(0), (WORLD, 1)))
+    finally:
+        accl.config = orig
