@@ -65,7 +65,13 @@ class ACCL:
         config: Optional[ACCLConfig] = None,
     ):
         self.config = config or ACCLConfig()
-        self._devices = list(devices) if devices is not None else list(jax.devices())
+        if devices is not None:
+            self._devices = list(devices)  # explicit order is the caller's
+        else:
+            self._devices = list(jax.devices())
+            if self.config.topology_order:
+                from .utils.bringup import snake_order
+                self._devices = snake_order(self._devices)
         self.comms: List[Communicator] = []
         self._programs = ProgramCache()
         self._queue = RequestQueue()

@@ -94,6 +94,11 @@ class ACCLConfig:
     # plain jnp ops are used (XLA fuses them anyway — this is a debug switch)
     use_pallas: bool = True
 
+    # snake-order auto-discovered TPU devices by chip coordinates so ring
+    # neighbors are physical ICI neighbors (bringup.snake_order); explicit
+    # device lists are never reordered
+    topology_order: bool = True
+
     # default algorithm policy
     algorithm: Algorithm = Algorithm.AUTO
 

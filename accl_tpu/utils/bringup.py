@@ -33,6 +33,30 @@ def detect_backend(devices: Optional[Sequence[jax.Device]] = None
     return TransportBackend.DCN if len(hosts) > 1 else TransportBackend.ICI
 
 
+def snake_order(devices: Sequence[jax.Device]) -> List[jax.Device]:
+    """Order devices so consecutive ranks are physical ICI neighbors.
+
+    Ring algorithms hop rank r -> r+1 every step; with jax.devices()'s
+    default ordering those hops can land on arbitrary chips, crossing
+    multiple ICI links. A snake raster over the chip coordinates (x
+    fastest, direction alternating with y, y direction alternating with z)
+    makes every consecutive pair adjacent on the torus, so each ring hop
+    rides exactly one link. Devices without coords (CPU emulator) are
+    returned unchanged — rank order there is synthetic anyway.
+    """
+    devs = list(devices)
+    if not devs or getattr(devs[0], "coords", None) is None:
+        return devs
+
+    def key(d):
+        x, y, z = (tuple(d.coords) + (0, 0, 0))[:3]
+        ys = y if z % 2 == 0 else -y
+        xs = x if (z + y) % 2 == 0 else -x
+        return (z, ys, xs, getattr(d, "core_on_chip", 0))
+
+    return sorted(devs, key=key)
+
+
 def generate_ranks(
     devices: Optional[Sequence[jax.Device]] = None,
     max_segment_size: int = DEFAULT_SEGMENT_SIZE,
@@ -91,7 +115,12 @@ def initialize_accl(
 
     if simulator_ranks is not None:
         devices = simulated_devices(simulator_ranks)
+    auto = devices is None
     devices = list(devices) if devices is not None else jax.devices()
     backend = detect_backend(devices)
     cfg = (config or ACCLConfig()).replace(transport=backend)
+    if auto and cfg.topology_order:
+        # auto-discovered devices get the same snake ordering bare ACCL()
+        # applies; an explicit caller list is never reordered
+        devices = snake_order(devices)
     return ACCL(devices=devices, config=cfg)

@@ -131,6 +131,25 @@ def main() -> int:
         assert np.allclose(r.host[rank], W), r.host[rank][:4]
     print(f"[p{me}] ring allreduce ok", flush=True)
 
+    # ---- flat-tree star family SPMD across controllers -----------------
+    acc.allreduce(s, r, n, reduceFunction.SUM, algorithm=Algorithm.FLAT)
+    for rank in local:
+        assert np.allclose(r.host[rank], want), r.host[rank][:4]
+    g = acc.create_buffer(n * W, dataType.float32)
+    acc.gather(s, g, n, root=1, algorithm=Algorithm.FLAT)
+    if comm.rank_is_local(1):
+        assert np.allclose(g.host[1].reshape(W, n), s.host)
+    print(f"[p{me}] flat family ok", flush=True)
+
+    # ---- fused command list: one launch per controller per sequence ----
+    cl = acc.command_list()
+    cl.allreduce(s, r, n, reduceFunction.SUM)
+    cl.bcast(r, n, 2)
+    cl.execute()
+    for rank in local:
+        assert np.allclose(r.host[rank], want), r.host[rank][:4]
+    print(f"[p{me}] command list ok", flush=True)
+
     acc.barrier()
     print(f"[p{me}] MP-OK", flush=True)
     return 0

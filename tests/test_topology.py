@@ -86,6 +86,66 @@ def test_profile_writes_a_trace(accl, rng):
         assert glob.glob(td + "/**/*", recursive=True)
 
 
+def test_snake_order_makes_neighbors_adjacent():
+    """Snake raster over chip coords: every consecutive rank pair differs
+    by exactly one step on exactly one torus axis, so each ring hop rides
+    a single ICI link (2x4 and 4x4x1-style topologies)."""
+    from accl_tpu.utils.bringup import snake_order
+
+    class _Dev:
+        def __init__(self, coords):
+            self.coords = coords
+            self.core_on_chip = 0
+
+    for shape in ((4, 2, 1), (4, 4, 1), (2, 2, 2)):
+        devs = [_Dev((x, y, z))
+                for z in range(shape[2])
+                for y in range(shape[1])
+                for x in range(shape[0])]
+        import random
+        random.Random(0).shuffle(devs)     # discovery order is arbitrary
+        ordered = snake_order(devs)
+        assert len(ordered) == len(devs)
+        for a, b in zip(ordered, ordered[1:]):
+            diff = [abs(p - q) for p, q in zip(a.coords, b.coords)]
+            assert sum(diff) == 1, \
+                f"{a.coords} -> {b.coords} is not a single-link hop"
+
+
+def test_snake_order_passthrough_without_coords(accl):
+    """CPU devices (no coords) keep discovery order."""
+    from accl_tpu.utils.bringup import snake_order
+    import jax
+    devs = jax.devices()[:4]
+    assert snake_order(devs) == list(devs)
+    assert accl._devices == list(jax.devices()[:8])
+
+
+def test_explicit_device_list_never_reordered(monkeypatch):
+    """The 'explicit order is the caller's' contract, pinned with devices
+    that WOULD be reordered if snake ordering were (wrongly) applied."""
+    import accl_tpu
+    from accl_tpu.utils import bringup
+
+    class _Dev:
+        def __init__(self, coords):
+            self.coords = coords
+            self.core_on_chip = 0
+
+    # reverse-snake order: snake_order would definitely permute this
+    shuffled = [_Dev((1, 1, 0)), _Dev((0, 0, 0)),
+                _Dev((0, 1, 0)), _Dev((1, 0, 0))]
+    assert bringup.snake_order(shuffled) != shuffled
+    seen = {}
+    orig_init = accl_tpu.ACCL.initialize
+    monkeypatch.setattr(
+        accl_tpu.ACCL, "initialize",
+        lambda self: seen.setdefault("devices", list(self._devices)))
+    accl_tpu.ACCL(devices=shuffled)
+    assert seen["devices"] == shuffled  # untouched
+    monkeypatch.setattr(accl_tpu.ACCL, "initialize", orig_init)
+
+
 def test_buffer_slice_full_parent_fast_path(accl, rng):
     """A slice covering the whole parent stores directly (no
     dynamic_update_slice re-materialization) and stays correct."""
