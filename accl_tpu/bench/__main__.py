@@ -23,8 +23,10 @@ def main(argv=None) -> int:
     ap.add_argument("--algorithm", default="XLA",
                     choices=["XLA", "RING", "TREE", "FLAT", "HIERARCHICAL"])
     ap.add_argument("--reps", type=int, default=9)
-    ap.add_argument("--mode", default="auto", choices=["auto", "block", "chain"],
-                    help="auto = chain on tpu, block elsewhere")
+    ap.add_argument("--mode", default="auto",
+                    choices=["auto", "block", "chain", "fused"],
+                    help="auto = chain on tpu, block elsewhere; fused = "
+                         "op chained inside ONE program (PERFCNT analog)")
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="force an N-device virtual CPU mesh (emulator rung)")
     ap.add_argument("--out", default="-", help="CSV path, - for stdout")
