@@ -212,6 +212,31 @@ class ACCL:
     def set_max_rendezvous_size(self, nbytes: int) -> None:
         self.config = self.config.replace(max_rendezvous_size=nbytes)
 
+    def write_arithconfig(self, cfg: ArithConfig) -> None:
+        """Register a datapath policy for a dtype pair (``ACCL::
+        write_arithconfig``, common.cpp:50-73). Beyond the reference's
+        float-cast pairs, quantized integer wires are supported:
+        ``ArithConfig(float32, int8, quant_scale=s,
+        arith_is_compressed=False)`` sends clip(round(x*s)) int8 on every
+        hop and decompresses before any arithmetic."""
+        if cfg.quant_scale is not None:
+            if cfg.arith_is_compressed:
+                raise ACCLError(
+                    errorCode.COMPRESSION_NOT_SUPPORTED,
+                    "quantized wire pairs must decompress before arithmetic "
+                    "(set arith_is_compressed=False): integer sums across "
+                    "ranks would overflow the wire dtype")
+            if cfg.quant_scale <= 0:
+                raise ACCLError(
+                    errorCode.COMPRESSION_NOT_SUPPORTED,
+                    f"quant_scale must be positive, got {cfg.quant_scale}")
+            if cfg.compressed != dataType.int8:
+                raise ACCLError(
+                    errorCode.COMPRESSION_NOT_SUPPORTED,
+                    "quant_scale applies to int8 wire dtypes only; float "
+                    "wires are plain casts")
+        self._arith_configs[(cfg.uncompressed, cfg.compressed)] = cfg
+
     def autotune(self, pows: Optional[Sequence[int]] = None,
                  reps: int = 3) -> None:
         """Re-derive the AUTO-selection size thresholds by measurement on
@@ -656,6 +681,15 @@ class ACCL:
         compresses the wire payload only (ETH_COMPRESSED semantics).
         """
         comm = comm or self.comms[0]
+        arith = self._arith(srcbuf.dtype, compress_dtype)
+        if arith is not None and arith.quant_scale is not None:
+            # BOTH two-sided delivery paths (move_at and the cross-process
+            # fabric) write wire payloads with a plain cast; a scaled wire
+            # would land unscaled values
+            raise ACCLError(
+                errorCode.COMPRESSION_NOT_SUPPORTED,
+                "quantized (scaled) wire pairs are supported on the "
+                "collective paths only; use a float wire dtype for send/recv")
         if comm.is_multiprocess and not (
                 comm.rank_is_local(src) and comm.rank_is_local(dst)):
             return self._cross_send(srcbuf, count, src, dst, tag,
@@ -664,7 +698,6 @@ class ACCL:
         self._pump()
         self._check_count(srcbuf, count, "send")
         data = self._input(srcbuf, count, from_device)
-        arith = self._arith(srcbuf.dtype, compress_dtype)
         if arith is not None and arith.is_compressing:
             from . import ops as _ops
             data = _ops.compress(data, arith.uncompressed, arith.compressed)

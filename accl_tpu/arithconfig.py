@@ -15,7 +15,7 @@ plugin registry (:mod:`accl_tpu.ops.registry`).
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 from .constants import dataType, dtype_size, reduceFunction
 
@@ -38,6 +38,10 @@ class ArithConfig:
         reduceFunction.MAX,
     )
     arith_is_compressed: bool = True
+    #: scale for quantized integer wire dtypes (int8): wire value =
+    #: clip(round(x * quant_scale)); a TPU-native extension beyond the
+    #: reference's float-cast-only plugin (register via write_arithconfig)
+    quant_scale: Optional[float] = None
 
     @property
     def uncompressed_bytes(self) -> int:

@@ -61,16 +61,23 @@ def reduce_axis0(x, fn: reduceFunction, dt: dataType):
     return acc
 
 
-def compress(x, src: dataType, dst: dataType):
-    """Cast toward the wire dtype (hp_compression compress lane analog)."""
+def compress(x, src: dataType, dst: dataType, scale=None):
+    """Cast toward the wire dtype (hp_compression compress lane analog).
+
+    ``scale`` enables the quantized-integer wire extension: for an int8
+    destination the wire value is clip(round(x * scale), -127, 127)."""
     if src == dst:
         return x
+    if dst == dataType.int8 and scale is not None:
+        return jnp.clip(jnp.round(x * scale), -127, 127).astype(jnp.int8)
     impl = _CAST_REGISTRY.get((src, dst))
     if impl is not None:
         return impl(x)
     return x.astype(to_jax_dtype(dst))
 
 
-def decompress(x, src: dataType, dst: dataType):
+def decompress(x, src: dataType, dst: dataType, scale=None):
     """Cast back from the wire dtype (hp_compression decompress lane)."""
+    if src == dataType.int8 and scale is not None:
+        return x.astype(to_jax_dtype(dst)) / scale
     return compress(x, src, dst)

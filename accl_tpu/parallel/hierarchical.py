@@ -31,6 +31,7 @@ from jax.sharding import PartitionSpec as P
 from ..arithconfig import ArithConfig
 from ..communicator import Communicator
 from ..constants import dataType, reduceFunction
+from .. import ops
 from .primitives import _unwire, _wire
 
 ROW_AXIS = "accl_y"  # which row (changes along a column)
@@ -78,22 +79,43 @@ def build_hier_allreduce(
     if rows * cols != comm.world_size:
         raise ValueError(f"{rows}x{cols} != world {comm.world_size}")
 
+    decompress_arith = (arith is not None and arith.is_compressing
+                        and not arith.arith_is_compressed)
+
     def body(v):  # (1, 1, n)
         n = v.shape[-1]
         pad = (-n) % cols
         x = jnp.pad(v[0, 0], (0, pad))
         w = _wire(x, arith)
-        if func == reduceFunction.SUM:
-            shard = lax.psum_scatter(
-                w.reshape(cols, -1), COL_AXIS, scatter_dimension=0, tiled=False
-            )
-            shard = lax.psum(shard, ROW_AXIS)
-            full = lax.all_gather(shard, COL_AXIS, tiled=True)
-        elif func == reduceFunction.MAX:
-            full = lax.pmax(lax.pmax(w, COL_AXIS), ROW_AXIS)
+        if func == reduceFunction.SUM and decompress_arith:
+            # decompress-before-arith pairs (casting/quantized wires): every
+            # hop carries the wire dtype, every fold runs at full precision
+            # — a wire-dtype psum would round (bf16) or wrap (int8).
+            # phase 1: chunk exchange along the row, local fold
+            sw = lax.all_to_all(w.reshape(cols, -1), COL_AXIS,
+                                split_axis=0, concat_axis=0)   # (cols, m)
+            shard = ops.reduce_axis0(
+                _unwire(sw, arith, x.dtype), func, dt)         # (m,)
+            # phase 2: cross-row fold of the shard
+            g = lax.all_gather(_wire(shard, arith), ROW_AXIS)  # (rows, m)
+            shard = ops.reduce_axis0(_unwire(g, arith, x.dtype), func, dt)
+            # phase 3: row all-gather (transfer only)
+            full = lax.all_gather(_wire(shard, arith), COL_AXIS, tiled=True)
+            out = _unwire(full, arith, v.dtype)
         else:
-            raise ValueError(func)
-        out = _unwire(full, arith, v.dtype)
+            if func == reduceFunction.SUM:
+                shard = lax.psum_scatter(
+                    w.reshape(cols, -1), COL_AXIS, scatter_dimension=0,
+                    tiled=False)
+                shard = lax.psum(shard, ROW_AXIS)
+                full = lax.all_gather(shard, COL_AXIS, tiled=True)
+            elif func == reduceFunction.MAX:
+                # max of wire values == wire of max (monotone cast): the
+                # fast path is exact for MAX under any wire dtype
+                full = lax.pmax(lax.pmax(w, COL_AXIS), ROW_AXIS)
+            else:
+                raise ValueError(func)
+            out = _unwire(full, arith, v.dtype)
         return out[:n][None, None, :] if pad else out[None, None, :]
 
     return _smap2d(comm, rows, cols, body)
@@ -113,10 +135,21 @@ def build_hier_reduce_bcast(
     if rows * cols != comm.world_size:
         raise ValueError(f"{rows}x{cols} != world {comm.world_size}")
 
+    decompress_arith = (arith is not None and arith.is_compressing
+                        and not arith.arith_is_compressed)
+
     def body(v):  # (1, 1, n)
         x = v[0, 0]
         w = _wire(x, arith)
         col = lax.axis_index(COL_AXIS)
+        if func == reduceFunction.SUM and decompress_arith:
+            # gather wire payloads per axis, fold at full precision (see
+            # build_hier_allreduce); the final row gather IS the bcast
+            g = lax.all_gather(w, COL_AXIS)                    # (cols, n)
+            row_tot = ops.reduce_axis0(_unwire(g, arith, x.dtype), func, dt)
+            g2 = lax.all_gather(_wire(row_tot, arith), ROW_AXIS)
+            total = ops.reduce_axis0(_unwire(g2, arith, x.dtype), func, dt)
+            return total.astype(v.dtype)[None, None, :]
         if func == reduceFunction.SUM:
             row_tot = lax.psum(w, COL_AXIS)
             # only the leader column carries the row total upward

@@ -61,14 +61,16 @@ def _wire(x, arith: Optional[ArithConfig]):
     """Cast to the wire dtype before a network hop (compress lane)."""
     if arith is None or not arith.is_compressing:
         return x
-    return ops.compress(x, arith.uncompressed, arith.compressed)
+    return ops.compress(x, arith.uncompressed, arith.compressed,
+                        arith.quant_scale)
 
 
 def _unwire(x, arith: Optional[ArithConfig], out_dtype):
     """Cast back after the network hop (decompress lane)."""
     if arith is None or not arith.is_compressing:
         return x.astype(out_dtype)
-    return ops.decompress(x, arith.compressed, arith.uncompressed).astype(out_dtype)
+    return ops.decompress(x, arith.compressed, arith.uncompressed,
+                          arith.quant_scale).astype(out_dtype)
 
 
 # --------------------------------------------------------------------------
@@ -209,7 +211,8 @@ def build_reduce(comm: Communicator, root: int, func: reduceFunction,
             # gather wire-dtype payloads, then rank-ordered reduce at full
             # precision — matches the reference's decompress-then-accumulate.
             g = lax.all_gather(x, AXIS)                 # (world, 1, count)
-            g = ops.decompress(g, arith.compressed, arith.uncompressed)
+            g = ops.decompress(g, arith.compressed, arith.uncompressed,
+                               arith.quant_scale)
             red = ops.reduce_axis0(g, func, dt).astype(recv.dtype)
         else:
             if func == reduceFunction.SUM:
@@ -248,7 +251,8 @@ def build_allreduce(comm: Communicator, func: reduceFunction, dt: dataType,
         x = _wire(send, arith)
         if arith is not None and arith.is_compressing and not arith.arith_is_compressed:
             g = lax.all_gather(x, AXIS)
-            g = ops.decompress(g, arith.compressed, arith.uncompressed)
+            g = ops.decompress(g, arith.compressed, arith.uncompressed,
+                               arith.quant_scale)
             red = ops.reduce_axis0(g, func, dt)
             return red.astype(send.dtype)
         if func == reduceFunction.SUM:
@@ -280,7 +284,8 @@ def build_reduce_scatter(comm: Communicator, func: reduceFunction, dt: dataType,
         chunks = x.reshape(world, 1, -1)
         swapped = lax.all_to_all(chunks, AXIS, split_axis=0, concat_axis=0)
         if arith is not None and arith.is_compressing:
-            swapped = ops.decompress(swapped, arith.compressed, arith.uncompressed)
+            swapped = ops.decompress(swapped, arith.compressed,
+                                   arith.uncompressed, arith.quant_scale)
         red = ops.reduce_axis0(swapped, func, dt)
         return red.astype(send.dtype)
 

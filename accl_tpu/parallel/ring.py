@@ -48,10 +48,12 @@ def _hop(buf: Array, world: int, arith: Optional[ArithConfig],
     overrides the direction) -> decompress."""
     orig_dtype = buf.dtype
     if arith is not None and arith.is_compressing:
-        buf = ops.compress(buf, arith.uncompressed, arith.compressed)
+        buf = ops.compress(buf, arith.uncompressed, arith.compressed,
+                           arith.quant_scale)
     moved = lax.ppermute(buf, AXIS, perm or _fwd_perm(world))
     if arith is not None and arith.is_compressing:
-        moved = ops.decompress(moved, arith.compressed, arith.uncompressed)
+        moved = ops.decompress(moved, arith.compressed, arith.uncompressed,
+                               arith.quant_scale)
         moved = moved.astype(orig_dtype)
     return moved
 

@@ -21,23 +21,14 @@ from ..arithconfig import ArithConfig
 from ..communicator import Communicator
 from ..constants import dataType, reduceFunction
 from .. import ops
-from .primitives import AXIS, _smap
+from .primitives import _unwire, _wire, AXIS, _smap
 
 
 def _ceil_log2(n: int) -> int:
     return max(1, math.ceil(math.log2(n))) if n > 1 else 0
 
 
-def _maybe_compress(buf, arith: Optional[ArithConfig]):
-    if arith is not None and arith.is_compressing:
-        return ops.compress(buf, arith.uncompressed, arith.compressed)
-    return buf
 
-
-def _maybe_decompress(buf, arith: Optional[ArithConfig], dtype):
-    if arith is not None and arith.is_compressing:
-        return ops.decompress(buf, arith.compressed, arith.uncompressed).astype(dtype)
-    return buf
 
 
 def build_tree_bcast(comm: Communicator, root: int,
@@ -61,8 +52,8 @@ def build_tree_bcast(comm: Communicator, root: int,
                 for i in range(half)
                 if i + half < world
             ]
-            wire = _maybe_compress(buf, arith)
-            moved = _maybe_decompress(
+            wire = _wire(buf, arith)
+            moved = _unwire(
                 lax.ppermute(wire, AXIS, perm), arith, buf.dtype
             )
             is_receiver = (rel >= half) & (rel < 2 * half)
@@ -95,8 +86,8 @@ def build_tree_reduce(comm: Communicator, root: int, func: reduceFunction,
                 for i in range(world)
                 if i % (2 * half) == half
             ]
-            wire = _maybe_compress(acc, arith)
-            moved = _maybe_decompress(
+            wire = _wire(acc, arith)
+            moved = _unwire(
                 lax.ppermute(wire, AXIS, perm), arith, acc.dtype
             )
             is_receiver = (jnp.mod(rel, 2 * half) == 0) & (rel + half < world)
@@ -122,16 +113,16 @@ def build_tree_allreduce(comm: Communicator, func: reduceFunction,
         for k in range(rounds):
             half = 1 << k
             perm = [(i, i - half) for i in range(world) if i % (2 * half) == half]
-            wire = _maybe_compress(acc, arith)
-            moved = _maybe_decompress(lax.ppermute(wire, AXIS, perm), arith, acc.dtype)
+            wire = _wire(acc, arith)
+            moved = _unwire(lax.ppermute(wire, AXIS, perm), arith, acc.dtype)
             is_receiver = (jnp.mod(rank, 2 * half) == 0) & (rank + half < world)
             acc = jnp.where(is_receiver, ops.combine(acc, moved, func, dt), acc)
         # broadcast from rank 0
         for k in range(rounds):
             half = 1 << k
             perm = [(i, i + half) for i in range(half) if i + half < world]
-            wire = _maybe_compress(acc, arith)
-            moved = _maybe_decompress(lax.ppermute(wire, AXIS, perm), arith, acc.dtype)
+            wire = _wire(acc, arith)
+            moved = _unwire(lax.ppermute(wire, AXIS, perm), arith, acc.dtype)
             is_receiver = (rank >= half) & (rank < 2 * half)
             acc = jnp.where(is_receiver, moved, acc)
         return acc[None, :]

@@ -1,0 +1,111 @@
+"""Quantized int8 wire compression — a TPU-native extension beyond the
+reference's float-cast plugin: registered via write_arithconfig (the
+ACCL::write_arithconfig surface), wire value = clip(round(x*scale)),
+decompressed before any arithmetic."""
+import numpy as np
+import pytest
+
+from accl_tpu import (ACCLError, Algorithm, ArithConfig, dataType,
+                      errorCode, reduceFunction)
+
+WORLD = 8
+SCALE = 64.0  # quantization grid 1/64
+
+
+@pytest.fixture()
+def q8(accl):
+    cfg = ArithConfig(dataType.float32, dataType.int8,
+                      arith_is_compressed=False, quant_scale=SCALE)
+    accl.write_arithconfig(cfg)
+    yield accl
+    accl._arith_configs.pop((dataType.float32, dataType.int8), None)
+
+
+def test_unregistered_pair_rejected(accl):
+    b = accl.create_buffer(16, dataType.float32)
+    with pytest.raises(ACCLError) as ei:
+        accl.bcast(b, 16, 0, compress_dtype=dataType.int8)
+    assert ei.value.code == errorCode.COMPRESSION_NOT_SUPPORTED
+
+
+def test_quantized_must_decompress_before_arith(accl):
+    with pytest.raises(ACCLError):
+        accl.write_arithconfig(ArithConfig(
+            dataType.float32, dataType.int8, quant_scale=8.0,
+            arith_is_compressed=True))
+
+
+@pytest.mark.parametrize("algo", [Algorithm.XLA, Algorithm.RING,
+                                  Algorithm.TREE, Algorithm.FLAT])
+def test_bcast_int8_wire(q8, rng, algo):
+    count = 47
+    b = q8.create_buffer(count, dataType.float32)
+    # payloads on the 1/SCALE grid survive quantization exactly
+    b.host[:] = rng.integers(-120, 120, (WORLD, count)) / SCALE
+    expect = b.host[2].copy()
+    q8.bcast(b, count, 2, compress_dtype=dataType.int8, algorithm=algo)
+    np.testing.assert_array_equal(b.host, np.tile(expect, (WORLD, 1)))
+
+
+def test_hierarchical_int8_no_overflow(q8):
+    """The decompress-before-arith path must hold for hierarchical too: 8
+    ranks x wire value 32 would wrap int8 (256 -> 0) if any phase summed
+    in the wire dtype."""
+    count = 32
+    s = q8.create_buffer(count, dataType.float32)
+    r = q8.create_buffer(count, dataType.float32)
+    # 0.125 quantizes to wire value 8; every partial sum stays inside the
+    # int8 wire range (the per-hop wire caps ALL intermediate values at
+    # 127/scale — inherent to hop-compressed transport), yet a wire-dtype
+    # accumulation of 8 ranks would wrap int8 at 256
+    s.host[:] = 0.125
+    q8.allreduce(s, r, count, reduceFunction.SUM,
+                 compress_dtype=dataType.int8,
+                 algorithm=Algorithm.HIERARCHICAL)
+    np.testing.assert_allclose(r.host, 1.0, atol=1e-6)
+    # the latency (reduce->bcast) variant as well
+    from accl_tpu.parallel.hierarchical import build_hier_reduce_bcast
+    import jax
+    from accl_tpu import ArithConfig
+    comm = q8.global_comm()
+    arith = ArithConfig(dataType.float32, dataType.int8,
+                        arith_is_compressed=False, quant_scale=SCALE)
+    prog = build_hier_reduce_bcast(comm, 2, 4, reduceFunction.SUM,
+                                   dataType.float32, arith)
+    x = jax.device_put(np.full((WORLD, count), 0.125, np.float32),
+                       comm.sharding())
+    np.testing.assert_allclose(np.asarray(prog(x)), 1.0, atol=1e-6)
+
+
+@pytest.mark.parametrize("algo", [Algorithm.XLA, Algorithm.RING,
+                                  Algorithm.FLAT])
+def test_allreduce_int8_wire(q8, rng, algo):
+    count = 64
+    s = q8.create_buffer(count, dataType.float32)
+    r = q8.create_buffer(count, dataType.float32)
+    s.host[:] = rng.integers(-15, 15, (WORLD, count)) / SCALE
+    q8.allreduce(s, r, count, reduceFunction.SUM,
+                 compress_dtype=dataType.int8, algorithm=algo)
+    # each hop requantizes; on-grid inputs whose partial sums stay within
+    # the int8 range are exact
+    expect = s.host.astype(np.float64).sum(0)
+    for k in range(WORLD):
+        np.testing.assert_allclose(r.host[k], expect, atol=1e-6)
+
+
+def test_quantization_error_bounded(q8, rng):
+    """Off-grid payloads: a single compressed hop errs by at most half the
+    quantization step."""
+    count = 256
+    b = q8.create_buffer(count, dataType.float32)
+    b.host[:] = rng.uniform(-1.5, 1.5, (WORLD, count)).astype(np.float32)
+    expect = b.host[0].copy()
+    q8.bcast(b, count, 0, compress_dtype=dataType.int8)
+    np.testing.assert_allclose(b.host[5], expect, atol=0.5 / SCALE + 1e-7)
+
+
+def test_send_rejects_quantized_wire(q8, rng):
+    s = q8.create_buffer(32, dataType.float32)
+    with pytest.raises(ACCLError) as ei:
+        q8.send(s, 32, src=0, dst=1, tag=1, compress_dtype=dataType.int8)
+    assert ei.value.code == errorCode.COMPRESSION_NOT_SUPPORTED
