@@ -844,6 +844,14 @@ class ACCL:
         completes on match — ``current_step`` counts delivered segments.
         """
         comm = comm or self.comms[0]
+        arith = self._arith(dstbuf.dtype, compress_dtype)  # validate the pair
+        if arith is not None and arith.quant_scale is not None:
+            # mirror send(): a quantized send is always rejected, so a
+            # quantized recv could never be fulfilled — fail it up front
+            raise ACCLError(
+                errorCode.COMPRESSION_NOT_SUPPORTED,
+                "quantized (scaled) wire pairs are supported on the "
+                "collective paths only; use a float wire dtype for send/recv")
         if comm.is_multiprocess and not (
                 comm.rank_is_local(src) and comm.rank_is_local(dst)):
             return self._cross_recv(dstbuf, count, src, dst, tag,
@@ -852,7 +860,6 @@ class ACCL:
         self._pump()
         self._check_count(dstbuf, count, "recv")
         matcher = self.matcher(comm)
-        _ = self._arith(dstbuf.dtype, compress_dtype)  # validate the pair
 
         assembled: list = []
         pending_req: list = []

@@ -44,6 +44,12 @@ class ArithConfig:
     quant_scale: Optional[float] = None
 
     @property
+    def decompress_before_arith(self) -> bool:
+        """True when reductions must run in the uncompressed dtype (casting
+        and quantized pairs): the wire dtype is transport-only."""
+        return self.is_compressing and not self.arith_is_compressed
+
+    @property
     def uncompressed_bytes(self) -> int:
         return dtype_size(self.uncompressed)
 

@@ -79,8 +79,7 @@ def build_hier_allreduce(
     if rows * cols != comm.world_size:
         raise ValueError(f"{rows}x{cols} != world {comm.world_size}")
 
-    decompress_arith = (arith is not None and arith.is_compressing
-                        and not arith.arith_is_compressed)
+    decompress_arith = (arith is not None and arith.decompress_before_arith)
 
     def body(v):  # (1, 1, n)
         n = v.shape[-1]
@@ -135,8 +134,7 @@ def build_hier_reduce_bcast(
     if rows * cols != comm.world_size:
         raise ValueError(f"{rows}x{cols} != world {comm.world_size}")
 
-    decompress_arith = (arith is not None and arith.is_compressing
-                        and not arith.arith_is_compressed)
+    decompress_arith = (arith is not None and arith.decompress_before_arith)
 
     def body(v):  # (1, 1, n)
         x = v[0, 0]

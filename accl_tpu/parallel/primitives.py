@@ -206,7 +206,7 @@ def build_reduce(comm: Communicator, root: int, func: reduceFunction,
 
     def body(send, recv):
         x = _wire(send, arith)
-        if arith is not None and arith.is_compressing and not arith.arith_is_compressed:
+        if arith is not None and arith.decompress_before_arith:
             # casting pairs decompress before arithmetic (DEFAULT_ARITH_CONFIG):
             # gather wire-dtype payloads, then rank-ordered reduce at full
             # precision — matches the reference's decompress-then-accumulate.
@@ -249,7 +249,7 @@ def build_allreduce(comm: Communicator, func: reduceFunction, dt: dataType,
 
     def body(send):
         x = _wire(send, arith)
-        if arith is not None and arith.is_compressing and not arith.arith_is_compressed:
+        if arith is not None and arith.decompress_before_arith:
             g = lax.all_gather(x, AXIS)
             g = ops.decompress(g, arith.compressed, arith.uncompressed,
                                arith.quant_scale)
@@ -274,7 +274,7 @@ def build_reduce_scatter(comm: Communicator, func: reduceFunction, dt: dataType,
     def body(send):
         x = _wire(send, arith)
         if func == reduceFunction.SUM and (
-            arith is None or not arith.is_compressing or arith.arith_is_compressed
+            arith is None or not arith.decompress_before_arith
         ):
             red = lax.psum_scatter(x, AXIS, scatter_dimension=1, tiled=True)
             return _unwire(red, arith, send.dtype)

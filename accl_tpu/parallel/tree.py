@@ -28,9 +28,6 @@ def _ceil_log2(n: int) -> int:
     return max(1, math.ceil(math.log2(n))) if n > 1 else 0
 
 
-
-
-
 def build_tree_bcast(comm: Communicator, root: int,
                      arith: Optional[ArithConfig] = None) -> Callable:
     """Binary-tree broadcast, doubling senders each round (fw :816-869).

@@ -78,7 +78,7 @@ def test_hierarchical_int8_no_overflow(q8):
 
 
 @pytest.mark.parametrize("algo", [Algorithm.XLA, Algorithm.RING,
-                                  Algorithm.FLAT])
+                                  Algorithm.TREE, Algorithm.FLAT])
 def test_allreduce_int8_wire(q8, rng, algo):
     count = 64
     s = q8.create_buffer(count, dataType.float32)
