@@ -603,7 +603,8 @@ class ACCL:
     # -- cross-process two-sided path (multiproc fabric) -------------------
 
     def _cross_send(self, srcbuf, count, src, dst, tag, from_device,
-                    run_async, comm, compress_dtype) -> Optional[Request]:
+                    run_async, comm, compress_dtype,
+                    arith=None) -> Optional[Request]:
         """Send to a rank owned by another controller process: payload
         travels over the coordination-service fabric with the same
         eager/rendezvous split (multiproc.CrossProcessFabric)."""
@@ -619,7 +620,8 @@ class ACCL:
         if not from_device:
             srcbuf.sync_to_device()
         data = srcbuf.read_rank_local(src, count)
-        arith = self._arith(srcbuf.dtype, compress_dtype)
+        if arith is None:
+            arith = self._arith(srcbuf.dtype, compress_dtype)
         compressing = arith is not None and arith.is_compressing
         if compressing:
             data = data.astype(
@@ -694,7 +696,7 @@ class ACCL:
                 comm.rank_is_local(src) and comm.rank_is_local(dst)):
             return self._cross_send(srcbuf, count, src, dst, tag,
                                     from_device, run_async, comm,
-                                    compress_dtype)
+                                    compress_dtype, arith)
         self._pump()
         self._check_count(srcbuf, count, "send")
         data = self._input(srcbuf, count, from_device)
