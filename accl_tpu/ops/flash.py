@@ -108,7 +108,10 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128):
-    """Fused blockwise attention. q/k/v: (H, S, d) (or (S, d), promoted).
+    """Fused blockwise attention. q: (H, S, d) (or (S, d), promoted);
+    k/v: (H_kv, S, d) with ``H % H_kv == 0`` — grouped-query attention
+    shares each kv head across ``H/H_kv`` q heads with no materialized
+    repeat (the kv blocks are simply indexed per group).
 
     Constraints (kernel tiling): S divisible by block_q and block_k, d a
     multiple of 128 lanes. Callers with other shapes use the jnp path
@@ -127,6 +130,10 @@ def flash_attention(q, k, v, causal: bool = False,
         raise ValueError(
             f"flash_attention needs S % block ({S} % {block_q}/{block_k}) "
             f"== 0, block_q % 128 == 0 ({block_q}) and d % 128 ({d}) == 0")
+    if k.shape != v.shape or k.shape[1:] != (S, d) or H % k.shape[0]:
+        raise ValueError(
+            f"k/v shape {k.shape} incompatible with q {q.shape}: need "
+            f"(H_kv, S, d) with H % H_kv == 0 (grouped-query attention)")
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
     out = _flash(q, k, v, causal, sc, block_q, block_k)
     return out[0] if single else out
@@ -165,6 +172,7 @@ def _flash_fwd_call(q, k, v, causal, sc, block_q, block_k):
     H, S, d = q.shape
     nq, nk = S // block_q, S // block_k
     pr = _pad_rows(block_q)
+    g = H // k.shape[0]   # grouped-query: q heads per kv head
     kernel = functools.partial(_kernel, causal=causal, scale=sc,
                                block_q=block_q, block_k=block_k)
     out, lse = pl.pallas_call(
@@ -172,8 +180,8 @@ def _flash_fwd_call(q, k, v, causal, sc, block_q, block_k):
         grid=(H, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h // g, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h // g, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
@@ -222,12 +230,14 @@ def _recompute_p_ds(q, kb, vb, do, lse, dd, i, j, causal, sc,
 
 def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
                    dk_ref, dv_ref, dk_acc, dv_acc, *,
-                   causal: bool, scale: float, block_q: int, block_k: int):
+                   causal: bool, scale: float, block_q: int, block_k: int,
+                   nq: int):
     j = pl.program_id(1)          # k-block (this kernel's subject)
-    i = pl.program_id(2)          # q sweep (innermost: scratch carries)
-    nq = pl.num_programs(2)
+    t = pl.program_id(2)          # fused (q-head-in-group, q-block) sweep
+    total = pl.num_programs(2)
+    i = t % nq                    # q-block within the current q head
 
-    @pl.when(i == 0)
+    @pl.when(t == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -251,7 +261,7 @@ def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
     else:
         _block()
 
-    @pl.when(i == nq - 1)
+    @pl.when(t == total - 1)
     def _finalize():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -295,24 +305,35 @@ def _flash_bwd_kv(q, k, v, do, lse, dd, causal, sc, block_q, block_k):
     pr = _pad_rows(block_q)
     kernel = functools.partial(_bwd_kv_kernel, causal=causal, scale=sc,
                                block_q=block_q, block_k=block_k)
+    hkv = k.shape[0]
+    g = H // hkv
+    # grid over KV heads; the innermost sweep walks this kv head's g q
+    # heads x nq q-blocks, accumulating into ONE (block_k, d) scratch pair
+    # — dk/dv come out at (hkv, S, d) directly, no g-times-oversized
+    # intermediate
+    qh = lambda h, j, t: h * g + t // nq          # global q head for step t
     return pl.pallas_call(
-        kernel,
-        grid=(H, nk, nq),
+        functools.partial(kernel, nq=nq),
+        grid=(hkv, nk, g * nq),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda h, j, i: (h, i, 0)),  # q
-            pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0)),  # k
-            pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0)),  # v
-            pl.BlockSpec((1, block_q, d), lambda h, j, i: (h, i, 0)),  # do
-            pl.BlockSpec((1, 1, pr, 128), lambda h, j, i: (h, i, 0, 0)),
-            pl.BlockSpec((1, 1, pr, 128), lambda h, j, i: (h, i, 0, 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda h, j, t: (qh(h, j, t), t % nq, 0)),  # q
+            pl.BlockSpec((1, block_k, d), lambda h, j, t: (h, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, j, t: (h, j, 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda h, j, t: (qh(h, j, t), t % nq, 0)),  # do
+            pl.BlockSpec((1, 1, pr, 128),
+                         lambda h, j, t: (qh(h, j, t), t % nq, 0, 0)),
+            pl.BlockSpec((1, 1, pr, 128),
+                         lambda h, j, t: (qh(h, j, t), t % nq, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, j, t: (h, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, j, t: (h, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((H, S, d), _F32),   # dk
-            jax.ShapeDtypeStruct((H, S, d), _F32),   # dv
+            jax.ShapeDtypeStruct((hkv, S, d), _F32),   # dk
+            jax.ShapeDtypeStruct((hkv, S, d), _F32),   # dv
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), _F32),
@@ -330,13 +351,14 @@ def _flash_bwd_q(q, k, v, do, lse, dd, causal, sc, block_q, block_k):
     pr = _pad_rows(block_q)
     kernel = functools.partial(_bwd_q_kernel, causal=causal, scale=sc,
                                block_q=block_q, block_k=block_k)
+    g = H // k.shape[0]
     return pl.pallas_call(
         kernel,
         grid=(H, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),  # q
-            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),  # k
-            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),  # v
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h // g, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h // g, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),  # do
             pl.BlockSpec((1, 1, pr, 128), lambda h, i, j: (h, i, 0, 0)),
             pl.BlockSpec((1, 1, pr, 128), lambda h, i, j: (h, i, 0, 0)),
