@@ -66,35 +66,54 @@ def shard_params(params: MoEParams, comm: Communicator) -> MoEParams:
 
 
 def build_moe_forward(comm: Communicator, n_experts: int,
-                      capacity: int) -> callable:
+                      capacity: int, top_k: int = 1) -> callable:
     """Compile the expert-parallel MoE forward.
 
     Input x: (world, n, d) token-sharded; output same shape. ``capacity``
     is the per-(rank, expert) token budget C; tokens over budget fall back
     to the residual path (standard Switch behavior, static shapes).
+    ``top_k`` routes each token to its k best experts with renormalized
+    gates (GShard-style top-2 is ``top_k=2``); choice priority is strict —
+    every token's first choice is slotted before any second choices, so
+    capacity pressure drops second choices first.
     """
     world = comm.world_size
     e_local = n_experts // world
+    if not 1 <= top_k <= n_experts:
+        raise ValueError(f"top_k {top_k} must be in [1, {n_experts}]")
 
     def body(params: MoEParams, x):
         x = x[0]                                       # (n, d) local tokens
         n, d = x.shape
         logits = x @ params.router                     # (n, E)
         probs = jax.nn.softmax(logits, axis=-1)
-        expert = jnp.argmax(probs, axis=-1)            # (n,) top-1
-        gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+        topv, topi = lax.top_k(probs, top_k)           # (n, k)
+        # Switch (k=1) scales by the raw router probability — THE router
+        # gradient path; renormalized gates are the GShard k>1 scheme
+        # (renormalizing at k=1 would make the gate identically 1 and the
+        # router analytically untrainable)
+        gates = (topv if top_k == 1
+                 else topv / topv.sum(axis=-1, keepdims=True))
 
-        # capacity slot per (token, expert): position among same-expert
-        # tokens in order — deterministic, matches the fixed-traversal rule
-        onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.int32)  # (n, E)
-        pos = jnp.cumsum(onehot, axis=0) * onehot - 1  # (n, E): slot or -1
-        slot = pos.max(axis=1)                         # (n,) slot for chosen e
-        keep = slot < capacity                         # over-budget → residual
-
-        disp = (jax.nn.one_hot(expert, n_experts, dtype=x.dtype)[:, :, None]
-                * jax.nn.one_hot(jnp.clip(slot, 0, capacity - 1), capacity,
-                                 dtype=x.dtype)[:, None, :])
-        disp = disp * keep[:, None, None].astype(x.dtype)  # (n, E, C)
+        # capacity slots with choice priority: choice j's positions start
+        # after ALL lower choices' per-expert counts — deterministic,
+        # matches the fixed-traversal rule
+        disp = jnp.zeros((n, n_experts, capacity), x.dtype)
+        comb = jnp.zeros((n, n_experts, capacity), x.dtype)
+        prev_counts = jnp.zeros((n_experts,), jnp.int32)
+        for j in range(top_k):
+            ej = topi[:, j]                            # (n,)
+            oh = jax.nn.one_hot(ej, n_experts, dtype=jnp.int32)   # (n, E)
+            pos = jnp.cumsum(oh, axis=0) * oh - 1      # (n, E) within-choice
+            slot = pos.max(axis=1) + prev_counts[ej]   # offset by prior picks
+            keep = (slot < capacity).astype(x.dtype)
+            sel = (jax.nn.one_hot(ej, n_experts, dtype=x.dtype)[:, :, None]
+                   * jax.nn.one_hot(jnp.clip(slot, 0, capacity - 1),
+                                    capacity, dtype=x.dtype)[:, None, :]
+                   * keep[:, None, None])              # (n, E, C)
+            disp = disp + sel
+            comb = comb + sel * gates[:, j][:, None, None]
+            prev_counts = prev_counts + oh.sum(axis=0)
 
         send = jnp.einsum("nec,nd->ecd", disp, x)      # (E, C, d)
         # dispatch: expert-block e → rank e // e_local; received blocks
@@ -110,9 +129,10 @@ def build_moe_forward(comm: Communicator, n_experts: int,
         # inverse all-to-all: send each rank its tokens' outputs back
         back = lax.all_to_all(y, AXIS, split_axis=1, concat_axis=0,
                               tiled=True)              # (E, C, d)
-        out = jnp.einsum("nec,ecd->nd", disp, back)    # gather my tokens
-        out = out * gate[:, None]
-        # over-capacity (and all) tokens keep the residual
+        # gate-weighted combine; dropped choices contribute nothing (the
+        # token keeps its residual, and surviving choices keep their
+        # renormalized weights)
+        out = jnp.einsum("nec,ecd->nd", comb, back)
         return (x + out)[None]
 
     from jax.sharding import PartitionSpec as P
@@ -124,8 +144,8 @@ def build_moe_forward(comm: Communicator, n_experts: int,
 
 
 def reference_moe(params: MoEParams, x: np.ndarray, n_experts: int,
-                  capacity: int) -> np.ndarray:
-    """Host reference: the same capacity-bounded top-1 MoE, computed
+                  capacity: int, top_k: int = 1) -> np.ndarray:
+    """Host reference: the same capacity-bounded top-k MoE, computed
     globally per rank (no parallelism) for test comparison."""
     world, n, d = x.shape
     out = np.array(x, dtype=np.float64)
@@ -136,12 +156,21 @@ def reference_moe(params: MoEParams, x: np.ndarray, n_experts: int,
         logits = x[r].astype(np.float64) @ router
         e_x = np.exp(logits - logits.max(-1, keepdims=True))
         probs = e_x / e_x.sum(-1, keepdims=True)
-        expert = probs.argmax(-1)
+        order = np.argsort(-probs, axis=-1)[:, :top_k]      # (n, k)
         counts = {e: 0 for e in range(n_experts)}
+        # choice priority: all first choices slotted before any second ones
+        kept = np.zeros((n, top_k), bool)
+        for j in range(top_k):
+            for t in range(n):
+                e = int(order[t, j])
+                if counts[e] < capacity:
+                    counts[e] += 1
+                    kept[t, j] = True
         for t in range(n):
-            e = int(expert[t])
-            if counts[e] < capacity:
-                counts[e] += 1
-                h = np.maximum(x[r, t].astype(np.float64) @ w_in[e], 0.0)
-                out[r, t] += (h @ w_out[e]) * probs[t, e]
+            gsum = probs[t, order[t]].sum() if top_k > 1 else 1.0
+            for j in range(top_k):
+                if kept[t, j]:
+                    e = int(order[t, j])
+                    h = np.maximum(x[r, t].astype(np.float64) @ w_in[e], 0.0)
+                    out[r, t] += (h @ w_out[e]) * (probs[t, e] / gsum)
     return out

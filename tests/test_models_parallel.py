@@ -40,6 +40,59 @@ def test_moe_capacity_overflow_residual(accl, rng):
     np.testing.assert_allclose(out, expect, rtol=2e-4, atol=2e-4)
 
 
+def test_moe_top2_matches_reference(accl, rng):
+    """GShard-style top-2 routing with renormalized gates and strict
+    choice priority under capacity pressure."""
+    comm = accl.global_comm()
+    n, d, h, E, C = 16, 32, 64, 16, 4   # tight capacity: drops happen
+    gp = moe.init_params(jax.random.PRNGKey(4), comm, d, h, E)
+    params = moe.shard_params(gp, comm)
+    fwd = moe.build_moe_forward(comm, n_experts=E, capacity=C, top_k=2)
+    x = rng.standard_normal((WORLD, n, d)).astype(np.float32)
+    out = np.asarray(fwd(params, jax.device_put(x, comm.sharding())))
+    host_params = moe.MoEParams(*(np.asarray(p) for p in gp))
+    expect = moe.reference_moe(host_params, x, n_experts=E, capacity=C,
+                               top_k=2)
+    np.testing.assert_allclose(out, expect, rtol=2e-4, atol=2e-4)
+
+
+def test_moe_top1_keeps_switch_gate_semantics(accl, rng):
+    """top_k=1 must scale each expert output by the RAW router probability
+    (Switch), not a renormalized gate (which would be identically 1 and
+    kill the router gradient). Expectation computed independently here —
+    NOT via reference_moe — so a semantics change in both implementations
+    cannot slip through."""
+    comm = accl.global_comm()
+    n, d, h, E, C = 8, 16, 32, 8, 8  # capacity ample: no drops
+    gp = moe.init_params(jax.random.PRNGKey(5), comm, d, h, E)
+    params = moe.shard_params(gp, comm)
+    fwd = moe.build_moe_forward(comm, n_experts=E, capacity=C, top_k=1)
+    x = rng.standard_normal((WORLD, n, d)).astype(np.float32)
+    out = np.asarray(fwd(params, jax.device_put(x, comm.sharding())))
+    router = np.asarray(gp.router, np.float64)
+    w_in = np.asarray(gp.w_in, np.float64)
+    w_out = np.asarray(gp.w_out, np.float64)
+    for r in range(WORLD):
+        logits = x[r].astype(np.float64) @ router
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        e = p.argmax(-1)
+        for t in range(n):
+            hdn = np.maximum(x[r, t].astype(np.float64) @ w_in[e[t]], 0.0)
+            expect = x[r, t] + (hdn @ w_out[e[t]]) * p[t, e[t]]
+            np.testing.assert_allclose(out[r, t], expect,
+                                       rtol=2e-4, atol=2e-4)
+
+
+def test_moe_rejects_bad_top_k(accl):
+    with pytest.raises(ValueError):
+        moe.build_moe_forward(accl.global_comm(), n_experts=8, capacity=4,
+                              top_k=0)
+    with pytest.raises(ValueError):
+        moe.build_moe_forward(accl.global_comm(), n_experts=8, capacity=4,
+                              top_k=9)
+
+
 def test_moe_rejects_indivisible_experts(accl):
     with pytest.raises(ValueError):
         moe.init_params(jax.random.PRNGKey(0), accl.global_comm(), 8, 16, 9)
