@@ -18,6 +18,7 @@ functional:
 """
 from __future__ import annotations
 
+import time
 from typing import List, Optional, Sequence, Union
 
 import jax
@@ -113,7 +114,8 @@ class ACCL:
 
             self._fabric = CrossProcessFabric(
                 timeout=self.config.timeout,
-                eager_window=self.config.eager_rx_buffer_count)
+                eager_window=self.config.eager_rx_buffer_count,
+                eager_seg_bytes=self.config.eager_rx_buffer_size)
         self._initialized = True
         log.info("initialized: %s", self.parse_hwid())
 
@@ -191,6 +193,11 @@ class ACCL:
         # message with fresh seqns
         self._sched.clear()
         self._parked_calls.clear()
+        if self._fabric is not None:
+            # tombstone reserved-but-unannounced cross-process seqs the
+            # dropped continuations would otherwise strand (peer fetch
+            # cursors must never stall on a hole)
+            self._fabric.reset()
         for m in self._matchers.values():
             m.clear()
         for comm in self.comms:
@@ -573,7 +580,16 @@ class ACCL:
         passes over the parked calls until one whole pass yields no
         progress — a single stuck continuation must not starve the others.
         Returns whether any continuation progressed (drives wait() backoff).
+
+        Multi-process: also drives the cross-process move schedule, so a
+        controller inside ANY ACCL call co-executes pair moves its peers
+        have accepted (cooperative progress, like the firmware loop).
         """
+        fab_progress = (self._fabric.drive()
+                        if self._fabric is not None else False)
+        return self._pump_parked() or fab_progress
+
+    def _pump_parked(self) -> bool:
         any_progress = False
         while True:
             n = len(self._parked_calls)
@@ -602,16 +618,41 @@ class ACCL:
 
     # -- cross-process two-sided path (multiproc fabric) -------------------
 
+    def _drive_until(self, pred, what: str) -> None:
+        """Drive the full cooperative scheduler (parked continuations AND
+        the cross-process mover — a parked async send may still need to
+        announce while this process blocks here) until ``pred()`` holds;
+        NOT_READY on session timeout."""
+        deadline = time.monotonic() + self.config.timeout
+        while not pred():
+            if not self._pump():
+                time.sleep(0.002)
+            if time.monotonic() > deadline:
+                raise ACCLError(errorCode.NOT_READY_ERROR, what)
+
+    def _park_continuation(self, cont, step: int) -> None:
+        """Park a resumable continuation on the cooperative retry queue
+        (NOT_READY re-enqueue with current_step,
+        ccl_offload_control.c:2460-2478)."""
+        call_id = self._next_call_id
+        self._next_call_id += 1
+        self._parked_calls[call_id] = cont
+        self._sched.push_retry(call_id, step)
+
     def _cross_send(self, srcbuf, count, src, dst, tag, from_device,
                     run_async, comm, compress_dtype,
                     arith=None) -> Optional[Request]:
-        """Send to a rank owned by another controller process: payload
-        travels over the coordination-service fabric with the same
-        eager/rendezvous split (multiproc.CrossProcessFabric)."""
-        if run_async:
-            raise ACCLError(
-                errorCode.CONFIG_ERROR,
-                "cross-process send is synchronous; drop run_async")
+        """Send to a rank owned by another controller process.
+
+        The payload stays staged on this process's device (jax arrays are
+        immutable — holding the shard reference is a zero-copy snapshot)
+        and moves as an SPMD pair-mesh program that both endpoint
+        controllers enter; the coordination service carries only the
+        header (multiproc.CrossProcessFabric). Eager sends complete at
+        announce time under the segment credit window; rendezvous sends
+        complete when the move executes — sync blocks driving the mover,
+        async parks on the retry queue like a NOT_READY firmware call
+        (ccl_offload_control.c:2460-2478)."""
         if not comm.rank_is_local(src):
             raise ACCLError(
                 errorCode.CONFIG_ERROR,
@@ -619,42 +660,179 @@ class ACCL:
         self._check_count(srcbuf, count, "send")
         if not from_device:
             srcbuf.sync_to_device()
-        data = srcbuf.read_rank_local(src, count)
+        payload = srcbuf.rank_shard(src)
+        if count != srcbuf.count:
+            payload = payload[:, :count]
         if arith is None:
             arith = self._arith(srcbuf.dtype, compress_dtype)
         compressing = arith is not None and arith.is_compressing
         if compressing:
-            data = data.astype(
-                np.dtype(constants.to_jax_dtype(arith.compressed)))
+            from . import ops as _ops
+            payload = _ops.compress(payload, arith.uncompressed,
+                                    arith.compressed)
         nbytes = count * constants.dtype_size(srcbuf.dtype)
         self._check_rendezvous_size(nbytes, compressing, "cross-process send")
+        sdev, ddev = comm.device(src).id, comm.device(dst).id
+        fab = self._fabric
+
         if nbytes > self.config.max_eager_size and not compressing:
-            self._fabric.send_rendezvous(src, dst, tag, data)
-        else:
-            seg_elems = max(self.config.eager_rx_buffer_size
-                            // constants.dtype_size(srcbuf.dtype), 1)
-            self._fabric.send_eager(src, dst, tag, data, seg_elems)
-        return self._finish(operation.send, None, data, True, False, comm)
+            # rendezvous: zero-copy handoff, done only when moved (fw :595-612)
+            seq = fab.announce(sdev, ddev, tag, payload, "r", 0)
+            if not run_async:
+                self._drive_until(
+                    lambda: not fab.send_pending(sdev, ddev, seq),
+                    f"rendezvous send {src}->{dst}: no recv accepted "
+                    f"within {self.config.timeout}s")
+                return self._finish(operation.send, None, payload, True,
+                                    False, comm)
+            req = Request(operation.send.name, outputs=None, external=True,
+                          on_complete=self._queue.retire,
+                          progress=self._pump, comm=comm,
+                          native_registry=self._reqreg)
+            self._queue.push(req)
+
+            def cont_rdv(step: int) -> Optional[int]:
+                if req.status in (requestStatus.COMPLETED, requestStatus.ERROR):
+                    return None
+                fab.drive()
+                if not fab.send_pending(sdev, ddev, seq):
+                    req.fulfill(outputs=payload)
+                    return None
+                return step
+
+            self._park_continuation(cont_rdv, 0)
+            return req
+
+        # eager: completes at announce, bounded by the credit window. The
+        # sequence number is reserved NOW — a credit-starved send holds its
+        # place in the pair stream so later sends cannot overtake it (the
+        # receiver's fetch cursor stalls at the unannounced seq until the
+        # announce lands: per-pair non-overtaking, like the per-pair seqn
+        # ordering of dma_mover.cpp:581-610)
+        nseg = fab.nsegments(count * payload.dtype.itemsize)
+        seq = fab.next_seq(sdev, ddev)
+        if not run_async:
+            try:
+                self._drive_until(
+                    lambda: fab.eager_credit_free(sdev, ddev, nseg),
+                    f"eager window to rank {dst} full for "
+                    f"{self.config.timeout}s (no recv consuming segments)")
+            except ACCLError:
+                # never strand the reserved seq: the pair stream must stay
+                # advanceable for the receiver after this send fails
+                fab.announce_cancel(sdev, ddev, seq)
+                raise
+            fab.announce(sdev, ddev, tag, payload, "e", nseg, seq=seq)
+            return self._finish(operation.send, None, payload, True, False,
+                                comm)
+
+        req = Request(operation.send.name, outputs=None, external=True,
+                      on_complete=self._queue.retire, progress=self._pump,
+                      comm=comm, native_registry=self._reqreg)
+        self._queue.push(req)
+
+        def cont_eager(step: int) -> Optional[int]:
+            if req.status in (requestStatus.COMPLETED, requestStatus.ERROR):
+                # cancelled while parked: tombstone the reserved seq so the
+                # receiver's fetch cursor is not stalled forever
+                fab.announce_cancel(sdev, ddev, seq)
+                return None
+            fab.drive()
+            if fab.eager_credit_free(sdev, ddev, nseg):
+                fab.announce(sdev, ddev, tag, payload, "e", nseg, seq=seq)
+                req.fulfill(outputs=payload)
+                return None
+            return step
+
+        first = cont_eager(0)
+        if first is not None:
+            self._park_continuation(cont_eager, first)
+        return req
 
     def _cross_recv(self, dstbuf, count, src, dst, tag, to_device,
                     run_async, comm, compress_dtype) -> Optional[Request]:
-        """Receive from a rank owned by another controller process."""
-        if run_async:
-            raise ACCLError(
-                errorCode.CONFIG_ERROR,
-                "cross-process recv is synchronous; drop run_async")
+        """Receive from a rank owned by another controller process.
+
+        Matches announcements on (src, tag|ANY) in seqn order with
+        out-of-order parking (rxbuf_seek.cpp:50-66 semantics), accepts the
+        match into the global move schedule, and drives the mover until the
+        payload shard lands on this process's device — written into the
+        destination buffer without a host round-trip."""
         if not comm.rank_is_local(dst):
             raise ACCLError(
                 errorCode.CONFIG_ERROR,
                 f"process {jax.process_index()} does not own dst rank {dst}")
         self._check_count(dstbuf, count, "recv")
-        _ = self._arith(dstbuf.dtype, compress_dtype)  # validate the pair
-        np_dtype = np.dtype(dstbuf.jnp_dtype)
-        # the SENDER's size/compression decide the protocol (fw :575-651);
-        # the fabric recv follows whichever the wire shows
-        vals = self._fabric.recv(src, dst, tag, count, np_dtype)
-        dstbuf.store_rank_local(dst, vals)
-        return self._finish(operation.recv, None, vals, to_device, False, comm)
+        arith = self._arith(dstbuf.dtype, compress_dtype)
+        sdev, ddev = comm.device(src).id, comm.device(dst).id
+        fab = self._fabric
+        delivered: list = []
+
+        def deliver(shard, header) -> None:
+            x = shard
+            if arith is not None and arith.is_compressing:
+                from . import ops as _ops
+                x = _ops.decompress(x, arith.compressed, arith.uncompressed)
+            # device-only store in the mover's hot path; the host mirror is
+            # refreshed once by the recv finalizer when to_device is False
+            dstbuf.store_rank_shard(dst, x, sync_host=False)
+            delivered.append(True)
+
+        def match_once() -> bool:
+            m = fab.try_match(sdev, ddev, tag)
+            if m is None:
+                return False
+            seq, header = m
+            if header["n"] != count:
+                raise ACCLError(
+                    errorCode.INVALID_BUFFER_SIZE,
+                    f"recv {dst}<-{src}: count {count} != message count "
+                    f"{header['n']}")
+            fab.accept(sdev, ddev, seq, header, deliver)
+            return True
+
+        if not run_async:
+            self._drive_until(
+                match_once,
+                f"recv {dst}<-{src}: no matching send within "
+                f"{self.config.timeout}s")
+            self._drive_until(
+                lambda: bool(delivered),
+                f"recv {dst}<-{src}: accepted but the move never "
+                f"executed within {self.config.timeout}s")
+            return self._finish(operation.recv, dstbuf, None, to_device,
+                                False, comm)
+
+        def finalizer(_req: Request) -> None:
+            if not to_device:
+                dstbuf.sync_from_device()
+
+        req = Request(operation.recv.name, outputs=None, finalizer=finalizer,
+                      external=True, on_complete=self._queue.retire,
+                      progress=self._pump, comm=comm,
+                      native_registry=self._reqreg)
+        self._queue.push(req)
+        matched: list = []
+
+        def cont_recv(step: int) -> Optional[int]:
+            if req.status in (requestStatus.COMPLETED, requestStatus.ERROR):
+                return None
+            try:
+                if not matched and match_once():
+                    matched.append(True)
+                fab.drive()
+            except Exception as e:  # count mismatch etc. surface on wait()
+                req.cancel(error=e)
+                return None
+            if delivered:
+                req.fulfill(outputs=dstbuf.rank_shard(dst))
+                return None
+            return 1 if matched else 0
+
+        first = cont_recv(0)
+        if first is not None:
+            self._park_continuation(cont_recv, first)
+        return req
 
     def send(
         self,
@@ -815,10 +993,7 @@ class ACCL:
 
         first = continue_from(0)
         if first is not None:
-            call_id = self._next_call_id
-            self._next_call_id += 1
-            self._parked_calls[call_id] = continue_from
-            self._sched.push_retry(call_id, first)
+            self._park_continuation(continue_from, first)
         return req
 
     def recv(
@@ -1240,6 +1415,15 @@ class ACCL:
             lambda: primitives.build_barrier(comm),
         )
         if comm.is_multiprocess:
+            # host-level barrier FIRST, scoped to this communicator's
+            # processes and driving the mover while it waits: a peer may be
+            # blocked inside a pair move this process must co-execute
+            # before it can enter the device collective below. Scoping
+            # fixes the round-2 fabric's all-process over-synchronization
+            # (a 2-rank sub-comm barrier no longer blocks the whole job).
+            procs = sorted({d.process_index for d in comm.devices})
+            self._fabric.barrier(name=self._comm_tag(comm),
+                                 process_ids=procs, pump=self._pump)
             shards = [
                 jax.device_put(np.ones((1,), np.int32), comm.device(r))
                 for r in comm.local_ranks
@@ -1251,8 +1435,14 @@ class ACCL:
                 np.ones((comm.world_size,), dtype=np.int32), comm.sharding()
             )
         jax.block_until_ready(prog(token))
-        if self._fabric is not None:
-            self._fabric.barrier()
+
+    @staticmethod
+    def _comm_tag(comm: Communicator) -> str:
+        """Stable cross-process identity for a communicator: the ordered
+        global device-id list (id(comm) differs per process)."""
+        import hashlib
+        ids = ",".join(str(d.id) for d in comm.devices)
+        return hashlib.md5(ids.encode()).hexdigest()[:12]
 
     # ------------------------------------------------------------------
     # introspection (accl.cpp:980-1064 dump_* analogs)

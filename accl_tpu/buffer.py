@@ -154,6 +154,46 @@ class Buffer(BaseBuffer):
                 return np.asarray(shard.data).reshape(-1)[:count]
         raise ValueError(f"rank {rank} is not local to this process")
 
+    def rank_shard(self, rank: int) -> jax.Array:
+        """Rank ``rank``'s (1, count) shard as a device array — the
+        device-resident handle the cross-process mover stages, so payload
+        never bounces through host numpy (must be process-local)."""
+        arr = self.data
+        for shard in arr.addressable_shards:
+            if shard.index[0].start == rank:
+                return shard.data
+        raise ValueError(f"rank {rank} is not local to this process")
+
+    def store_rank_shard(self, rank: int, values: jax.Array,
+                         offset: int = 0, sync_host: bool = True) -> None:
+        """Device-native write of a (1, n) device array into rank
+        ``rank``'s shard at element ``offset``, reassembling the global
+        array from per-process shards without a host round-trip. With
+        ``sync_host`` the staging mirror is refreshed for the written span
+        (the receiving process's own D2H); callers on a hot device path
+        pass False and sync once at completion instead."""
+        arr = self.data
+        shards = []
+        done = False
+        for shard in arr.addressable_shards:
+            if shard.index[0].start == rank:
+                row = shard.data
+                new = jax.lax.dynamic_update_slice(
+                    row, values.astype(row.dtype).reshape(1, -1),
+                    (0, offset))
+                shards.append(new)
+                done = True
+            else:
+                shards.append(shard.data)
+        if not done:
+            raise ValueError(f"rank {rank} is not local to this process")
+        self._device = jax.make_array_from_single_device_arrays(
+            (self.comm.world_size, self.count), self.comm.sharding(), shards)
+        if sync_host:
+            n = values.shape[-1]
+            self.host[rank, offset : offset + n] = (
+                np.asarray(values).reshape(-1))
+
     def store_rank_local(self, rank: int, values: np.ndarray) -> None:
         """Write into rank ``rank``'s shard (must be process-local),
         reassembling the global array from per-process shards."""
@@ -223,6 +263,14 @@ class BufferSlice(BaseBuffer):
         cur = self.parent.read_rank_local(rank, self.parent.count).copy()
         cur[self.start : self.start + values.shape[-1]] = values
         self.parent.store_rank_local(rank, cur)
+
+    def rank_shard(self, rank: int) -> jax.Array:
+        return self.parent.rank_shard(rank)[:, self.start : self.end]
+
+    def store_rank_shard(self, rank: int, values: jax.Array,
+                         offset: int = 0, sync_host: bool = True) -> None:
+        self.parent.store_rank_shard(rank, values, self.start + offset,
+                                     sync_host)
 
     def device_view(self) -> jax.Array:
         if self.start == 0 and self.end == self.parent.count:

@@ -7,13 +7,43 @@ build, expressed through JAX's multi-controller runtime instead of MPI+ZMQ:
 
 * process bring-up = ``jax.distributed.initialize`` (gloo TCP collectives
   on the CPU emulator rung; native ICI/DCN on real multi-host TPU);
-* device data plane = global ``jax.Array``s assembled from per-process
-  shards (``jax.make_array_from_single_device_arrays``) — collectives are
-  the same shard_map programs, now executed SPMD by every controller;
-* host control plane = the distributed coordination service's key-value
-  store, standing in for the ZMQ pub/sub fabric: eager segments, the
-  rendezvous address handshake, flow-control credits and barriers all ride
-  on it.
+* **device data plane** = every cross-process message moves as an SPMD
+  ``ppermute`` program over a two-device *pair mesh* that both endpoint
+  controllers enter — payload rides the interconnect (gloo TCP on the
+  emulator rung, ICI/DCN on hardware), exactly like the collectives, and
+  **never transits the coordination service**. This is the reference's
+  defining control/data split: the host-side service only supervises
+  (``/root/reference/README.md:5-13``); a rendezvous message is one
+  device-to-device write (``ccl_offload_control.c:604-612``).
+* **host control plane** = the coordination service's key-value store
+  carries only headers: message announcements, the global move schedule,
+  and barriers. A byte counter (:attr:`CrossProcessFabric.kv_bytes`)
+  tracks every control write so tests can assert payload never rides it.
+
+Protocol (two-sided semantics on an SPMD machine):
+
+1. The sender *announces* a message under ``m/{sdev}.{ddev}/{seq}`` — a
+   small JSON header (tag, wire dtype, count, eager/rendezvous kind) — and
+   keeps the payload staged **on its own device** (jax arrays are
+   immutable, so holding the shard reference is a zero-copy snapshot).
+2. The receiver *matches* announcements against posted recvs on
+   (src, tag | TAG_ANY) in seqn order, parking non-matching heads — the
+   out-of-order matching of ``rxbuf_seek.cpp:50-66``.
+3. On match the receiver *accepts*: it draws a globally unique index from
+   an atomic KV counter and publishes a schedule record ``s/{idx}``.
+4. Every controller *drives* the schedule in index order, entering the
+   pair-mesh move program for each record it participates in. The global
+   total order makes concurrent cross-traffic deadlock-free: the smallest
+   outstanding move is always entered first by both of its endpoints.
+
+Eager vs rendezvous keeps the firmware's observable split: an eager send
+completes at announce time (bounded by a credit window of
+staged-but-unmoved rx-buffer-sized segments — the rx pool backpressure;
+credits free locally because the sender co-executes every move), while a
+rendezvous send completes only when the move has executed (zero-copy
+buffer handoff). Progress is cooperative, like the single-threaded
+MicroBlaze dispatch loop: moves execute inside ACCL calls (send/recv/
+barrier/request waits), not on a background thread.
 
 Environment contract (set by :mod:`accl_tpu.launch`):
 
@@ -27,12 +57,12 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import Optional
+from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
 from . import constants
-from .constants import ACCLError, dataType, errorCode
+from .constants import ACCLError, ACCLTimeoutError, errorCode
 
 _ENV_COORD = "ACCL_COORDINATOR"
 _ENV_NPROCS = "ACCL_NUM_PROCS"
@@ -108,93 +138,58 @@ def _client():
 
 
 class CrossProcessFabric:
-    """KV-store message fabric between per-rank controllers.
+    """Control plane + device-move scheduler between per-rank controllers.
 
-    Protocol (mirrors the firmware's two-sided split, with the coordination
-    service playing the wire):
-
-    * **eager** (payload <= max_eager_size, or compressed): the sender
-      posts rx-buffer-sized segments immediately under keys
-      ``e/{src}.{dst}/{seq}``, throttled by a per-pair credit window of
-      ``eager_rx_buffer_count`` unconsumed segments (the rx-pool
-      backpressure, rxbuf_enqueue.cpp lifecycle); the receiver consumes
-      them in sequence order and bumps the pair's ack counter.
-    * **rendezvous** (larger): the receiver announces its posted recv under
-      ``a/{src}.{dst}/{seq}`` (the address handshake,
-      ``ccl_offload_control.c:142-150``); the sender blocks for the
-      announcement, then writes the payload in one post
-      (``r/{src}.{dst}/{seq}`` — the single RDMA WRITE analog :604-612).
-
-    Sequence numbers are per (src, dst) pair and counted independently at
-    both endpoints — identical to the exchange-memory seqn registers the
-    DMP updates on each side of the wire (dma_mover.cpp:581-610).
+    Endpoints are named by **global device ids** (the session table of
+    ``communicator.cpp:25-52``), so sequence numbers and announcements are
+    communicator-independent — two sub-communicators over the same device
+    pair share one ordered stream, like the exchange-memory seqn registers
+    (``dma_mover.cpp:581-610``).
     """
 
-    def __init__(self, timeout: float, eager_window: int):
+    def __init__(self, timeout: float, eager_window: int,
+                 eager_seg_bytes: int = 16 * 1024):
+        import jax
+
         self.timeout = timeout
+        #: credit window: max staged-but-unmoved eager segments per pair
         self.eager_window = max(int(eager_window), 1)
-        self._out_seq: dict = {}
-        self._in_seq: dict = {}
-        self._sent: dict = {}
+        self.eager_seg_bytes = max(int(eager_seg_bytes), 1)
+        self._me = jax.process_index()
+        self._dev_by_id = {d.id: d for d in jax.devices()}
+        # sender state
+        self._out_seq: Dict[Tuple[int, int], int] = {}
+        self._reserved: set = set()
+        self._staged: Dict[Tuple[int, int, int], object] = {}
+        self._staged_segs: Dict[Tuple[int, int], int] = {}
+        # receiver state
+        self._fetch_seq: Dict[Tuple[int, int], int] = {}
+        self._parked_ann: Dict[Tuple[int, int], Dict[int, dict]] = {}
+        self._accepts: Dict[Tuple[int, int, int], Callable] = {}
+        # global schedule cursor (next s/{idx} to consider): snapshot the
+        # counter so a fabric created after an earlier session's teardown
+        # skips history it can never participate in (any move involving
+        # this fabric is announced/accepted only after this line)
+        self._cursor = int(self._try_get(_client(), "accl/sn") or 0) + 1
+        # pair-mesh move programs keyed (sdev, ddev, count, wire dtype)
+        self._progs: Dict[tuple, tuple] = {}
+        self._bar_epoch: Dict[str, int] = {}
+        #: control bytes written to the KV store (keys + values) — the
+        #: accounting that proves payload rides the device path
+        self.kv_bytes = 0
+        #: payload bytes moved by pair-mesh device programs this process
+        #: participated in (each endpoint counts every move it entered)
+        self.moved_bytes = 0
 
-    # -- key helpers -------------------------------------------------------
+    # -- KV helpers (all writes tallied) -----------------------------------
 
-    @staticmethod
-    def _pair(src: int, dst: int) -> str:
-        return f"{src}.{dst}"
+    def _kset(self, client, key: str, value: str) -> None:
+        self.kv_bytes += len(key) + len(value)
+        client.key_value_set(key, value)
 
-    def _next_out(self, src: int, dst: int) -> int:
-        k = (src, dst)
-        self._out_seq[k] = self._out_seq.get(k, 0) + 1
-        return self._out_seq[k]
-
-    def _next_in(self, src: int, dst: int) -> int:
-        k = (src, dst)
-        self._in_seq[k] = self._in_seq.get(k, 0) + 1
-        return self._in_seq[k]
-
-    def _timeout_ms(self) -> int:
-        return max(int(self.timeout * 1000), 1)
-
-    # -- wire format -------------------------------------------------------
-
-    @staticmethod
-    def _pack(header: dict, payload: bytes) -> bytes:
-        h = json.dumps(header).encode()
-        return len(h).to_bytes(4, "little") + h + payload
-
-    @staticmethod
-    def _unpack(blob: bytes):
-        hlen = int.from_bytes(blob[:4], "little")
-        header = json.loads(blob[4 : 4 + hlen].decode())
-        return header, blob[4 + hlen :]
-
-    # -- eager path --------------------------------------------------------
-
-    def send_eager(self, src: int, dst: int, tag: int, data: np.ndarray,
-                   seg_elems: int) -> None:
-        """Post segments immediately, bounded by the credit window."""
-        client = _client()
-        pair = self._pair(src, dst)
-        total = data.shape[-1]
-        offs = list(range(0, total, seg_elems))
-        nseg = len(offs)
-        for i, off in enumerate(offs):
-            self._await_credit(client, pair, src, dst)
-            seq = self._next_out(src, dst)
-            seg = np.ascontiguousarray(data[..., off : off + seg_elems])
-            header = {
-                "tag": tag,
-                "dtype": str(seg.dtype),
-                "count": int(seg.shape[-1]),
-                "total": int(total),
-                "seg": i,
-                "nseg": nseg,
-            }
-            client.key_value_set_bytes(
-                f"accl/e/{pair}/{seq}", self._pack(header, seg.tobytes())
-            )
-            self._sent[(src, dst)] = self._sent.get((src, dst), 0) + 1
+    def _kincr(self, client, key: str, by: int = 1) -> int:
+        self.kv_bytes += len(key) + 8
+        return int(client.key_value_increment(key, by))
 
     @staticmethod
     def _try_get(client, key: str) -> Optional[str]:
@@ -205,147 +200,264 @@ class CrossProcessFabric:
         except Exception:
             return None
 
-    @staticmethod
-    def _try_get_bytes(client, key: str) -> Optional[bytes]:
-        try:
-            return client.key_value_try_get_bytes(key)
-        except Exception:
-            return None
+    def _timeout_ms(self) -> int:
+        return max(int(self.timeout * 1000), 1)
 
-    def _await_credit(self, client, pair: str, src: int, dst: int) -> None:
-        """Block while the unconsumed-segment window is full (rx-pool
-        backpressure: IDLE/ENQUEUED slot turnover)."""
-        sent = self._sent.get((src, dst), 0)
-        if sent < self.eager_window:
-            return
-        deadline = time.monotonic() + self.timeout
-        while True:
-            acked = self._try_get(client, f"accl/ack/{pair}") or "0"
-            if sent - int(acked) < self.eager_window:
-                return
-            if time.monotonic() > deadline:
-                raise ACCLError(
-                    errorCode.NOT_READY_ERROR,
-                    f"eager window to rank {dst} full for "
-                    f"{self.timeout}s (no recv consuming segments)",
-                )
-            time.sleep(0.002)
+    # -- sender side -------------------------------------------------------
 
-    # -- rendezvous send ---------------------------------------------------
+    def next_seq(self, sdev: int, ddev: int) -> int:
+        """Reserve the next sequence number on the pair. The reservation is
+        tracked until :meth:`announce` / :meth:`announce_cancel` resolves it
+        so :meth:`reset` can tombstone holes a dropped send would leave."""
+        k = (sdev, ddev)
+        self._out_seq[k] = self._out_seq.get(k, 0) + 1
+        seq = self._out_seq[k]
+        self._reserved.add((sdev, ddev, seq))
+        return seq
 
-    def send_rendezvous(self, src: int, dst: int, tag: int,
-                        data: np.ndarray) -> None:
-        """Block for the receiver's announcement, then one payload post."""
-        client = _client()
-        pair = self._pair(src, dst)
-        seq = self._next_out(src, dst)
-        try:
-            ann = client.blocking_key_value_get(
-                f"accl/a/{pair}/{seq}", self._timeout_ms())
-        except Exception as e:
-            raise ACCLError(
-                errorCode.NOT_READY_ERROR,
-                f"rendezvous send {src}->{dst}: no recv announced "
-                f"within {self.timeout}s ({e})") from e
-        ann = json.loads(ann)
-        if ann["count"] != int(data.shape[-1]):
-            raise ACCLError(
-                errorCode.INVALID_BUFFER_SIZE,
-                f"rendezvous send {src}->{dst}: recv count {ann['count']} "
-                f"!= send count {int(data.shape[-1])}")
-        header = {"tag": tag, "dtype": str(data.dtype),
-                  "count": int(data.shape[-1])}
-        client.key_value_set_bytes(
-            f"accl/r/{pair}/{seq}",
-            self._pack(header, np.ascontiguousarray(data).tobytes()))
+    def nsegments(self, nbytes: int) -> int:
+        """Eager staging cost in rx-buffer slots (fw segmentation geometry,
+        ccl_offload_control.c:613-650)."""
+        return max((int(nbytes) + self.eager_seg_bytes - 1)
+                   // self.eager_seg_bytes, 1)
 
-    # -- receive (protocol discovered from the wire) -----------------------
+    def eager_credit_free(self, sdev: int, ddev: int, nseg: int) -> bool:
+        """Whether ``nseg`` more staged segments fit the pair's window.
 
-    def recv(self, src: int, dst: int, tag: int, count: int,
-             np_dtype) -> np.ndarray:
-        """Receive one message, following whichever protocol the sender
-        chose.
+        A message larger than the whole window (e.g. a big compressed
+        payload, which must ride eager for fw parity) is admitted when the
+        pair has nothing staged — it takes the window exclusively;
+        otherwise it could never be sent at all (the in-process pool path
+        raises the same way only when no recv could ever drain it)."""
+        used = self._staged_segs.get((sdev, ddev), 0)
+        return used == 0 or used + nseg <= self.eager_window
 
-        The sender is authoritative for the eager/rendezvous split (its
-        byte count and compression decide, fw send :575-651); the receiver
-        cannot know it in advance when dtypes differ across the pair. So
-        the recv always announces itself (the rendezvous address post —
-        harmless if unused) and then waits for this sequence number to
-        materialize as either an eager segment or a rendezvous payload.
+    def announce(self, sdev: int, ddev: int, tag: int, payload,
+                 kind: str, nseg: int, seq: Optional[int] = None) -> int:
+        """Stage the payload on-device and publish the message header.
+
+        ``payload`` is a single-device jax array of shape (1, count) on the
+        source device; immutability makes the held reference a snapshot
+        (eager) and a zero-copy handle (rendezvous) at once.
+
+        ``seq`` publishes under a sequence number reserved earlier with
+        :meth:`next_seq` — a credit-starved send reserves its seq at issue
+        time so later sends on the pair cannot overtake it (the receiver's
+        fetch cursor stalls at the unannounced seq, so per-pair posting
+        order IS delivery-visibility order, MPI non-overtaking semantics).
         """
         client = _client()
-        pair = self._pair(src, dst)
-        seq = self._next_in(src, dst)
-        client.key_value_set(
-            f"accl/a/{pair}/{seq}", json.dumps({"count": int(count)}))
-        blob, is_rendezvous = self._await_message(client, pair, seq, src, dst)
-        header, payload = self._unpack(blob)
-        if tag != constants.TAG_ANY and header["tag"] != tag:
-            raise ACCLError(
-                errorCode.RECEIVE_OFFCHIP_ERROR,
-                f"recv {dst}<-{src}: tag mismatch (got {header['tag']}, "
-                f"want {tag}) at head of pair stream")
-        if is_rendezvous:
-            client.key_value_delete(f"accl/r/{pair}/{seq}")
-            return np.frombuffer(payload, dtype=header["dtype"]).astype(
-                np_dtype, copy=False)
+        if seq is None:
+            seq = self.next_seq(sdev, ddev)
+        self._reserved.discard((sdev, ddev, seq))
+        credits = nseg if kind == "e" else 0
+        self._staged[(sdev, ddev, seq)] = (payload, credits)
+        if credits:
+            k = (sdev, ddev)
+            self._staged_segs[k] = self._staged_segs.get(k, 0) + credits
+        header = {"tag": int(tag), "dt": str(payload.dtype),
+                  "n": int(payload.shape[-1]), "k": kind, "g": int(nseg)}
+        self._kset(client, f"accl/m/{sdev}.{ddev}/{seq}", json.dumps(header))
+        return seq
 
-        # eager: the announcement went unused — reclaim it
-        client.key_value_delete(f"accl/a/{pair}/{seq}")
-        # the first segment carries the message geometry; consume the
-        # remaining segments in sequence order
-        if header["total"] != count:
-            raise ACCLError(
-                errorCode.INVALID_BUFFER_SIZE,
-                f"recv {dst}<-{src}: count {count} != message total "
-                f"{header['total']}")
-        client.key_value_delete(f"accl/e/{pair}/{seq}")
-        client.key_value_increment(f"accl/ack/{pair}", 1)
-        parts = [np.frombuffer(payload, dtype=header["dtype"])]
-        got = header["count"]
-        while got < count:
-            seq = self._next_in(src, dst)
-            key = f"accl/e/{pair}/{seq}"
-            try:
-                blob = client.blocking_key_value_get_bytes(
-                    key, self._timeout_ms())
-            except Exception as e:
-                raise ACCLError(
-                    errorCode.NOT_READY_ERROR,
-                    f"recv {dst}<-{src}: segment seq={seq} never arrived "
-                    f"({e})") from e
-            header, payload = self._unpack(blob)
-            parts.append(np.frombuffer(payload, dtype=header["dtype"]))
-            got += header["count"]
-            client.key_value_delete(key)
-            client.key_value_increment(f"accl/ack/{pair}", 1)
-        return np.concatenate(parts).astype(np_dtype, copy=False)
+    def announce_cancel(self, sdev: int, ddev: int, seq: int) -> None:
+        """Release a reserved-but-never-announced sequence number (a parked
+        send cancelled by soft_reset): publishes a tombstone so the
+        receiver's fetch cursor can advance past the hole."""
+        self._reserved.discard((sdev, ddev, seq))
+        self._kset(_client(), f"accl/m/{sdev}.{ddev}/{seq}",
+                   json.dumps({"k": "x"}))
 
-    def _await_message(self, client, pair: str, seq: int,
-                       src: int, dst: int):
-        """Poll for sequence ``seq`` arriving as an eager segment or a
-        rendezvous payload; returns (blob, is_rendezvous)."""
-        deadline = time.monotonic() + self.timeout
+    def reset(self) -> None:
+        """Local-state part of soft_reset (cfgFunc::reset_periph analog).
+
+        Tombstones every reserved-but-unannounced sequence number so peer
+        fetch cursors never stall on holes left by dropped sends. Announced
+        in-flight messages are deliberately NOT retracted: a peer may
+        already have fetched/accepted them, and retracting one side of a
+        committed move would desynchronize the global schedule — like the
+        reference, a soft reset is per-controller; a full distributed reset
+        is all processes resetting at a barrier."""
+        for (sdev, ddev, seq) in list(self._reserved):
+            self.announce_cancel(sdev, ddev, seq)
+
+    def send_pending(self, sdev: int, ddev: int, seq: int) -> bool:
+        """True while the staged payload has not been moved yet."""
+        return (sdev, ddev, seq) in self._staged
+
+    # -- receiver side -----------------------------------------------------
+
+    def _fetch(self, client, sdev: int, ddev: int) -> None:
+        """Pull new announcements for the pair into the parked table.
+        Cancellation tombstones (kind "x") advance the cursor unparked."""
+        k = (sdev, ddev)
+        cur = self._fetch_seq.get(k, 1)
         while True:
-            blob = self._try_get_bytes(client, f"accl/e/{pair}/{seq}")
-            if blob is not None:
-                return blob, False
-            blob = self._try_get_bytes(client, f"accl/r/{pair}/{seq}")
-            if blob is not None:
-                return blob, True
-            if time.monotonic() > deadline:
-                raise ACCLError(
-                    errorCode.NOT_READY_ERROR,
-                    f"recv {dst}<-{src}: no matching send within "
-                    f"{self.timeout}s")
-            time.sleep(0.002)
+            key = f"accl/m/{sdev}.{ddev}/{cur}"
+            v = self._try_get(client, key)
+            if v is None:
+                break
+            h = json.loads(v)
+            if h.get("k") != "x":
+                self._parked_ann.setdefault(k, {})[cur] = h
+            client.key_value_delete(key)
+            cur += 1
+        self._fetch_seq[k] = cur
+
+    def try_match(self, sdev: int, ddev: int,
+                  tag: int) -> Optional[Tuple[int, dict]]:
+        """Match a posted recv against announcements on (src, tag|ANY) in
+        seqn order, skipping (parking) non-matching heads — the
+        out-of-order matching table of ``rxbuf_seek.cpp:50-66``.
+
+        Non-consuming: the matched announcement stays parked until
+        :meth:`accept` commits it, so a caller that rejects the match
+        (count mismatch) leaves the message matchable by a corrected recv.
+        """
+        self._fetch(_client(), sdev, ddev)
+        parked = self._parked_ann.get((sdev, ddev), {})
+        for seq in sorted(parked):
+            h = parked[seq]
+            if tag == constants.TAG_ANY or h["tag"] == tag:
+                return seq, h
+        return None
+
+    def accept(self, sdev: int, ddev: int, seq: int, header: dict,
+               deliver: Callable) -> int:
+        """Commit a match: consume the parked announcement, draw a global
+        schedule index and publish the move record. ``deliver(shard,
+        header)`` runs on this (receiver) process when the move executes,
+        with the payload shard on the dst device."""
+        client = _client()
+        self._parked_ann.get((sdev, ddev), {}).pop(seq, None)
+        self._accepts[(sdev, ddev, seq)] = lambda arr: deliver(arr, header)
+        idx = self._kincr(client, "accl/sn")
+        rec = {"s": sdev, "d": ddev, "q": seq,
+               "n": header["n"], "dt": header["dt"]}
+        self._kset(client, f"accl/s/{idx}", json.dumps(rec))
+        return idx
+
+    # -- the mover ---------------------------------------------------------
+
+    def _program(self, sdev: int, ddev: int, count: int, wdt: str):
+        """Pair-mesh move program: one ppermute over Mesh([src, dst]) — the
+        single RDMA WRITE analog (ccl_offload_control.c:604-612). Cached per
+        (pair, shape, dtype); both endpoint processes compile identically.
+        """
+        key = (sdev, ddev, count, wdt)
+        hit = self._progs.get(key)
+        if hit is not None:
+            return hit
+        import jax
+        from jax import lax, shard_map
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array([self._dev_by_id[sdev], self._dev_by_id[ddev]]),
+                    ("pair",))
+        sharding = NamedSharding(mesh, P("pair"))
+        prog = jax.jit(shard_map(
+            lambda x: lax.ppermute(x, "pair", [(0, 1)]),
+            mesh=mesh, in_specs=P("pair"), out_specs=P("pair"),
+            check_vma=False))
+        self._progs[key] = (prog, sharding)
+        return prog, sharding
+
+    def _execute(self, rec: dict) -> None:
+        """Enter the move program for one schedule record. Both endpoint
+        processes call this with the same record at the same cursor; the
+        collective blocks until the peer joins (cooperative progress).
+
+        Entering a move is a COMMITMENT: like any SPMD collective (or an
+        MPI rendezvous), it cannot be cancelled once entered, so a peer
+        that died mid-protocol leaves this side blocked past any timeout.
+        That failure mode is resolved at the job level — the launcher's
+        mpirun-style abort semantics kill all controllers when one dies
+        (launch.py), exactly like the reference's MPI harness."""
+        import jax
+        import jax.numpy as jnp
+
+        sdev, ddev, seq = rec["s"], rec["d"], rec["q"]
+        count, wdt = rec["n"], rec["dt"]
+        i_send = self._dev_by_id[sdev].process_index == self._me
+        prog, sharding = self._program(sdev, ddev, count, wdt)
+        if i_send:
+            shard, credits = self._staged.pop((sdev, ddev, seq))
+        else:
+            shard = jax.device_put(
+                jnp.zeros((1, count), dtype=wdt), self._dev_by_id[ddev])
+        garr = jax.make_array_from_single_device_arrays(
+            (2, count), sharding, [shard])
+        out = prog(garr)
+        jax.block_until_ready(out)
+        self.moved_bytes += count * np.dtype(wdt).itemsize
+        if i_send:
+            # return exactly the credits this message took (0 for
+            # rendezvous — it never entered the eager window)
+            if credits:
+                k = (sdev, ddev)
+                self._staged_segs[k] = max(
+                    self._staged_segs.get(k, 0) - credits, 0)
+        else:
+            cb = self._accepts.pop((sdev, ddev, seq))
+            cb(out.addressable_shards[0].data)
+        # schedule records are never deleted mid-session: a third process
+        # whose cursor has not reached this index yet must still read it to
+        # skip — a hole would look like "not yet published" and stall its
+        # scheduler. ~100 B/message in the coordinator, which dies with the
+        # job (the reference's exchange memory persists the same way).
+
+    def drive(self) -> bool:
+        """Advance the global move schedule: execute (or skip) every
+        published record from the cursor on, in index order — the
+        cooperative dispatch loop (``wait_for_call`` round-robin,
+        ccl_offload_control.c:2264-2288). Returns whether anything ran."""
+        client = _client()
+        progressed = False
+        while True:
+            v = self._try_get(client, f"accl/s/{self._cursor}")
+            if v is None:
+                return progressed
+            rec = json.loads(v)
+            sp = self._dev_by_id[rec["s"]].process_index
+            dp = self._dev_by_id[rec["d"]].process_index
+            if self._me in (sp, dp):
+                self._execute(rec)
+                progressed = True
+            self._cursor += 1
 
     # -- barrier -----------------------------------------------------------
 
-    _barrier_n = 0
+    def barrier(self, name: str = "all",
+                process_ids: Optional[list] = None,
+                pump: Optional[Callable[[], bool]] = None) -> None:
+        """Barrier over a process subset that keeps the mover driving while
+        it waits — required because a peer may be blocked inside a pair
+        move this process must co-execute before it can arrive. Scoped per
+        ``name`` (one per communicator), fixing the all-process
+        over-synchronization of the round-2 fabric. ``pump`` (the session's
+        cooperative scheduler) is preferred over the raw mover so parked
+        continuations — e.g. a credit-starved async send that still needs
+        to announce — also progress while this process waits."""
+        import jax
 
-    def barrier(self, name: str = "accl") -> None:
-        """All-process barrier (coordination-service native)."""
-        CrossProcessFabric._barrier_n += 1
-        _client().wait_at_barrier(
-            f"{name}/{CrossProcessFabric._barrier_n}", self._timeout_ms())
+        client = _client()
+        n = len(process_ids) if process_ids is not None else jax.process_count()
+        epoch = self._bar_epoch.get(name, 0) + 1
+        self._bar_epoch[name] = epoch
+        key = f"accl/b/{name}/{epoch}"
+        self._kincr(client, key)
+        deadline = time.monotonic() + self.timeout
+        progress = pump or self.drive
+        while int(self._try_get(client, key) or 0) < n:
+            if not progress():
+                time.sleep(0.002)
+            if time.monotonic() > deadline:
+                raise ACCLTimeoutError(
+                    f"barrier {name!r}: {self._try_get(client, key)}/{n} "
+                    f"processes within {self.timeout}s")
+        # all arrived; lazily reap the previous epoch's key
+        if epoch > 1:
+            try:
+                client.key_value_delete(f"accl/b/{name}/{epoch - 1}")
+            except Exception:
+                pass

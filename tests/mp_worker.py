@@ -5,10 +5,13 @@ reference test process per rank under mpirun (fixture.hpp:48-144). The
 launcher's env connects us to the coordination service on import of
 accl_tpu; from there the same public API runs SPMD.
 
-Exercises, across 2 processes x 2 devices (world=4):
-collectives (allreduce/bcast) executed by every controller; eager and
-rendezvous cross-process send/recv; compressed wire payloads; the
-in-process two-sided path between same-process ranks; barriers.
+Shape-agnostic: runs under any process x devices-per-process launch shape
+(the reference suite parametrizes rank counts, fixture.hpp:48-144).
+Exercises: collectives executed by every controller; eager and rendezvous
+cross-process send/recv over the DEVICE data plane (with control/data byte
+accounting proving payload never transits the coordination service);
+compressed wire payloads; in-process pairs; sub-communicators spanning
+processes unevenly; comm-scoped barriers.
 """
 import sys
 
@@ -27,7 +30,6 @@ def main() -> int:
     acc = accl_tpu.ACCL()
     comm = acc.global_comm()
     W = acc.world_size
-    assert W == 4, f"expected world 4, got {W}"
     assert comm.is_multiprocess
     local = comm.local_ranks
     print(f"[p{me}] world={W} local_ranks={local}", flush=True)
@@ -114,14 +116,38 @@ def main() -> int:
         assert np.allclose(rb2.host[dst][10 : 10 + half], payload[:half])
     print(f"[p{me}] slice cross-process ok", flush=True)
 
+    # ---- 1 MiB rendezvous + control/data accounting --------------------
+    # the defining property of the data plane: payload rides pair-mesh
+    # device programs (gloo TCP / ICI), the coordination service carries
+    # only headers (README.md:5-13 "the host only supervises")
+    bigN = 256 * 1024  # 1 MiB f32
+    sb4 = acc.create_buffer(bigN, dataType.float32)
+    rb4 = acc.create_buffer(bigN, dataType.float32)
+    if comm.rank_is_local(src):
+        sb4.host[src] = np.arange(bigN, dtype=np.float32) % 1000
+        acc.send(sb4, bigN, src=src, dst=dst, tag=23)
+    if comm.rank_is_local(dst):
+        acc.recv(rb4, bigN, src=src, dst=dst, tag=23)
+        assert np.allclose(rb4.host[dst],
+                           np.arange(bigN, dtype=np.float32) % 1000)
+    if comm.rank_is_local(src) or comm.rank_is_local(dst):
+        fab = acc._fabric
+        assert fab.moved_bytes >= 4 * bigN, fab.moved_bytes
+        assert fab.kv_bytes < max(fab.moved_bytes // 50, 8192), (
+            f"KV control traffic {fab.kv_bytes} B is not small vs "
+            f"{fab.moved_bytes} B of device-path payload")
+        print(f"[p{me}] accounting ok: kv={fab.kv_bytes}B "
+              f"moved={fab.moved_bytes}B", flush=True)
+
     # ---- in-process pair still uses the matching engine ----------------
-    a, bb = local[0], local[1]
-    if comm.rank_is_local(a):
-        sb.host[a] = payload * 2
-        acc.send(sb, cnt, src=a, dst=bb, tag=3)
-        acc.recv(rb, cnt, src=a, dst=bb, tag=3)
-        assert np.allclose(rb.host[bb], payload * 2)
-    print(f"[p{me}] in-process pair ok", flush=True)
+    if len(local) >= 2:
+        a, bb = local[0], local[1]
+        if comm.rank_is_local(a):
+            sb.host[a] = payload * 2
+            acc.send(sb, cnt, src=a, dst=bb, tag=3)
+            acc.recv(rb, cnt, src=a, dst=bb, tag=3)
+            assert np.allclose(rb.host[bb], payload * 2)
+        print(f"[p{me}] in-process pair ok", flush=True)
 
     acc.barrier()
 
@@ -140,6 +166,40 @@ def main() -> int:
     if comm.rank_is_local(1):
         assert np.allclose(g.host[1].reshape(W, n), s.host)
     print(f"[p{me}] flat family ok", flush=True)
+
+    # ---- sub-communicator spanning processes (unevenly when W > 3) -----
+    # child ranks {0, 1, W-1}: two from the first process group, one from
+    # the last — the multi-comm split of test.cpp:621-752, now cross-process
+    if W >= 3:
+        sub_ranks = [0, 1, W - 1]
+        sub = acc.create_communicator(sub_ranks)
+        Ws = len(sub_ranks)
+        # ONLY member processes enter sub-comm programs: a controller with
+        # no addressable shard in the sub-mesh must not launch on it (the
+        # SPMD participation rule; MPI sub-communicator semantics)
+        member = len(sub.local_ranks) > 0
+        if member:
+            ss = acc.create_buffer(n, dataType.float32, comm=sub)
+            rs = acc.create_buffer(n, dataType.float32, comm=sub)
+            for i in range(Ws):
+                ss.host[i] = 10 * (i + 1)
+            acc.allreduce(ss, rs, n, reduceFunction.SUM, comm=sub)
+            for i, gr in enumerate(sub_ranks):
+                if comm.rank_is_local(gr):
+                    assert np.allclose(rs.host[i], 60), rs.host[i][:4]
+            # cross-process two-sided INSIDE the sub-communicator
+            if sub.is_multiprocess:
+                s_sub, d_sub = 0, Ws - 1  # global ranks 0 and W-1
+                if sub.rank_is_local(s_sub):
+                    ss.host[s_sub] = payload[:n]
+                    acc.send(ss, n, src=s_sub, dst=d_sub, tag=31, comm=sub)
+                if sub.rank_is_local(d_sub):
+                    acc.recv(rs, n, src=s_sub, dst=d_sub, tag=31, comm=sub)
+                    assert np.allclose(rs.host[d_sub], payload[:n])
+            # comm-scoped barrier: only the sub's processes participate —
+            # non-member controllers are NOT blocked (round-2 Weak #6 fix)
+            acc.barrier(comm=sub)
+            print(f"[p{me}] sub-communicator ok", flush=True)
 
     # ---- fused command list: one launch per controller per sequence ----
     cl = acc.command_list()
