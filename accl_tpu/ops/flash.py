@@ -105,6 +105,29 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
                 (lse_ref.shape[2] - rows, 128), _F32)
 
 
+def _pad_head_dim(q, k, v, d: int):
+    """Zero-pad the feature dim to the 128-lane tile. EXACT: padded q/k
+    lanes contribute 0 to every score, padded v lanes produce zero output
+    columns that the caller slices away (and autodiff through pad/slice
+    zeroes their gradients)."""
+    dp = -(-d // 128) * 128
+    if dp == d:
+        return q, k, v, dp
+    pad = ((0, 0), (0, 0), (0, dp - d))
+    return jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad), dp
+
+
+def _check_shapes(q, k, v, S, d, block_q, block_k):
+    if S % block_q or S % block_k or block_q % 128:
+        raise ValueError(
+            f"flash_attention needs S % block ({S} % {block_q}/{block_k}) "
+            f"== 0 and block_q % 128 == 0 ({block_q})")
+    if k.shape != v.shape or k.shape[1:] != (S, d) or q.shape[0] % k.shape[0]:
+        raise ValueError(
+            f"k/v shape {k.shape} incompatible with q {q.shape}: need "
+            f"(H_kv, S, d) with H % H_kv == 0 (grouped-query attention)")
+
+
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128):
@@ -113,8 +136,10 @@ def flash_attention(q, k, v, causal: bool = False,
     shares each kv head across ``H/H_kv`` q heads with no materialized
     repeat (the kv blocks are simply indexed per group).
 
-    Constraints (kernel tiling): S divisible by block_q and block_k, d a
-    multiple of 128 lanes. Callers with other shapes use the jnp path
+    Constraints (kernel tiling): S divisible by block_q and block_k. Any
+    head dim works: d not a multiple of 128 lanes (64 and 96, the common
+    attention sizes) is zero-padded to the tile — exact, see
+    ``_pad_head_dim``. Callers with other sequence shapes use the jnp path
     (``parallel.context``'s online-softmax blocks — same math, unfused).
 
     Differentiable: the custom VJP runs the canonical two-pass flash
@@ -126,17 +151,50 @@ def flash_attention(q, k, v, causal: bool = False,
     if single:
         q, k, v = q[None], k[None], v[None]
     H, S, d = q.shape
-    if S % block_q or S % block_k or d % 128 or block_q % 128:
-        raise ValueError(
-            f"flash_attention needs S % block ({S} % {block_q}/{block_k}) "
-            f"== 0, block_q % 128 == 0 ({block_q}) and d % 128 ({d}) == 0")
-    if k.shape != v.shape or k.shape[1:] != (S, d) or H % k.shape[0]:
-        raise ValueError(
-            f"k/v shape {k.shape} incompatible with q {q.shape}: need "
-            f"(H_kv, S, d) with H % H_kv == 0 (grouped-query attention)")
-    sc = scale if scale is not None else 1.0 / (d ** 0.5)
+    _check_shapes(q, k, v, S, d, block_q, block_k)
+    sc = scale if scale is not None else 1.0 / (d ** 0.5)  # ORIGINAL d
+    q, k, v, dp = _pad_head_dim(q, k, v, d)
     out = _flash(q, k, v, causal, sc, block_q, block_k)
+    if dp != d:
+        out = out[..., :d]
     return out[0] if single else out
+
+
+def flash_attention_lse(q, k, v, causal: bool = False,
+                        scale: Optional[float] = None,
+                        block_q: int = 128, block_k: int = 128):
+    """Like :func:`flash_attention` but also returns the per-row
+    log-sum-exp, shape (H, S) — the merge key for composing partial
+    attentions over key/value blocks (ring attention: each step's
+    (out, lse) pair merges into the running result). Differentiable in
+    BOTH outputs: the lse cotangent folds into the softmax-jacobian
+    correction (ds gains ``+ p * dlse``), so the same two backward kernels
+    serve, with ``D - dlse`` in place of ``D``."""
+    single = q.ndim == 2
+    if single:
+        q, k, v = q[None], k[None], v[None]
+    H, S, d = q.shape
+    _check_shapes(q, k, v, S, d, block_q, block_k)
+    sc = scale if scale is not None else 1.0 / (d ** 0.5)
+    q, k, v, dp = _pad_head_dim(q, k, v, d)
+    out, lse = _flash_lse(q, k, v, causal, sc, block_q, block_k)
+    if dp != d:
+        out = out[..., :d]
+    return (out[0], lse[0]) if single else (out, lse)
+
+
+def _lse_slab_to_2d(lse, H: int, S: int, block_q: int):
+    """(H, nq, pad_rows, 128) slab -> (H, S) row-major lse."""
+    rows = block_q // 128
+    return lse[:, :, :rows, :].reshape(H, S)
+
+
+def _lse_2d_to_slab(x, H: int, S: int, block_q: int):
+    nq, rows, pr = S // block_q, block_q // 128, _pad_rows(block_q)
+    x = x.reshape(H, nq, rows, 128)
+    if pr != rows:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, pr - rows), (0, 0)))
+    return x
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -149,23 +207,49 @@ def _flash_vjp_fwd(q, k, v, causal, sc, block_q, block_k):
     return out, (q, k, v, out, lse)
 
 
-def _flash_vjp_bwd(causal, sc, block_q, block_k, res, do):
-    q, k, v, out, lse = res
+def _bwd_from_dd(q, k, v, do, lse, dd_2d, causal, sc, block_q, block_k):
+    """Shared backward: ``dd_2d`` (H, S) is the per-row correction term —
+    plain D for the out-only VJP, ``D - dlse`` when an lse cotangent
+    exists (∂lse/∂s = p folds into the same p·(dp − ·) form)."""
     H, S, _ = q.shape
-    # D_i = rowsum(dO ∘ O) — the softmax-jacobian correction term, stored
-    # in the same per-q-block lane-tiled slab layout as lse
-    nq, rows, pr = S // block_q, block_q // 128, _pad_rows(block_q)
-    dd = jnp.sum(do.astype(_F32) * out.astype(_F32), axis=-1)
-    dd = dd.reshape(H, nq, rows, 128)
-    if pr != rows:
-        dd = jnp.pad(dd, ((0, 0), (0, 0), (0, pr - rows), (0, 0)))
+    dd = _lse_2d_to_slab(dd_2d, H, S, block_q)
     dk, dv = _flash_bwd_kv(q, k, v, do, lse, dd, causal, sc,
                            block_q, block_k)
     dq = _flash_bwd_q(q, k, v, do, lse, dd, causal, sc, block_q, block_k)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+def _flash_vjp_bwd(causal, sc, block_q, block_k, res, do):
+    q, k, v, out, lse = res
+    # D_i = rowsum(dO ∘ O) — the softmax-jacobian correction term
+    dd = jnp.sum(do.astype(_F32) * out.astype(_F32), axis=-1)
+    return _bwd_from_dd(q, k, v, do, lse, dd, causal, sc, block_q, block_k)
+
+
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q, k, v, causal, sc, block_q, block_k):
+    out, lse = _flash_fwd_call(q, k, v, causal, sc, block_q, block_k)
+    return out, _lse_slab_to_2d(lse, q.shape[0], q.shape[1], block_q)
+
+
+def _flash_lse_vjp_fwd(q, k, v, causal, sc, block_q, block_k):
+    out, lse = _flash_fwd_call(q, k, v, causal, sc, block_q, block_k)
+    out2 = _lse_slab_to_2d(lse, q.shape[0], q.shape[1], block_q)
+    return (out, out2), (q, k, v, out, lse)
+
+
+def _flash_lse_vjp_bwd(causal, sc, block_q, block_k, res, cts):
+    do, dlse = cts
+    q, k, v, out, lse = res
+    dd = (jnp.sum(do.astype(_F32) * out.astype(_F32), axis=-1)
+          - dlse.astype(_F32))
+    return _bwd_from_dd(q, k, v, do, lse, dd, causal, sc, block_q, block_k)
+
+
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 
 def _flash_fwd_call(q, k, v, causal, sc, block_q, block_k):

@@ -61,6 +61,11 @@ def supported(op: operation, algo: Algorithm) -> bool:
     return algo in _SUPPORTED.get(op, {Algorithm.XLA})
 
 
+#: (algorithm, op) pairs already warned about — the global-preference
+#: fallback is observable exactly once per pair (ADVICE r2 #5)
+_warned_global_fallback: set = set()
+
+
 def select(
     op: operation,
     nbytes: int,
@@ -79,7 +84,15 @@ def select(
         if requested is not None:
             raise ValueError(f"{algo} not supported for {op.name}")
         # a global cfg.algorithm preference that this op cannot honor falls
-        # through to AUTO resolution rather than poisoning unrelated ops
+        # through to AUTO resolution rather than poisoning unrelated ops —
+        # observable via a one-time warning so a misconfigured session-wide
+        # preference is not silently masked
+        if (algo, op) not in _warned_global_fallback:
+            _warned_global_fallback.add((algo, op))
+            from ..utils.logging import get_logger
+            get_logger("algorithms").warning(
+                "session algorithm %s unsupported for %s; using AUTO",
+                algo.name, op.name)
     world = comm.world_size
     if world == 1:
         return Algorithm.XLA
@@ -89,13 +102,30 @@ def select(
         # soon as the payload justifies it (cfg.dcn_hier_threshold — set
         # by autotune when measured on the live DCN mesh); log-depth trees
         # for rooted rendezvous ops (a flat star would cross the DCN
-        # world-1 times)
+        # world-1 times). The early engage needs a HOST-aligned 2-D shape:
+        # with one device per host the factor2d fallback would put the
+        # bandwidth-heavy "intra-host" phase on DCN links — a perf trap,
+        # so fall through to the ICI thresholds instead (ADVICE r2 #4)
         if op == operation.allreduce and nbytes >= cfg.dcn_hier_threshold \
-                and _hier_shape(comm) is not None:
+                and comm.hosts_shape() is not None:
             return Algorithm.HIERARCHICAL
         if op in (operation.bcast, operation.reduce) \
                 and nbytes > cfg.max_eager_size:
             return Algorithm.TREE
+    if cfg.transport == TransportBackend.ICI:
+        # the RDMA-over-ICI perf core is the default large-payload path on
+        # real chip-to-chip links (VMEM ring below the staging threshold,
+        # segmented HBM kernels above it — the builders split internally).
+        # Per-op thresholds: each op's nbytes convention differs (count vs
+        # per-block vs total input bytes), so one shared value would mix
+        # units; autotune measures each crossover like the ring pair.
+        pallas_at = {
+            operation.allreduce: cfg.pallas_threshold,
+            operation.allgather: cfg.ag_pallas_threshold,
+            operation.reduce_scatter: cfg.rs_pallas_threshold,
+        }.get(op)
+        if pallas_at is not None and nbytes >= pallas_at:
+            return Algorithm.PALLAS
     if op == operation.allreduce and nbytes >= cfg.hier_threshold \
             and _hier_shape(comm) is not None:
         return Algorithm.HIERARCHICAL
@@ -128,17 +158,6 @@ def select(
 # ---------------------------------------------------------------------------
 # builder dispatch
 # ---------------------------------------------------------------------------
-
-def _reject_pallas_compression(arith: Optional[ArithConfig]) -> None:
-    """The Pallas ring kernels move raw VMEM tiles; wire compression is not
-    plumbed through them yet — refuse loudly rather than silently sending
-    uncompressed (use RING for per-hop ETH_COMPRESSED semantics)."""
-    if arith is not None and arith.is_compressing:
-        raise ACCLError(
-            errorCode.COMPRESSION_NOT_SUPPORTED,
-            "Algorithm.PALLAS does not support wire compression; "
-            "use Algorithm.RING")
-
 
 def build_bcast(comm, root: int, algo: Algorithm,
                 arith: Optional[ArithConfig]) -> Callable:
@@ -191,9 +210,8 @@ def build_allreduce(comm, func: reduceFunction, dt: dataType, algo: Algorithm,
                     segment_bytes: Optional[int] = None,
                     fanin: int = 0) -> Callable:
     if algo == Algorithm.PALLAS:
-        _reject_pallas_compression(arith)
         return pallas_ring.build_pallas_ring_allreduce(
-            comm, func, dt, segment_bytes)
+            comm, func, dt, segment_bytes, arith=arith)
     if algo == Algorithm.FLAT:
         return flat.build_flat_allreduce(comm, func, dt, arith, fanin)
     if algo == Algorithm.RING:
@@ -215,8 +233,8 @@ def build_allgather(comm, algo: Algorithm,
                     dt: dataType,
                     segment_bytes: Optional[int] = None) -> Callable:
     if algo == Algorithm.PALLAS:
-        _reject_pallas_compression(arith)
-        return pallas_ring.build_pallas_ring_allgather(comm, dt, segment_bytes)
+        return pallas_ring.build_pallas_ring_allgather(
+            comm, dt, segment_bytes, arith=arith)
     if algo == Algorithm.RING:
         return ring.build_ring_allgather(comm, arith)
     return primitives.build_allgather(comm, arith)
@@ -227,9 +245,8 @@ def build_reduce_scatter(comm, func: reduceFunction, dt: dataType,
                          arith: Optional[ArithConfig],
                          segment_bytes: Optional[int] = None) -> Callable:
     if algo == Algorithm.PALLAS:
-        _reject_pallas_compression(arith)
         return pallas_ring.build_pallas_ring_reduce_scatter(
-            comm, func, dt, segment_bytes)
+            comm, func, dt, segment_bytes, arith=arith)
     if algo == Algorithm.RING:
         return ring.build_ring_reduce_scatter(comm, func, dt, arith)
     return primitives.build_reduce_scatter(comm, func, dt, arith)

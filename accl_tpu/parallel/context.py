@@ -69,17 +69,94 @@ def _online_block(q, kb, vb, acc, m, l, qpos, kpos, causal: bool,
     return acc_new, m_new, l_new
 
 
+def _merge_partials(o_c, lse_c, o_s, lse_s):
+    """Merge two normalized partial attentions by their log-sum-exps:
+    out = (w_c·o_c + w_s·o_s)/(w_c + w_s), w = exp(lse − max). A fully
+    masked partial carries lse = −1e30, so its weight is an exact zero."""
+    m = jnp.maximum(lse_c, lse_s)
+    wc = jnp.exp(lse_c - m)
+    ws = jnp.exp(lse_s - m)
+    tot = wc + ws
+    safe = jnp.where(tot > 0, tot, 1.0)
+    o = (o_c * wc[:, None] + o_s * ws[:, None]) / safe[:, None]
+    return o, m + jnp.log(safe)
+
+
 def build_ring_attention(comm: Communicator, causal: bool = False,
-                         scale: Optional[float] = None) -> Callable:
+                         scale: Optional[float] = None,
+                         use_flash: bool = False) -> Callable:
     """Ring attention over the communicator's mesh.
 
     Inputs: q, k, v of global shape (world, n, d) — rank r owns sequence
     block [r*n, (r+1)*n). Output: (world, n, d), the exact softmax
     attention of the full (world*n)-long sequence, accumulated online so
     no rank ever materializes more than one remote K/V block.
+
+    ``use_flash`` runs EACH ring step through the fused Pallas flash
+    kernel (:func:`accl_tpu.ops.flash.flash_attention_lse`): the step's
+    (out, lse) pair merges into the running result by log-sum-exp
+    weighting — same math as the online-softmax carry, at kernel speed.
+    Requires the per-rank block n to be a multiple of the 128-wide flash
+    blocks; any head dim (64/96/...) works via the kernel's lane padding.
+    Differentiable end-to-end (the lse cotangent folds into the flash
+    backward).
     """
     world = comm.world_size
     perm = _fwd_perm(world)
+
+    if use_flash:
+        import jax as _jax
+        from ..ops import flash as _flash
+        # lax.cond around interpret-mode pallas is pathologically slow on
+        # the CPU rung; there the fully-masked steps are dropped by exact
+        # lse weighting instead (weight = exp(-1e30 - m) = 0). On real TPU
+        # the cond skips the kernel entirely — the reference's
+        # masked-block skip at zero FLOPs.
+        skip_via_cond = _jax.default_backend() == "tpu"
+
+        def body_flash(q, k, v):
+            q, k, v = q[0], k[0], v[0]                # (n, d) local blocks
+            n, d = q.shape
+            sc = scale if scale is not None else 1.0 / (d ** 0.5)
+            rank = lax.axis_index(AXIS)
+            o_c = jnp.zeros((n, d), _F32)
+            lse_c = jnp.full((n,), -1e30, _F32)
+            kb, vb = k, v
+            for s in range(world):
+                src = jnp.mod(rank - s, world)
+                if s == 0:
+                    # the diagonal block: local causal mask is the global
+                    # one (both sides share the rank*n offset)
+                    o_s, lse_s = _flash.flash_attention_lse(
+                        q, kb, vb, causal=causal, scale=sc)
+                    o_c, lse_c = _merge_partials(
+                        o_c, lse_c, o_s.astype(_F32), lse_s)
+                else:
+                    def attend(carry, kb=kb, vb=vb):
+                        o_s, lse_s = _flash.flash_attention_lse(
+                            q, kb, vb, causal=False, scale=sc)
+                        return _merge_partials(
+                            carry[0], carry[1], o_s.astype(_F32), lse_s)
+
+                    if causal and skip_via_cond:
+                        # future blocks (src > rank) are fully masked: skip
+                        # both matmuls entirely
+                        o_c, lse_c = lax.cond(
+                            src <= rank, attend, lambda c: c, (o_c, lse_c))
+                    elif causal:
+                        o_s, lse_s = _flash.flash_attention_lse(
+                            q, kb, vb, causal=False, scale=sc)
+                        lse_s = jnp.where(src <= rank, lse_s, -1e30)
+                        o_c, lse_c = _merge_partials(
+                            o_c, lse_c, o_s.astype(_F32), lse_s)
+                    else:
+                        o_c, lse_c = attend((o_c, lse_c))
+                if s + 1 < world:
+                    kb = lax.ppermute(kb, AXIS, perm)
+                    vb = lax.ppermute(vb, AXIS, perm)
+            return o_c.astype(q.dtype)[None]
+
+        return _smap(comm, body_flash, 3)
 
     def body(q, k, v):
         q, k, v = q[0], k[0], v[0]                    # (n, d) local blocks

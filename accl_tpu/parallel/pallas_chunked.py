@@ -75,12 +75,21 @@ def _seg_rows(segment_bytes: int, dtype) -> int:
 
 def _chunked_rs_kernel(x_ref, o_ref, acc_buf, recv_buf, local_buf,
                        send_sem, recv_sem, seed_sem, local_sem, store_sem,
-                       cap_sem, *, P: int, C: int, func: reduceFunction):
+                       cap_sem, *rest, P: int, C: int, func: reduceFunction,
+                       wire=None):
     """x_ref: (P, C, Sr, 128) in HBM; o_ref: (C, Sr, 128) in HBM.
 
     Rank ``my`` ends owning folded chunk ``(my+1) % P`` (ring schedule);
     the wrapper rolls it back.  Two channels process segments 2g / 2g+1.
+
+    ``wire=(wire dtype, scale)`` adds a wire staging buffer (``rest[0]``):
+    the remote DMA carries the compressed segment, the fold decompresses
+    it and accumulates at full precision — per-hop ETH_COMPRESSED
+    semantics (hp_compression.cpp:30-144) at HBM scale. acc_buf stays in
+    the compute dtype (seed source + store staging); the rdma source
+    switches to the wire buffer, whose reuse rdma.wait_send() guards.
     """
+    wire_buf = rest[0] if wire is not None else None
     my, left, right = _neighbors(P)
     _ring_barrier(left, right)
     hops = P - 1
@@ -110,7 +119,7 @@ def _chunked_rs_kernel(x_ref, o_ref, acc_buf, recv_buf, local_buf,
             pltpu.semaphore_wait(cap_sem.at[chan], 1)
 
         rdma = pltpu.make_async_remote_copy(
-            src_ref=acc_buf.at[chan],
+            src_ref=(acc_buf if wire is None else wire_buf).at[chan],
             dst_ref=recv_buf.at[chan, slot],
             send_sem=send_sem.at[chan],
             recv_sem=recv_sem.at[chan, slot],
@@ -130,7 +139,10 @@ def _chunked_rs_kernel(x_ref, o_ref, acc_buf, recv_buf, local_buf,
         slot = lax.rem(t, 2)
         rdma.wait_recv()
         local.wait()
-        folded = _combine(recv_buf[chan, slot], local_buf[chan], func)
+        rx = (recv_buf[chan, slot] if wire is None
+              else _pr._from_wire(recv_buf[chan, slot],
+                                  local_buf.dtype, wire))
+        folded = _combine(rx, local_buf[chan], func)
 
         # recv slot consumed -> grant left a credit for its step t+2
         @pl.when(t + 2 <= T[chan] - 1)
@@ -139,8 +151,11 @@ def _chunked_rs_kernel(x_ref, o_ref, acc_buf, recv_buf, local_buf,
                 cap_sem.at[chan], inc=1, device_id=left,
                 device_id_type=pltpu.DeviceIdType.LOGICAL)
 
-        rdma.wait_send()          # acc_buf drained -> safe to overwrite
-        acc_buf[chan] = folded    # next hop's payload (or store staging)
+        rdma.wait_send()          # send staging drained -> safe to overwrite
+        acc_buf[chan] = folded    # store staging (and next hop's payload
+                                  # when uncompressed)
+        if wire is not None:
+            wire_buf[chan] = _pr._to_wire(folded, wire)  # compress lane
 
         @pl.when(s == P - 2)
         def _flush():
@@ -159,6 +174,10 @@ def _chunked_rs_kernel(x_ref, o_ref, acc_buf, recv_buf, local_buf,
                 x_ref.at[my, c], acc_buf.at[chan], seed_sem.at[chan])
             ld.start()
             ld.wait()
+            if wire is not None:
+                # compress the seed for hop 0's remote DMA (the previous
+                # group's last wait_send already drained wire_buf)
+                wire_buf[chan] = _pr._to_wire(acc_buf[chan], wire)
 
         chan1 = 2 * g + 1 < C
         seed(0)
@@ -197,23 +216,28 @@ def _chunked_rs_kernel(x_ref, o_ref, acc_buf, recv_buf, local_buf,
         wait_store(1)
 
 
-def _chunked_rs_call(x, *, P: int, C: int, sr: int, func, dtype):
+def _chunked_rs_call(x, *, P: int, C: int, sr: int, func, dtype, wire=None):
+    scratch = [
+        pltpu.VMEM((2, sr, _LANES), dtype),          # acc_buf
+        pltpu.VMEM((2, 2, sr, _LANES),
+                   wire[0] if wire is not None else dtype),  # recv_buf
+        pltpu.VMEM((2, sr, _LANES), dtype),          # local_buf
+        pltpu.SemaphoreType.DMA((2,)),               # send_sem
+        pltpu.SemaphoreType.DMA((2, 2)),             # recv_sem
+        pltpu.SemaphoreType.DMA((2,)),               # seed_sem
+        pltpu.SemaphoreType.DMA((2,)),               # local_sem
+        pltpu.SemaphoreType.DMA((2,)),               # store_sem
+        pltpu.SemaphoreType.REGULAR((2,)),           # cap_sem (per chan)
+    ]
+    if wire is not None:
+        scratch.append(pltpu.VMEM((2, sr, _LANES), wire[0]))  # wire_buf
     return pl.pallas_call(
-        functools.partial(_chunked_rs_kernel, P=P, C=C, func=func),
+        functools.partial(_chunked_rs_kernel, P=P, C=C, func=func,
+                          wire=wire),
         out_shape=jax.ShapeDtypeStruct((C, sr, _LANES), dtype),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        scratch_shapes=[
-            pltpu.VMEM((2, sr, _LANES), dtype),      # acc_buf
-            pltpu.VMEM((2, 2, sr, _LANES), dtype),   # recv_buf
-            pltpu.VMEM((2, sr, _LANES), dtype),      # local_buf
-            pltpu.SemaphoreType.DMA((2,)),           # send_sem
-            pltpu.SemaphoreType.DMA((2, 2)),         # recv_sem
-            pltpu.SemaphoreType.DMA((2,)),           # seed_sem
-            pltpu.SemaphoreType.DMA((2,)),           # local_sem
-            pltpu.SemaphoreType.DMA((2,)),           # store_sem
-            pltpu.SemaphoreType.REGULAR((2,)),       # cap_sem (per chan)
-        ],
+        scratch_shapes=scratch,
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True, collective_id=2),
         interpret=_interpret_params(),
@@ -373,8 +397,9 @@ def _geometry(chunk_elems: int, dtype, segment_bytes: int):
 
 
 def chunked_rs_body(x, *, P: int, func: reduceFunction, dtype,
-                    segment_bytes: int):
-    """Per-rank shard_map body: (1, world*n) -> (1, n) (HBM-scale)."""
+                    segment_bytes: int, wire=None):
+    """Per-rank shard_map body: (1, world*n) -> (1, n) (HBM-scale).
+    ``wire`` compresses every remote hop (see _chunked_rs_kernel)."""
     total = x.shape[-1]
     n = total // P
     if P == 1:
@@ -386,7 +411,8 @@ def chunked_rs_body(x, *, P: int, func: reduceFunction, dtype,
     padded = lax.dynamic_update_slice(
         padded, x.reshape(P, n).astype(dtype), (0, 0))
     chunks = padded.reshape(P, C, sr, _LANES)
-    out = _chunked_rs_call(chunks, P=P, C=C, sr=sr, func=func, dtype=dtype)
+    out = _chunked_rs_call(chunks, P=P, C=C, sr=sr, func=func, dtype=dtype,
+                           wire=wire)
     mine = out.reshape(-1)[:n]
     shifted = lax.ppermute(
         mine, AXIS, [(i, (i + 1) % P) for i in range(P)])
@@ -408,9 +434,11 @@ def chunked_ag_body(x, *, P: int, dtype, segment_bytes: int):
 
 
 def chunked_ar_body(x, *, P: int, func: reduceFunction, dtype,
-                    segment_bytes: int):
+                    segment_bytes: int, wire=None, ag_wire=None):
     """Per-rank shard_map body: (1, n) -> (1, n); segmented ring RS + ring
-    AG composition (fw ``:1888-2071`` analog)."""
+    AG composition (fw ``:1888-2071`` analog). ``wire`` compresses the RS
+    hops (fold at full precision); ``ag_wire`` the AG hops (pure
+    transport)."""
     n = x.shape[-1]
     if P == 1:
         return x
@@ -426,8 +454,15 @@ def chunked_ar_body(x, *, P: int, func: reduceFunction, dtype,
     chunks = grid.reshape(P, C, sr, _LANES)
 
     partial = _chunked_rs_call(chunks, P=P, C=C, sr=sr, func=func,
-                               dtype=dtype)
-    gathered = _chunked_ag_call(partial, P=P, C=C, sr=sr, dtype=dtype)
+                               dtype=dtype, wire=wire)
+    if ag_wire is not None and ag_wire[0] != dtype:
+        # compress once for the gather ring (no arithmetic remains)
+        gathered = _chunked_ag_call(
+            _pr._to_wire(partial, ag_wire), P=P, C=C, sr=sr,
+            dtype=ag_wire[0])
+        gathered = _pr._from_wire(gathered, dtype, ag_wire)
+    else:
+        gathered = _chunked_ag_call(partial, P=P, C=C, sr=sr, dtype=dtype)
     # slot j holds folded chunk (j+1)%P; roll so slot c holds chunk c
     blocks = gathered.reshape(P, per)[:, :chunk]
     ordered = jnp.roll(blocks, shift=1, axis=0)
@@ -436,30 +471,65 @@ def chunked_ar_body(x, *, P: int, func: reduceFunction, dtype,
 
 def build_chunked_ring_reduce_scatter(comm: Communicator,
                                       func: reduceFunction, dt: dataType,
-                                      segment_bytes: int) -> Callable:
-    """(world, world*n) sharded in -> (world, n) sharded out (HBM-scale)."""
+                                      segment_bytes: int,
+                                      arith=None) -> Callable:
+    """(world, world*n) sharded in -> (world, n) sharded out (HBM-scale).
+    A compressing ``arith`` applies the per-hop wire lanes (see
+    _chunked_rs_kernel)."""
     P = comm.world_size
     dtype = to_jax_dtype(dt)
-    return _smap(comm, functools.partial(
-        chunked_rs_body, P=P, func=func, dtype=dtype,
-        segment_bytes=segment_bytes), 1)
+    kdtype, wire, pre, post = _pr._wire_policy(arith, dtype)
+
+    def body(x):
+        out = chunked_rs_body(pre(x), P=P, func=func, dtype=kdtype,
+                              segment_bytes=segment_bytes, wire=wire)
+        return post(out, x.dtype)
+
+    return _smap(comm, body, 1)
 
 
 def build_chunked_ring_allgather(comm: Communicator, dt: dataType,
-                                 segment_bytes: int) -> Callable:
-    """(world, n) sharded in -> (world, world*n) sharded out (HBM-scale)."""
+                                 segment_bytes: int,
+                                 arith=None) -> Callable:
+    """(world, n) sharded in -> (world, world*n) sharded out (HBM-scale).
+    A compressing ``arith`` runs the whole ring in the wire dtype (pure
+    transport — every hop carries compressed payload)."""
     P = comm.world_size
     dtype = to_jax_dtype(dt)
-    return _smap(comm, functools.partial(
-        chunked_ag_body, P=P, dtype=dtype, segment_bytes=segment_bytes), 1)
+    compressing = arith is not None and arith.is_compressing
+    if compressing:
+        wire = (to_jax_dtype(arith.compressed), arith.quant_scale)
+
+    def body(x):
+        out_dtype = x.dtype
+        if compressing:
+            x = _pr._to_wire(x, wire)
+            out = chunked_ag_body(x, P=P, dtype=wire[0],
+                                  segment_bytes=segment_bytes)
+            return _pr._from_wire(out, out_dtype, wire).astype(out_dtype)
+        return chunked_ag_body(x, P=P, dtype=dtype,
+                               segment_bytes=segment_bytes)
+
+    return _smap(comm, body, 1)
 
 
 def build_chunked_ring_allreduce(comm: Communicator, func: reduceFunction,
                                  dt: dataType,
-                                 segment_bytes: int) -> Callable:
-    """Segmented ring RS + ring AG composition (fw ``:1888-2071`` analog)."""
+                                 segment_bytes: int,
+                                 arith=None) -> Callable:
+    """Segmented ring RS + ring AG composition (fw ``:1888-2071`` analog).
+    A compressing ``arith`` compresses every hop of both phases."""
     P = comm.world_size
     dtype = to_jax_dtype(dt)
-    return _smap(comm, functools.partial(
-        chunked_ar_body, P=P, func=func, dtype=dtype,
-        segment_bytes=segment_bytes), 1)
+    kdtype, wire, pre, post = _pr._wire_policy(arith, dtype)
+    compressing = arith is not None and arith.is_compressing
+    ag_wire = ((to_jax_dtype(arith.compressed), arith.quant_scale)
+               if compressing else None)
+
+    def body(x):
+        out = chunked_ar_body(pre(x), P=P, func=func, dtype=kdtype,
+                              segment_bytes=segment_bytes, wire=wire,
+                              ag_wire=ag_wire)
+        return post(out, x.dtype)
+
+    return _smap(comm, body, 1)

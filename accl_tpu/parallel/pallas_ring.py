@@ -62,6 +62,55 @@ def _combine(a, b, func: reduceFunction):
     return a + b if func == reduceFunction.SUM else jnp.maximum(a, b)
 
 
+# --------------------------------------------------------------------------
+# wire compression inside the kernels (hp_compression lane analog)
+# --------------------------------------------------------------------------
+#: kernel-level wire policy: (wire jnp dtype, quant scale or None). The
+#: compress lane runs right before the remote DMA (the send slot is staged
+#: in the wire dtype), the decompress lane right before the fold — per-hop
+#: ETH_COMPRESSED semantics (hp_compression.cpp:30-144 in front of the
+#: packetizer), expressed as elementwise casts XLA/Mosaic fuse into the
+#: kernel body.
+
+def _to_wire(x, wire):
+    wdt, scale = wire
+    if scale is not None:
+        return jnp.clip(jnp.round(x * scale), -127, 127).astype(wdt)
+    return x.astype(wdt)
+
+
+def _from_wire(x, cdt, wire):
+    _, scale = wire
+    if scale is not None:
+        return x.astype(cdt) / scale
+    return x.astype(cdt)
+
+
+def _wire_policy(arith, compute_dtype):
+    """Resolve an ArithConfig into (kernel compute dtype, in-kernel wire
+    policy, entry cast, exit cast).
+
+    * casting/quantized pairs (``decompress_before_arith``): the kernel
+      folds at full precision and stages the send slot in the wire dtype —
+      wire policy is in-kernel;
+    * ``arith_is_compressed`` pairs: the whole kernel runs in the wire
+      dtype (fold in wire precision, reference same-dtype-pair semantics) —
+      entry/exit casts outside the kernel;
+    * no compression: identity.
+    """
+    if arith is None or not arith.is_compressing:
+        return compute_dtype, None, (lambda x: x), (lambda y, od: y.astype(od))
+    from ..constants import to_jax_dtype as _tj
+    wdt = _tj(arith.compressed)
+    scale = arith.quant_scale
+    if arith.arith_is_compressed:
+        return (wdt, None,
+                lambda x: _to_wire(x, (wdt, scale)),
+                lambda y, od: _from_wire(y, od, (wdt, scale)))
+    return (compute_dtype, (wdt, scale),
+            (lambda x: x), (lambda y, od: y.astype(od)))
+
+
 def _neighbors(P: int):
     my = lax.axis_index(AXIS)
     p32 = jnp.int32(P)
@@ -113,17 +162,21 @@ def _ag_kernel(x_ref, o_ref, send_sem, recv_sem, copy_sem, *, P: int):
 
 
 
-def _rs_call(chunks, *, P: int, func: reduceFunction, rows: int, dtype):
+def _rs_call(chunks, *, P: int, func: reduceFunction, rows: int, dtype,
+             wire=None):
     """The reduce-scatter pallas_call (single definition — also used by the
-    allreduce composition)."""
+    allreduce composition). With ``wire`` the send/recv staging buffers are
+    allocated in the wire dtype — the payload crosses the interconnect
+    compressed on every hop."""
+    staged_dt = wire[0] if wire is not None else dtype
     return pl.pallas_call(
-        functools.partial(_rs_kernel, P=P, func=func),
+        functools.partial(_rs_kernel, P=P, func=func, wire=wire),
         out_shape=jax.ShapeDtypeStruct((rows, _LANES), dtype),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((2, rows, _LANES), dtype),
-            pltpu.VMEM((2, rows, _LANES), dtype),
+            pltpu.VMEM((2, rows, _LANES), staged_dt),
+            pltpu.VMEM((2, rows, _LANES), staged_dt),
             pltpu.SemaphoreType.DMA((max(P - 1, 1),)),
             pltpu.SemaphoreType.DMA((max(P - 1, 1),)),
             pltpu.SemaphoreType.DMA(()),
@@ -167,26 +220,46 @@ def _staged_bytes(P: int, block_elems: int, dtype) -> int:
 
 
 def build_pallas_ring_allgather(comm: Communicator, dt: dataType,
-                                segment_bytes: Optional[int] = None) -> Callable:
+                                segment_bytes: Optional[int] = None,
+                                arith=None) -> Callable:
     """(world, n) sharded in -> (world, world*n) sharded out.
 
     Payloads whose staged footprint exceeds ``VMEM_PAYLOAD_THRESHOLD``
-    route to the segmented HBM kernel (``segment_bytes`` chunks)."""
+    route to the segmented HBM kernel (``segment_bytes`` chunks).
+
+    With a compressing ``arith`` the whole ring runs in the wire dtype —
+    every hop carries compressed payload (there is no arithmetic to
+    protect, so wire-as-compute IS per-hop ETH_COMPRESSED semantics)."""
     P = comm.world_size
     dtype = to_jax_dtype(dt)
     seg = segment_bytes or constants.DEFAULT_SEGMENT_SIZE
+    compressing = arith is not None and arith.is_compressing
+    if compressing:
+        wdt = to_jax_dtype(arith.compressed)
+        wire = (wdt, arith.quant_scale)
+        kdtype = wdt
+    else:
+        kdtype = dtype
 
     def body(x):
         n = x.shape[-1]
-        if _staged_bytes(P, n, dtype) > VMEM_PAYLOAD_THRESHOLD:
+        out_dtype = x.dtype
+        if compressing:
+            x = _to_wire(x, wire)
+        if _staged_bytes(P, n, kdtype) > VMEM_PAYLOAD_THRESHOLD:
             from . import pallas_chunked
-            return pallas_chunked.chunked_ag_body(
-                x, P=P, dtype=dtype, segment_bytes=seg)
-        rows = _pad_rows(n, dtype)
-        xt = jnp.zeros((rows, _LANES), dtype).reshape(-1)
-        xt = lax.dynamic_update_slice(xt, x[0], (0,)).reshape(rows, _LANES)
-        out = _ag_call(xt, P=P, rows=rows, dtype=dtype)
-        return out.reshape(P, rows * _LANES)[:, :n].reshape(1, P * n)
+            out = pallas_chunked.chunked_ag_body(
+                x, P=P, dtype=kdtype, segment_bytes=seg)
+        else:
+            rows = _pad_rows(n, kdtype)
+            xt = jnp.zeros((rows, _LANES), kdtype).reshape(-1)
+            xt = lax.dynamic_update_slice(
+                xt, x[0], (0,)).reshape(rows, _LANES)
+            out = _ag_call(xt, P=P, rows=rows, dtype=kdtype)
+            out = out.reshape(P, rows * _LANES)[:, :n].reshape(1, P * n)
+        if compressing:
+            out = _from_wire(out, out_dtype, wire)
+        return out.astype(out_dtype)
 
     return _smap(comm, body, 1)
 
@@ -196,13 +269,20 @@ def build_pallas_ring_allgather(comm: Communicator, dt: dataType,
 # ---------------------------------------------------------------------------
 
 def _rs_kernel(x_ref, o_ref, send_buf, recv_buf, send_sem, recv_sem,
-               copy_sem, cap_sem, *, P: int, func: reduceFunction):
+               copy_sem, cap_sem, *, P: int, func: reduceFunction,
+               wire=None):
+    """``wire=(wire dtype, scale)`` stages the send slot compressed and
+    decompresses right before the fold — per-hop ETH_COMPRESSED semantics
+    with full-precision accumulation (decompress_before_arith)."""
     my, left, right = _neighbors(P)
     _ring_barrier(left, right)
     # seed the pipeline: my own chunk `my` is the first partial to forward
-    seed = pltpu.make_async_copy(x_ref.at[my], send_buf.at[0], copy_sem)
-    seed.start()
-    seed.wait()
+    if wire is None:
+        seed = pltpu.make_async_copy(x_ref.at[my], send_buf.at[0], copy_sem)
+        seed.start()
+        seed.wait()
+    else:
+        send_buf[0] = _to_wire(x_ref[my], wire)   # compress lane
 
     def hop(s, _):
         slot = lax.rem(s, 2)
@@ -229,7 +309,9 @@ def _rs_kernel(x_ref, o_ref, send_buf, recv_buf, send_sem, recv_sem,
         # fold the received partial with the local contribution for that
         # chunk (fused_recv_reduce, fw :718-751) and stage for the next hop
         idx = lax.rem(my - s - jnp.int32(1) + jnp.int32(P), jnp.int32(P))
-        folded = _combine(recv_buf[slot], x_ref[idx], func)
+        rx = (recv_buf[slot] if wire is None
+              else _from_wire(recv_buf[slot], x_ref.dtype, wire))
+        folded = _combine(rx, x_ref[idx], func)
 
         # recv_buf[slot] is consumed: grant the left neighbor a credit for
         # its hop s+2 (only if that hop exists)
@@ -241,7 +323,8 @@ def _rs_kernel(x_ref, o_ref, send_buf, recv_buf, send_sem, recv_sem,
 
         @pl.when(s < P - 2)
         def _stage():
-            send_buf[nxt] = folded
+            send_buf[nxt] = (folded if wire is None
+                             else _to_wire(folded, wire))
 
         @pl.when(s == P - 2)
         def _finish():
@@ -258,34 +341,46 @@ def _rs_kernel(x_ref, o_ref, send_buf, recv_buf, send_sem, recv_sem,
 
 def build_pallas_ring_reduce_scatter(comm: Communicator,
                                      func: reduceFunction, dt: dataType,
-                                     segment_bytes: Optional[int] = None) -> Callable:
+                                     segment_bytes: Optional[int] = None,
+                                     arith=None) -> Callable:
     """(world, world*n) sharded in -> (world, n) sharded out; rank r ends
     owning chunk (r+1) mod P (ring schedule); the wrapper rolls chunks so
     rank r returns chunk r, matching the host-level API contract.
 
-    HBM-scale payloads route to the segmented kernel (see allgather)."""
+    HBM-scale payloads route to the segmented kernel (see allgather).
+    Compressing ``arith``: casting/quantized pairs stage the send slot in
+    the wire dtype and fold at full precision (in-kernel compress/
+    decompress lanes); wire-arith pairs run the whole kernel in the wire
+    dtype."""
     P = comm.world_size
     dtype = to_jax_dtype(dt)
     seg = segment_bytes or constants.DEFAULT_SEGMENT_SIZE
+    kdtype, wire, pre, post = _wire_policy(arith, dtype)
 
     def body(x):
         total = x.shape[-1]
         n = total // P
-        if _staged_bytes(P, n, dtype) > VMEM_PAYLOAD_THRESHOLD:
+        out_dtype = x.dtype
+        x = pre(x)
+        if _staged_bytes(P, n, kdtype) > VMEM_PAYLOAD_THRESHOLD:
             from . import pallas_chunked
-            return pallas_chunked.chunked_rs_body(
-                x, P=P, func=func, dtype=dtype, segment_bytes=seg)
-        rows = _pad_rows(n, dtype)
-        chunks = jnp.zeros((P, rows * _LANES), dtype)
-        chunks = lax.dynamic_update_slice(
-            chunks, x.reshape(P, n).astype(dtype), (0, 0))
-        chunks = chunks.reshape(P, rows, _LANES)
-        out = _rs_call(chunks, P=P, func=func, rows=rows, dtype=dtype)
-        mine = out.reshape(-1)[:n]
-        # kernel leaves chunk (my+1)%P here; shift it back to chunk my
-        shifted = lax.ppermute(
-            mine, AXIS, [(i, (i + 1) % P) for i in range(P)])
-        return shifted.reshape(1, n)
+            out = pallas_chunked.chunked_rs_body(
+                x, P=P, func=func, dtype=kdtype, segment_bytes=seg,
+                wire=wire)
+        else:
+            rows = _pad_rows(n, kdtype)
+            chunks = jnp.zeros((P, rows * _LANES), kdtype)
+            chunks = lax.dynamic_update_slice(
+                chunks, x.reshape(P, n).astype(kdtype), (0, 0))
+            chunks = chunks.reshape(P, rows, _LANES)
+            out = _rs_call(chunks, P=P, func=func, rows=rows, dtype=kdtype,
+                           wire=wire)
+            mine = out.reshape(-1)[:n]
+            # kernel leaves chunk (my+1)%P here; shift it back to chunk my
+            out = lax.ppermute(
+                mine, AXIS, [(i, (i + 1) % P) for i in range(P)]
+            ).reshape(1, n)
+        return post(out, out_dtype)
 
     return _smap(comm, body, 1)
 
@@ -296,33 +391,54 @@ def build_pallas_ring_reduce_scatter(comm: Communicator,
 
 def build_pallas_ring_allreduce(comm: Communicator, func: reduceFunction,
                                 dt: dataType,
-                                segment_bytes: Optional[int] = None) -> Callable:
+                                segment_bytes: Optional[int] = None,
+                                arith=None) -> Callable:
+    """RS + AG composition (fw :1888-2071). With a compressing ``arith``
+    every interconnect hop of BOTH phases carries the wire dtype: the RS
+    phase per the ``arith`` fold policy, the AG phase always wire-as-
+    transport (folded values are compressed once for the gather ring and
+    decompressed at the end)."""
     P = comm.world_size
     dtype = to_jax_dtype(dt)
     seg = segment_bytes or constants.DEFAULT_SEGMENT_SIZE
+    kdtype, wire, pre, post = _wire_policy(arith, dtype)
+    compressing = arith is not None and arith.is_compressing
+    wdt = to_jax_dtype(arith.compressed) if compressing else None
+    ag_wire = (wdt, arith.quant_scale) if compressing else None
 
     def body(x):
         n = x.shape[-1]
         chunk = -(-n // P)
-        if _staged_bytes(P, chunk, dtype) > VMEM_PAYLOAD_THRESHOLD:
+        out_dtype = x.dtype
+        if _staged_bytes(P, chunk, kdtype) > VMEM_PAYLOAD_THRESHOLD:
             from . import pallas_chunked
-            return pallas_chunked.chunked_ar_body(
-                x, P=P, func=func, dtype=dtype, segment_bytes=seg)
-        padded = jnp.zeros((P * chunk,), dtype)
+            out = pallas_chunked.chunked_ar_body(
+                pre(x), P=P, func=func, dtype=kdtype, segment_bytes=seg,
+                wire=wire, ag_wire=ag_wire)
+            return post(out, out_dtype)
+        xx = pre(x)
+        padded = jnp.zeros((P * chunk,), kdtype)
         padded = lax.dynamic_update_slice(
-            padded, x[0].astype(dtype), (0,))
-        rows = _pad_rows(chunk, dtype)
-        chunks = jnp.zeros((P, rows * _LANES), dtype)
+            padded, xx[0].astype(kdtype), (0,))
+        rows = _pad_rows(chunk, kdtype)
+        chunks = jnp.zeros((P, rows * _LANES), kdtype)
         chunks = lax.dynamic_update_slice(
             chunks, padded.reshape(P, chunk), (0, 0))
         chunks = chunks.reshape(P, rows, _LANES)
 
-        partial = _rs_call(chunks, P=P, func=func, rows=rows, dtype=dtype)
-        gathered = _ag_call(partial, P=P, rows=rows, dtype=dtype)
+        partial = _rs_call(chunks, P=P, func=func, rows=rows, dtype=kdtype,
+                           wire=wire)
+        if wire is not None:
+            # gather ring rides the wire dtype too (no arithmetic left)
+            gathered = _ag_call(_to_wire(partial, wire), P=P, rows=rows,
+                                dtype=wire[0])
+            gathered = _from_wire(gathered, kdtype, wire)
+        else:
+            gathered = _ag_call(partial, P=P, rows=rows, dtype=kdtype)
         # slot j holds the partial produced at rank j = full chunk (j+1)%P;
         # roll so slot c holds chunk c, then flatten and trim the padding
         blocks = gathered.reshape(P, rows * _LANES)[:, :chunk]
         ordered = jnp.roll(blocks, shift=1, axis=0)
-        return ordered.reshape(-1)[:n].astype(x.dtype).reshape(1, n)
+        return post(ordered.reshape(-1)[:n].reshape(1, n), out_dtype)
 
     return _smap(comm, body, 1)

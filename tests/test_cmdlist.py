@@ -86,14 +86,132 @@ def test_cmdlist_async_execute(accl, rng):
                                rtol=1e-5, atol=1e-5)
 
 
-def test_cmdlist_rejects_partial_counts_and_dummies(accl):
+def test_cmdlist_rejects_oversized_counts_and_dummies(accl):
     x = accl.create_buffer(64, dataType.float32)
     cl = accl.command_list()
     with pytest.raises(ACCLError) as ei:
-        cl.bcast(x, 32, 0)
+        cl.bcast(x, 128, 0)
     assert ei.value.code == errorCode.INVALID_BUFFER_SIZE
     with pytest.raises(ACCLError):
         cl.copy(accl.dummy_buffer(), x, 64)
+
+
+def test_cmdlist_partial_counts(accl, rng):
+    """Round-3: partial-count operands (slice plumbing between steps) —
+    an op may use a prefix of its buffer; the tail is preserved
+    (accl_hls.h ACCLCommand count operands)."""
+    x = accl.create_buffer(64, dataType.int32)
+    y = accl.create_buffer(64, dataType.int32)
+    x0, y0 = _ints(rng, (WORLD, 64)), _ints(rng, (WORLD, 64))
+    x.host[:] = x0
+    y.host[:] = y0
+    cl = accl.command_list()
+    cl.allreduce(x, y, 32, reduceFunction.SUM)   # only the first 32
+    cl.bcast(y, 16, root=3)                      # then first 16 from rank 3
+    cl.execute()
+    want = np.tile(x0[:, :32].sum(0), (WORLD, 1))
+    want[:, :16] = want[3, :16]
+    np.testing.assert_array_equal(y.host[:, :32], want)
+    np.testing.assert_array_equal(y.host[:, 32:], y0[:, 32:])  # tail kept
+
+
+def test_cmdlist_full_op_set(accl, rng):
+    """scatter / gather / alltoall in a fused chain (VERDICT r2 #8: the
+    reference ACCLCommand covers the full op set, accl_hls.h:82-496)."""
+    n = 16
+    root = 2
+    s = accl.create_buffer(n * WORLD, dataType.int32)
+    r = accl.create_buffer(n, dataType.int32)
+    g = accl.create_buffer(n * WORLD, dataType.int32)
+    a = accl.create_buffer(n * WORLD, dataType.int32)
+    s0 = _ints(rng, (WORLD, n * WORLD))
+    s.host[:] = s0
+    cl = accl.command_list()
+    cl.scatter(s, r, n, root)
+    cl.gather(r, g, n, root)
+    cl.alltoall(s, a, n)
+    cl.execute()
+    for k in range(WORLD):
+        np.testing.assert_array_equal(
+            r.host[k], s0[root, k * n:(k + 1) * n])
+    np.testing.assert_array_equal(g.host[root], s0[root])
+    for k in range(WORLD):
+        expect = np.concatenate(
+            [s0[src, k * n:(k + 1) * n] for src in range(WORLD)])
+        np.testing.assert_array_equal(a.host[k], expect)
+
+
+def test_cmdlist_send_recv_pair_fuses(accl, rng):
+    """A send/recv pair inside one list executes as one fused move step,
+    chained with collectives in a single launch."""
+    n = 48
+    x = accl.create_buffer(n, dataType.int32)
+    y = accl.create_buffer(n, dataType.int32)
+    x0 = _ints(rng, (WORLD, n))
+    x.host[:] = x0
+    cl = accl.command_list()
+    cl.allreduce(x, x, n, reduceFunction.SUM)
+    cl.send(x, n, src=1, dst=5, tag=9)
+    cl.recv(y, n, src=1, dst=5, tag=9)
+    cl.bcast(y, n, root=5)
+    cl.execute()
+    want = x0.sum(0)
+    np.testing.assert_array_equal(x.host, np.tile(want, (WORLD, 1)))
+    np.testing.assert_array_equal(y.host, np.tile(want, (WORLD, 1)))
+
+
+def test_cmdlist_unpaired_send_recv_rejected(accl):
+    x = accl.create_buffer(8, dataType.float32)
+    cl = accl.command_list()
+    cl.send(x, 8, src=0, dst=1, tag=3)
+    with pytest.raises(ACCLError) as ei:
+        cl.execute()
+    assert ei.value.code == errorCode.CONFIG_ERROR
+    cl2 = accl.command_list()
+    with pytest.raises(ACCLError):
+        cl2.recv(x, 8, src=0, dst=1, tag=3)  # no send recorded
+    cl3 = accl.command_list()
+    cl3.send(x, 8, src=0, dst=1, tag=3)
+    with pytest.raises(ACCLError) as ei3:
+        cl3.recv(x, 4, src=0, dst=1, tag=3)  # count mismatch
+    assert ei3.value.code == errorCode.INVALID_BUFFER_SIZE
+
+
+def test_cmdlist_reselects_after_autotune(accl, monkeypatch):
+    """ADVICE r2 #3: a recorded list re-resolves algorithm selection at
+    execute() time, so autotuned thresholds apply to existing lists."""
+    from accl_tpu.config import Algorithm
+    from accl_tpu.parallel import algorithms as alg
+    n = 64
+    x = accl.create_buffer(n, dataType.int32)
+    y = accl.create_buffer(n, dataType.int32)
+    x.host[:] = 1
+    cl = accl.command_list()
+    cl.allreduce(x, y, n, reduceFunction.SUM)
+    seen = []
+    orig_select = alg.select
+
+    def spy(op, nbytes, comm, cfg, requested=None, count=None):
+        got = orig_select(op, nbytes, comm, cfg, requested, count)
+        seen.append((op, got))
+        return got
+
+    monkeypatch.setattr(alg, "select", spy)
+    cl.execute()
+    first = [g for o, g in seen if o.name == "allreduce"][-1]
+    # shrink the ring threshold below this payload: re-execute must
+    # re-select RING without re-recording
+    orig_cfg = accl.config
+    try:
+        accl.config = accl.config.replace(ring_threshold=1)
+        accl._programs.clear()
+        seen.clear()
+        cl.execute()
+        second = [g for o, g in seen if o.name == "allreduce"][-1]
+        assert first == Algorithm.XLA and second == Algorithm.RING
+        np.testing.assert_array_equal(y.host, np.full((WORLD, n), WORLD))
+    finally:
+        accl.config = orig_cfg
 
 
 def test_cmdlist_empty_execute_is_noop(accl):
