@@ -33,7 +33,7 @@ from .constants import (
 from .request import Request, RequestQueue, requestStatus
 from .utils import Timer
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
 
 __all__ = [
     "ACCL",

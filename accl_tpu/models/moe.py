@@ -66,7 +66,8 @@ def shard_params(params: MoEParams, comm: Communicator) -> MoEParams:
 
 
 def build_moe_forward(comm: Communicator, n_experts: int,
-                      capacity: int, top_k: int = 1) -> callable:
+                      capacity: int, top_k: int = 1,
+                      return_aux: bool = False) -> callable:
     """Compile the expert-parallel MoE forward.
 
     Input x: (world, n, d) token-sharded; output same shape. ``capacity``
@@ -76,6 +77,14 @@ def build_moe_forward(comm: Communicator, n_experts: int,
     gates (GShard-style top-2 is ``top_k=2``); choice priority is strict —
     every token's first choice is slotted before any second choices, so
     capacity pressure drops second choices first.
+
+    ``return_aux`` also returns the Switch auxiliary load-balancing loss
+    computed over the GLOBAL batch (one ``psum`` across ranks):
+    ``aux = E * Σ_e f_e · P_e`` with f_e the fraction of tokens whose
+    top-1 choice is expert e and P_e the mean router probability —
+    differentiable through P_e, minimized at a uniform routing, the
+    standard training-time pressure against expert collapse. Returned as
+    a (world,)-replicated scalar array; add ``λ·aux[0]`` to the loss.
     """
     world = comm.world_size
     e_local = n_experts // world
@@ -133,14 +142,29 @@ def build_moe_forward(comm: Communicator, n_experts: int,
         # token keeps its residual, and surviving choices keep their
         # renormalized weights)
         out = jnp.einsum("nec,ecd->nd", comb, back)
-        return (x + out)[None]
+        result = (x + out)[None]
+        if not return_aux:
+            return result
+        # Switch aux loss over the GLOBAL batch: counts and probability
+        # masses psum across ranks, so every rank sees the same scalar
+        f_local = jax.nn.one_hot(topi[:, 0], n_experts,
+                                 dtype=jnp.float32).sum(0)      # (E,)
+        p_local = probs.astype(jnp.float32).sum(0)              # (E,)
+        f = lax.psum(f_local, AXIS)
+        p = lax.psum(p_local, AXIS)
+        n_tot = n * world
+        aux = n_experts * jnp.sum((f / n_tot) * (p / n_tot))
+        return result, aux[None]
 
     from jax.sharding import PartitionSpec as P
     param_specs = MoEParams(router=P(None, None),
                             w_in=P(AXIS, None, None),
                             w_out=P(AXIS, None, None))
+    out_specs = ((P(AXIS, None, None), P(AXIS)) if return_aux
+                 else P(AXIS, None, None))
     return _smap(comm, body, 2,
-                 in_specs=(param_specs, P(AXIS, None, None)))
+                 in_specs=(param_specs, P(AXIS, None, None)),
+                 out_specs=out_specs)
 
 
 def reference_moe(params: MoEParams, x: np.ndarray, n_experts: int,

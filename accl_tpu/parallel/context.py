@@ -36,6 +36,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..communicator import Communicator
@@ -192,6 +193,122 @@ def build_ring_attention(comm: Communicator, causal: bool = False,
                 vb = lax.ppermute(vb, AXIS, perm)
         safe_l = jnp.where(l > 0, l, 1.0)
         return (acc / safe_l[:, None]).astype(q.dtype)[None]
+
+    return _smap(comm, body, 3)
+
+
+def zigzag_layout(x, world: int):
+    """Permute a (S, ...) sequence-major array into the zigzag ring
+    layout: rank r owns half-blocks ``r`` and ``2W-1-r`` of the 2W
+    half-blocks — returns (world, S//world, ...)."""
+    S = x.shape[0]
+    h = S // (2 * world)
+    halves = x.reshape(2 * world, h, *x.shape[1:])
+    idx = np.stack([np.arange(world), 2 * world - 1 - np.arange(world)], 1)
+    return halves[idx.reshape(-1)].reshape(world, 2 * h, *x.shape[1:])
+
+
+def zigzag_unlayout(x, world: int):
+    """Inverse of :func:`zigzag_layout`: (world, n, ...) -> (S, ...)."""
+    n = x.shape[1]
+    h = n // 2
+    halves = x.reshape(2 * world, h, *x.shape[2:])
+    idx = np.stack([np.arange(world), 2 * world - 1 - np.arange(world)], 1)
+    inv = np.argsort(idx.reshape(-1))
+    return halves[inv].reshape(2 * world * h, *x.shape[2:])
+
+
+def build_zigzag_ring_attention(comm: Communicator,
+                                scale: Optional[float] = None) -> Callable:
+    """Load-balanced CAUSAL ring attention (zigzag block order).
+
+    Plain causal ring attention is imbalanced: rank r has r+1 live steps
+    of W, so rank 0 idles ~half the wall-clock while rank W-1 computes
+    every step (~50% utilization at scale). Zigzag assigns each rank two
+    HALF-blocks — indices r and 2W-1-r of the 2W half-blocks (use
+    :func:`zigzag_layout`) — which makes every ring step cost two
+    quarter-block attentions on every rank (step 0 runs a third,
+    half-masked diagonal block — one extra quarter total per rank, the
+    same on every rank):
+
+    * the late half (index 2W-1-r ≥ W) attends EVERY arriving early half
+      in full;
+    * plus exactly one of {early-vs-early (src ≤ r), late-vs-late
+      (src ≥ r)} — the two branches are the same shape, so the ``cond``
+      is load-neutral; positional masking inside the block keeps the
+      diagonal exact.
+
+    Inputs/outputs are (world, n, d) in the zigzag layout; masking uses
+    global positions, so the result equals dense causal attention on the
+    un-permuted sequence (see ``zigzag_unlayout``). K/V rotate one hop a
+    step like the plain ring — the same neighbor-only ICI schedule.
+    """
+    world = comm.world_size
+    perm = _fwd_perm(world)
+
+    def body(q, k, v):
+        q, k, v = q[0], k[0], v[0]                    # (n, d): two halves
+        n, d = q.shape
+        if n % 2:
+            raise ValueError(f"zigzag needs an even per-rank block, got {n}")
+        h = n // 2
+        sc = scale if scale is not None else 1.0 / (d ** 0.5)
+        rank = lax.axis_index(AXIS)
+        iA = rank                                      # early half index
+        iB = 2 * world - 1 - rank                      # late half index
+        posA = iA * h + jnp.arange(h)
+        posB = iB * h + jnp.arange(h)
+        qA, qB = q[:h], q[h:]
+        stA = (jnp.zeros((h, d), _F32), jnp.full((h,), -jnp.inf, _F32),
+               jnp.zeros((h,), _F32))
+        stB = (jnp.zeros((h, d), _F32), jnp.full((h,), -jnp.inf, _F32),
+               jnp.zeros((h,), _F32))
+        kb, vb = k, v
+        for s in range(world):
+            src = jnp.mod(rank - s, world)
+            jA = src                                   # arriving early half
+            jB = 2 * world - 1 - src                   # arriving late half
+            kposA = jA * h + jnp.arange(h)
+            kposB = jB * h + jnp.arange(h)
+            kvA = (kb[:h], vb[:h])
+            kvB = (kb[h:], vb[h:])
+
+            # pair 1: late q-half vs arriving early kv-half — ALWAYS a
+            # full attend (iB >= W > jA), masking is a no-op but kept for
+            # the s=0 case where jA == src == rank < iB still holds
+            stB = _online_block(qB, kvA[0], kvA[1], *stB, posB, kposA,
+                                True, sc)
+
+            # pair 2: equal-shape branches — early-vs-early when the
+            # arriving block is not newer (src <= r), late-vs-late
+            # otherwise; positional masks make the diagonals exact
+            def early(st, kvA=kvA, kposA=kposA):
+                a = _online_block(qA, kvA[0], kvA[1], *st[0], posA,
+                                  kposA, True, sc)
+                return a, st[1]
+
+            def late(st, kvB=kvB, kposB=kposB):
+                b = _online_block(qB, kvB[0], kvB[1], *st[1], posB,
+                                  kposB, True, sc)
+                return st[0], b
+
+            stA, stB = lax.cond(src <= rank, early, late, (stA, stB))
+            if s == 0:
+                # the diagonal late-vs-late block (own kv): src == rank
+                # routed to `early` above, so do B/B here
+                stB = _online_block(qB, kvB[0], kvB[1], *stB, posB, kposB,
+                                    True, sc)
+            if s + 1 < world:
+                kb = lax.ppermute(kb, AXIS, perm)
+                vb = lax.ppermute(vb, AXIS, perm)
+
+        def norm(st):
+            acc, m, l = st
+            safe = jnp.where(l > 0, l, 1.0)
+            return acc / safe[:, None]
+
+        return jnp.concatenate([norm(stA), norm(stB)], 0).astype(
+            q.dtype)[None]
 
     return _smap(comm, body, 3)
 

@@ -476,6 +476,7 @@ def build_chunked_ring_reduce_scatter(comm: Communicator,
     """(world, world*n) sharded in -> (world, n) sharded out (HBM-scale).
     A compressing ``arith`` applies the per-hop wire lanes (see
     _chunked_rs_kernel)."""
+    _pr._check_multiprocess(comm)
     P = comm.world_size
     dtype = to_jax_dtype(dt)
     kdtype, wire, pre, post = _pr._wire_policy(arith, dtype)
@@ -494,6 +495,7 @@ def build_chunked_ring_allgather(comm: Communicator, dt: dataType,
     """(world, n) sharded in -> (world, world*n) sharded out (HBM-scale).
     A compressing ``arith`` runs the whole ring in the wire dtype (pure
     transport — every hop carries compressed payload)."""
+    _pr._check_multiprocess(comm)
     P = comm.world_size
     dtype = to_jax_dtype(dt)
     compressing = arith is not None and arith.is_compressing
@@ -519,6 +521,7 @@ def build_chunked_ring_allreduce(comm: Communicator, func: reduceFunction,
                                  arith=None) -> Callable:
     """Segmented ring RS + ring AG composition (fw ``:1888-2071`` analog).
     A compressing ``arith`` compresses every hop of both phases."""
+    _pr._check_multiprocess(comm)
     P = comm.world_size
     dtype = to_jax_dtype(dt)
     kdtype, wire, pre, post = _pr._wire_policy(arith, dtype)

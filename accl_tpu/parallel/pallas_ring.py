@@ -48,6 +48,23 @@ def _interpret_params():
     return pltpu.InterpretParams()
 
 
+def _check_multiprocess(comm: "Communicator") -> None:
+    """Interpret-mode remote DMAs are PROCESS-LOCAL: each controller runs
+    its own kernel interpreter, and the simulated inter-device semaphores
+    cannot signal across interpreters — a multi-controller Pallas ring on
+    the CPU rung hangs in the neighbor barrier. Refuse loudly. On real
+    multi-host TPU the kernels compile natively and the remote copies ride
+    ICI/DCN; this guard only fires on non-TPU backends."""
+    if jax.default_backend() != "tpu" and comm.is_multiprocess:
+        from ..constants import ACCLError, errorCode
+        raise ACCLError(
+            errorCode.CONFIG_ERROR,
+            "Algorithm.PALLAS on a multi-process CPU (interpret) mesh is "
+            "unsupported: the kernel interpreter's simulated remote DMAs "
+            "are process-local. Use RING/TREE/FLAT/XLA on the emulator "
+            "rung; PALLAS engages on real TPU meshes (AUTO does this)")
+
+
 def _sublane(dtype) -> int:
     return 16 if jnp.dtype(dtype).itemsize == 2 else 8
 
@@ -230,6 +247,7 @@ def build_pallas_ring_allgather(comm: Communicator, dt: dataType,
     With a compressing ``arith`` the whole ring runs in the wire dtype —
     every hop carries compressed payload (there is no arithmetic to
     protect, so wire-as-compute IS per-hop ETH_COMPRESSED semantics)."""
+    _check_multiprocess(comm)
     P = comm.world_size
     dtype = to_jax_dtype(dt)
     seg = segment_bytes or constants.DEFAULT_SEGMENT_SIZE
@@ -352,6 +370,7 @@ def build_pallas_ring_reduce_scatter(comm: Communicator,
     the wire dtype and fold at full precision (in-kernel compress/
     decompress lanes); wire-arith pairs run the whole kernel in the wire
     dtype."""
+    _check_multiprocess(comm)
     P = comm.world_size
     dtype = to_jax_dtype(dt)
     seg = segment_bytes or constants.DEFAULT_SEGMENT_SIZE
@@ -398,6 +417,7 @@ def build_pallas_ring_allreduce(comm: Communicator, func: reduceFunction,
     phase per the ``arith`` fold policy, the AG phase always wire-as-
     transport (folded values are compressed once for the gather ring and
     decompressed at the end)."""
+    _check_multiprocess(comm)
     P = comm.world_size
     dtype = to_jax_dtype(dt)
     seg = segment_bytes or constants.DEFAULT_SEGMENT_SIZE

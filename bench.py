@@ -11,14 +11,17 @@ the reference's 512-bit @ 250 MHz CCLO datapath envelope bounds at
 measured stream rate against that envelope (multi-chip: against the
 100 Gbps = 12.5 GB/s line rate, `README.md:5`).
 
-Measurement is `accl_tpu.bench.harness` under two accountings on TPU, and
-the better per size is reported: `fused` (the op chained inside ONE
-launched program via lax.fori_loop — immune to tunnel RTT, the PERFCNT
-device-cycle analog and the CommandList fusion path) and `chain`
-(per-launch dependent chains with forced readback — includes async
-dispatch cost). Both force execution through readbacks, so lazy dispatch
-through tunneled TPU backends cannot fake the numbers; the reported
-small-op latency is always the fused accounting.
+Measurement is `accl_tpu.bench.harness` under two accountings on TPU,
+emitted as SEPARATE series (never mixed per size): `fused` (the op
+chained inside ONE launched program via lax.fori_loop — immune to tunnel
+RTT, the PERFCNT device-cycle analog and the CommandList fusion path)
+and `chain` (per-launch dependent chains with forced readback — includes
+async dispatch cost but no loop-carry copy, so it can be the truer
+throughput at HBM-bound sizes). The scalar headline is the better of the
+two series' PEAKS, labeled by the `accounting` field. Both force
+execution through readbacks, so lazy dispatch through tunneled TPU
+backends cannot fake the numbers; the reported small-op latency is
+always the fused accounting.
 """
 from __future__ import annotations
 
@@ -72,24 +75,40 @@ def main() -> None:
                  "rounds": r.rounds,
                  "GBps": round(r.algbw_GBps, 3)} for r in rows]
 
-    headline_mode = "fused" if on_tpu else "block"
-    sweep = series(headline_mode)
+    sweep = series("fused" if on_tpu else "block")
     sweep_chain = series("chain") if on_tpu else None
 
-    peak = max(r["GBps"] for r in sweep)
+    # headline = the better of the two series' PEAKS, explicitly labeled —
+    # not a per-size max over mixed methodologies. The two accountings
+    # have different systematic errors: fused excludes dispatch but pays a
+    # loop-carry copy at HBM-bound sizes (~2x measured at 64 MiB); chain
+    # has no carry but includes per-launch dispatch, amortized over the
+    # chain. Each series is internally consistent; the scalar headline
+    # takes whichever methodology peaks higher and says which it was.
+    peak_fused = max(r["GBps"] for r in sweep)
+    peak_chain = (max(r["GBps"] for r in sweep_chain)
+                  if sweep_chain else None)
+    if peak_chain is not None and peak_chain > peak_fused:
+        peak, accounting = peak_chain, "chain"
+    else:
+        peak, accounting = peak_fused, "fused" if on_tpu else "block"
     out = {
         "metric": metric,
         "value": round(peak, 3),
         "unit": "GB/s",
         "vs_baseline": round(peak / baseline, 3),
+        "accounting": accounting,
+        # named by the series' ACTUAL methodology (block on non-TPU rigs)
+        ("value_fused" if on_tpu else "value_block"): round(peak_fused, 3),
         # fused/device-only accounting (dispatch excluded) — see module doc
-        "per_op_small_us_fused": sweep[0]["per_op_us"],
-        "accounting": headline_mode,
+        ("per_op_small_us_fused" if on_tpu
+         else "per_op_small_us_block"): sweep[0]["per_op_us"],
         "backend": jax.default_backend(),
         "world": world,
         "sweep": sweep,
     }
     if sweep_chain is not None:
+        out["value_chain"] = round(peak_chain, 3)
         out["sweep_chain"] = sweep_chain
     print(json.dumps(out))
 

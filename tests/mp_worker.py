@@ -201,6 +201,20 @@ def main() -> int:
             acc.barrier(comm=sub)
             print(f"[p{me}] sub-communicator ok", flush=True)
 
+    # ---- Pallas on the multi-process CPU rung refuses loudly -----------
+    # interpret-mode remote DMAs are process-local; a cross-controller
+    # kernel ring would hang in the neighbor barrier — the builders raise
+    # instead (on real multi-host TPU the kernels compile natively)
+    from accl_tpu import ACCLError, errorCode
+    try:
+        acc.allreduce(s, r, n, reduceFunction.SUM,
+                      algorithm=accl_tpu.Algorithm.PALLAS)
+    except ACCLError as e:
+        assert e.code == errorCode.CONFIG_ERROR, e
+        print(f"[p{me}] pallas-on-mp-cpu guard ok", flush=True)
+    else:
+        raise AssertionError("PALLAS on mp CPU mesh should refuse")
+
     # ---- fused command list: one launch per controller per sequence ----
     cl = acc.command_list()
     cl.allreduce(s, r, n, reduceFunction.SUM)

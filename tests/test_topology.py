@@ -48,16 +48,29 @@ def test_hosts_shape_detection():
     assert _FakeComm([0, 1, 2, 3]).hosts_shape() is None
 
 
-def test_dcn_selection_prefers_hierarchical_and_tree(accl):
+def test_dcn_selection_prefers_hierarchical_and_tree(accl, monkeypatch):
     """On a DCN (multi-host) mesh hierarchical engages at 64 KiB instead of
-    64 MiB, and rooted rendezvous ops go log-depth instead of flat star."""
+    64 MiB, and rooted rendezvous ops go log-depth instead of flat star.
+
+    Round 3 (ADVICE r2 #4): the early engage requires a HOST-ALIGNED 2-D
+    shape — on this single-process mesh ``hosts_shape()`` is None, so the
+    positive branch is exercised by faking a 2x4 host layout; the real
+    single-process shape must fall through instead of using the factor2d
+    trap (whose "intra-host" heavy phase would cross DCN links)."""
     comm = accl.global_comm()
     dcn = accl.config.replace(transport=TransportBackend.DCN)
     ici = accl.config.replace(transport=TransportBackend.ICI)
     mid = 256 * 1024  # between DCN_HIER_THRESHOLD and RING_THRESHOLD
 
+    # genuine single-process mesh: no host shape -> NO early hierarchical
+    assert comm.hosts_shape() is None
+    assert algorithms.select(operation.allreduce, mid, comm, dcn) \
+        == Algorithm.XLA
+    # host-major 2x4 layout -> the early engage fires
+    monkeypatch.setattr(type(comm), "hosts_shape", lambda self: (2, 4))
     assert algorithms.select(operation.allreduce, mid, comm, dcn) \
         == Algorithm.HIERARCHICAL
+    monkeypatch.undo()
     assert algorithms.select(operation.allreduce, mid, comm, ici) \
         == Algorithm.XLA
 
