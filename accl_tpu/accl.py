@@ -761,7 +761,7 @@ class ACCL:
         if not run_async:
             try:
                 self._drive_until(
-                    lambda: fab.eager_credit_free(sdev, ddev, nseg),
+                    lambda: fab.eager_can_announce(sdev, ddev, seq, nseg),
                     f"eager window to rank {dst} full for "
                     f"{self.config.timeout}s (no recv consuming segments)")
             except ACCLError:
@@ -785,7 +785,7 @@ class ACCL:
                 fab.announce_cancel(sdev, ddev, seq)
                 return None
             fab.drive()
-            if fab.eager_credit_free(sdev, ddev, nseg):
+            if fab.eager_can_announce(sdev, ddev, seq, nseg):
                 fab.announce(sdev, ddev, tag, payload, "e", nseg, seq=seq)
                 req.fulfill(outputs=payload)
                 return None

@@ -173,6 +173,9 @@ class CrossProcessFabric:
         self._cursor = int(self._try_get(_client(), "accl/sn") or 0) + 1
         # pair-mesh move programs keyed (sdev, ddev, count, wire dtype)
         self._progs: Dict[tuple, tuple] = {}
+        # barrier arrivals that timed out before their round completed:
+        # name -> target count still owed (consumed by the next call)
+        self._barrier_pending: Dict[str, int] = {}
         #: control bytes written to the KV store (keys + values) — the
         #: accounting that proves payload rides the device path
         self.kv_bytes = 0
@@ -230,6 +233,23 @@ class CrossProcessFabric:
         raises the same way only when no recv could ever drain it)."""
         used = self._staged_segs.get((sdev, ddev), 0)
         return used == 0 or used + nseg <= self.eager_window
+
+    def eager_can_announce(self, sdev: int, ddev: int, seq: int,
+                           nseg: int) -> bool:
+        """Whether the eager send holding reserved ``seq`` may announce now.
+
+        FIFO per pair on top of the credit window: while an EARLIER seq on
+        the pair is still reserved-but-unannounced, later sends must queue
+        behind it. Without this, a later send could take window credits
+        and announce past the hole — the receiver's fetch cursor stalls at
+        the unannounced seq, so those credits could never be freed by a
+        move and the earlier (e.g. oversized, used==0-gated) send would
+        starve forever: a send-order deadlock no recv posting can break.
+        """
+        for (s, d, q) in self._reserved:
+            if s == sdev and d == ddev and q < seq:
+                return False
+        return self.eager_credit_free(sdev, ddev, nseg)
 
     def announce(self, sdev: int, ddev: int, tag: int, payload,
                  kind: str, nseg: int, seq: Optional[int] = None) -> int:
@@ -443,14 +463,27 @@ class CrossProcessFabric:
         round's full multiple of n. The counter persists in the
         coordinator, so a fabric created after an earlier session's
         teardown inherits a consistent state (any completed history is a
-        multiple of n) instead of colliding with stale per-epoch keys."""
+        multiple of n) instead of colliding with stale per-epoch keys.
+
+        A TIMED-OUT arrival stays pending rather than being abandoned
+        mid-round: the next barrier call on the same name re-waits on the
+        recorded target instead of incrementing again. Otherwise the
+        retry's own arrival would complete the broken round by itself and
+        pass instantly with no peer present — a barrier that silently
+        stops synchronizing. With the pending arrival consumed on retry,
+        a timeout keeps fail-stop semantics: the retry blocks until the
+        laggard actually arrives (like the per-epoch scheme it replaced),
+        and per-process call counts stay matched 1:1 with arrivals."""
         import jax
 
         client = _client()
         n = len(process_ids) if process_ids is not None else jax.process_count()
         key = f"accl/b/{name}"
-        arrive = self._kincr(client, key)
-        target = ((arrive - 1) // n + 1) * n
+        target = self._barrier_pending.get(key)
+        if target is None:
+            arrive = self._kincr(client, key)
+            target = ((arrive - 1) // n + 1) * n
+            self._barrier_pending[key] = target
         deadline = time.monotonic() + self.timeout
         progress = pump or self.drive
         while int(self._try_get(client, key) or 0) < target:
@@ -460,3 +493,4 @@ class CrossProcessFabric:
                 raise ACCLTimeoutError(
                     f"barrier {name!r}: {self._try_get(client, key)}/"
                     f"{target} arrivals within {self.timeout}s")
+        del self._barrier_pending[key]

@@ -36,11 +36,20 @@ HIER_THRESHOLD = 64 * 1024 * 1024
 DCN_HIER_THRESHOLD = 64 * 1024
 
 
-def _hier_shape(comm: Communicator):
+def _hier_shape(comm: Communicator, on_dcn: bool = False):
     """2-D factorization for hierarchical collectives: host-aligned when
     the mesh spans hosts (rows = hosts, so DCN traffic is the small
-    phase), most-square otherwise."""
-    return comm.hosts_shape() or hierarchical.factor2d(comm.world_size)
+    phase), most-square otherwise. On a DCN transport WITHOUT a
+    host-aligned shape there is no valid auto split at all — the factor2d
+    fallback would put the bandwidth-heavy "intra-host" phase on DCN
+    links (the ADVICE r2 #4 trap, which applies to every AUTO engage
+    point, not just the early dcn_hier_threshold branch)."""
+    hs = comm.hosts_shape()
+    if hs is not None:
+        return hs
+    if on_dcn:
+        return None
+    return hierarchical.factor2d(comm.world_size)
 
 _SUPPORTED = {
     operation.bcast: {Algorithm.XLA, Algorithm.FLAT, Algorithm.TREE, Algorithm.RING},
@@ -127,7 +136,7 @@ def select(
         if pallas_at is not None and nbytes >= pallas_at:
             return Algorithm.PALLAS
     if op == operation.allreduce and nbytes >= cfg.hier_threshold \
-            and _hier_shape(comm) is not None:
+            and _hier_shape(comm, on_dcn) is not None:
         return Algorithm.HIERARCHICAL
     if op == operation.allreduce and nbytes >= cfg.ring_threshold:
         return Algorithm.RING

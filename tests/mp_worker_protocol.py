@@ -171,6 +171,47 @@ def main() -> int:
         assert np.allclose(rb5.host[dst], 3.0)
     acc.barrier()
 
+    # ---- 6c. later sends must not overtake a credit-starved send -------
+    # m1 fills half the window; m2 (async, oversized: admitted only with
+    # the window exclusively) parks with its seq reserved; m3 (small,
+    # would fit the residual window) must QUEUE BEHIND m2. If m3
+    # announced past the hole, the receiver's fetch cursor would stall at
+    # m2's unannounced seq, m3's credits could never be freed by a move,
+    # and m2's used==0 gate would starve forever — a send-order deadlock
+    # no recv posting can break.
+    half = win_bytes // 4          # f32 count; f16 wire = half the window
+    over = win_bytes               # f32 count; f16 wire = 2x the window
+    sb6 = acc.create_buffer(half, dataType.float32)
+    sb7 = acc.create_buffer(over, dataType.float32)
+    sb8 = acc.create_buffer(n, dataType.float32)
+    rb6 = acc.create_buffer(half, dataType.float32)
+    rb7 = acc.create_buffer(over, dataType.float32)
+    rb8 = acc.create_buffer(n, dataType.float32)
+    if i_src:
+        sb6.host[src] = np.full(half, 4.0, np.float32)
+        acc.send(sb6, half, src=src, dst=dst, tag=83,
+                 compress_dtype=dataType.float16)  # window half full
+        sb7.host[src] = np.full(over, 5.0, np.float32)
+        r_over = acc.send(sb7, over, src=src, dst=dst, tag=84,
+                          run_async=True, compress_dtype=dataType.float16)
+        sb8.host[src] = np.full(n, 6.0, np.float32)
+        r_small = acc.send(sb8, n, src=src, dst=dst, tag=85,
+                           run_async=True, compress_dtype=dataType.float16)
+        r_over.wait(timeout=60)
+        r_small.wait(timeout=60)
+        print(f"[p{me}] no send-order deadlock ok", flush=True)
+    if i_dst:
+        acc.recv(rb6, half, src=src, dst=dst, tag=83,
+                 compress_dtype=dataType.float16)
+        assert np.allclose(rb6.host[dst], 4.0)
+        acc.recv(rb7, over, src=src, dst=dst, tag=84,
+                 compress_dtype=dataType.float16)
+        assert np.allclose(rb7.host[dst], 5.0)
+        acc.recv(rb8, n, src=src, dst=dst, tag=85,
+                 compress_dtype=dataType.float16)
+        assert np.allclose(rb8.host[dst], 6.0)
+    acc.barrier()
+
     # ---- 7. count mismatch surfaces at the receiver --------------------
     if i_src:
         sb.host[src] = A
@@ -188,6 +229,36 @@ def main() -> int:
         assert np.allclose(rb.host[dst], A)
         print(f"[p{me}] corrected recv after mismatch ok", flush=True)
     acc.barrier()
+
+    # ---- 8. barrier timeout keeps fail-stop semantics ------------------
+    # p0 times out waiting alone; its RETRY must block until p1 actually
+    # arrives. The timed-out arrival is consumed by the retry, not
+    # abandoned mid-round — otherwise the retry's own arrival would
+    # complete the broken round by itself and the barrier would pass
+    # instantly with no peer present (silently desynchronized forever).
+    from accl_tpu import multiproc as _mp
+    from accl_tpu.constants import ACCLTimeoutError
+    client = _mp._client()
+    fab = acc._fabric
+    flag = "accl/test/p1-at-t8"
+    if me == 0:
+        acc.set_timeout(1.5)
+        try:
+            fab.barrier(name="t8")
+        except ACCLTimeoutError:
+            pass
+        else:
+            raise AssertionError("lone barrier arrival did not time out")
+        acc.set_timeout(60.0)
+        fab.barrier(name="t8")  # retry: must wait for p1's REAL arrival
+        assert _mp.CrossProcessFabric._try_get(client, flag) is not None, \
+            "barrier retry passed without the peer arriving"
+        print(f"[p{me}] barrier timeout fail-stop ok", flush=True)
+    elif me == 1:
+        time.sleep(4.0)  # past p0's 1.5 s timeout
+        client.key_value_set(flag, "1")
+        fab.barrier(name="t8")
+    acc.barrier()  # the next round still synchronizes
 
     print(f"[p{me}] MP-PROTOCOL-OK", flush=True)
     return 0

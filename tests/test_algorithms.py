@@ -210,6 +210,26 @@ def test_auto_selects_pallas_on_ici(accl):
     assert got != Algorithm.PALLAS
 
 
+def test_dcn_hier_generic_branch_needs_host_shape(accl):
+    """The generic hier_threshold engage point is gated the same way on
+    DCN as the early dcn_hier_threshold branch: with no host-aligned
+    shape, the factor2d fallback would put the bandwidth-heavy
+    "intra-host" phase on DCN links, so AUTO must not pick HIERARCHICAL
+    at ANY size. Off DCN the most-square fallback still engages."""
+    from accl_tpu.config import TransportBackend
+    comm = accl.global_comm()
+    assert comm.hosts_shape() is None  # single-process CPU mesh
+    dcn = accl.config.replace(transport=TransportBackend.DCN)
+    got = algorithms.select(
+        operation.allreduce, dcn.hier_threshold, comm, dcn)
+    assert got != Algorithm.HIERARCHICAL
+    # the SIM/ICI-style fallback (factor2d) is intra-host and still fine
+    sim = accl.config
+    got = algorithms.select(
+        operation.allreduce, sim.hier_threshold, comm, sim)
+    assert got == Algorithm.HIERARCHICAL
+
+
 def test_dcn_hier_needs_host_shape(accl):
     """ADVICE r2 #4: on a DCN mesh whose ranks are NOT host-major (no
     hosts_shape), the hierarchical early-engage must NOT fire — its
