@@ -17,9 +17,15 @@ schedule, but the *shape* of the tree does — every transfer is a direct
 XLA is free to overlap them, while ``lax.optimization_barrier`` over BOTH
 the accumulator and the send operand between rounds enforces the
 reference's bounded fan-in (``GATHER_FLAT_TREE_MAX_FANIN``): at most
-``fanin`` transfers are schedulable concurrently at the root. Bcast and
-scatter are unthrottled single-round stars, matching the firmware's
-out-of-order root fanout (no fanout register exists in the reference).
+``fanin`` transfers are schedulable concurrently at the root. The barrier
+constrains XLA's latency-hiding scheduler and is then dropped from the
+final module, so the bound lives in the SCHEDULE, not the op list —
+``tests/test_flat_schedule.py`` measures it on an AOT v5e compile: the
+peak number of open ``collective-permute-start``/``-done`` pairs in the
+scheduled TPU executable equals ``fanin`` exactly (and exceeds it when
+unthrottled). Bcast and scatter are unthrottled single-round stars,
+matching the firmware's out-of-order root fanout (no fanout register
+exists in the reference).
 
 Distinct from both the XLA one-shot (single fused collective) and the
 binary tree (log-depth relays) — selectable via ``Algorithm.FLAT`` and

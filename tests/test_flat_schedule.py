@@ -1,0 +1,104 @@
+"""Fan-in throttle as a MEASURED bound on the real TPU executable.
+
+The flat gather/reduce throttle (``GATHER_FLAT_TREE_MAX_FANIN``,
+``ccl_offload_control.c:1144-1206``) is expressed with
+``lax.optimization_barrier`` between rounds. The barrier constrains XLA's
+latency-hiding scheduler and is then dropped from the final module — so
+correctness-only tests (or grepping the executable for barriers) cannot
+show the bound holds. These tests verify it where it actually lives: the
+POST-SCHEDULING instruction sequence of an ahead-of-time compile for a
+real v5e 2x4 TPU topology. In a scheduled TPU HLO module, text order is
+execution order per core, and an async transfer is in flight between its
+``collective-permute-start`` and ``collective-permute-done``; the peak
+number of simultaneously-open start/done pairs IS the root's concurrent
+transfer count. Asserting peak == fanin proves the throttle survives
+compilation to TPU hardware code (round-2 Weak #4).
+"""
+import re
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from accl_tpu.communicator import Communicator
+from accl_tpu.constants import dataType, reduceFunction
+from accl_tpu.parallel import flat
+
+WORLD = 8
+
+
+@pytest.fixture(scope="module")
+def tpu_comm():
+    """Communicator over an AOT v5e 2x4 topology (compile-only: no chips
+    needed — skip where libtpu cannot provide topology descriptions)."""
+    try:
+        from jax.experimental import topologies
+        topo = topologies.get_topology_desc(
+            platform="tpu", topology_name="v5e:2x4")
+        devices = list(topo.devices)
+    except Exception as e:  # pragma: no cover - environment-dependent
+        pytest.skip(f"TPU AOT topology unavailable: {e}")
+    assert len(devices) == WORLD
+    return Communicator(devices)
+
+
+_START = re.compile(r"%?\S+ = .*collective-permute-start\(")
+_DONE = re.compile(r"%?\S+ = .*collective-permute-done\(")
+
+
+def _schedule_stats(compiled_text: str):
+    """(total starts, peak simultaneously-in-flight) over the scheduled
+    module. Defs precede uses in HLO text and a scheduled TPU module lists
+    instructions in execution order, so a linear walk reproduces the
+    per-core schedule."""
+    inflight = peak = starts = 0
+    for line in compiled_text.splitlines():
+        s = line.strip()
+        if _START.match(s):
+            inflight += 1
+            starts += 1
+            peak = max(peak, inflight)
+        elif _DONE.match(s):
+            inflight -= 1
+    return starts, peak
+
+
+def _compile_text(fn, comm, *shapes):
+    sh = comm.sharding()
+    args = [jax.ShapeDtypeStruct(s, jnp.float32, sharding=sh) for s in shapes]
+    return fn.lower(*args).compile().as_text()
+
+
+@pytest.mark.parametrize("fanin", [1, 2, 3])
+def test_gather_schedule_bounds_inflight(tpu_comm, fanin):
+    fn = flat.build_flat_gather(tpu_comm, root=0, arith=None, fanin=fanin)
+    txt = _compile_text(fn, tpu_comm, (WORLD, 2048), (WORLD, WORLD * 2048))
+    starts, peak = _schedule_stats(txt)
+    assert starts == WORLD - 1  # every peer is a direct root edge
+    assert peak <= fanin, f"throttle violated: {peak} > fanin={fanin}"
+    # the throttle bounds but does not serialize: full rounds do overlap
+    if fanin > 1:
+        assert peak == fanin
+
+
+def test_reduce_schedule_bounds_inflight(tpu_comm):
+    fanin = 2
+    fn = flat.build_flat_reduce(
+        tpu_comm, root=0, func=reduceFunction.SUM, dt=dataType.float32,
+        arith=None, fanin=fanin)
+    txt = _compile_text(fn, tpu_comm, (WORLD, 2048), (WORLD, 2048))
+    starts, peak = _schedule_stats(txt)
+    assert starts == WORLD - 1
+    assert peak <= fanin
+
+
+def test_unthrottled_gather_exceeds_bound(tpu_comm):
+    """Control: WITHOUT the throttle the scheduler opens more transfers at
+    once (XLA's own in-flight cap, >3 on v5e) — proving the measured bound
+    above comes from the barrier structure, not from the scheduler being
+    conservative anyway."""
+    fn = flat.build_flat_gather(tpu_comm, root=0, arith=None, fanin=0)
+    txt = _compile_text(fn, tpu_comm, (WORLD, 2048), (WORLD, WORLD * 2048))
+    starts, peak = _schedule_stats(txt)
+    assert starts == WORLD - 1
+    assert peak > 3
