@@ -455,9 +455,11 @@ class ACCL:
         algo = algorithms.select(
             operation.bcast, count * constants.dtype_size(dtype),
             comm, self.config, algorithm)
+        seg = self.config.segment_size
         return (self._key(comm, operation.bcast, count, dtype, root,
-                          compress_dtype, algo),
-                lambda: algorithms.build_bcast(comm, root, algo, arith))
+                          compress_dtype, algo, seg),
+                lambda: algorithms.build_bcast(comm, root, algo, arith,
+                                               dtype, seg))
 
     def _spec_allgather(self, comm, count: int, dtype: dataType,
                         compress_dtype, algorithm):

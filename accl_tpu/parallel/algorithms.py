@@ -52,7 +52,8 @@ def _hier_shape(comm: Communicator, on_dcn: bool = False):
     return hierarchical.factor2d(comm.world_size)
 
 _SUPPORTED = {
-    operation.bcast: {Algorithm.XLA, Algorithm.FLAT, Algorithm.TREE, Algorithm.RING},
+    operation.bcast: {Algorithm.XLA, Algorithm.FLAT, Algorithm.TREE,
+                      Algorithm.RING, Algorithm.PALLAS},
     operation.reduce: {Algorithm.XLA, Algorithm.FLAT, Algorithm.TREE, Algorithm.RING},
     operation.allreduce: {Algorithm.XLA, Algorithm.FLAT, Algorithm.TREE,
                           Algorithm.RING, Algorithm.HIERARCHICAL,
@@ -132,6 +133,7 @@ def select(
             operation.allreduce: cfg.pallas_threshold,
             operation.allgather: cfg.ag_pallas_threshold,
             operation.reduce_scatter: cfg.rs_pallas_threshold,
+            operation.bcast: cfg.bcast_pallas_threshold,
         }.get(op)
         if pallas_at is not None and nbytes >= pallas_at:
             return Algorithm.PALLAS
@@ -169,7 +171,13 @@ def select(
 # ---------------------------------------------------------------------------
 
 def build_bcast(comm, root: int, algo: Algorithm,
-                arith: Optional[ArithConfig]) -> Callable:
+                arith: Optional[ArithConfig],
+                dt: Optional[dataType] = None,
+                segment_bytes: Optional[int] = None) -> Callable:
+    if algo == Algorithm.PALLAS:
+        from . import pallas_chunked
+        return pallas_chunked.build_chunked_ring_bcast(
+            comm, root, dt, segment_bytes, arith=arith)
     if algo == Algorithm.FLAT:
         return flat.build_flat_bcast(comm, root, arith)
     if algo == Algorithm.TREE:

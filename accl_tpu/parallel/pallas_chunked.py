@@ -385,6 +385,146 @@ def _chunked_ag_call(x, *, P: int, C: int, sr: int, dtype):
 
 
 # ---------------------------------------------------------------------------
+# segmented pipelined ring broadcast
+# ---------------------------------------------------------------------------
+
+def _chunked_bcast_kernel(x_ref, o_ref, buf, send_sem, recv_sem, seed_sem,
+                          store_sem, cap_sem, *, P: int, C: int, root: int):
+    """x_ref: (C, Sr, 128) root's payload in HBM; o_ref: (C, Sr, 128) HBM.
+
+    Pipelined ring broadcast — the HBM-scale analog of the firmware's
+    segmented eager bcast fanout (``ccl_offload_control.c:923-989``), but
+    ring-shaped because that is the TPU-optimal topology: the root streams
+    segments to its right neighbor and every rank forwards segment ``s``
+    while receiving ``s+1``, so total time is ~(C + P - 2) segment times
+    (≈ payload/bw for C >> P) instead of the root serializing (P-1) full
+    copies like a star fanout would.
+
+    Software pipeline over global steps ``t`` with ring position
+    ``pos = (my - root) % P``: at step ``t`` a rank sends segment
+    ``t - pos`` (the one it received at ``t-1``; the root loads it from
+    HBM instead) and receives segment ``t - pos + 1``. The last rank
+    (pos = P-1) only receives. Two VMEM slots alternate on segment
+    parity; a credit semaphore gates slot reuse exactly like the other
+    chunked kernels: the writer to a slot may send only after its owner
+    consumed the slot's previous content (forwarded AND flushed to HBM),
+    so backpressure — not luck — bounds the in-flight segments.
+    """
+    my, left, right = _neighbors(P)
+    _ring_barrier(left, right)
+    pos = lax.rem(my - jnp.int32(root) + jnp.int32(P), jnp.int32(P))
+    is_root = pos == 0
+    is_last = pos == P - 1
+
+    def wait_store(slot):
+        """Consume a store completion (descriptor recreated for its size —
+        the DMA-semaphore wait decrements by the copy's byte count)."""
+        pltpu.make_async_copy(
+            buf.at[slot], o_ref.at[0], store_sem.at[slot]).wait()
+
+    def grant(slot_seg):
+        """Release the slot that held ``slot_seg`` back to the left
+        writer — only when a future segment will actually reuse it
+        (grants == gates, so every semaphore drains to zero)."""
+        @pl.when(slot_seg <= C - 3)
+        def _g():
+            pltpu.semaphore_signal(
+                cap_sem, inc=1, device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    def _rdma(slot):
+        return pltpu.make_async_remote_copy(
+            src_ref=buf.at[slot],
+            dst_ref=buf.at[slot],
+            send_sem=send_sem,
+            recv_sem=recv_sem.at[slot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    def step(t, _):
+        # the loop index arrives as int64 under x64 on the interpret rung
+        s_idx = jnp.int32(t) - pos   # segment this rank sends at step t
+        r_idx = s_idx + jnp.int32(1)  # segment received at step t
+        send_m = jnp.logical_and(jnp.logical_and(s_idx >= 0, s_idx < C),
+                                 jnp.logical_not(is_last))
+        recv_m = jnp.logical_and(jnp.logical_and(r_idx >= 0, r_idx < C),
+                                 jnp.logical_not(is_root))
+
+        @pl.when(send_m)
+        def _send():
+            slot = lax.rem(s_idx, jnp.int32(2))
+
+            @pl.when(is_root)
+            def _load():
+                # our own slot is safe: its previous send (s_idx-2) was
+                # drained by wait_send two steps ago
+                ld = pltpu.make_async_copy(
+                    x_ref.at[s_idx], buf.at[slot], seed_sem)
+                ld.start()
+                ld.wait()
+
+            # credit gate: the right neighbor must have consumed the
+            # slot's previous segment (s_idx - 2) before we overwrite it
+            @pl.when(s_idx >= 2)
+            def _gate():
+                pltpu.semaphore_wait(cap_sem, 1)
+
+            _rdma(slot).start()
+
+        @pl.when(recv_m)
+        def _recv():
+            rslot = lax.rem(r_idx, jnp.int32(2))
+            _rdma(rslot).wait_recv()
+            st = pltpu.make_async_copy(
+                buf.at[rslot], o_ref.at[r_idx], store_sem.at[rslot])
+            st.start()
+
+            # the last rank never forwards: its slot is consumed once the
+            # flush lands, so it grants from the recv side
+            @pl.when(is_last)
+            def _last():
+                wait_store(rslot)
+                grant(r_idx)
+
+        @pl.when(send_m)
+        def _finish():
+            slot = lax.rem(s_idx, jnp.int32(2))
+            _rdma(slot).wait_send()
+
+            # forwarding ranks also flushed this slot's segment last step;
+            # both readers are done now, so the slot goes back to the left
+            @pl.when(jnp.logical_not(is_root))
+            def _drain():
+                wait_store(slot)
+                grant(s_idx)
+
+        return 0
+
+    lax.fori_loop(0, C + P - 2, step, 0)
+
+
+def _chunked_bcast_call(x, *, P: int, C: int, sr: int, dtype, root: int):
+    return pl.pallas_call(
+        functools.partial(_chunked_bcast_kernel, P=P, C=C, root=root),
+        out_shape=jax.ShapeDtypeStruct((C, sr, _LANES), dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((2, sr, _LANES), dtype),      # buf (2 slots)
+            pltpu.SemaphoreType.DMA,                 # send_sem
+            pltpu.SemaphoreType.DMA((2,)),           # recv_sem
+            pltpu.SemaphoreType.DMA,                 # seed_sem
+            pltpu.SemaphoreType.DMA((2,)),           # store_sem
+            pltpu.SemaphoreType.REGULAR,             # cap_sem
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=4),
+        interpret=_interpret_params(),
+    )(x)
+
+
+# ---------------------------------------------------------------------------
 # geometry + builders
 # ---------------------------------------------------------------------------
 
@@ -467,6 +607,50 @@ def chunked_ar_body(x, *, P: int, func: reduceFunction, dtype,
     blocks = gathered.reshape(P, per)[:, :chunk]
     ordered = jnp.roll(blocks, shift=1, axis=0)
     return ordered.reshape(-1)[:n].astype(x.dtype).reshape(1, n)
+
+
+def chunked_bcast_body(x, *, P: int, root: int, dtype, segment_bytes: int,
+                       wire=None):
+    """Per-rank shard_map body: (1, n) -> (1, n) (HBM-scale). ``wire``
+    runs the whole ring in the wire dtype (pure transport — every hop
+    carries compressed payload); the root's own copy stays exact."""
+    n = x.shape[-1]
+    if P == 1:
+        return x
+    kdt = wire[0] if wire is not None else dtype
+    xin = (_pr._to_wire(x[0], wire) if wire is not None
+           else x[0].astype(dtype))
+    C, sr, seg_elems = _geometry(n, kdt, segment_bytes)
+    padded = jnp.zeros((C * seg_elems,), kdt)
+    padded = lax.dynamic_update_slice(padded, xin, (0,))
+    out = _chunked_bcast_call(
+        padded.reshape(C, sr, _LANES), P=P, C=C, sr=sr, dtype=kdt, root=root)
+    flat = out.reshape(-1)[:n]
+    res = (_pr._from_wire(flat, dtype, wire) if wire is not None
+           else flat).astype(x.dtype)
+    # the root's o_ref is never written (it is the source); keep its input
+    res = jnp.where(lax.axis_index(AXIS) == root, x[0], res)
+    return res.reshape(1, n)
+
+
+def build_chunked_ring_bcast(comm: Communicator, root: int, dt: dataType,
+                             segment_bytes: int, arith=None) -> Callable:
+    """(world, n) sharded in -> (world, n) sharded out (HBM-scale):
+    pipelined ring broadcast, the segmented analog of the firmware's
+    eager bcast fanout (``ccl_offload_control.c:923-989``). A compressing
+    ``arith`` compresses every hop (pure transport)."""
+    _pr._check_multiprocess(comm)
+    P = comm.world_size
+    dtype = to_jax_dtype(dt)
+    compressing = arith is not None and arith.is_compressing
+    wire = ((to_jax_dtype(arith.compressed), arith.quant_scale)
+            if compressing else None)
+
+    def body(x):
+        return chunked_bcast_body(x, P=P, root=root, dtype=dtype,
+                                  segment_bytes=segment_bytes, wire=wire)
+
+    return _smap(comm, body, 1)
 
 
 def build_chunked_ring_reduce_scatter(comm: Communicator,

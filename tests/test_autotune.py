@@ -129,3 +129,35 @@ def test_autotune_pallas_crossover_on_ici(accl, monkeypatch):
             operation.allreduce, 2 ** 9 * 4, comm, tuned) == Algorithm.PALLAS
     finally:
         accl.config = orig
+
+
+def test_autotune_bcast_pallas_crossover_on_ici(accl, monkeypatch):
+    """The pipelined-ring Pallas bcast joins the tuned set on ICI: its
+    measured crossover vs the best jnp family lands in
+    bcast_pallas_threshold (and select() then engages it)."""
+    from accl_tpu.config import TransportBackend
+
+    def fake_measure(comm, cs, algos, dt, reps, segment_bytes=None):
+        assert Algorithm.PALLAS in algos and Algorithm.TREE in algos
+        t = {a: [1.0, 1.0] for a in algos}
+        t[Algorithm.TREE] = [0.5, 1.5]      # best-of includes TREE at idx 0
+        t[Algorithm.PALLAS] = [0.75, 0.25]  # wins from index 1 on
+        return t
+
+    monkeypatch.setattr(autotune, "measure_bcast", fake_measure)
+    orig = accl.config
+    try:
+        accl.config = accl.config.replace(transport=TransportBackend.ICI)
+        tuned = autotune.autotune_bcast(accl, accl.config, pows=(6, 9),
+                                        reps=1)
+        assert tuned.bcast_pallas_threshold == 2 ** 9 * 4
+        comm = accl.global_comm()
+        assert algorithms.select(
+            operation.bcast, 2 ** 9 * 4, comm, tuned) == Algorithm.PALLAS
+        # off ICI the knob is untouched
+        accl.config = orig
+        same = autotune.autotune_bcast(accl, accl.config, pows=(6, 9),
+                                       reps=1)
+        assert same.bcast_pallas_threshold == orig.bcast_pallas_threshold
+    finally:
+        accl.config = orig
