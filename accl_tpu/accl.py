@@ -493,10 +493,11 @@ class ACCL:
             comm, self.config, algorithm)
         fanin = (self.config.gather_flat_tree_max_fanin
                  if algo == Algorithm.FLAT else 0)
+        seg = self.config.segment_size
         return (self._key(comm, operation.gather, count, dtype, root,
-                          compress_dtype, algo, fanin),
+                          compress_dtype, algo, fanin, seg),
                 lambda: algorithms.build_gather(comm, root, algo, arith,
-                                                fanin))
+                                                fanin, dtype, seg))
 
     def _spec_alltoall(self, comm, count: int, dtype: dataType,
                        compress_dtype, algorithm):

@@ -239,6 +239,56 @@ def autotune_bcast(acc, cfg: ACCLConfig,
         bcast_pallas_threshold=p_at if p_at is not None else DISABLED)
 
 
+def measure_gather(comm, counts: Sequence[int],
+                   algos: Sequence[Algorithm],
+                   dt: dataType = dataType.float32,
+                   reps: int = 3,
+                   segment_bytes: Optional[int] = None
+                   ) -> Dict[Algorithm, List[float]]:
+    import jax
+    from .harness import _pick
+    npdt = np.dtype(to_jax_dtype(dt))
+    W = comm.world_size
+    out: Dict[Algorithm, List[float]] = {a: [] for a in algos}
+    for algo in algos:
+        for n in counts:
+            prog = algorithms.build_gather(comm, 0, algo, None, 0, dt,
+                                           segment_bytes)
+            x = jax.device_put(
+                np.full((W, n), 1e-6, npdt), comm.sharding())
+            r = jax.device_put(np.zeros((W, W * n), npdt), comm.sharding())
+            np.asarray(_pick(jax.block_until_ready(prog(x, r))))  # warm
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                np.asarray(_pick(jax.block_until_ready(prog(x, r))))
+                ts.append(time.perf_counter() - t0)
+            out[algo].append(float(np.min(ts)))
+    return out
+
+
+def autotune_gather(acc, cfg: ACCLConfig,
+                    pows: Sequence[int] = (10, 14, 18, 21),
+                    reps: int = 3,
+                    dt: dataType = dataType.float32) -> ACCLConfig:
+    """On ICI, the measured crossover where the ring-relay Pallas gather
+    beats the best jnp family (XLA one-shot / ring relay), written to
+    ``gather_pallas_threshold`` (per-block bytes, matching select())."""
+    on_ici = acc.config.transport == TransportBackend.ICI
+    if not on_ici:
+        return cfg
+    comm = acc.global_comm()
+    counts = [2 ** p for p in pows]
+    elem = np.dtype(to_jax_dtype(dt)).itemsize
+    t = measure_gather(comm, counts,
+                       [Algorithm.XLA, Algorithm.RING, Algorithm.PALLAS],
+                       dt, reps, segment_bytes=acc.config.segment_size)
+    best = [min(a, b) for a, b in zip(t[Algorithm.XLA], t[Algorithm.RING])]
+    p_at = _crossover(counts, best, t[Algorithm.PALLAS], elem)
+    return cfg.replace(
+        gather_pallas_threshold=p_at if p_at is not None else DISABLED)
+
+
 def autotune_flat_tree(acc, cfg: ACCLConfig, reps: int = 3,
                        dt: dataType = dataType.float32) -> ACCLConfig:
     """Measure the flat-star family against the binary tree at the LIVE
@@ -339,6 +389,7 @@ def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
         cfg = autotune_allgather(acc, cfg, pows=pows, reps=reps, dt=dt)
         cfg = autotune_reduce_scatter(acc, cfg, pows=pows, reps=reps, dt=dt)
         cfg = autotune_bcast(acc, cfg, pows=pows, reps=reps, dt=dt)
+        cfg = autotune_gather(acc, cfg, pows=pows, reps=reps, dt=dt)
         cfg = autotune_flat_tree(acc, cfg, reps=reps, dt=dt)
     finally:
         acc.config = saved

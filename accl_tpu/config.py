@@ -93,6 +93,7 @@ class ACCLConfig:
     ag_pallas_threshold: int = 1 * 1024 * 1024    # allgather (per-block)
     rs_pallas_threshold: int = 8 * 1024 * 1024    # reduce_scatter (total)
     bcast_pallas_threshold: int = 8 * 1024 * 1024  # bcast (payload bytes)
+    gather_pallas_threshold: int = 8 * 1024 * 1024  # gather (per-block)
 
     # timeout for request waits, in seconds (HOUSEKEEP_TIMEOUT analog)
     timeout: float = 60.0

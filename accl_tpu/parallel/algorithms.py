@@ -62,7 +62,8 @@ _SUPPORTED = {
     operation.reduce_scatter: {Algorithm.XLA, Algorithm.RING,
                                Algorithm.PALLAS},
     operation.scatter: {Algorithm.XLA, Algorithm.FLAT},
-    operation.gather: {Algorithm.XLA, Algorithm.FLAT, Algorithm.RING},
+    operation.gather: {Algorithm.XLA, Algorithm.FLAT, Algorithm.RING,
+                       Algorithm.PALLAS},
     operation.alltoall: {Algorithm.XLA, Algorithm.FLAT},
 }
 
@@ -134,6 +135,7 @@ def select(
             operation.allgather: cfg.ag_pallas_threshold,
             operation.reduce_scatter: cfg.rs_pallas_threshold,
             operation.bcast: cfg.bcast_pallas_threshold,
+            operation.gather: cfg.gather_pallas_threshold,
         }.get(op)
         if pallas_at is not None and nbytes >= pallas_at:
             return Algorithm.PALLAS
@@ -195,7 +197,13 @@ def build_scatter(comm, root: int, algo: Algorithm,
 
 
 def build_gather(comm, root: int, algo: Algorithm,
-                 arith: Optional[ArithConfig], fanin: int = 0) -> Callable:
+                 arith: Optional[ArithConfig], fanin: int = 0,
+                 dt: Optional[dataType] = None,
+                 segment_bytes: Optional[int] = None) -> Callable:
+    if algo == Algorithm.PALLAS:
+        from . import pallas_chunked
+        return pallas_chunked.build_chunked_ring_gather(
+            comm, root, dt, segment_bytes, arith=arith)
     if algo == Algorithm.FLAT:
         return flat.build_flat_gather(comm, root, arith, fanin)
     if algo == Algorithm.RING:

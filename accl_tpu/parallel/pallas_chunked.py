@@ -525,6 +525,148 @@ def _chunked_bcast_call(x, *, P: int, C: int, sr: int, dtype, root: int):
 
 
 # ---------------------------------------------------------------------------
+# segmented ring-relay gather
+# ---------------------------------------------------------------------------
+
+def _chunked_gather_kernel(x_ref, o_ref, send_buf, recv_buf, send_sem,
+                           recv_sem, load_sem, store_sem, cap_sem, *,
+                           P: int, C: int, root: int):
+    """x_ref: (C, Sr, 128) own block in HBM; o_ref: (P, C, Sr, 128) HBM.
+
+    Ring-relay gather — the HBM-scale analog of the firmware's eager
+    gather relay (``ccl_offload_control.c:1207-1295``): every rank sends
+    its own block first, then relays the blocks arriving from upstream,
+    store-and-forward through its own o_ref (the rx-buffer memory analog;
+    non-root o_ref is scratch, masked off by the wrapper).
+
+    With ``pos = (my - root) % P``, blocks flow toward the root in +1
+    ring direction: rank pos sends ``pos`` blocks (own, then pos-1
+    relays, FIFO) and receives ``pos - 1`` (the root: P-1). The t-th
+    outgoing segment is own segment ``t`` for ``t < C``, else the segment
+    received at step ``t - C`` reloaded from o_ref. Two VMEM slots per
+    direction alternate on step parity; credit semaphores gate slot reuse
+    (grants == gates, every semaphore drains to zero).
+    """
+    my, left, right = _neighbors(P)
+    _ring_barrier(left, right)
+    pos = lax.rem(my - jnp.int32(root) + jnp.int32(P), jnp.int32(P))
+    is_root = pos == 0
+    Cc = jnp.int32(C)
+    n_send = pos * Cc                      # root: 0
+    n_recv = jnp.where(is_root, jnp.int32((P - 1) * C), (pos - 1) * Cc)
+
+    def blk_rank(i):
+        """Global rank whose block is the i-th to arrive here (upstream
+        neighbors in reverse-position order: pos-1, pos-2, ...)."""
+        return lax.rem(my - jnp.int32(1) - i + jnp.int32(2 * P), jnp.int32(P))
+
+    def step(t, _):
+        t = jnp.int32(t)
+        seg = lax.rem(t, Cc)
+        slot = lax.rem(t, jnp.int32(2))
+        send_m = t < n_send
+        recv_m = t < n_recv
+
+        @pl.when(send_m)
+        def _send():
+            # fill the send slot (safe: its step t-2 send was drained by
+            # wait_send): own segment from x_ref for the first C steps,
+            # then relays — the segment received at step t - C, reloaded
+            # from o_ref (its store was waited before the slot was granted)
+            @pl.when(t < Cc)
+            def _own():
+                d = pltpu.make_async_copy(
+                    x_ref.at[seg], send_buf.at[slot], load_sem)
+                d.start()
+                d.wait()
+
+            @pl.when(t >= Cc)
+            def _relay():
+                i = t // Cc - jnp.int32(1)
+                d = pltpu.make_async_copy(
+                    o_ref.at[blk_rank(i), seg], send_buf.at[slot], load_sem)
+                d.start()
+                d.wait()
+
+            # credit gate: the right neighbor must have consumed this
+            # slot's step t-2 content before we overwrite its recv slot
+            @pl.when(t >= 2)
+            def _gate():
+                pltpu.semaphore_wait(cap_sem, 1)
+
+            pltpu.make_async_remote_copy(
+                src_ref=send_buf.at[slot],
+                dst_ref=recv_buf.at[slot],
+                send_sem=send_sem,
+                recv_sem=recv_sem.at[slot],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            ).start()
+
+        @pl.when(recv_m)
+        def _recv():
+            pltpu.make_async_remote_copy(
+                src_ref=send_buf.at[slot],
+                dst_ref=recv_buf.at[slot],
+                send_sem=send_sem,
+                recv_sem=recv_sem.at[slot],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            ).wait_recv()
+            i = t // Cc
+            st = pltpu.make_async_copy(
+                recv_buf.at[slot], o_ref.at[blk_rank(i), seg],
+                store_sem.at[slot])
+            st.start()
+            # the flush must land before the slot is granted back (the
+            # relay reload at step t + C reads it from o_ref) — the wait
+            # costs ~segment HBM-write time, well under the hop time
+            st.wait()
+
+            @pl.when(t + 2 < n_recv)
+            def _grant():
+                pltpu.semaphore_signal(
+                    cap_sem, inc=1, device_id=left,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+        @pl.when(send_m)
+        def _drain():
+            pltpu.make_async_remote_copy(
+                src_ref=send_buf.at[slot],
+                dst_ref=recv_buf.at[slot],
+                send_sem=send_sem,
+                recv_sem=recv_sem.at[slot],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            ).wait_send()
+
+        return 0
+
+    lax.fori_loop(0, C * (P - 1), step, 0)
+
+
+def _chunked_gather_call(x, *, P: int, C: int, sr: int, dtype, root: int):
+    return pl.pallas_call(
+        functools.partial(_chunked_gather_kernel, P=P, C=C, root=root),
+        out_shape=jax.ShapeDtypeStruct((P, C, sr, _LANES), dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((2, sr, _LANES), dtype),      # send_buf (2 slots)
+            pltpu.VMEM((2, sr, _LANES), dtype),      # recv_buf (2 slots)
+            pltpu.SemaphoreType.DMA,                 # send_sem
+            pltpu.SemaphoreType.DMA((2,)),           # recv_sem
+            pltpu.SemaphoreType.DMA,                 # load_sem
+            pltpu.SemaphoreType.DMA((2,)),           # store_sem
+            pltpu.SemaphoreType.REGULAR,             # cap_sem
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=5),
+        interpret=_interpret_params(),
+    )(x)
+
+
+# ---------------------------------------------------------------------------
 # geometry + builders
 # ---------------------------------------------------------------------------
 
@@ -651,6 +793,51 @@ def build_chunked_ring_bcast(comm: Communicator, root: int, dt: dataType,
                                   segment_bytes=segment_bytes, wire=wire)
 
     return _smap(comm, body, 1)
+
+
+def chunked_gather_body(x, dest, *, P: int, root: int, dtype,
+                        segment_bytes: int, wire=None):
+    """Per-rank shard_map body: (1, n), (1, world*n) -> (1, world*n);
+    non-root outputs pass through unchanged (reference recvbuf
+    semantics). ``wire`` runs every relay hop in the wire dtype; the
+    root's own block stays exact."""
+    n = x.shape[-1]
+    rank = lax.axis_index(AXIS)
+    if P == 1:
+        return jnp.where(rank == root, x, dest)
+    kdt = wire[0] if wire is not None else dtype
+    xin = (_pr._to_wire(x[0], wire) if wire is not None
+           else x[0].astype(dtype))
+    C, sr, seg_elems = _geometry(n, kdt, segment_bytes)
+    padded = jnp.zeros((C * seg_elems,), kdt)
+    padded = lax.dynamic_update_slice(padded, xin, (0,))
+    out = _chunked_gather_call(
+        padded.reshape(C, sr, _LANES), P=P, C=C, sr=sr, dtype=kdt, root=root)
+    flat = out.reshape(P, C * seg_elems)[:, :n]
+    flat = (_pr._from_wire(flat, dtype, wire) if wire is not None
+            else flat).astype(x.dtype)
+    flat = flat.at[root].set(x[0])  # own block, exact (never on the wire)
+    return jnp.where(rank == root, flat.reshape(1, P * n), dest)
+
+
+def build_chunked_ring_gather(comm: Communicator, root: int, dt: dataType,
+                              segment_bytes: int, arith=None) -> Callable:
+    """(world, n), (world, world*n) sharded in -> (world, world*n) out
+    (HBM-scale): ring-relay gather, the segmented analog of the
+    firmware's eager gather relay (``ccl_offload_control.c:1207-1295``).
+    A compressing ``arith`` compresses every hop (pure transport)."""
+    _pr._check_multiprocess(comm)
+    P = comm.world_size
+    dtype = to_jax_dtype(dt)
+    compressing = arith is not None and arith.is_compressing
+    wire = ((to_jax_dtype(arith.compressed), arith.quant_scale)
+            if compressing else None)
+
+    def body(x, dest):
+        return chunked_gather_body(x, dest, P=P, root=root, dtype=dtype,
+                                   segment_bytes=segment_bytes, wire=wire)
+
+    return _smap(comm, body, 2)
 
 
 def build_chunked_ring_reduce_scatter(comm: Communicator,

@@ -161,3 +161,28 @@ def test_autotune_bcast_pallas_crossover_on_ici(accl, monkeypatch):
         assert same.bcast_pallas_threshold == orig.bcast_pallas_threshold
     finally:
         accl.config = orig
+
+
+def test_autotune_gather_pallas_crossover_on_ici(accl, monkeypatch):
+    """The ring-relay Pallas gather joins the tuned set on ICI: its
+    crossover vs the best jnp family lands in gather_pallas_threshold."""
+    from accl_tpu.config import TransportBackend
+
+    def fake_measure(comm, cs, algos, dt, reps, segment_bytes=None):
+        assert Algorithm.PALLAS in algos and Algorithm.RING in algos
+        t = {a: [1.0, 1.0] for a in algos}
+        t[Algorithm.PALLAS] = [2.0, 0.5]  # wins from index 1 on
+        return t
+
+    monkeypatch.setattr(autotune, "measure_gather", fake_measure)
+    orig = accl.config
+    try:
+        accl.config = accl.config.replace(transport=TransportBackend.ICI)
+        tuned = autotune.autotune_gather(accl, accl.config, pows=(6, 9),
+                                         reps=1)
+        assert tuned.gather_pallas_threshold == 2 ** 9 * 4
+        comm = accl.global_comm()
+        assert algorithms.select(
+            operation.gather, 2 ** 9 * 4, comm, tuned) == Algorithm.PALLAS
+    finally:
+        accl.config = orig

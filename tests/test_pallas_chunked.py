@@ -305,6 +305,96 @@ def test_chunked_bcast_through_host_api(accl, rng):
         ici) == Algorithm.PALLAS
 
 
+# pipeline fill/relay regimes: C=1 (pure relay chain), C=2 (both slots),
+# C=3/4 (relay reload crosses slot-reuse credit chains)
+@pytest.mark.parametrize("nseg", [1, 2, 3, 4])
+@pytest.mark.parametrize("root", [0, 3])
+def test_chunked_gather(accl, rng, nseg, root):
+    comm = accl.global_comm()
+    n = 1024 * nseg
+    x = rng.standard_normal((WORLD, n)).astype(np.float32)
+    dest = rng.standard_normal((WORLD, WORLD * n)).astype(np.float32)
+    prog = pallas_chunked.build_chunked_ring_gather(
+        comm, root, dataType.float32, segment_bytes=SEG)
+    import jax
+    out = np.asarray(prog(_put(accl, x), _put(accl, dest)))
+    np.testing.assert_array_equal(out[root].reshape(WORLD, n), x)
+    for r in range(WORLD):
+        if r != root:  # non-root outputs pass through unchanged
+            np.testing.assert_array_equal(out[r], dest[r])
+
+
+def test_chunked_gather_uneven_payload(accl, rng):
+    comm = accl.global_comm()
+    n = 5000
+    x = rng.standard_normal((WORLD, n)).astype(np.float32)
+    dest = np.zeros((WORLD, WORLD * n), np.float32)
+    prog = pallas_chunked.build_chunked_ring_gather(
+        comm, 6, dataType.float32, segment_bytes=SEG)
+    out = np.asarray(prog(_put(accl, x), _put(accl, dest)))
+    np.testing.assert_array_equal(out[6].reshape(WORLD, n), x)
+
+
+def test_chunked_gather_race_free(accl, rng, monkeypatch):
+    """Ring-relay gather store-and-forward protocol (recv slot flush,
+    o_ref relay reload, credit chain) under the interpret-mode race
+    detector."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    monkeypatch.setattr(
+        pallas_ring, "_interpret_params",
+        lambda: pltpu.InterpretParams(detect_races=True))
+    comm = accl.global_comm()
+    n = 1024 * 3
+    x = rng.standard_normal((WORLD, n)).astype(np.float32)
+    dest = np.zeros((WORLD, WORLD * n), np.float32)
+    prog = pallas_chunked.build_chunked_ring_gather(
+        comm, 2, dataType.float32, segment_bytes=SEG)
+    out = np.asarray(prog(_put(accl, x), _put(accl, dest)))
+    np.testing.assert_array_equal(out[2].reshape(WORLD, n), x)
+
+
+def test_chunked_gather_compressed_wire(accl, rng):
+    """bf16 wire through the relay: every hop compressed; the root's own
+    block never rides the wire and stays exact."""
+    from accl_tpu import ArithConfig
+    comm = accl.global_comm()
+    arith = ArithConfig(dataType.float32, dataType.bfloat16,
+                        arith_is_compressed=False)
+    n = 1024 * 2
+    x = rng.integers(-10, 10, (WORLD, n)).astype(np.float32)
+    x[0] += 0.33  # root block: not bf16-representable, must stay exact
+    dest = np.zeros((WORLD, WORLD * n), np.float32)
+    prog = pallas_chunked.build_chunked_ring_gather(
+        comm, 0, dataType.float32, segment_bytes=SEG, arith=arith)
+    out = np.asarray(prog(_put(accl, x), _put(accl, dest)))
+    got = out[0].reshape(WORLD, n)
+    np.testing.assert_array_equal(got[0], x[0])       # exact own block
+    np.testing.assert_array_equal(got[1:], x[1:])     # bf16-exact ints
+
+
+def test_chunked_gather_through_host_api(accl, rng):
+    """Algorithm.PALLAS through ACCL.gather runs the relay end to end
+    (and AUTO engages it on ICI above gather_pallas_threshold)."""
+    from accl_tpu.constants import operation
+    from accl_tpu.parallel import algorithms
+    from accl_tpu.config import TransportBackend
+
+    count = 4096
+    send = accl.create_buffer(count, dataType.float32)
+    recv = accl.create_buffer(count * WORLD, dataType.float32)
+    send.host[:] = rng.standard_normal(send.host.shape).astype(np.float32)
+    accl.gather(send, recv, count, root=3, algorithm=Algorithm.PALLAS)
+    np.testing.assert_array_equal(
+        recv.host[3].reshape(WORLD, count), send.host)
+
+    ici = accl.config.replace(transport=TransportBackend.ICI)
+    comm = accl.global_comm()
+    assert algorithms.select(
+        operation.gather, ici.gather_pallas_threshold, comm,
+        ici) == Algorithm.PALLAS
+
+
 @pytest.mark.skipif(
     not os.environ.get("ACCL_BIG_PAYLOAD"),
     reason="1 GiB interpret-mode run; set ACCL_BIG_PAYLOAD=1 to enable")
