@@ -481,9 +481,11 @@ class ACCL:
         algo = algorithms.select(
             operation.scatter, count * constants.dtype_size(dtype),
             comm, self.config, algorithm)
+        seg = self.config.segment_size
         return (self._key(comm, operation.scatter, count, dtype, root,
-                          compress_dtype, algo),
-                lambda: algorithms.build_scatter(comm, root, algo, arith))
+                          compress_dtype, algo, seg),
+                lambda: algorithms.build_scatter(comm, root, algo, arith,
+                                                 dtype, seg))
 
     def _spec_gather(self, comm, count: int, dtype: dataType, root: int,
                      compress_dtype, algorithm):

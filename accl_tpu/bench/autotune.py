@@ -289,6 +289,48 @@ def autotune_gather(acc, cfg: ACCLConfig,
         gather_pallas_threshold=p_at if p_at is not None else DISABLED)
 
 
+def measure_scatter(comm, counts: Sequence[int],
+                    algos: Sequence[Algorithm],
+                    dt: dataType = dataType.float32,
+                    reps: int = 3,
+                    segment_bytes: Optional[int] = None
+                    ) -> Dict[Algorithm, List[float]]:
+    import jax
+    npdt = np.dtype(to_jax_dtype(dt))
+    W = comm.world_size
+    out: Dict[Algorithm, List[float]] = {a: [] for a in algos}
+    for algo in algos:
+        for n in counts:
+            prog = algorithms.build_scatter(comm, 0, algo, None, dt,
+                                            segment_bytes)
+            x = jax.device_put(
+                np.full((W, W * n), 1e-6, npdt), comm.sharding())
+            out[algo].append(_time_prog(prog, x, reps))
+    return out
+
+
+def autotune_scatter(acc, cfg: ACCLConfig,
+                     pows: Sequence[int] = (10, 14, 18, 21),
+                     reps: int = 3,
+                     dt: dataType = dataType.float32) -> ACCLConfig:
+    """On ICI, the measured crossover where the ring-relay Pallas scatter
+    beats the best jnp family (XLA one-shot / flat star), written to
+    ``scatter_pallas_threshold`` (per-edge bytes, matching select())."""
+    on_ici = acc.config.transport == TransportBackend.ICI
+    if not on_ici:
+        return cfg
+    comm = acc.global_comm()
+    counts = [2 ** p for p in pows]
+    elem = np.dtype(to_jax_dtype(dt)).itemsize
+    t = measure_scatter(comm, counts,
+                        [Algorithm.XLA, Algorithm.FLAT, Algorithm.PALLAS],
+                        dt, reps, segment_bytes=acc.config.segment_size)
+    best = [min(a, b) for a, b in zip(t[Algorithm.XLA], t[Algorithm.FLAT])]
+    p_at = _crossover(counts, best, t[Algorithm.PALLAS], elem)
+    return cfg.replace(
+        scatter_pallas_threshold=p_at if p_at is not None else DISABLED)
+
+
 def autotune_flat_tree(acc, cfg: ACCLConfig, reps: int = 3,
                        dt: dataType = dataType.float32) -> ACCLConfig:
     """Measure the flat-star family against the binary tree at the LIVE
@@ -390,6 +432,7 @@ def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
         cfg = autotune_reduce_scatter(acc, cfg, pows=pows, reps=reps, dt=dt)
         cfg = autotune_bcast(acc, cfg, pows=pows, reps=reps, dt=dt)
         cfg = autotune_gather(acc, cfg, pows=pows, reps=reps, dt=dt)
+        cfg = autotune_scatter(acc, cfg, pows=pows, reps=reps, dt=dt)
         cfg = autotune_flat_tree(acc, cfg, reps=reps, dt=dt)
     finally:
         acc.config = saved

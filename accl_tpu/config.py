@@ -94,6 +94,7 @@ class ACCLConfig:
     rs_pallas_threshold: int = 8 * 1024 * 1024    # reduce_scatter (total)
     bcast_pallas_threshold: int = 8 * 1024 * 1024  # bcast (payload bytes)
     gather_pallas_threshold: int = 8 * 1024 * 1024  # gather (per-block)
+    scatter_pallas_threshold: int = 8 * 1024 * 1024  # scatter (per-edge)
 
     # timeout for request waits, in seconds (HOUSEKEEP_TIMEOUT analog)
     timeout: float = 60.0

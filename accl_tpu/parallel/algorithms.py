@@ -61,7 +61,7 @@ _SUPPORTED = {
     operation.allgather: {Algorithm.XLA, Algorithm.RING, Algorithm.PALLAS},
     operation.reduce_scatter: {Algorithm.XLA, Algorithm.RING,
                                Algorithm.PALLAS},
-    operation.scatter: {Algorithm.XLA, Algorithm.FLAT},
+    operation.scatter: {Algorithm.XLA, Algorithm.FLAT, Algorithm.PALLAS},
     operation.gather: {Algorithm.XLA, Algorithm.FLAT, Algorithm.RING,
                        Algorithm.PALLAS},
     operation.alltoall: {Algorithm.XLA, Algorithm.FLAT},
@@ -136,6 +136,7 @@ def select(
             operation.reduce_scatter: cfg.rs_pallas_threshold,
             operation.bcast: cfg.bcast_pallas_threshold,
             operation.gather: cfg.gather_pallas_threshold,
+            operation.scatter: cfg.scatter_pallas_threshold,
         }.get(op)
         if pallas_at is not None and nbytes >= pallas_at:
             return Algorithm.PALLAS
@@ -190,7 +191,13 @@ def build_bcast(comm, root: int, algo: Algorithm,
 
 
 def build_scatter(comm, root: int, algo: Algorithm,
-                  arith: Optional[ArithConfig]) -> Callable:
+                  arith: Optional[ArithConfig],
+                  dt: Optional[dataType] = None,
+                  segment_bytes: Optional[int] = None) -> Callable:
+    if algo == Algorithm.PALLAS:
+        from . import pallas_chunked
+        return pallas_chunked.build_chunked_ring_scatter(
+            comm, root, dt, segment_bytes, arith=arith)
     if algo == Algorithm.FLAT:
         return flat.build_flat_scatter(comm, root, arith)
     return primitives.build_scatter(comm, root, arith)

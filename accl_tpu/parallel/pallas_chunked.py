@@ -525,6 +525,146 @@ def _chunked_bcast_call(x, *, P: int, C: int, sr: int, dtype, root: int):
 
 
 # ---------------------------------------------------------------------------
+# segmented ring-relay scatter
+# ---------------------------------------------------------------------------
+
+def _chunked_scatter_kernel(x_ref, o_ref, buf, send_sem, recv_sem, load_sem,
+                            store_sem, cap_sem, *, P: int, C: int,
+                            root: int):
+    """x_ref: (P, C, Sr, 128) in HBM (root's full payload; scratch
+    elsewhere); o_ref: (C, Sr, 128) own chunk in HBM.
+
+    Ring-relay scatter — the segmented analog of the firmware's eager
+    scatter fanout (``ccl_offload_control.c:1082-1124``), ring-shaped:
+    the root streams blocks for positions 1..P-1 in that order; each rank
+    keeps the FIRST C segments that arrive (its own block) and forwards
+    everything after directly from the receive slot — the relay needs no
+    buffering beyond the two slots because the outgoing stream is exactly
+    the incoming stream minus the head block.
+
+    With ``pos = (my - root) % P``: rank pos receives C*(P-pos) segments
+    and sends C*(P-1-pos); the root sends C*(P-1) from HBM. Incoming
+    segment t is block pos + t//C; at t >= C it is forwarded in the same
+    step (its receiver indexes it as t - C, so the remote slot is
+    (t-C)%2). Credit semaphores gate slot reuse; grants == gates.
+    """
+    my, left, right = _neighbors(P)
+    _ring_barrier(left, right)
+    pos = lax.rem(my - jnp.int32(root) + jnp.int32(P), jnp.int32(P))
+    is_root = pos == 0
+    Cc = jnp.int32(C)
+    two = jnp.int32(2)
+    n_in = jnp.where(is_root, jnp.int32(0), (jnp.int32(P) - pos) * Cc)
+    n_out = (jnp.int32(P) - jnp.int32(1) - pos) * Cc
+
+    def _rdma(src_slot, dst_slot):
+        # send semaphores are PER SLOT: the root keeps two sends in
+        # flight, and DMA completions are unordered — a shared counting
+        # semaphore could satisfy slot A's drain with slot B's completion
+        # and let the loader overwrite a slot mid-send (race-detector
+        # caught exactly this)
+        return pltpu.make_async_remote_copy(
+            src_ref=buf.at[src_slot],
+            dst_ref=buf.at[dst_slot],
+            send_sem=send_sem.at[src_slot],
+            recv_sem=recv_sem.at[dst_slot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    def step(t, _):
+        t = jnp.int32(t)
+        seg = lax.rem(t, Cc)
+
+        # ---- root: send out-segment t from HBM --------------------------
+        @pl.when(jnp.logical_and(is_root, t < n_out))
+        def _root_send():
+            slot = lax.rem(t, two)
+            blk = lax.rem(jnp.int32(root) + jnp.int32(1) + t // Cc,
+                          jnp.int32(P))
+
+            # deferred drain: consume THIS slot's t-2 send completion just
+            # before overwriting it, keeping two sends in flight
+            @pl.when(t >= two)
+            def _drain_prev():
+                _rdma(slot, slot).wait_send()
+
+            ld = pltpu.make_async_copy(
+                x_ref.at[blk, seg], buf.at[slot], load_sem)
+            ld.start()
+            ld.wait()
+
+            @pl.when(t >= two)
+            def _gate():
+                pltpu.semaphore_wait(cap_sem, 1)
+
+            _rdma(slot, slot).start()
+
+        # ---- non-root: receive in-segment t, keep or forward ------------
+        @pl.when(jnp.logical_and(jnp.logical_not(is_root), t < n_in))
+        def _recv():
+            slot = lax.rem(t, two)
+            _rdma(slot, slot).wait_recv()
+
+            @pl.when(t < Cc)
+            def _keep():
+                st = pltpu.make_async_copy(
+                    buf.at[slot], o_ref.at[seg], store_sem)
+                st.start()
+                st.wait()
+
+            @pl.when(t >= Cc)
+            def _forward():
+                u = t - Cc           # receiver's incoming index
+                dslot = lax.rem(u, two)
+
+                @pl.when(u >= two)
+                def _gate():
+                    pltpu.semaphore_wait(cap_sem, 1)
+
+                _rdma(slot, dslot).start()
+                _rdma(slot, dslot).wait_send()
+
+            @pl.when(t + two < n_in)
+            def _grant():
+                pltpu.semaphore_signal(
+                    cap_sem, inc=1, device_id=left,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+        return 0
+
+    lax.fori_loop(0, C * (P - 1), step, 0)
+
+    # epilogue: the root's final (up to two) sends are still undrained —
+    # the last two out-segments sit in different slots
+    @pl.when(is_root)
+    def _epilogue():
+        _rdma(0, 0).wait_send()
+        if C * (P - 1) >= 2:
+            _rdma(1, 1).wait_send()
+
+
+def _chunked_scatter_call(x, *, P: int, C: int, sr: int, dtype, root: int):
+    return pl.pallas_call(
+        functools.partial(_chunked_scatter_kernel, P=P, C=C, root=root),
+        out_shape=jax.ShapeDtypeStruct((C, sr, _LANES), dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((2, sr, _LANES), dtype),      # buf (2 slots)
+            pltpu.SemaphoreType.DMA((2,)),           # send_sem (per slot)
+            pltpu.SemaphoreType.DMA((2,)),           # recv_sem
+            pltpu.SemaphoreType.DMA,                 # load_sem
+            pltpu.SemaphoreType.DMA,                 # store_sem
+            pltpu.SemaphoreType.REGULAR,             # cap_sem
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=6),
+        interpret=_interpret_params(),
+    )(x)
+
+
+# ---------------------------------------------------------------------------
 # segmented ring-relay gather
 # ---------------------------------------------------------------------------
 
@@ -791,6 +931,54 @@ def build_chunked_ring_bcast(comm: Communicator, root: int, dt: dataType,
     def body(x):
         return chunked_bcast_body(x, P=P, root=root, dtype=dtype,
                                   segment_bytes=segment_bytes, wire=wire)
+
+    return _smap(comm, body, 1)
+
+
+def chunked_scatter_body(x, *, P: int, root: int, dtype,
+                         segment_bytes: int, wire=None):
+    """Per-rank shard_map body: (1, world*n) -> (1, n) (HBM-scale).
+    ``wire`` runs every hop in the wire dtype (pure transport); the
+    root's own chunk never rides the wire and stays exact."""
+    total = x.shape[-1]
+    n = total // P
+    if P == 1:
+        return x[:, :n]
+    kdt = wire[0] if wire is not None else dtype
+    xin = x.reshape(P, n)
+    wired = (_pr._to_wire(xin, wire) if wire is not None
+             else xin.astype(dtype))
+    C, sr, seg_elems = _geometry(n, kdt, segment_bytes)
+    per = C * seg_elems
+    grid = jnp.zeros((P, per), kdt)
+    grid = lax.dynamic_update_slice(grid, wired, (0, 0))
+    out = _chunked_scatter_call(
+        grid.reshape(P, C, sr, _LANES), P=P, C=C, sr=sr, dtype=kdt,
+        root=root)
+    mine = out.reshape(-1)[:n]
+    mine = (_pr._from_wire(mine, dtype, wire) if wire is not None
+            else mine).astype(x.dtype)
+    # the root's o_ref is never written (it is the source); keep its chunk
+    mine = jnp.where(lax.axis_index(AXIS) == root, xin[root], mine)
+    return mine.reshape(1, n)
+
+
+def build_chunked_ring_scatter(comm: Communicator, root: int, dt: dataType,
+                               segment_bytes: int, arith=None) -> Callable:
+    """(world, world*n) sharded in -> (world, n) sharded out (HBM-scale):
+    ring-relay scatter, the segmented analog of the firmware's eager
+    scatter fanout (``ccl_offload_control.c:1082-1124``). A compressing
+    ``arith`` compresses every hop (pure transport)."""
+    _pr._check_multiprocess(comm)
+    P = comm.world_size
+    dtype = to_jax_dtype(dt)
+    compressing = arith is not None and arith.is_compressing
+    wire = ((to_jax_dtype(arith.compressed), arith.quant_scale)
+            if compressing else None)
+
+    def body(x):
+        return chunked_scatter_body(x, P=P, root=root, dtype=dtype,
+                                    segment_bytes=segment_bytes, wire=wire)
 
     return _smap(comm, body, 1)
 
