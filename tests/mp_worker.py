@@ -54,6 +54,18 @@ def main() -> int:
         assert np.allclose(b.host[rank], 100), b.host[rank][:4]
     print(f"[p{me}] bcast ok", flush=True)
 
+    # ---- one-sided put across controllers ------------------------------
+    # put is an SPMD move program every controller enters (like a
+    # collective) — no matching recv, the stream_put semantics
+    psrc = acc.create_buffer(n, dataType.float32)
+    pdst = acc.create_buffer(n, dataType.float32)
+    for rank in range(W):
+        psrc.host[rank] = 10 * (rank + 1)
+    acc.put(psrc, pdst, n, src=0, dst=W - 1)
+    if comm.rank_is_local(W - 1):
+        assert np.allclose(pdst.host[W - 1], 10), pdst.host[W - 1][:4]
+    print(f"[p{me}] one-sided put ok", flush=True)
+
     # ---- cross-process eager send/recv (rank 0 -> rank W-1) ------------
     cnt = 300
     payload = np.arange(cnt, dtype=np.float32)
