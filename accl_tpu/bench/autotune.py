@@ -27,14 +27,14 @@ from ..parallel import algorithms
 DISABLED = 1 << 62
 
 
-def _time_prog(prog, x, reps: int) -> float:
+def _time_prog(prog, *args, reps: int) -> float:
     import jax
     from .harness import _pick
-    np.asarray(_pick(jax.block_until_ready(prog(x))))  # compile + warm
+    np.asarray(_pick(jax.block_until_ready(prog(*args))))  # compile + warm
     ts = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        np.asarray(_pick(jax.block_until_ready(prog(x))))
+        np.asarray(_pick(jax.block_until_ready(prog(*args))))
         ts.append(time.perf_counter() - t0)
     return float(np.min(ts))
 
@@ -53,7 +53,7 @@ def measure_allreduce(comm, counts: Sequence[int],
                 comm, reduceFunction.SUM, dt, algo, None)
             x = jax.device_put(
                 np.full((comm.world_size, n), 1e-6, npdt), comm.sharding())
-            out[algo].append(_time_prog(prog, x, reps))
+            out[algo].append(_time_prog(prog, x, reps=reps))
     return out
 
 
@@ -122,7 +122,7 @@ def measure_allgather(comm, counts: Sequence[int],
             prog = algorithms.build_allgather(comm, algo, None, dt, None)
             x = jax.device_put(
                 np.full((comm.world_size, n), 1e-6, npdt), comm.sharding())
-            out[algo].append(_time_prog(prog, x, reps))
+            out[algo].append(_time_prog(prog, x, reps=reps))
     return out
 
 
@@ -140,7 +140,7 @@ def measure_reduce_scatter(comm, counts: Sequence[int],
                 comm, reduceFunction.SUM, dt, algo, None)
             x = jax.device_put(
                 np.full((W, W * n), 1e-6, npdt), comm.sharding())
-            out[algo].append(_time_prog(prog, x, reps))
+            out[algo].append(_time_prog(prog, x, reps=reps))
     return out
 
 
@@ -196,47 +196,31 @@ def autotune_reduce_scatter(acc, cfg: ACCLConfig,
     return cfg
 
 
+def _measure_rooted(build, comm, counts, algos, dt, reps, make_inputs):
+    """Shared measurement loop for the rooted ops (root = 0)."""
+    import jax
+    npdt = np.dtype(to_jax_dtype(dt))
+    out: Dict[Algorithm, List[float]] = {a: [] for a in algos}
+    for algo in algos:
+        for n in counts:
+            prog = build(algo)
+            args = [jax.device_put(a, comm.sharding())
+                    for a in make_inputs(npdt, comm.world_size, n)]
+            out[algo].append(_time_prog(prog, *args, reps=reps))
+    return out
+
+
 def measure_bcast(comm, counts: Sequence[int],
                   algos: Sequence[Algorithm],
                   dt: dataType = dataType.float32,
                   reps: int = 3,
                   segment_bytes: Optional[int] = None
                   ) -> Dict[Algorithm, List[float]]:
-    import jax
-    npdt = np.dtype(to_jax_dtype(dt))
-    out: Dict[Algorithm, List[float]] = {a: [] for a in algos}
-    for algo in algos:
-        for n in counts:
-            prog = algorithms.build_bcast(comm, 0, algo, None, dt,
-                                          segment_bytes)
-            x = jax.device_put(
-                np.full((comm.world_size, n), 1e-6, npdt), comm.sharding())
-            out[algo].append(_time_prog(prog, x, reps))
-    return out
-
-
-def autotune_bcast(acc, cfg: ACCLConfig,
-                   pows: Sequence[int] = (10, 14, 18, 21),
-                   reps: int = 3,
-                   dt: dataType = dataType.float32) -> ACCLConfig:
-    """On ICI, the measured crossover where the pipelined-ring Pallas
-    bcast beats the best jnp family (XLA one-shot / binary tree), written
-    to ``bcast_pallas_threshold`` (payload bytes, matching select()). The
-    XLA/FLAT/TREE splits are world-size registers, tuned by
-    autotune_flat_tree; only the Pallas engage point is a size threshold."""
-    on_ici = acc.config.transport == TransportBackend.ICI
-    if not on_ici:
-        return cfg
-    comm = acc.global_comm()
-    counts = [2 ** p for p in pows]
-    elem = np.dtype(to_jax_dtype(dt)).itemsize
-    t = measure_bcast(comm, counts,
-                      [Algorithm.XLA, Algorithm.TREE, Algorithm.PALLAS],
-                      dt, reps, segment_bytes=acc.config.segment_size)
-    best = [min(a, b) for a, b in zip(t[Algorithm.XLA], t[Algorithm.TREE])]
-    p_at = _crossover(counts, best, t[Algorithm.PALLAS], elem)
-    return cfg.replace(
-        bcast_pallas_threshold=p_at if p_at is not None else DISABLED)
+    return _measure_rooted(
+        lambda algo: algorithms.build_bcast(comm, 0, algo, None, dt,
+                                            segment_bytes),
+        comm, counts, algos, dt, reps,
+        lambda npdt, W, n: [np.full((W, n), 1e-6, npdt)])
 
 
 def measure_gather(comm, counts: Sequence[int],
@@ -245,26 +229,59 @@ def measure_gather(comm, counts: Sequence[int],
                    reps: int = 3,
                    segment_bytes: Optional[int] = None
                    ) -> Dict[Algorithm, List[float]]:
-    import jax
-    from .harness import _pick
-    npdt = np.dtype(to_jax_dtype(dt))
-    W = comm.world_size
-    out: Dict[Algorithm, List[float]] = {a: [] for a in algos}
-    for algo in algos:
-        for n in counts:
-            prog = algorithms.build_gather(comm, 0, algo, None, 0, dt,
-                                           segment_bytes)
-            x = jax.device_put(
-                np.full((W, n), 1e-6, npdt), comm.sharding())
-            r = jax.device_put(np.zeros((W, W * n), npdt), comm.sharding())
-            np.asarray(_pick(jax.block_until_ready(prog(x, r))))  # warm
-            ts = []
-            for _ in range(reps):
-                t0 = time.perf_counter()
-                np.asarray(_pick(jax.block_until_ready(prog(x, r))))
-                ts.append(time.perf_counter() - t0)
-            out[algo].append(float(np.min(ts)))
-    return out
+    return _measure_rooted(
+        lambda algo: algorithms.build_gather(comm, 0, algo, None, 0, dt,
+                                             segment_bytes),
+        comm, counts, algos, dt, reps,
+        lambda npdt, W, n: [np.full((W, n), 1e-6, npdt),
+                            np.zeros((W, W * n), npdt)])
+
+
+def measure_scatter(comm, counts: Sequence[int],
+                    algos: Sequence[Algorithm],
+                    dt: dataType = dataType.float32,
+                    reps: int = 3,
+                    segment_bytes: Optional[int] = None
+                    ) -> Dict[Algorithm, List[float]]:
+    return _measure_rooted(
+        lambda algo: algorithms.build_scatter(comm, 0, algo, None, dt,
+                                              segment_bytes),
+        comm, counts, algos, dt, reps,
+        lambda npdt, W, n: [np.full((W, W * n), 1e-6, npdt)])
+
+
+def _rooted_pallas_crossover(acc, cfg, *, measure, baseline: Algorithm,
+                             field: str, pows, reps, dt) -> ACCLConfig:
+    """Shared shape of the rooted-op Pallas tuners: on ICI, measure
+    [XLA, baseline, PALLAS], take best-of the jnp families per size, and
+    write the crossover (or DISABLED) to ``field``. The XLA/FLAT/TREE
+    splits themselves are world-size registers tuned by
+    autotune_flat_tree; only the Pallas engage point is a size threshold.
+    Units follow each op's select() byte convention (the caller picks the
+    field; all three rooted ops use per-edge/per-block bytes = count x
+    elem)."""
+    if acc.config.transport != TransportBackend.ICI:
+        return cfg
+    comm = acc.global_comm()
+    counts = [2 ** p for p in pows]
+    elem = np.dtype(to_jax_dtype(dt)).itemsize
+    t = measure(comm, counts, [Algorithm.XLA, baseline, Algorithm.PALLAS],
+                dt, reps, segment_bytes=acc.config.segment_size)
+    best = [min(a, b) for a, b in zip(t[Algorithm.XLA], t[baseline])]
+    p_at = _crossover(counts, best, t[Algorithm.PALLAS], elem)
+    return cfg.replace(**{field: p_at if p_at is not None else DISABLED})
+
+
+def autotune_bcast(acc, cfg: ACCLConfig,
+                   pows: Sequence[int] = (10, 14, 18, 21),
+                   reps: int = 3,
+                   dt: dataType = dataType.float32) -> ACCLConfig:
+    """On ICI, the measured crossover where the pipelined-ring Pallas
+    bcast beats the best jnp family (XLA one-shot / binary tree), written
+    to ``bcast_pallas_threshold`` (payload bytes, matching select())."""
+    return _rooted_pallas_crossover(
+        acc, cfg, measure=measure_bcast, baseline=Algorithm.TREE,
+        field="bcast_pallas_threshold", pows=pows, reps=reps, dt=dt)
 
 
 def autotune_gather(acc, cfg: ACCLConfig,
@@ -274,39 +291,9 @@ def autotune_gather(acc, cfg: ACCLConfig,
     """On ICI, the measured crossover where the ring-relay Pallas gather
     beats the best jnp family (XLA one-shot / ring relay), written to
     ``gather_pallas_threshold`` (per-block bytes, matching select())."""
-    on_ici = acc.config.transport == TransportBackend.ICI
-    if not on_ici:
-        return cfg
-    comm = acc.global_comm()
-    counts = [2 ** p for p in pows]
-    elem = np.dtype(to_jax_dtype(dt)).itemsize
-    t = measure_gather(comm, counts,
-                       [Algorithm.XLA, Algorithm.RING, Algorithm.PALLAS],
-                       dt, reps, segment_bytes=acc.config.segment_size)
-    best = [min(a, b) for a, b in zip(t[Algorithm.XLA], t[Algorithm.RING])]
-    p_at = _crossover(counts, best, t[Algorithm.PALLAS], elem)
-    return cfg.replace(
-        gather_pallas_threshold=p_at if p_at is not None else DISABLED)
-
-
-def measure_scatter(comm, counts: Sequence[int],
-                    algos: Sequence[Algorithm],
-                    dt: dataType = dataType.float32,
-                    reps: int = 3,
-                    segment_bytes: Optional[int] = None
-                    ) -> Dict[Algorithm, List[float]]:
-    import jax
-    npdt = np.dtype(to_jax_dtype(dt))
-    W = comm.world_size
-    out: Dict[Algorithm, List[float]] = {a: [] for a in algos}
-    for algo in algos:
-        for n in counts:
-            prog = algorithms.build_scatter(comm, 0, algo, None, dt,
-                                            segment_bytes)
-            x = jax.device_put(
-                np.full((W, W * n), 1e-6, npdt), comm.sharding())
-            out[algo].append(_time_prog(prog, x, reps))
-    return out
+    return _rooted_pallas_crossover(
+        acc, cfg, measure=measure_gather, baseline=Algorithm.RING,
+        field="gather_pallas_threshold", pows=pows, reps=reps, dt=dt)
 
 
 def autotune_scatter(acc, cfg: ACCLConfig,
@@ -316,19 +303,9 @@ def autotune_scatter(acc, cfg: ACCLConfig,
     """On ICI, the measured crossover where the ring-relay Pallas scatter
     beats the best jnp family (XLA one-shot / flat star), written to
     ``scatter_pallas_threshold`` (per-edge bytes, matching select())."""
-    on_ici = acc.config.transport == TransportBackend.ICI
-    if not on_ici:
-        return cfg
-    comm = acc.global_comm()
-    counts = [2 ** p for p in pows]
-    elem = np.dtype(to_jax_dtype(dt)).itemsize
-    t = measure_scatter(comm, counts,
-                        [Algorithm.XLA, Algorithm.FLAT, Algorithm.PALLAS],
-                        dt, reps, segment_bytes=acc.config.segment_size)
-    best = [min(a, b) for a, b in zip(t[Algorithm.XLA], t[Algorithm.FLAT])]
-    p_at = _crossover(counts, best, t[Algorithm.PALLAS], elem)
-    return cfg.replace(
-        scatter_pallas_threshold=p_at if p_at is not None else DISABLED)
+    return _rooted_pallas_crossover(
+        acc, cfg, measure=measure_scatter, baseline=Algorithm.FLAT,
+        field="scatter_pallas_threshold", pows=pows, reps=reps, dt=dt)
 
 
 def autotune_flat_tree(acc, cfg: ACCLConfig, reps: int = 3,
@@ -354,7 +331,7 @@ def autotune_flat_tree(acc, cfg: ACCLConfig, reps: int = 3,
     def timed(build, *shape):
         prog = build()
         x = jax.device_put(np.full(shape, 1e-6, npdt), comm.sharding())
-        return _time_prog(prog, x, reps)
+        return _time_prog(prog, x, reps=reps)
 
     t_flat = timed(lambda: algorithms.build_bcast(
         comm, 0, Algorithm.FLAT, None), W, n)

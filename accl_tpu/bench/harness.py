@@ -137,7 +137,7 @@ def _cases(comm: Communicator, dt: dataType, func: reduceFunction,
             lambda n: (flat(n), flat(n, 0.0))),
         "bcast": _Case(
             operation.bcast,
-            lambda: algorithms.build_bcast(comm, 0, algo, None),
+            lambda: algorithms.build_bcast(comm, 0, algo, None, dt),
             lambda n: (flat(n),)),
         "scatter": _Case(
             operation.scatter,

@@ -178,6 +178,8 @@ def build_bcast(comm, root: int, algo: Algorithm,
                 dt: Optional[dataType] = None,
                 segment_bytes: Optional[int] = None) -> Callable:
     if algo == Algorithm.PALLAS:
+        if dt is None:
+            raise ValueError("Algorithm.PALLAS bcast requires dt")
         from . import pallas_chunked
         return pallas_chunked.build_chunked_ring_bcast(
             comm, root, dt, segment_bytes, arith=arith)
@@ -195,6 +197,8 @@ def build_scatter(comm, root: int, algo: Algorithm,
                   dt: Optional[dataType] = None,
                   segment_bytes: Optional[int] = None) -> Callable:
     if algo == Algorithm.PALLAS:
+        if dt is None:
+            raise ValueError("Algorithm.PALLAS scatter requires dt")
         from . import pallas_chunked
         return pallas_chunked.build_chunked_ring_scatter(
             comm, root, dt, segment_bytes, arith=arith)
@@ -208,6 +212,8 @@ def build_gather(comm, root: int, algo: Algorithm,
                  dt: Optional[dataType] = None,
                  segment_bytes: Optional[int] = None) -> Callable:
     if algo == Algorithm.PALLAS:
+        if dt is None:
+            raise ValueError("Algorithm.PALLAS gather requires dt")
         from . import pallas_chunked
         return pallas_chunked.build_chunked_ring_gather(
             comm, root, dt, segment_bytes, arith=arith)

@@ -43,7 +43,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..communicator import Communicator
-from ..constants import dataType, reduceFunction, to_jax_dtype
+from ..constants import (DEFAULT_SEGMENT_SIZE, dataType, reduceFunction,
+                         to_jax_dtype)
 from .primitives import AXIS, _smap
 from . import pallas_ring as _pr
 from .pallas_ring import (_LANES, _combine, _neighbors, _pad_rows,
@@ -700,6 +701,16 @@ def _chunked_gather_kernel(x_ref, o_ref, send_buf, recv_buf, send_sem,
         neighbors in reverse-position order: pos-1, pos-2, ...)."""
         return lax.rem(my - jnp.int32(1) - i + jnp.int32(2 * P), jnp.int32(P))
 
+    def _rdma(slot):
+        return pltpu.make_async_remote_copy(
+            src_ref=send_buf.at[slot],
+            dst_ref=recv_buf.at[slot],
+            send_sem=send_sem,
+            recv_sem=recv_sem.at[slot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
     def step(t, _):
         t = jnp.int32(t)
         seg = lax.rem(t, Cc)
@@ -734,25 +745,11 @@ def _chunked_gather_kernel(x_ref, o_ref, send_buf, recv_buf, send_sem,
             def _gate():
                 pltpu.semaphore_wait(cap_sem, 1)
 
-            pltpu.make_async_remote_copy(
-                src_ref=send_buf.at[slot],
-                dst_ref=recv_buf.at[slot],
-                send_sem=send_sem,
-                recv_sem=recv_sem.at[slot],
-                device_id=right,
-                device_id_type=pltpu.DeviceIdType.LOGICAL,
-            ).start()
+            _rdma(slot).start()
 
         @pl.when(recv_m)
         def _recv():
-            pltpu.make_async_remote_copy(
-                src_ref=send_buf.at[slot],
-                dst_ref=recv_buf.at[slot],
-                send_sem=send_sem,
-                recv_sem=recv_sem.at[slot],
-                device_id=right,
-                device_id_type=pltpu.DeviceIdType.LOGICAL,
-            ).wait_recv()
+            _rdma(slot).wait_recv()
             i = t // Cc
             st = pltpu.make_async_copy(
                 recv_buf.at[slot], o_ref.at[blk_rank(i), seg],
@@ -771,14 +768,7 @@ def _chunked_gather_kernel(x_ref, o_ref, send_buf, recv_buf, send_sem,
 
         @pl.when(send_m)
         def _drain():
-            pltpu.make_async_remote_copy(
-                src_ref=send_buf.at[slot],
-                dst_ref=recv_buf.at[slot],
-                send_sem=send_sem,
-                recv_sem=recv_sem.at[slot],
-                device_id=right,
-                device_id_type=pltpu.DeviceIdType.LOGICAL,
-            ).wait_send()
+            _rdma(slot).wait_send()
 
         return 0
 
@@ -922,6 +912,7 @@ def build_chunked_ring_bcast(comm: Communicator, root: int, dt: dataType,
     eager bcast fanout (``ccl_offload_control.c:923-989``). A compressing
     ``arith`` compresses every hop (pure transport)."""
     _pr._check_multiprocess(comm)
+    segment_bytes = segment_bytes or DEFAULT_SEGMENT_SIZE
     P = comm.world_size
     dtype = to_jax_dtype(dt)
     compressing = arith is not None and arith.is_compressing
@@ -970,6 +961,7 @@ def build_chunked_ring_scatter(comm: Communicator, root: int, dt: dataType,
     scatter fanout (``ccl_offload_control.c:1082-1124``). A compressing
     ``arith`` compresses every hop (pure transport)."""
     _pr._check_multiprocess(comm)
+    segment_bytes = segment_bytes or DEFAULT_SEGMENT_SIZE
     P = comm.world_size
     dtype = to_jax_dtype(dt)
     compressing = arith is not None and arith.is_compressing
@@ -1015,6 +1007,7 @@ def build_chunked_ring_gather(comm: Communicator, root: int, dt: dataType,
     firmware's eager gather relay (``ccl_offload_control.c:1207-1295``).
     A compressing ``arith`` compresses every hop (pure transport)."""
     _pr._check_multiprocess(comm)
+    segment_bytes = segment_bytes or DEFAULT_SEGMENT_SIZE
     P = comm.world_size
     dtype = to_jax_dtype(dt)
     compressing = arith is not None and arith.is_compressing
