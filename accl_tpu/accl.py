@@ -508,9 +508,11 @@ class ACCL:
         algo = algorithms.select(
             operation.alltoall, count * constants.dtype_size(dtype),
             comm, self.config, algorithm)
+        seg = self.config.segment_size
         return (self._key(comm, operation.alltoall, count, dtype,
-                          compress_dtype, algo),
-                lambda: algorithms.build_alltoall(comm, algo, arith))
+                          compress_dtype, algo, seg),
+                lambda: algorithms.build_alltoall(comm, algo, arith,
+                                                  dtype, seg))
 
     def _spec_reduce(self, comm, count: int, dtype: dataType, root: int,
                      function: reduceFunction, compress_dtype, algorithm):

@@ -250,6 +250,19 @@ def measure_scatter(comm, counts: Sequence[int],
         lambda npdt, W, n: [np.full((W, W * n), 1e-6, npdt)])
 
 
+def measure_alltoall(comm, counts: Sequence[int],
+                     algos: Sequence[Algorithm],
+                     dt: dataType = dataType.float32,
+                     reps: int = 3,
+                     segment_bytes: Optional[int] = None
+                     ) -> Dict[Algorithm, List[float]]:
+    return _measure_rooted(
+        lambda algo: algorithms.build_alltoall(comm, algo, None, dt,
+                                               segment_bytes),
+        comm, counts, algos, dt, reps,
+        lambda npdt, W, n: [np.full((W, W * n), 1e-6, npdt)])
+
+
 def _rooted_pallas_crossover(acc, cfg, *, measure, baseline: Algorithm,
                              field: str, pows, reps, dt) -> ACCLConfig:
     """Shared shape of the rooted-op Pallas tuners: on ICI, measure
@@ -306,6 +319,18 @@ def autotune_scatter(acc, cfg: ACCLConfig,
     return _rooted_pallas_crossover(
         acc, cfg, measure=measure_scatter, baseline=Algorithm.FLAT,
         field="scatter_pallas_threshold", pows=pows, reps=reps, dt=dt)
+
+
+def autotune_alltoall(acc, cfg: ACCLConfig,
+                      pows: Sequence[int] = (10, 14, 18, 21),
+                      reps: int = 3,
+                      dt: dataType = dataType.float32) -> ACCLConfig:
+    """On ICI, the measured crossover where the phased-rotation Pallas
+    alltoall beats the best jnp family (XLA one-shot / fused flat trees),
+    written to ``alltoall_pallas_threshold`` (per-edge bytes)."""
+    return _rooted_pallas_crossover(
+        acc, cfg, measure=measure_alltoall, baseline=Algorithm.FLAT,
+        field="alltoall_pallas_threshold", pows=pows, reps=reps, dt=dt)
 
 
 def autotune_flat_tree(acc, cfg: ACCLConfig, reps: int = 3,
@@ -410,6 +435,7 @@ def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
         cfg = autotune_bcast(acc, cfg, pows=pows, reps=reps, dt=dt)
         cfg = autotune_gather(acc, cfg, pows=pows, reps=reps, dt=dt)
         cfg = autotune_scatter(acc, cfg, pows=pows, reps=reps, dt=dt)
+        cfg = autotune_alltoall(acc, cfg, pows=pows, reps=reps, dt=dt)
         cfg = autotune_flat_tree(acc, cfg, reps=reps, dt=dt)
     finally:
         acc.config = saved

@@ -95,6 +95,7 @@ class ACCLConfig:
     bcast_pallas_threshold: int = 8 * 1024 * 1024  # bcast (payload bytes)
     gather_pallas_threshold: int = 8 * 1024 * 1024  # gather (per-block)
     scatter_pallas_threshold: int = 8 * 1024 * 1024  # scatter (per-edge)
+    alltoall_pallas_threshold: int = 8 * 1024 * 1024  # alltoall (per-edge)
 
     # timeout for request waits, in seconds (HOUSEKEEP_TIMEOUT analog)
     timeout: float = 60.0

@@ -64,7 +64,7 @@ _SUPPORTED = {
     operation.scatter: {Algorithm.XLA, Algorithm.FLAT, Algorithm.PALLAS},
     operation.gather: {Algorithm.XLA, Algorithm.FLAT, Algorithm.RING,
                        Algorithm.PALLAS},
-    operation.alltoall: {Algorithm.XLA, Algorithm.FLAT},
+    operation.alltoall: {Algorithm.XLA, Algorithm.FLAT, Algorithm.PALLAS},
 }
 
 
@@ -137,6 +137,7 @@ def select(
             operation.bcast: cfg.bcast_pallas_threshold,
             operation.gather: cfg.gather_pallas_threshold,
             operation.scatter: cfg.scatter_pallas_threshold,
+            operation.alltoall: cfg.alltoall_pallas_threshold,
         }.get(op)
         if pallas_at is not None and nbytes >= pallas_at:
             return Algorithm.PALLAS
@@ -225,7 +226,15 @@ def build_gather(comm, root: int, algo: Algorithm,
 
 
 def build_alltoall(comm, algo: Algorithm,
-                   arith: Optional[ArithConfig]) -> Callable:
+                   arith: Optional[ArithConfig],
+                   dt: Optional[dataType] = None,
+                   segment_bytes: Optional[int] = None) -> Callable:
+    if algo == Algorithm.PALLAS:
+        if dt is None:
+            raise ValueError("Algorithm.PALLAS alltoall requires dt")
+        from . import pallas_chunked
+        return pallas_chunked.build_chunked_ring_alltoall(
+            comm, dt, segment_bytes, arith=arith)
     if algo == Algorithm.FLAT:
         return flat.build_flat_alltoall(comm, arith)
     return primitives.build_alltoall(comm, arith)

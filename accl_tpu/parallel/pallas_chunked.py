@@ -666,6 +666,152 @@ def _chunked_scatter_call(x, *, P: int, C: int, sr: int, dtype, root: int):
 
 
 # ---------------------------------------------------------------------------
+# segmented ring alltoall
+# ---------------------------------------------------------------------------
+
+def _chunked_alltoall_kernel(x_ref, o_ref, bounce, send_buf, recv_buf,
+                             send_sem, recv_sem, load_sem, store_sem,
+                             cap_sem, *, P: int, C: int):
+    """x_ref: (P, C, Sr, 128) chunks by DESTINATION rank in HBM;
+    o_ref: (P, C, Sr, 128) by SOURCE rank; bounce: (2, C, Sr, 128) HBM
+    ping-pong scratch for multi-hop relays (the wrapper discards it).
+
+    Segmented ring alltoall — beyond the reference, whose eager alltoall
+    is itself unimplemented (``ccl_offload_control.c:2123-2218`` raises
+    COLLECTIVE_NOT_IMPLEMENTED on the eager path). Phase ``s`` (1..P-1)
+    rotates every rank's distance-``s`` chunk ``s`` hops right, one
+    uniform single-hop shift of C segments at a time, store-and-forward
+    through the bounce buffer. Per-link traffic is C * P(P-1)/2 segment
+    times — the unidirectional-ring lower bound (every link carries a
+    segment at every step of every phase).
+
+    The step schedule is UNIFORM (no role masks): at global step
+    ``g = C*s(s-1)/2 + h*C + c`` every rank sends segment c of hop h of
+    phase s and receives its counterpart. One global credit chain spans
+    all hops and phases: slots index by g parity, every send from g >= 2
+    gates on a credit, and every recv grants one after its flush lands —
+    so a fast sender cannot overwrite a neighbor's slot that still holds
+    the PREVIOUS hop's tail segments (the cross-hop hazard a per-hop
+    credit reset would reintroduce).
+    """
+    my, left, right = _neighbors(P)
+    _ring_barrier(left, right)
+    Cc = jnp.int32(C)
+    two = jnp.int32(2)
+    N = C * (P * (P - 1) // 2)  # total steps
+
+    def _rdma(slot):
+        return pltpu.make_async_remote_copy(
+            src_ref=send_buf.at[slot],
+            dst_ref=recv_buf.at[slot],
+            send_sem=send_sem,
+            recv_sem=recv_sem.at[slot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    for s in range(1, P):           # phase: chunks travelling s hops
+        base = C * (s * (s - 1) // 2)
+        src_rank = lax.rem(my + jnp.int32(s), jnp.int32(P))
+        dst_slot_rank = lax.rem(my - jnp.int32(s) + jnp.int32(P),
+                                jnp.int32(P))
+
+        def hop(h, _, s=s, base=base, src_rank=src_rank,
+                dst_slot_rank=dst_slot_rank):
+            # loop indices arrive as int64 under x64 on the interpret rung
+            h = jnp.int32(h)
+            first, last = h == 0, h == jnp.int32(s - 1)
+
+            def step(c, _):
+                c = jnp.int32(c)
+                g = jnp.int32(base) + h * Cc + c
+                slot = lax.rem(g, two)
+
+                # fill the send slot (hop 0 from the input chunk, later
+                # hops from the bounce written by the previous hop's recv)
+                @pl.when(first)
+                def _ld_x():
+                    d = pltpu.make_async_copy(
+                        x_ref.at[src_rank, c], send_buf.at[slot], load_sem)
+                    d.start()
+                    d.wait()
+
+                @pl.when(jnp.logical_not(first))
+                def _ld_bounce():
+                    d = pltpu.make_async_copy(
+                        bounce.at[lax.rem(h, two), c], send_buf.at[slot],
+                        load_sem)
+                    d.start()
+                    d.wait()
+
+                @pl.when(g >= two)
+                def _gate():
+                    pltpu.semaphore_wait(cap_sem, 1)
+
+                _rdma(slot).start()
+
+                # receive the counterpart and flush it (final hop: to its
+                # output slot by source rank; else: to the bounce the
+                # NEXT hop's sends will read)
+                _rdma(slot).wait_recv()
+
+                @pl.when(last)
+                def _st_out():
+                    st = pltpu.make_async_copy(
+                        recv_buf.at[slot], o_ref.at[dst_slot_rank, c],
+                        store_sem)
+                    st.start()
+                    st.wait()
+
+                @pl.when(jnp.logical_not(last))
+                def _st_bounce():
+                    st = pltpu.make_async_copy(
+                        recv_buf.at[slot],
+                        bounce.at[lax.rem(h + jnp.int32(1), two), c],
+                        store_sem)
+                    st.start()
+                    st.wait()
+
+                @pl.when(g + two < jnp.int32(N))
+                def _grant():
+                    pltpu.semaphore_signal(
+                        cap_sem, inc=1, device_id=left,
+                        device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+                _rdma(slot).wait_send()
+                return 0
+
+            lax.fori_loop(0, C, step, 0)
+            return 0
+
+        lax.fori_loop(0, s, hop, 0)
+
+
+def _chunked_alltoall_call(x, *, P: int, C: int, sr: int, dtype):
+    out = pl.pallas_call(
+        functools.partial(_chunked_alltoall_kernel, P=P, C=C),
+        out_shape=(jax.ShapeDtypeStruct((P, C, sr, _LANES), dtype),
+                   jax.ShapeDtypeStruct((2, C, sr, _LANES), dtype)),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)),
+        scratch_shapes=[
+            pltpu.VMEM((2, sr, _LANES), dtype),      # send_buf
+            pltpu.VMEM((2, sr, _LANES), dtype),      # recv_buf
+            pltpu.SemaphoreType.DMA,                 # send_sem
+            pltpu.SemaphoreType.DMA((2,)),           # recv_sem
+            pltpu.SemaphoreType.DMA,                 # load_sem
+            pltpu.SemaphoreType.DMA,                 # store_sem
+            pltpu.SemaphoreType.REGULAR,             # cap_sem
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=7),
+        interpret=_interpret_params(),
+    )(x)
+    return out[0]  # bounce scratch discarded
+
+
+# ---------------------------------------------------------------------------
 # segmented ring-relay gather
 # ---------------------------------------------------------------------------
 
@@ -971,6 +1117,58 @@ def build_chunked_ring_scatter(comm: Communicator, root: int, dt: dataType,
     def body(x):
         return chunked_scatter_body(x, P=P, root=root, dtype=dtype,
                                     segment_bytes=segment_bytes, wire=wire)
+
+    return _smap(comm, body, 1)
+
+
+def chunked_alltoall_body(x, *, P: int, dtype, segment_bytes: int,
+                          wire=None):
+    """Per-rank shard_map body: (1, world*n) -> (1, world*n) (HBM-scale).
+    Chunk d of the input goes to rank d; output slot s holds rank s's
+    chunk for this rank. ``wire`` runs every hop in the wire dtype (pure
+    transport); the rank's own chunk never rides the wire."""
+    total = x.shape[-1]
+    n = total // P
+    if P == 1:
+        return x
+    kdt = wire[0] if wire is not None else dtype
+    xin = x.reshape(P, n)
+    wired = (_pr._to_wire(xin, wire) if wire is not None
+             else xin.astype(dtype))
+    C, sr, seg_elems = _geometry(n, kdt, segment_bytes)
+    per = C * seg_elems
+    grid = jnp.zeros((P, per), kdt)
+    grid = lax.dynamic_update_slice(grid, wired, (0, 0))
+    out = _chunked_alltoall_call(
+        grid.reshape(P, C, sr, _LANES), P=P, C=C, sr=sr, dtype=kdt)
+    blocks = out.reshape(P, per)[:, :n]
+    blocks = (_pr._from_wire(blocks, dtype, wire) if wire is not None
+              else blocks).astype(x.dtype)
+    # own chunk stays local (never on the wire; o_ref[my] is unwritten)
+    rank = lax.axis_index(AXIS)
+    mine = lax.dynamic_index_in_dim(xin, rank, axis=0, keepdims=False)
+    blocks = lax.dynamic_update_index_in_dim(
+        blocks, mine.astype(x.dtype), rank, axis=0)
+    return blocks.reshape(1, P * n)
+
+
+def build_chunked_ring_alltoall(comm: Communicator, dt: dataType,
+                                segment_bytes: int, arith=None) -> Callable:
+    """(world, world*n) sharded in -> (world, world*n) sharded out
+    (HBM-scale): phased ring-rotation alltoall. The reference's eager
+    alltoall is unimplemented (COLLECTIVE_NOT_IMPLEMENTED) — this path
+    goes beyond it. A compressing ``arith`` compresses every hop."""
+    _pr._check_multiprocess(comm)
+    segment_bytes = segment_bytes or DEFAULT_SEGMENT_SIZE
+    P = comm.world_size
+    dtype = to_jax_dtype(dt)
+    compressing = arith is not None and arith.is_compressing
+    wire = ((to_jax_dtype(arith.compressed), arith.quant_scale)
+            if compressing else None)
+
+    def body(x):
+        return chunked_alltoall_body(x, P=P, dtype=dtype,
+                                     segment_bytes=segment_bytes, wire=wire)
 
     return _smap(comm, body, 1)
 

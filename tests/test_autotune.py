@@ -211,3 +211,27 @@ def test_autotune_scatter_pallas_crossover_on_ici(accl, monkeypatch):
             operation.scatter, 2 ** 9 * 4, comm, tuned) == Algorithm.PALLAS
     finally:
         accl.config = orig
+
+
+def test_autotune_alltoall_pallas_crossover_on_ici(accl, monkeypatch):
+    """The phased-rotation Pallas alltoall joins the tuned set on ICI."""
+    from accl_tpu.config import TransportBackend
+
+    def fake_measure(comm, cs, algos, dt, reps, segment_bytes=None):
+        assert Algorithm.PALLAS in algos and Algorithm.FLAT in algos
+        t = {a: [1.0, 1.0] for a in algos}
+        t[Algorithm.PALLAS] = [2.0, 0.5]  # wins from index 1 on
+        return t
+
+    monkeypatch.setattr(autotune, "measure_alltoall", fake_measure)
+    orig = accl.config
+    try:
+        accl.config = accl.config.replace(transport=TransportBackend.ICI)
+        tuned = autotune.autotune_alltoall(accl, accl.config, pows=(6, 9),
+                                           reps=1)
+        assert tuned.alltoall_pallas_threshold == 2 ** 9 * 4
+        comm = accl.global_comm()
+        assert algorithms.select(
+            operation.alltoall, 2 ** 9 * 4, comm, tuned) == Algorithm.PALLAS
+    finally:
+        accl.config = orig

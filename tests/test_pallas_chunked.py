@@ -305,6 +305,95 @@ def test_chunked_bcast_through_host_api(accl, rng):
         ici) == Algorithm.PALLAS
 
 
+# C regimes: single segment (no intra-hop pipeline), odd C (slot parity
+# flips across hop boundaries - the global credit chain must absorb it)
+@pytest.mark.parametrize("nseg", [1, 2, 3])
+def test_chunked_alltoall(accl, rng, nseg):
+    comm = accl.global_comm()
+    n = 1024 * nseg  # per-destination chunk
+    x = rng.standard_normal((WORLD, WORLD * n)).astype(np.float32)
+    prog = pallas_chunked.build_chunked_ring_alltoall(
+        comm, dataType.float32, segment_bytes=SEG)
+    out = np.asarray(prog(_put(accl, x)))
+    ref = x.reshape(WORLD, WORLD, n).transpose(1, 0, 2).reshape(
+        WORLD, WORLD * n)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_chunked_alltoall_uneven_payload(accl, rng):
+    comm = accl.global_comm()
+    n = 5000 * WORLD
+    x = rng.standard_normal((WORLD, n)).astype(np.float32)
+    prog = pallas_chunked.build_chunked_ring_alltoall(
+        comm, dataType.float32, segment_bytes=SEG)
+    out = np.asarray(prog(_put(accl, x)))
+    ref = x.reshape(WORLD, WORLD, 5000).transpose(1, 0, 2).reshape(WORLD, n)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_chunked_alltoall_race_free(accl, rng, monkeypatch):
+    """The single global credit chain spanning all hops and phases under
+    the interpret-mode race detector — a per-hop credit reset would let a
+    fast sender overwrite a neighbor's slot still holding the previous
+    hop's tail segments."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    monkeypatch.setattr(
+        pallas_ring, "_interpret_params",
+        lambda: pltpu.InterpretParams(detect_races=True))
+    comm = accl.global_comm()
+    n = 1024 * 3  # odd C: slot parity flips across hop boundaries
+    x = rng.standard_normal((WORLD, WORLD * n)).astype(np.float32)
+    prog = pallas_chunked.build_chunked_ring_alltoall(
+        comm, dataType.float32, segment_bytes=SEG)
+    out = np.asarray(prog(_put(accl, x)))
+    ref = x.reshape(WORLD, WORLD, n).transpose(1, 0, 2).reshape(
+        WORLD, WORLD * n)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_chunked_alltoall_compressed_wire(accl, rng):
+    """bf16 wire on every rotation hop; each rank's own chunk never rides
+    the wire and stays exact."""
+    from accl_tpu import ArithConfig
+    comm = accl.global_comm()
+    arith = ArithConfig(dataType.float32, dataType.bfloat16,
+                        arith_is_compressed=False)
+    n = 1024 * 2
+    x = rng.integers(-10, 10, (WORLD, WORLD * n)).astype(np.float32)
+    for r in range(WORLD):
+        x[r, r * n:(r + 1) * n] += 0.33  # own chunks: not bf16-exact
+    prog = pallas_chunked.build_chunked_ring_alltoall(
+        comm, dataType.float32, segment_bytes=SEG, arith=arith)
+    out = np.asarray(prog(_put(accl, x)))
+    ref = x.reshape(WORLD, WORLD, n).transpose(1, 0, 2).reshape(
+        WORLD, WORLD * n)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_chunked_alltoall_through_host_api(accl, rng):
+    """Algorithm.PALLAS through ACCL.alltoall (and AUTO engages it on ICI
+    above alltoall_pallas_threshold)."""
+    from accl_tpu.constants import operation
+    from accl_tpu.parallel import algorithms
+    from accl_tpu.config import TransportBackend
+
+    count = 2048
+    send = accl.create_buffer(count * WORLD, dataType.float32)
+    recv = accl.create_buffer(count * WORLD, dataType.float32)
+    send.host[:] = rng.standard_normal(send.host.shape).astype(np.float32)
+    accl.alltoall(send, recv, count, algorithm=Algorithm.PALLAS)
+    ref = send.host.reshape(WORLD, WORLD, count).transpose(1, 0, 2)
+    np.testing.assert_array_equal(
+        recv.host, ref.reshape(WORLD, WORLD * count))
+
+    ici = accl.config.replace(transport=TransportBackend.ICI)
+    comm = accl.global_comm()
+    assert algorithms.select(
+        operation.alltoall, ici.alltoall_pallas_threshold, comm,
+        ici) == Algorithm.PALLAS
+
+
 @pytest.mark.parametrize("nseg", [1, 2, 3, 4])
 @pytest.mark.parametrize("root", [0, 3])
 def test_chunked_scatter(accl, rng, nseg, root):
