@@ -254,18 +254,33 @@ class ACCL:
         self._arith_configs[(cfg.uncompressed, cfg.compressed)] = cfg
 
     def autotune(self, pows: Optional[Sequence[int]] = None,
-                 reps: int = 3) -> None:
+                 reps: int = 3,
+                 cache_path: Optional[str] = None) -> None:
         """Re-derive EVERY AUTO-selection threshold by measurement on the
         live mesh — allreduce ring/hier(/pallas on ICI) crossovers, the
-        allgather/reduce_scatter ring crossovers, and the flat-tree
-        rank/count/fan-in registers (adaptive tuning registers — see
-        :mod:`accl_tpu.bench.autotune`). Drops the program cache so later
-        calls re-select with the tuned config."""
+        allgather/reduce_scatter ring crossovers, the rooted-op Pallas
+        engage points, and the flat-tree rank/count/fan-in registers
+        (adaptive tuning registers — see :mod:`accl_tpu.bench.autotune`).
+        Drops the program cache so later calls re-select with the tuned
+        config.
+
+        ``cache_path`` makes the tuning durable like the reference's
+        per-deployment register write (accl.cpp:1214-1224): if the file
+        exists it is loaded INSTEAD of measuring; otherwise the measured
+        config is saved there for the next session's bring-up."""
+        import os
+
         from .bench import autotune as _at
+        if cache_path and os.path.exists(cache_path):
+            self.config = ACCLConfig.load(cache_path)
+            self._programs.clear()
+            return
         kw = {"reps": reps}
         if pows is not None:
             kw["pows"] = pows
         self.config = _at.autotune_session(self, **kw)
+        if cache_path:
+            self.config.save(cache_path)
         self._programs.clear()
 
     def config_call(self, function: constants.cfgFunc,

@@ -122,3 +122,35 @@ class ACCLConfig:
 
     def replace(self, **kw) -> "ACCLConfig":
         return dataclasses.replace(self, **kw)
+
+    # -- persistence (the init-time tuning-register write, durable) -------
+    # The reference bakes its tuned thresholds into each deployment's init
+    # sequence (accl.cpp:1214-1224 writes them to exchange memory every
+    # bring-up). The TPU analog: measure once with ACCL.autotune(), save,
+    # and load at the next session's init instead of re-measuring.
+
+    def save(self, path: str) -> None:
+        """Write the config as JSON (enums by value, None transport kept)."""
+        import json
+        d = dataclasses.asdict(self)
+        d["algorithm"] = self.algorithm.value
+        d["transport"] = self.transport.value if self.transport else None
+        with open(path, "w") as f:
+            json.dump(d, f, indent=1, sort_keys=True)
+
+    @classmethod
+    def load(cls, path: str) -> "ACCLConfig":
+        """Read a config written by :meth:`save`. Unknown keys are
+        rejected (a stale file from a different version should fail
+        loudly, not half-apply)."""
+        import json
+        with open(path) as f:
+            d = json.load(f)
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown config keys {sorted(unknown)}")
+        d["algorithm"] = Algorithm(d.get("algorithm", Algorithm.AUTO.value))
+        t = d.get("transport")
+        d["transport"] = TransportBackend(t) if t else None
+        return cls(**d)

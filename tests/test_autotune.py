@@ -213,6 +213,50 @@ def test_autotune_scatter_pallas_crossover_on_ici(accl, monkeypatch):
         accl.config = orig
 
 
+def test_config_save_load_roundtrip(tmp_path):
+    """ACCLConfig persists as JSON and loads back identical — the durable
+    tuning-register analog (accl.cpp:1214-1224 re-writes per bring-up;
+    we measure once and reload)."""
+    from accl_tpu.config import ACCLConfig, Algorithm, TransportBackend
+    cfg = ACCLConfig().replace(
+        ring_threshold=12345, algorithm=Algorithm.RING,
+        transport=TransportBackend.ICI, gather_flat_tree_max_fanin=3)
+    path = str(tmp_path / "tuned.json")
+    cfg.save(path)
+    back = ACCLConfig.load(path)
+    assert back == cfg
+    # stale files from other versions fail loudly, not half-apply
+    import json
+    d = json.load(open(path))
+    d["no_such_knob"] = 1
+    json.dump(d, open(path, "w"))
+    with pytest.raises(ValueError, match="no_such_knob"):
+        ACCLConfig.load(path)
+
+
+def test_autotune_cache_path(accl, monkeypatch, tmp_path):
+    """autotune(cache_path=...) measures once and saves; a second session
+    loads the file instead of re-measuring."""
+    from accl_tpu.config import ACCLConfig
+    calls = []
+
+    def fake_session(acc, **kw):
+        calls.append(1)
+        return acc.config.replace(ring_threshold=777)
+
+    monkeypatch.setattr(autotune, "autotune_session", fake_session)
+    path = str(tmp_path / "tuned.json")
+    orig = accl.config
+    try:
+        accl.autotune(cache_path=path)
+        assert accl.config.ring_threshold == 777 and len(calls) == 1
+        accl.config = orig
+        accl.autotune(cache_path=path)  # loads, does not re-measure
+        assert accl.config.ring_threshold == 777 and len(calls) == 1
+    finally:
+        accl.config = orig
+
+
 def test_autotune_alltoall_pallas_crossover_on_ici(accl, monkeypatch):
     """The phased-rotation Pallas alltoall joins the tuned set on ICI."""
     from accl_tpu.config import TransportBackend

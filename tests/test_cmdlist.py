@@ -232,3 +232,23 @@ def test_cmdlist_picks_up_host_writes_each_execute(accl, rng):
     x.host[:] = second   # host write AFTER device materialization
     cl.execute()
     np.testing.assert_array_equal(y.host, np.tile(second.sum(0), (WORLD, 1)))
+
+
+def test_cmdlist_fuses_chunked_pallas_step(accl, rng):
+    """A recorded list mixing a Pallas chunked collective with jnp-family
+    steps compiles and launches as one fused program — the segmented
+    kernels are ordinary steps to the CommandList because the shared
+    _spec_* builders route them (accl_hls.h chained-command analog)."""
+    from accl_tpu import Algorithm
+    n = 2048
+    x = accl.create_buffer(n, dataType.float32)
+    y = accl.create_buffer(n, dataType.float32)
+    x.host[:] = rng.standard_normal((WORLD, n)).astype(np.float32)
+    rootdata = x.host[2].copy()
+    cl = accl.command_list()
+    cl.bcast(x, n, root=2, algorithm=Algorithm.PALLAS)
+    cl.allreduce(x, y, n, reduceFunction.SUM)
+    cl.execute()
+    np.testing.assert_array_equal(x.host, np.tile(rootdata, (WORLD, 1)))
+    np.testing.assert_allclose(
+        y.host, np.tile(rootdata * WORLD, (WORLD, 1)), rtol=1e-5)
