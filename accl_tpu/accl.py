@@ -265,22 +265,78 @@ class ACCL:
         config.
 
         ``cache_path`` makes the tuning durable like the reference's
-        per-deployment register write (accl.cpp:1214-1224): if the file
-        exists it is loaded INSTEAD of measuring; otherwise the measured
-        config is saved there for the next session's bring-up."""
-        import os
-
+        per-deployment register write (accl.cpp:1214-1224): a valid cache
+        for THIS deployment (world size + transport fingerprint) is
+        loaded instead of measuring; anything else — absent file,
+        truncated JSON, different schema version, different mesh — falls
+        back to measuring and overwrites the cache (atomic write). In a
+        multi-process session process 0 alone reads the file and
+        publishes the load-or-measure decision through the coordination
+        service, so every controller takes the SAME branch — a racing
+        exists-check would let one process load-and-return while the
+        rest entered the collective measurement programs, hanging the
+        mesh."""
         from .bench import autotune as _at
-        if cache_path and os.path.exists(cache_path):
-            self.config = ACCLConfig.load(cache_path)
+
+        def measure() -> ACCLConfig:
+            kw = {"reps": reps}
+            if pows is not None:
+                kw["pows"] = pows
+            return _at.autotune_session(self, **kw)
+
+        if not cache_path:
+            self.config = measure()
             self._programs.clear()
             return
-        kw = {"reps": reps}
-        if pows is not None:
-            kw["pows"] = pows
-        self.config = _at.autotune_session(self, **kw)
-        if cache_path:
-            self.config.save(cache_path)
+
+        fp = {"world": self.world_size,
+              "transport": (self.config.transport.value
+                            if self.config.transport else None),
+              "schema": 1}
+
+        def try_read() -> Optional[str]:
+            """Validated cache content, or None for any reason the cache
+            cannot be used (absent / truncated / stale schema / other
+            deployment) — all of which mean 'measure and overwrite'."""
+            import os
+            if not os.path.exists(cache_path):
+                return None
+            try:
+                with open(cache_path) as f:
+                    text = f.read()
+                ACCLConfig.from_json(text, expect_fingerprint=fp)
+                return text
+            except Exception as e:
+                get_logger("accl").warning(
+                    "autotune cache %s unusable (%s); re-measuring",
+                    cache_path, e)
+                return None
+
+        if self._fabric is not None:
+            # decision must be mesh-uniform: p0 decides, everyone follows
+            from . import multiproc as _mp
+            client = _mp._client()
+            self._tune_epoch = getattr(self, "_tune_epoch", 0) + 1
+            key = f"accl/tune/{self._comm_tag(self.comms[0])}/{self._tune_epoch}"
+            if jax.process_index() == 0:
+                text = try_read()
+                self._fabric._kset(client, key,
+                                   "L" + text if text else "M")
+            decision = client.blocking_key_value_get(
+                key, self._fabric._timeout_ms())
+            if decision.startswith("L"):
+                self.config = ACCLConfig.from_json(decision[1:])
+            else:
+                self.config = measure()
+                if jax.process_index() == 0:
+                    self.config.save(cache_path, fingerprint=fp)
+        else:
+            text = try_read()
+            if text is not None:
+                self.config = ACCLConfig.from_json(text)
+            else:
+                self.config = measure()
+                self.config.save(cache_path, fingerprint=fp)
         self._programs.clear()
 
     def config_call(self, function: constants.cfgFunc,

@@ -129,28 +129,55 @@ class ACCLConfig:
     # bring-up). The TPU analog: measure once with ACCL.autotune(), save,
     # and load at the next session's init instead of re-measuring.
 
-    def save(self, path: str) -> None:
-        """Write the config as JSON (enums by value, None transport kept)."""
-        import json
+    def to_json(self, fingerprint: Optional[dict] = None) -> str:
         d = dataclasses.asdict(self)
         d["algorithm"] = self.algorithm.value
         d["transport"] = self.transport.value if self.transport else None
-        with open(path, "w") as f:
-            json.dump(d, f, indent=1, sort_keys=True)
+        if fingerprint is not None:
+            d["_fingerprint"] = fingerprint
+        import json
+        return json.dumps(d, indent=1, sort_keys=True)
 
     @classmethod
-    def load(cls, path: str) -> "ACCLConfig":
-        """Read a config written by :meth:`save`. Unknown keys are
-        rejected (a stale file from a different version should fail
-        loudly, not half-apply)."""
+    def from_json(cls, text: str,
+                  expect_fingerprint: Optional[dict] = None) -> "ACCLConfig":
+        """Parse :meth:`to_json` output. The field set must match EXACTLY
+        — unknown keys (newer file) and missing keys (older file) both
+        raise, so a cache from a different version never half-applies.
+        ``expect_fingerprint`` additionally rejects a file tuned on a
+        different deployment (mesh/world/transport mismatch)."""
         import json
-        with open(path) as f:
-            d = json.load(f)
+        d = json.loads(text)
+        fp = d.pop("_fingerprint", None)
+        if expect_fingerprint is not None and fp != expect_fingerprint:
+            raise ValueError(
+                f"config fingerprint {fp} does not match this session "
+                f"{expect_fingerprint}")
         known = {f.name for f in dataclasses.fields(cls)}
-        unknown = set(d) - known
-        if unknown:
-            raise ValueError(f"unknown config keys {sorted(unknown)}")
-        d["algorithm"] = Algorithm(d.get("algorithm", Algorithm.AUTO.value))
-        t = d.get("transport")
+        unknown, missing = set(d) - known, known - set(d)
+        if unknown or missing:
+            raise ValueError(
+                f"config schema mismatch: unknown={sorted(unknown)} "
+                f"missing={sorted(missing)}")
+        d["algorithm"] = Algorithm(d["algorithm"])
+        t = d["transport"]
         d["transport"] = TransportBackend(t) if t else None
         return cls(**d)
+
+    def save(self, path: str, fingerprint: Optional[dict] = None) -> None:
+        """Write the config as JSON, atomically (tmp + rename): a crash
+        mid-save must never leave a truncated file that bricks the next
+        bring-up's load."""
+        import os
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(self.to_json(fingerprint))
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str,
+             expect_fingerprint: Optional[dict] = None) -> "ACCLConfig":
+        """Read a config written by :meth:`save` (see :meth:`from_json`
+        for the exact-schema and fingerprint rules)."""
+        with open(path) as f:
+            return cls.from_json(f.read(), expect_fingerprint)

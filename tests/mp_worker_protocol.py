@@ -260,6 +260,34 @@ def main() -> int:
         fab.barrier(name="t8")
     acc.barrier()  # the next round still synchronizes
 
+    # ---- 9. autotune cache decision is mesh-uniform --------------------
+    # p0 alone reads the cache file and publishes load-vs-measure through
+    # the coordination service; a racing per-process exists-check could
+    # send one controller down the load path while others entered the
+    # collective measurement programs — a mesh-wide hang.
+    import os as _os
+
+    from accl_tpu.bench import autotune as _at
+    cache = "/tmp/accl_tune_%s.json" % _os.environ[
+        "ACCL_COORDINATOR"].replace(":", "_").replace("/", "_")
+    if me == 0 and _os.path.exists(cache):
+        _os.unlink(cache)
+    acc.barrier()
+    measured = []
+    _at.autotune_session = lambda a, **kw: (
+        measured.append(1) or a.config.replace(ring_threshold=555))
+    saved_cfg = acc.config
+    acc.autotune(cache_path=cache)  # first: every process measures
+    assert acc.config.ring_threshold == 555 and len(measured) == 1
+    acc.config = saved_cfg
+    acc.barrier()  # p0's save must land before the reload round
+    acc.autotune(cache_path=cache)  # second: every process LOADS
+    assert acc.config.ring_threshold == 555 and len(measured) == 1, \
+        "cache reload re-measured (decision not mesh-uniform)"
+    acc.config = saved_cfg
+    print(f"[p{me}] autotune cache decision ok", flush=True)
+    acc.barrier()
+
     print(f"[p{me}] MP-PROTOCOL-OK", flush=True)
     return 0
 

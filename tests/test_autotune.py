@@ -214,9 +214,11 @@ def test_autotune_scatter_pallas_crossover_on_ici(accl, monkeypatch):
 
 
 def test_config_save_load_roundtrip(tmp_path):
-    """ACCLConfig persists as JSON and loads back identical — the durable
-    tuning-register analog (accl.cpp:1214-1224 re-writes per bring-up;
-    we measure once and reload)."""
+    """ACCLConfig persists as JSON (atomically) and loads back identical
+    — the durable tuning-register analog (accl.cpp:1214-1224 re-writes
+    per bring-up; we measure once and reload). A file whose schema does
+    not match EXACTLY (extra OR missing keys — a cache from a different
+    version) fails loudly instead of half-applying."""
     from accl_tpu.config import ACCLConfig, Algorithm, TransportBackend
     cfg = ACCLConfig().replace(
         ring_threshold=12345, algorithm=Algorithm.RING,
@@ -225,18 +227,29 @@ def test_config_save_load_roundtrip(tmp_path):
     cfg.save(path)
     back = ACCLConfig.load(path)
     assert back == cfg
-    # stale files from other versions fail loudly, not half-apply
     import json
     d = json.load(open(path))
     d["no_such_knob"] = 1
     json.dump(d, open(path, "w"))
     with pytest.raises(ValueError, match="no_such_knob"):
         ACCLConfig.load(path)
+    d.pop("no_such_knob")
+    d.pop("ring_threshold")  # older version missing a field: also loud
+    json.dump(d, open(path, "w"))
+    with pytest.raises(ValueError, match="ring_threshold"):
+        ACCLConfig.load(path)
+    # fingerprint pins the deployment the tuning belongs to
+    cfg.save(path, fingerprint={"world": 8})
+    assert ACCLConfig.load(path, expect_fingerprint={"world": 8}) == cfg
+    with pytest.raises(ValueError, match="fingerprint"):
+        ACCLConfig.load(path, expect_fingerprint={"world": 16})
 
 
 def test_autotune_cache_path(accl, monkeypatch, tmp_path):
     """autotune(cache_path=...) measures once and saves; a second session
-    loads the file instead of re-measuring."""
+    loads the file instead of re-measuring. An unusable cache — crash-
+    truncated JSON or one fingerprinted for a different deployment —
+    falls back to measuring and overwrites, never bricking bring-up."""
     from accl_tpu.config import ACCLConfig
     calls = []
 
@@ -253,6 +266,24 @@ def test_autotune_cache_path(accl, monkeypatch, tmp_path):
         accl.config = orig
         accl.autotune(cache_path=path)  # loads, does not re-measure
         assert accl.config.ring_threshold == 777 and len(calls) == 1
+
+        # truncated file (crash mid-write of a non-atomic writer)
+        with open(path, "w") as f:
+            f.write('{"ring_thresh')
+        accl.config = orig
+        accl.autotune(cache_path=path)
+        assert accl.config.ring_threshold == 777 and len(calls) == 2
+        # ...and the fallback rewrote a valid cache
+        accl.config = orig
+        accl.autotune(cache_path=path)
+        assert len(calls) == 2
+
+        # cache tuned on a different deployment (wrong fingerprint)
+        accl.config.save(path, fingerprint={"world": 99, "transport": "x",
+                                            "schema": 1})
+        accl.config = orig
+        accl.autotune(cache_path=path)
+        assert len(calls) == 3  # re-measured, not silently adopted
     finally:
         accl.config = orig
 
