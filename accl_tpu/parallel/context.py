@@ -219,7 +219,8 @@ def zigzag_unlayout(x, world: int):
 
 
 def build_zigzag_ring_attention(comm: Communicator,
-                                scale: Optional[float] = None) -> Callable:
+                                scale: Optional[float] = None,
+                                use_flash: bool = False) -> Callable:
     """Load-balanced CAUSAL ring attention (zigzag block order).
 
     Plain causal ring attention is imbalanced: rank r has r+1 live steps
@@ -242,9 +243,98 @@ def build_zigzag_ring_attention(comm: Communicator,
     global positions, so the result equals dense causal attention on the
     un-permuted sequence (see ``zigzag_unlayout``). K/V rotate one hop a
     step like the plain ring — the same neighbor-only ICI schedule.
+
+    ``use_flash``: the zigzag schedule is exactly flash-shaped — every
+    half-block pair is either a FULL attention (cross-half, strictly
+    earlier positions) or an ALIGNED diagonal (own half at step 0), so
+    each pair runs through the fused kernel
+    (:func:`accl_tpu.ops.flash.flash_attention_lse`, ``causal=False`` /
+    ``causal=True`` respectively) and merges by log-sum-exp weighting; no
+    arbitrary positional mask is ever needed. Requires the per-rank HALF
+    block (n/2) to be a multiple of the 128-wide flash blocks.
     """
     world = comm.world_size
     perm = _fwd_perm(world)
+
+    if use_flash:
+        import jax as _jax
+        from ..ops import flash as _flash
+        # same interpret-mode caveat as build_ring_attention: lax.cond
+        # around interpret-mode pallas is pathologically slow to build on
+        # the CPU rung, so there both branches run and lse masking picks
+        # one; on real TPU the cond skips the dead branch's kernel
+        skip_via_cond = _jax.default_backend() == "tpu"
+
+        def body_flash(q, k, v):
+            q, k, v = q[0], k[0], v[0]
+            n, d = q.shape
+            if n % 2:
+                raise ValueError(
+                    f"zigzag needs an even per-rank block, got {n}")
+            h = n // 2
+            sc = scale if scale is not None else 1.0 / (d ** 0.5)
+            rank = lax.axis_index(AXIS)
+            qA, qB = q[:h], q[h:]
+            oA = jnp.zeros((h, d), _F32)
+            lA = jnp.full((h,), -1e30, _F32)
+            oB = jnp.zeros((h, d), _F32)
+            lB = jnp.full((h,), -1e30, _F32)
+            kb, vb = k, v
+            for s in range(world):
+                src = jnp.mod(rank - s, world)
+                kvA = (kb[:h], vb[:h])
+                kvB = (kb[h:], vb[h:])
+
+                # pair 1: late q-half vs arriving early kv-half — always
+                # strictly earlier positions, a full attend
+                o_s, l_s = _flash.flash_attention_lse(
+                    qB, kvA[0], kvA[1], causal=False, scale=sc)
+                oB, lB = _merge_partials(oB, lB, o_s.astype(_F32), l_s)
+
+                if s == 0:
+                    # own kv: both diagonals are ALIGNED causal blocks
+                    o_s, l_s = _flash.flash_attention_lse(
+                        qA, kvA[0], kvA[1], causal=True, scale=sc)
+                    oA, lA = _merge_partials(oA, lA, o_s.astype(_F32), l_s)
+                    o_s, l_s = _flash.flash_attention_lse(
+                        qB, kvB[0], kvB[1], causal=True, scale=sc)
+                    oB, lB = _merge_partials(oB, lB, o_s.astype(_F32), l_s)
+                else:
+                    # equal-shape full attends: early-vs-early when the
+                    # arriving block is older (src < rank, strictly
+                    # earlier positions), late-vs-late otherwise
+                    take_early = src <= rank
+
+                    def early(st, kvA=kvA):
+                        o_s, l_s = _flash.flash_attention_lse(
+                            qA, kvA[0], kvA[1], causal=False, scale=sc)
+                        (a, la), b = st
+                        return (_merge_partials(
+                            a, la, o_s.astype(_F32), l_s), b)
+
+                    def late(st, kvB=kvB):
+                        o_s, l_s = _flash.flash_attention_lse(
+                            qB, kvB[0], kvB[1], causal=False, scale=sc)
+                        a, (b, lb) = st
+                        return (a, _merge_partials(
+                            b, lb, o_s.astype(_F32), l_s))
+
+                    if skip_via_cond:
+                        (oA, lA), (oB, lB) = lax.cond(
+                            take_early, early, late, ((oA, lA), (oB, lB)))
+                    else:
+                        (oA2, lA2), _ = early(((oA, lA), (oB, lB)))
+                        _, (oB2, lB2) = late(((oA, lA), (oB, lB)))
+                        oA = jnp.where(take_early, oA2, oA)
+                        lA = jnp.where(take_early, lA2, lA)
+                        oB = jnp.where(take_early, oB, oB2)
+                        lB = jnp.where(take_early, lB, lB2)
+                if s + 1 < world:
+                    kb = lax.ppermute(kb, AXIS, perm)
+                    vb = lax.ppermute(vb, AXIS, perm)
+            return jnp.concatenate([oA, oB], 0).astype(q.dtype)[None]
+
+        return _smap(comm, body_flash, 3)
 
     def body(q, k, v):
         q, k, v = q[0], k[0], v[0]                    # (n, d): two halves
