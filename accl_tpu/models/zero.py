@@ -1,0 +1,132 @@
+"""ZeRO-style fully-sharded data parallelism (the FSDP family).
+
+The reference is the layer below model parallelism (SURVEY.md §2.6); this
+module is the canonical training-side CONSUMER of the two collectives
+whose perf core this framework builds — allgather and reduce-scatter:
+
+* parameters and Adam state live permanently SHARDED 1/world per rank
+  (the ZeRO memory win: a rank never holds full optimizer state);
+* each step: ``all_gather`` the parameter shards -> forward/backward on
+  the local batch -> ``psum_scatter`` the gradients (every rank receives
+  only ITS shard, already dp-reduced) -> Adam update on the shard alone;
+* everything is ONE jitted shard_map program over the communicator's
+  mesh axis — compute fused with collectives, host only launches, the
+  vadd_put pattern (``driver/hls/accl_hls.h``) scaled to a real
+  optimizer step.
+
+On hardware the two collectives are exactly the ops served by the
+chunked Pallas kernels at HBM scale, so the same autotuned thresholds
+govern a training step's communication.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import PartitionSpec as P
+
+from ..communicator import Communicator
+from ..parallel.primitives import AXIS, _smap
+from . import mlp
+
+
+class ZeroState(NamedTuple):
+    """Per-rank shards of the flat parameter/optimizer vectors, plus the
+    replicated Adam step counter. Global shapes: (world, n_pad/world)."""
+
+    w: jax.Array
+    m: jax.Array
+    v: jax.Array
+    t: jax.Array  # () int32, replicated
+
+
+def _template(d_model: int, d_hidden: int) -> Tuple[int, callable]:
+    """(flat length, unravel) for the MLP parameter pytree."""
+    p = mlp.init_params(jax.random.PRNGKey(0), d_model, d_hidden)
+    vec, unravel = ravel_pytree(p)
+    return vec.shape[0], unravel
+
+
+def init_zero_state(key, comm: Communicator, d_model: int,
+                    d_hidden: int) -> ZeroState:
+    """Initialize parameters and shard them (with zeroed Adam moments)
+    across the communicator — 1/world of every vector per rank."""
+    world = comm.world_size
+    n, _ = _template(d_model, d_hidden)
+    vec, _ = ravel_pytree(mlp.init_params(key, d_model, d_hidden))
+    pad = (-n) % world
+    flat = np.concatenate([np.asarray(vec), np.zeros(pad, np.float32)])
+    shards = flat.reshape(world, -1)
+    put = lambda a: jax.device_put(a, comm.sharding())
+    return ZeroState(
+        w=put(shards),
+        m=put(np.zeros_like(shards)),
+        v=put(np.zeros_like(shards)),
+        t=jnp.zeros((), jnp.int32),
+    )
+
+
+def build_zero_train_step(comm: Communicator, d_model: int, d_hidden: int,
+                          lr: float = 1e-2, b1: float = 0.9,
+                          b2: float = 0.999, eps: float = 1e-8):
+    """``step(state, x, y) -> (state, loss)`` — one fused ZeRO step.
+
+    ``x``/``y``: (world, batch, d_model) global arrays, batch sharded
+    over the communicator axis (pure dp; compose with the tp MLP for 2-D).
+    """
+    world = comm.world_size
+    n, unravel = _template(d_model, d_hidden)
+
+    def body(w, m, v, t, x, y):
+        w, m, v = w[0], m[0], v[0]          # (n_pad/world,) local shards
+        x, y = x[0], y[0]                   # (batch, d) local batch
+        full = lax.all_gather(w, AXIS, tiled=True)     # (n_pad,)
+        params = unravel(full[:n])
+
+        def loss_fn(p):
+            h = jnp.dot(x, p.w1, preferred_element_type=jnp.float32) + p.b1
+            h = jax.nn.gelu(h)
+            out = jnp.dot(h, p.w2, preferred_element_type=jnp.float32) + p.b2
+            return jnp.mean((out - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        gvec = ravel_pytree(grads)[0]
+        gvec = jnp.concatenate(
+            [gvec, jnp.zeros((w.shape[0] * world - n,), gvec.dtype)])
+        # dp-reduce AND shard in one collective: each rank receives only
+        # its slice of the mean gradient (ZeRO's defining move)
+        gsh = lax.psum_scatter(gvec, AXIS, tiled=True) / world
+
+        t_new = t + 1
+        m_new = b1 * m + (1 - b1) * gsh
+        v_new = b2 * v + (1 - b2) * gsh * gsh
+        mhat = m_new / (1 - b1 ** t_new.astype(jnp.float32))
+        vhat = v_new / (1 - b2 ** t_new.astype(jnp.float32))
+        w_new = w - lr * mhat / (jnp.sqrt(vhat) + eps)
+        loss = lax.psum(loss, AXIS) / world
+        return (w_new[None], m_new[None], v_new[None], t_new, loss)
+
+    prog = _smap(
+        comm, body, 6,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(), P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(), P()),
+    )
+
+    def step(state: ZeroState, x, y):
+        w, m, v, t, loss = prog(state.w, state.m, state.v, state.t, x, y)
+        return ZeroState(w, m, v, t), loss
+
+    return step
+
+
+def gather_params(state: ZeroState, comm: Communicator, d_model: int,
+                  d_hidden: int) -> mlp.MLPParams:
+    """Materialize the full parameter pytree from the shards (host-side
+    convenience for eval/checkpointing)."""
+    n, unravel = _template(d_model, d_hidden)
+    flat = np.asarray(state.w).reshape(-1)[:n]
+    return unravel(jnp.asarray(flat))
