@@ -47,6 +47,11 @@ from .utils.logging import get_logger
 
 log = get_logger("accl")
 
+#: process-global autotune-decision epoch — survives ACCL instance
+#: teardown because the coordination-service KV (where decisions are
+#: published under accl/tune/<epoch>) does too
+_tune_epoch = 0
+
 BufLike = Union[Buffer, BufferSlice]
 
 
@@ -294,34 +299,42 @@ class ACCL:
                             if self.config.transport else None),
               "schema": 1}
 
-        def try_read() -> Optional[str]:
-            """Validated cache content, or None for any reason the cache
-            cannot be used (absent / truncated / stale schema / other
-            deployment) — all of which mean 'measure and overwrite'."""
+        def try_read():
+            """(validated config, raw text), or (None, None) for any
+            reason the cache cannot be used (absent / truncated / stale
+            schema / other deployment) — all of which mean 'measure and
+            overwrite'."""
             import os
             if not os.path.exists(cache_path):
-                return None
+                return None, None
             try:
                 with open(cache_path) as f:
                     text = f.read()
-                ACCLConfig.from_json(text, expect_fingerprint=fp)
-                return text
+                return ACCLConfig.from_json(text, expect_fingerprint=fp), text
             except Exception as e:
                 get_logger("accl").warning(
                     "autotune cache %s unusable (%s); re-measuring",
                     cache_path, e)
-                return None
+                return None, None
 
         if self._fabric is not None:
-            # decision must be mesh-uniform: p0 decides, everyone follows
+            # decision must be mesh-uniform: p0 decides, everyone
+            # follows. The decision key counts with a PROCESS-GLOBAL
+            # epoch (not a per-instance one): the coordination service's
+            # KV outlives ACCL instances within a job, so a fresh
+            # instance restarting at epoch 1 would read a stale earlier
+            # instance's decision (and p0's re-set of the existing key
+            # would fail) — the SPMD call discipline makes the global
+            # counter advance identically on every process
+            global _tune_epoch
+            _tune_epoch += 1
             from . import multiproc as _mp
             client = _mp._client()
-            self._tune_epoch = getattr(self, "_tune_epoch", 0) + 1
-            key = f"accl/tune/{self._comm_tag(self.comms[0])}/{self._tune_epoch}"
+            key = f"accl/tune/{_tune_epoch}"
             if jax.process_index() == 0:
-                text = try_read()
+                cfg, text = try_read()
                 self._fabric._kset(client, key,
-                                   "L" + text if text else "M")
+                                   "L" + text if cfg is not None else "M")
             decision = client.blocking_key_value_get(
                 key, self._fabric._timeout_ms())
             if decision.startswith("L"):
@@ -331,9 +344,9 @@ class ACCL:
                 if jax.process_index() == 0:
                     self.config.save(cache_path, fingerprint=fp)
         else:
-            text = try_read()
-            if text is not None:
-                self.config = ACCLConfig.from_json(text)
+            cfg, _ = try_read()
+            if cfg is not None:
+                self.config = cfg
             else:
                 self.config = measure()
                 self.config.save(cache_path, fingerprint=fp)

@@ -20,6 +20,7 @@ govern a training step's communication.
 """
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple, Tuple
 
 import jax
@@ -44,8 +45,12 @@ class ZeroState(NamedTuple):
     t: jax.Array  # () int32, replicated
 
 
+@functools.lru_cache(maxsize=None)
 def _template(d_model: int, d_hidden: int) -> Tuple[int, callable]:
-    """(flat length, unravel) for the MLP parameter pytree."""
+    """(flat length, unravel) for the MLP parameter pytree — cached per
+    geometry so the throwaway sizing init runs at most once per process
+    (init_zero_state derives its own from the real init and never calls
+    this)."""
     p = mlp.init_params(jax.random.PRNGKey(0), d_model, d_hidden)
     vec, unravel = ravel_pytree(p)
     return vec.shape[0], unravel
@@ -56,8 +61,8 @@ def init_zero_state(key, comm: Communicator, d_model: int,
     """Initialize parameters and shard them (with zeroed Adam moments)
     across the communicator — 1/world of every vector per rank."""
     world = comm.world_size
-    n, _ = _template(d_model, d_hidden)
     vec, _ = ravel_pytree(mlp.init_params(key, d_model, d_hidden))
+    n = vec.shape[0]
     pad = (-n) % world
     flat = np.concatenate([np.asarray(vec), np.zeros(pad, np.float32)])
     shards = flat.reshape(world, -1)
