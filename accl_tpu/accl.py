@@ -608,10 +608,11 @@ class ACCL:
             comm, self.config, algorithm, count=count)
         fanin = (self.config.gather_flat_tree_max_fanin
                  if algo == Algorithm.FLAT else 0)
+        seg = self.config.segment_size
         return (self._key(comm, operation.reduce, count, dtype, root,
-                          function, compress_dtype, algo, fanin),
+                          function, compress_dtype, algo, fanin, seg),
                 lambda: algorithms.build_reduce(comm, root, function, dtype,
-                                                algo, arith, fanin))
+                                                algo, arith, fanin, seg))
 
     def _spec_allreduce(self, comm, count: int, dtype: dataType,
                         function: reduceFunction, compress_dtype, algorithm):

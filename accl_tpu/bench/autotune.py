@@ -263,6 +263,20 @@ def measure_alltoall(comm, counts: Sequence[int],
         lambda npdt, W, n: [np.full((W, W * n), 1e-6, npdt)])
 
 
+def measure_reduce(comm, counts: Sequence[int],
+                   algos: Sequence[Algorithm],
+                   dt: dataType = dataType.float32,
+                   reps: int = 3,
+                   segment_bytes: Optional[int] = None
+                   ) -> Dict[Algorithm, List[float]]:
+    return _measure_rooted(
+        lambda algo: algorithms.build_reduce(
+            comm, 0, reduceFunction.SUM, dt, algo, None, 0, segment_bytes),
+        comm, counts, algos, dt, reps,
+        lambda npdt, W, n: [np.full((W, n), 1e-6, npdt),
+                            np.zeros((W, n), npdt)])
+
+
 def _rooted_pallas_crossover(acc, cfg, *, measure, baseline: Algorithm,
                              field: str, pows, reps, dt) -> ACCLConfig:
     """Shared shape of the rooted-op Pallas tuners: on ICI, measure
@@ -319,6 +333,18 @@ def autotune_scatter(acc, cfg: ACCLConfig,
     return _rooted_pallas_crossover(
         acc, cfg, measure=measure_scatter, baseline=Algorithm.FLAT,
         field="scatter_pallas_threshold", pows=pows, reps=reps, dt=dt)
+
+
+def autotune_reduce(acc, cfg: ACCLConfig,
+                    pows: Sequence[int] = (10, 14, 18, 21),
+                    reps: int = 3,
+                    dt: dataType = dataType.float32) -> ACCLConfig:
+    """On ICI, the measured crossover where the chunked RS + relay-gather
+    Pallas reduce beats the best jnp family (XLA one-shot / binary
+    tree), written to ``reduce_pallas_threshold`` (payload bytes)."""
+    return _rooted_pallas_crossover(
+        acc, cfg, measure=measure_reduce, baseline=Algorithm.TREE,
+        field="reduce_pallas_threshold", pows=pows, reps=reps, dt=dt)
 
 
 def autotune_alltoall(acc, cfg: ACCLConfig,
@@ -436,6 +462,7 @@ def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
         cfg = autotune_gather(acc, cfg, pows=pows, reps=reps, dt=dt)
         cfg = autotune_scatter(acc, cfg, pows=pows, reps=reps, dt=dt)
         cfg = autotune_alltoall(acc, cfg, pows=pows, reps=reps, dt=dt)
+        cfg = autotune_reduce(acc, cfg, pows=pows, reps=reps, dt=dt)
         cfg = autotune_flat_tree(acc, cfg, reps=reps, dt=dt)
     finally:
         acc.config = saved

@@ -96,6 +96,7 @@ class ACCLConfig:
     gather_pallas_threshold: int = 8 * 1024 * 1024  # gather (per-block)
     scatter_pallas_threshold: int = 8 * 1024 * 1024  # scatter (per-edge)
     alltoall_pallas_threshold: int = 8 * 1024 * 1024  # alltoall (per-edge)
+    reduce_pallas_threshold: int = 8 * 1024 * 1024   # reduce (payload)
 
     # timeout for request waits, in seconds (HOUSEKEEP_TIMEOUT analog)
     timeout: float = 60.0

@@ -54,7 +54,8 @@ def _hier_shape(comm: Communicator, on_dcn: bool = False):
 _SUPPORTED = {
     operation.bcast: {Algorithm.XLA, Algorithm.FLAT, Algorithm.TREE,
                       Algorithm.RING, Algorithm.PALLAS},
-    operation.reduce: {Algorithm.XLA, Algorithm.FLAT, Algorithm.TREE, Algorithm.RING},
+    operation.reduce: {Algorithm.XLA, Algorithm.FLAT, Algorithm.TREE,
+                       Algorithm.RING, Algorithm.PALLAS},
     operation.allreduce: {Algorithm.XLA, Algorithm.FLAT, Algorithm.TREE,
                           Algorithm.RING, Algorithm.HIERARCHICAL,
                           Algorithm.PALLAS},
@@ -138,6 +139,7 @@ def select(
             operation.gather: cfg.gather_pallas_threshold,
             operation.scatter: cfg.scatter_pallas_threshold,
             operation.alltoall: cfg.alltoall_pallas_threshold,
+            operation.reduce: cfg.reduce_pallas_threshold,
         }.get(op)
         if pallas_at is not None and nbytes >= pallas_at:
             return Algorithm.PALLAS
@@ -242,7 +244,12 @@ def build_alltoall(comm, algo: Algorithm,
 
 def build_reduce(comm, root: int, func: reduceFunction, dt: dataType,
                  algo: Algorithm, arith: Optional[ArithConfig],
-                 fanin: int = 0) -> Callable:
+                 fanin: int = 0,
+                 segment_bytes: Optional[int] = None) -> Callable:
+    if algo == Algorithm.PALLAS:
+        from . import pallas_chunked
+        return pallas_chunked.build_chunked_ring_reduce(
+            comm, root, func, dt, segment_bytes, arith=arith)
     if algo == Algorithm.FLAT:
         return flat.build_flat_reduce(comm, root, func, dt, arith, fanin)
     if algo == Algorithm.TREE:

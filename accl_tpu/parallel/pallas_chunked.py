@@ -1265,6 +1265,70 @@ def build_chunked_ring_allgather(comm: Communicator, dt: dataType,
     return _smap(comm, body, 1)
 
 
+def chunked_reduce_body(x, dest, *, P: int, root: int,
+                        func: reduceFunction, dtype, segment_bytes: int,
+                        wire=None, gather_wire=None):
+    """Per-rank shard_map body: (1, n), (1, n) -> (1, n); segmented ring
+    reduce-scatter + ring-relay gather-to-root composition (the firmware
+    composes reduce from the same parts, ``ccl_offload_control.c:
+    1768-1781`` reduce-then-scatter / ``:1878-1887`` reduce-then-bcast
+    stance). ``wire`` compresses the RS hops (fold at full precision);
+    ``gather_wire`` the relay hops (pure transport)."""
+    n = x.shape[-1]
+    rank = lax.axis_index(AXIS)
+    if P == 1:
+        return jnp.where(rank == root, x, dest)
+    chunk = -(-n // P)
+    C, sr, seg_elems = _geometry(chunk, dtype, segment_bytes)
+    per = C * seg_elems
+    grid = jnp.zeros((P, per), dtype)
+    src = jnp.zeros((P * chunk,), dtype)
+    src = lax.dynamic_update_slice(src, x[0].astype(dtype), (0,))
+    grid = lax.dynamic_update_slice(grid, src.reshape(P, chunk), (0, 0))
+    partial = _chunked_rs_call(grid.reshape(P, C, sr, _LANES), P=P, C=C,
+                               sr=sr, func=func, dtype=dtype, wire=wire)
+    mine = partial.reshape(-1)[:chunk]  # rank owns folded chunk (my+1)%P
+    gdest = jnp.zeros((1, P * chunk), x.dtype)
+    gath = chunked_gather_body(mine.astype(x.dtype)[None], gdest, P=P,
+                               root=root, dtype=dtype,
+                               segment_bytes=segment_bytes,
+                               wire=gather_wire)
+    # source rank r contributed chunk (r+1)%P; roll so slot c holds chunk c
+    blocks = gath.reshape(P, chunk)
+    ordered = jnp.roll(blocks, shift=1, axis=0).reshape(-1)[:n]
+    return jnp.where(rank == root, ordered.reshape(1, n), dest)
+
+
+def build_chunked_ring_reduce(comm: Communicator, root: int,
+                              func: reduceFunction, dt: dataType,
+                              segment_bytes: int, arith=None) -> Callable:
+    """(world, n), (world, n) sharded in -> (world, n) out (HBM-scale):
+    chunked RS + relay gather composition; non-root outputs pass through
+    unchanged. A compressing ``arith`` compresses every hop of both
+    phases."""
+    _pr._check_multiprocess(comm)
+    segment_bytes = segment_bytes or DEFAULT_SEGMENT_SIZE
+    P = comm.world_size
+    dtype = to_jax_dtype(dt)
+    kdtype, wire, pre, post = _pr._wire_policy(arith, dtype)
+    compressing = arith is not None and arith.is_compressing
+    # same-dtype guard as the allreduce composition: when the whole
+    # kernel already runs in the wire dtype (arith_is_compressed pairs),
+    # compressing the gather phase again would double-apply a quantized
+    # scale
+    gather_wire = ((to_jax_dtype(arith.compressed), arith.quant_scale)
+                   if compressing and to_jax_dtype(arith.compressed) != kdtype
+                   else None)
+
+    def body(x, dest):
+        out = chunked_reduce_body(pre(x), dest, P=P, root=root, func=func,
+                                  dtype=kdtype, segment_bytes=segment_bytes,
+                                  wire=wire, gather_wire=gather_wire)
+        return post(out, x.dtype)
+
+    return _smap(comm, body, 2)
+
+
 def build_chunked_ring_allreduce(comm: Communicator, func: reduceFunction,
                                  dt: dataType,
                                  segment_bytes: int,

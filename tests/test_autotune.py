@@ -288,6 +288,30 @@ def test_autotune_cache_path(accl, monkeypatch, tmp_path):
         accl.config = orig
 
 
+def test_autotune_reduce_pallas_crossover_on_ici(accl, monkeypatch):
+    """The chunked RS + relay-gather Pallas reduce joins the tuned set."""
+    from accl_tpu.config import TransportBackend
+
+    def fake_measure(comm, cs, algos, dt, reps, segment_bytes=None):
+        assert Algorithm.PALLAS in algos and Algorithm.TREE in algos
+        t = {a: [1.0, 1.0] for a in algos}
+        t[Algorithm.PALLAS] = [2.0, 0.5]  # wins from index 1 on
+        return t
+
+    monkeypatch.setattr(autotune, "measure_reduce", fake_measure)
+    orig = accl.config
+    try:
+        accl.config = accl.config.replace(transport=TransportBackend.ICI)
+        tuned = autotune.autotune_reduce(accl, accl.config, pows=(6, 9),
+                                         reps=1)
+        assert tuned.reduce_pallas_threshold == 2 ** 9 * 4
+        comm = accl.global_comm()
+        assert algorithms.select(
+            operation.reduce, 2 ** 9 * 4, comm, tuned) == Algorithm.PALLAS
+    finally:
+        accl.config = orig
+
+
 def test_autotune_alltoall_pallas_crossover_on_ici(accl, monkeypatch):
     """The phased-rotation Pallas alltoall joins the tuned set on ICI."""
     from accl_tpu.config import TransportBackend

@@ -305,6 +305,64 @@ def test_chunked_bcast_through_host_api(accl, rng):
         ici) == Algorithm.PALLAS
 
 
+@pytest.mark.parametrize("func", [reduceFunction.SUM, reduceFunction.MAX])
+@pytest.mark.parametrize("root", [0, 3])
+def test_chunked_reduce(accl, rng, func, root):
+    """Chunked RS + relay-gather composition: root gets the reduction,
+    non-root outputs pass through unchanged."""
+    comm = accl.global_comm()
+    n = 1024 * 2 * WORLD + 77  # odd tail exercises padding
+    x = rng.standard_normal((WORLD, n)).astype(np.float32)
+    dest = rng.standard_normal((WORLD, n)).astype(np.float32)
+    prog = pallas_chunked.build_chunked_ring_reduce(
+        comm, root, func, dataType.float32, segment_bytes=SEG)
+    out = np.asarray(prog(_put(accl, x), _put(accl, dest)))
+    ref = x.sum(0) if func == reduceFunction.SUM else x.max(0)
+    np.testing.assert_allclose(out[root], ref, rtol=1e-4, atol=1e-4)
+    for r in range(WORLD):
+        if r != root:
+            np.testing.assert_array_equal(out[r], dest[r])
+
+
+def test_chunked_reduce_compressed_wire(accl, rng):
+    """bf16 wire through both phases of the reduce composition."""
+    from accl_tpu import ArithConfig
+    comm = accl.global_comm()
+    arith = ArithConfig(dataType.float32, dataType.bfloat16,
+                        arith_is_compressed=False)
+    n = 1024 * WORLD
+    x = rng.integers(-8, 8, (WORLD, n)).astype(np.float32)
+    dest = np.zeros((WORLD, n), np.float32)
+    prog = pallas_chunked.build_chunked_ring_reduce(
+        comm, 1, reduceFunction.SUM, dataType.float32, segment_bytes=SEG,
+        arith=arith)
+    out = np.asarray(prog(_put(accl, x), _put(accl, dest)))
+    np.testing.assert_array_equal(out[1], x.sum(0))
+
+
+def test_chunked_reduce_through_host_api(accl, rng):
+    """Algorithm.PALLAS through ACCL.reduce (and AUTO engages it on ICI
+    above reduce_pallas_threshold)."""
+    from accl_tpu.constants import operation
+    from accl_tpu.parallel import algorithms
+    from accl_tpu.config import TransportBackend
+
+    count = 4096 * WORLD
+    send = accl.create_buffer(count, dataType.float32)
+    recv = accl.create_buffer(count, dataType.float32)
+    send.host[:] = rng.standard_normal(send.host.shape).astype(np.float32)
+    accl.reduce(send, recv, count, root=4, function=reduceFunction.SUM,
+                algorithm=Algorithm.PALLAS)
+    np.testing.assert_allclose(recv.host[4], send.host.sum(0),
+                               rtol=1e-4, atol=1e-4)
+
+    ici = accl.config.replace(transport=TransportBackend.ICI)
+    comm = accl.global_comm()
+    assert algorithms.select(
+        operation.reduce, ici.reduce_pallas_threshold, comm,
+        ici, count=1 << 22) == Algorithm.PALLAS
+
+
 # C regimes: single segment (no intra-hop pipeline), odd C (slot parity
 # flips across hop boundaries - the global credit chain must absorb it)
 @pytest.mark.parametrize("nseg", [1, 2, 3])
