@@ -1002,14 +1002,9 @@ def chunked_ar_body(x, *, P: int, func: reduceFunction, dtype,
         return x
     chunk = -(-n // P)
     C, sr, seg_elems = _geometry(chunk, dtype, segment_bytes)
-    # place each rank's chunk at stride C*seg_elems so the segment
-    # geometry is uniform across chunks
     per = C * seg_elems
-    grid = jnp.zeros((P, per), dtype)
-    src = jnp.zeros((P * chunk,), dtype)
-    src = lax.dynamic_update_slice(src, x[0].astype(dtype), (0,))
-    grid = lax.dynamic_update_slice(grid, src.reshape(P, chunk), (0, 0))
-    chunks = grid.reshape(P, C, sr, _LANES)
+    chunks = _pack_chunks(x[0], P=P, chunk=chunk, C=C, sr=sr,
+                          seg_elems=seg_elems, dtype=dtype)
 
     partial = _chunked_rs_call(chunks, P=P, C=C, sr=sr, func=func,
                                dtype=dtype, wire=wire)
@@ -1265,6 +1260,22 @@ def build_chunked_ring_allgather(comm: Communicator, dt: dataType,
     return _smap(comm, body, 1)
 
 
+def _pack_chunks(vec, *, P: int, chunk: int, C: int, sr: int,
+                 seg_elems: int, dtype):
+    """Stride-pad a flat per-rank payload into the kernels' uniform
+    (P, C, Sr, 128) chunk grid: chunk p occupies the first ``chunk``
+    elements of row p's C*seg_elems stride (so segment geometry is
+    identical across chunks). Shared by the allreduce and reduce
+    compositions — the packing and the (my+1)%P chunk-ownership roll
+    must stay in lockstep with the RS kernel's ring schedule."""
+    per = C * seg_elems
+    grid = jnp.zeros((P, per), dtype)
+    src = jnp.zeros((P * chunk,), dtype)
+    src = lax.dynamic_update_slice(src, vec.astype(dtype), (0,))
+    grid = lax.dynamic_update_slice(grid, src.reshape(P, chunk), (0, 0))
+    return grid.reshape(P, C, sr, _LANES)
+
+
 def chunked_reduce_body(x, dest, *, P: int, root: int,
                         func: reduceFunction, dtype, segment_bytes: int,
                         wire=None, gather_wire=None):
@@ -1280,21 +1291,27 @@ def chunked_reduce_body(x, dest, *, P: int, root: int,
         return jnp.where(rank == root, x, dest)
     chunk = -(-n // P)
     C, sr, seg_elems = _geometry(chunk, dtype, segment_bytes)
+    grid = _pack_chunks(x[0], P=P, chunk=chunk, C=C, sr=sr,
+                        seg_elems=seg_elems, dtype=dtype)
+    partial = _chunked_rs_call(grid, P=P, C=C, sr=sr, func=func,
+                               dtype=dtype, wire=wire)
+    # the RS output already has the gather kernel's exact (C, Sr, 128)
+    # geometry — feed it straight in, no repack round trip
+    if gather_wire is not None:
+        gath = _chunked_gather_call(
+            _pr._to_wire(partial, gather_wire), P=P, C=C, sr=sr,
+            dtype=gather_wire[0], root=root)
+        gath = _pr._from_wire(gath, dtype, gather_wire)
+    else:
+        gath = _chunked_gather_call(partial, P=P, C=C, sr=sr, dtype=dtype,
+                                    root=root)
     per = C * seg_elems
-    grid = jnp.zeros((P, per), dtype)
-    src = jnp.zeros((P * chunk,), dtype)
-    src = lax.dynamic_update_slice(src, x[0].astype(dtype), (0,))
-    grid = lax.dynamic_update_slice(grid, src.reshape(P, chunk), (0, 0))
-    partial = _chunked_rs_call(grid.reshape(P, C, sr, _LANES), P=P, C=C,
-                               sr=sr, func=func, dtype=dtype, wire=wire)
-    mine = partial.reshape(-1)[:chunk]  # rank owns folded chunk (my+1)%P
-    gdest = jnp.zeros((1, P * chunk), x.dtype)
-    gath = chunked_gather_body(mine.astype(x.dtype)[None], gdest, P=P,
-                               root=root, dtype=dtype,
-                               segment_bytes=segment_bytes,
-                               wire=gather_wire)
-    # source rank r contributed chunk (r+1)%P; roll so slot c holds chunk c
-    blocks = gath.reshape(P, chunk)
+    blocks = gath.reshape(P, per)[:, :chunk]  # indexed by SOURCE rank
+    # the relay never transfers the root's own contribution: insert its
+    # partial at full precision (it never rides the wire)
+    blocks = blocks.at[root].set(partial.reshape(-1)[:chunk])
+    # source rank r contributed folded chunk (r+1)%P; roll so slot c
+    # holds chunk c
     ordered = jnp.roll(blocks, shift=1, axis=0).reshape(-1)[:n]
     return jnp.where(rank == root, ordered.reshape(1, n), dest)
 
