@@ -288,6 +288,41 @@ def main() -> int:
     print(f"[p{me}] autotune cache decision ok", flush=True)
     acc.barrier()
 
+    # ---- 10. cross-process soft_reset tombstones parked sends ----------
+    # A credit-starved async send parks holding a reserved seq.
+    # soft_reset must tombstone that seq so the peer's fetch cursor can
+    # advance past the hole, while announced in-flight messages are
+    # deliberately KEPT (retracting one side of a possibly-accepted
+    # message would desynchronize the global schedule).
+    sbA = acc.create_buffer(cnt, dataType.float32)
+    sbB = acc.create_buffer(cnt, dataType.float32)
+    rbA = acc.create_buffer(cnt, dataType.float32)
+    if i_src:
+        sbA.host[src] = np.full(cnt, 9.0, np.float32)
+        acc.send(sbA, cnt, src=src, dst=dst, tag=100,
+                 compress_dtype=dataType.float16)  # fills the window
+        sbB.host[src] = np.full(cnt, 8.0, np.float32)
+        reqB = acc.send(sbB, cnt, src=src, dst=dst, tag=101,
+                        run_async=True, compress_dtype=dataType.float16)
+        assert not reqB.test()  # parked: window full, seq reserved
+        acc.soft_reset()        # drops the parked send, tombstones seq
+    acc.barrier()
+    if i_dst:
+        acc.recv(rbA, cnt, src=src, dst=dst, tag=100,
+                 compress_dtype=dataType.float16)
+        assert np.allclose(rbA.host[dst], 9.0)  # in-flight message kept
+    # the pair stream must still be live past the tombstoned hole — if
+    # the reserved seq were left dangling, this send could never be
+    # fetched and the recv would time out
+    if i_src:
+        sb.host[src] = A * 7
+        acc.send(sb, n, src=src, dst=dst, tag=102)
+    if i_dst:
+        acc.recv(rb, n, src=src, dst=dst, tag=102)
+        assert np.allclose(rb.host[dst], A * 7)
+        print(f"[p{me}] soft_reset tombstone ok", flush=True)
+    acc.barrier()
+
     print(f"[p{me}] MP-PROTOCOL-OK", flush=True)
     return 0
 
