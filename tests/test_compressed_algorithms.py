@@ -18,7 +18,7 @@ def _small_ints(rng, shape):
 
 
 @pytest.mark.parametrize("algo", [Algorithm.RING, Algorithm.TREE,
-                                  Algorithm.FLAT])
+                                  Algorithm.FLAT, Algorithm.PALLAS])
 @pytest.mark.parametrize("wire", [dataType.bfloat16, dataType.float16])
 @pytest.mark.parametrize("count", [33, 1021])
 def test_bcast_compressed_algorithms(accl, rng, algo, wire, count):
@@ -31,7 +31,7 @@ def test_bcast_compressed_algorithms(accl, rng, algo, wire, count):
 
 
 @pytest.mark.parametrize("algo", [Algorithm.RING, Algorithm.TREE,
-                                  Algorithm.FLAT])
+                                  Algorithm.FLAT, Algorithm.PALLAS])
 @pytest.mark.parametrize("func", [reduceFunction.SUM, reduceFunction.MAX])
 def test_reduce_compressed_algorithms(accl, rng, algo, func):
     count = 47
@@ -60,7 +60,8 @@ def test_allreduce_compressed_algorithms(accl, rng, algo):
         np.testing.assert_array_equal(recv.host[r], expect)
 
 
-@pytest.mark.parametrize("algo", [Algorithm.FLAT, Algorithm.RING])
+@pytest.mark.parametrize("algo", [Algorithm.FLAT, Algorithm.RING,
+                                  Algorithm.PALLAS])
 def test_gather_compressed_algorithms(accl, rng, algo):
     count = 19
     send = accl.create_buffer(count, dataType.float32)
@@ -86,6 +87,30 @@ def test_scatter_alltoall_compressed_flat(accl, rng):
     a.host[:] = _small_ints(rng, (WORLD, count * WORLD))
     accl.alltoall(a, ar, count, compress_dtype=dataType.bfloat16,
                   algorithm=Algorithm.FLAT)
+    for k in range(WORLD):
+        expect = np.concatenate(
+            [a.host[src, k * count:(k + 1) * count] for src in range(WORLD)])
+        np.testing.assert_array_equal(ar.host[k], expect)
+
+
+def test_scatter_alltoall_compressed_pallas(accl, rng):
+    """The segmented relay/rotation kernels through the same compressed
+    matrix as the FLAT family (small-int payloads are exact through any
+    number of cast hops)."""
+    count = 13
+    s = accl.create_buffer(count * WORLD, dataType.float32)
+    r = accl.create_buffer(count, dataType.float32)
+    s.host[:] = _small_ints(rng, (WORLD, count * WORLD))
+    accl.scatter(s, r, count, 4, compress_dtype=dataType.bfloat16,
+                 algorithm=Algorithm.PALLAS)
+    for k in range(WORLD):
+        np.testing.assert_array_equal(
+            r.host[k], s.host[4, k * count:(k + 1) * count])
+    a = accl.create_buffer(count * WORLD, dataType.float32)
+    ar = accl.create_buffer(count * WORLD, dataType.float32)
+    a.host[:] = _small_ints(rng, (WORLD, count * WORLD))
+    accl.alltoall(a, ar, count, compress_dtype=dataType.bfloat16,
+                  algorithm=Algorithm.PALLAS)
     for k in range(WORLD):
         expect = np.concatenate(
             [a.host[src, k * count:(k + 1) * count] for src in range(WORLD)])
