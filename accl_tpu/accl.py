@@ -552,10 +552,11 @@ class ACCL:
             operation.allgather, count * constants.dtype_size(dtype),
             comm, self.config, algorithm)
         seg = self.config.segment_size
+        bidir = self.config.bidirectional_rings
         return (self._key(comm, operation.allgather, count, dtype,
-                          compress_dtype, algo, seg),
+                          compress_dtype, algo, seg, bidir),
                 lambda: algorithms.build_allgather(comm, algo, arith, dtype,
-                                                   seg))
+                                                   seg, bidir))
 
     def _spec_scatter(self, comm, count: int, dtype: dataType, root: int,
                       compress_dtype, algorithm):
@@ -625,10 +626,12 @@ class ACCL:
         fanin = (self.config.gather_flat_tree_max_fanin
                  if algo == Algorithm.FLAT else 0)
         seg = self.config.segment_size
+        bidir = self.config.bidirectional_rings
         return (self._key(comm, operation.allreduce, count, dtype, function,
-                          compress_dtype, algo, seg, fanin),
+                          compress_dtype, algo, seg, fanin, bidir),
                 lambda: algorithms.build_allreduce(comm, function, dtype,
-                                                   algo, arith, seg, fanin))
+                                                   algo, arith, seg, fanin,
+                                                   bidir))
 
     def _spec_reduce_scatter(self, comm, count: int, dtype: dataType,
                              function: reduceFunction, compress_dtype,
@@ -641,11 +644,12 @@ class ACCL:
             count * comm.world_size * constants.dtype_size(dtype),
             comm, self.config, algorithm)
         seg = self.config.segment_size
+        bidir = self.config.bidirectional_rings
         return (self._key(comm, operation.reduce_scatter, count, dtype,
-                          function, compress_dtype, algo, seg),
+                          function, compress_dtype, algo, seg, bidir),
                 lambda: algorithms.build_reduce_scatter(comm, function,
                                                         dtype, algo, arith,
-                                                        seg))
+                                                        seg, bidir))
 
     # ------------------------------------------------------------------
     # primitives: copy / combine

@@ -97,6 +97,13 @@ class ACCLConfig:
     scatter_pallas_threshold: int = 8 * 1024 * 1024  # scatter (per-edge)
     alltoall_pallas_threshold: int = 8 * 1024 * 1024  # alltoall (per-edge)
     reduce_pallas_threshold: int = 8 * 1024 * 1024   # reduce (payload)
+    # chunked ring kernels rotate segment parities in OPPOSITE directions
+    # so both directions of every ICI link carry payload simultaneously
+    # (each moves half the bytes — the 2x bandwidth ceiling of a
+    # bidirectional torus link, unusable by the reference's
+    # unidirectional Ethernet rings). Correctness-identical on the
+    # interpret rung; applies to allreduce/allgather/reduce_scatter.
+    bidirectional_rings: bool = True
 
     # timeout for request waits, in seconds (HOUSEKEEP_TIMEOUT analog)
     timeout: float = 60.0

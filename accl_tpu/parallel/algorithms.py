@@ -262,10 +262,12 @@ def build_reduce(comm, root: int, func: reduceFunction, dt: dataType,
 def build_allreduce(comm, func: reduceFunction, dt: dataType, algo: Algorithm,
                     arith: Optional[ArithConfig],
                     segment_bytes: Optional[int] = None,
-                    fanin: int = 0) -> Callable:
+                    fanin: int = 0,
+                    bidirectional: bool = False) -> Callable:
     if algo == Algorithm.PALLAS:
         return pallas_ring.build_pallas_ring_allreduce(
-            comm, func, dt, segment_bytes, arith=arith)
+            comm, func, dt, segment_bytes, arith=arith,
+            bidirectional=bidirectional)
     if algo == Algorithm.FLAT:
         return flat.build_flat_allreduce(comm, func, dt, arith, fanin)
     if algo == Algorithm.RING:
@@ -285,10 +287,12 @@ def build_allreduce(comm, func: reduceFunction, dt: dataType, algo: Algorithm,
 def build_allgather(comm, algo: Algorithm,
                     arith: Optional[ArithConfig],
                     dt: dataType,
-                    segment_bytes: Optional[int] = None) -> Callable:
+                    segment_bytes: Optional[int] = None,
+                    bidirectional: bool = False) -> Callable:
     if algo == Algorithm.PALLAS:
         return pallas_ring.build_pallas_ring_allgather(
-            comm, dt, segment_bytes, arith=arith)
+            comm, dt, segment_bytes, arith=arith,
+            bidirectional=bidirectional)
     if algo == Algorithm.RING:
         return ring.build_ring_allgather(comm, arith)
     return primitives.build_allgather(comm, arith)
@@ -297,10 +301,12 @@ def build_allgather(comm, algo: Algorithm,
 def build_reduce_scatter(comm, func: reduceFunction, dt: dataType,
                          algo: Algorithm,
                          arith: Optional[ArithConfig],
-                         segment_bytes: Optional[int] = None) -> Callable:
+                         segment_bytes: Optional[int] = None,
+                         bidirectional: bool = False) -> Callable:
     if algo == Algorithm.PALLAS:
         return pallas_ring.build_pallas_ring_reduce_scatter(
-            comm, func, dt, segment_bytes, arith=arith)
+            comm, func, dt, segment_bytes, arith=arith,
+            bidirectional=bidirectional)
     if algo == Algorithm.RING:
         return ring.build_ring_reduce_scatter(comm, func, dt, arith)
     return primitives.build_reduce_scatter(comm, func, dt, arith)

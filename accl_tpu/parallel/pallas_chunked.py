@@ -77,11 +77,19 @@ def _seg_rows(segment_bytes: int, dtype) -> int:
 def _chunked_rs_kernel(x_ref, o_ref, acc_buf, recv_buf, local_buf,
                        send_sem, recv_sem, seed_sem, local_sem, store_sem,
                        cap_sem, *rest, P: int, C: int, func: reduceFunction,
-                       wire=None):
+                       wire=None, bidirectional: bool = False):
     """x_ref: (P, C, Sr, 128) in HBM; o_ref: (C, Sr, 128) in HBM.
 
     Rank ``my`` ends owning folded chunk ``(my+1) % P`` (ring schedule);
     the wrapper rolls it back.  Two channels process segments 2g / 2g+1.
+
+    ``bidirectional=True`` mirrors channel 1 — its segments rotate LEFT
+    while channel 0's rotate right, so both directions of every ICI link
+    carry payload simultaneously (each direction moves half the bytes:
+    the 2x ring-bandwidth ceiling a bidirectional torus link offers,
+    which the reference's unidirectional Ethernet rings cannot use).
+    Channel 1 then ends owning chunk ``(my-1) % P`` for its segments;
+    the wrapper realigns per segment parity.
 
     ``wire=(wire dtype, scale)`` adds a wire staging buffer (``rest[0]``):
     the remote DMA carries the compressed segment, the fold decompresses
@@ -96,6 +104,12 @@ def _chunked_rs_kernel(x_ref, o_ref, acc_buf, recv_buf, local_buf,
     hops = P - 1
     G = -(-C // 2)           # groups of two segments
     T = [G * hops, (C // 2) * hops]   # per-channel global step counts
+    # per-channel ring orientation: (downstream we send to, upstream we
+    # grant credits to, fold-index sign)
+    def _dirs(chan):
+        if bidirectional and chan == 1:
+            return left, right, jnp.int32(1)
+        return right, left, jnp.int32(-1)
 
     def seg_of(chan, g):
         return g * 2 + chan
@@ -109,12 +123,14 @@ def _chunked_rs_kernel(x_ref, o_ref, acc_buf, recv_buf, local_buf,
     def chan_step(chan, g, s, t):
         """One hop for one channel; every async op's semaphore is consumed
         exactly once (hazard accounting in the module docstring)."""
+        dst, _, sign = _dirs(chan)
         c = seg_of(chan, g)
         slot = lax.rem(t, 2)
-        idx = lax.rem(my - s - jnp.int32(1) + jnp.int32(P), jnp.int32(P))
+        idx = lax.rem(my + sign * (s + jnp.int32(1)) + jnp.int32(2 * P),
+                      jnp.int32(P))
 
-        # credit gate: writing right's recv slot t%2 needs right to have
-        # folded the slot's step t-2 content (rx-pool backpressure analog)
+        # credit gate: writing the downstream recv slot t%2 needs it to
+        # have folded the slot's step t-2 content (rx-pool backpressure)
         @pl.when(t >= 2)
         def _gate():
             pltpu.semaphore_wait(cap_sem.at[chan], 1)
@@ -124,7 +140,7 @@ def _chunked_rs_kernel(x_ref, o_ref, acc_buf, recv_buf, local_buf,
             dst_ref=recv_buf.at[chan, slot],
             send_sem=send_sem.at[chan],
             recv_sem=recv_sem.at[chan, slot],
-            device_id=right,
+            device_id=dst,
             device_id_type=pltpu.DeviceIdType.LOGICAL,
         )
         rdma.start()
@@ -136,6 +152,7 @@ def _chunked_rs_kernel(x_ref, o_ref, acc_buf, recv_buf, local_buf,
         return rdma, local
 
     def chan_fold(chan, g, s, t, rdma, local):
+        _, upstream, _ = _dirs(chan)
         c = seg_of(chan, g)
         slot = lax.rem(t, 2)
         rdma.wait_recv()
@@ -145,11 +162,11 @@ def _chunked_rs_kernel(x_ref, o_ref, acc_buf, recv_buf, local_buf,
                                   local_buf.dtype, wire))
         folded = _combine(rx, local_buf[chan], func)
 
-        # recv slot consumed -> grant left a credit for its step t+2
+        # recv slot consumed -> grant upstream a credit for its step t+2
         @pl.when(t + 2 <= T[chan] - 1)
         def _free():
             pltpu.semaphore_signal(
-                cap_sem.at[chan], inc=1, device_id=left,
+                cap_sem.at[chan], inc=1, device_id=upstream,
                 device_id_type=pltpu.DeviceIdType.LOGICAL)
 
         rdma.wait_send()          # send staging drained -> safe to overwrite
@@ -217,7 +234,8 @@ def _chunked_rs_kernel(x_ref, o_ref, acc_buf, recv_buf, local_buf,
         wait_store(1)
 
 
-def _chunked_rs_call(x, *, P: int, C: int, sr: int, func, dtype, wire=None):
+def _chunked_rs_call(x, *, P: int, C: int, sr: int, func, dtype, wire=None,
+                     bidirectional: bool = False):
     scratch = [
         pltpu.VMEM((2, sr, _LANES), dtype),          # acc_buf
         pltpu.VMEM((2, 2, sr, _LANES),
@@ -234,7 +252,7 @@ def _chunked_rs_call(x, *, P: int, C: int, sr: int, func, dtype, wire=None):
         scratch.append(pltpu.VMEM((2, sr, _LANES), wire[0]))  # wire_buf
     return pl.pallas_call(
         functools.partial(_chunked_rs_kernel, P=P, C=C, func=func,
-                          wire=wire),
+                          wire=wire, bidirectional=bidirectional),
         out_shape=jax.ShapeDtypeStruct((C, sr, _LANES), dtype),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
@@ -250,17 +268,27 @@ def _chunked_rs_call(x, *, P: int, C: int, sr: int, func, dtype, wire=None):
 # ---------------------------------------------------------------------------
 
 def _chunked_ag_kernel(x_ref, o_ref, buf, send_sem, recv_sem, seed_sem,
-                       store_sem, cap_sem, *, P: int, C: int):
+                       store_sem, cap_sem, *, P: int, C: int,
+                       bidirectional: bool = False):
     """x_ref: (C, Sr, 128) own block in HBM; o_ref: (P, C, Sr, 128) HBM.
 
-    Step t: send ``buf[chan, t%2]`` right, receive block ``(my-s-1)%P``
-    into ``buf[chan, (t+1)%2]``, flush it to HBM, forward it at t+1.
+    Step t: send ``buf[chan, t%2]`` downstream, receive block
+    ``(my-s-1)%P`` (channel 1 mirrored: ``(my+s+1)%P``) into
+    ``buf[chan, (t+1)%2]``, flush it to HBM, forward it at t+1.
+    ``bidirectional=True`` rotates channel 1 LEFT so both directions of
+    every link carry payload; the output is complete either way (each
+    block's odd segments just arrive via the opposite ring).
     """
     my, left, right = _neighbors(P)
     _ring_barrier(left, right)
     hops = P - 1
     G = -(-C // 2)
     T = [G * hops, (C // 2) * hops]
+
+    def _dirs(chan):
+        if bidirectional and chan == 1:
+            return left, right, jnp.int32(1)
+        return right, left, jnp.int32(-1)
 
     def seg_of(chan, g):
         return g * 2 + chan
@@ -289,10 +317,11 @@ def _chunked_ag_kernel(x_ref, o_ref, buf, send_sem, recv_sem, seed_sem,
         st.start()
 
     def chan_send(chan, g, s, t):
+        dst, _, _ = _dirs(chan)
         slot = lax.rem(t, 2)
         nslot = lax.rem(t + 1, 2)
 
-        # credit: right's send(t-1) + store(t-2) must have released nslot
+        # credit: downstream's send(t-1) + store(t-2) must have freed nslot
         @pl.when(t >= 1)
         def _gate():
             pltpu.semaphore_wait(cap_sem.at[chan], 1)
@@ -302,17 +331,19 @@ def _chunked_ag_kernel(x_ref, o_ref, buf, send_sem, recv_sem, seed_sem,
             dst_ref=buf.at[chan, nslot],
             send_sem=send_sem.at[chan],
             recv_sem=recv_sem.at[chan, nslot],
-            device_id=right,
+            device_id=dst,
             device_id_type=pltpu.DeviceIdType.LOGICAL,
         )
         rdma.start()
         return rdma
 
     def chan_finish(chan, g, s, t, rdma):
+        _, upstream, sign = _dirs(chan)
         c = seg_of(chan, g)
         slot = lax.rem(t, 2)
         nslot = lax.rem(t + 1, 2)
-        src_idx = lax.rem(my - s - jnp.int32(1) + jnp.int32(P), jnp.int32(P))
+        src_idx = lax.rem(my + sign * (s + jnp.int32(1)) + jnp.int32(2 * P),
+                          jnp.int32(P))
 
         rdma.wait_recv()
         st = pltpu.make_async_copy(
@@ -322,13 +353,13 @@ def _chunked_ag_kernel(x_ref, o_ref, buf, send_sem, recv_sem, seed_sem,
 
         rdma.wait_send()
         # the slot just sent was flushed by store(t-1) (or the seed store);
-        # consume that signal, then release the slot to the left writer
+        # consume that signal, then release the slot to the upstream writer
         wait_store(chan, slot)
 
         @pl.when(t <= T[chan] - 2)
         def _release():
             pltpu.semaphore_signal(
-                cap_sem.at[chan], inc=1, device_id=left,
+                cap_sem.at[chan], inc=1, device_id=upstream,
                 device_id_type=pltpu.DeviceIdType.LOGICAL)
 
     def group(g, _):
@@ -365,9 +396,11 @@ def _chunked_ag_kernel(x_ref, o_ref, buf, send_sem, recv_sem, seed_sem,
         wait_store(1, T[1] % 2)
 
 
-def _chunked_ag_call(x, *, P: int, C: int, sr: int, dtype):
+def _chunked_ag_call(x, *, P: int, C: int, sr: int, dtype,
+                     bidirectional: bool = False):
     return pl.pallas_call(
-        functools.partial(_chunked_ag_kernel, P=P, C=C),
+        functools.partial(_chunked_ag_kernel, P=P, C=C,
+                          bidirectional=bidirectional),
         out_shape=jax.ShapeDtypeStruct((P, C, sr, _LANES), dtype),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
@@ -955,9 +988,14 @@ def _geometry(chunk_elems: int, dtype, segment_bytes: int):
 
 
 def chunked_rs_body(x, *, P: int, func: reduceFunction, dtype,
-                    segment_bytes: int, wire=None):
+                    segment_bytes: int, wire=None,
+                    bidirectional: bool = False):
     """Per-rank shard_map body: (1, world*n) -> (1, n) (HBM-scale).
-    ``wire`` compresses every remote hop (see _chunked_rs_kernel)."""
+    ``wire`` compresses every remote hop (see _chunked_rs_kernel).
+    ``bidirectional`` runs segment parities on counter-rotating rings;
+    the final single-hop realignment then goes one hop in each
+    direction (even segments came to own chunk (my+1), odd to
+    (my-1))."""
     total = x.shape[-1]
     n = total // P
     if P == 1:
@@ -970,15 +1008,25 @@ def chunked_rs_body(x, *, P: int, func: reduceFunction, dtype,
         padded, x.reshape(P, n).astype(dtype), (0, 0))
     chunks = padded.reshape(P, C, sr, _LANES)
     out = _chunked_rs_call(chunks, P=P, C=C, sr=sr, func=func, dtype=dtype,
-                           wire=wire)
-    mine = out.reshape(-1)[:n]
-    shifted = lax.ppermute(
-        mine, AXIS, [(i, (i + 1) % P) for i in range(P)])
-    return shifted.reshape(1, n).astype(x.dtype)
+                           wire=wire, bidirectional=bidirectional)
+    fwd = [(i, (i + 1) % P) for i in range(P)]
+    if bidirectional:
+        segs = out.reshape(C, seg_elems)
+        segs = segs.at[0::2].set(lax.ppermute(segs[0::2], AXIS, fwd))
+        if C > 1:  # odd channel exists only with >= 2 segments
+            segs = segs.at[1::2].set(lax.ppermute(
+                segs[1::2], AXIS, [(i, (i - 1 + P) % P) for i in range(P)]))
+        mine = segs.reshape(-1)[:n]
+    else:
+        mine = lax.ppermute(out.reshape(-1)[:n], AXIS, fwd)
+    return mine.reshape(1, n).astype(x.dtype)
 
 
-def chunked_ag_body(x, *, P: int, dtype, segment_bytes: int):
-    """Per-rank shard_map body: (1, n) -> (1, world*n) (HBM-scale)."""
+def chunked_ag_body(x, *, P: int, dtype, segment_bytes: int,
+                    bidirectional: bool = False):
+    """Per-rank shard_map body: (1, n) -> (1, world*n) (HBM-scale). The
+    output layout is direction-independent — each block's odd segments
+    just arrive via the opposite ring when ``bidirectional``."""
     n = x.shape[-1]
     if P == 1:
         return x
@@ -986,17 +1034,22 @@ def chunked_ag_body(x, *, P: int, dtype, segment_bytes: int):
     padded = jnp.zeros((C * seg_elems,), dtype)
     padded = lax.dynamic_update_slice(padded, x[0].astype(dtype), (0,))
     out = _chunked_ag_call(
-        padded.reshape(C, sr, _LANES), P=P, C=C, sr=sr, dtype=dtype)
+        padded.reshape(C, sr, _LANES), P=P, C=C, sr=sr, dtype=dtype,
+        bidirectional=bidirectional)
     return (out.reshape(P, C * seg_elems)[:, :n]
             .reshape(1, P * n).astype(x.dtype))
 
 
 def chunked_ar_body(x, *, P: int, func: reduceFunction, dtype,
-                    segment_bytes: int, wire=None, ag_wire=None):
+                    segment_bytes: int, wire=None, ag_wire=None,
+                    bidirectional: bool = False):
     """Per-rank shard_map body: (1, n) -> (1, n); segmented ring RS + ring
     AG composition (fw ``:1888-2071`` analog). ``wire`` compresses the RS
     hops (fold at full precision); ``ag_wire`` the AG hops (pure
-    transport)."""
+    transport). ``bidirectional`` runs both phases on counter-rotating
+    per-parity rings; the final reorder then rolls even segments by +1
+    and odd by -1 along the source-rank axis (rank r's partial holds
+    chunk (r+1)'s even and chunk (r-1)'s odd segments)."""
     n = x.shape[-1]
     if P == 1:
         return x
@@ -1007,15 +1060,24 @@ def chunked_ar_body(x, *, P: int, func: reduceFunction, dtype,
                           seg_elems=seg_elems, dtype=dtype)
 
     partial = _chunked_rs_call(chunks, P=P, C=C, sr=sr, func=func,
-                               dtype=dtype, wire=wire)
+                               dtype=dtype, wire=wire,
+                               bidirectional=bidirectional)
     if ag_wire is not None and ag_wire[0] != dtype:
         # compress once for the gather ring (no arithmetic remains)
         gathered = _chunked_ag_call(
             _pr._to_wire(partial, ag_wire), P=P, C=C, sr=sr,
-            dtype=ag_wire[0])
+            dtype=ag_wire[0], bidirectional=bidirectional)
         gathered = _pr._from_wire(gathered, dtype, ag_wire)
     else:
-        gathered = _chunked_ag_call(partial, P=P, C=C, sr=sr, dtype=dtype)
+        gathered = _chunked_ag_call(partial, P=P, C=C, sr=sr, dtype=dtype,
+                                    bidirectional=bidirectional)
+    if bidirectional:
+        segs = gathered.reshape(P, C, seg_elems)
+        segs = segs.at[:, 0::2].set(jnp.roll(segs[:, 0::2], 1, axis=0))
+        if C > 1:
+            segs = segs.at[:, 1::2].set(jnp.roll(segs[:, 1::2], -1, axis=0))
+        blocks = segs.reshape(P, per)[:, :chunk]
+        return blocks.reshape(-1)[:n].astype(x.dtype).reshape(1, n)
     # slot j holds folded chunk (j+1)%P; roll so slot c holds chunk c
     blocks = gathered.reshape(P, per)[:, :chunk]
     ordered = jnp.roll(blocks, shift=1, axis=0)
@@ -1217,7 +1279,8 @@ def build_chunked_ring_gather(comm: Communicator, root: int, dt: dataType,
 def build_chunked_ring_reduce_scatter(comm: Communicator,
                                       func: reduceFunction, dt: dataType,
                                       segment_bytes: int,
-                                      arith=None) -> Callable:
+                                      arith=None,
+                                      bidirectional: bool = False) -> Callable:
     """(world, world*n) sharded in -> (world, n) sharded out (HBM-scale).
     A compressing ``arith`` applies the per-hop wire lanes (see
     _chunked_rs_kernel)."""
@@ -1228,7 +1291,8 @@ def build_chunked_ring_reduce_scatter(comm: Communicator,
 
     def body(x):
         out = chunked_rs_body(pre(x), P=P, func=func, dtype=kdtype,
-                              segment_bytes=segment_bytes, wire=wire)
+                              segment_bytes=segment_bytes, wire=wire,
+                              bidirectional=bidirectional)
         return post(out, x.dtype)
 
     return _smap(comm, body, 1)
@@ -1236,7 +1300,8 @@ def build_chunked_ring_reduce_scatter(comm: Communicator,
 
 def build_chunked_ring_allgather(comm: Communicator, dt: dataType,
                                  segment_bytes: int,
-                                 arith=None) -> Callable:
+                                 arith=None,
+                                 bidirectional: bool = False) -> Callable:
     """(world, n) sharded in -> (world, world*n) sharded out (HBM-scale).
     A compressing ``arith`` runs the whole ring in the wire dtype (pure
     transport — every hop carries compressed payload)."""
@@ -1252,10 +1317,12 @@ def build_chunked_ring_allgather(comm: Communicator, dt: dataType,
         if compressing:
             x = _pr._to_wire(x, wire)
             out = chunked_ag_body(x, P=P, dtype=wire[0],
-                                  segment_bytes=segment_bytes)
+                                  segment_bytes=segment_bytes,
+                                  bidirectional=bidirectional)
             return _pr._from_wire(out, out_dtype, wire).astype(out_dtype)
         return chunked_ag_body(x, P=P, dtype=dtype,
-                               segment_bytes=segment_bytes)
+                               segment_bytes=segment_bytes,
+                               bidirectional=bidirectional)
 
     return _smap(comm, body, 1)
 
@@ -1349,7 +1416,8 @@ def build_chunked_ring_reduce(comm: Communicator, root: int,
 def build_chunked_ring_allreduce(comm: Communicator, func: reduceFunction,
                                  dt: dataType,
                                  segment_bytes: int,
-                                 arith=None) -> Callable:
+                                 arith=None,
+                                 bidirectional: bool = False) -> Callable:
     """Segmented ring RS + ring AG composition (fw ``:1888-2071`` analog).
     A compressing ``arith`` compresses every hop of both phases."""
     _pr._check_multiprocess(comm)
@@ -1363,7 +1431,7 @@ def build_chunked_ring_allreduce(comm: Communicator, func: reduceFunction,
     def body(x):
         out = chunked_ar_body(pre(x), P=P, func=func, dtype=kdtype,
                               segment_bytes=segment_bytes, wire=wire,
-                              ag_wire=ag_wire)
+                              ag_wire=ag_wire, bidirectional=bidirectional)
         return post(out, x.dtype)
 
     return _smap(comm, body, 1)

@@ -238,7 +238,8 @@ def _staged_bytes(P: int, block_elems: int, dtype) -> int:
 
 def build_pallas_ring_allgather(comm: Communicator, dt: dataType,
                                 segment_bytes: Optional[int] = None,
-                                arith=None) -> Callable:
+                                arith=None,
+                                bidirectional: bool = False) -> Callable:
     """(world, n) sharded in -> (world, world*n) sharded out.
 
     Payloads whose staged footprint exceeds ``VMEM_PAYLOAD_THRESHOLD``
@@ -267,7 +268,8 @@ def build_pallas_ring_allgather(comm: Communicator, dt: dataType,
         if _staged_bytes(P, n, kdtype) > VMEM_PAYLOAD_THRESHOLD:
             from . import pallas_chunked
             out = pallas_chunked.chunked_ag_body(
-                x, P=P, dtype=kdtype, segment_bytes=seg)
+                x, P=P, dtype=kdtype, segment_bytes=seg,
+                bidirectional=bidirectional)
         else:
             rows = _pad_rows(n, kdtype)
             xt = jnp.zeros((rows, _LANES), kdtype).reshape(-1)
@@ -360,7 +362,8 @@ def _rs_kernel(x_ref, o_ref, send_buf, recv_buf, send_sem, recv_sem,
 def build_pallas_ring_reduce_scatter(comm: Communicator,
                                      func: reduceFunction, dt: dataType,
                                      segment_bytes: Optional[int] = None,
-                                     arith=None) -> Callable:
+                                     arith=None,
+                                     bidirectional: bool = False) -> Callable:
     """(world, world*n) sharded in -> (world, n) sharded out; rank r ends
     owning chunk (r+1) mod P (ring schedule); the wrapper rolls chunks so
     rank r returns chunk r, matching the host-level API contract.
@@ -385,7 +388,7 @@ def build_pallas_ring_reduce_scatter(comm: Communicator,
             from . import pallas_chunked
             out = pallas_chunked.chunked_rs_body(
                 x, P=P, func=func, dtype=kdtype, segment_bytes=seg,
-                wire=wire)
+                wire=wire, bidirectional=bidirectional)
         else:
             rows = _pad_rows(n, kdtype)
             chunks = jnp.zeros((P, rows * _LANES), kdtype)
@@ -411,7 +414,8 @@ def build_pallas_ring_reduce_scatter(comm: Communicator,
 def build_pallas_ring_allreduce(comm: Communicator, func: reduceFunction,
                                 dt: dataType,
                                 segment_bytes: Optional[int] = None,
-                                arith=None) -> Callable:
+                                arith=None,
+                                bidirectional: bool = False) -> Callable:
     """RS + AG composition (fw :1888-2071). With a compressing ``arith``
     every interconnect hop of BOTH phases carries the wire dtype: the RS
     phase per the ``arith`` fold policy, the AG phase always wire-as-
@@ -434,7 +438,7 @@ def build_pallas_ring_allreduce(comm: Communicator, func: reduceFunction,
             from . import pallas_chunked
             out = pallas_chunked.chunked_ar_body(
                 pre(x), P=P, func=func, dtype=kdtype, segment_bytes=seg,
-                wire=wire, ag_wire=ag_wire)
+                wire=wire, ag_wire=ag_wire, bidirectional=bidirectional)
             return post(out, out_dtype)
         xx = pre(x)
         padded = jnp.zeros((P * chunk,), kdtype)

@@ -762,3 +762,102 @@ def test_chunked_rooted_quantized_wire(accl, rng):
         comm, 0, dataType.float32, segment_bytes=SEG, arith=arith)
     out = np.asarray(prog(_put(accl, x), _put(accl, dest)))
     np.testing.assert_array_equal(out[0].reshape(WORLD, n), x)
+
+
+# ---------------------------------------------------------------------------
+# bidirectional rings: segment parities rotate in OPPOSITE directions so
+# both directions of every ICI link carry payload (each direction moves
+# half the bytes - the 2x ceiling of a bidirectional torus link, which the
+# reference's unidirectional Ethernet rings cannot use)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("nseg", [1, 2, 3, 4])
+def test_bidirectional_rs_ag(accl, rng, nseg):
+    comm = accl.global_comm()
+    n = 1024 * nseg
+    x = rng.standard_normal((WORLD, WORLD * n)).astype(np.float32)
+    prog = pallas_chunked.build_chunked_ring_reduce_scatter(
+        comm, reduceFunction.SUM, dataType.float32, SEG, bidirectional=True)
+    out = np.asarray(prog(_put(accl, x)))
+    np.testing.assert_allclose(out, x.reshape(WORLD, WORLD, n).sum(0),
+                               rtol=1e-4, atol=1e-4)
+
+    xa = rng.standard_normal((WORLD, n)).astype(np.float32)
+    prog = pallas_chunked.build_chunked_ring_allgather(
+        comm, dataType.float32, SEG, bidirectional=True)
+    out = np.asarray(prog(_put(accl, xa)))
+    for r in range(WORLD):
+        np.testing.assert_allclose(out[r].reshape(WORLD, n), xa, rtol=1e-6)
+
+
+def test_bidirectional_allreduce_uneven(accl, rng):
+    comm = accl.global_comm()
+    n = 1024 * 3 * WORLD + 77
+    x = rng.standard_normal((WORLD, n)).astype(np.float32)
+    prog = pallas_chunked.build_chunked_ring_allreduce(
+        comm, reduceFunction.SUM, dataType.float32, SEG, bidirectional=True)
+    out = np.asarray(prog(_put(accl, x)))
+    for r in range(WORLD):
+        np.testing.assert_allclose(out[r], x.sum(0), rtol=1e-4, atol=1e-4)
+
+
+def test_bidirectional_race_free(accl, rng, monkeypatch):
+    """Counter-rotating credit chains under the race detector: the two
+    channels now signal credits in OPPOSITE directions on the same pair
+    of neighbors; their semaphore arrays must stay fully independent."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    monkeypatch.setattr(
+        pallas_ring, "_interpret_params",
+        lambda: pltpu.InterpretParams(detect_races=True))
+    comm = accl.global_comm()
+    n = 1024 * 4 * WORLD
+    x = rng.standard_normal((WORLD, n)).astype(np.float32)
+    prog = pallas_chunked.build_chunked_ring_allreduce(
+        comm, reduceFunction.SUM, dataType.float32, SEG, bidirectional=True)
+    out = np.asarray(prog(_put(accl, x)))
+    np.testing.assert_allclose(out[0], x.sum(0), rtol=1e-4, atol=1e-4)
+
+
+def test_bidirectional_compressed_wire(accl, rng):
+    """bf16 wire on both counter-rotating rings, both phases."""
+    from accl_tpu import ArithConfig
+    comm = accl.global_comm()
+    arith = ArithConfig(dataType.float32, dataType.bfloat16,
+                        arith_is_compressed=False)
+    n = 1024 * 2 * WORLD + 33
+    x = rng.integers(-10, 10, (WORLD, n)).astype(np.float32)
+    prog = pallas_chunked.build_chunked_ring_allreduce(
+        comm, reduceFunction.SUM, dataType.float32, SEG, arith=arith,
+        bidirectional=True)
+    out = np.asarray(prog(_put(accl, x)))
+    np.testing.assert_array_equal(out, np.tile(x.sum(0), (WORLD, 1)))
+
+
+def test_bidirectional_is_host_api_default(accl, rng):
+    """cfg.bidirectional_rings (default True) reaches the chunked path
+    through ACCL.allreduce with Algorithm.PALLAS."""
+    assert accl.config.bidirectional_rings
+    count = (1 << 17) * WORLD + 128  # over the VMEM->chunked threshold
+    send = accl.create_buffer(count, dataType.float32)
+    recv = accl.create_buffer(count, dataType.float32)
+    send.host[:] = rng.standard_normal(send.host.shape).astype(np.float32)
+    accl.allreduce(send, recv, count, reduceFunction.SUM,
+                   algorithm=Algorithm.PALLAS)
+    np.testing.assert_allclose(recv.host[0], send.host.sum(0),
+                               rtol=1e-4, atol=1e-3)
+
+
+@pytest.mark.parametrize("w", [2, 3, 5])
+def test_bidirectional_world_matrix(accl, rng, w):
+    import jax
+    from accl_tpu.communicator import Communicator
+    comm = Communicator(jax.devices()[:w])
+    put = lambda a: jax.device_put(a, comm.sharding())
+    n = 1024 * 3
+    x = rng.standard_normal((w, n)).astype(np.float32)
+    prog = pallas_chunked.build_chunked_ring_allreduce(
+        comm, reduceFunction.SUM, dataType.float32, SEG, bidirectional=True)
+    out = np.asarray(prog(put(x)))
+    for r in range(w):
+        np.testing.assert_allclose(out[r], x.sum(0), rtol=1e-4, atol=1e-4)
