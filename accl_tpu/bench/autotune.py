@@ -42,7 +42,9 @@ def _time_prog(prog, *args, reps: int) -> float:
 def measure_allreduce(comm, counts: Sequence[int],
                       algos: Sequence[Algorithm],
                       dt: dataType = dataType.float32,
-                      reps: int = 3) -> Dict[Algorithm, List[float]]:
+                      reps: int = 3,
+                      bidirectional: bool = False
+                      ) -> Dict[Algorithm, List[float]]:
     """Per-algorithm best-of-`reps` wall time for each payload count."""
     import jax
     npdt = np.dtype(to_jax_dtype(dt))
@@ -50,7 +52,8 @@ def measure_allreduce(comm, counts: Sequence[int],
     for algo in algos:
         for n in counts:
             prog = algorithms.build_allreduce(
-                comm, reduceFunction.SUM, dt, algo, None)
+                comm, reduceFunction.SUM, dt, algo, None,
+                bidirectional=bidirectional)
             x = jax.device_put(
                 np.full((comm.world_size, n), 1e-6, npdt), comm.sharding())
             out[algo].append(_time_prog(prog, x, reps=reps))
@@ -88,7 +91,8 @@ def autotune_allreduce(acc, pows: Sequence[int] = (10, 14, 18, 21),
         # the RDMA-over-ICI kernels only make sense on real chip links —
         # interpret mode on the emulator rung would measure the simulator
         algos.append(Algorithm.PALLAS)
-    t = measure_allreduce(comm, counts, algos, dt, reps)
+    t = measure_allreduce(comm, counts, algos, dt, reps,
+                          bidirectional=acc.config.bidirectional_rings)
 
     ring_at = _crossover(counts, t[Algorithm.XLA], t[Algorithm.RING], elem)
     cfg = acc.config.replace(
@@ -113,13 +117,16 @@ def autotune_allreduce(acc, pows: Sequence[int] = (10, 14, 18, 21),
 def measure_allgather(comm, counts: Sequence[int],
                       algos: Sequence[Algorithm],
                       dt: dataType = dataType.float32,
-                      reps: int = 3) -> Dict[Algorithm, List[float]]:
+                      reps: int = 3,
+                      bidirectional: bool = False
+                      ) -> Dict[Algorithm, List[float]]:
     import jax
     npdt = np.dtype(to_jax_dtype(dt))
     out: Dict[Algorithm, List[float]] = {a: [] for a in algos}
     for algo in algos:
         for n in counts:
-            prog = algorithms.build_allgather(comm, algo, None, dt, None)
+            prog = algorithms.build_allgather(comm, algo, None, dt, None,
+                                              bidirectional=bidirectional)
             x = jax.device_put(
                 np.full((comm.world_size, n), 1e-6, npdt), comm.sharding())
             out[algo].append(_time_prog(prog, x, reps=reps))
@@ -129,7 +136,9 @@ def measure_allgather(comm, counts: Sequence[int],
 def measure_reduce_scatter(comm, counts: Sequence[int],
                            algos: Sequence[Algorithm],
                            dt: dataType = dataType.float32,
-                           reps: int = 3) -> Dict[Algorithm, List[float]]:
+                           reps: int = 3,
+                           bidirectional: bool = False
+                           ) -> Dict[Algorithm, List[float]]:
     import jax
     npdt = np.dtype(to_jax_dtype(dt))
     W = comm.world_size
@@ -137,7 +146,8 @@ def measure_reduce_scatter(comm, counts: Sequence[int],
     for algo in algos:
         for n in counts:
             prog = algorithms.build_reduce_scatter(
-                comm, reduceFunction.SUM, dt, algo, None)
+                comm, reduceFunction.SUM, dt, algo, None,
+                bidirectional=bidirectional)
             x = jax.device_put(
                 np.full((W, W * n), 1e-6, npdt), comm.sharding())
             out[algo].append(_time_prog(prog, x, reps=reps))
@@ -158,7 +168,8 @@ def autotune_allgather(acc, cfg: ACCLConfig,
     on_ici = acc.config.transport == TransportBackend.ICI
     if on_ici:
         algos.append(Algorithm.PALLAS)
-    t = measure_allgather(comm, counts, algos, dt, reps)
+    t = measure_allgather(comm, counts, algos, dt, reps,
+                          bidirectional=acc.config.bidirectional_rings)
     at = _crossover(counts, t[Algorithm.XLA], t[Algorithm.RING], elem)
     cfg = cfg.replace(ag_ring_threshold=at if at is not None else DISABLED)
     if on_ici:
@@ -184,7 +195,8 @@ def autotune_reduce_scatter(acc, cfg: ACCLConfig,
     on_ici = acc.config.transport == TransportBackend.ICI
     if on_ici:
         algos.append(Algorithm.PALLAS)
-    t = measure_reduce_scatter(comm, counts, algos, dt, reps)
+    t = measure_reduce_scatter(comm, counts, algos, dt, reps,
+                               bidirectional=acc.config.bidirectional_rings)
     at = _crossover(counts, t[Algorithm.XLA], t[Algorithm.RING], elem)
     cfg = cfg.replace(rs_ring_threshold=at if at is not None else DISABLED)
     if on_ici:

@@ -110,7 +110,8 @@ def _build_combine_best(comm: Communicator, func: reduceFunction,
 
 
 def _cases(comm: Communicator, dt: dataType, func: reduceFunction,
-           algo: Algorithm) -> Dict[str, _Case]:
+           algo: Algorithm,
+           bidirectional: bool = True) -> Dict[str, _Case]:
     world = comm.world_size
     npdt = np.dtype(to_jax_dtype(dt))
 
@@ -151,7 +152,8 @@ def _cases(comm: Communicator, dt: dataType, func: reduceFunction,
             chain_adapt=lambda out: out[:, : out.shape[1] // comm.world_size]),
         "allgather": _Case(
             operation.allgather,
-            lambda: algorithms.build_allgather(comm, algo, None, dt),
+            lambda: algorithms.build_allgather(
+                comm, algo, None, dt, bidirectional=bidirectional),
             lambda n: (flat(n),),
             chain_adapt=lambda out: out[:, : out.shape[1] // comm.world_size]),
         "reduce": _Case(
@@ -160,11 +162,13 @@ def _cases(comm: Communicator, dt: dataType, func: reduceFunction,
             lambda n: (flat(n), flat(n, 0.0))),
         "allreduce": _Case(
             operation.allreduce,
-            lambda: algorithms.build_allreduce(comm, func, dt, algo, None),
+            lambda: algorithms.build_allreduce(comm, func, dt, algo, None,
+                                               bidirectional=bidirectional),
             lambda n: (flat(n, 1e-6),)),
         "reduce_scatter": _Case(
             operation.reduce_scatter,
-            lambda: algorithms.build_reduce_scatter(comm, func, dt, algo, None),
+            lambda: algorithms.build_reduce_scatter(
+                comm, func, dt, algo, None, bidirectional=bidirectional),
             lambda n: (wide(n, 1e-6),),
             chain_adapt=lambda out: jnp.tile(out, (1, comm.world_size)),
             payload_bytes=lambda n: n * comm.world_size * dtype_size(dt)),
@@ -290,12 +294,15 @@ def run_sweep(
     link_bw: float = 45e9,
     rtt: float = 1e-6,
     pows: Optional[Sequence[int]] = None,
+    bidirectional: bool = True,
 ) -> List[SweepRow]:
     """Sweep ``ops`` over 2^min_pow..2^max_pow elements (bench.cpp matrix).
 
     ``pows`` overrides the contiguous range with an explicit list of
-    exponents (the headline bench samples a sparse sweep)."""
-    cases = _cases(comm, dt, func, algorithm)
+    exponents (the headline bench samples a sparse sweep).
+    ``bidirectional`` matches ACCLConfig.bidirectional_rings' default so
+    the sweep measures the kernel the host API actually dispatches."""
+    cases = _cases(comm, dt, func, algorithm, bidirectional)
     unknown = [o for o in ops if o not in cases]
     if unknown:
         raise ValueError(f"unknown ops {unknown}; have {sorted(cases)}")

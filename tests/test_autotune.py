@@ -88,7 +88,7 @@ def test_tuned_config_changes_selection(accl, monkeypatch):
     on flip the allgather selection relative to the defaults."""
     counts = [2 ** 6, 2 ** 9]
 
-    def fake_measure(comm, cs, algos, dt, reps):
+    def fake_measure(comm, cs, algos, dt, reps, bidirectional=False):
         assert list(cs) == counts
         return {Algorithm.XLA: [1.0, 1.0],
                 Algorithm.RING: [2.0, 0.5]}  # wins from index 1 on
@@ -111,7 +111,7 @@ def test_autotune_pallas_crossover_on_ici(accl, monkeypatch):
     from accl_tpu.config import TransportBackend
     counts = [2 ** 6, 2 ** 9]
 
-    def fake_measure(comm, cs, algos, dt, reps):
+    def fake_measure(comm, cs, algos, dt, reps, bidirectional=False):
         assert Algorithm.PALLAS in algos
         t = {a: [1.0, 1.0] for a in algos}
         t[Algorithm.RING] = [3.0, 3.0]
