@@ -848,6 +848,25 @@ def test_bidirectional_is_host_api_default(accl, rng):
                                rtol=1e-4, atol=1e-3)
 
 
+@pytest.mark.skipif(
+    not os.environ.get("ACCL_BIG_PAYLOAD"),
+    reason="64 MiB interpret-mode run; set ACCL_BIG_PAYLOAD=1 to enable")
+def test_bidirectional_64mib(accl):
+    """Counter-rotating rings at HBM scale (the shipped host-API default
+    at large payloads)."""
+    import jax
+    import jax.numpy as jnp
+    comm = accl.global_comm()
+    n = (64 * 1024 * 1024) // 4  # 64 MiB of f32 per rank
+    x = jnp.ones((WORLD, n), jnp.float32)
+    prog = pallas_chunked.build_chunked_ring_allreduce(
+        comm, reduceFunction.SUM, dataType.float32, segment_bytes=1 << 20,
+        bidirectional=True)
+    out = prog(jax.device_put(x, comm.sharding()))
+    assert float(out[0, 0]) == float(WORLD)
+    assert float(out[0, -1]) == float(WORLD)
+
+
 @pytest.mark.parametrize("w", [2, 3, 5])
 def test_bidirectional_world_matrix(accl, rng, w):
     import jax
