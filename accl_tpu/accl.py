@@ -29,7 +29,7 @@ from . import constants
 from .arithconfig import DEFAULT_ARITH_CONFIG, ArithConfig
 from .buffer import BaseBuffer, Buffer, BufferSlice, DummyBuffer
 from .communicator import Communicator
-from .config import ACCLConfig, Algorithm
+from .config import ACCLConfig, Algorithm, TransportBackend
 from .constants import (
     ACCLError,
     TAG_ANY,
@@ -46,11 +46,6 @@ from .sendrecv import MatchingEngine, RecvPost, SendPost
 from .utils.logging import get_logger
 
 log = get_logger("accl")
-
-#: process-global autotune-decision epoch — survives ACCL instance
-#: teardown because the coordination-service KV (where decisions are
-#: published under accl/tune/<epoch>) does too
-_tune_epoch = 0
 
 BufLike = Union[Buffer, BufferSlice]
 
@@ -294,10 +289,18 @@ class ACCL:
             self._programs.clear()
             return
 
+        # topology-qualified fingerprint: world size alone would let a cache
+        # tuned on a different mesh shape or chip generation (4x2 vs 8x1
+        # ICI, v5e vs v6e) load silently with stale thresholds (ADVICE r3
+        # #2) — the reference analog is one register file per installed
+        # fabric, not per fabric SIZE
+        hs = self.global_comm().hosts_shape()
         fp = {"world": self.world_size,
               "transport": (self.config.transport.value
                             if self.config.transport else None),
-              "schema": 1}
+              "device": getattr(self._devices[0], "device_kind", "cpu"),
+              "hosts": list(hs) if hs is not None else None,
+              "schema": 2}
 
         def try_read():
             """(validated config, raw text), or (None, None) for any
@@ -318,19 +321,21 @@ class ACCL:
                 return None, None
 
         if self._fabric is not None:
-            # decision must be mesh-uniform: p0 decides, everyone
-            # follows. The decision key counts with a PROCESS-GLOBAL
-            # epoch (not a per-instance one): the coordination service's
-            # KV outlives ACCL instances within a job, so a fresh
-            # instance restarting at epoch 1 would read a stale earlier
-            # instance's decision (and p0's re-set of the existing key
-            # would fail) — the SPMD call discipline makes the global
-            # counter advance identically on every process
-            global _tune_epoch
-            _tune_epoch += 1
+            # decision must be mesh-uniform: p0 decides, everyone follows.
+            # The decision key is numbered by a KV-DERIVED round, not a
+            # module-global epoch: the KV store outlives controller
+            # restarts, so a restarted process counting from 0 would read
+            # a stale earlier instance's decision (ADVICE r3 #4). Each
+            # call increments a persistent arrivals counter; the exit
+            # barrier below guarantees all n arrivals of call k land
+            # before any process increments for call k+1, so the blocks
+            # stay n-aligned and (arrive-1)//n is identical mesh-wide —
+            # and monotonic across restarts, so keys never collide.
             from . import multiproc as _mp
             client = _mp._client()
-            key = f"accl/tune/{_tune_epoch}"
+            n = jax.process_count()
+            arrive = self._fabric._kincr(client, "accl/tune/round")
+            key = f"accl/tune/d/{(arrive - 1) // n}"
             if jax.process_index() == 0:
                 cfg, text = try_read()
                 self._fabric._kset(client, key,
@@ -343,6 +348,9 @@ class ACCL:
                 self.config = measure()
                 if jax.process_index() == 0:
                     self.config.save(cache_path, fingerprint=fp)
+            # exit barrier: no process may start the NEXT autotune round's
+            # increment until every process has arrived in THIS one
+            self._fabric.barrier("tune", pump=self._pump)
         else:
             cfg, _ = try_read()
             if cfg is not None:
@@ -627,11 +635,12 @@ class ACCL:
                  if algo == Algorithm.FLAT else 0)
         seg = self.config.segment_size
         bidir = self.config.bidirectional_rings
+        on_dcn = self.config.transport == TransportBackend.DCN
         return (self._key(comm, operation.allreduce, count, dtype, function,
-                          compress_dtype, algo, seg, fanin, bidir),
+                          compress_dtype, algo, seg, fanin, bidir, on_dcn),
                 lambda: algorithms.build_allreduce(comm, function, dtype,
                                                    algo, arith, seg, fanin,
-                                                   bidir))
+                                                   bidir, on_dcn=on_dcn))
 
     def _spec_reduce_scatter(self, comm, count: int, dtype: dataType,
                              function: reduceFunction, compress_dtype,

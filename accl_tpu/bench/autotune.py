@@ -83,7 +83,11 @@ def autotune_allreduce(acc, pows: Sequence[int] = (10, 14, 18, 21),
     counts = [2 ** p for p in pows]
     elem = np.dtype(to_jax_dtype(dt)).itemsize
     algos = [Algorithm.XLA, Algorithm.RING]
-    has_hier = algorithms._hier_shape(comm) is not None
+    # same on_dcn guard as select(): on a DCN mesh without a host-aligned
+    # shape, HIERARCHICAL would measure the factor2d split select() never
+    # takes — and write a threshold nothing honors (ADVICE r3 #1)
+    on_dcn = acc.config.transport == TransportBackend.DCN
+    has_hier = algorithms._hier_shape(comm, on_dcn) is not None
     if has_hier:
         algos.append(Algorithm.HIERARCHICAL)
     on_ici = acc.config.transport == TransportBackend.ICI

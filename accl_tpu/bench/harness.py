@@ -81,6 +81,9 @@ class _Case:
     chain_adapt: Optional[Callable] = None
     # bytes moved per rank for algbw accounting (defaults to count*dtsize)
     payload_bytes: Optional[Callable[[int], int]] = None
+    # in-place variant for the fused (loop-carry) accounting: output
+    # aliases the carry operand so the chain streams with no copy
+    build_fused: Optional[Callable[[], Callable]] = None
 
 
 def _dev(comm: Communicator, arr: np.ndarray):
@@ -88,13 +91,16 @@ def _dev(comm: Communicator, arr: np.ndarray):
 
 
 def _build_combine_best(comm: Communicator, func: reduceFunction,
-                        dt: dataType):
+                        dt: dataType, donate: bool = False):
     """combine through the Pallas reduce_ops lane on TPU, jnp elsewhere.
     Pallas failures surface at first trace, not at build — smoke-execute
-    on tiny inputs before accepting the lane."""
+    on tiny inputs before accepting the lane. ``donate`` builds the
+    in-place chain variant (output aliases operand 0) used by the fused
+    accounting, where the loop carry is dead after each step."""
     use_pallas = jax.default_backend() == "tpu"
     for pallas in ([True, False] if use_pallas else [False]):
-        prog = primitives.build_combine(comm, func, dt, use_pallas=pallas)
+        prog = primitives.build_combine(comm, func, dt, use_pallas=pallas,
+                                        donate=donate and pallas)
         try:
             tiny = _dev(comm, np.zeros((comm.world_size, 256),
                                        np.dtype(to_jax_dtype(dt))))
@@ -111,7 +117,8 @@ def _build_combine_best(comm: Communicator, func: reduceFunction,
 
 def _cases(comm: Communicator, dt: dataType, func: reduceFunction,
            algo: Algorithm,
-           bidirectional: bool = True) -> Dict[str, _Case]:
+           bidirectional: bool = True,
+           on_dcn: bool = False) -> Dict[str, _Case]:
     world = comm.world_size
     npdt = np.dtype(to_jax_dtype(dt))
 
@@ -131,7 +138,9 @@ def _cases(comm: Communicator, dt: dataType, func: reduceFunction,
         "combine": _Case(
             operation.combine,
             lambda: _build_combine_best(comm, func, dt),
-            lambda n: (flat(n), flat(n, 2.0))),
+            lambda n: (flat(n), flat(n, 2.0)),
+            build_fused=lambda: _build_combine_best(comm, func, dt,
+                                                    donate=True)),
         "sendrecv": _Case(
             operation.send,
             lambda: primitives.build_move(comm, 0, (1 % world)),
@@ -163,7 +172,8 @@ def _cases(comm: Communicator, dt: dataType, func: reduceFunction,
         "allreduce": _Case(
             operation.allreduce,
             lambda: algorithms.build_allreduce(comm, func, dt, algo, None,
-                                               bidirectional=bidirectional),
+                                               bidirectional=bidirectional,
+                                               on_dcn=on_dcn),
             lambda n: (flat(n, 1e-6),)),
         "reduce_scatter": _Case(
             operation.reduce_scatter,
@@ -295,21 +305,26 @@ def run_sweep(
     rtt: float = 1e-6,
     pows: Optional[Sequence[int]] = None,
     bidirectional: bool = True,
+    on_dcn: bool = False,
 ) -> List[SweepRow]:
     """Sweep ``ops`` over 2^min_pow..2^max_pow elements (bench.cpp matrix).
 
     ``pows`` overrides the contiguous range with an explicit list of
     exponents (the headline bench samples a sparse sweep).
     ``bidirectional`` matches ACCLConfig.bidirectional_rings' default so
-    the sweep measures the kernel the host API actually dispatches."""
-    cases = _cases(comm, dt, func, algorithm, bidirectional)
+    the sweep measures the kernel the host API actually dispatches.
+    ``on_dcn`` mirrors the production DCN guard: a HIERARCHICAL sweep on
+    a DCN mesh without a host-aligned shape fails loudly instead of
+    benchmarking the factor2d split select() refuses to take."""
+    cases = _cases(comm, dt, func, algorithm, bidirectional, on_dcn)
     unknown = [o for o in ops if o not in cases]
     if unknown:
         raise ValueError(f"unknown ops {unknown}; have {sorted(cases)}")
     rows: List[SweepRow] = []
     for name in ops:
         case = cases[name]
-        prog = case.build()
+        prog = (case.build_fused() if mode == "fused" and case.build_fused
+                else case.build())
         for p in (pows if pows is not None else range(min_pow, max_pow + 1)):
             n = 2 ** p
             args = case.make_inputs(n)

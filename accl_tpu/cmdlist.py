@@ -321,7 +321,7 @@ class CommandList:
                   slots[s.out_id], s.out_count, s.out_dtype)
                  for i, s in enumerate(self._steps)]
 
-        def composite(arrays):
+        def composite(*arrays):
             state = list(arrays)
             for prog, in_slots, in_counts, out_slot, out_count, odt in steps:
                 ins = []
@@ -339,11 +339,34 @@ class CommandList:
                         cur, out.astype(cur.dtype), (0, 0))
             return tuple(state)
 
-        fused = acc._programs.get(
-            self._composite_key([k for k, _ in resolved]),
-            lambda: jax.jit(composite))
         arrays = tuple(self._buffers[b].device_view() for b in order)
-        results = fused(arrays)
+        # Donate written slots so the composite streams buffer-to-buffer in
+        # place — the datapath never re-buffers payload between chained
+        # stages (the reference's dma_mover streams segments stage-to-stage,
+        # dma_mover.cpp:514-699). Donation must stand down for:
+        #   * slots whose OWNING Buffer is shared with another slot (a
+        #     Buffer and any BufferSlice of it bound in one list): the twin
+        #     slot's view or post-execute device_store would touch the
+        #     donated (deleted) parent array;
+        #   * any moment with an outstanding async Request — its held
+        #     outputs may be these very arrays, and wait() on a deleted
+        #     array raises.
+        # Donation is a TPU-runtime feature; the CPU emulator rung ignores
+        # it with a warning, so gate on backend.
+        from .buffer import BufferSlice
+
+        written_slots = {slots[s.out_id] for s in self._steps}
+        owners = [id(self._buffers[b].parent)
+                  if isinstance(self._buffers[b], BufferSlice)
+                  else id(self._buffers[b]) for b in order]
+        shared = {i for i, o in enumerate(owners) if owners.count(o) > 1}
+        donate = (tuple(sorted(written_slots - shared))
+                  if jax.default_backend() == "tpu"
+                  and not acc._queue.has_inflight() else ())
+        fused = acc._programs.get(
+            self._composite_key([k for k, _ in resolved]) + (donate,),
+            lambda: jax.jit(composite, donate_argnums=donate))
+        results = fused(*arrays)
         written = {s.out_id for s in self._steps}
         out_bufs = []
         for bid, res in zip(order, results):

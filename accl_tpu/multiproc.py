@@ -174,8 +174,9 @@ class CrossProcessFabric:
         # pair-mesh move programs keyed (sdev, ddev, count, wire dtype)
         self._progs: Dict[tuple, tuple] = {}
         # barrier arrivals that timed out before their round completed:
-        # name -> target count still owed (consumed by the next call)
-        self._barrier_pending: Dict[str, int] = {}
+        # name -> (target count still owed, participant count) — consumed
+        # by the next call, which must use the same participant set
+        self._barrier_pending: Dict[str, Tuple[int, int]] = {}
         #: control bytes written to the KV store (keys + values) — the
         #: accounting that proves payload rides the device path
         self.kv_bytes = 0
@@ -479,11 +480,21 @@ class CrossProcessFabric:
         client = _client()
         n = len(process_ids) if process_ids is not None else jax.process_count()
         key = f"accl/b/{name}"
-        target = self._barrier_pending.get(key)
-        if target is None:
+        pending = self._barrier_pending.get(key)
+        if pending is not None and pending[1] != n:
+            # a retry with a different participant set would silently
+            # re-wait the stale round's target (ADVICE r3 #3) — the retry
+            # contract is same-name, same-scope
+            raise ACCLError(
+                errorCode.CONFIG_ERROR,
+                f"barrier {name!r}: retry with {n} participants, but the "
+                f"pending timed-out round expected {pending[1]}")
+        if pending is None:
             arrive = self._kincr(client, key)
             target = ((arrive - 1) // n + 1) * n
-            self._barrier_pending[key] = target
+            self._barrier_pending[key] = (target, n)
+        else:
+            target = pending[0]
         deadline = time.monotonic() + self.timeout
         progress = pump or self.drive
         while int(self._try_get(client, key) or 0) < target:

@@ -40,7 +40,23 @@ PALLAS_DTYPES = (dataType.float32, dataType.bfloat16, dataType.float16,
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    # pallas_ring.aot_lowering() must cover this lane too: an AOT compile
+    # for a TPU topology from a CPU-backend host forces compiled kernels
+    from ..parallel import pallas_ring as _pr
+    return jax.default_backend() != "tpu" and not _pr._force_compile
+
+
+#: wide-block geometry for HBM-bound sizes: a (512, 512) f32 block is 1 MiB,
+#: large enough that the per-grid-step pipeline overhead amortizes away
+#: (measured ~1.5-1.8x over the (256, 128) tile at 64 MiB on a v5e)
+_WIDE_LANES = 512
+_WIDE_ROWS = 512
+
+
+def _rows_for(lanes: int) -> int:
+    """Block rows for a lane width — the single source of the tile
+    geometry shared by the pad computation and the BlockSpec."""
+    return _WIDE_ROWS if lanes == _WIDE_LANES else _BLOCK_ROWS
 
 
 def _combine_kernel(a_ref, b_ref, o_ref, *, func: reduceFunction):
@@ -50,12 +66,22 @@ def _combine_kernel(a_ref, b_ref, o_ref, *, func: reduceFunction):
         o_ref[:] = jnp.maximum(a_ref[:], b_ref[:])
 
 
-@functools.partial(jax.jit, static_argnames=("func",))
-def _pallas_combine_2d(a, b, func: reduceFunction):
-    """Tiled elementwise combine over a (M, 128) layout."""
-    m = a.shape[0]
-    grid = (pl.cdiv(m, _BLOCK_ROWS),)
-    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
+@functools.partial(jax.jit, static_argnames=("func", "donate"))
+def _pallas_combine_2d(a, b, func: reduceFunction, donate: bool = False):
+    """Tiled elementwise combine over a (M, lanes) layout.
+
+    ``donate`` sets ``input_output_aliases={0: 0}``: the output occupies
+    operand 0's buffer, so a chain (``lax.fori_loop`` carry, CommandList
+    step) updates in place with no loop-carry copy — the TPU analog of the
+    reference datapath streaming payload between stages without
+    re-buffering (``dma_mover.cpp:514-699``). XLA inserts a defensive copy
+    if operand 0 is still live, so standalone callers pass donate=False to
+    keep the plain 3x-payload traffic.
+    """
+    m, lanes = a.shape
+    rows = _rows_for(lanes)
+    grid = (pl.cdiv(m, rows),)
+    spec = pl.BlockSpec((rows, lanes), lambda i: (i, 0),
                         memory_space=pltpu.VMEM)
     return pl.pallas_call(
         functools.partial(_combine_kernel, func=func),
@@ -64,22 +90,38 @@ def _pallas_combine_2d(a, b, func: reduceFunction):
         in_specs=[spec, spec],
         out_specs=spec,
         interpret=_interpret(),
+        **({"input_output_aliases": {0: 0}} if donate else {}),
     )(a, b)
 
 
-def pallas_combine(a, b, func: reduceFunction):
-    """a ⊕ b for arbitrary shapes via the Pallas lane (pads to tile grid)."""
+def pallas_combine(a, b, func: reduceFunction, *, donate: bool = False):
+    """a ⊕ b for arbitrary shapes via the Pallas lane (pads to tile grid).
+
+    Large buffers that divide the wide (512, 512) tile take the wide-block
+    geometry (1 MiB blocks — per-step pipeline overhead amortized); others
+    keep the (256, 128) tile so padding stays small. ``donate`` aliases the
+    output onto operand 0 for in-place chain execution (see
+    :func:`_pallas_combine_2d`).
+    """
     shape = a.shape
     flat_a = a.reshape(-1)
     flat_b = b.reshape(-1)
     n = flat_a.shape[0]
-    tile = _BLOCK_ROWS * _LANES
+    wide_tile = _WIDE_ROWS * _WIDE_LANES
+    # wide only when it divides evenly — jnp.pad copies the whole array,
+    # which would cost more than the wide blocks save
+    if n >= wide_tile and n % wide_tile == 0:
+        lanes = _WIDE_LANES
+    else:
+        lanes = _LANES
+    tile = _rows_for(lanes) * lanes
     pad = (-n) % tile
     if pad:
         flat_a = jnp.pad(flat_a, (0, pad))
         flat_b = jnp.pad(flat_b, (0, pad))
     out = _pallas_combine_2d(
-        flat_a.reshape(-1, _LANES), flat_b.reshape(-1, _LANES), func
+        flat_a.reshape(-1, lanes), flat_b.reshape(-1, lanes), func,
+        donate=donate,
     ).reshape(-1)
     if pad:
         out = out[:n]

@@ -263,7 +263,8 @@ def build_allreduce(comm, func: reduceFunction, dt: dataType, algo: Algorithm,
                     arith: Optional[ArithConfig],
                     segment_bytes: Optional[int] = None,
                     fanin: int = 0,
-                    bidirectional: bool = False) -> Callable:
+                    bidirectional: bool = False,
+                    on_dcn: bool = False) -> Callable:
     if algo == Algorithm.PALLAS:
         return pallas_ring.build_pallas_ring_allreduce(
             comm, func, dt, segment_bytes, arith=arith,
@@ -275,10 +276,16 @@ def build_allreduce(comm, func: reduceFunction, dt: dataType, algo: Algorithm,
     if algo == Algorithm.TREE:
         return tree.build_tree_allreduce(comm, func, dt, arith)
     if algo == Algorithm.HIERARCHICAL:
-        rc = _hier_shape(comm)
+        # on_dcn: an explicit HIERARCHICAL request on a DCN mesh without a
+        # host-aligned shape must fail loudly, not take the factor2d split
+        # that puts the bandwidth-heavy phase on DCN links (the same trap
+        # select() avoids — ADVICE r3 #1)
+        rc = _hier_shape(comm, on_dcn)
         if rc is None:
             raise ValueError(
-                f"hierarchical allreduce needs a composite world, got {comm.world_size}"
+                "hierarchical allreduce needs a composite world"
+                + (" with a host-aligned 2-D shape on DCN" if on_dcn else "")
+                + f", got world={comm.world_size}"
             )
         return hierarchical.build_hier_allreduce(comm, rc[0], rc[1], func, dt, arith)
     return primitives.build_allreduce(comm, func, dt, arith)

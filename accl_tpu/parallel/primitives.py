@@ -83,19 +83,21 @@ def build_copy(comm: Communicator) -> Callable:
 
 
 def build_combine(comm: Communicator, func: reduceFunction, dt: dataType,
-                  use_pallas: bool = False) -> Callable:
+                  use_pallas: bool = False, donate: bool = False) -> Callable:
     """``ACCL::combine`` — per-rank elementwise reduce of two operands.
 
     ``use_pallas`` routes through the explicit Pallas reduce_ops lane
     (standalone VMEM-tiled kernel, the plugin-architecture analog);
-    otherwise the registry's fused jnp path.
+    otherwise the registry's fused jnp path. ``donate`` aliases the result
+    onto operand 0 inside the Pallas lane so chained execution (fused
+    loops, CommandList steps) updates in place — no loop-carry copy.
     """
     if use_pallas:
         from ..ops import reduce_ops
 
         if dt in reduce_ops.PALLAS_DTYPES:
             def body(a, b):
-                return reduce_ops.pallas_combine(a, b, func)
+                return reduce_ops.pallas_combine(a, b, func, donate=donate)
 
             return _smap(comm, body, 2)
 

@@ -260,6 +260,13 @@ class RequestQueue:
                 self._history.append(req)
                 del self._history[: -self._max_history]
 
+    def has_inflight(self) -> bool:
+        """True while any issued request has not completed — buffer donation
+        must stand down then (an outstanding async Request may still hold
+        device arrays that donation would delete)."""
+        with self._lock:
+            return bool(self._inflight)
+
     def cancel_externals(self) -> None:
         """Cancel parked externally-completed requests (unmatched async recvs);
         cancellation triggers their on_complete retirement."""

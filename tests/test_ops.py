@@ -32,6 +32,47 @@ def test_pallas_combine_2d_shape(rng):
     np.testing.assert_allclose(np.asarray(got), np.asarray(a + b))
 
 
+@pytest.mark.parametrize("n", [
+    reduce_ops._WIDE_ROWS * reduce_ops._WIDE_LANES,       # wide geometry
+    2 * reduce_ops._WIDE_ROWS * reduce_ops._WIDE_LANES,   # multi-block wide
+    reduce_ops._WIDE_ROWS * reduce_ops._WIDE_LANES + 128,  # falls back narrow
+])
+@pytest.mark.parametrize("donate", [False, True])
+def test_pallas_combine_wide_and_donate(rng, n, donate):
+    """The wide-block geometry and the donate (in-place alias) lane both
+    produce exact results; with donate=True the ORIGINAL operand stays
+    readable afterwards — under jit, XLA inserts the defensive copy when
+    the aliased operand is still live (the standalone-call contract)."""
+    a = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    b = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    a_host = np.asarray(a).copy()
+    got = reduce_ops.pallas_combine(a, b, reduceFunction.SUM, donate=donate)
+    np.testing.assert_array_equal(np.asarray(got), a_host + np.asarray(b))
+    # operand 0 must survive the aliased call (defensive-copy contract)
+    np.testing.assert_array_equal(np.asarray(a), a_host)
+
+
+def test_pallas_combine_donate_chain_matches(rng):
+    """A fori_loop chain over the donated lane — the fused/CommandList
+    execution model — accumulates exactly like the non-donated lane."""
+    import jax
+    from jax import lax
+
+    n = reduce_ops._WIDE_ROWS * reduce_ops._WIDE_LANES
+    a = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    b = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    k = 5
+
+    def chain(donate):
+        def body(_, v):
+            return reduce_ops.pallas_combine(v, b, reduceFunction.SUM,
+                                             donate=donate)
+        return jax.jit(lambda x: lax.fori_loop(0, k, body, x))(a)
+
+    np.testing.assert_array_equal(np.asarray(chain(True)),
+                                  np.asarray(chain(False)))
+
+
 @pytest.mark.parametrize("src,dst", [(jnp.float32, jnp.bfloat16),
                                      (jnp.bfloat16, jnp.float32),
                                      (jnp.float32, jnp.float16),
