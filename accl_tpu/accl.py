@@ -775,10 +775,16 @@ class ACCL:
         the cross-process mover — a parked async send may still need to
         announce while this process blocks here) until ``pred()`` holds;
         NOT_READY on session timeout."""
+        from .multiproc import CrossProcessFabric
+
         deadline = time.monotonic() + self.config.timeout
+        idle = 0
         while not pred():
             if not self._pump():
-                time.sleep(0.002)
+                idle += 1
+                CrossProcessFabric.poll_sleep(idle)
+            else:
+                idle = 0
             if time.monotonic() > deadline:
                 raise ACCLError(errorCode.NOT_READY_ERROR, what)
 

@@ -41,6 +41,15 @@ from . import models
 _pick = jax.jit(lambda v: v.ravel()[0])
 
 
+def _salt_scalar(dtype, i: int):
+    """Per-invocation input perturbation that survives the payload dtype:
+    nonzero for integers, representable (no underflow) for bf16/f16."""
+    import jax.numpy as jnp
+    if jnp.issubdtype(dtype, jnp.integer):
+        return jnp.asarray(i % 113 + 1, dtype)
+    return jnp.asarray(i * 1e-6, dtype)
+
+
 @dataclasses.dataclass
 class Timing:
     """One measurement with its in-run spread: ``best`` is the reported
@@ -206,7 +215,7 @@ def _time_block(prog, args, reps: int) -> Timing:
 
 
 def time_fused(prog, args, adapt=None, nbytes: int = 0,
-               est_bw: float = 700e9, target_s: float = 0.25,
+               est_bw: float = 700e9, target_s: float = 1.0,
                rounds: int = 3) -> Timing:
     """Per-op device time with the chain INSIDE one jitted program
     (``lax.fori_loop``): one launch per measurement, so host dispatch —
@@ -222,22 +231,34 @@ def time_fused(prog, args, adapt=None, nbytes: int = 0,
 
     rest = args[1:]
 
+    # every invocation perturbs the loop init with a FRESH scalar: the
+    # tunneled runtime caches repeat executions of (program, identical
+    # inputs) — measured round 4: a constant-input loop returned in
+    # 0.1 ms total, no launch at all. The x + s pass runs once per
+    # launch, outside the loop, so it cancels out of the slope.
+    _salt = iter(range(1, 1 << 30))
+
     def make(k: int):
-        def chained(x):
+        def chained(x, s):
             def body(_, v):
                 out = prog(v, *rest)
                 return adapt(out) if adapt is not None else out
-            return lax.fori_loop(0, k, body, x)
+            return lax.fori_loop(0, k, body,
+                                 x + s.astype(x.dtype))
         return jax.jit(chained)
 
+    # target ~1 s of DEVICE work in the long chain: the tunneled runtime's
+    # fixed launch cost is ~100 ms (measured round 4), so a short chain
+    # leaves launch/k dominating the conservative floor below
     est = max(3 * nbytes / est_bw, 2e-6)
-    k_long = int(min(max(target_s / est, 64), 8192))
+    k_long = int(min(max(target_s / est, 64), 16384))
     k_short = max(k_long // 8, 8)
     long_f, short_f = make(k_long), make(k_short)
 
     def once(f) -> float:
+        s = _salt_scalar(args[0].dtype, next(_salt))
         t0 = time.perf_counter()
-        float(np.asarray(_pick(jax.block_until_ready(f(args[0])))))
+        float(np.asarray(_pick(jax.block_until_ready(f(args[0], s)))))
         return time.perf_counter() - t0
 
     once(short_f)  # compile + warm
@@ -263,8 +284,12 @@ def time_chain(prog, args, adapt=None, nbytes: int = 0,
     each: slope = (t_long - t_short)/(k_long - k_short). The single shared
     implementation — the repo-root ``bench.py`` headline uses it too.
     ``rounds`` independent slope estimates carry the in-run spread."""
+    # fresh-scalar perturbation per run: defeats the tunneled runtime's
+    # repeat-execution cache (see time_fused)
+    _salt = iter(range(1, 1 << 30))
+
     def run(k: int) -> None:
-        x = args[0]
+        x = args[0] + _salt_scalar(args[0].dtype, next(_salt))
         for _ in range(k):
             out = prog(x, *args[1:])
             x = adapt(out) if adapt is not None else out

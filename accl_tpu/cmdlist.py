@@ -281,13 +281,18 @@ class CommandList:
              slots[s.out_id], s.out_count, str(s.out_dtype))
             for key, s in zip(step_keys, self._steps))
 
-    def execute(self, sync: bool = True):
+    def execute(self, sync: bool = True, from_device: bool = False):
         """Run the whole list as ONE device launch.
 
         With ``sync`` (default) block and sync every written buffer's host
         mirror — the per-op ``to_device=False`` finalizer applied once per
         list. ``sync=False`` returns an async Request instead (state is on
-        device; callers sync selectively)."""
+        device; callers sync selectively). ``from_device`` skips the
+        pre-execute host-mirror upload of read buffers — the per-op
+        paths' ``from_device=True`` knob applied list-wide: the caller
+        asserts device state is current (e.g. re-executing a list whose
+        buffers were only touched on device), saving the full payload
+        upload through the host link every call."""
         if self._pending_sends:
             ps = self._pending_sends[0]
             raise ACCLError(
@@ -307,9 +312,10 @@ class CommandList:
         for s in self._steps:
             for bid in s.in_ids:
                 if bid not in synced:
-                    self._buffers[bid].sync_to_device()
+                    if not from_device:
+                        self._buffers[bid].sync_to_device()
                     synced.add(bid)  # sync once; list-internal flow rules after
-            if (s.out_id not in synced
+            if (s.out_id not in synced and not from_device
                     and s.out_count < self._buffers[s.out_id].count):
                 # partial write: the unwritten tail must come from the
                 # host mirror, not a stale device materialization

@@ -142,6 +142,15 @@ def flash_attention(q, k, v, causal: bool = False,
     ``_pad_head_dim``. Callers with other sequence shapes use the jnp path
     (``parallel.context``'s online-softmax blocks — same math, unfused).
 
+    Pad cost (measured, round 4 — the ``flash_attention_d{64,96,128}``
+    bench lanes): useful-FLOP throughput at d=64 is ~0.5-0.6x of d=128,
+    i.e. proportional to the d/128 lane utilization — the structural
+    bound of the 128-wide MXU/VPU tiles, not kernel overhead. Recovering
+    it would require packing two d=64 heads per 128-lane tile, which
+    makes the QK^T contraction block-diagonal (a different kernel, not a
+    block-shape knob); until a head-packed variant exists, d<128 callers
+    pay the proportional pad and the bench rows keep the cost visible.
+
     Differentiable: the custom VJP runs the canonical two-pass flash
     backward (dK/dV kernel sweeping q-blocks, dQ kernel sweeping
     k-blocks), recomputing probabilities from the saved log-sum-exp so

@@ -234,6 +234,23 @@ def test_cmdlist_picks_up_host_writes_each_execute(accl, rng):
     np.testing.assert_array_equal(y.host, np.tile(second.sum(0), (WORLD, 1)))
 
 
+def test_cmdlist_from_device_skips_host_upload(accl, rng):
+    """execute(from_device=True) is the list-wide analog of the per-op
+    from_device=True knob: the device state is authoritative and a later
+    host write is NOT picked up (callers assert device currency)."""
+    x = accl.create_buffer(32, dataType.int32)
+    y = accl.create_buffer(32, dataType.int32)
+    first = _ints(rng, (WORLD, 32))
+    x.host[:] = first
+    cl = accl.command_list()
+    cl.allreduce(x, y, 32, reduceFunction.SUM)
+    cl.execute()  # uploads `first`, leaves it materialized on device
+    np.testing.assert_array_equal(y.host, np.tile(first.sum(0), (WORLD, 1)))
+    x.host[:] = _ints(rng, (WORLD, 32))  # host write the re-execute ignores
+    cl.execute(from_device=True)
+    np.testing.assert_array_equal(y.host, np.tile(first.sum(0), (WORLD, 1)))
+
+
 def test_cmdlist_fuses_chunked_pallas_step(accl, rng):
     """A recorded list mixing a Pallas chunked collective with jnp-family
     steps compiles and launches as one fused program — the segmented
