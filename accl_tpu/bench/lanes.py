@@ -101,6 +101,17 @@ def _fit_fused_loop(step, x0, rounds: int = 5, target_s: float = 0.4,
 
 
 
+def _random_operands(n: int, scale: float = 1e-9):
+    """Seeded non-splat bench operands: jnp.zeros/jnp.full closures become
+    SPLAT constants the compiler materializes without reading HBM, which
+    silently understates a lane's traffic; random content must be read.
+    float32 generation avoids a 2x float64 temp."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal(n, dtype=np.float32))
+    b = jnp.asarray(rng.standard_normal(n, dtype=np.float32) * np.float32(scale))
+    return x, b
+
+
 def _physical(gbps: float, floor_multiplier: float) -> bool:
     """A lane whose implied HBM traffic exceeds the chip's peak even at
     the MINIMUM possible traffic multiplier did not measure the device:
@@ -126,8 +137,7 @@ def bench_cast_lane(nbytes: int = 64 << 20) -> dict:
     from ..ops import compression
 
     n = nbytes // 4
-    x = jnp.zeros((n,), jnp.float32)
-    b = jnp.full((n,), 1e-7, jnp.float32)
+    x, b = _random_operands(n, scale=1e-7)
 
     def step(_, v):
         w = compression.pallas_cast(v, jnp.bfloat16)
@@ -155,8 +165,7 @@ def bench_combine_pallas_vs_jnp(nbytes: int = 64 << 20) -> dict:
     from ..ops import reduce_ops
 
     n = nbytes // 4
-    x = jnp.zeros((n,), jnp.float32)
-    b = jnp.full((n,), 1e-9, jnp.float32)
+    x, b = _random_operands(n)
 
     t_pl = _fit_fused_loop(
         lambda _, v: reduce_ops.pallas_combine(v, b, reduceFunction.SUM,
@@ -330,8 +339,7 @@ def small_op_latency_distribution(nbytes: int = 16 << 10,
     from ..ops import reduce_ops
 
     n = nbytes // 4
-    x = jnp.zeros((n,), jnp.float32)
-    b = jnp.full((n,), 1e-9, jnp.float32)
+    x, b = _random_operands(n)
 
     def dist(step):
         t = _fit_fused_loop(step, x, rounds=rounds, target_s=0.5,
