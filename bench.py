@@ -13,15 +13,16 @@ measured stream rate against that envelope (multi-chip: against the
 
 Measurement is `accl_tpu.bench.harness` under two accountings on TPU,
 emitted as SEPARATE series (never mixed per size): `fused` (the op
-chained inside ONE launched program via lax.fori_loop — immune to tunnel
-RTT, the PERFCNT device-cycle analog and the CommandList fusion path)
-and `chain` (per-launch dependent chains with forced readback — includes
-async dispatch cost but no loop-carry copy, so it can be the truer
-throughput at HBM-bound sizes). The scalar headline is the better of the
-two series' PEAKS, labeled by the `accounting` field. Both force
-execution through readbacks, so lazy dispatch through tunneled TPU
-backends cannot fake the numbers; the reported small-op latency is
-always the fused accounting.
+chained inside ONE launched program via lax.fori_loop with a DONATED
+in-place carry — immune to tunnel RTT, the PERFCNT device-cycle analog
+and the CommandList fusion path) and `chain` (per-launch dependent
+chains with forced readback — includes async dispatch cost). The scalar
+headline is the better of the two series' PEAKS, labeled by the
+`accounting` field. Anti-cheat: inputs are salted per invocation (the
+tunneled runtime caches identical re-executions), execution is forced
+through readbacks, and per-op times are floored at what the HBM
+roofline physically allows; the reported small-op latency is always the
+fused accounting.
 """
 from __future__ import annotations
 
@@ -73,6 +74,7 @@ def main() -> None:
                  "med_us": round(r.duration_med_ns / 1e3, 1),
                  "max_us": round(r.duration_max_ns / 1e3, 1),
                  "rounds": r.rounds,
+                 "floored": r.floored,
                  "GBps": round(r.algbw_GBps, 3)} for r in rows]
 
     sweep = series("fused" if on_tpu else "block")
@@ -85,9 +87,14 @@ def main() -> None:
     # has no carry but includes per-launch dispatch, amortized over the
     # chain. Each series is internally consistent; the scalar headline
     # takes whichever methodology peaks higher and says which it was.
-    peak_fused = max(r["GBps"] for r in sweep)
-    peak_chain = (max(r["GBps"] for r in sweep_chain)
-                  if sweep_chain else None)
+    # floored rows carry the anti-cheat CAP, not a measurement — they are
+    # ineligible for the headline peak
+    def peak_of(rows):
+        vals = [r["GBps"] for r in rows if not r.get("floored")]
+        return max(vals) if vals else 0.0
+
+    peak_fused = peak_of(sweep)
+    peak_chain = peak_of(sweep_chain) if sweep_chain else None
     if peak_chain is not None and peak_chain > peak_fused:
         peak, accounting = peak_chain, "chain"
     else:
@@ -100,9 +107,11 @@ def main() -> None:
         "accounting": accounting,
         # named by the series' ACTUAL methodology (block on non-TPU rigs)
         ("value_fused" if on_tpu else "value_block"): round(peak_fused, 3),
-        # fused/device-only accounting (dispatch excluded) — see module doc
+        # fused/device-only accounting (dispatch excluded) — see module doc;
+        # a floored small row is the anti-cheat CAP, not a latency claim
         ("per_op_small_us_fused" if on_tpu
          else "per_op_small_us_block"): sweep[0]["per_op_us"],
+        "per_op_small_floored": sweep[0].get("floored", False),
         "backend": jax.default_backend(),
         "world": world,
         "sweep": sweep,
@@ -122,10 +131,11 @@ def main() -> None:
         # operands and writes one = 3x payload traffic against the chip's
         # ~819 GB/s (VERDICT r3 weak #2 — vs_baseline alone compares only
         # the reference's 16 GB/s FPGA envelope, cleared since round 1)
+        hbm_peak = harness.hbm_peak_bytes_per_s() / 1e9
         out["roofline"] = {
-            "hbm_peak_GBps": lanes.V5E_HBM_GBPS,
+            "hbm_peak_GBps": hbm_peak,
             "traffic_multiplier": 3,
-            "hbm_frac": round(3 * peak / lanes.V5E_HBM_GBPS, 3),
+            "hbm_frac": round(3 * peak / hbm_peak, 3),
         }
         # the rest of the single-chip datapath lanes (bench.cpp sweeps
         # every op; one metric per round is not parity)
