@@ -170,3 +170,24 @@ def test_vmem_ring_allreduce_lowers_multihost(tpu_comm):
     fn = pallas_ring.build_pallas_ring_allreduce(
         tpu_comm, reduceFunction.SUM, dataType.float32, None)
     _assert_lowered(_aot_compile(fn, tpu_comm, (WORLD, 1 << 14)))
+
+
+def test_chunked_allreduce_lowers_16chip_4host():
+    """Scale-up: the flagship composition (chunked bidirectional
+    allreduce) lowers for a 16-chip, FOUR-host v5e:4x4 topology — the
+    ring schedule, segment geometry, and VMEM budgets are world-size
+    parametric, not tuned to one shape."""
+    try:
+        from jax.experimental import topologies
+        topo = topologies.get_topology_desc(
+            platform="tpu", topology_name="v5e:4x4")
+        devices = list(topo.devices)
+    except Exception as e:  # pragma: no cover - environment-dependent
+        pytest.skip(f"TPU AOT v5e:4x4 topology unavailable: {e}")
+    comm16 = Communicator(devices)
+    assert comm16.world_size == 16
+    assert len({d.process_index for d in devices}) == 4
+    fn = pallas_chunked.build_chunked_ring_allreduce(
+        comm16, reduceFunction.SUM, dataType.float32, SEG,
+        bidirectional=True)
+    _assert_lowered(_aot_compile(fn, comm16, (16, N)), 2)
