@@ -117,6 +117,37 @@ def _pad_head_dim(q, k, v, d: int):
     return jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad), dp
 
 
+#: scoped-VMEM budget for the auto block model (the chip limit is 16 MiB;
+#: headroom left for Mosaic's own staging)
+_VMEM_BUDGET = 12 << 20
+
+
+def _auto_block(S: int, causal: bool, dp: int = 128) -> int:
+    """Largest 128-multiple power-of-two block that divides S, capped by
+    skip granularity and a VMEM budget.
+
+    Measured (round 4, v5e, S=2048 d=128 non-causal): per-grid-step
+    overhead dominates small blocks — 128-blocks ran at 17 TFLOP/s,
+    256 at 38, 1024 at 58 (outputs equal within f32 reassociation).
+    Non-causal caps at 1024; causal at 256, because whole-block masking
+    is the skip granularity — giant blocks forfeit the ~2x causal
+    compute skip. ``dp`` (the PADDED head dim) feeds a VMEM estimate —
+    ~2 score/prob f32 blocks + ~8 double-buffered q/k/v/out/acc strips —
+    so large-d callers are not pushed past the scoped-VMEM limit the
+    old fixed 128 default never approached.
+    """
+    cap = 256 if causal else 1024
+
+    def vmem_est(b: int) -> int:
+        return 2 * b * b * 4 + 8 * b * dp * 4
+
+    b = 128
+    while b * 2 <= cap and S % (b * 2) == 0 \
+            and vmem_est(b * 2) <= _VMEM_BUDGET:
+        b *= 2
+    return b if S % b == 0 else 128
+
+
 def _check_shapes(q, k, v, S, d, block_q, block_k):
     if S % block_q or S % block_k or block_q % 128:
         raise ValueError(
@@ -130,7 +161,8 @@ def _check_shapes(q, k, v, S, d, block_q, block_k):
 
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128):
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None):
     """Fused blockwise attention. q: (H, S, d) (or (S, d), promoted);
     k/v: (H_kv, S, d) with ``H % H_kv == 0`` — grouped-query attention
     shares each kv head across ``H/H_kv`` q heads with no materialized
@@ -160,6 +192,11 @@ def flash_attention(q, k, v, causal: bool = False,
     if single:
         q, k, v = q[None], k[None], v[None]
     H, S, d = q.shape
+    dp_est = -(-d // 128) * 128
+    if block_q is None:
+        block_q = _auto_block(S, causal, dp_est)
+    if block_k is None:
+        block_k = _auto_block(S, causal, dp_est)
     _check_shapes(q, k, v, S, d, block_q, block_k)
     sc = scale if scale is not None else 1.0 / (d ** 0.5)  # ORIGINAL d
     q, k, v, dp = _pad_head_dim(q, k, v, d)
@@ -171,7 +208,8 @@ def flash_attention(q, k, v, causal: bool = False,
 
 def flash_attention_lse(q, k, v, causal: bool = False,
                         scale: Optional[float] = None,
-                        block_q: int = 128, block_k: int = 128):
+                        block_q: Optional[int] = None,
+                        block_k: Optional[int] = None):
     """Like :func:`flash_attention` but also returns the per-row
     log-sum-exp, shape (H, S) — the merge key for composing partial
     attentions over key/value blocks (ring attention: each step's
@@ -183,6 +221,11 @@ def flash_attention_lse(q, k, v, causal: bool = False,
     if single:
         q, k, v = q[None], k[None], v[None]
     H, S, d = q.shape
+    dp_est = -(-d // 128) * 128
+    if block_q is None:
+        block_q = _auto_block(S, causal, dp_est)
+    if block_k is None:
+        block_k = _auto_block(S, causal, dp_est)
     _check_shapes(q, k, v, S, d, block_q, block_k)
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
     q, k, v, dp = _pad_head_dim(q, k, v, d)
@@ -216,11 +259,26 @@ def _flash_vjp_fwd(q, k, v, causal, sc, block_q, block_k):
     return out, (q, k, v, out, lse)
 
 
+#: the two-pass backward kernels' lse/dd slab indexing is proven at the
+#: 128-row geometry only (Mosaic rejects the wider slab reshape:
+#: "unsupported shape cast" on (1,1,rows,128)->(block,1) at rows>1), so
+#: the backward always runs 128-blocks regardless of the forward's
+#: (bigger fwd blocks are where the measured win is — see _auto_block)
+_BWD_BLOCK = 128
+
+
 def _bwd_from_dd(q, k, v, do, lse, dd_2d, causal, sc, block_q, block_k):
     """Shared backward: ``dd_2d`` (H, S) is the per-row correction term —
     plain D for the out-only VJP, ``D - dlse`` when an lse cotangent
     exists (∂lse/∂s = p folds into the same p·(dp − ·) form)."""
     H, S, _ = q.shape
+    if block_q != _BWD_BLOCK or block_k != _BWD_BLOCK:
+        # re-slab the forward's lse residual into the backward's geometry
+        # (plain jnp reshape/pad on (H, S) f32 — negligible next to the
+        # kernels) and run the backward at its supported block size
+        lse = _lse_2d_to_slab(_lse_slab_to_2d(lse, H, S, block_q),
+                              H, S, _BWD_BLOCK)
+        block_q = block_k = _BWD_BLOCK
     dd = _lse_2d_to_slab(dd_2d, H, S, block_q)
     dk, dv = _flash_bwd_kv(q, k, v, do, lse, dd, causal, sc,
                            block_q, block_k)
