@@ -148,6 +148,18 @@ def _auto_block(S: int, causal: bool, dp: int = 128) -> int:
     return b if S % b == 0 else 128
 
 
+def _default_blocks(S: int, d: int, causal: bool,
+                    block_q: Optional[int], block_k: Optional[int]):
+    """Resolve the wrappers' block defaults in one place: None picks the
+    auto size for the PADDED head dim (the VMEM model's operand width)."""
+    dp_est = -(-d // 128) * 128
+    if block_q is None:
+        block_q = _auto_block(S, causal, dp_est)
+    if block_k is None:
+        block_k = _auto_block(S, causal, dp_est)
+    return block_q, block_k
+
+
 def _check_shapes(q, k, v, S, d, block_q, block_k):
     if S % block_q or S % block_k or block_q % 128:
         raise ValueError(
@@ -192,11 +204,7 @@ def flash_attention(q, k, v, causal: bool = False,
     if single:
         q, k, v = q[None], k[None], v[None]
     H, S, d = q.shape
-    dp_est = -(-d // 128) * 128
-    if block_q is None:
-        block_q = _auto_block(S, causal, dp_est)
-    if block_k is None:
-        block_k = _auto_block(S, causal, dp_est)
+    block_q, block_k = _default_blocks(S, d, causal, block_q, block_k)
     _check_shapes(q, k, v, S, d, block_q, block_k)
     sc = scale if scale is not None else 1.0 / (d ** 0.5)  # ORIGINAL d
     q, k, v, dp = _pad_head_dim(q, k, v, d)
@@ -221,11 +229,7 @@ def flash_attention_lse(q, k, v, causal: bool = False,
     if single:
         q, k, v = q[None], k[None], v[None]
     H, S, d = q.shape
-    dp_est = -(-d // 128) * 128
-    if block_q is None:
-        block_q = _auto_block(S, causal, dp_est)
-    if block_k is None:
-        block_k = _auto_block(S, causal, dp_est)
+    block_q, block_k = _default_blocks(S, d, causal, block_q, block_k)
     _check_shapes(q, k, v, S, d, block_q, block_k)
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
     q, k, v, dp = _pad_head_dim(q, k, v, d)
@@ -259,26 +263,13 @@ def _flash_vjp_fwd(q, k, v, causal, sc, block_q, block_k):
     return out, (q, k, v, out, lse)
 
 
-#: the two-pass backward kernels' lse/dd slab indexing is proven at the
-#: 128-row geometry only (Mosaic rejects the wider slab reshape:
-#: "unsupported shape cast" on (1,1,rows,128)->(block,1) at rows>1), so
-#: the backward always runs 128-blocks regardless of the forward's
-#: (bigger fwd blocks are where the measured win is — see _auto_block)
-_BWD_BLOCK = 128
-
-
 def _bwd_from_dd(q, k, v, do, lse, dd_2d, causal, sc, block_q, block_k):
     """Shared backward: ``dd_2d`` (H, S) is the per-row correction term —
     plain D for the out-only VJP, ``D - dlse`` when an lse cotangent
-    exists (∂lse/∂s = p folds into the same p·(dp − ·) form)."""
+    exists (∂lse/∂s = p folds into the same p·(dp − ·) form). The two
+    backward kernels sweep big q-blocks as unrolled 128-row strips, so
+    they run at the forward's (auto) block sizes directly."""
     H, S, _ = q.shape
-    if block_q != _BWD_BLOCK or block_k != _BWD_BLOCK:
-        # re-slab the forward's lse residual into the backward's geometry
-        # (plain jnp reshape/pad on (H, S) f32 — negligible next to the
-        # kernels) and run the backward at its supported block size
-        lse = _lse_2d_to_slab(_lse_slab_to_2d(lse, H, S, block_q),
-                              H, S, _BWD_BLOCK)
-        block_q = block_k = _BWD_BLOCK
     dd = _lse_2d_to_slab(dd_2d, H, S, block_q)
     dk, dv = _flash_bwd_kv(q, k, v, do, lse, dd, causal, sc,
                            block_q, block_k)
@@ -364,17 +355,20 @@ def _flash_fwd_call(q, k, v, causal, sc, block_q, block_k):
 #   dK = dSᵀ Q     dQ = dS K
 # ---------------------------------------------------------------------------
 
-def _recompute_p_ds(q, kb, vb, do, lse, dd, i, j, causal, sc,
-                    block_q, block_k):
+def _recompute_p_ds(q, kb, vb, do, lse, dd, row0, col0, causal, sc):
+    """Recompute probabilities + score gradients for one (q-rows, k-block)
+    tile. ``row0``/``col0`` are ELEMENT offsets of the tile's first row /
+    column (not block indices): the backward kernels sweep big q-blocks
+    as unrolled 128-row strips, each strip carrying its own row offset."""
     s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                            preferred_element_type=_F32) * sc   # (bq, bk)
+                            preferred_element_type=_F32) * sc   # (rows, bk)
     if causal:
-        rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        rows = row0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = col0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(rows >= cols, s, _NEG_INF)
-    p = jnp.exp(s - lse[:, None])                               # (bq, bk)
+    p = jnp.exp(s - lse[:, None])                               # (rows, bk)
     dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
-                             preferred_element_type=_F32)       # (bq, bk)
+                             preferred_element_type=_F32)       # (rows, bk)
     ds = p * (dp - dd[:, None]) * sc
     return p, ds
 
@@ -394,18 +388,25 @@ def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     def _block():
-        rows = block_q // 128
-        p, ds = _recompute_p_ds(
-            q_ref[0], k_ref[0], v_ref[0], do_ref[0].astype(_F32),
-            lse_ref[0, 0, :rows].reshape(block_q),
-            dd_ref[0, 0, :rows].reshape(block_q),
-            i, j, causal, scale, block_q, block_k)
-        dv_acc[:] += jax.lax.dot_general(
-            p, do_ref[0].astype(_F32), (((0,), (0,)), ((), ())),
-            preferred_element_type=_F32)                        # (bk, d)
-        dk_acc[:] += jax.lax.dot_general(
-            ds, q_ref[0].astype(_F32), (((0,), (0,)), ((), ())),
-            preferred_element_type=_F32)                        # (bk, d)
+        # big q-blocks sweep as UNROLLED 128-row strips: the per-row
+        # lse/dd slab strip is (128,), whose (128, 1) relayout Mosaic
+        # supports (the whole-block (rows, 128) -> (block_q, 1) reshape
+        # it rejects is never formed), and the strip loop costs no
+        # grid-step overhead — the point of the big block
+        for r in range(block_q // 128):
+            sl = slice(r * 128, (r + 1) * 128)
+            qs = q_ref[0][sl]
+            dos = do_ref[0][sl].astype(_F32)
+            p, ds = _recompute_p_ds(
+                qs, k_ref[0], v_ref[0], dos,
+                lse_ref[0, 0, r], dd_ref[0, 0, r],
+                i * block_q + r * 128, j * block_k, causal, scale)
+            dv_acc[:] += jax.lax.dot_general(
+                p, dos, (((0,), (0,)), ((), ())),
+                preferred_element_type=_F32)                    # (bk, d)
+            dk_acc[:] += jax.lax.dot_general(
+                ds, qs.astype(_F32), (((0,), (0,)), ((), ())),
+                preferred_element_type=_F32)                    # (bk, d)
 
     if causal:
         pl.when(j * block_k < (i + 1) * block_q)(_block)
@@ -430,15 +431,17 @@ def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     def _block():
-        rows = block_q // 128
-        _, ds = _recompute_p_ds(
-            q_ref[0], k_ref[0], v_ref[0], do_ref[0].astype(_F32),
-            lse_ref[0, 0, :rows].reshape(block_q),
-            dd_ref[0, 0, :rows].reshape(block_q),
-            i, j, causal, scale, block_q, block_k)
-        dq_acc[:] += jax.lax.dot_general(
-            ds, k_ref[0].astype(_F32), (((1,), (0,)), ((), ())),
-            preferred_element_type=_F32)                        # (bq, d)
+        # unrolled 128-row strips — see _bwd_kv_kernel for why
+        for r in range(block_q // 128):
+            sl = slice(r * 128, (r + 1) * 128)
+            _, ds = _recompute_p_ds(
+                q_ref[0][sl], k_ref[0], v_ref[0],
+                do_ref[0][sl].astype(_F32),
+                lse_ref[0, 0, r], dd_ref[0, 0, r],
+                i * block_q + r * 128, j * block_k, causal, scale)
+            dq_acc[sl] += jax.lax.dot_general(
+                ds, k_ref[0].astype(_F32), (((1,), (0,)), ((), ())),
+                preferred_element_type=_F32)                    # (128, d)
 
     if causal:
         pl.when(j * block_k < (i + 1) * block_q)(_block)
