@@ -151,7 +151,14 @@ def _auto_block(S: int, causal: bool, dp: int = 128) -> int:
 def _default_blocks(S: int, d: int, causal: bool,
                     block_q: Optional[int], block_k: Optional[int]):
     """Resolve the wrappers' block defaults in one place: None picks the
-    auto size for the PADDED head dim (the VMEM model's operand width)."""
+    auto size for the PADDED head dim (the VMEM model's operand width).
+
+    Interpret mode (the CPU emulator rung) keeps the 128 geometry: the
+    auto sizes exist to amortize REAL per-grid-step hardware overhead,
+    while the interpreter pays per-element either way — measured, auto
+    blocks made the CPU suite ~3.5x slower for zero benefit."""
+    if _interpret_params() is not None:
+        return block_q or 128, block_k or 128
     dp_est = -(-d // 128) * 128
     if block_q is None:
         block_q = _auto_block(S, causal, dp_est)
