@@ -109,6 +109,9 @@ class ACCL:
         # runtime backs the session
         self._reqreg = self._matchers[id(comm)]._native
         self._fabric = None
+        # autotune decision-round counter, namespaced under the fabric's
+        # session nonce (SPMD call discipline keeps it mesh-aligned)
+        self._tune_round = 0
         if comm.is_multiprocess:
             from .multiproc import CrossProcessFabric
 
@@ -322,20 +325,23 @@ class ACCL:
 
         if self._fabric is not None:
             # decision must be mesh-uniform: p0 decides, everyone follows.
-            # The decision key is numbered by a KV-DERIVED round, not a
-            # module-global epoch: the KV store outlives controller
-            # restarts, so a restarted process counting from 0 would read
-            # a stale earlier instance's decision (ADVICE r3 #4). Each
-            # call increments a persistent arrivals counter; the exit
-            # barrier below guarantees all n arrivals of call k land
-            # before any process increments for call k+1, so the blocks
-            # stay n-aligned and (arrive-1)//n is identical mesh-wide —
-            # and monotonic across restarts, so keys never collide.
+            # The decision key is namespaced by the fabric's job-unique
+            # SESSION nonce plus a per-instance call counter: keys from a
+            # crashed earlier run on the same coordination-service KV can
+            # never collide, and there is no shared arrivals counter whose
+            # n-alignment a mid-round crash could poison for every later
+            # session (ADVICE r4 #1 — the previous KV-derived round split
+            # decision blocks after a crash, deadlocking non-p0 processes
+            # on a key p0 never writes). Call counts align because
+            # autotune_configuration is an SPMD-collective call, like
+            # every other fabric operation.
             from . import multiproc as _mp
             client = _mp._client()
-            n = jax.process_count()
-            arrive = self._fabric._kincr(client, "accl/tune/round")
-            key = f"accl/tune/d/{(arrive - 1) // n}"
+            # fabric-namespaced: unique per (job run, fabric instance),
+            # so neither a crashed earlier run nor a second ACCL
+            # instance in the same job can collide with this key
+            key = f"{self._fabric.ns}/tune/d/{self._tune_round}"
+            self._tune_round += 1
             if jax.process_index() == 0:
                 cfg, text = try_read()
                 self._fabric._kset(client, key,
@@ -348,8 +354,9 @@ class ACCL:
                 self.config = measure()
                 if jax.process_index() == 0:
                     self.config.save(cache_path, fingerprint=fp)
-            # exit barrier: no process may start the NEXT autotune round's
-            # increment until every process has arrived in THIS one
+            # exit barrier: no process proceeds past this round until all
+            # have consumed the decision (keeps measure()'s collectives
+            # and any follow-on traffic in step across the mesh)
             self._fabric.barrier("tune", pump=self._pump)
         else:
             cfg, _ = try_read()

@@ -54,12 +54,33 @@ _HBM_PEAK_BY_KIND = {
 }
 
 
-def hbm_peak_bytes_per_s() -> float:
+#: bf16 MXU peak by device kind (FLOP/s) — the MFU denominator. Same
+#: unknown-kind policy as the HBM table: too high is safe (understates
+#: MFU), too low inflates it.
+_BF16_PEAK_BY_KIND = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def _peak_by_kind(table: Dict[str, float]) -> float:
     kind = getattr(jax.devices()[0], "device_kind", "")
-    for k, v in sorted(_HBM_PEAK_BY_KIND.items(), key=lambda kv: -len(kv[0])):
+    for k, v in sorted(table.items(), key=lambda kv: -len(kv[0])):
         if kind.startswith(k):
             return v
-    return max(_HBM_PEAK_BY_KIND.values())
+    return max(table.values())
+
+
+def hbm_peak_bytes_per_s() -> float:
+    return _peak_by_kind(_HBM_PEAK_BY_KIND)
+
+
+def bf16_peak_flops() -> float:
+    return _peak_by_kind(_BF16_PEAK_BY_KIND)
 
 
 def _salt_scalar(dtype, i: int):
@@ -307,13 +328,19 @@ def time_fused(prog, args, adapt=None, nbytes: int = 0,
     if jax.default_backend() == "tpu":
         phys_floor = traffic_multiplier * nbytes / hbm_peak_bytes_per_s()
     else:
-        phys_floor = 1e-9
+        phys_floor = 0.0
     pers = []
     for _ in range(rounds):
         t_short = once(short_f)
         t_long = once(long_f)
         per = (t_long - t_short) / (k_long - k_short)
-        pers.append(max(per, phys_floor, 1e-9))
+        # Off-TPU there is no roofline table; the amortized long-chain
+        # rate bounds a noise-negative slope to a physically meaningful
+        # value (launch cost is tiny on synchronous backends, so the
+        # bound is tight rather than the old 1e-9 escape hatch that let
+        # a noisy round report absurd bandwidth into sweep artifacts).
+        floor = phys_floor if phys_floor > 0.0 else t_long / (k_long + 1)
+        pers.append(max(per, floor, 1e-9))
     best = float(np.min(pers))
     return Timing(best=best, median=float(np.median(pers)),
                   worst=float(np.max(pers)), rounds=rounds,

@@ -20,37 +20,57 @@ lanes so ``bench.py`` emits a sweep of them every round:
 Every lane uses the fused (single-launch, loop-carried) accounting where
 possible so tunnel RTT is excluded; each reports its own traffic
 multiplier so the HBM roofline fraction is explicit.
+
+Resolution protocol (VERDICT r4 weak #3): a lane is *flagged*, never
+silently zeroed. The anti-cheat check runs against the MEDIAN of the
+per-round slope distribution — a single noise-fast round at an honest
+0.95-0.98 roofline must not zero the lane — and every row reports the
+raw best/median values alongside the ``resolved`` flag so a flagged
+measurement is still on the record. Rooflines come from the harness's
+per-device-kind tables, never a hardcoded v5e pair (ADVICE r4 #2).
 """
 from __future__ import annotations
 
 import time
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-#: v5e datasheet numbers (per chip)
-V5E_HBM_GBPS = 819.0
-V5E_BF16_TFLOPS = 197.0
+from . import harness
+
+
+def _hbm_peak_gbps() -> float:
+    return harness.hbm_peak_bytes_per_s() / 1e9
+
+
+def _bf16_peak_tflops() -> float:
+    return harness.bf16_peak_flops() / 1e12
+
 
 def _fit_fused_loop(step, x0, rounds: int = 5, target_s: float = 0.4,
-                    k_cap: int = 262144) -> Dict[str, float]:
-    """Per-op device time by a two-point slope over chain lengths.
+                    k_cap: int = 262144,
+                    per_est: Optional[float] = None) -> Dict[str, float]:
+    """Per-op device time by a paired-round slope over chain lengths.
 
     Total wall time of one launched ``lax.fori_loop(k)`` program is
     t(k) = launch + k * per_op. On this rig the fixed launch cost through
     the tunneled runtime is enormous AND noisy (~80-115 ms, +-30 ms
     across minutes — same total measured at k=512 and k=2048), so naive
     t/k misattributes it all to per_op, and a fit over small k drowns in
-    intercept noise. Two defenses: (1) a pilot run sizes k_max so the
-    DEVICE work (slope x k_max) targets ``target_s`` seconds, well above
-    the intercept noise; (2) the slope uses min-of-``rounds`` at each of
-    two well-separated k values, cancelling the intercept. Returns per_op
-    (slope, clamped >= 0), launch (intercept estimate), and the naive
-    amortized floor at k_max (the conservative bound the headline bench
-    reports)."""
+    intercept noise. Defenses: (1) k_max is sized so the DEVICE work
+    (slope x k_max) targets ``target_s`` seconds, well above the
+    intercept noise — from a two-point compiled pilot, or from the
+    caller's ``per_est`` hint (roofline-derived) which saves the pilot's
+    two tunnel compiles (VERDICT r4 weak #1: compile cost dominated the
+    20-minute bench); (2) each round pairs one short and one long chain
+    into an independent slope sample, so the fit returns a DISTRIBUTION:
+    ``per_op`` (min — the latency-floor estimator), ``per_op_med`` /
+    ``per_op_max`` (the weather). Flag decisions belong on the median;
+    headline values on the min (VERDICT r4 weak #3).
+    """
     # Every invocation perturbs the loop init with a FRESH scalar: the
     # tunneled runtime caches repeat executions of (program, identical
     # inputs) — a constant-input loop measured 0.1 ms TOTAL, no launch at
@@ -72,33 +92,46 @@ def _fit_fused_loop(step, x0, rounds: int = 5, target_s: float = 0.4,
         jax.block_until_ready(prog(x0, s))
         return time.perf_counter() - t0
 
-    # two-point pilot: the launch cost cancels, so a fast op's estimate
-    # is bounded by noise/240 instead of noise/16 — a single-point pilot
-    # mis-sized k_max by ~100x for sub-us ops
-    p16, p256 = make(16), make(256)
-    once(p16)  # compile + warm
-    once(p256)
-    t16 = min(once(p16), once(p16))
-    t256 = min(once(p256), once(p256))
-    per_est = max((t256 - t16) / 240, 1e-7)
+    pilot = "hint"
+    if per_est is None:
+        # two-point pilot: the launch cost cancels, so a fast op's
+        # estimate is bounded by noise/240 instead of noise/16 — a
+        # single-point pilot mis-sized k_max by ~100x for sub-us ops.
+        # Costs two extra tunnel compiles; callers whose per-op cost is
+        # roofline-predictable pass ``per_est`` and skip it.
+        pilot = "measured"
+        p16, p256 = make(16), make(256)
+        once(p16)  # compile + warm
+        once(p256)
+        t16 = min(once(p16), once(p16))
+        t256 = min(once(p256), once(p256))
+        per_est = max((t256 - t16) / 240, 1e-7)
+    per_est = max(per_est, 1e-9)
     k_max = int(min(max(target_s / per_est, 512), k_cap))
     k_short = max(k_max // 8, 1)
     long_p, short_p = make(k_max), make(k_short)
     once(long_p)
     once(short_p)
-    t_long = min(once(long_p) for _ in range(rounds))
-    t_short = min(once(short_p) for _ in range(rounds))
-    slope = (t_long - t_short) / (k_max - k_short)
+    slopes, t_longs = [], []
+    for _ in range(rounds):
+        t_short = once(short_p)
+        t_long = once(long_p)
+        t_longs.append(t_long)
+        slopes.append((t_long - t_short) / (k_max - k_short))
+    slope_min = float(np.min(slopes))
+    slope_med = float(np.median(slopes))
     # resolved when the device work separating the two chains exceeds the
-    # observed launch jitter scale (~20-30 ms on this rig)
-    resolved = slope * (k_max - k_short) >= 0.02
-    return {"per_op": float(max(slope, 0.0)),
-            "launch": float(max(t_short - k_short * slope, 0.0)),
-            "amortized_floor": float(t_long / k_max),
+    # observed launch jitter scale (~20-30 ms on this rig) — judged on
+    # the median round, so one bad round doesn't unresolve the lane and
+    # one lucky round doesn't resolve it
+    resolved = slope_med * (k_max - k_short) >= 0.02
+    return {"per_op": float(max(slope_min, 0.0)),
+            "per_op_med": float(max(slope_med, 0.0)),
+            "per_op_max": float(max(np.max(slopes), 0.0)),
+            "launch": float(max(min(t_longs) - k_max * slope_med, 0.0)),
+            "amortized_floor": float(min(t_longs) / k_max),
             "resolved": bool(resolved),
-            "k_max": k_max, "rounds": rounds}
-
-
+            "k_max": k_max, "rounds": rounds, "pilot": pilot}
 
 
 def _random_operands(n: int, scale: float = 1e-9):
@@ -116,13 +149,39 @@ def _physical(gbps: float, floor_multiplier: float) -> bool:
     """A lane whose implied HBM traffic exceeds the chip's peak even at
     the MINIMUM possible traffic multiplier did not measure the device:
     the tunneled runtime caches repeat executions at custom-call
-    granularity when iteration content is unchanged (an idempotent
-    step's iterations 2..k all hit), and XLA can elide pure chains.
-    ``floor_multiplier`` is the least HBM traffic per payload byte the
-    lane could possibly generate (XLA may keep intermediates
-    VMEM-resident, so the nominal multiplier overstates traffic). Flag
-    instead of report."""
-    return gbps * floor_multiplier <= V5E_HBM_GBPS * 1.05
+    granularity when iteration content is unchanged, and XLA can elide
+    pure chains. ``floor_multiplier`` is the least HBM traffic per
+    payload byte the lane could possibly generate. The 1.10 margin admits
+    an honest kernel at 0.95-0.98 roofline plus measurement noise
+    (VERDICT r4 weak #3: the old 1.05 cap zeroed the framework's own
+    best results); callers apply this to the MEDIAN slope, where cache
+    pollution shows up as a systematic 3-10x violation, not a 5% one."""
+    return gbps * floor_multiplier <= _hbm_peak_gbps() * 1.10
+
+
+def _bw_fields(t: Dict[str, float], nbytes: int, mult: float) -> dict:
+    """Shared resolution protocol for bandwidth lanes: flag on the median
+    slope; report the best slope as the value when it is itself physical,
+    else fall back to the median (the best round was noise-fast); ALWAYS
+    carry both raw values so a flagged lane keeps its measurement."""
+    g_best = nbytes / t["per_op"] / 1e9 if t["per_op"] > 0 else 0.0
+    g_med = nbytes / t["per_op_med"] / 1e9 if t["per_op_med"] > 0 else 0.0
+    ok = t["resolved"] and _physical(g_med, mult)
+    if ok:
+        # a zero g_best is a noise-NEGATIVE min slope (clamped), not a
+        # measurement — it must fall back to the median, not report 0.0
+        # on a resolved lane
+        value = (g_best if g_best > 0 and _physical(g_best, mult)
+                 else g_med)
+    else:
+        value = 0.0
+    return {"value": round(value, 3), "resolved": ok,
+            "raw_GBps": round(g_best, 3), "raw_med_GBps": round(g_med, 3),
+            "per_op_us": round(t["per_op"] * 1e6, 1),
+            "per_op_med_us": round(t["per_op_med"] * 1e6, 1),
+            "launch_ms": round(t["launch"] * 1e3, 1),
+            "rounds": t["rounds"], "pilot": t["pilot"],
+            "hbm_frac": round(mult * value / _hbm_peak_gbps(), 3)}
 
 
 def bench_cast_lane(nbytes: int = 64 << 20) -> dict:
@@ -143,19 +202,15 @@ def bench_cast_lane(nbytes: int = 64 << 20) -> dict:
         w = compression.pallas_cast(v, jnp.bfloat16)
         return compression.pallas_cast(w, jnp.float32) + b
 
-    t = _fit_fused_loop(step, x)
-    gbps = nbytes / t["per_op"] / 1e9 if t["resolved"] else 0.0
+    # roofline hint: ~5x payload HBM traffic per iteration (see above)
+    t = _fit_fused_loop(step, x,
+                        per_est=5 * nbytes / harness.hbm_peak_bytes_per_s())
     # traffic floor 2x payload: the f32 source read + f32 result write
     # must cross HBM; the bf16 intermediate and drift operand may stay
     # VMEM-resident under XLA's memory-space assignment
-    ok = t["resolved"] and _physical(gbps, 2)
     return {"metric": "hp_compression_cast_roundtrip", "unit": "GB/s",
-            "value": round(gbps, 3) if ok else 0.0, "bytes": nbytes,
-            "resolved": ok, "raw_GBps": round(gbps, 3),
-            "per_op_us": round(t["per_op"] * 1e6, 1),
-            "launch_ms": round(t["launch"] * 1e3, 1),
-            "traffic_multiplier_min": 2,
-            "hbm_frac": round(2 * gbps / V5E_HBM_GBPS, 3) if ok else 0.0}
+            "bytes": nbytes, "traffic_multiplier_min": 2,
+            **_bw_fields(t, nbytes, 2)}
 
 
 def bench_combine_pallas_vs_jnp(nbytes: int = 64 << 20) -> dict:
@@ -167,54 +222,65 @@ def bench_combine_pallas_vs_jnp(nbytes: int = 64 << 20) -> dict:
     n = nbytes // 4
     x, b = _random_operands(n)
 
+    hint = 3 * nbytes / harness.hbm_peak_bytes_per_s()
     t_pl = _fit_fused_loop(
         lambda _, v: reduce_ops.pallas_combine(v, b, reduceFunction.SUM,
-                                               donate=True), x)
-    t_np = _fit_fused_loop(lambda _, v: v + b, x)
-    g_pl = nbytes / t_pl["per_op"] / 1e9 if t_pl["resolved"] else 0.0
-    g_np = nbytes / t_np["per_op"] / 1e9 if t_np["resolved"] else 0.0
-    ok_pl = t_pl["resolved"] and _physical(g_pl, 3)
-    ok_np = t_np["resolved"] and _physical(g_np, 3)
+                                               donate=True), x, per_est=hint)
+    t_np = _fit_fused_loop(lambda _, v: v + b, x, per_est=hint)
+    pl = _bw_fields(t_pl, nbytes, 3)
+    np_ = _bw_fields(t_np, nbytes, 3)
     return {"metric": "combine_pallas_vs_jnp", "unit": "GB/s",
-            "value": round(g_pl, 3) if ok_pl else 0.0,
-            "jnp_GBps": round(g_np, 3) if ok_np else 0.0,
-            "jnp_raw_GBps": round(g_np, 3),
-            "ratio": (round(g_pl / g_np, 3)
-                      if ok_pl and ok_np else None),
-            "resolved": ok_pl, "bytes": nbytes,
-            "per_op_us": round(t_pl["per_op"] * 1e6, 1),
-            "launch_ms": round(t_pl["launch"] * 1e3, 1),
-            "traffic_multiplier": 3,
-            "hbm_frac": round(3 * g_pl / V5E_HBM_GBPS, 3) if ok_pl else 0.0}
+            "bytes": nbytes, "traffic_multiplier": 3,
+            **pl,
+            "jnp_GBps": np_["value"], "jnp_raw_GBps": np_["raw_GBps"],
+            "jnp_raw_med_GBps": np_["raw_med_GBps"],
+            "ratio": (round(pl["value"] / np_["value"], 3)
+                      if pl["resolved"] and np_["resolved"]
+                      and np_["value"] > 0 else None)}
 
 
 def bench_flash(head_dims=(64, 96, 128), H: int = 8, S: int = 2048,
-                rounds: int = 5) -> List[dict]:
+                rounds: int = 5, packed_d64: bool = True) -> List[dict]:
     """Flash attention fwd and fwd+bwd MFU per head dim on the chip.
 
     FLOPs (non-causal): fwd = 4*H*S^2*d (QK^T + PV); bwd recomputes
     scores and runs the two-pass dK/dV + dQ sweeps = 2.5x fwd. MFU is
-    against the bf16 MXU peak; inputs are bf16 (f32 accumulation inside
-    the kernel). d<128 runs zero-padded to the 128-lane tile, so its
-    useful-FLOP MFU is expected to shrink by ~d/128 — reporting it per
-    head dim quantifies the pad cost (VERDICT r3 weak #5)."""
+    against the device's bf16 MXU peak; inputs are bf16 (f32 accumulation
+    inside the kernel). d<128 runs zero-padded to the 128-lane tile, so
+    its useful-FLOP MFU is expected to shrink by ~d/128 — reporting it
+    per head dim quantifies the pad cost (VERDICT r3 weak #5). With
+    ``packed_d64`` a fourth row measures the head-packed d=64 kernel
+    (two heads per 128-lane tile; VERDICT r4 weak #6)."""
     from ..ops import flash
 
+    rng = np.random.default_rng(0)
+
+    def operand(shape):
+        return jnp.asarray(rng.standard_normal(shape, dtype=np.float32)
+                           * np.float32(0.1)).astype(jnp.bfloat16)
+
+    peak_tflops = _bf16_peak_tflops()
     rows = []
-    for d in head_dims:
-        q = jnp.ones((H, S, d), jnp.bfloat16) * 0.1
-        k = jnp.ones((H, S, d), jnp.bfloat16) * 0.1
-        v = jnp.ones((H, S, d), jnp.bfloat16) * 0.1
+    variants = [(d, False) for d in head_dims]
+    if (packed_d64 and 64 in head_dims
+            and hasattr(flash, "flash_attention_packed")):
+        variants.append((64, True))
+    for d, packed in variants:
+        q = operand((H, S, d))
+        k = operand((H, S, d))
+        v = operand((H, S, d))
+
+        attn = (flash.flash_attention_packed if packed
+                else flash.flash_attention)
 
         # out feeds the next call's q: a dependent chain inside ONE
         # launched program, so the fixed launch cost fits out as the
         # intercept and per-call device time is the slope
         def fwd_step(_, qq):
-            return flash.flash_attention(qq, k, v).astype(qq.dtype)
+            return attn(qq, k, v).astype(qq.dtype)
 
         def loss(qq, kk, vv):
-            return flash.flash_attention(qq, kk, vv).astype(
-                jnp.float32).sum()
+            return attn(qq, kk, vv).astype(jnp.float32).sum()
 
         grad_all = jax.grad(loss, argnums=(0, 1, 2))
 
@@ -227,32 +293,60 @@ def bench_flash(head_dims=(64, 96, 128), H: int = 8, S: int = 2048,
             return (dq + (dk.sum() + dv.sum()).astype(qq.dtype) * 1e-30
                     ).astype(qq.dtype)
 
-        t_f = _fit_fused_loop(fwd_step, q, rounds=rounds)
-        t_fb = _fit_fused_loop(fwdbwd_step, q, rounds=rounds)
         flops_f = 4 * H * S * S * d
         # the chained bwd recomputes fwd inside grad: fwd (1x) + bwd (2.5x)
         flops_fb = flops_f * 3.5
+        # roofline hint at an assumed 50% MFU — saves the pilot compiles;
+        # a slower kernel just runs a longer (still bounded) chain
+        t_f = _fit_fused_loop(fwd_step, q, rounds=rounds,
+                              per_est=flops_f / (0.5 * peak_tflops * 1e12))
+        t_fb = _fit_fused_loop(fwdbwd_step, q, rounds=rounds,
+                               per_est=flops_fb / (0.5 * peak_tflops * 1e12))
         resolved = t_f["resolved"] and t_fb["resolved"]
         # an unresolved slope must zero the headline fields, like every
         # other lane — a clamped per_op of ~0 would otherwise imply
-        # absurd TFLOP/s with only a side flag
-        tf, tfb = max(t_f["per_op"], 1e-9), max(t_fb["per_op"], 1e-9)
-        tf_tflops = flops_f / tf / 1e12 if resolved else 0.0
-        tfb_tflops = flops_fb / tfb / 1e12 if resolved else 0.0
+        # absurd TFLOP/s with only a side flag. Raw values stay on the
+        # record either way (resolution protocol). The MEDIAN slope is
+        # the flash headline AND carries the flag: one noise-fast paired
+        # slope produced a "97.5% MFU fwd+bwd" min that slipped under
+        # any physical cap — compute-lane jitter corrupts the min in
+        # BOTH directions (it is a slope difference), while the median
+        # is stable at these long per-op times. The min stays on record
+        # as raw_*.
+        def tfl(t, flops):
+            raw_min = flops / max(t["per_op"], 1e-9) / 1e12
+            raw_med = flops / max(t["per_op_med"], 1e-9) / 1e12
+            return raw_med, raw_min, raw_med <= peak_tflops * 1.0
+
+        tf_tflops, raw_tf, ok_f = tfl(t_f, flops_f)
+        tfb_tflops, raw_tfb, ok_fb = tfl(t_fb, flops_fb)
+        raw_tf_med, raw_tfb_med = tf_tflops, tfb_tflops
+        resolved = resolved and ok_f and ok_fb
+        tf = flops_f / max(tf_tflops, 1e-9) / 1e12
+        tfb = flops_fb / max(tfb_tflops, 1e-9) / 1e12
+        if not resolved:
+            tf_tflops = tfb_tflops = 0.0
         rows.append({
-            "metric": f"flash_attention_d{d}", "unit": "TFLOP/s",
+            "metric": (f"flash_attention_d{d}_packed" if packed
+                       else f"flash_attention_d{d}"),
+            "unit": "TFLOP/s",
             "resolved": resolved,
-            "H": H, "S": S, "d": d,
+            "H": H, "S": S, "d": d, "packed": packed,
             "fwd_TFLOPs": round(tf_tflops, 2),
+            "raw_fwd_TFLOPs": round(raw_tf, 2),
+            "raw_fwd_med_TFLOPs": round(raw_tf_med, 2),
             "fwd_us": round(tf * 1e6, 1) if resolved else 0.0,
             "fwdbwd_TFLOPs": round(tfb_tflops, 2),
+            "raw_fwdbwd_TFLOPs": round(raw_tfb, 2),
+            "raw_fwdbwd_med_TFLOPs": round(raw_tfb_med, 2),
             "fwdbwd_us": round(tfb * 1e6, 1) if resolved else 0.0,
             "launch_ms": round(t_f["launch"] * 1e3, 1),
             "value": round(tf_tflops, 2),
-            "mfu_fwd": round(tf_tflops / V5E_BF16_TFLOPS, 4),
-            "mfu_fwdbwd": round(tfb_tflops / V5E_BF16_TFLOPS, 4),
+            "mfu_fwd": round(tf_tflops / peak_tflops, 4),
+            "mfu_fwdbwd": round(tfb_tflops / peak_tflops, 4),
             # useful work per MXU tile row: d/128 of the padded lanes
-            "pad_lane_util": round(min(d, 128) / 128, 3),
+            # (a packed kernel fills both halves of the tile)
+            "pad_lane_util": 1.0 if packed else round(min(d, 128) / 128, 3),
         })
     return rows
 
@@ -265,7 +359,9 @@ def bench_cmdlist_chain(acc, nbytes: int = 128 << 20, k: int = 64,
     ``from_device=True`` (buffers untouched on host), so the slope
     between list lengths is the pure per-op device cost; it should match
     the fused series at the same size — before the donation fix it lost
-    ~2x to loop-carry copies."""
+    ~2x to loop-carry copies. Rounds pair one short-list and one
+    long-list execute into an independent slope sample, same resolution
+    protocol as the loop lanes (median flags, raw values reported)."""
     from ..constants import dataType, reduceFunction
 
     n = nbytes // 4
@@ -288,40 +384,43 @@ def bench_cmdlist_chain(acc, nbytes: int = 128 << 20, k: int = 64,
     short, long_ = make_list(k_short), make_list(k)
     salt = iter(range(1, 1 << 30))
 
-    def timed(cl):
-        cl.execute()  # compile + warm + upload host mirrors once
-        ts = []
-        for _ in range(rounds):
-            # perturb operand a ON DEVICE between reps (untimed): a
-            # value-identical re-execute is exactly what the tunnel's
-            # repeat-execution cache serves without running
-            a.device_store(a.device_view() + np.float32(next(salt) * 1e-6))
-            # from_device skips the payload upload, sync=False skips the
-            # payload download; wait() blocks on device completion only —
-            # so the re-execute cost is launch + k * per-op device time
-            t0 = time.perf_counter()
-            req = cl.execute(sync=False, from_device=True)
-            req.wait(timeout=120)
-            ts.append(time.perf_counter() - t0)
-        return float(np.min(ts))
+    def timed_once(cl) -> float:
+        # perturb operand a ON DEVICE between reps (untimed): a
+        # value-identical re-execute is exactly what the tunnel's
+        # repeat-execution cache serves without running
+        a.device_store(a.device_view() + np.float32(next(salt) * 1e-6))
+        # from_device skips the payload upload, sync=False skips the
+        # payload download; wait() blocks on device completion only —
+        # so the re-execute cost is launch + k * per-op device time
+        t0 = time.perf_counter()
+        req = cl.execute(sync=False, from_device=True)
+        req.wait(timeout=120)
+        return time.perf_counter() - t0
 
-    t_short, t_long = timed(short), timed(long_)
-    per = (t_long - t_short) / (k - k_short)
-    gbps = nbytes / per / 1e9 if per > 1e-7 else 0.0
-    # same cache-pollution guard as the loop lanes: implied HBM traffic
-    # beyond the roofline means the device did not run the chain
-    resolved = per > 1e-7 and _physical(gbps, 3)
-    if not resolved:
-        gbps = 0.0
+    short.execute()  # compile + warm + upload host mirrors once
+    long_.execute()
+    slopes, t_longs = [], []
+    for _ in range(rounds):
+        t_s = timed_once(short)
+        t_l = timed_once(long_)
+        t_longs.append(t_l)
+        slopes.append((t_l - t_s) / (k - k_short))
+    per_min = float(np.min(slopes))
+    per_med = float(np.median(slopes))
+    # package the slope distribution in _fit_fused_loop's shape and run
+    # the SHARED resolution protocol (median flag, physical cap,
+    # noise-negative-min fallback, raw reporting) — one copy of the
+    # anti-cheat policy, not two drifting ones
+    t = {"per_op": max(per_min, 0.0), "per_op_med": max(per_med, 0.0),
+         "per_op_max": float(max(np.max(slopes), 0.0)),
+         "launch": float(max(min(t_longs) - k * per_med, 0.0)),
+         "amortized_floor": float(min(t_longs) / k),
+         "resolved": per_med > 1e-7,
+         "k_max": k, "rounds": rounds, "pilot": "cmdlist"}
     return {"metric": "cmdlist_chain_combine", "unit": "GB/s",
-            "value": round(gbps, 3), "bytes": nbytes, "ops": k,
-            "per_op_us": round(max(per, 0.0) * 1e6, 1),
-            "resolved": resolved,
-            "fixed_overhead_ms": round(
-                max(t_short - k_short * max(per, 0.0), 0.0) * 1e3, 1),
-            "traffic_multiplier": 3,
-            "hbm_frac": round(3 * gbps / V5E_HBM_GBPS, 3),
-            "world": w}
+            "bytes": nbytes, "ops": k,
+            "traffic_multiplier": 3, "world": w,
+            **_bw_fields(t, nbytes, 3)}
 
 
 def small_op_latency_distribution(nbytes: int = 16 << 10,
@@ -334,7 +433,9 @@ def small_op_latency_distribution(nbytes: int = 16 << 10,
     k=512 and k=2048 — measured), while the per-op slope is the true
     device time. Earlier rounds' "22-25 us at 16 KiB" was the amortized
     launch floor t/k_max, not device time; both numbers are reported so
-    the artifact says which is which."""
+    the artifact says which is which. These per-op times are far above
+    the roofline hint (launch-bound, not HBM-bound), so the lane keeps
+    the measured two-point pilot."""
     from ..constants import reduceFunction
     from ..ops import reduce_ops
 
@@ -348,6 +449,7 @@ def small_op_latency_distribution(nbytes: int = 16 << 10,
         # the single-launch amortized floor IS the honest upper bound:
         # it includes launch/k_max, so true per-op <= this value
         return {"per_op_us": round(t["per_op"] * 1e6, 2),
+                "per_op_med_us": round(t["per_op_med"] * 1e6, 2),
                 "per_op_upper_us": round(t["amortized_floor"] * 1e6, 2),
                 "launch_ms": round(t["launch"] * 1e3, 1),
                 "resolved": t["resolved"], "k_max": t["k_max"]}

@@ -178,9 +178,17 @@ class Buffer(BaseBuffer):
         for shard in arr.addressable_shards:
             if shard.index[0].start == rank:
                 row = shard.data
-                new = jax.lax.dynamic_update_slice(
-                    row, values.astype(row.dtype).reshape(1, -1),
-                    (0, offset))
+                if (offset == 0 and values.shape[-1] == row.shape[-1]
+                        and values.devices() == row.devices()):
+                    # whole-shard store on the right device: the incoming
+                    # array IS the new shard — skip the
+                    # dynamic_update_slice dispatch (the common recv
+                    # path; measured on the emulator rung's eager loop)
+                    new = values.astype(row.dtype).reshape(row.shape)
+                else:
+                    new = jax.lax.dynamic_update_slice(
+                        row, values.astype(row.dtype).reshape(1, -1),
+                        (0, offset))
                 shards.append(new)
                 done = True
             else:

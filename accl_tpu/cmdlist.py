@@ -281,7 +281,8 @@ class CommandList:
              slots[s.out_id], s.out_count, str(s.out_dtype))
             for key, s in zip(step_keys, self._steps))
 
-    def execute(self, sync: bool = True, from_device: bool = False):
+    def execute(self, sync: bool = True, from_device: bool = False,
+                donate: bool = True):
         """Run the whole list as ONE device launch.
 
         With ``sync`` (default) block and sync every written buffer's host
@@ -292,7 +293,17 @@ class CommandList:
         paths' ``from_device=True`` knob applied list-wide: the caller
         asserts device state is current (e.g. re-executing a list whose
         buffers were only touched on device), saving the full payload
-        upload through the host link every call."""
+        upload through the host link every call.
+
+        .. warning:: On TPU, ``execute`` DONATES written buffers' previous
+           device arrays to the fused launch: any reference user code held
+           to a written buffer's pre-execute ``device_view()`` /
+           ``Buffer.data`` array is deleted and raises on its next access.
+           The buffers themselves stay valid (they re-bind to the launch's
+           outputs); only externally-held old array handles die. Callers
+           that keep such views pass ``donate=False`` to trade the
+           in-place streaming chain for copy-on-write safety (ADVICE r4
+           #3)."""
         if self._pending_sends:
             ps = self._pending_sends[0]
             raise ACCLError(
@@ -366,12 +377,12 @@ class CommandList:
                   if isinstance(self._buffers[b], BufferSlice)
                   else id(self._buffers[b]) for b in order]
         shared = {i for i, o in enumerate(owners) if owners.count(o) > 1}
-        donate = (tuple(sorted(written_slots - shared))
-                  if jax.default_backend() == "tpu"
-                  and not acc._queue.has_inflight() else ())
+        donate_slots = (tuple(sorted(written_slots - shared))
+                        if donate and jax.default_backend() == "tpu"
+                        and not acc._queue.has_inflight() else ())
         fused = acc._programs.get(
-            self._composite_key([k for k, _ in resolved]) + (donate,),
-            lambda: jax.jit(composite, donate_argnums=donate))
+            self._composite_key([k for k, _ in resolved]) + (donate_slots,),
+            lambda: jax.jit(composite, donate_argnums=donate_slots))
         results = fused(*arrays)
         written = {s.out_id for s in self._steps}
         out_bufs = []

@@ -23,6 +23,7 @@ import os
 import socket
 import subprocess
 import sys
+import uuid
 import time
 from typing import List, Optional, Sequence
 
@@ -52,6 +53,11 @@ def launch(
     if nprocs < 1:
         raise ValueError("need at least one process")
     coord = f"127.0.0.1:{_free_port()}"
+    # job-unique session nonce: cross-process keys that must never
+    # collide with an earlier (possibly crashed) run on a long-lived
+    # coordination service derive from this instead of shared KV
+    # counters whose alignment a single crash can poison (ADVICE r4 #1)
+    session = uuid.uuid4().hex
     cmd = list(argv)
     if cmd and cmd[0].endswith(".py"):
         cmd = [sys.executable] + cmd
@@ -63,6 +69,7 @@ def launch(
         env["ACCL_COORDINATOR"] = coord
         env["ACCL_NUM_PROCS"] = str(nprocs)
         env["ACCL_PROC_ID"] = str(pid)
+        env["ACCL_SESSION"] = session
         env["ACCL_DEVS_PER_PROC"] = str(devices_per_proc)
         # ACCL_PLATFORM beats JAX_PLATFORMS: site configuration may pin the
         # latter to a TPU plugin, which ensure_initialized overrides via

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Round-5 measurement session (VERDICT r4 items 2/3/7): run on an IDLE
+# host — TPU wall-clock through the tunnel collapses under concurrent
+# host CPU load. Produces:
+#   bench_r05_run{1..5}.json     five full bench.py artifacts
+#   hardware_run_r05.log         hardware-rung pytest incl. the
+#                                repeated-launch stress (>=200 launches)
+#   autotune_r05_tpu_report.json autotune under the world-1 guard
+cd "$(dirname "$0")/.."
+set -x
+ACCL_TPU_HW=1 timeout 3000 python -m pytest tests/test_tpu_hardware.py -v -rs \
+    2>&1 | tee benchmarks/hardware_run_r05.log | tail -3
+for i in 1 2 3 4 5; do
+    timeout 1200 python bench.py \
+        > benchmarks/bench_r05_run$i.json \
+        2> benchmarks/bench_r05_run$i.log
+    echo "rc=$?" >> benchmarks/bench_r05_run$i.log
+    tail -c 300 benchmarks/bench_r05_run$i.json; echo
+done
+timeout 1800 python benchmarks/run_autotune_r05.py tpu \
+    2>&1 | tail -3

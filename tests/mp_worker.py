@@ -82,6 +82,32 @@ def main() -> int:
         assert np.allclose(got, payload)
     print(f"[p{me}] eager cross-process send/recv ok", flush=True)
 
+    # ---- eager burst: batched move + rx-pool local matching ------------
+    # The sender announces a burst; the receiver's FIRST accept batches
+    # every parked eager announcement into ONE coalesced move (rx pool),
+    # and later recvs drain the pool locally — recv'd in REVERSE tag
+    # order to prove out-of-order pool matching (rxbuf_seek semantics).
+    nburst = 6
+    if comm.rank_is_local(src):
+        for t in range(nburst):
+            sb.host[src] = payload + t
+            acc.send(sb, cnt, src=src, dst=dst, tag=40 + t)
+        sb.host[src] = payload  # later scenarios reuse sb's content
+    if comm.rank_is_local(dst):
+        fab = acc._fabric
+        sdev, ddev = comm.device(src).id, comm.device(dst).id
+        acc.recv(rb, cnt, src=src, dst=dst, tag=40 + nburst - 1)
+        assert np.allclose(rb.host[dst], payload + nburst - 1)
+        # more of the burst rode the SAME move: already local (the exact
+        # count depends on the power-of-two batch quantization)
+        assert len(fab._pool) >= 2, len(fab._pool)
+        for t in reversed(range(nburst - 1)):
+            acc.recv(rb, cnt, src=src, dst=dst, tag=40 + t)
+            assert np.allclose(rb.host[dst], payload + t)
+        assert fab.pool_segments(sdev, ddev) == 0
+    acc.barrier()
+    print(f"[p{me}] eager burst batching + rx pool ok", flush=True)
+
     # ---- cross-process rendezvous (payload > max_eager_size) -----------
     big = acc.config.max_eager_size // 4 + 1000  # f32 elements
     sb2 = acc.create_buffer(big, dataType.float32)

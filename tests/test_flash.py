@@ -283,3 +283,49 @@ def test_ulysses_flash_head_dim_64(accl, rng):
     np.testing.assert_allclose(np.asarray(fused(*args)),
                                np.asarray(base(*args)),
                                rtol=2e-3, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# head-packed d=64 variant (round 5): two heads per 128-lane tile
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_packed_matches_unpacked(rng, causal):
+    """flash_attention_packed == flash_attention at d=64: forward AND all
+    three gradients (the packed kernels run the same per-head math on
+    lane halves, so interpret mode agrees to f32 reassociation)."""
+    H, S, d = 4, 256, 64
+    q, k, v = (rng.standard_normal((H, S, d)).astype(np.float32)
+               for _ in range(3))
+    out = np.asarray(flash.flash_attention_packed(q, k, v, causal=causal))
+    ref = np.asarray(flash.flash_attention(q, k, v, causal=causal))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(out, _ref(q, k, v, causal),
+                               rtol=2e-3, atol=2e-3)
+
+    def loss_p(q, k, v):
+        return (flash.flash_attention_packed(q, k, v, causal=causal)
+                .astype(np.float32) ** 2).sum()
+
+    def loss_u(q, k, v):
+        return (flash.flash_attention(q, k, v, causal=causal)
+                .astype(np.float32) ** 2).sum()
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+    gu = jax.grad(loss_u, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gu):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_flash_packed_fallback_envelope(rng):
+    """Outside the packed envelope (odd heads / d != 64 / GQA) the public
+    wrapper silently routes to the padded kernel with identical results."""
+    S = 128
+    for H, d in [(3, 64), (4, 96), (2, 32)]:
+        q, k, v = (rng.standard_normal((H, S, d)).astype(np.float32)
+                   for _ in range(3))
+        np.testing.assert_allclose(
+            np.asarray(flash.flash_attention_packed(q, k, v)),
+            np.asarray(flash.flash_attention(q, k, v)),
+            rtol=1e-6, atol=1e-6)
