@@ -1,0 +1,102 @@
+"""Round-4 autotune evidence (VERDICT r3 Missing #3 / item 4).
+
+Runs ``ACCL.autotune(cache_path=...)`` for real on the selected rung,
+records the fingerprinted cache, and emits a tuned-vs-default comparison:
+every threshold ``select()`` reads, before and after, plus the AUTO
+selections that changed at probe sizes.
+
+Usage::
+
+    python benchmarks/run_autotune_r05.py cpu   # 8-device emulator rung
+    python benchmarks/run_autotune_r05.py tpu   # the attached chip
+"""
+import json
+import os
+import sys
+
+rung = sys.argv[1] if len(sys.argv) > 1 else "cpu"
+if rung == "cpu":
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+else:
+    import jax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import accl_tpu
+from accl_tpu.config import ACCLConfig
+from accl_tpu.constants import operation
+from accl_tpu.parallel import algorithms
+
+THRESHOLDS = [
+    "ring_threshold", "hier_threshold", "dcn_hier_threshold",
+    "pallas_threshold", "ag_ring_threshold", "ag_pallas_threshold",
+    "rs_ring_threshold", "rs_pallas_threshold", "bcast_pallas_threshold",
+    "gather_pallas_threshold", "scatter_pallas_threshold",
+    "alltoall_pallas_threshold", "reduce_pallas_threshold",
+    "bcast_flat_tree_max_ranks", "reduce_flat_tree_max_ranks",
+    "reduce_flat_tree_max_count", "gather_flat_tree_max_fanin",
+]
+
+PROBE_SIZES = [1 << 12, 1 << 16, 1 << 20, 1 << 24]
+PROBE_OPS = [operation.allreduce, operation.allgather,
+             operation.reduce_scatter, operation.bcast, operation.reduce,
+             operation.gather, operation.scatter, operation.alltoall]
+
+
+def selections(acc, cfg):
+    comm = acc.global_comm()
+    return {f"{op.name}@{nb}": algorithms.select(op, nb, comm, cfg).name
+            for op in PROBE_OPS for nb in PROBE_SIZES}
+
+
+def main():
+    acc = accl_tpu.ACCL()
+    here = os.path.dirname(os.path.abspath(__file__))
+    cache = os.path.join(here, f"autotune_r05_{rung}.json")
+    if os.path.exists(cache):
+        os.unlink(cache)  # force a fresh measurement, not a cache load
+
+    default_cfg = acc.config
+    before_thr = {k: getattr(default_cfg, k) for k in THRESHOLDS}
+    before_sel = selections(acc, default_cfg)
+
+    acc.autotune(cache_path=cache)
+    tuned_cfg = acc.config
+    after_thr = {k: getattr(tuned_cfg, k) for k in THRESHOLDS}
+    after_sel = selections(acc, tuned_cfg)
+
+    moved = {k: {"default": before_thr[k], "tuned": after_thr[k]}
+             for k in THRESHOLDS if before_thr[k] != after_thr[k]}
+    changed = {k: {"default": before_sel[k], "tuned": after_sel[k]}
+               for k in before_sel if before_sel[k] != after_sel[k]}
+
+    out = {
+        "rung": rung,
+        "backend": jax.default_backend(),
+        "world": acc.world_size,
+        "cache": os.path.basename(cache),
+        "fingerprint": json.load(open(cache)).get("_fingerprint"),
+        "thresholds_moved": moved,
+        "selections_changed": changed,
+        "thresholds_default": before_thr,
+        "thresholds_tuned": after_thr,
+    }
+    report = os.path.join(here, f"autotune_r05_{rung}_report.json")
+    with open(report, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"rung": rung, "moved": len(moved),
+                      "changed": len(changed), "report": report}))
+    if acc.world_size == 1:
+        # round-5 behavior: every select() threshold splits inter-device
+        # families, all degenerate at world=1 — autotune declines to
+        # write "measured" noise (VERDICT r4 weak #4); the record IS the
+        # empty move set plus the fingerprinted default cache
+        assert not moved, f"world=1 must not tune crossovers: {moved}"
+    else:
+        assert moved, "autotune moved no threshold — nothing recorded"
+
+
+if __name__ == "__main__":
+    main()
