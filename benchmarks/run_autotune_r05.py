@@ -15,6 +15,10 @@ import os
 import sys
 
 rung = sys.argv[1] if len(sys.argv) > 1 else "cpu"
+# round label for the artifact names: this script is round-agnostic so
+# future rounds re-run it instead of accreting drifting copies (the
+# r04 copy is kept as the producer of that round's committed artifacts)
+ROUND = sys.argv[2] if len(sys.argv) > 2 else "r05"
 if rung == "cpu":
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     import jax
@@ -54,7 +58,7 @@ def selections(acc, cfg):
 def main():
     acc = accl_tpu.ACCL()
     here = os.path.dirname(os.path.abspath(__file__))
-    cache = os.path.join(here, f"autotune_r05_{rung}.json")
+    cache = os.path.join(here, f"autotune_{ROUND}_{rung}.json")
     if os.path.exists(cache):
         os.unlink(cache)  # force a fresh measurement, not a cache load
 
@@ -83,7 +87,7 @@ def main():
         "thresholds_default": before_thr,
         "thresholds_tuned": after_thr,
     }
-    report = os.path.join(here, f"autotune_r05_{rung}_report.json")
+    report = os.path.join(here, f"autotune_{ROUND}_{rung}_report.json")
     with open(report, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"rung": rung, "moved": len(moved),
