@@ -45,33 +45,44 @@ def _cast_kernel(x_ref, o_ref, *, dst):
 
 
 @functools.partial(jax.jit, static_argnames=("dst",))
-def _pallas_cast_2d(x, dst):
-    m = x.shape[0]
-    grid = (pl.cdiv(m, _BLOCK_ROWS),)
-    in_spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
-                           memory_space=pltpu.VMEM)
-    out_spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
-                            memory_space=pltpu.VMEM)
+def _pallas_cast_rowmajor(x, dst):
+    """Cast over (W, rows, lanes): the leading dim rides the grid, so a
+    (W, n) operand reaches the kernel with a TRAILING-dim-only split —
+    flattening a (1, n) buffer (the single-chip API shape) forces XLA
+    relayout copies at the kernel boundary, measured 2x on the combine
+    chain (see reduce_ops._pallas_combine_rowmajor). Flat callers enter
+    with W=1."""
+    w, m, _ = x.shape
+    spec = pl.BlockSpec((1, _BLOCK_ROWS, _LANES),
+                        lambda wi, i: (wi, i, 0),
+                        memory_space=pltpu.VMEM)
     return pl.pallas_call(
         functools.partial(_cast_kernel, dst=dst),
         out_shape=jax.ShapeDtypeStruct(x.shape, dst),
-        grid=grid,
-        in_specs=[in_spec],
-        out_specs=out_spec,
+        grid=(w, pl.cdiv(m, _BLOCK_ROWS)),
+        in_specs=[spec],
+        out_specs=spec,
         interpret=_interpret(),
     )(x)
 
 
 def pallas_cast(x, dst_dtype):
-    """Cast via the Pallas lane, any shape (pads to the tile grid)."""
+    """Cast via the Pallas lane, any shape (pads to the tile grid); 2D
+    operands whose trailing dim divides the tile keep their leading dim
+    as a grid axis (no flatten relayout)."""
     shape = x.shape
+    tile = _BLOCK_ROWS * _LANES
+    if len(shape) == 2 and shape[1] >= tile and shape[1] % tile == 0:
+        out = _pallas_cast_rowmajor(
+            x.reshape(shape[0], -1, _LANES), dst_dtype)
+        return out.reshape(shape)
     flat = x.reshape(-1)
     n = flat.shape[0]
-    tile = _BLOCK_ROWS * _LANES
     pad = (-n) % tile
     if pad:
         flat = jnp.pad(flat, (0, pad))
-    out = _pallas_cast_2d(flat.reshape(-1, _LANES), dst_dtype).reshape(-1)
+    out = _pallas_cast_rowmajor(
+        flat.reshape(1, -1, _LANES), dst_dtype).reshape(-1)
     if pad:
         out = out[:n]
     return out.reshape(shape)
@@ -83,30 +94,43 @@ def _sr_kernel(x_ref, seed_ref, o_ref, *, dst):
     o_ref[:] = pltpu.stochastic_round(x_ref[:], bits, target_dtype=dst)
 
 
+def _pallas_sr_rowmajor(x3, dst_dtype, seed: int):
+    """Stochastic-round cast over (W, rows, lanes) — same grid-axis
+    leading dim as :func:`_pallas_cast_rowmajor` (no flatten relayout);
+    the seed rides SMEM unchanged."""
+    w, m, _ = x3.shape
+    spec = pl.BlockSpec((1, _BLOCK_ROWS, _LANES),
+                        lambda wi, i: (wi, i, 0),
+                        memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        functools.partial(_sr_kernel, dst=dst_dtype),
+        out_shape=jax.ShapeDtypeStruct(x3.shape, dst_dtype),
+        grid=(w, pl.cdiv(m, _BLOCK_ROWS)),
+        in_specs=[spec, pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=spec,
+    )(x3, jnp.array([seed], dtype=jnp.int32))
+
+
 def pallas_compress_stochastic(x, dst_dtype, seed: int = 0):
     """f32 -> bf16 compress with stochastic rounding: unbiased under the
     repeated compress/reduce cycles of multi-hop ring collectives (TPU-only;
-    no reference analog — the FPGA lane truncates)."""
+    no reference analog — the FPGA lane truncates). 2D operands keep
+    their leading dim as a grid axis like the deterministic lane."""
     if jax.default_backend() != "tpu":  # stochastic_round is TPU-only
         return x.astype(dst_dtype)
     shape = x.shape
+    tile = _BLOCK_ROWS * _LANES
+    if len(shape) == 2 and shape[1] >= tile and shape[1] % tile == 0:
+        out = _pallas_sr_rowmajor(
+            x.reshape(shape[0], -1, _LANES), dst_dtype, seed)
+        return out.reshape(shape)
     flat = x.reshape(-1)
     n = flat.shape[0]
-    tile = _BLOCK_ROWS * _LANES
     pad = (-n) % tile
     if pad:
         flat = jnp.pad(flat, (0, pad))
-    x2 = flat.reshape(-1, _LANES)
-    m = x2.shape[0]
-    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
-                        memory_space=pltpu.VMEM)
-    out = pl.pallas_call(
-        functools.partial(_sr_kernel, dst=dst_dtype),
-        out_shape=jax.ShapeDtypeStruct(x2.shape, dst_dtype),
-        grid=(pl.cdiv(m, _BLOCK_ROWS),),
-        in_specs=[spec, pl.BlockSpec(memory_space=pltpu.SMEM)],
-        out_specs=spec,
-    )(x2, jnp.array([seed], dtype=jnp.int32)).reshape(-1)
+    out = _pallas_sr_rowmajor(
+        flat.reshape(1, -1, _LANES), dst_dtype, seed).reshape(-1)
     if pad:
         out = out[:n]
     return out.reshape(shape)

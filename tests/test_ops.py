@@ -176,3 +176,20 @@ def test_pallas_combine_rowmajor_donate_chain(rng):
     np.testing.assert_allclose(np.asarray(got),
                                np.asarray(a) + 4 * np.asarray(b),
                                rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("w", [1, 2])
+def test_pallas_cast_rowmajor_2d_path(rng, w):
+    """The (W, n) trailing-split cast path (round 5): 2D operands whose
+    trailing dim divides the tile avoid the flatten relayout — results
+    must be bit-identical to the flat path's for the same data."""
+    from accl_tpu.ops import compression
+    n_tail = 2 * compression._BLOCK_ROWS * compression._LANES
+    x = jnp.asarray(rng.standard_normal((w, n_tail)).astype(np.float32))
+    got = compression.pallas_cast(x, jnp.bfloat16)
+    assert got.shape == (w, n_tail) and got.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.asarray(x.astype(jnp.bfloat16)))
+    back = compression.pallas_cast(got, jnp.float32)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x),
+                               atol=0.02, rtol=0.02)
