@@ -156,10 +156,39 @@ def _auto_block(S: int, causal: bool, dp: int = 128) -> int:
     return b if S % b == 0 else 128
 
 
+def _single_k_bq(S: int, dp: int, itemsize: int) -> int:
+    """Largest 128-multiple q-block <= 512 dividing S whose single-k-
+    block footprint fits the VMEM budget, else 0. Estimate per
+    (head, q-block) step, in the OPERAND dtype's width where the value
+    depends on it: s f32 (4) + p at operand width (bq*S*(4+itemsize)),
+    double-buffered k/v (4*S*dp*itemsize), q/o/acc/m/l (~24*bq*dp).
+    Shrinking bq keeps the geometry for wide heads (dp 256) instead of
+    forfeiting it outright."""
+    for bq in (512, 384, 256, 128):
+        if S % bq:
+            continue
+        est = (bq * S * (4 + itemsize) + 4 * S * dp * itemsize
+               + 24 * bq * dp)
+        if est <= _VMEM_BUDGET:
+            return bq
+    return 0
+
+
 def _default_blocks(S: int, d: int, causal: bool,
-                    block_q: Optional[int], block_k: Optional[int]):
+                    block_q: Optional[int], block_k: Optional[int],
+                    itemsize: int = 2):
     """Resolve the wrappers' block defaults in one place: None picks the
     auto size for the PADDED head dim (the VMEM model's operand width).
+
+    Sequences up to 2048 take the SINGLE-K-BLOCK geometry (block_k = S,
+    block_q <= 512): the whole online-softmax carry loop disappears
+    (nk=1 — one-shot softmax per q-block) and k/v stay VMEM-resident
+    across the q sweep. Measured round 5 (v5e, H=8, S=2048, d=128,
+    median slope): non-causal 67.8% -> 73.7% MFU, and CAUSAL 305 us ->
+    141 us per call (2.2x) — at the old 256-block causal geometry the
+    per-grid-step overhead cost far more than the whole-block causal
+    skip saved. Longer sequences keep the swept-block auto sizes (at
+    S=4096 a 2048-wide k block measured WORSE than swept 512s).
 
     Interpret mode (the CPU emulator rung) keeps the 128 geometry: the
     auto sizes exist to amortize REAL per-grid-step hardware overhead,
@@ -168,6 +197,10 @@ def _default_blocks(S: int, d: int, causal: bool,
     if _interpret_params() is not None:
         return block_q or 128, block_k or 128
     dp_est = -(-d // 128) * 128
+    if block_q is None and block_k is None and S <= 2048 and S % 128 == 0:
+        bq = _single_k_bq(S, dp_est, itemsize)
+        if bq:
+            return bq, S
     if block_q is None:
         block_q = _auto_block(S, causal, dp_est)
     if block_k is None:
@@ -220,7 +253,8 @@ def flash_attention(q, k, v, causal: bool = False,
     if single:
         q, k, v = q[None], k[None], v[None]
     H, S, d = q.shape
-    block_q, block_k = _default_blocks(S, d, causal, block_q, block_k)
+    block_q, block_k = _default_blocks(S, d, causal, block_q, block_k,
+                                   q.dtype.itemsize)
     _check_shapes(q, k, v, S, d, block_q, block_k)
     sc = scale if scale is not None else 1.0 / (d ** 0.5)  # ORIGINAL d
     q, k, v, dp = _pad_head_dim(q, k, v, d)
@@ -245,7 +279,8 @@ def flash_attention_lse(q, k, v, causal: bool = False,
     if single:
         q, k, v = q[None], k[None], v[None]
     H, S, d = q.shape
-    block_q, block_k = _default_blocks(S, d, causal, block_q, block_k)
+    block_q, block_k = _default_blocks(S, d, causal, block_q, block_k,
+                                   q.dtype.itemsize)
     _check_shapes(q, k, v, S, d, block_q, block_k)
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
     q, k, v, dp = _pad_head_dim(q, k, v, d)
@@ -821,7 +856,8 @@ def flash_attention_packed(q, k, v, causal: bool = False,
         return flash_attention(q, k, v, causal=causal, scale=scale,
                                block_q=block_q, block_k=block_k)
     H, S, d = q.shape
-    block_q, block_k = _default_blocks(S, 2 * d, causal, block_q, block_k)
+    block_q, block_k = _default_blocks(S, 2 * d, causal, block_q, block_k,
+                                   q.dtype.itemsize)
     _check_shapes(q, k, v, S, d, block_q, block_k)
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
     qp, kp, vp = _pack_heads(q), _pack_heads(k), _pack_heads(v)
