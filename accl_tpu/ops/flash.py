@@ -47,11 +47,51 @@ def _interpret_params():
     return pallas_ring._interpret_params()
 
 
+def _store_lse(lse_ref, lse_vec, block_q: int):
+    """Write a q-block's per-row lse into its (pad_rows, 128) slab,
+    zeroing the 8-sublane padding tail — the ONE writer both forward
+    paths share (a diverged copy would corrupt backward gradients for
+    whichever geometry used it)."""
+    rows = block_q // 128
+    lse_ref[0, 0, :rows] = lse_vec.reshape(rows, 128)
+    if rows < lse_ref.shape[2]:
+        lse_ref[0, 0, rows:] = jnp.zeros(
+            (lse_ref.shape[2] - rows, 128), _F32)
+
+
 def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
             causal: bool, scale: float, block_q: int, block_k: int):
     i = pl.program_id(1)          # q-block
     j = pl.program_id(2)          # k-block (innermost: scratch carries)
     nk = pl.num_programs(2)
+
+    if nk == 1 and causal:
+        # single-k-block geometry (block_k == S), causal: one-shot
+        # softmax — no scratch carry, no alpha renormalization, the
+        # accumulator never round-trips VMEM scratch. Measured 16%
+        # faster for the causal mask (141 -> 122 us at H=8, S=2048,
+        # d=128) but ~5% SLOWER non-causal (Mosaic schedules the
+        # scratch-accumulated epilogue better there), so the carry
+        # path keeps the non-causal case.
+        q = q_ref[0]
+        s = jax.lax.dot_general(
+            q, k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=_F32) * (scale * _LOG2E)
+        rows_i = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0)
+        cols_i = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(rows_i >= cols_i, s, _NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)             # (bq, 1)
+        p = jnp.exp2(s - m)
+        l = jnp.sum(p, -1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=_F32)
+        safe_l = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = (pv / safe_l).astype(o_ref.dtype)
+        _store_lse(lse_ref, m[:, 0] * _LN2 + jnp.log(safe_l[:, 0]),
+                   block_q)
+        return
 
     @pl.when(j == 0)
     def _init():
@@ -105,12 +145,8 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         # per-(h, i) block keeps VMEM O(block_q) and the q dimension
         # megacore-parallel)
         # m is a log2 quantity (exp2-domain softmax); lse is natural log
-        lse = (m_ref[:, 0] * _LN2 + jnp.log(safe_l[:, 0]))
-        rows = block_q // 128
-        lse_ref[0, 0, :rows] = lse.reshape(rows, 128)
-        if rows < lse_ref.shape[2]:       # zero the 8-sublane padding tail
-            lse_ref[0, 0, rows:] = jnp.zeros(
-                (lse_ref.shape[2] - rows, 128), _F32)
+        _store_lse(lse_ref, m_ref[:, 0] * _LN2 + jnp.log(safe_l[:, 0]),
+                   block_q)
 
 
 def _pad_head_dim(q, k, v, d: int):
