@@ -173,12 +173,16 @@ def _auto_block(S: int, causal: bool, dp: int = 128) -> int:
     Measured (round 4, v5e, S=2048 d=128 non-causal): per-grid-step
     overhead dominates small blocks — 128-blocks ran at 17 TFLOP/s,
     256 at 38, 1024 at 58 (outputs equal within f32 reassociation).
-    Non-causal caps at 1024; causal at 256, because whole-block masking
-    is the skip granularity — giant blocks forfeit the ~2x causal
-    compute skip. ``dp`` (the PADDED head dim) feeds a VMEM estimate —
-    ~2 score/prob f32 blocks + ~8 double-buffered q/k/v/out/acc strips —
-    so large-d callers are not pushed past the scoped-VMEM limit the
-    old fixed 128 default never approached.
+    Non-causal caps at 1024. The causal 256 cap survives ONLY for the
+    user-pinned-block path (one of block_q/block_k given explicitly):
+    its original whole-block-skip rationale was disproved by round-5
+    measurements — per-grid-step overhead costs far more than the skip
+    saves — and the all-default causal path in ``_default_blocks`` now
+    picks asymmetric 512x1024 blocks instead. ``dp`` (the PADDED head
+    dim) feeds a VMEM estimate — ~2 score/prob f32 blocks + ~8
+    double-buffered q/k/v/out/acc strips — so large-d callers are not
+    pushed past the scoped-VMEM limit the old fixed 128 default never
+    approached.
     """
     cap = 256 if causal else 1024
 
@@ -237,6 +241,27 @@ def _default_blocks(S: int, d: int, causal: bool,
         bq = _single_k_bq(S, dp_est, itemsize)
         if bq:
             return bq, S
+    if causal and block_q is None and block_k is None:
+        # swept causal (S > 2048): ASYMMETRIC blocks. The old symmetric
+        # 256 cap reasoned that whole-block masking is the skip
+        # granularity and big blocks forfeit the ~2x causal skip —
+        # measured round 5, the per-grid-step overhead costs far more
+        # than the skip saves: 512x1024 runs 3.3x faster than 256x256
+        # at S=4096 (1130 -> 343 us) and 3.6x at S=8192. The VMEM
+        # estimate is asymmetric (s/p f32+operand: 8*bq*bk; q/k/v/o
+        # strips double-buffered: 16*(bq+bk)*dp).
+        for bq in (512, 384, 256, 128):
+            if S % bq:
+                continue
+            for bk in (1024, 512, 384, 256, 128):
+                if S % bk:
+                    continue
+                if 8 * bq * bk + 16 * (bq + bk) * dp_est <= _VMEM_BUDGET:
+                    return bq, bk
+            # even bk=128 missed the budget (very wide padded head):
+            # shrink the q block too — preserving the old symmetric
+            # path's guaranteed degradation toward (128, 128)
+        return 128, 128
     if block_q is None:
         block_q = _auto_block(S, causal, dp_est)
     if block_k is None:
