@@ -58,3 +58,30 @@ def accl() -> accl_tpu.ACCL:
 @pytest.fixture()
 def rng() -> np.random.Generator:
     return np.random.default_rng(42)
+
+
+# ---------------------------------------------------------------------------
+# shared AOT lowering gate (test_chunked_schedule + test_flash_schedule):
+# one copy of the Mosaic-kernel detection and buffer-plan check, so a jax
+# upgrade that changes the custom-call target string is fixed in one place
+# ---------------------------------------------------------------------------
+
+import re  # noqa: E402
+
+MOSAIC_CALL = re.compile(r'custom_call_target="tpu_custom_call"')
+AOT_HBM_BYTES = 16 << 30   # v5e: 16 GiB HBM per chip
+
+
+def assert_aot_lowered(compiled, min_kernels: int = 1) -> str:
+    """The compiled module must contain the Mosaic kernels (not an
+    interpret-mode callback) and its buffer plan must fit the chip.
+    Returns the module text for further structural assertions."""
+    txt = compiled.as_text()
+    kernels = len(MOSAIC_CALL.findall(txt))
+    assert kernels >= min_kernels, \
+        f"expected >= {min_kernels} Mosaic kernels, found {kernels}"
+    ma = compiled.memory_analysis()
+    total = (ma.argument_size_in_bytes + ma.output_size_in_bytes
+             + ma.temp_size_in_bytes)
+    assert total < AOT_HBM_BYTES, f"buffer plan {total} exceeds HBM"
+    return txt

@@ -58,7 +58,7 @@ def tpu_comm():
     return comm
 
 
-_MOSAIC = re.compile(r'custom_call_target="tpu_custom_call"')
+from conftest import assert_aot_lowered  # shared AOT gate
 
 
 def _aot_compile(fn, comm, *shapes, dtype=jnp.float32):
@@ -73,17 +73,7 @@ def _aot_compile(fn, comm, *shapes, dtype=jnp.float32):
 
 
 def _assert_lowered(compiled, min_kernels: int = 1):
-    """The module must contain the Mosaic kernels (not an interpret-mode
-    callback) and its buffer plan must fit the chip."""
-    txt = compiled.as_text()
-    kernels = len(_MOSAIC.findall(txt))
-    assert kernels >= min_kernels, \
-        f"expected >= {min_kernels} Mosaic kernels, found {kernels}"
-    ma = compiled.memory_analysis()
-    total = (ma.argument_size_in_bytes + ma.output_size_in_bytes
-             + ma.temp_size_in_bytes)
-    assert total < HBM_BYTES, f"buffer plan {total} exceeds HBM"
-    return txt
+    return assert_aot_lowered(compiled, min_kernels)
 
 
 def test_chunked_allreduce_lowers_multihost(tpu_comm):
