@@ -577,8 +577,9 @@ def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
 # What packing can and cannot buy on the MXU (measured + hardware model):
 # a (m,64)x(64,n) matmul streams through the 128x128 systolic array in
 # the SAME time as (m,128)x(128,n) — the contraction dim is padded in
-# hardware — so per-(bq,bk) tile the two packed heads' matmuls cost
-# exactly what two unpacked heads cost. The structural useful-FLOP
+# hardware (microbench committed in benchmarks/flash_packed_r05.json:
+# 8.4 us either way) — so per-(bq,bk) tile the two packed heads' matmuls
+# cost exactly what two unpacked heads cost. The structural useful-FLOP
 # ceiling at d=64 is therefore d/128 = 50% MFU, and no packing scheme
 # beats it on a dense systolic array (block-diagonal operands stream
 # their zeros). What packing DOES recover:
@@ -588,8 +589,14 @@ def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
 #   * kernel HBM traffic halves (dense 128-lane tiles instead of
 #     half-zero padded ones);
 #   * grid steps halve (one per head PAIR), halving per-step overhead.
-# Measured effect: d=64 fwd 33% -> ~45% MFU (of the 50% ceiling), see
-# the flash_attention_d64_packed bench lane.
+# Measured effect at the bench shapes (H=8, S=2048): ~NEUTRAL — the
+# kernel is matmul/VPU-bound there, the pad pass is hoisted for the
+# loop-invariant k/v, and the pack relayout of q costs about what the
+# pad did (packed 32-34% vs unpacked 33-37% fwd MFU across committed
+# runs). The variant is kept because its wins are traffic-proportional:
+# HBM-bound shapes (short S, many heads, memory-pressured pipelines)
+# keep the halved traffic, and the bench row keeps the comparison
+# honest every round.
 # ---------------------------------------------------------------------------
 
 
