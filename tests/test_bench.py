@@ -78,3 +78,51 @@ def test_efficiency_bounds():
                              measured_s=1e3, bw=1e9) < 1e-5
     assert models.efficiency(operation.barrier, 1, 0,
                              measured_s=1.0, bw=1e9) == 0.0
+
+
+def test_bw_fields_resolution_protocol(monkeypatch):
+    """The lane resolution protocol (VERDICT r4 weak #3): flag on the
+    MEDIAN slope with a 1.10x cap; the min slope is the headline unless
+    it is unphysical or clamped-to-zero (noise-negative), in which case
+    the median reports; raw values stay on the record either way."""
+    from accl_tpu.bench import harness, lanes
+
+    monkeypatch.setattr(harness, "hbm_peak_bytes_per_s", lambda: 800e9)
+    nbytes = 64 << 20
+    base = {"per_op_max": 1e-3, "launch": 0.1, "amortized_floor": 1e-3,
+            "resolved": True, "k_max": 512, "rounds": 5, "pilot": "hint"}
+
+    def bw(per):  # implied GB/s at 3x traffic for a given slope
+        return nbytes / per / 1e9
+
+    # normal: min physical -> min is the headline
+    t = dict(base, per_op=3e-4, per_op_med=3.3e-4)
+    f = lanes._bw_fields(t, nbytes, 3)
+    assert f["resolved"] and f["value"] == round(bw(3e-4), 3)
+
+    # noise-fast min (implied > 1.10x roofline) with healthy median ->
+    # median reports, raw min stays on the record
+    fast = nbytes * 3 / (800e9 * 2)     # 2x roofline
+    t = dict(base, per_op=fast, per_op_med=3.3e-4)
+    f = lanes._bw_fields(t, nbytes, 3)
+    assert f["resolved"] and f["value"] == round(bw(3.3e-4), 3)
+    assert f["raw_GBps"] == round(bw(fast), 3)
+
+    # clamped-to-zero min (noise-negative slope) must NOT report 0.0 on
+    # a resolved lane — the regression the round-5 review caught
+    t = dict(base, per_op=0.0, per_op_med=3.3e-4)
+    f = lanes._bw_fields(t, nbytes, 3)
+    assert f["resolved"] and f["value"] == round(bw(3.3e-4), 3)
+
+    # MEDIAN unphysical -> the lane unresolves, value zeroes, raws kept
+    t = dict(base, per_op=fast, per_op_med=fast)
+    f = lanes._bw_fields(t, nbytes, 3)
+    assert not f["resolved"] and f["value"] == 0.0
+    assert f["raw_med_GBps"] == round(bw(fast), 3)
+
+    # an honest ~0.98-roofline median survives the 1.10x cap (the old
+    # 1.05x min-based cap zeroed exactly this case)
+    honest = nbytes * 3 / (800e9 * 0.98)
+    t = dict(base, per_op=honest, per_op_med=honest)
+    f = lanes._bw_fields(t, nbytes, 3)
+    assert f["resolved"] and f["value"] == round(bw(honest), 3)
