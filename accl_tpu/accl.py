@@ -60,6 +60,21 @@ class ACCL:
     memory), so those steps dissolve.
     """
 
+    # config is a write-through property: session knobs that steer
+    # module-level kernel policy (the flash backward mode) are applied on
+    # EVERY assignment — init, autotune adoption, runtime setters — so a
+    # replaced config never leaves the kernel layer on a stale policy.
+    @property
+    def config(self) -> ACCLConfig:
+        return self._config
+
+    @config.setter
+    def config(self, cfg: ACCLConfig) -> None:
+        self._config = cfg
+        from .ops import flash as _flash_ops
+
+        _flash_ops.set_flash_bwd_mode(cfg.flash_bwd)
+
     def __init__(
         self,
         devices: Optional[Sequence[jax.Device]] = None,

@@ -462,13 +462,54 @@ def autotune_flat_tree(acc, cfg: ACCLConfig, reps: int = 3,
     return cfg.replace(gather_flat_tree_max_fanin=best_fanin)
 
 
+def autotune_flash_bwd(acc, cfg: Optional[ACCLConfig] = None,
+                       H: int = 8, S: int = 2048, d: int = 128,
+                       reps: int = 3) -> ACCLConfig:
+    """Measure the FUSED single-pass flash backward against the two-pass
+    pair on the live chip and write the winner to ``cfg.flash_bwd`` —
+    the fused/two-pass crossover register of the round-6 kernel. Only
+    meaningful on a real TPU backend: the interpret rung would measure
+    the emulator (both modes run identical 128-blocks there), so on any
+    other backend the config passes through untouched. Single-chip —
+    runs at ANY world size, unlike the collective crossovers."""
+    import jax
+    cfg = cfg or acc.config
+    if jax.default_backend() != "tpu":
+        return cfg
+    import jax.numpy as jnp
+    from ..ops import flash
+
+    rng = np.random.default_rng(0)
+    ops = {}
+    q, k, v = (jnp.asarray(rng.standard_normal((H, S, d))
+                           .astype(np.float32) * 0.1).astype(jnp.bfloat16)
+               for _ in range(3))
+    for mode in ("fused", "two_pass"):
+        def loss(a, b, c, mode=mode):
+            return flash.flash_attention(a, b, c, causal=True,
+                                         bwd_mode=mode).astype(
+                jnp.float32).sum()
+
+        prog = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        jax.block_until_ready(prog(q, k, v))  # compile + warm
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(prog(q, k, v))
+            ts.append(time.perf_counter() - t0)
+        ops[mode] = float(np.min(ts))
+    winner = "fused" if ops["fused"] <= ops["two_pass"] else "two_pass"
+    return cfg.replace(flash_bwd=winner)
+
+
 def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
                      reps: int = 3,
                      dt: dataType = dataType.float32) -> ACCLConfig:
     """Tune EVERY threshold ``select()`` reads on the live mesh: allreduce
-    ring/hier(/pallas), allgather + reduce_scatter ring crossovers, and
-    the flat-tree rank/count/fan-in registers (accl.cpp:1214-1224 analog,
-    measured instead of frozen)."""
+    ring/hier(/pallas), allgather + reduce_scatter ring crossovers, the
+    flat-tree rank/count/fan-in registers (accl.cpp:1214-1224 analog,
+    measured instead of frozen), and the single-chip flash fused/two-pass
+    backward crossover (any world size)."""
     if acc.global_comm().world_size == 1:
         # Every threshold select() reads splits INTER-DEVICE algorithm
         # families; at world=1 all of them are degenerate (a one-rank
@@ -481,8 +522,8 @@ def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
         from ..utils.logging import get_logger
         get_logger("accl").info(
             "autotune: world=1 — collective crossovers are degenerate; "
-            "keeping default thresholds")
-        return acc.config
+            "keeping default thresholds (flash bwd crossover still runs)")
+        return autotune_flash_bwd(acc, reps=reps)
     cfg = autotune_allreduce(acc, pows=pows, reps=reps, dt=dt)
     acc.config, saved = cfg, acc.config
     try:
@@ -494,6 +535,7 @@ def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
         cfg = autotune_alltoall(acc, cfg, pows=pows, reps=reps, dt=dt)
         cfg = autotune_reduce(acc, cfg, pows=pows, reps=reps, dt=dt)
         cfg = autotune_flat_tree(acc, cfg, reps=reps, dt=dt)
+        cfg = autotune_flash_bwd(acc, cfg, reps=reps)
     finally:
         acc.config = saved
     return cfg

@@ -382,6 +382,87 @@ def bench_flash(head_dims=(64, 96, 128), H: int = 8, S: int = 2048,
     return rows
 
 
+def bench_flash_bwd(head_dims=(64, 96, 128), H: int = 8, S: int = 2048,
+                    rounds: int = 5, causal_dim: int = 128) -> List[dict]:
+    """BACKWARD-only flash MFU per head dim, fused vs two-pass A/B
+    (round 6: the fused single-pass dK/dV+dQ kernel) — beside the
+    existing fwd+bwd rows, which cannot separate the backward.
+
+    The chained step is the PURE backward: residuals come from one
+    jax.vjp outside the loop (captured as constants), the cotangent is
+    the loop carry (dq feeds it; dk/dv fold in at 1e-30 so the fused
+    kernel's dk/dv outputs cannot be dead-code-eliminated). FLOPs are
+    the USEFUL 5-matmul count (2.5x fwd = 10*H*S^2*d) for BOTH modes —
+    the two-pass pair actually executes 7 matmuls/tile, so its honest
+    useful-MFU is lower; the ratio field is the fused win. Resolution
+    protocol as everywhere: the MEDIAN slope is the headline and carries
+    the flag, raw min/median values stay on the record either way."""
+    from ..ops import flash
+
+    rng = np.random.default_rng(0)
+
+    def operand(shape):
+        return jnp.asarray(rng.standard_normal(shape, dtype=np.float32)
+                           * np.float32(0.1)).astype(jnp.bfloat16)
+
+    peak_tflops = _bf16_peak_tflops()
+    rows = []
+    cases = [(d, False) for d in head_dims]
+    if causal_dim in head_dims:
+        cases.append((causal_dim, True))
+    for d, causal in cases:
+        q = operand((H, S, d))
+        k = operand((H, S, d))
+        v = operand((H, S, d))
+        cot = operand((H, S, d))
+        flops = 10 * H * S * S * d // (2 if causal else 1)  # useful bwd
+
+        def measure(mode):
+            _, vjp = jax.vjp(
+                lambda a, b, c: flash.flash_attention(
+                    a, b, c, causal=causal, bwd_mode=mode), q, k, v)
+
+            def step(_, ct):
+                dq, dk, dv = vjp(ct)
+                return (dq + (dk.sum() + dv.sum()).astype(ct.dtype) * 1e-30
+                        ).astype(ct.dtype)
+
+            t = _fit_fused_loop(step, cot, rounds=rounds,
+                                per_est=flops / (0.4 * peak_tflops * 1e12))
+            raw_min = flops / max(t["per_op"], 1e-9) / 1e12
+            raw_med = flops / max(t["per_op_med"], 1e-9) / 1e12
+            ok = t["resolved"] and raw_med <= peak_tflops
+            return t, raw_min, raw_med, ok
+
+        t_f, f_min, f_med, f_ok = measure("fused")
+        t_t, t_min, t_med, t_ok = measure("two_pass")
+        rows.append({
+            "metric": (f"flash_bwd_d{d}_causal" if causal
+                       else f"flash_bwd_d{d}"),
+            "unit": "TFLOP/s",
+            "resolved": f_ok, "H": H, "S": S, "d": d, "causal": causal,
+            "flop_accounting": ("useful bwd 5-matmul"
+                                + (", masked half excluded" if causal
+                                   else "")),
+            "value": round(f_med if f_ok else 0.0, 2),
+            "raw_bwd_TFLOPs": round(f_min, 2),
+            "raw_bwd_med_TFLOPs": round(f_med, 2),
+            "bwd_us": round(t_f["per_op_med"] * 1e6, 1) if f_ok else 0.0,
+            "mfu_bwd": round((f_med if f_ok else 0.0) / peak_tflops, 4),
+            "launch_ms": round(t_f["launch"] * 1e3, 1),
+            # the two-pass A/B sibling, same protocol fields
+            "twopass_resolved": t_ok,
+            "twopass_TFLOPs": round(t_med if t_ok else 0.0, 2),
+            "raw_twopass_TFLOPs": round(t_min, 2),
+            "raw_twopass_med_TFLOPs": round(t_med, 2),
+            "mfu_bwd_twopass": round((t_med if t_ok else 0.0)
+                                     / peak_tflops, 4),
+            "fused_vs_twopass": (round(f_med / t_med, 3)
+                                 if f_ok and t_ok and t_med > 0 else None),
+        })
+    return rows
+
+
 def bench_cmdlist_chain(acc, nbytes: int = 128 << 20, k: int = 64,
                         rounds: int = 7) -> dict:
     """A CommandList of ``k`` chained large combines executed as ONE

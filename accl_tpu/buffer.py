@@ -179,7 +179,11 @@ class Buffer(BaseBuffer):
             if shard.index[0].start == rank:
                 row = shard.data
                 if (offset == 0 and values.shape[-1] == row.shape[-1]
+                        and isinstance(values, jax.Array)
                         and values.devices() == row.devices()):
+                    # the isinstance gate: NumPy arrays have no
+                    # .devices() and must fall through to the
+                    # dynamic_update_slice path, not raise (ADVICE r5)
                     # whole-shard store on the right device: the incoming
                     # array IS the new shard — skip the
                     # dynamic_update_slice dispatch (the common recv

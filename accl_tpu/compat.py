@@ -1,0 +1,82 @@
+"""Version-bridging imports.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the
+top-level ``jax`` namespace around jax 0.6; the container's baked jax
+pin moves between rounds, so every module imports it from here instead
+of guessing which spelling this jax exports.
+"""
+import inspect as _inspect
+
+try:                       # jax >= 0.6 exports shard_map at top level
+    from jax import shard_map
+except ImportError:        # older jax: the experimental namespace
+    from jax.experimental.shard_map import shard_map
+
+# the replication check kwarg was renamed check_rep -> check_vma; the
+# codebase writes the current spelling, older jax gets it translated
+if "check_vma" not in _inspect.signature(shard_map).parameters:
+    _shard_map_raw = shard_map
+
+    def shard_map(*args, **kw):  # noqa: F811 — deliberate compat rebind
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        return _shard_map_raw(*args, **kw)
+
+from jax.experimental.pallas import tpu as _pltpu
+
+# Pallas TPU renames, bridged INTO the pltpu namespace so every kernel
+# module and test keeps the current-jax spelling (this package imports
+# compat before any kernel module loads):
+#  * CompilerParams was TPUCompilerParams before jax ~0.5;
+#  * InterpretParams (the TPU interpreter with race detection) does not
+#    exist on older jax at all — the stand-in below is truthy (selects
+#    the generic pallas interpreter, which pallas_call accepts for its
+#    ``interpret`` flag) and swallows kwargs like ``detect_races``, so
+#    interpret-mode suites still run; race DETECTION is simply
+#    unavailable on a jax without the TPU interpreter.
+if not hasattr(_pltpu, "CompilerParams"):
+    import dataclasses as _dc
+
+    _TCP_FIELDS = {f.name for f in _dc.fields(_pltpu.TPUCompilerParams)}
+
+    def _compiler_params_compat(**kw):
+        """TPUCompilerParams factory that DROPS kwargs this older jax
+        cannot express (e.g. ``has_side_effects``, which has no
+        TPUCompilerParams field before jax ~0.5). Dropping is safe for
+        the kernels here: every side-effecting kernel also has real
+        data outputs its callers consume, so DCE cannot remove it; the
+        flag is belt-and-suspenders on jax versions that accept it."""
+        return _pltpu.TPUCompilerParams(
+            **{k: v for k, v in kw.items() if k in _TCP_FIELDS})
+
+    _pltpu.CompilerParams = _compiler_params_compat
+
+from jax import lax as _lax
+
+if not hasattr(_lax, "axis_size"):
+    def _axis_size(axis_name):
+        """lax.axis_size appeared ~jax 0.5; psum of ones is the classic
+        spelling and works in every shard_map body."""
+        return _lax.psum(1, axis_name)
+
+    _lax.axis_size = _axis_size
+
+
+class _InterpretParamsStandIn:
+    """API stand-in for pltpu.InterpretParams on older jax (see above)."""
+
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+#: True when this jax ships the real TPU interpreter (InterpretParams):
+#: only that interpreter can simulate CROSS-DEVICE remote DMA and
+#: semaphore signals. Under the stand-in, the generic pallas interpreter
+#: runs single-device kernels fine but raises NotImplementedError on
+#: remote signals — the interpret-rung RDMA suites skip on this flag.
+HAS_TPU_INTERPRET = hasattr(_pltpu, "InterpretParams")
+
+if not HAS_TPU_INTERPRET:
+    _pltpu.InterpretParams = _InterpretParamsStandIn
+
+__all__ = ["shard_map"]

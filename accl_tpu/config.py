@@ -116,6 +116,14 @@ class ACCLConfig:
     # plain jnp ops are used (XLA fuses them anyway — this is a debug switch)
     use_pallas: bool = True
 
+    # flash-attention backward: "fused" runs the single-pass dK/dV+dQ
+    # kernel wherever its VMEM plan fits (two-pass beyond); "two_pass"
+    # pins the classic kernel pair everywhere — the A/B switch and the
+    # VMEM-pressure escape hatch. Applied to accl_tpu.ops.flash at every
+    # config assignment; bench.autotune_flash_bwd measures the crossover
+    # on the live chip and writes the winner here.
+    flash_bwd: str = "fused"
+
     # snake-order auto-discovered TPU devices by chip coordinates so ring
     # neighbors are physical ICI neighbors (bringup.snake_order); explicit
     # device lists are never reordered

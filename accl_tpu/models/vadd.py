@@ -12,8 +12,9 @@ the ring put fuse into a single XLA schedule.
 from __future__ import annotations
 
 import jax
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+from ..compat import shard_map
 
 from .. import device_api as dapi
 from ..communicator import Communicator

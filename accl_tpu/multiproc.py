@@ -65,6 +65,7 @@ Environment contract (set by :mod:`accl_tpu.launch`):
 """
 from __future__ import annotations
 
+import collections
 import json
 import os
 import time
@@ -224,8 +225,10 @@ class CrossProcessFabric:
         # (sdev, ddev, seq) — consumed by _execute when the move lands
         self._batch_hdrs: Dict[Tuple[int, int, int], dict] = {}
         # consumed announcement keys awaiting lazy cleanup (deleted off
-        # the critical path by idle pump cycles)
-        self._pending_deletes: list = []
+        # the critical path by idle pump cycles) — a FIFO: drained
+        # oldest-first so the coordinator's oldest keys are cleaned
+        # first instead of starving behind every newer batch (ADVICE r5)
+        self._pending_deletes: collections.deque = collections.deque()
         # directory-read support flag: flipped off (with a warning) on
         # the first dir_get failure, switching fetch to per-seq try_get
         self._dir_get_ok = True
@@ -239,8 +242,7 @@ class CrossProcessFabric:
         # namespace is fresh per fabric instance, but snapshotting stays
         # cheap insurance against namespace reuse outside the contract
         # (e.g. a mid-job process restart with the env session nonce)
-        self._cursor = int(self._try_get(_client(), f"{self.ns}/sn")
-                           or 0) + 1
+        self._cursor = self._kcount(_client(), f"{self.ns}/sn") + 1
         # pair-mesh move programs keyed (sdev, ddev, count, wire dtype)
         self._progs: Dict[tuple, tuple] = {}
         # barrier arrivals that timed out before their round completed:
@@ -270,29 +272,46 @@ class CrossProcessFabric:
             # key populated — the publish must OVERWRITE, or p0 raises
             # ALREADY_EXISTS exactly when the nonce matters most
             self._kset_force(client, key, s)
-            # fail-LOUD echo check: on a long-lived KV a peer can read a
-            # dead run's nonce before this overwrite lands (it is the
-            # likely outcome, not a rare race — p0 pays a _kincr round
-            # trip first). Each peer echoes what it read; a mismatch
-            # here turns a silent mesh-split hang into an actionable
-            # error that aborts the job (launcher mpirun semantics).
+            # Handshake keys are namespaced by the FRESHLY MINTED nonce
+            # (ADVICE r5): a reused coordination service holds the dead
+            # run's ack keys, and the old un-namespaced blocking get
+            # returned one of those stale values instantly — aborting
+            # the rerun with CONFIG_ERROR exactly when the nonce
+            # mattered. Under this run's nonce the ack key simply does
+            # not exist until peer p has READ s, so p0 waits, never
+            # compares against a ghost.
             for p in range(1, jax.process_count()):
-                got = client.blocking_key_value_get(
-                    f"accl/sess_ack/{self.instance}/{p}",
+                client.blocking_key_value_get(
+                    f"accl/sess_ack/{self.instance}/{s}/{p}",
                     self._timeout_ms())
-                if got != s:
+            # release the peers: the confirm is nonce-namespaced too, so
+            # a peer that raced the overwrite and echoed a dead run's
+            # nonce sees no confirm, re-reads, and CONVERGES on s
+            self._kset_force(client, f"accl/sess_ok/{self.instance}/{s}",
+                             "1")
+            return s
+        deadline = time.monotonic() + self.timeout
+        poll_ms = max(min(2000, self._timeout_ms()), 1)
+        while True:
+            s = client.blocking_key_value_get(key, self._timeout_ms())
+            self._kset_force(
+                client, f"accl/sess_ack/{self.instance}/{s}/{self._me}", s)
+            try:
+                client.blocking_key_value_get(
+                    f"accl/sess_ok/{self.instance}/{s}", poll_ms)
+                return s
+            except Exception:
+                # no confirm for the nonce we echoed: either p0 is still
+                # collecting (keep waiting) or we read a dead run's value
+                # before p0's overwrite landed (the re-read converges on
+                # the fresh nonce). Bounded by the session timeout.
+                if time.monotonic() > deadline:
                     raise ACCLError(
                         errorCode.CONFIG_ERROR,
-                        f"session nonce split: process {p} read {got!r}, "
-                        f"this run minted {s!r} — a stale value from an "
-                        f"earlier run on this coordination service. Set "
-                        f"ACCL_SESSION to a job-unique value to avoid "
-                        f"the bootstrap race entirely")
-            return s
-        s = client.blocking_key_value_get(key, self._timeout_ms())
-        self._kset_force(client,
-                         f"accl/sess_ack/{self.instance}/{self._me}", s)
-        return s
+                        f"session nonce handshake timed out: no confirm "
+                        f"for {s!r} within {self.timeout}s — is process 0 "
+                        f"alive? Set ACCL_SESSION to a job-unique value "
+                        f"to skip the bootstrap handshake entirely")
 
     # -- KV helpers (all writes tallied) -----------------------------------
 
@@ -315,7 +334,57 @@ class CrossProcessFabric:
 
     def _kincr(self, client, key: str, by: int = 1) -> int:
         self.kv_bytes += len(key) + 8
-        return int(client.key_value_increment(key, by))
+        try:
+            return int(client.key_value_increment(key, by))
+        except AttributeError:
+            # Older coordination clients have no atomic increment.
+            # Emulate with a DENSE CAS ladder: claim key#c<n> via
+            # create-only sets (ALREADY_EXISTS = lost that slot, move
+            # on), scanning forward from a monotonic hint. A claim only
+            # succeeds on a previously unclaimed n, so the sequence has
+            # no gaps — consumers that need gap-free counters (the
+            # schedule index) stay correct — at O(contenders) RTTs per
+            # increment. The counter VALUE key is never written (a
+            # last-writer-wins mirror could regress); readers go
+            # through :meth:`_kcount`, which scans the same ladder.
+            if by != 1:
+                raise ACCLError(
+                    errorCode.CONFIG_ERROR,
+                    "emulated KV increment supports by=1 only")
+            n = int(self._try_get(client, key + "#hint") or 0)
+            while True:
+                nxt = n + 1
+                if self._try_get(client, f"{key}#c{nxt}") is not None:
+                    n = nxt
+                    continue
+                try:
+                    self.kv_bytes += len(key) + 8
+                    client.key_value_set(f"{key}#c{nxt}", "1")
+                except Exception:
+                    # ALREADY_EXISTS means we raced and slot nxt is
+                    # taken — but a TRANSIENT RPC failure must retry the
+                    # SAME slot, or the ladder gets a permanent hole
+                    # that caps every later _kcount scan. Disambiguate
+                    # by re-probing the slot.
+                    if self._try_get(client, f"{key}#c{nxt}") is not None:
+                        n = nxt
+                    continue
+                # hint is best-effort and <= some existing claim, so a
+                # stale hint only costs extra forward probes
+                self._kset_force(client, key + "#hint", str(nxt))
+                return nxt
+
+    def _kcount(self, client, key: str) -> int:
+        """Current value of a :meth:`_kincr` counter: the native value
+        key when the client has atomic increments, else a forward scan
+        of the emulation's claim ladder."""
+        v = self._try_get(client, key)
+        if v is not None:
+            return int(v)
+        n = int(self._try_get(client, key + "#hint") or 0)
+        while self._try_get(client, f"{key}#c{n + 1}") is not None:
+            n += 1
+        return n
 
     @staticmethod
     def poll_sleep(idle_iters: int) -> None:
@@ -335,9 +404,23 @@ class CrossProcessFabric:
     @staticmethod
     def _try_get(client, key: str) -> Optional[str]:
         """try_get that treats a missing key as None (the client raises
-        NOT_FOUND rather than returning a sentinel)."""
+        NOT_FOUND rather than returning a sentinel). Older clients have
+        no key_value_try_get at all — there, a ~1 ms blocking get is the
+        emulation (present keys return immediately; the deadline error
+        means missing). The AttributeError arm must not swallow into the
+        generic None path: that made EVERY key look missing and stalled
+        the whole eager protocol on such clients."""
         try:
             return client.key_value_try_get(key)
+        except AttributeError:
+            # 25 ms deadline: must cover a same-DC coordinator RTT, or
+            # PRESENT keys read as missing and the protocol stalls; a
+            # miss costs the full deadline, which only slows idle polls
+            # (poll_sleep already backs off around them)
+            try:
+                return client.blocking_key_value_get(key, 25)
+            except Exception:
+                return None
         except Exception:
             return None
 
@@ -502,9 +585,11 @@ class CrossProcessFabric:
     def _flush_deletes(self, client, limit: int = 8) -> None:
         """Delete up to ``limit`` consumed announcement keys — called
         from idle pump cycles so cleanup RTTs never sit on the message
-        critical path."""
+        critical path. popleft: oldest keys first (the list.pop() LIFO
+        let the earliest keys linger for the whole session whenever new
+        consumption outpaced idle cycles — ADVICE r5)."""
         while self._pending_deletes and limit > 0:
-            client.key_value_delete(self._pending_deletes.pop())
+            client.key_value_delete(self._pending_deletes.popleft())
             limit -= 1
 
     def try_match(self, sdev: int, ddev: int,
@@ -691,8 +776,10 @@ class CrossProcessFabric:
         if hit is not None:
             return hit
         import jax
-        from jax import lax, shard_map
+        from jax import lax
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from .compat import shard_map
 
         mesh = Mesh(np.array([self._dev_by_id[sdev], self._dev_by_id[ddev]]),
                     ("pair",))
@@ -938,7 +1025,7 @@ class CrossProcessFabric:
         deadline = time.monotonic() + self.timeout
         progress = pump or self.drive
         idle = 0
-        while int(self._try_get(client, key) or 0) < target:
+        while self._kcount(client, key) < target:
             if not progress():
                 idle += 1
                 self.poll_sleep(idle)
@@ -946,6 +1033,6 @@ class CrossProcessFabric:
                 idle = 0
             if time.monotonic() > deadline:
                 raise ACCLTimeoutError(
-                    f"barrier {name!r}: {self._try_get(client, key)}/"
+                    f"barrier {name!r}: {self._kcount(client, key)}/"
                     f"{target} arrivals within {self.timeout}s")
         del self._barrier_pending[key]

@@ -17,7 +17,7 @@ the attention. Interpret mode (CPU emulator rung) uses the same
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -269,6 +269,87 @@ def _default_blocks(S: int, d: int, causal: bool,
     return block_q, block_k
 
 
+#: backward-pass mode: "fused" runs the single-pass dK/dV+dQ kernel
+#: wherever its VMEM plan fits (falling back to two-pass beyond), and
+#: "two_pass" pins the classic dK/dV-then-dQ pair everywhere — the A/B
+#: switch ``ACCLConfig.flash_bwd`` writes through ``set_flash_bwd_mode``.
+_BWD_MODES = ("fused", "two_pass")
+_BWD_MODE = "fused"
+
+
+def set_flash_bwd_mode(mode: str) -> None:
+    """Set the module-default backward mode (``ACCLConfig.flash_bwd``
+    lands here at session init). Per-call override: the wrappers'
+    ``bwd_mode`` argument."""
+    global _BWD_MODE
+    if mode not in _BWD_MODES:
+        raise ValueError(f"flash_bwd mode {mode!r} not in {_BWD_MODES}")
+    _BWD_MODE = mode
+
+
+def get_flash_bwd_mode() -> str:
+    return _BWD_MODE
+
+
+def _bwd_vmem_est(S: int, dp: int, bq: int, bk: int, itemsize: int) -> int:
+    """VMEM plan of the fused backward at (bq, bk): the dK/dV
+    accumulation planes are the fused kernel's defining cost — (S, dp)
+    f32 each, resident for a whole kv head's sweep — plus double-buffered
+    k/v and q/do strips, the dq output (double-buffered) and its scratch,
+    and the per-128-row-strip score/prob/ds/dp f32 tiles."""
+    plane = 2 * S * dp * 4              # dk + dv accumulation planes
+    kv = 4 * bk * dp * itemsize         # k/v blocks, double-buffered
+    qdo = 4 * bq * dp * itemsize        # q/do blocks, double-buffered
+    dq = 3 * bq * dp * 4                # dq out (x2) + dq_acc scratch
+    tiles = 4 * 128 * bk * 4            # s/p/ds/dp strip temporaries
+    return plane + kv + qdo + dq + tiles
+
+
+def _bwd_default_blocks(S: int, dp: int, causal: bool,
+                        itemsize: int = 2) -> Optional[Tuple[int, int]]:
+    """Backward arm of the block policy: the (block_q, block_k) the FUSED
+    single-pass kernel runs at, or None when no geometry fits the VMEM
+    budget (caller falls back to the two-pass kernels at the forward
+    blocks). Ports the three measured forward findings (round 5):
+
+    * single-k-block for S <= 2048 — block_k = S makes nk = 1, so k/v
+      stay VMEM-resident across the whole q sweep (every operand read
+      from HBM exactly ONCE) and dq needs no scratch carry (one-shot
+      epilogue, the causal one-shot variant's analog);
+    * asymmetric swept blocks for longer causal sequences (512x1024
+      first, same rationale as the forward's asymmetric sweep: per-grid-
+      step overhead beats the whole-block skip);
+    * swept non-causal prefers the big square 1024s like the forward's
+      auto cap.
+
+    ``dp`` is the PADDED head dim (the d=64 packed layout calls with
+    dp = 2d = 128 — the pair shares the plan). Interpret mode keeps the
+    128 geometry for the same reason as the forward: the emulator pays
+    per-element either way and big blocks only slow the CPU suite."""
+    if _interpret_params() is not None:
+        return 128, 128
+    if S % 128:
+        return None
+
+    def fits(bq: int, bk: int) -> bool:
+        return _bwd_vmem_est(S, dp, bq, bk, itemsize) <= _VMEM_BUDGET
+
+    if S <= 2048:
+        for bq in (512, 384, 256, 128):
+            if S % bq == 0 and fits(bq, S):
+                return bq, S
+    for bq in ((512, 384, 256, 128) if causal
+               else (1024, 512, 384, 256, 128)):
+        if S % bq:
+            continue
+        for bk in (1024, 512, 384, 256, 128):
+            if S % bk:
+                continue
+            if fits(bq, bk):
+                return bq, bk
+    return None   # dk/dv planes alone exceed VMEM (very long S): two-pass
+
+
 def _check_shapes(q, k, v, S, d, block_q, block_k):
     if S % block_q or S % block_k or block_q % 128:
         raise ValueError(
@@ -283,7 +364,8 @@ def _check_shapes(q, k, v, S, d, block_q, block_k):
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
                     block_q: Optional[int] = None,
-                    block_k: Optional[int] = None):
+                    block_k: Optional[int] = None,
+                    bwd_mode: Optional[str] = None):
     """Fused blockwise attention. q: (H, S, d) (or (S, d), promoted);
     k/v: (H_kv, S, d) with ``H % H_kv == 0`` — grouped-query attention
     shares each kv head across ``H/H_kv`` q heads with no materialized
@@ -305,11 +387,20 @@ def flash_attention(q, k, v, causal: bool = False,
     section for the exact accounting of what packing can and cannot
     recover on a dense systolic array).
 
-    Differentiable: the custom VJP runs the canonical two-pass flash
-    backward (dK/dV kernel sweeping q-blocks, dQ kernel sweeping
-    k-blocks), recomputing probabilities from the saved log-sum-exp so
-    the (S, S) score matrix never materializes in either direction.
+    Differentiable: the custom VJP runs the FUSED single-pass flash
+    backward by default — per (q-block, k-block) tile, probabilities and
+    score gradients are recomputed ONCE from the saved log-sum-exp and
+    dQ, dK, dV all come out of the same kernel (dq via the scratch
+    epilogue over the k sweep, dk/dv accumulated in VMEM planes along
+    the q sweep) — at the backward block policy's geometry. Where the
+    fused VMEM plan does not fit (very long S), or with
+    ``bwd_mode="two_pass"`` (``ACCLConfig.flash_bwd`` A/B switch), the
+    canonical two-pass backward runs instead (dK/dV kernel sweeping
+    q-blocks, dQ kernel sweeping k-blocks — each recomputing its own
+    probabilities). Either way the (S, S) score matrix never
+    materializes in either direction.
     """
+    bwd = _resolve_bwd(bwd_mode)
     single = q.ndim == 2
     if single:
         q, k, v = q[None], k[None], v[None]
@@ -319,7 +410,7 @@ def flash_attention(q, k, v, causal: bool = False,
     _check_shapes(q, k, v, S, d, block_q, block_k)
     sc = scale if scale is not None else 1.0 / (d ** 0.5)  # ORIGINAL d
     q, k, v, dp = _pad_head_dim(q, k, v, d)
-    out = _flash(q, k, v, causal, sc, block_q, block_k)
+    out = _flash(q, k, v, causal, sc, block_q, block_k, bwd)
     if dp != d:
         out = out[..., :d]
     return out[0] if single else out
@@ -328,14 +419,17 @@ def flash_attention(q, k, v, causal: bool = False,
 def flash_attention_lse(q, k, v, causal: bool = False,
                         scale: Optional[float] = None,
                         block_q: Optional[int] = None,
-                        block_k: Optional[int] = None):
+                        block_k: Optional[int] = None,
+                        bwd_mode: Optional[str] = None):
     """Like :func:`flash_attention` but also returns the per-row
     log-sum-exp, shape (H, S) — the merge key for composing partial
     attentions over key/value blocks (ring attention: each step's
     (out, lse) pair merges into the running result). Differentiable in
     BOTH outputs: the lse cotangent folds into the softmax-jacobian
-    correction (ds gains ``+ p * dlse``), so the same two backward kernels
-    serve, with ``D - dlse`` in place of ``D``."""
+    correction (ds gains ``+ p * dlse``), so the same backward kernels
+    (fused or two-pass — see :func:`flash_attention`) serve, with
+    ``D - dlse`` in place of ``D``."""
+    bwd = _resolve_bwd(bwd_mode)
     single = q.ndim == 2
     if single:
         q, k, v = q[None], k[None], v[None]
@@ -345,7 +439,7 @@ def flash_attention_lse(q, k, v, causal: bool = False,
     _check_shapes(q, k, v, S, d, block_q, block_k)
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
     q, k, v, dp = _pad_head_dim(q, k, v, d)
-    out, lse = _flash_lse(q, k, v, causal, sc, block_q, block_k)
+    out, lse = _flash_lse(q, k, v, causal, sc, block_q, block_k, bwd)
     if dp != d:
         out = out[..., :d]
     return (out[0], lse[0]) if single else (out, lse)
@@ -365,23 +459,48 @@ def _lse_2d_to_slab(x, H: int, S: int, block_q: int):
     return x
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, sc, block_q, block_k):
+def _resolve_bwd(bwd_mode: Optional[str]) -> str:
+    """Wrapper-entry resolution of the backward mode: an explicit
+    per-call ``bwd_mode`` wins, else the module default. Resolved at
+    trace time — the returned string rides the custom VJP as a nondiff
+    argument, so a jitted program keeps the mode it was traced with."""
+    bwd = bwd_mode or _BWD_MODE
+    if bwd not in _BWD_MODES:
+        raise ValueError(f"bwd_mode {bwd!r} not in {_BWD_MODES}")
+    return bwd
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sc, block_q, block_k, bwd):
     return _flash_fwd_call(q, k, v, causal, sc, block_q, block_k)[0]
 
 
-def _flash_vjp_fwd(q, k, v, causal, sc, block_q, block_k):
+def _flash_vjp_fwd(q, k, v, causal, sc, block_q, block_k, bwd):
     out, lse = _flash_fwd_call(q, k, v, causal, sc, block_q, block_k)
     return out, (q, k, v, out, lse)
 
 
-def _bwd_from_dd(q, k, v, do, lse, dd_2d, causal, sc, block_q, block_k):
+def _bwd_from_dd(q, k, v, do, lse, dd_2d, causal, sc, block_q, block_k,
+                 bwd):
     """Shared backward: ``dd_2d`` (H, S) is the per-row correction term —
     plain D for the out-only VJP, ``D - dlse`` when an lse cotangent
-    exists (∂lse/∂s = p folds into the same p·(dp − ·) form). The two
-    backward kernels sweep big q-blocks as unrolled 128-row strips, so
-    they run at the forward's (auto) block sizes directly."""
-    H, S, _ = q.shape
+    exists (∂lse/∂s = p folds into the same p·(dp − ·) form). All
+    backward kernels sweep big q-blocks as unrolled 128-row strips.
+
+    Mode "fused" re-slabs lse/dd at the backward policy's block_q and
+    runs the single-pass kernel; when no fused geometry fits the VMEM
+    budget (policy returns None) — or mode "two_pass" — the classic
+    kernel pair runs at the forward's blocks."""
+    H, S, dp = q.shape
+    if bwd == "fused":
+        blocks = _bwd_default_blocks(S, dp, causal, q.dtype.itemsize)
+        if blocks is not None:
+            bq, bk = blocks
+            lse2 = _lse_slab_to_2d(lse, H, S, block_q)
+            dq, dk, dv = _flash_bwd_fused(
+                q, k, v, do, _lse_2d_to_slab(lse2, H, S, bq),
+                _lse_2d_to_slab(dd_2d, H, S, bq), causal, sc, bq, bk)
+            return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
     dd = _lse_2d_to_slab(dd_2d, H, S, block_q)
     dk, dv = _flash_bwd_kv(q, k, v, do, lse, dd, causal, sc,
                            block_q, block_k)
@@ -389,34 +508,36 @@ def _bwd_from_dd(q, k, v, do, lse, dd_2d, causal, sc, block_q, block_k):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def _flash_vjp_bwd(causal, sc, block_q, block_k, res, do):
+def _flash_vjp_bwd(causal, sc, block_q, block_k, bwd, res, do):
     q, k, v, out, lse = res
     # D_i = rowsum(dO ∘ O) — the softmax-jacobian correction term
     dd = jnp.sum(do.astype(_F32) * out.astype(_F32), axis=-1)
-    return _bwd_from_dd(q, k, v, do, lse, dd, causal, sc, block_q, block_k)
+    return _bwd_from_dd(q, k, v, do, lse, dd, causal, sc, block_q, block_k,
+                        bwd)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_lse(q, k, v, causal, sc, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, causal, sc, block_q, block_k, bwd):
     out, lse = _flash_fwd_call(q, k, v, causal, sc, block_q, block_k)
     return out, _lse_slab_to_2d(lse, q.shape[0], q.shape[1], block_q)
 
 
-def _flash_lse_vjp_fwd(q, k, v, causal, sc, block_q, block_k):
+def _flash_lse_vjp_fwd(q, k, v, causal, sc, block_q, block_k, bwd):
     out, lse = _flash_fwd_call(q, k, v, causal, sc, block_q, block_k)
     out2 = _lse_slab_to_2d(lse, q.shape[0], q.shape[1], block_q)
     return (out, out2), (q, k, v, out, lse)
 
 
-def _flash_lse_vjp_bwd(causal, sc, block_q, block_k, res, cts):
+def _flash_lse_vjp_bwd(causal, sc, block_q, block_k, bwd, res, cts):
     do, dlse = cts
     q, k, v, out, lse = res
     dd = (jnp.sum(do.astype(_F32) * out.astype(_F32), axis=-1)
           - dlse.astype(_F32))
-    return _bwd_from_dd(q, k, v, do, lse, dd, causal, sc, block_q, block_k)
+    return _bwd_from_dd(q, k, v, do, lse, dd, causal, sc, block_q, block_k,
+                        bwd)
 
 
 _flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
@@ -566,6 +687,142 @@ def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
     @pl.when(j == nk - 1)
     def _finalize():
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# fused single-pass backward (round 6): per (q-block, k-block) tile, P and
+# dS are recomputed ONCE and dQ, dK, dV all come out of the same kernel.
+#
+#   grid (hkv, g*nq, nk) — k-blocks INNERMOST:
+#     * q/do/lse/dd blocks are indexed by t only, so each is fetched from
+#       HBM exactly once (the two-pass pair refetched them nk+1 times);
+#     * dQ accumulates in a (block_q, d) VMEM scratch over the inner k
+#       sweep and flushes at j == nk-1 — the existing scratch-epilogue
+#       pattern (nk == 1 skips the scratch and stores one-shot, the
+#       backward analog of the forward's one-shot causal kernel);
+#     * dK/dV accumulate along the q-grid axis directly into their
+#       OUTPUT buffers, blocked (1, S, d) with an index map constant per
+#       kv head — the canonical revisited-output accumulation (Pallas
+#       keeps the block VMEM-resident while its index is unchanged), at
+#       the tile's pl.ds(j * block_k) sublane offset. Zeroed at the
+#       head's first grid step, flushed when the head advances.
+#
+#   Invariants the geometry policy (_bwd_default_blocks) must hold:
+#     * the two (S, d) f32 dk/dv planes + double-buffered strips fit the
+#       scoped-VMEM budget (else: two-pass fallback — the planes are the
+#       fused kernel's defining VMEM cost);
+#     * accumulation order matches the two-pass kernels (t ascending per
+#       k block, j ascending per q block, 128-row strips in order), so
+#       fused and two-pass gradients are BIT-exact at equal blocks, and
+#       equal within f32 reassociation otherwise.
+#
+# Compute per live tile drops from 7 matmuls + 2 exp2-softmaxes (the
+# two-pass pair recomputed s and dp in BOTH kernels) to 5 matmuls + 1.
+# ---------------------------------------------------------------------------
+
+
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+                      dq_ref, dk_ref, dv_ref, dq_acc, *,
+                      causal: bool, scale: float, block_q: int,
+                      block_k: int, nq: int, nk: int):
+    t = pl.program_id(1)          # fused (q-head-in-group, q-block) sweep
+    j = pl.program_id(2)          # k-block (innermost: dq scratch carries)
+    i = t % nq                    # q-block within the current q head
+
+    @pl.when((t == 0) & (j == 0))
+    def _init_kv():
+        # the dk/dv planes are this kv head's OUTPUT buffers, resident
+        # across the whole (t, j) sweep (constant index map)
+        dk_ref[:] = jnp.zeros_like(dk_ref)
+        dv_ref[:] = jnp.zeros_like(dv_ref)
+
+    if nk > 1:
+        @pl.when(j == 0)
+        def _init_q():
+            dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _block():
+        col = j * block_k
+        for r in range(block_q // 128):
+            sl = slice(r * 128, (r + 1) * 128)
+            qs = q_ref[0][sl]
+            dos = do_ref[0][sl].astype(_F32)
+            p, ds = _recompute_p_ds(
+                qs, k_ref[0], v_ref[0], dos,
+                lse_ref[0, 0, r], dd_ref[0, 0, r],
+                i * block_q + r * 128, col, causal, scale)
+            dv_ref[0, pl.ds(col, block_k), :] += jax.lax.dot_general(
+                p, dos, (((0,), (0,)), ((), ())),
+                preferred_element_type=_F32)                    # (bk, d)
+            dk_ref[0, pl.ds(col, block_k), :] += jax.lax.dot_general(
+                ds, qs.astype(_F32), (((0,), (0,)), ((), ())),
+                preferred_element_type=_F32)                    # (bk, d)
+            dq_part = jax.lax.dot_general(
+                ds, k_ref[0].astype(_F32), (((1,), (0,)), ((), ())),
+                preferred_element_type=_F32)                    # (128, d)
+            if nk == 1:
+                # one-shot epilogue: no scratch carry to init or flush
+                dq_ref[0, sl, :] = dq_part.astype(dq_ref.dtype)
+            else:
+                dq_acc[sl] += dq_part
+
+    if causal:
+        pl.when(j * block_k < (i + 1) * block_q)(_block)
+    else:
+        _block()
+
+    if nk > 1:
+        @pl.when(j == nk - 1)
+        def _finalize():
+            dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_fused(q, k, v, do, lse, dd, causal, sc, block_q, block_k):
+    """One pallas_call for all three gradients. ``lse``/``dd`` arrive
+    slabbed at THIS kernel's block_q (the VJP re-slabs from the forward
+    geometry — a reshape/pad, no kernel)."""
+    H, S, d = q.shape
+    hkv = k.shape[0]
+    g = H // hkv
+    nq, nk = S // block_q, S // block_k
+    pr = _pad_rows(block_q)
+    qh = lambda h, t: h * g + t // nq             # global q head at step t
+    kernel = functools.partial(_bwd_fused_kernel, causal=causal, scale=sc,
+                               block_q=block_q, block_k=block_k,
+                               nq=nq, nk=nk)
+    return pl.pallas_call(
+        kernel,
+        grid=(hkv, g * nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d),
+                         lambda h, t, j: (qh(h, t), t % nq, 0)),   # q
+            pl.BlockSpec((1, block_k, d), lambda h, t, j: (h, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, t, j: (h, j, 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda h, t, j: (qh(h, t), t % nq, 0)),   # do
+            pl.BlockSpec((1, 1, pr, 128),
+                         lambda h, t, j: (qh(h, t), t % nq, 0, 0)),
+            pl.BlockSpec((1, 1, pr, 128),
+                         lambda h, t, j: (qh(h, t), t % nq, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d),
+                         lambda h, t, j: (qh(h, t), t % nq, 0)),   # dq
+            pl.BlockSpec((1, S, d), lambda h, t, j: (h, 0, 0)),    # dk
+            pl.BlockSpec((1, S, d), lambda h, t, j: (h, 0, 0)),    # dv
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((H, S, d), _F32),
+            jax.ShapeDtypeStruct((hkv, S, d), _F32),
+            jax.ShapeDtypeStruct((hkv, S, d), _F32),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_q, d), _F32)],   # dq carry
+        # only the kv-head axis is parallel: t carries the dk/dv planes,
+        # j carries the dq scratch
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=_interpret_params() or False,
+    )(q, k, v, do, lse, dd)
 
 
 # ---------------------------------------------------------------------------
@@ -872,31 +1129,149 @@ def _flash_bwd_q_packed(q, k, v, do, lse, dd, causal, sc,
     )(q, k, v, do, lse, dd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_packed(q, k, v, causal, sc, block_q, block_k):
+def _packed_slab_to_2d(x, H2: int, S: int, block_q: int):
+    """(H2, nq, 2, pad_rows, 128) packed slab -> (H2, 2, S) per-half."""
+    rows = block_q // 128
+    return x[:, :, :, :rows, :].swapaxes(1, 2).reshape(H2, 2, S)
+
+
+def _packed_2d_to_slab(x, H2: int, S: int, block_q: int):
+    """Inverse of :func:`_packed_slab_to_2d` (zero sublane tail)."""
+    nq, rows, pr = S // block_q, block_q // 128, _pad_rows(block_q)
+    x = x.reshape(H2, 2, nq, rows, 128).swapaxes(1, 2)
+    if pr != rows:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, pr - rows), (0, 0)))
+    return x
+
+
+def _bwd_fused_kernel_packed(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+                             dq_ref, dk_ref, dv_ref, dq_acc, *,
+                             causal: bool, scale: float, block_q: int,
+                             block_k: int, nk: int, d: int):
+    """Packed fused backward: same dataflow as :func:`_bwd_fused_kernel`
+    (see the fused section comment), two heads per grid step on their
+    own lane halves (g = 1 — the packed path excludes GQA)."""
+    t = pl.program_id(1)          # q-block (g == 1: t IS the q index)
+    j = pl.program_id(2)
+
+    @pl.when((t == 0) & (j == 0))
+    def _init_kv():
+        dk_ref[:] = jnp.zeros_like(dk_ref)
+        dv_ref[:] = jnp.zeros_like(dv_ref)
+
+    if nk > 1:
+        @pl.when(j == 0)
+        def _init_q():
+            dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _block():
+        col = j * block_k
+        for r in range(block_q // 128):
+            rs = slice(r * 128, (r + 1) * 128)
+            for h in range(2):
+                sl = slice(h * d, (h + 1) * d)
+                qs = q_ref[0][rs, sl]
+                dos = do_ref[0][rs, sl].astype(_F32)
+                p, ds = _recompute_p_ds(
+                    qs, k_ref[0][:, sl], v_ref[0][:, sl], dos,
+                    lse_ref[0, 0, h, r], dd_ref[0, 0, h, r],
+                    t * block_q + r * 128, col, causal, scale)
+                dv_ref[0, pl.ds(col, block_k), sl] += jax.lax.dot_general(
+                    p, dos, (((0,), (0,)), ((), ())),
+                    preferred_element_type=_F32)
+                dk_ref[0, pl.ds(col, block_k), sl] += jax.lax.dot_general(
+                    ds, qs.astype(_F32), (((0,), (0,)), ((), ())),
+                    preferred_element_type=_F32)
+                dq_part = jax.lax.dot_general(
+                    ds, k_ref[0][:, sl].astype(_F32),
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=_F32)
+                if nk == 1:
+                    dq_ref[0, rs, sl] = dq_part.astype(dq_ref.dtype)
+                else:
+                    dq_acc[rs, sl] += dq_part
+
+    if causal:
+        pl.when(j * block_k < (t + 1) * block_q)(_block)
+    else:
+        _block()
+
+    if nk > 1:
+        @pl.when(j == nk - 1)
+        def _finalize():
+            dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_fused_packed(q, k, v, do, lse, dd, causal, sc,
+                            block_q, block_k):
+    H2, S, d2 = q.shape
+    d = d2 // 2
+    nq, nk = S // block_q, S // block_k
+    pr = _pad_rows(block_q)
+    kernel = functools.partial(_bwd_fused_kernel_packed, causal=causal,
+                               scale=sc, block_q=block_q, block_k=block_k,
+                               nk=nk, d=d)
+    return pl.pallas_call(
+        kernel,
+        grid=(H2, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d2), lambda h, t, j: (h, t, 0)),
+            pl.BlockSpec((1, block_k, d2), lambda h, t, j: (h, j, 0)),
+            pl.BlockSpec((1, block_k, d2), lambda h, t, j: (h, j, 0)),
+            pl.BlockSpec((1, block_q, d2), lambda h, t, j: (h, t, 0)),
+            pl.BlockSpec((1, 1, 2, pr, 128),
+                         lambda h, t, j: (h, t, 0, 0, 0)),
+            pl.BlockSpec((1, 1, 2, pr, 128),
+                         lambda h, t, j: (h, t, 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d2), lambda h, t, j: (h, t, 0)),
+            pl.BlockSpec((1, S, d2), lambda h, t, j: (h, 0, 0)),
+            pl.BlockSpec((1, S, d2), lambda h, t, j: (h, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((H2, S, d2), _F32),
+            jax.ShapeDtypeStruct((H2, S, d2), _F32),
+            jax.ShapeDtypeStruct((H2, S, d2), _F32),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_q, d2), _F32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=_interpret_params() or False,
+    )(q, k, v, do, lse, dd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_packed(q, k, v, causal, sc, block_q, block_k, bwd):
     return _flash_packed_fwd_call(q, k, v, causal, sc, block_q, block_k)[0]
 
 
-def _flash_packed_vjp_fwd(q, k, v, causal, sc, block_q, block_k):
+def _flash_packed_vjp_fwd(q, k, v, causal, sc, block_q, block_k, bwd):
     out, lse = _flash_packed_fwd_call(q, k, v, causal, sc, block_q, block_k)
     return out, (q, k, v, out, lse)
 
 
-def _flash_packed_vjp_bwd(causal, sc, block_q, block_k, res, do):
+def _flash_packed_vjp_bwd(causal, sc, block_q, block_k, bwd, res, do):
     q, k, v, out, lse = res
     H2, S, d2 = q.shape
     d = d2 // 2
-    nq = S // block_q
-    pr = _pad_rows(block_q)
     # per-head D = rowsum(dO ∘ O): reduce each lane half separately,
-    # then slab to (H2, nq, 2, pr, 128) alongside the packed lse
+    # then slab alongside the packed lse at the backward's block_q
     prod = do.astype(_F32) * out.astype(_F32)
-    dd = jnp.stack([prod[..., :d].sum(-1), prod[..., d:].sum(-1)],
-                   axis=1)                                    # (H2, 2, S)
-    rows = block_q // 128
-    dd = dd.reshape(H2, 2, nq, rows, 128).swapaxes(1, 2)
-    if pr != rows:
-        dd = jnp.pad(dd, ((0, 0), (0, 0), (0, 0), (0, pr - rows), (0, 0)))
+    dd2 = jnp.stack([prod[..., :d].sum(-1), prod[..., d:].sum(-1)],
+                    axis=1)                                   # (H2, 2, S)
+    if bwd == "fused":
+        # the PACKED tile is d2 lanes wide — the pair shares the plan
+        blocks = _bwd_default_blocks(S, d2, causal, q.dtype.itemsize)
+        if blocks is not None:
+            bq, bk = blocks
+            lse_b = _packed_2d_to_slab(
+                _packed_slab_to_2d(lse, H2, S, block_q), H2, S, bq)
+            dq, dk, dv = _flash_bwd_fused_packed(
+                q, k, v, do, lse_b, _packed_2d_to_slab(dd2, H2, S, bq),
+                causal, sc, bq, bk)
+            return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    dd = _packed_2d_to_slab(dd2, H2, S, block_q)
     dk, dv = _flash_bwd_kv_packed(q, k, v, do, lse, dd, causal, sc,
                                   block_q, block_k)
     dq = _flash_bwd_q_packed(q, k, v, do, lse, dd, causal, sc,
@@ -910,7 +1285,8 @@ _flash_packed.defvjp(_flash_packed_vjp_fwd, _flash_packed_vjp_bwd)
 def flash_attention_packed(q, k, v, causal: bool = False,
                            scale: Optional[float] = None,
                            block_q: Optional[int] = None,
-                           block_k: Optional[int] = None):
+                           block_k: Optional[int] = None,
+                           bwd_mode: Optional[str] = None):
     """Head-packed flash attention for d == 64 exactly: head pairs share
     the 128-lane tile (see the packed-kernel section comment for what
     this does and does not recover on the MXU). Same semantics and
@@ -922,14 +1298,16 @@ def flash_attention_packed(q, k, v, causal: bool = False,
     if (q.ndim != 3 or q.shape[0] % 2 or q.shape[-1] != 64
             or k.shape[0] != q.shape[0]):
         return flash_attention(q, k, v, causal=causal, scale=scale,
-                               block_q=block_q, block_k=block_k)
+                               block_q=block_q, block_k=block_k,
+                               bwd_mode=bwd_mode)
+    bwd = _resolve_bwd(bwd_mode)
     H, S, d = q.shape
     block_q, block_k = _default_blocks(S, 2 * d, causal, block_q, block_k,
                                    q.dtype.itemsize)
     _check_shapes(q, k, v, S, d, block_q, block_k)
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
     qp, kp, vp = _pack_heads(q), _pack_heads(k), _pack_heads(v)
-    out = _flash_packed(qp, kp, vp, causal, sc, block_q, block_k)
+    out = _flash_packed(qp, kp, vp, causal, sc, block_q, block_k, bwd)
     return _unpack_heads(out)
 
 

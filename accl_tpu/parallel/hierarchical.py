@@ -25,8 +25,10 @@ from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import PartitionSpec as P
+
+from ..compat import shard_map
 
 from ..arithconfig import ArithConfig
 from ..communicator import Communicator

@@ -104,16 +104,33 @@ def _run_stage(name: str, fn, retries: int = 1):
                           "retried": attempt}
 
 
-def main() -> None:
+def main() -> int:
     import accl_tpu
     from accl_tpu import Algorithm
     from accl_tpu.bench import harness
 
-    acc = accl_tpu.ACCL()
-    comm = acc.global_comm()
+    errors = []
+
+    # Session bring-up under the SAME retry/deadline protection as every
+    # stage (ADVICE r5): a transient tunnel error here used to escape to
+    # the last-resort handler — losing the whole round's artifact to a
+    # setup crash that a 2 s retry would have cleared.
+    def _setup():
+        acc = accl_tpu.ACCL()
+        return acc, acc.global_comm()
+
+    setup, err = _run_stage("setup_accl", _setup)
+    if err:
+        errors.append(err)
+        print(json.dumps({"metric": "bench_setup_failed",
+                          "value": 0.0, "unit": "none",
+                          "vs_baseline": 0.0,
+                          "errors": errors,
+                          "elapsed_s": round(_elapsed(), 1)}))
+        return 1
+    acc, comm = setup
     world = comm.world_size
     on_tpu = jax.default_backend() == "tpu"
-    errors = []
 
     if world > 1:
         op, metric = "allreduce", f"allreduce_ring_algbw_{world}dev"
@@ -222,6 +239,7 @@ def main() -> None:
                 ("hp_compression_cast_roundtrip", lanes.bench_cast_lane),
                 ("combine_pallas_vs_jnp", lanes.bench_combine_pallas_vs_jnp),
                 ("flash_attention", lanes.bench_flash),
+                ("flash_bwd", lanes.bench_flash_bwd),
                 ("cmdlist_chain_combine",
                  lambda: lanes.bench_cmdlist_chain(acc)),
                 ("small_op_fused_latency",
@@ -248,17 +266,22 @@ def main() -> None:
         out["errors"] = errors
     out["elapsed_s"] = round(_elapsed(), 1)
     print(json.dumps(out))
+    return 0
 
 
 if __name__ == "__main__":
     try:
-        main()
+        raise SystemExit(main())
+    except SystemExit:
+        raise
     except BaseException as e:  # noqa: BLE001 — the artifact must land
-        # last-resort: even a setup crash emits a parseable JSON line
-        # (round 4's artifact was rc=1 with zero rows)
+        # last-resort: even a crash emits a parseable JSON line (round
+        # 4's artifact was rc=1 with zero rows) — but exits NON-zero
+        # (ADVICE r5): the stub is a loss report, and rc=0 here let the
+        # driver file a crashed round as success
         print(json.dumps({"metric": "bench_crashed",
                           "value": 0.0, "unit": "none",
                           "vs_baseline": 0.0,
                           "error": f"{type(e).__name__}: {e}"[:1000],
                           "elapsed_s": round(_elapsed(), 1)}))
-        raise SystemExit(0)
+        raise SystemExit(1)

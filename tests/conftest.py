@@ -60,6 +60,28 @@ def rng() -> np.random.Generator:
     return np.random.default_rng(42)
 
 
+#: Skip marker for interpret-rung suites that SIMULATE cross-device
+#: remote DMA/semaphores: only the real TPU interpreter (jax >= ~0.5's
+#: pltpu.InterpretParams) implements remote signals — under the compat
+#: stand-in the generic interpreter raises NotImplementedError. On real
+#: TPU backends the kernels run natively and the marker does not apply.
+INTERPRET_RDMA_UNAVAILABLE = (
+    jax.default_backend() != "tpu"
+    and not accl_tpu.compat.HAS_TPU_INTERPRET)
+_RDMA_REASON = ("this jax has no TPU interpret mode: remote DMA/semaphore "
+                "simulation unavailable (see accl_tpu/compat.py)")
+requires_interpret_rdma = pytest.mark.skipif(
+    INTERPRET_RDMA_UNAVAILABLE, reason=_RDMA_REASON)
+
+
+def skip_unless_interpret_rdma() -> None:
+    """Runtime form of :data:`requires_interpret_rdma` for tests where
+    only some parametrizations (Algorithm.PALLAS) ride the RDMA
+    kernels."""
+    if INTERPRET_RDMA_UNAVAILABLE:
+        pytest.skip(_RDMA_REASON)
+
+
 # ---------------------------------------------------------------------------
 # shared AOT lowering gate (test_chunked_schedule + test_flash_schedule):
 # one copy of the Mosaic-kernel detection and buffer-plan check, so a jax
@@ -67,9 +89,52 @@ def rng() -> np.random.Generator:
 # ---------------------------------------------------------------------------
 
 import re  # noqa: E402
+import subprocess  # noqa: E402
+import sys  # noqa: E402
 
 MOSAIC_CALL = re.compile(r'custom_call_target="tpu_custom_call"')
 AOT_HBM_BYTES = 16 << 30   # v5e: 16 GiB HBM per chip
+
+# ---------------------------------------------------------------------------
+# hermetic AOT-topology probe, shared by every *_schedule test module.
+# get_topology_desc loads libtpu, and on a rig whose TPU tunnel is sick
+# that load can HANG forever instead of failing (the VERDICT r5 rc=124
+# failure mode) — one hung fixture would then eat the entire tier-1
+# budget. The FIRST probe therefore runs in a subprocess with a
+# deadline; only a fast successful probe admits the in-process call.
+# Cached per session: one sick probe skips all AOT modules at one cost.
+# ---------------------------------------------------------------------------
+
+_AOT_PROBE: dict = {}
+
+
+def aot_topology_devices(topology_name: str = "v5e:2x4"):
+    """Devices of an AOT TPU topology, or pytest.skip — never a hang."""
+    if "state" not in _AOT_PROBE:
+        code = ("from jax.experimental import topologies; "
+                "topologies.get_topology_desc(platform='tpu', "
+                "topology_name='v5e:2x4'); print('AOT_OK')")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"   # only the topology call may load libtpu
+        try:
+            r = subprocess.run([sys.executable, "-c", code], timeout=60,
+                               capture_output=True, text=True, env=env)
+            _AOT_PROBE["state"] = (
+                "ok" if "AOT_OK" in r.stdout
+                else f"error: {(r.stderr or r.stdout)[-300:]}")
+        except subprocess.TimeoutExpired:
+            _AOT_PROBE["state"] = ("hung: libtpu topology init exceeded "
+                                   "60s (sick TPU tunnel?)")
+    if _AOT_PROBE["state"] != "ok":
+        pytest.skip(
+            f"TPU AOT topology unavailable ({_AOT_PROBE['state']})")
+    from jax.experimental import topologies
+    try:
+        topo = topologies.get_topology_desc(platform="tpu",
+                                            topology_name=topology_name)
+    except Exception as e:  # healthy libtpu, but not THIS topology
+        pytest.skip(f"TPU AOT topology {topology_name} unavailable: {e}")
+    return list(topo.devices)
 
 
 def assert_aot_lowered(compiled, min_kernels: int = 1) -> str:

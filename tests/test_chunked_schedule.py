@@ -43,13 +43,8 @@ def tpu_comm():
     """Communicator over an AOT v5e 2x4 topology — 8 chips, 2 HOSTS
     (compile-only: no chips needed; skip where libtpu cannot provide
     topology descriptions)."""
-    try:
-        from jax.experimental import topologies
-        topo = topologies.get_topology_desc(
-            platform="tpu", topology_name="v5e:2x4")
-        devices = list(topo.devices)
-    except Exception as e:  # pragma: no cover - environment-dependent
-        pytest.skip(f"TPU AOT topology unavailable: {e}")
+    from conftest import aot_topology_devices
+    devices = aot_topology_devices("v5e:2x4")
     assert len(devices) == WORLD
     comm = Communicator(devices)
     # the whole point: this is a genuine multi-controller topology
@@ -167,13 +162,8 @@ def test_chunked_allreduce_lowers_16chip_4host():
     allreduce) lowers for a 16-chip, FOUR-host v5e:4x4 topology — the
     ring schedule, segment geometry, and VMEM budgets are world-size
     parametric, not tuned to one shape."""
-    try:
-        from jax.experimental import topologies
-        topo = topologies.get_topology_desc(
-            platform="tpu", topology_name="v5e:4x4")
-        devices = list(topo.devices)
-    except Exception as e:  # pragma: no cover - environment-dependent
-        pytest.skip(f"TPU AOT v5e:4x4 topology unavailable: {e}")
+    from conftest import aot_topology_devices
+    devices = aot_topology_devices("v5e:4x4")
     comm16 = Communicator(devices)
     assert comm16.world_size == 16
     assert len({d.process_index for d in devices}) == 4

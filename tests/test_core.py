@@ -55,6 +55,29 @@ def test_buffer_slice_device_roundtrip(accl, rng):
     np.testing.assert_array_equal(view, buf.host[:, 8:16])
 
 
+def test_store_rank_shard_numpy_values(accl, rng):
+    """ADVICE r5 regression: store_rank_shard's whole-shard fast path is
+    gated on jax.Array — a NumPy payload (no .devices()) must fall
+    through to the dynamic_update_slice path, not raise AttributeError,
+    for both the whole-shard and the offset store."""
+    buf = accl.create_buffer(16, dataType.float32)
+    buf.host[:] = 0.0
+    buf.sync_to_device()
+    whole = rng.standard_normal((1, 16)).astype(np.float32)
+    buf.store_rank_shard(0, whole)                 # np payload, offset 0
+    np.testing.assert_allclose(buf.read_rank_local(0, 16),
+                               whole.reshape(-1))
+    part = rng.standard_normal(4).astype(np.float32)
+    buf.store_rank_shard(1, part, offset=8)        # np payload, offset
+    np.testing.assert_allclose(buf.read_rank_local(1, 16)[8:12], part)
+    # the jax.Array fast path still works (same observable result)
+    import jax
+    jwhole = jax.device_put(whole, list(buf.rank_shard(2).devices())[0])
+    buf.store_rank_shard(2, jwhole)
+    np.testing.assert_allclose(buf.read_rank_local(2, 16),
+                               whole.reshape(-1))
+
+
 def test_dummy_buffer(accl):
     d = accl.dummy_buffer()
     assert d.is_dummy

@@ -6,8 +6,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+from accl_tpu.compat import shard_map
 
 from accl_tpu import Communicator, device_api as dapi, reduceFunction
 from accl_tpu.models import mlp, vadd

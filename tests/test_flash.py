@@ -318,6 +318,172 @@ def test_flash_packed_matches_unpacked(rng, causal):
                                    rtol=1e-4, atol=1e-4)
 
 
+# ---------------------------------------------------------------------------
+# fused single-pass backward (round 6): one kernel emits dQ, dK and dV,
+# recomputing P/dS once per tile. Identical tile partition + accumulation
+# order make it BIT-exact vs the two-pass pair under the interpreter
+# (both run the 128-block interpret geometry), so parity is asserted
+# with zero tolerance — any reassociation is a kernel bug, not noise.
+# ---------------------------------------------------------------------------
+
+
+def _bwd_parity_case(rng, H, S, d, causal, hkv=None, atol=0.0):
+    import jax.numpy as jnp
+    q = jnp.asarray(rng.standard_normal((H, S, d)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((hkv or H, S, d))
+                    .astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((hkv or H, S, d))
+                    .astype(np.float32))
+    cot = jnp.asarray(rng.standard_normal((H, S, d)).astype(np.float32))
+
+    def grads(mode):
+        def f(a, b, c):
+            return jnp.sum(flash.flash_attention(
+                a, b, c, causal=causal, bwd_mode=mode) * cot)
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    gf, gt = grads("fused"), grads("two_pass")
+    for name, a, b in zip("qkv", gf, gt):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0.0, atol=atol,
+                                   err_msg=f"d{name} fused vs two-pass")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("d", [64, 96, 128])
+def test_flash_fused_bwd_bit_exact(rng, d, causal):
+    """Tier-1 parity gate (runs on CPU, no hardware): fused == two-pass
+    to the BIT for every head dim and mask."""
+    _bwd_parity_case(rng, 2, 256, d, causal)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_fused_bwd_bit_exact_s2048(rng, causal):
+    """Tier-1 parity at the single-k-block policy's flagship length."""
+    _bwd_parity_case(rng, 1, 2048, 128, causal)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("d", [64, 96, 128])
+@pytest.mark.parametrize("S", [2048, 4096])
+def test_flash_fused_bwd_bit_exact_long(rng, S, d, causal):
+    """The full acceptance grid (d x S x mask) — interpreter-slow at
+    S=4096, so the long tail rides the slow tier; S=256 and the d=128
+    S=2048 cases run in tier-1 above."""
+    _bwd_parity_case(rng, 1, S, d, causal)
+
+
+def test_flash_fused_bwd_gqa_bit_exact(rng):
+    """Grouped-query fused backward: dk/dv fold the q-head group inside
+    ONE kernel sweep — still bit-exact vs the two-pass pair."""
+    _bwd_parity_case(rng, 4, 256, 128, True, hkv=2)
+
+
+def test_flash_fused_bwd_matches_autodiff_reference(rng):
+    """Anchor beyond self-consistency: the fused gradients also match
+    jax.grad of a dense jnp attention."""
+    import jax.numpy as jnp
+    H, S, d = 2, 256, 128
+    q, k, v = (jnp.asarray(rng.standard_normal((H, S, d))
+                           .astype(np.float32)) for _ in range(3))
+
+    def dense(q, k, v):
+        sc = 1.0 / np.sqrt(d)
+        s = jnp.einsum("hqd,hkd->hqk", q, k) * sc
+        mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+        s = jnp.where(mask[None], s, -jnp.inf)
+        return jnp.einsum("hqk,hkd->hqd", jax.nn.softmax(s, -1), v)
+
+    loss = lambda f: (lambda a, b, c: jnp.sum(f(a, b, c) ** 2))
+    gf = jax.grad(loss(lambda a, b, c: flash.flash_attention(
+        a, b, c, causal=True, bwd_mode="fused")), argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss(dense), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-2, atol=2e-3,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_flash_fused_bwd_lse_cotangent(rng):
+    """flash_attention_lse with an lse cotangent routes through the same
+    fused kernel (D - dlse in place of D) — bit-exact vs two-pass."""
+    import jax.numpy as jnp
+    H, S, d = 1, 256, 64
+    q, k, v = (jnp.asarray(rng.standard_normal((H, S, d))
+                           .astype(np.float32)) for _ in range(3))
+
+    def grads(mode):
+        def f(a, b, c):
+            o, l = flash.flash_attention_lse(a, b, c, causal=True,
+                                             bwd_mode=mode)
+            return (o ** 2).sum() + (0.3 * l).sum()
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    for a, b in zip(grads("fused"), grads("two_pass")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0.0, atol=0.0)
+
+
+def test_flash_fused_bwd_packed_bit_exact(rng):
+    """The d=64 packed layout's fused backward (two heads per tile, one
+    kernel) vs the packed two-pass pair."""
+    import jax.numpy as jnp
+    H, S, d = 4, 256, 64
+    q, k, v = (rng.standard_normal((H, S, d)).astype(np.float32)
+               for _ in range(3))
+
+    def grads(mode):
+        def f(a, b, c):
+            return (flash.flash_attention_packed(
+                a, b, c, causal=True, bwd_mode=mode) ** 2).sum()
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    for a, b in zip(grads("fused"), grads("two_pass")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0.0, atol=0.0)
+
+
+def test_flash_bwd_block_policy():
+    """Pin the backward geometry the fused kernel runs at on hardware
+    (the aot seam bypasses the interpret 128s): the ported forward
+    findings, the VMEM-driven degradation, and the two-pass fallback
+    when the dk/dv planes cannot fit."""
+    from accl_tpu.parallel import pallas_ring
+    with pallas_ring.aot_lowering():
+        assert flash._bwd_default_blocks(2048, 128, False) == (512, 2048)
+        assert flash._bwd_default_blocks(2048, 128, True) == (512, 2048)
+        assert flash._bwd_default_blocks(256, 128, True) == (256, 256)
+        assert flash._bwd_default_blocks(4096, 128, True) == (512, 1024)
+        assert flash._bwd_default_blocks(4096, 128, False) == (1024, 1024)
+        assert flash._bwd_default_blocks(8192, 128, True) == (512, 512)
+        # dk/dv planes alone exceed the budget: policy -> two-pass
+        assert flash._bwd_default_blocks(16384, 128, True) is None
+    # interpret rung keeps the cheap 128 geometry
+    assert flash._bwd_default_blocks(2048, 128, False) == (128, 128)
+
+
+def test_flash_bwd_mode_config_wiring(accl):
+    """ACCLConfig.flash_bwd writes through to the kernel module on every
+    config assignment, and bogus modes fail loudly."""
+    from accl_tpu.ops import flash as fmod
+    saved = accl.config
+    try:
+        assert fmod.get_flash_bwd_mode() == "fused"
+        accl.config = accl.config.replace(flash_bwd="two_pass")
+        assert fmod.get_flash_bwd_mode() == "two_pass"
+    finally:
+        accl.config = saved
+    assert fmod.get_flash_bwd_mode() == "fused"
+    with pytest.raises(ValueError, match="flash_bwd"):
+        fmod.set_flash_bwd_mode("nope")
+    with pytest.raises(ValueError, match="bwd_mode"):
+        flash.flash_attention(
+            np.zeros((1, 128, 64), np.float32),
+            np.zeros((1, 128, 64), np.float32),
+            np.zeros((1, 128, 64), np.float32), bwd_mode="bogus")
+
+
 def test_flash_packed_fallback_envelope(rng):
     """Outside the packed envelope (odd heads / d != 64 / GQA) the public
     wrapper silently routes to the padded kernel with identical results."""

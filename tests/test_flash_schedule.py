@@ -2,8 +2,10 @@
 
 The block-geometry policy picks different kernels per (S, mask): the
 single-k-block scratch path (S <= 2048 non-causal), the one-shot causal
-kernel, the asymmetric 512x1024 causal sweep (S > 2048), and the
-head-packed d=64 family. The CPU suite runs them all in interpret mode,
+kernel, the asymmetric 512x1024 causal sweep (S > 2048), the
+head-packed d=64 family, and (round 6) the fused single-pass backward's
+geometries beside the two-pass pair. The CPU suite runs them all in
+interpret mode,
 which cannot catch Mosaic lowering regressions — these tests compile
 the real TPU kernels for a v5e target from the CPU rung via the
 ``pallas_ring.aot_lowering()`` seam (the same gate the chunked
@@ -23,14 +25,10 @@ from accl_tpu.parallel import pallas_ring
 
 @pytest.fixture(scope="module")
 def tpu_dev():
-    """One AOT v5e device (compile-only; no chip needed)."""
-    try:
-        from jax.experimental import topologies
-        topo = topologies.get_topology_desc(
-            platform="tpu", topology_name="v5e:2x4")
-        return list(topo.devices)[0]
-    except Exception as e:  # pragma: no cover - environment-dependent
-        pytest.skip(f"TPU AOT topology unavailable: {e}")
+    """One AOT v5e device (compile-only; no chip needed), via the
+    hermetic conftest probe (a sick libtpu must skip, never hang)."""
+    from conftest import aot_topology_devices
+    return aot_topology_devices("v5e:2x4")[0]
 
 
 def _aot(fn, dev, *shapes, dtype=jnp.bfloat16, min_kernels=1):
@@ -38,7 +36,7 @@ def _aot(fn, dev, *shapes, dtype=jnp.bfloat16, min_kernels=1):
     args = [jax.ShapeDtypeStruct(s, dtype, sharding=sh) for s in shapes]
     with jax.enable_x64(False), pallas_ring.aot_lowering():
         compiled = jax.jit(fn).lower(*args).compile()
-    assert_aot_lowered(compiled, min_kernels)
+    return assert_aot_lowered(compiled, min_kernels)
 
 
 def _resolved_blocks(S, d, causal, itemsize=2):
@@ -46,6 +44,13 @@ def _resolved_blocks(S, d, causal, itemsize=2):
     computed under the aot seam so interpret mode doesn't mask it."""
     with pallas_ring.aot_lowering():
         return flash._default_blocks(S, d, causal, None, None, itemsize)
+
+
+def _resolved_bwd_blocks(S, dp, causal, itemsize=2):
+    """Backward arm: the fused kernel's hardware geometry (None means
+    the policy itself falls back to two-pass)."""
+    with pallas_ring.aot_lowering():
+        return flash._bwd_default_blocks(S, dp, causal, itemsize)
 
 
 @pytest.mark.parametrize("S,causal,expect_blocks,geometry", [
@@ -65,25 +70,85 @@ def test_flash_forward_lowers_for_v5e(tpu_dev, S, causal, expect_blocks,
 
 
 @pytest.mark.parametrize("causal", [False, True])
-def test_flash_backward_lowers_for_v5e(tpu_dev, causal):
-    """fwd + dK/dV + dQ = three Mosaic kernels through the custom VJP."""
+def test_flash_backward_two_pass_lowers_for_v5e(tpu_dev, causal):
+    """The two-pass fallback/A-B path: fwd + dK/dV + dQ = three Mosaic
+    kernels through the custom VJP (pinned via bwd_mode — the round-6
+    default is the fused single-pass kernel)."""
     H, S, d = 4, 2048, 128
 
     def loss(q, k, v):
-        return flash.flash_attention(q, k, v, causal=causal).astype(
+        return flash.flash_attention(q, k, v, causal=causal,
+                                     bwd_mode="two_pass").astype(
             jnp.float32).sum()
 
     _aot(jax.grad(loss, argnums=(0, 1, 2)), tpu_dev,
          (H, S, d), (H, S, d), (H, S, d), min_kernels=3)
+
+
+@pytest.mark.parametrize("S,causal,expect_blocks,geometry", [
+    (2048, False, (512, 2048), "single-k fused bwd (nk=1, one-shot dq)"),
+    (2048, True, (512, 2048), "single-k fused bwd, causal"),
+    (4096, True, (512, 1024), "asymmetric causal fused sweep"),
+    (4096, False, (1024, 1024), "swept non-causal fused bwd"),
+])
+def test_flash_fused_bwd_lowers_for_v5e(tpu_dev, S, causal,
+                                        expect_blocks, geometry):
+    """Round 6: every fused-backward geometry the policy can pick must
+    Mosaic-compile, and produce EXACTLY two kernels (fwd + ONE fused
+    bwd) — a third kernel means the two-pass pair silently engaged."""
+    H, d = 2, 128
+    assert _resolved_bwd_blocks(S, d, causal) == expect_blocks, geometry
+
+    def loss(q, k, v):
+        return flash.flash_attention(q, k, v, causal=causal,
+                                     bwd_mode="fused").astype(
+            jnp.float32).sum()
+
+    txt = _aot(jax.grad(loss, argnums=(0, 1, 2)), tpu_dev,
+               (H, S, d), (H, S, d), (H, S, d), min_kernels=2)
+    from conftest import MOSAIC_CALL
+    assert len(MOSAIC_CALL.findall(txt)) == 2, geometry
+
+
+def test_flash_fused_bwd_gqa_lowers_for_v5e(tpu_dev):
+    """Grouped-query fused backward: the q sweep walks each kv head's
+    group (g*nq steps) and dk/dv come out at (hkv, S, d)."""
+    H, hkv, S, d = 4, 2, 2048, 128
+
+    def loss(q, k, v):
+        return flash.flash_attention(q, k, v, causal=True,
+                                     bwd_mode="fused").astype(
+            jnp.float32).sum()
+
+    _aot(jax.grad(loss, argnums=(0, 1, 2)), tpu_dev,
+         (H, S, d), (hkv, S, d), (hkv, S, d), min_kernels=2)
 
 
 def test_flash_packed_lowers_for_v5e(tpu_dev):
-    """The head-packed d=64 family (fwd + both backward kernels)."""
+    """The head-packed d=64 family, two-pass pinned (fwd + both backward
+    kernels)."""
     H, S, d = 4, 2048, 64
 
     def loss(q, k, v):
-        return flash.flash_attention_packed(q, k, v).astype(
+        return flash.flash_attention_packed(q, k, v,
+                                            bwd_mode="two_pass").astype(
             jnp.float32).sum()
 
     _aot(jax.grad(loss, argnums=(0, 1, 2)), tpu_dev,
          (H, S, d), (H, S, d), (H, S, d), min_kernels=3)
+
+
+def test_flash_packed_fused_bwd_lowers_for_v5e(tpu_dev):
+    """Head-packed fused backward (two heads per 128-lane tile, single
+    backward kernel): exactly fwd + fused bwd."""
+    H, S, d = 4, 2048, 64
+
+    def loss(q, k, v):
+        return flash.flash_attention_packed(q, k, v,
+                                            bwd_mode="fused").astype(
+            jnp.float32).sum()
+
+    txt = _aot(jax.grad(loss, argnums=(0, 1, 2)), tpu_dev,
+               (H, S, d), (H, S, d), (H, S, d), min_kernels=2)
+    from conftest import MOSAIC_CALL
+    assert len(MOSAIC_CALL.findall(txt)) == 2

@@ -31,13 +31,8 @@ WORLD = 8
 def tpu_comm():
     """Communicator over an AOT v5e 2x4 topology (compile-only: no chips
     needed — skip where libtpu cannot provide topology descriptions)."""
-    try:
-        from jax.experimental import topologies
-        topo = topologies.get_topology_desc(
-            platform="tpu", topology_name="v5e:2x4")
-        devices = list(topo.devices)
-    except Exception as e:  # pragma: no cover - environment-dependent
-        pytest.skip(f"TPU AOT topology unavailable: {e}")
+    from conftest import aot_topology_devices
+    devices = aot_topology_devices("v5e:2x4")
     assert len(devices) == WORLD
     return Communicator(devices)
 

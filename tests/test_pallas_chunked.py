@@ -14,6 +14,10 @@ import pytest
 
 from accl_tpu import Algorithm, dataType, reduceFunction
 from accl_tpu.parallel import pallas_chunked, pallas_ring
+from conftest import requires_interpret_rdma
+
+# the whole module simulates cross-device RDMA in interpret mode
+pytestmark = requires_interpret_rdma
 
 WORLD = 8
 SEG = 4096  # bytes -> 1024 f32 elements per segment

@@ -186,8 +186,8 @@ def test_sendrecv_over_real_ici(hw_accl):
 @multichip
 def test_device_api_collective_in_kernel_on_ici(hw_accl):
     """Device-initiated collective (vadd_put analog) on real chips."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+    from accl_tpu.compat import shard_map
     from accl_tpu import device_api as dapi
 
     comm = hw_accl.global_comm()
