@@ -71,9 +71,13 @@ class ACCL:
     @config.setter
     def config(self, cfg: ACCLConfig) -> None:
         self._config = cfg
+        from .ops import collective_matmul as _cm_ops
         from .ops import flash as _flash_ops
 
         _flash_ops.set_flash_bwd_mode(cfg.flash_bwd)
+        _cm_ops.set_overlap_enabled(cfg.cmatmul_overlap)
+        _cm_ops.set_overlap_thresholds(cfg.ag_matmul_threshold,
+                                       cfg.rs_matmul_threshold)
 
     def __init__(
         self,
@@ -108,6 +112,9 @@ class ACCL:
         """accl.cpp:1082-1130 analog."""
         if self._initialized:
             return
+        # fresh session: the once-per-pair fallback warning set is
+        # module-global and must not inherit a prior session's silence
+        algorithms.reset_global_fallback_warnings()
         if self.config.transport is None:
             from .utils.bringup import detect_backend
 

@@ -462,6 +462,98 @@ def autotune_flat_tree(acc, cfg: ACCLConfig, reps: int = 3,
     return cfg.replace(gather_flat_tree_max_fanin=best_fanin)
 
 
+def measure_collective_matmul(comm, ms: Sequence[int],
+                              algos: Sequence[Algorithm],
+                              k: int = 512, n: int = 512,
+                              dt: dataType = dataType.float32,
+                              reps: int = 3,
+                              bidirectional: bool = True,
+                              ops: Sequence[str] = ("agmm", "mmrs")) -> dict:
+    """Per-algorithm best-of-`reps` wall time for the fused collective
+    matmuls over a sweep of per-rank row counts ``ms``. Returns
+    ``{op_name: {algo: [t, ...]}}`` for ``agmm`` (allgather_matmul,
+    LHS shard (m, k)) and ``mmrs`` (matmul_reduce_scatter, local rows
+    (m*world, k) so the scattered chunk is (m, n))."""
+    import jax
+    W = comm.world_size
+    npdt = np.dtype(to_jax_dtype(dt))
+    out = {op: {a: [] for a in algos} for op in ops}
+    w = jax.device_put(np.full((W, k, n), 1e-3, npdt), comm.sharding())
+    for algo in algos:
+        ag_prog = algorithms.build_allgather_matmul(
+            comm, algo, bidirectional=bidirectional)
+        rs_prog = algorithms.build_matmul_reduce_scatter(
+            comm, algo, bidirectional=bidirectional)
+        for m in ms:
+            if "agmm" in ops:
+                x = jax.device_put(np.full((W, m, k), 1e-3, npdt),
+                                   comm.sharding())
+                out["agmm"][algo].append(
+                    _time_prog(ag_prog, x, w, reps=reps))
+            if "mmrs" in ops:
+                x = jax.device_put(np.full((W, W * m, k), 1e-3, npdt),
+                                   comm.sharding())
+                out["mmrs"][algo].append(
+                    _time_prog(rs_prog, x, w, reps=reps))
+    return out
+
+
+def autotune_collective_matmul(acc, cfg: Optional[ACCLConfig] = None,
+                               pows: Sequence[int] = (7, 9, 11),
+                               k: int = 512, n: int = 512,
+                               reps: int = 3,
+                               dt: dataType = dataType.float32
+                               ) -> ACCLConfig:
+    """Measure the comm/compute-overlapped collective matmuls against the
+    unfused XLA pairs on the live mesh and write the crossovers to
+    ``ag_matmul_threshold`` / ``rs_matmul_threshold`` (the overlap-vs-XLA
+    registers select() reads for the allgather_matmul /
+    matmul_reduce_scatter operations). Units match select()'s byte
+    conventions: the (m, k) LHS shard for agmm, the (m, n) f32
+    travelling accumulator for mmrs. ICI only — the kernels would
+    measure the simulator anywhere else."""
+    from ..ops import collective_matmul as cm
+
+    cfg = cfg or acc.config
+    if acc.config.transport != TransportBackend.ICI:
+        return cfg
+    comm = acc.global_comm()
+    W = comm.world_size
+    if W == 1:
+        return cfg
+    bidir = acc.config.bidirectional_rings
+    elem = np.dtype(to_jax_dtype(dt)).itemsize
+    npdt = to_jax_dtype(dt)
+    # sweep only sizes whose overlap PLAN fits: beyond the VMEM budget
+    # the "PALLAS" builder silently runs the XLA fallback, and a
+    # crossover computed over those points would time XLA against
+    # itself and write DISABLED on a healthy mesh
+    ms_ag = [m for m in (2 ** p for p in pows)
+             if cm.agmm_plan(m, k, n, W, npdt, bidir) is not None]
+    ms_rs = [m for m in (2 ** p for p in pows)
+             if cm.mmrs_plan(W * m, k, n, W, npdt, bidir) is not None]
+    algos = [Algorithm.XLA, Algorithm.PALLAS]
+    if ms_ag:
+        t = measure_collective_matmul(comm, ms_ag, algos, k=k, n=n, dt=dt,
+                                      reps=reps, bidirectional=bidir,
+                                      ops=("agmm",))
+        ag_at = _crossover([m * k for m in ms_ag],
+                           t["agmm"][Algorithm.XLA],
+                           t["agmm"][Algorithm.PALLAS], elem)
+        cfg = cfg.replace(
+            ag_matmul_threshold=ag_at if ag_at is not None else DISABLED)
+    if ms_rs:
+        t = measure_collective_matmul(comm, ms_rs, algos, k=k, n=n, dt=dt,
+                                      reps=reps, bidirectional=bidir,
+                                      ops=("mmrs",))
+        rs_at = _crossover([m * n for m in ms_rs],
+                           t["mmrs"][Algorithm.XLA],
+                           t["mmrs"][Algorithm.PALLAS], 4)  # f32 acc
+        cfg = cfg.replace(
+            rs_matmul_threshold=rs_at if rs_at is not None else DISABLED)
+    return cfg
+
+
 def autotune_flash_bwd(acc, cfg: Optional[ACCLConfig] = None,
                        H: int = 8, S: int = 2048, d: int = 128,
                        reps: int = 3) -> ACCLConfig:
@@ -508,8 +600,9 @@ def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
     """Tune EVERY threshold ``select()`` reads on the live mesh: allreduce
     ring/hier(/pallas), allgather + reduce_scatter ring crossovers, the
     flat-tree rank/count/fan-in registers (accl.cpp:1214-1224 analog,
-    measured instead of frozen), and the single-chip flash fused/two-pass
-    backward crossover (any world size)."""
+    measured instead of frozen), the collective-matmul overlap-vs-XLA
+    crossovers (ICI), and the single-chip flash fused/two-pass backward
+    crossover (any world size)."""
     if acc.global_comm().world_size == 1:
         # Every threshold select() reads splits INTER-DEVICE algorithm
         # families; at world=1 all of them are degenerate (a one-rank
@@ -535,6 +628,7 @@ def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
         cfg = autotune_alltoall(acc, cfg, pows=pows, reps=reps, dt=dt)
         cfg = autotune_reduce(acc, cfg, pows=pows, reps=reps, dt=dt)
         cfg = autotune_flat_tree(acc, cfg, reps=reps, dt=dt)
+        cfg = autotune_collective_matmul(acc, cfg, reps=reps, dt=dt)
         cfg = autotune_flash_bwd(acc, cfg, reps=reps)
     finally:
         acc.config = saved

@@ -116,6 +116,24 @@ class ACCLConfig:
     # plain jnp ops are used (XLA fuses them anyway — this is a debug switch)
     use_pallas: bool = True
 
+    # collective matmul (ops/collective_matmul.py): comm/compute-
+    # overlapped all-gather x matmul / matmul x reduce-scatter. The
+    # session A/B switch (write-through to set_overlap_enabled, like
+    # flash_bwd; per-call override on every entry point) and the
+    # overlap-vs-XLA size thresholds — read by select() for the
+    # dispatch path AND written through (set_overlap_thresholds) to the
+    # kernel module, where the overlap=None session-default resolution
+    # of the device_api/mlp entry points consults them; an explicit
+    # overlap=True bypasses them per call. Per-op, in LHS-shard bytes
+    # (allgather_matmul: the (m, k) shard each hop moves;
+    # matmul_reduce_scatter: the travelling (m/P, n) f32 accumulator).
+    # bench.autotune_collective_matmul measures both crossovers on the
+    # live mesh (DISABLED when fused never wins — overlap then never
+    # engages by default).
+    cmatmul_overlap: bool = True
+    ag_matmul_threshold: int = 256 * 1024       # allgather_matmul (bytes)
+    rs_matmul_threshold: int = 256 * 1024       # matmul_reduce_scatter
+
     # flash-attention backward: "fused" runs the single-pass dK/dV+dQ
     # kernel wherever its VMEM plan fits (two-pass beyond); "two_pass"
     # pins the classic kernel pair everywhere — the A/B switch and the

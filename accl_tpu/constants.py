@@ -48,6 +48,10 @@ class operation(enum.IntEnum):
     barrier = 12
     alltoall = 13
     put = 14  # one-sided stream_put (accl.hpp stream_put)
+    # comm/compute-overlapped TP matmul family (beyond the reference's
+    # enum — the collective and the matmul are one scenario here)
+    allgather_matmul = 15
+    matmul_reduce_scatter = 16
     nop = 255
 
 

@@ -120,6 +120,37 @@ def all_to_all(x, axis: str = AXIS):
     return swapped.reshape(x.shape)
 
 
+def all_gather_matmul(x, w, axis: str = AXIS, mesh_axes=None,
+                      overlap: Optional[bool] = None,
+                      bidirectional: bool = True):
+    """In-kernel comm/compute-overlapped ``all_gather(x, rows) @ w``
+    (Megatron column-parallel forward over a row-sharded LHS): each
+    arriving ring shard is multiplied while the next hop's remote DMA
+    is in flight (ops/collective_matmul.py). ``overlap=None`` follows
+    the session default (``ACCLConfig.cmatmul_overlap``); the policy
+    falls back to the unfused XLA pair when the staged shard misses the
+    scoped-VMEM budget. On a multi-axis mesh pass the mesh's axis-name
+    order as ``mesh_axes`` (ring neighbors need flat device ids).
+    Differentiable — the backward runs the dual overlapped kernel."""
+    from .ops import collective_matmul as cm
+    mesh_axes = tuple(mesh_axes) if mesh_axes else None
+    return cm.all_gather_matmul(x, w, axis, mesh_axes, overlap,
+                                bidirectional)
+
+
+def matmul_reduce_scatter(x, w, axis: str = AXIS, mesh_axes=None,
+                          overlap: Optional[bool] = None,
+                          bidirectional: bool = True):
+    """In-kernel comm/compute-overlapped ``reduce_scatter(x @ w, rows)``
+    (row-parallel combine): the per-hop partial product is computed on
+    the MXU while the travelling accumulator's remote DMA is in flight.
+    Same policy/fallback semantics as :func:`all_gather_matmul`."""
+    from .ops import collective_matmul as cm
+    mesh_axes = tuple(mesh_axes) if mesh_axes else None
+    return cm.matmul_reduce_scatter(x, w, axis, mesh_axes, overlap,
+                                    bidirectional)
+
+
 def put_next(x, axis: str = AXIS, offset: int = 1):
     """One-sided put to rank+offset on the ring — the ``stream_put`` analog
     (vadd_put.cpp:26-86 sends its stream to the next rank)."""

@@ -10,14 +10,35 @@ the vadd_put pattern (compute fused with collectives, host only launches).
 Sharding (Megatron column/row parallel):
   W1 (d, h): columns sharded over tp -> local (d, h/tp)
   W2 (h, d): rows    sharded over tp -> local (h/tp, d)
-  activations never materialize h; the partial products psum over tp.
+  activations never materialize h; the partial products combine over tp.
   Batch sharded over dp; gradients dp-averaged with a psum (the classic
   DP gradient allreduce, here fused into the step program).
+
+Two selectable TP datapaths (``overlap``; the A/B the collective-matmul
+kernels are benched against):
+
+* **psum baseline** (``overlap=False``): the textbook sequential
+  pattern — local matmuls, then a blocking ``psum`` combine; ICI idles
+  during MXU time and vice versa;
+* **overlapped** (``overlap=True``): the forward column-parallel matmul
+  runs as :func:`device_api.all_gather_matmul` over the batch rows'
+  tp-shards and the row-parallel combine as
+  :func:`device_api.matmul_reduce_scatter` — each ring hop's transfer
+  flies while the MXU computes the previous hop's block
+  (``ops/collective_matmul.py``), in the backward too (the kernels are
+  ``custom_vjp`` duals of each other). Same math: the loss trajectory
+  matches the baseline to float tolerance.
+
+``overlap=None`` (default) follows the session config
+(``ACCLConfig.cmatmul_overlap`` write-through); the per-call argument on
+:func:`make_forward` / :func:`make_train_step` pins either path. The
+block-geometry policy inside the kernels still falls back to the unfused
+pair when the staged shard misses the scoped-VMEM budget, and the
+baseline is used when the per-dp-rank batch does not divide by tp.
 """
 from __future__ import annotations
 
-import functools
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -26,6 +47,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..compat import shard_map
+from .. import device_api as dapi
 
 DP_AXIS = "dp"
 TP_AXIS = "tp"
@@ -56,8 +78,45 @@ def param_specs() -> MLPParams:
     )
 
 
-def _forward_local(p: MLPParams, x):
-    """Per-rank forward: tp-partial matmuls + device-side psum (bf16 MXU)."""
+def _forward_local(p: MLPParams, x, overlap: Optional[bool] = False,
+                   mesh_axes=(DP_AXIS, TP_AXIS)):
+    """Per-rank forward; ``overlap`` picks the TP datapath (same math).
+    None follows the session default and the tuned size registers
+    (``cm.agmm_engages``/``mmrs_engages``, resolved at trace = build
+    time); an explicit True forces the fused kernels at any size."""
+    from ..ops import collective_matmul as cm
+
+    tp = lax.axis_size(TP_AXIS)
+    rows = x.shape[0]
+    h_loc = p.w1.shape[1]
+    # take the restructured datapath only when the fused kernels would
+    # ACTUALLY engage for both stages (session registers + VMEM plan +
+    # rung) — its unfused rendition re-gathers rows every rank already
+    # holds and would be strictly slower than the psum baseline
+    if (tp > 1 and rows % tp == 0
+            and cm.agmm_engages(rows // tp, x.shape[1], h_loc, tp,
+                                x.dtype, overlap)
+            and cm.mmrs_engages(rows, h_loc, p.w2.shape[1], tp,
+                                x.dtype, overlap)):
+        # overlapped datapath: the column-parallel matmul regenerates
+        # the full batch rows from each rank's row shard hop by hop
+        # (x is tp-replicated, so the shards ARE x's row blocks), and
+        # the row-parallel combine folds each hop's partial block into
+        # the travelling accumulator — MXU busy while ICI moves
+        ms = rows // tp
+        x_s = lax.dynamic_slice_in_dim(
+            x, lax.axis_index(TP_AXIS) * ms, ms, axis=0)
+        h = dapi.all_gather_matmul(x_s, p.w1, axis=TP_AXIS,
+                                   mesh_axes=mesh_axes,
+                                   overlap=overlap) + p.b1
+        h = jax.nn.gelu(h)
+        y_s = dapi.matmul_reduce_scatter(h.astype(x.dtype), p.w2,
+                                         axis=TP_AXIS, mesh_axes=mesh_axes,
+                                         overlap=overlap)
+        # rebuild the dp-rank's full rows (the scattered halves of the
+        # psum: all_gather(psum_scatter(p)) == psum(p))
+        y = lax.all_gather(y_s, TP_AXIS, axis=0, tiled=True) + p.b2
+        return y
     h = jnp.dot(x, p.w1, preferred_element_type=jnp.float32) + p.b1
     h = jax.nn.gelu(h)
     y_partial = jnp.dot(h, p.w2, preferred_element_type=jnp.float32)
@@ -70,12 +129,14 @@ def make_mesh(devices, dp: int, tp: int) -> Mesh:
     return Mesh(devs, (DP_AXIS, TP_AXIS))
 
 
-def make_forward(mesh: Mesh):
-    """Jitted forward over the (dp, tp) mesh."""
+def make_forward(mesh: Mesh, overlap: Optional[bool] = None):
+    """Jitted forward over the (dp, tp) mesh. ``overlap`` picks the TP
+    datapath (None: session default; see the module docstring)."""
     specs = param_specs()
+    axes = tuple(mesh.axis_names)
 
     def fwd(p, x):
-        return _forward_local(p, x)
+        return _forward_local(p, x, overlap=overlap, mesh_axes=axes)
 
     return jax.jit(
         shard_map(fwd, mesh=mesh, in_specs=(specs, P(DP_AXIS, None)),
@@ -83,19 +144,24 @@ def make_forward(mesh: Mesh):
     )
 
 
-def make_train_step(mesh: Mesh, lr: float = 1e-2):
+def make_train_step(mesh: Mesh, lr: float = 1e-2,
+                    overlap: Optional[bool] = None):
     """One fused program: forward + backward + dp gradient allreduce + SGD.
 
     Returns ``step(params, x, targets) -> (new_params, loss)`` with params
     living sharded on device between steps (no host round-trips — the
-    framework's north-star property applied to training).
+    framework's north-star property applied to training). With
+    ``overlap`` the TP matmuls of BOTH passes ride the collective-matmul
+    kernels (their custom VJPs are each other's duals), producing the
+    same loss trajectory as the psum baseline to float tolerance.
     """
     specs = param_specs()
     dp_size = mesh.shape[DP_AXIS]
+    axes = tuple(mesh.axis_names)
 
     def local_step(p: MLPParams, x, t):
         def loss_fn(p_):
-            y = _forward_local(p_, x)
+            y = _forward_local(p_, x, overlap=overlap, mesh_axes=axes)
             return jnp.mean((y - t) ** 2)
 
         loss, grads = jax.value_and_grad(loss_fn)(p)

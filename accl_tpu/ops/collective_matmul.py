@@ -1,0 +1,677 @@
+"""Collective matmul: comm/compute-overlapped tensor-parallel kernels.
+
+The textbook TP pattern serializes its two engines: the MXU runs the
+local matmul, THEN the ICI runs the collective (or vice versa), so each
+sits idle for the other's phase — exactly the host-launch/streaming
+split the reference's datapath exists to avoid (SURVEY §2: compute fused
+with collectives).  ACCL+ (arXiv 2312.11742) fuses the collective engine
+into the application dataflow; Near-Optimal Wafer-Scale Reduce (arXiv
+2404.15888) folds per-hop compute into the transfer schedule.  These
+kernels are that idea for the TPU build: the ring schedule and the MXU
+schedule are ONE Pallas program —
+
+* :func:`all_gather_matmul` — ``Y = all_gather(x) @ w`` where ``x`` is
+  the per-rank row shard of the LHS and ``w`` the local weight block
+  (Megatron column-parallel forward over a sequence-sharded input).
+  Each arriving ring shard is multiplied while the next hop's
+  ``make_async_remote_copy`` is in flight, starting from the local
+  shard (its matmul overlaps hop 0);
+* :func:`matmul_reduce_scatter` — ``Y_shard = reduce_scatter(x @ w)``
+  (row-parallel combine).  The travelling partial-product accumulator
+  rides the ring; each hop's local partial block is computed on the
+  MXU while the accumulator is in flight, then folded — the per-hop
+  accumulate-in-transfer schedule of the wafer-scale reduce.
+
+Both reuse the double-buffered send/recv VMEM staging discipline of
+``parallel/pallas_chunked.py`` (two slots, credit semaphores with
+grants == gates, every semaphore drains to zero) and offer
+bidirectional-channel variants for P >= 4 mirroring ``_dirs(chan)``
+there: the shard's row halves counter-rotate so both directions of
+every ICI link carry payload (half the bytes each).
+
+Backward passes are the SAME kernels with roles swapped (the classic
+collective-matmul duality), registered as ``jax.custom_vjp``:
+
+* d(all_gather_matmul):  dx = matmul_reduce_scatter(dy, wᵀ),
+                         dw = all_gather(x)ᵀ @ dy;
+* d(matmul_reduce_scatter): dx = all_gather_matmul(dy, wᵀ),
+                            dw = xᵀ @ all_gather(dy).
+
+A block-geometry policy (:func:`agmm_plan` / :func:`mmrs_plan`) sizes
+the staged shard against the scoped-VMEM budget and falls back to the
+unfused XLA pair when it misses — the same fallback shape the flash
+backward policy established (``ops/flash.py``).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..parallel import pallas_ring as _pr
+from ..parallel.pallas_ring import _LANES, _sublane
+
+AXIS = _pr.AXIS
+
+#: scoped-VMEM budget for the overlap plan (chip limit ~16 MiB; the
+#: margin covers Mosaic's own staging) — the flash policy's number
+_VMEM_BUDGET = 12 << 20
+
+
+def _interpret_params():
+    # late-bound so tests patching pallas_ring._interpret_params (e.g. to
+    # enable the race detector) cover these kernels too
+    return _pr._interpret_params()
+
+
+# ---------------------------------------------------------------------------
+# session-level overlap switch (ACCLConfig.cmatmul_overlap write-through,
+# the flash set_flash_bwd_mode shape); per-call override on the wrappers
+# ---------------------------------------------------------------------------
+
+_OVERLAP_DEFAULT = True
+#: engage-at-or-above payload bytes for the SESSION-DEFAULT resolution
+#: (overlap=None): agmm keys on the (m, k) LHS shard, mmrs on the
+#: (m/P, n) f32 travelling accumulator — the same conventions as the
+#: ``select()`` registers, which land here via the config write-through
+#: (``ACCLConfig.ag_matmul_threshold`` / ``rs_matmul_threshold``, incl.
+#: autotune's DISABLED sentinel). 0 until a session installs tuned
+#: values: overlap-by-default, matching cmatmul_overlap=True. An
+#: EXPLICIT ``overlap=True`` bypasses the thresholds (the force-
+#: selectable per-call analog, like a requested Algorithm.PALLAS).
+_AG_THRESHOLD = 0
+_RS_THRESHOLD = 0
+
+
+def set_overlap_enabled(enabled: bool) -> None:
+    """Set the module-default overlap mode (``ACCLConfig.cmatmul_overlap``
+    lands here at every config assignment). Per-call override: the
+    wrappers' ``overlap`` argument."""
+    global _OVERLAP_DEFAULT
+    _OVERLAP_DEFAULT = bool(enabled)
+
+
+def get_overlap_enabled() -> bool:
+    return _OVERLAP_DEFAULT
+
+
+def set_overlap_thresholds(ag_bytes: int, rs_bytes: int) -> None:
+    """Install the session's overlap-vs-XLA size registers (config
+    write-through; autotuned). Consulted only by the overlap=None
+    session-default resolution — see the module attribute docs."""
+    global _AG_THRESHOLD, _RS_THRESHOLD
+    _AG_THRESHOLD = int(ag_bytes)
+    _RS_THRESHOLD = int(rs_bytes)
+
+
+def get_overlap_thresholds() -> Tuple[int, int]:
+    return _AG_THRESHOLD, _RS_THRESHOLD
+
+
+# ---------------------------------------------------------------------------
+# ring geometry over a (possibly multi-axis) mesh
+# ---------------------------------------------------------------------------
+
+def _flat_ids(axis: str, mesh_axes: Sequence[str], P: int):
+    """(my, left, right) as LOGICAL device ids over the FULL mesh.
+
+    The remote-DMA device id is the linear index into the mesh's device
+    array, so on a multi-axis mesh (the mlp's (dp, tp)) the ring axis
+    index alone is not the device id — the other axes contribute the
+    row offset. ``mesh_axes`` is the mesh's axis-name order; rings stay
+    within a row because only the ring axis' index differs between
+    neighbors."""
+    pos = lax.axis_index(axis)
+    p32 = jnp.int32(P)
+    rpos = lax.rem(pos + jnp.int32(1), p32)
+    lpos = lax.rem(pos + p32 - jnp.int32(1), p32)
+    my = jnp.int32(0)
+    left = jnp.int32(0)
+    right = jnp.int32(0)
+    for name in mesh_axes:
+        size = jnp.int32(lax.axis_size(name))
+        idx = lax.axis_index(name)
+        my = my * size + idx
+        left = left * size + (lpos if name == axis else idx)
+        right = right * size + (rpos if name == axis else idx)
+    return pos, my, left, right
+
+
+def _dirs(chan: int, left, right, bidirectional: bool):
+    """Per-channel ring orientation, mirroring pallas_chunked._dirs:
+    (downstream we send to, upstream we grant credits to, index sign).
+    Channel 1 rotates LEFT when bidirectional so both directions of
+    every ICI link carry payload simultaneously."""
+    if bidirectional and chan == 1:
+        return left, right, jnp.int32(1)
+    return right, left, jnp.int32(-1)
+
+
+# ---------------------------------------------------------------------------
+# latency-hiding all-gather x matmul
+# ---------------------------------------------------------------------------
+
+def _agmm_kernel(x_ref, w_ref, o_ref, buf, send_sem, recv_sem, cap_sem, *,
+                 P: int, axis: str, mesh_axes: Tuple[str, ...],
+                 bidirectional: bool):
+    """x_ref: (mp, kp) own LHS shard; w_ref: (kp, n); o_ref: (P, mp, n);
+    all VMEM. ``buf``: (nchan, 2, mh, kp) double-buffered recv slots.
+
+    Transfer ``t`` (t = 0..P-2) forwards the shard received at t-1 (t=0:
+    the local shard) downstream while the matmul of the newest arrival
+    runs on the MXU — the hop transfer and the hop matmul overlap by
+    construction. Credit discipline (grants == gates, drains to zero):
+    the slot written by transfer t is granted back upstream only after
+    its matmul consumed it AND the forward that read it drained.
+
+    ``bidirectional``: the shard's row halves counter-rotate (channel 0
+    top half -> right, channel 1 bottom half -> left); each output
+    block's halves arrive via opposite rings, so every link carries
+    half the bytes in each direction.
+    """
+    nchan = 2 if bidirectional else 1
+    mh = x_ref.shape[0] // nchan
+    pos, _, left, right = _flat_ids(axis, mesh_axes, P)
+    _pr._ring_barrier(left, right)
+    hops = P - 1
+
+    def rows(chan):
+        return pl.ds(chan * mh, mh)
+
+    def _rdma(chan, src_slot, dst_slot, use_x: bool):
+        dst, _, _ = _dirs(chan, left, right, bidirectional)
+        src = (x_ref.at[rows(chan), :] if use_x
+               else buf.at[chan, src_slot])
+        return pltpu.make_async_remote_copy(
+            src_ref=src,
+            dst_ref=buf.at[chan, dst_slot],
+            send_sem=send_sem.at[chan, dst_slot],
+            recv_sem=recv_sem.at[chan, dst_slot],
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    # prologue: launch transfer 0 on every channel, then compute the
+    # local block while the ring moves — hop 0 is already overlapped
+    for chan in range(nchan):
+        _rdma(chan, 0, 0, use_x=True).start()
+    o_ref[pos] = jnp.dot(x_ref[...], w_ref[...],
+                         preferred_element_type=o_ref.dtype)
+
+    def hop(t, _):
+        t = jnp.int32(t)
+        slot = lax.rem(t, jnp.int32(2))
+        nslot = lax.rem(t + 1, jnp.int32(2))
+
+        for chan in range(nchan):
+            _, upstream, sign = _dirs(chan, left, right, bidirectional)
+            # block whose shard transfer t delivered here
+            src_idx = lax.rem(pos + sign * (t + jnp.int32(1))
+                              + jnp.int32(2 * P), jnp.int32(P))
+
+            _rdma(chan, slot, slot, use_x=False).wait_recv()
+
+            # forward the arrival before its matmul so transfer t+1 is
+            # in flight during the MXU work of hop t
+            @pl.when(t + 1 <= hops - 1)
+            def _fwd(chan=chan, slot=slot, nslot=nslot):
+                # credit gate: downstream must have consumed its slot
+                # (t+1)%2 content (transfer t-1) before we overwrite it
+                @pl.when(t + 1 >= 2)
+                def _gate():
+                    pltpu.semaphore_wait(cap_sem.at[chan], 1)
+                _rdma(chan, slot, nslot, use_x=False).start()
+
+            o_ref[src_idx, rows(chan)] = jnp.dot(
+                buf[chan, slot], w_ref[...],
+                preferred_element_type=o_ref.dtype)
+
+            @pl.when(t + 1 <= hops - 1)
+            def _drain(chan=chan, slot=slot, nslot=nslot):
+                _rdma(chan, slot, nslot, use_x=False).wait_send()
+
+            @pl.when(t == 0)
+            def _drain0(chan=chan):
+                # the prologue send (x_ref source) also used slot 0's
+                # send semaphore; consume it exactly once
+                _rdma(chan, 0, 0, use_x=True).wait_send()
+
+            # slot t%2 consumed by matmul AND drained by the forward ->
+            # grant it back for upstream's transfer t+2 (grants == gates)
+            @pl.when(t + 2 <= hops - 1)
+            def _grant(chan=chan, upstream=upstream):
+                pltpu.semaphore_signal(
+                    cap_sem.at[chan], inc=1, device_id=upstream,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+        return 0
+
+    lax.fori_loop(0, hops, hop, 0)
+
+
+def _agmm_call(x, w, *, P: int, axis: str, mesh_axes: Tuple[str, ...],
+               out_dtype, bidirectional: bool):
+    mp, kp = x.shape
+    n = w.shape[1]
+    nchan = 2 if bidirectional else 1
+    return pl.pallas_call(
+        functools.partial(_agmm_kernel, P=P, axis=axis,
+                          mesh_axes=mesh_axes, bidirectional=bidirectional),
+        out_shape=jax.ShapeDtypeStruct((P, mp, n), out_dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((nchan, 2, mp // nchan, kp), x.dtype),  # buf
+            pltpu.SemaphoreType.DMA((nchan, 2)),               # send_sem
+            pltpu.SemaphoreType.DMA((nchan, 2)),               # recv_sem
+            pltpu.SemaphoreType.REGULAR((nchan,)),             # cap_sem
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=8),
+        interpret=_interpret_params(),
+    )(x, w)
+
+
+# ---------------------------------------------------------------------------
+# matmul x reduce-scatter
+# ---------------------------------------------------------------------------
+
+def _mmrs_kernel(x_ref, w_ref, o_ref, acc_buf, recv_buf, send_sem,
+                 recv_sem, cap_sem, *, P: int, axis: str,
+                 mesh_axes: Tuple[str, ...], bidirectional: bool):
+    """x_ref: (P, cp, kp) own LHS rows grouped by output chunk; w_ref:
+    (kp, n); o_ref: (cp, n) f32; all VMEM.
+
+    Ring schedule mirrors ``pallas_chunked._chunked_rs_kernel``: the
+    accumulator travels downstream; at hop ``s`` the LOCAL partial for
+    chunk ``(pos + sign*(s+1)) % P`` is computed ON THE MXU while the
+    accumulator's remote DMA is in flight, then folded into the
+    arrival. Rank ``pos`` ends owning folded chunk ``(pos+1) % P``
+    (channel 1 mirrored: ``(pos-1) % P``); the wrapper realigns.
+
+    The seed partial (own chunk) is NOT overlapped — it gates hop 0's
+    send — but every one of the P-1 hop partials is.
+    """
+    nchan = 2 if bidirectional else 1
+    cp = o_ref.shape[0]
+    ch = cp // nchan
+    pos, _, left, right = _flat_ids(axis, mesh_axes, P)
+    _pr._ring_barrier(left, right)
+    hops = P - 1
+
+    def rows(chan):
+        return pl.ds(chan * ch, ch)
+
+    def partial(chan, idx):
+        return jnp.dot(x_ref[idx, rows(chan)], w_ref[...],
+                       preferred_element_type=o_ref.dtype)
+
+    def _rdma(chan, slot):
+        dst, _, _ = _dirs(chan, left, right, bidirectional)
+        return pltpu.make_async_remote_copy(
+            src_ref=acc_buf.at[chan],
+            dst_ref=recv_buf.at[chan, slot],
+            send_sem=send_sem.at[chan],
+            recv_sem=recv_sem.at[chan, slot],
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    for chan in range(nchan):
+        acc_buf[chan] = partial(chan, pos)   # seed: own chunk's partial
+
+    def hop(s, _):
+        s = jnp.int32(s)
+        slot = lax.rem(s, jnp.int32(2))
+
+        for chan in range(nchan):
+            _, upstream, sign = _dirs(chan, left, right, bidirectional)
+            idx = lax.rem(pos + sign * (s + jnp.int32(1))
+                          + jnp.int32(2 * P), jnp.int32(P))
+
+            # credit gate: downstream's fold of this slot's s-2 content
+            @pl.when(s >= 2)
+            def _gate(chan=chan):
+                pltpu.semaphore_wait(cap_sem.at[chan], 1)
+
+            rdma = _rdma(chan, slot)
+            rdma.start()
+
+            # the hop's local partial runs on the MXU while the
+            # accumulator is on the wire — the overlap this kernel is for
+            p = partial(chan, idx)
+
+            rdma.wait_recv()
+            folded = recv_buf[chan, slot] + p
+
+            # recv slot consumed -> grant upstream a credit for s+2
+            @pl.when(s + 2 <= hops - 1)
+            def _grant(chan=chan, upstream=upstream):
+                pltpu.semaphore_signal(
+                    cap_sem.at[chan], inc=1, device_id=upstream,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+            rdma.wait_send()          # send staging drained
+            acc_buf[chan] = folded
+        return 0
+
+    lax.fori_loop(0, hops, hop, 0)
+    for chan in range(nchan):
+        o_ref[rows(chan)] = acc_buf[chan]
+
+
+def _mmrs_call(x, w, *, P: int, axis: str, mesh_axes: Tuple[str, ...],
+               out_dtype, bidirectional: bool):
+    _, cp, kp = x.shape
+    n = w.shape[1]
+    nchan = 2 if bidirectional else 1
+    return pl.pallas_call(
+        functools.partial(_mmrs_kernel, P=P, axis=axis,
+                          mesh_axes=mesh_axes, bidirectional=bidirectional),
+        out_shape=jax.ShapeDtypeStruct((cp, n), out_dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((nchan, cp // nchan, n), out_dtype),     # acc_buf
+            pltpu.VMEM((nchan, 2, cp // nchan, n), out_dtype),  # recv_buf
+            pltpu.SemaphoreType.DMA((nchan,)),                  # send_sem
+            pltpu.SemaphoreType.DMA((nchan, 2)),                # recv_sem
+            pltpu.SemaphoreType.REGULAR((nchan,)),              # cap_sem
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=9),
+        interpret=_interpret_params(),
+    )(x, w)
+
+
+# ---------------------------------------------------------------------------
+# block-geometry policy (the flash fallback shape: a plan, or None -> XLA)
+# ---------------------------------------------------------------------------
+
+def _pad_to(v: int, mult: int) -> int:
+    return -(-v // mult) * mult
+
+
+def agmm_plan(m: int, k: int, n: int, P: int, dtype,
+              bidirectional: bool) -> Optional[dict]:
+    """Geometry for the overlapped all-gather-matmul, or None when the
+    staged shard misses the scoped-VMEM budget (caller falls back to
+    the unfused XLA pair). Everything is VMEM-resident: the shard, the
+    weight block, the (P, m, n) output and the double-buffered recv
+    slots must fit together."""
+    if m < 1 or k < 1 or n < 1 or P < 1:
+        return None
+    isz = jnp.dtype(dtype).itemsize
+    sub = _sublane(dtype)
+    nchan = 2 if (bidirectional and P >= 4) else 1
+    mp = _pad_to(max(m, 1), sub * nchan)
+    kp = _pad_to(max(k, 1), _LANES)   # lane dim of x, sublane dim of w
+    np_ = _pad_to(max(n, 1), _LANES)
+    est = (mp * kp * isz            # x shard
+           + kp * np_ * isz         # w block
+           + P * mp * np_ * 4       # f32 output blocks
+           + 2 * mp * kp * isz)     # recv slots (nchan halves sum to mp)
+    if est > _VMEM_BUDGET:
+        return None
+    return {"mp": mp, "kp": kp, "np": np_, "nchan": nchan,
+            "bidirectional": nchan == 2, "vmem_bytes": est}
+
+
+def mmrs_plan(m: int, k: int, n: int, P: int, dtype,
+              bidirectional: bool) -> Optional[dict]:
+    """Geometry for the overlapped matmul-reduce-scatter, or None when
+    the staged operands miss the scoped-VMEM budget. ``m`` is the FULL
+    local row count (must divide by P; the wrapper checks)."""
+    if m < 1 or k < 1 or n < 1 or P < 1 or m % P:
+        return None
+    isz = jnp.dtype(dtype).itemsize
+    sub = _sublane(dtype)
+    nchan = 2 if (bidirectional and P >= 4) else 1
+    cp = _pad_to(max(m // P, 1), sub * nchan)
+    kp = _pad_to(max(k, 1), _LANES)   # lane dim of the chunk grid
+    np_ = _pad_to(max(n, 1), _LANES)
+    est = (P * cp * kp * isz        # x grouped by chunk
+           + kp * np_ * isz         # w block
+           + cp * np_ * 4           # f32 output chunk
+           + cp * np_ * 4           # acc
+           + 2 * cp * np_ * 4)      # recv slots
+    if est > _VMEM_BUDGET:
+        return None
+    return {"cp": cp, "kp": kp, "np": np_, "nchan": nchan,
+            "bidirectional": nchan == 2, "vmem_bytes": est}
+
+
+# ---------------------------------------------------------------------------
+# unfused XLA references (the fallback pair, and the parity oracle)
+# ---------------------------------------------------------------------------
+
+def xla_all_gather_matmul(x, w, axis: str = AXIS):
+    """The sequential pair: blocking all-gather, then the matmul."""
+    xg = lax.all_gather(x, axis, axis=0, tiled=True)
+    return jnp.dot(xg, w, preferred_element_type=jnp.float32)
+
+
+def xla_matmul_reduce_scatter(x, w, axis: str = AXIS):
+    """The sequential pair: full local matmul, then a blocking
+    psum_scatter over the row dimension."""
+    p = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    return lax.psum_scatter(p, axis, scatter_dimension=0, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# per-rank bodies (padding + realignment around the kernels)
+# ---------------------------------------------------------------------------
+
+def _kernels_available() -> bool:
+    """The ring kernels need a backend that can execute remote DMA: a
+    real TPU, an AOT TPU lowering (``pallas_ring.aot_lowering``), or a
+    jax whose TPU interpreter simulates it. On the generic-interpreter
+    rung (older jax) the overlapped path silently resolves to the
+    unfused XLA pair — the same observable math, no overlap."""
+    from .. import compat
+    return (jax.default_backend() == "tpu" or _pr._force_compile
+            or compat.HAS_TPU_INTERPRET)
+
+
+def _resolve(overlap: Optional[bool], nbytes: int, threshold: int) -> bool:
+    """overlap=None: session default AND the payload clears the tuned
+    size register; True/False: forced (the per-call tuning-register
+    override). Either way the kernels must be executable here."""
+    if overlap is None:
+        on = _OVERLAP_DEFAULT and nbytes >= threshold
+    else:
+        on = bool(overlap)
+    return on and _kernels_available()
+
+
+def agmm_engages(m: int, k: int, n: int, P: int, dtype,
+                 overlap: Optional[bool] = None,
+                 bidirectional: bool = True) -> bool:
+    """True when :func:`all_gather_matmul` would run the FUSED kernel
+    for these shapes under the given overlap mode — the session
+    registers, the VMEM plan, and kernel availability all resolved.
+    Lets callers that RESTRUCTURE around the fused kernels (the mlp's
+    sequence-sharded datapath) fall back to their own baseline instead
+    of a degraded unfused rendition of the restructured program."""
+    nbytes = m * k * jnp.dtype(dtype).itemsize
+    return (_resolve(overlap, nbytes, _AG_THRESHOLD)
+            and agmm_plan(m, k, n, P, dtype, bidirectional) is not None)
+
+
+def mmrs_engages(m: int, k: int, n: int, P: int, dtype,
+                 overlap: Optional[bool] = None,
+                 bidirectional: bool = True) -> bool:
+    """:func:`agmm_engages`' sibling for :func:`matmul_reduce_scatter`."""
+    if P < 1 or m % P:
+        return False
+    nbytes = (m // P) * n * 4
+    return (_resolve(overlap, nbytes, _RS_THRESHOLD)
+            and mmrs_plan(m, k, n, P, dtype, bidirectional) is not None)
+
+
+def all_gather_matmul_body(x, w, *, axis: str = AXIS,
+                           mesh_axes: Optional[Tuple[str, ...]] = None,
+                           overlap: Optional[bool] = None,
+                           bidirectional: bool = True):
+    """Per-rank body: x (m, k) row shard, w (k, n) local block ->
+    (P*m, n) f32 — ``all_gather(x, rows) @ w`` with per-hop overlap.
+    Falls back to the unfused XLA pair when overlap is off or the plan
+    misses the VMEM budget."""
+    m, k = x.shape
+    k2, n = w.shape
+    if k != k2:
+        raise ValueError(f"contraction mismatch: x {x.shape} vs w {w.shape}")
+    P = lax.axis_size(axis)
+    mesh_axes = tuple(mesh_axes) if mesh_axes else (axis,)
+    shard_bytes = m * k * jnp.dtype(x.dtype).itemsize
+    plan = agmm_plan(m, k, n, P, x.dtype, bidirectional) \
+        if _resolve(overlap, shard_bytes, _AG_THRESHOLD) else None
+    if P == 1:
+        return jnp.dot(x, w, preferred_element_type=jnp.float32)
+    if plan is None:
+        return xla_all_gather_matmul(x, w, axis)
+    mp, kp, np_ = plan["mp"], plan["kp"], plan["np"]
+    xp = jnp.zeros((mp, kp), x.dtype)
+    xp = lax.dynamic_update_slice(xp, x, (0, 0))
+    wp = jnp.zeros((kp, np_), w.dtype)
+    wp = lax.dynamic_update_slice(wp, w, (0, 0))
+    out = _agmm_call(xp, wp, P=P, axis=axis, mesh_axes=mesh_axes,
+                     out_dtype=jnp.float32,
+                     bidirectional=plan["bidirectional"])
+    return out[:, :m, :n].reshape(P * m, n)
+
+
+def matmul_reduce_scatter_body(x, w, *, axis: str = AXIS,
+                               mesh_axes: Optional[Tuple[str, ...]] = None,
+                               overlap: Optional[bool] = None,
+                               bidirectional: bool = True):
+    """Per-rank body: x (m, k) local rows, w (k, n) local block ->
+    (m/P, n) f32 — ``reduce_scatter(x @ w, rows)`` with the per-hop
+    partial computed while the accumulator is on the wire."""
+    m, k = x.shape
+    k2, n = w.shape
+    if k != k2:
+        raise ValueError(f"contraction mismatch: x {x.shape} vs w {w.shape}")
+    P = lax.axis_size(axis)
+    if m % P:
+        raise ValueError(f"rows {m} not divisible by world {P}")
+    mesh_axes = tuple(mesh_axes) if mesh_axes else (axis,)
+    if P == 1:
+        return jnp.dot(x, w, preferred_element_type=jnp.float32)
+    acc_bytes = (m // P) * n * 4   # the travelling f32 accumulator
+    plan = mmrs_plan(m, k, n, P, x.dtype, bidirectional) \
+        if _resolve(overlap, acc_bytes, _RS_THRESHOLD) else None
+    if plan is None:
+        return xla_matmul_reduce_scatter(x, w, axis)
+    cp, kp, np_ = plan["cp"], plan["kp"], plan["np"]
+    mc = m // P
+    # group rows by output chunk with per-chunk padding so the kernel
+    # indexes a uniform (P, cp, kp) grid
+    grid = jnp.zeros((P, cp, kp), x.dtype)
+    grid = lax.dynamic_update_slice(
+        grid, x.reshape(P, mc, k), (0, 0, 0))
+    wp = jnp.zeros((kp, np_), w.dtype)
+    wp = lax.dynamic_update_slice(wp, w, (0, 0))
+    out = _mmrs_call(grid, wp, P=P, axis=axis, mesh_axes=mesh_axes,
+                     out_dtype=jnp.float32,
+                     bidirectional=plan["bidirectional"])
+    fwd = [(i, (i + 1) % P) for i in range(P)]
+    if plan["bidirectional"]:
+        # channel 0 (top half rows) ended at chunk (pos+1), channel 1
+        # (bottom half) at chunk (pos-1): realign per half, one hop in
+        # each direction (the chunked-RS bidirectional realignment)
+        ch = cp // 2
+        bwd = [(i, (i - 1 + P) % P) for i in range(P)]
+        top = lax.ppermute(out[:ch], axis, fwd)
+        bot = lax.ppermute(out[ch:], axis, bwd)
+        out = jnp.concatenate([top, bot], axis=0)
+    else:
+        # rank pos holds folded chunk (pos+1)%P; one forward hop aligns
+        out = lax.ppermute(out, axis, fwd)
+    return out[:mc, :n]
+
+
+# ---------------------------------------------------------------------------
+# differentiable entry points (the collective-matmul duality as a VJP)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def all_gather_matmul(x, w, axis: str = AXIS,
+                      mesh_axes: Optional[Tuple[str, ...]] = None,
+                      overlap: Optional[bool] = None,
+                      bidirectional: bool = True):
+    """``all_gather(x, rows) @ w`` with per-hop comm/compute overlap.
+
+    x: (m, k) per-rank row shard of the LHS; w: (k, n) local weight
+    block (column-parallel). Returns (P*m, n) f32. ``overlap=None``
+    follows the session default (``ACCLConfig.cmatmul_overlap``);
+    False pins the unfused XLA pair. Differentiable: the backward runs
+    the dual ``matmul_reduce_scatter`` for dx (overlapped too)."""
+    return all_gather_matmul_body(x, w, axis=axis, mesh_axes=mesh_axes,
+                                  overlap=overlap,
+                                  bidirectional=bidirectional)
+
+
+def _agmm_fwd(x, w, axis, mesh_axes, overlap, bidirectional):
+    y = all_gather_matmul_body(x, w, axis=axis, mesh_axes=mesh_axes,
+                               overlap=overlap, bidirectional=bidirectional)
+    return y, (x, w)
+
+
+def _agmm_bwd(axis, mesh_axes, overlap, bidirectional, res, dy):
+    x, w = res
+    # dX_full = psum_p(dy_p w_pᵀ); our row shard of it is exactly the
+    # dual overlapped kernel
+    dx = matmul_reduce_scatter_body(
+        dy.astype(x.dtype), jnp.transpose(w).astype(x.dtype),
+        axis=axis, mesh_axes=mesh_axes, overlap=overlap,
+        bidirectional=bidirectional).astype(x.dtype)
+    xg = lax.all_gather(x, axis, axis=0, tiled=True)
+    dw = jnp.dot(jnp.transpose(xg), dy.astype(xg.dtype),
+                 preferred_element_type=jnp.float32).astype(w.dtype)
+    return dx, dw
+
+
+all_gather_matmul.defvjp(_agmm_fwd, _agmm_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def matmul_reduce_scatter(x, w, axis: str = AXIS,
+                          mesh_axes: Optional[Tuple[str, ...]] = None,
+                          overlap: Optional[bool] = None,
+                          bidirectional: bool = True):
+    """``reduce_scatter(x @ w, rows)`` with per-hop comm/compute
+    overlap. x: (m, k) local rows (m divisible by world); w: (k, n)
+    local block (row-parallel). Returns (m/P, n) f32. Differentiable:
+    dx runs the dual overlapped ``all_gather_matmul``."""
+    return matmul_reduce_scatter_body(x, w, axis=axis, mesh_axes=mesh_axes,
+                                      overlap=overlap,
+                                      bidirectional=bidirectional)
+
+
+def _mmrs_fwd(x, w, axis, mesh_axes, overlap, bidirectional):
+    y = matmul_reduce_scatter_body(x, w, axis=axis, mesh_axes=mesh_axes,
+                                   overlap=overlap,
+                                   bidirectional=bidirectional)
+    return y, (x, w)
+
+
+def _mmrs_bwd(axis, mesh_axes, overlap, bidirectional, res, dy):
+    x, w = res
+    dx = all_gather_matmul_body(
+        dy.astype(x.dtype), jnp.transpose(w).astype(x.dtype),
+        axis=axis, mesh_axes=mesh_axes, overlap=overlap,
+        bidirectional=bidirectional).astype(x.dtype)
+    dyg = lax.all_gather(dy, axis, axis=0, tiled=True)
+    dw = jnp.dot(jnp.transpose(x), dyg.astype(x.dtype),
+                 preferred_element_type=jnp.float32).astype(w.dtype)
+    return dx, dw
+
+
+matmul_reduce_scatter.defvjp(_mmrs_fwd, _mmrs_bwd)

@@ -66,6 +66,11 @@ _SUPPORTED = {
     operation.gather: {Algorithm.XLA, Algorithm.FLAT, Algorithm.RING,
                        Algorithm.PALLAS},
     operation.alltoall: {Algorithm.XLA, Algorithm.FLAT, Algorithm.PALLAS},
+    # the overlapped TP matmul family: one program is both the collective
+    # and the matmul, so only the fused Pallas kernels and the unfused
+    # XLA pair exist as families
+    operation.allgather_matmul: {Algorithm.XLA, Algorithm.PALLAS},
+    operation.matmul_reduce_scatter: {Algorithm.XLA, Algorithm.PALLAS},
 }
 
 
@@ -74,8 +79,19 @@ def supported(op: operation, algo: Algorithm) -> bool:
 
 
 #: (algorithm, op) pairs already warned about — the global-preference
-#: fallback is observable exactly once per pair (ADVICE r2 #5)
+#: fallback is observable exactly once per pair (ADVICE r2 #5). Scope
+#: is one SESSION, not the process: ACCL.initialize() clears it via
+#: :func:`reset_global_fallback_warnings`, so a fresh session (or a
+#: test constructing its own ACCL) observes its own misconfiguration
+#: again instead of inheriting a prior session's silence.
 _warned_global_fallback: set = set()
+
+
+def reset_global_fallback_warnings() -> None:
+    """Session hook: forget which (algorithm, op) fallbacks were already
+    warned about. Called by ``ACCL.initialize()`` — the module-global
+    set would otherwise leak across sessions and test runs."""
+    _warned_global_fallback.clear()
 
 
 def select(
@@ -140,6 +156,12 @@ def select(
             operation.scatter: cfg.scatter_pallas_threshold,
             operation.alltoall: cfg.alltoall_pallas_threshold,
             operation.reduce: cfg.reduce_pallas_threshold,
+            # overlap-vs-XLA thresholds for the collective-matmul family
+            # (allgather_matmul: LHS shard bytes; matmul_reduce_scatter:
+            # travelling f32 accumulator bytes) — autotuned by
+            # bench.autotune_collective_matmul
+            operation.allgather_matmul: cfg.ag_matmul_threshold,
+            operation.matmul_reduce_scatter: cfg.rs_matmul_threshold,
         }.get(op)
         if pallas_at is not None and nbytes >= pallas_at:
             return Algorithm.PALLAS
@@ -289,6 +311,45 @@ def build_allreduce(comm, func: reduceFunction, dt: dataType, algo: Algorithm,
             )
         return hierarchical.build_hier_allreduce(comm, rc[0], rc[1], func, dt, arith)
     return primitives.build_allreduce(comm, func, dt, arith)
+
+
+def build_allgather_matmul(comm, algo: Algorithm,
+                           bidirectional: bool = True) -> Callable:
+    """(world, m, k) sharded LHS row shards + (world, k, n) sharded
+    weight blocks -> (world, world*m, n): ``all_gather(x, rows) @ w``.
+    PALLAS runs the comm/compute-overlapped ring kernel
+    (ops/collective_matmul.py); anything else the unfused XLA pair."""
+    from ..ops import collective_matmul as cm
+    if algo == Algorithm.PALLAS:
+        pallas_ring._check_multiprocess(comm)
+
+    def body(x, w):
+        y = cm.all_gather_matmul_body(
+            x[0], w[0], axis=primitives.AXIS,
+            overlap=(algo == Algorithm.PALLAS),
+            bidirectional=bidirectional)
+        return y[None]
+
+    return primitives._smap(comm, body, 2)
+
+
+def build_matmul_reduce_scatter(comm, algo: Algorithm,
+                                bidirectional: bool = True) -> Callable:
+    """(world, m, k) sharded local rows + (world, k, n) sharded weight
+    blocks -> (world, m/world, n): ``reduce_scatter(x @ w, rows)`` with
+    the per-hop partial folded into the ring under PALLAS."""
+    from ..ops import collective_matmul as cm
+    if algo == Algorithm.PALLAS:
+        pallas_ring._check_multiprocess(comm)
+
+    def body(x, w):
+        y = cm.matmul_reduce_scatter_body(
+            x[0], w[0], axis=primitives.AXIS,
+            overlap=(algo == Algorithm.PALLAS),
+            bidirectional=bidirectional)
+        return y[None]
+
+    return primitives._smap(comm, body, 2)
 
 
 def build_allgather(comm, algo: Algorithm,

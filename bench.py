@@ -104,7 +104,69 @@ def _run_stage(name: str, fn, retries: int = 1):
                           "retried": attempt}
 
 
-def main() -> int:
+def _parse_args(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--lanes", default=os.environ.get("ACCL_BENCH_LANES", ""),
+        help="comma-separated lane filter (e.g. 'cmatmul_ag' or "
+             "'flash_bwd,sweep') — run ONLY these stages, for on-silicon "
+             "A/Bs. 'sweep' names the headline sweep; empty = everything")
+    ap.add_argument(
+        "--probe-timeout", type=float,
+        default=float(os.environ.get("ACCL_BENCH_PROBE_S", "75")),
+        help="TPU-backend preflight deadline in seconds (0 disables)")
+    return ap.parse_args(argv)
+
+
+def _lane_selected(lanes: list, name: str) -> bool:
+    return not lanes or any(name.startswith(pat) or pat.startswith(name)
+                            for pat in lanes)
+
+
+def _preflight_backend(deadline_s: float):
+    """Bounded TPU-backend probe (the conftest AOT-probe pattern): on a
+    rig whose TPU tunnel is dead, the FIRST jax.devices() call can hang
+    for tens of minutes (BENCH_r05 lost 1502 s to exactly this). The
+    probe initializes the backend in a SUBPROCESS under a deadline, so a
+    sick tunnel costs seconds and emits the bench_crashed stub instead
+    of eating the round's budget. A cpu-pinned run skips the probe —
+    nothing to hang."""
+    import subprocess
+
+    if deadline_s <= 0 or os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return None
+    code = ("import jax; d = jax.devices(); "
+            "print('PROBE_OK', jax.default_backend(), len(d))")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           timeout=deadline_s, capture_output=True,
+                           text=True, env=dict(os.environ))
+        if "PROBE_OK" in r.stdout:
+            _log(f"preflight: {r.stdout.strip().splitlines()[-1]}")
+            return None
+        return (f"backend probe failed (rc={r.returncode}): "
+                f"{(r.stderr or r.stdout)[-400:]}")
+    except subprocess.TimeoutExpired:
+        return (f"backend probe exceeded {deadline_s:.0f}s deadline "
+                "(dead TPU tunnel?)")
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv)
+    lanes_filter = [s.strip() for s in args.lanes.split(",") if s.strip()]
+
+    probe_err = _preflight_backend(args.probe_timeout)
+    if probe_err:
+        _log(f"preflight: FAILED — {probe_err}")
+        print(json.dumps({"metric": "bench_crashed",
+                          "value": 0.0, "unit": "none",
+                          "vs_baseline": 0.0,
+                          "error": f"preflight: {probe_err}",
+                          "elapsed_s": round(_elapsed(), 1)}))
+        return 1
+
     import accl_tpu
     from accl_tpu import Algorithm
     from accl_tpu.bench import harness
@@ -160,12 +222,17 @@ def main() -> int:
                  "floored": r.floored,
                  "GBps": round(r.algbw_GBps, 3)} for r in rows]
 
-    sweep, err = _run_stage("sweep_fused",
-                            lambda: series("fused" if on_tpu else "block"))
-    if err:
-        errors.append(err)
+    run_sweep_stage = _lane_selected(lanes_filter, "sweep")
+    sweep = None
+    if run_sweep_stage:
+        sweep, err = _run_stage("sweep_fused",
+                                lambda: series("fused" if on_tpu else "block"))
+        if err:
+            errors.append(err)
+    else:
+        _log("sweep: skipped by --lanes filter")
     sweep_chain = None
-    if on_tpu:
+    if on_tpu and run_sweep_stage:
         sweep_chain, err = _run_stage("sweep_chain", lambda: series("chain"))
         if err:
             errors.append(err)
@@ -212,6 +279,34 @@ def main() -> int:
         out["value_chain"] = round(peak_chain, 3)
         out["sweep_chain"] = sweep_chain
 
+    if world > 1:
+        # multi-chip: the collective-matmul overlap A/B lanes (the
+        # fused-vs-(matmul + collective) efficiency beside resolved
+        # flags; on a single chip the ring is degenerate — stubbed)
+        from accl_tpu.bench import lanes as _lanes
+
+        wanted = [name for name in ("cmatmul_ag", "cmatmul_rs")
+                  if _lane_selected(lanes_filter, name)]
+        cm_rows = []
+        if wanted and _elapsed() > _BUDGET_S:
+            cm_rows = [{"metric": name, "skipped": True,
+                        "reason": f"budget {_BUDGET_S}s exceeded"}
+                       for name in wanted]
+        elif wanted:
+            # measure the ring mode the session actually dispatches
+            bidir = acc.config.bidirectional_rings
+            r, err = _run_stage("cmatmul",
+                                lambda: _lanes.bench_cmatmul(
+                                    comm, ops=wanted, bidirectional=bidir))
+            if err:
+                errors.append(err)
+                cm_rows = [{"metric": name, "error": err["error"]}
+                           for name in wanted]
+            else:
+                cm_rows = r
+        if cm_rows:
+            out["lanes"] = cm_rows
+
     if on_tpu and world == 1:
         # single-chip mode only: the roofline model below is the COMBINE
         # datapath's (3x payload vs HBM); a multi-chip headline is ring
@@ -246,6 +341,9 @@ def main() -> int:
                  lanes.small_op_latency_distribution),
             ]
             for name, fn in stages:
+                if not _lane_selected(lanes_filter, name):
+                    _log(f"{name}: skipped by --lanes filter")
+                    continue
                 if _elapsed() > _BUDGET_S:
                     _log(f"{name}: SKIPPED — budget {_BUDGET_S}s exceeded")
                     extra.append({"metric": name, "skipped": True,

@@ -244,6 +244,94 @@ def test_dcn_hier_needs_host_shape(accl):
     assert got != Algorithm.HIERARCHICAL
 
 
+def test_select_threshold_exact_boundaries(accl):
+    """The tuning-register semantics are INCLUSIVE at the threshold byte
+    (nbytes >= threshold engages the heavier family) — pinned at the
+    exact edge for every allreduce register so an off-by-one in a
+    refactor (or a tuned config written by autotune) is visible."""
+    cfg = accl.config
+    comm = accl.global_comm()
+    sel = lambda nb, c=cfg: algorithms.select(operation.allreduce, nb, comm, c)
+    # ring edge
+    assert sel(cfg.ring_threshold - 1) == Algorithm.XLA
+    assert sel(cfg.ring_threshold) == Algorithm.RING
+    # hier edge (composite world, factor2d shape exists)
+    assert sel(cfg.hier_threshold - 1) == Algorithm.RING
+    assert sel(cfg.hier_threshold) == Algorithm.HIERARCHICAL
+
+
+def test_select_dcn_hier_threshold_boundary(accl, monkeypatch):
+    """dcn_hier_threshold is inclusive too — host-aligned DCN meshes
+    engage HIERARCHICAL at exactly the tuned byte, one byte below rides
+    the generic thresholds."""
+    from accl_tpu.config import TransportBackend
+    comm = accl.global_comm()
+    monkeypatch.setattr(type(comm), "hosts_shape", lambda self: (2, 4))
+    dcn = accl.config.replace(transport=TransportBackend.DCN)
+    got = algorithms.select(
+        operation.allreduce, dcn.dcn_hier_threshold, comm, dcn)
+    assert got == Algorithm.HIERARCHICAL
+    got = algorithms.select(
+        operation.allreduce, dcn.dcn_hier_threshold - 1, comm, dcn)
+    assert got != Algorithm.HIERARCHICAL
+
+
+def test_select_dcn_non_host_aligned_falls_through(accl):
+    """The DCN fallback path END state: with no host-aligned shape the
+    early engage must not fire at ANY size, and the payload instead
+    resolves through the ICI-style ladder (ring at/above its edge)."""
+    from accl_tpu.config import TransportBackend
+    comm = accl.global_comm()
+    assert comm.hosts_shape() is None
+    dcn = accl.config.replace(transport=TransportBackend.DCN)
+    assert algorithms.select(
+        operation.allreduce, dcn.ring_threshold, comm, dcn) == Algorithm.RING
+    assert algorithms.select(
+        operation.allreduce, dcn.ring_threshold - 1, comm, dcn) \
+        == Algorithm.XLA
+
+
+def test_select_overlap_threshold_boundaries(accl):
+    """The new collective-matmul overlap registers follow the same
+    inclusive-edge discipline on ICI (per-op bytes; see config)."""
+    from accl_tpu.config import TransportBackend
+    comm = accl.global_comm()
+    ici = accl.config.replace(transport=TransportBackend.ICI)
+    for op, th in ((operation.allgather_matmul, ici.ag_matmul_threshold),
+                   (operation.matmul_reduce_scatter,
+                    ici.rs_matmul_threshold)):
+        assert algorithms.select(op, th, comm, ici) == Algorithm.PALLAS
+        assert algorithms.select(op, th - 1, comm, ici) == Algorithm.XLA
+
+
+def test_warned_fallback_resets_per_session(accl, caplog):
+    """Satellite regression (ISSUE r7): the once-per-pair fallback
+    warning set is module-global — a NEW session must observe its own
+    misconfiguration again, not inherit this session's silence."""
+    import logging
+    import accl_tpu
+    import jax as _jax
+    cfg = accl.config.replace(algorithm=Algorithm.TREE)
+    comm = accl.global_comm()
+    algorithms._warned_global_fallback.discard(
+        (Algorithm.TREE, operation.alltoall))
+    with caplog.at_level(logging.WARNING, logger="accl_tpu.algorithms"):
+        algorithms.select(operation.alltoall, 1024, comm, cfg)
+    assert (Algorithm.TREE, operation.alltoall) \
+        in algorithms._warned_global_fallback
+    # a fresh session clears the set via initialize()
+    inst = accl_tpu.ACCL(devices=_jax.devices()[:1])
+    try:
+        assert algorithms._warned_global_fallback == set()
+        with caplog.at_level(logging.WARNING,
+                             logger="accl_tpu.algorithms"):
+            algorithms.select(operation.alltoall, 1024, comm, cfg)
+        assert sum("unsupported for alltoall" in r.message
+                   for r in caplog.records) == 2  # warned AGAIN
+    finally:
+        inst.deinit()
+
+
 def test_global_algorithm_fallback_warns_once(accl, caplog):
     """ADVICE r2 #5: a session-wide cfg.algorithm an op cannot honor falls
     back to AUTO with a one-time observable warning."""

@@ -334,3 +334,56 @@ def test_autotune_alltoall_pallas_crossover_on_ici(accl, monkeypatch):
             operation.alltoall, 2 ** 9 * 4, comm, tuned) == Algorithm.PALLAS
     finally:
         accl.config = orig
+
+
+def test_autotune_collective_matmul_crossover_on_ici(accl, monkeypatch):
+    """The overlap crossovers land in ag/rs_matmul_threshold on ICI —
+    and the sweep NEVER includes sizes whose overlap plan misses the
+    VMEM budget (there the 'PALLAS' builder silently runs the XLA
+    fallback, and the crossover would time XLA against itself and
+    write DISABLED on a healthy mesh — the review-r7 finding)."""
+    from accl_tpu.config import TransportBackend
+    from accl_tpu.ops import collective_matmul as cm
+
+    seen = {}
+
+    def fake_measure(comm, ms, algos, k=512, n=512, dt=None, reps=1,
+                     bidirectional=True, ops=("agmm", "mmrs")):
+        seen[ops[0]] = list(ms)
+        # every requested size must have a live overlap plan
+        for m in ms:
+            if "agmm" in ops:
+                assert cm.agmm_plan(m, k, n, comm.world_size,
+                                    np.float32, bidirectional) is not None
+            if "mmrs" in ops:
+                assert cm.mmrs_plan(comm.world_size * m, k, n,
+                                    comm.world_size, np.float32,
+                                    bidirectional) is not None
+        return {op: {Algorithm.XLA: [1.0] * len(ms),
+                     Algorithm.PALLAS: [0.5] * len(ms)} for op in ops}
+
+    monkeypatch.setattr(autotune, "measure_collective_matmul", fake_measure)
+    orig = accl.config
+    try:
+        accl.config = accl.config.replace(transport=TransportBackend.ICI)
+        # pows include 2^13 = 8192 rows: agmm plan needs ~P*m*n*4 VMEM
+        # for the output panel alone -> far over budget, must be dropped
+        tuned = autotune.autotune_collective_matmul(accl, pows=(7, 13),
+                                                    reps=1)
+        assert seen["agmm"] == [128] and seen["mmrs"] == [128]
+        assert tuned.ag_matmul_threshold == 128 * 512 * 4
+        assert tuned.rs_matmul_threshold == 128 * 512 * 4
+        comm = accl.global_comm()
+        assert algorithms.select(operation.allgather_matmul,
+                                 tuned.ag_matmul_threshold, comm,
+                                 tuned) == Algorithm.PALLAS
+    finally:
+        accl.config = orig
+
+
+def test_autotune_collective_matmul_noop_off_ici(accl):
+    """SIM/DCN transports pass the config through untouched (the kernels
+    would measure the simulator)."""
+    tuned = autotune.autotune_collective_matmul(accl, accl.config)
+    assert tuned.ag_matmul_threshold == accl.config.ag_matmul_threshold
+    assert tuned.rs_matmul_threshold == accl.config.rs_matmul_threshold

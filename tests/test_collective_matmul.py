@@ -1,0 +1,454 @@
+"""Collective matmul (ops/collective_matmul.py): comm/compute-overlapped
+all-gather x matmul and matmul x reduce-scatter.
+
+Parity is BIT-exact fp32 against the unfused XLA pair: operands are
+integer-valued floats (every product and partial sum is exactly
+representable), so any reassociation the ring schedule introduces cannot
+hide behind tolerance. Kernel suites need simulated remote DMA
+(``requires_interpret_rdma``); the policy/fallback/model tests run on
+every rung — the overlapped entry points resolve to the unfused pair
+where kernels cannot run, same math by construction.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accl_tpu import Algorithm
+from accl_tpu.communicator import Communicator
+from accl_tpu.ops import collective_matmul as cm
+from accl_tpu.parallel import algorithms, pallas_ring
+from conftest import requires_interpret_rdma
+
+WORLD = 8
+
+
+def _ints(rng, shape, lo=-4, hi=5):
+    """Integer-valued fp32: exact under any summation order."""
+    return rng.integers(lo, hi, shape).astype(np.float32)
+
+
+def _comm(W):
+    return Communicator(jax.devices()[:W])
+
+
+def _put(comm, arr):
+    return jax.device_put(arr, comm.sharding())
+
+
+def _run_agmm(comm, x, w, algo, bidirectional):
+    prog = algorithms.build_allgather_matmul(
+        comm, algo, bidirectional=bidirectional)
+    return np.asarray(prog(_put(comm, x), _put(comm, w)))
+
+
+def _run_mmrs(comm, x, w, algo, bidirectional):
+    prog = algorithms.build_matmul_reduce_scatter(
+        comm, algo, bidirectional=bidirectional)
+    return np.asarray(prog(_put(comm, x), _put(comm, w)))
+
+
+# ---------------------------------------------------------------------------
+# interpreter parity: fused kernels vs the unfused XLA pair, bit-exact
+# ---------------------------------------------------------------------------
+
+@requires_interpret_rdma
+@pytest.mark.parametrize("W", [2, 4, 8])
+@pytest.mark.parametrize("shape", [(16, 128, 128),    # dense, tile-aligned
+                                   (12, 72, 40)])     # uneven-divisible
+def test_agmm_parity_bit_exact(accl, rng, W, shape):
+    m, k, n = shape
+    x = _ints(rng, (W, m, k))
+    w = _ints(rng, (W, k, n))
+    comm = _comm(W)
+    fused = _run_agmm(comm, x, w, Algorithm.PALLAS, bidirectional=False)
+    ref = _run_agmm(comm, x, w, Algorithm.XLA, bidirectional=False)
+    np.testing.assert_array_equal(fused, ref)
+    # and vs host math: rank r's output is all rows times ITS w block
+    gathered = x.reshape(W * m, k)
+    for r in range(W):
+        np.testing.assert_array_equal(fused[r], gathered @ w[r])
+
+
+@requires_interpret_rdma
+@pytest.mark.parametrize("W", [4, 8])
+@pytest.mark.parametrize("shape", [(16, 128, 128), (12, 72, 40)])
+def test_agmm_parity_bidirectional(accl, rng, W, shape):
+    """The counter-rotating row-half channels (P >= 4) are output-
+    identical to the unidirectional ring and the XLA pair."""
+    m, k, n = shape
+    x = _ints(rng, (W, m, k))
+    w = _ints(rng, (W, k, n))
+    comm = _comm(W)
+    fused = _run_agmm(comm, x, w, Algorithm.PALLAS, bidirectional=True)
+    ref = _run_agmm(comm, x, w, Algorithm.XLA, bidirectional=True)
+    np.testing.assert_array_equal(fused, ref)
+
+
+@requires_interpret_rdma
+@pytest.mark.parametrize("W", [2, 4, 8])
+@pytest.mark.parametrize("shape", [(16, 128, 128), (12, 72, 40)])
+def test_mmrs_parity_bit_exact(accl, rng, W, shape):
+    m, k, n = shape
+    x = _ints(rng, (W, W * m, k), lo=-3, hi=4)
+    w = _ints(rng, (W, k, n), lo=-3, hi=4)
+    comm = _comm(W)
+    fused = _run_mmrs(comm, x, w, Algorithm.PALLAS, bidirectional=False)
+    # integer-valued operands: the ring's fold order and psum's order
+    # agree exactly
+    ref = _run_mmrs(comm, x, w, Algorithm.XLA, bidirectional=False)
+    np.testing.assert_array_equal(fused, ref)
+    host = np.einsum("rmk,rkn->rmn", x.astype(np.float64),
+                     w.astype(np.float64)).sum(0)
+    for r in range(W):
+        np.testing.assert_array_equal(
+            fused[r], host[r * m:(r + 1) * m].astype(np.float32))
+
+
+@requires_interpret_rdma
+@pytest.mark.parametrize("W", [4, 8])
+@pytest.mark.parametrize("shape", [(16, 128, 128), (12, 72, 40)])
+def test_mmrs_parity_bidirectional(accl, rng, W, shape):
+    m, k, n = shape
+    x = _ints(rng, (W, W * m, k), lo=-3, hi=4)
+    w = _ints(rng, (W, k, n), lo=-3, hi=4)
+    comm = _comm(W)
+    fused = _run_mmrs(comm, x, w, Algorithm.PALLAS, bidirectional=True)
+    ref = _run_mmrs(comm, x, w, Algorithm.XLA, bidirectional=True)
+    np.testing.assert_array_equal(fused, ref)
+
+
+@requires_interpret_rdma
+def test_cmatmul_race_free(accl, rng, monkeypatch):
+    """Both ring kernels, uni- and bidirectional, under the interpret-mode
+    race detector: the double-buffer credit protocol (grants == gates)
+    must hold with the MXU folded into the schedule."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    monkeypatch.setattr(
+        pallas_ring, "_interpret_params",
+        lambda: pltpu.InterpretParams(detect_races=True))
+    comm = _comm(WORLD)
+    m, k, n = 16, 128, 128
+    x_ag = _ints(rng, (WORLD, m, k))
+    x_rs = _ints(rng, (WORLD, WORLD * m, k), lo=-3, hi=4)
+    w = _ints(rng, (WORLD, k, n), lo=-3, hi=4)
+    for bidir in (False, True):
+        fused = _run_agmm(comm, x_ag, w, Algorithm.PALLAS, bidir)
+        ref = _run_agmm(comm, x_ag, w, Algorithm.XLA, bidir)
+        np.testing.assert_array_equal(fused, ref)
+        fused = _run_mmrs(comm, x_rs, w, Algorithm.PALLAS, bidir)
+        ref = _run_mmrs(comm, x_rs, w, Algorithm.XLA, bidir)
+        np.testing.assert_array_equal(fused, ref)
+
+
+@requires_interpret_rdma
+def test_cmatmul_grads_through_kernels(accl, rng):
+    """The custom VJPs (each kernel's backward is the other kernel) match
+    the grads of the unfused pair — same integer-exactness trick."""
+    from accl_tpu.parallel.primitives import AXIS, _smap
+
+    comm = _comm(4)
+    W, m, k, n = 4, 8, 64, 32
+    x = _ints(rng, (W, m, k), lo=-2, hi=3)
+    w = _ints(rng, (W, k, n), lo=-2, hi=3)
+
+    def make(overlap):
+        def body(xs, ws):
+            def loss(ws_):
+                y = cm.all_gather_matmul(xs[0], ws_, AXIS, None, overlap)
+                z = cm.matmul_reduce_scatter(
+                    y.astype(xs.dtype), jnp.transpose(ws_), AXIS, None,
+                    overlap)
+                return jnp.sum(z)
+
+            return jax.grad(loss)(ws[0])[None]
+
+        return _smap(comm, body, 2)
+
+    g_fused = np.asarray(make(True)(_put(comm, x), _put(comm, w)))
+    g_ref = np.asarray(make(False)(_put(comm, x), _put(comm, w)))
+    np.testing.assert_array_equal(g_fused, g_ref)
+
+
+# ---------------------------------------------------------------------------
+# block-geometry policy (every rung)
+# ---------------------------------------------------------------------------
+
+def test_plan_geometry_pins():
+    """The plan is the kernel's geometry contract — pin it so a silent
+    padding change shows up as a diff, not a VMEM surprise on silicon."""
+    p = cm.agmm_plan(12, 72, 40, 4, jnp.float32, bidirectional=False)
+    assert (p["mp"], p["kp"], p["np"], p["nchan"]) == (16, 128, 128, 1)
+    p = cm.agmm_plan(12, 72, 40, 4, jnp.float32, bidirectional=True)
+    assert (p["mp"], p["nchan"]) == (16, 2)  # rows pad to 2x sublane
+    p = cm.mmrs_plan(48, 72, 40, 4, jnp.float32, bidirectional=True)
+    assert (p["cp"], p["kp"], p["np"], p["nchan"]) == (16, 128, 128, 2)
+    # bf16 staging: 16-row sublane tiles
+    p = cm.agmm_plan(8, 128, 128, 4, jnp.bfloat16, bidirectional=False)
+    assert p["mp"] == 16
+
+
+def test_plan_vmem_budget_fallback():
+    """Geometry that misses the scoped-VMEM budget returns None — the
+    unfused-XLA fallback trigger (the flash bwd policy's shape)."""
+    assert cm.agmm_plan(4096, 4096, 4096, 8, jnp.float32, False) is None
+    assert cm.mmrs_plan(8 * 4096, 4096, 4096, 8, jnp.float32, False) is None
+    # m not divisible by world is never a kernel plan
+    assert cm.mmrs_plan(13, 64, 64, 4, jnp.float32, False) is None
+    ok = cm.agmm_plan(64, 256, 256, 8, jnp.float32, False)
+    assert ok is not None and ok["vmem_bytes"] <= cm._VMEM_BUDGET
+
+
+def test_overlap_off_never_traces_kernels(accl, monkeypatch):
+    """overlap=False (per call) and oversized plans pin the unfused XLA
+    pair — no pallas_call may appear in the traced program. (Kernel
+    availability is forced so the assertion bites on every rung.)"""
+    from accl_tpu.compat import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    monkeypatch.setattr(cm, "_kernels_available", lambda: True)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("accl",))
+
+    def trace(m, k, n, overlap):
+        def body(xs, ws):
+            return cm.all_gather_matmul_body(xs, ws, axis="accl",
+                                             overlap=overlap)
+
+        return str(jax.make_jaxpr(shard_map(
+            body, mesh=mesh, in_specs=(P("accl"), P(None)),
+            out_specs=P("accl"), check_vma=False))(
+            jnp.zeros((4 * m, k), jnp.float32),
+            jnp.zeros((k, n), jnp.float32)))
+
+    assert "pallas_call" not in trace(16, 64, 64, overlap=False)
+    # oversized: overlap requested but the plan misses the budget
+    assert "pallas_call" not in trace(4096, 4096, 4096, overlap=True)
+
+
+def test_session_config_write_through(accl):
+    """ACCLConfig.cmatmul_overlap lands in the kernel module on every
+    config assignment (the flash_bwd write-through discipline)."""
+    saved = accl.config
+    try:
+        accl.config = accl.config.replace(cmatmul_overlap=False)
+        assert cm.get_overlap_enabled() is False
+        accl.config = accl.config.replace(cmatmul_overlap=True)
+        assert cm.get_overlap_enabled() is True
+    finally:
+        accl.config = saved
+
+
+def test_body_rejects_bad_shapes(accl):
+    from accl_tpu.compat import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("accl",))
+
+    def run(body, xshape, wshape):
+        f = shard_map(body, mesh=mesh, in_specs=(P("accl"), P(None)),
+                      out_specs=P("accl"), check_vma=False)
+        return jax.make_jaxpr(f)(jnp.zeros(xshape, jnp.float32),
+                                 jnp.zeros(wshape, jnp.float32))
+
+    with pytest.raises(ValueError, match="contraction"):
+        run(lambda x, w: cm.all_gather_matmul_body(x, w, axis="accl"),
+            (4 * 8, 16), (32, 8))
+    with pytest.raises(ValueError, match="divisible"):
+        run(lambda x, w: cm.matmul_reduce_scatter_body(x, w, axis="accl"),
+            (4 * 13, 16), (16, 8))
+
+
+# ---------------------------------------------------------------------------
+# the duals agree on every rung (XLA fallback path): structure A/B
+# ---------------------------------------------------------------------------
+
+def test_fallback_grads_match_plain_math(accl, rng):
+    """grad through the custom VJPs == grad of the plain gathered math,
+    on whatever rung this is (kernels or fallback)."""
+    from accl_tpu.parallel.primitives import AXIS, _smap
+    from jax import lax
+
+    comm = _comm(4)
+    W, m, k, n = 4, 8, 32, 16
+    x = _ints(rng, (W, m, k), lo=-2, hi=3)
+    w = _ints(rng, (W, k, n), lo=-2, hi=3)
+
+    def body_vjp(xs, ws):
+        def loss(ws_):
+            return jnp.sum(cm.all_gather_matmul(xs[0], ws_, AXIS))
+
+        return jax.grad(loss)(ws[0])[None]
+
+    def body_plain(xs, ws):
+        def loss(ws_):
+            xg = lax.all_gather(xs[0], AXIS, axis=0, tiled=True)
+            return jnp.sum(jnp.dot(xg, ws_,
+                                   preferred_element_type=jnp.float32))
+
+        return jax.grad(loss)(ws[0])[None]
+
+    g1 = np.asarray(_smap(comm, body_vjp, 2)(_put(comm, x), _put(comm, w)))
+    g2 = np.asarray(_smap(comm, body_plain, 2)(_put(comm, x), _put(comm, w)))
+    np.testing.assert_array_equal(g1, g2)
+
+
+# ---------------------------------------------------------------------------
+# the flagship workload: mlp loss trajectories, overlap on vs off
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dp,tp", [(1, 4), (2, 4)])
+def test_mlp_loss_trajectory_overlap_ab(rng, dp, tp):
+    """The train step produces identical loss trajectories (fp tolerance)
+    with the overlapped TP datapath on vs off — selectable per call."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from accl_tpu.models import mlp
+
+    d, h, b = 16, 64, 8
+    mesh = mlp.make_mesh(jax.devices()[: dp * tp], dp=dp, tp=tp)
+    params = mlp.shard_params(
+        mlp.init_params(jax.random.PRNGKey(1), d, h), mesh)
+    sh = NamedSharding(mesh, P(mlp.DP_AXIS, None))
+    x = jax.device_put(
+        rng.standard_normal((dp * b, d)).astype(np.float32), sh)
+    t = jax.device_put(
+        rng.standard_normal((dp * b, d)).astype(np.float32), sh)
+    traj = {}
+    for ov in (False, True):
+        p = params
+        step = mlp.make_train_step(mesh, lr=5e-2, overlap=ov)
+        traj[ov] = []
+        for _ in range(4):
+            p, loss = step(p, x, t)
+            traj[ov].append(float(loss))
+    np.testing.assert_allclose(traj[True], traj[False],
+                               rtol=1e-5, atol=1e-7)
+    assert traj[True][-1] < traj[True][0]  # it actually trains
+
+
+def test_mlp_session_selectable(rng):
+    """overlap=None follows ACCLConfig.cmatmul_overlap (via the
+    kernel-module engage checks) at build time; the session switch off
+    disengages both stages regardless of shapes."""
+    from accl_tpu.models import mlp
+
+    mesh = mlp.make_mesh(jax.devices()[:4], dp=1, tp=4)
+    saved = cm.get_overlap_enabled()
+    saved_th = cm.get_overlap_thresholds()
+    try:
+        cm.set_overlap_thresholds(0, 0)
+        cm.set_overlap_enabled(False)
+        assert cm.agmm_engages(8, 32, 32, 4, jnp.float32, None) is False
+        cm.set_overlap_enabled(True)
+        assert cm.agmm_engages(8, 32, 32, 4, jnp.float32, None) \
+            == cm._kernels_available()
+        assert cm.agmm_engages(8, 32, 32, 4, jnp.float32, False) is False
+    finally:
+        cm.set_overlap_enabled(saved)
+        cm.set_overlap_thresholds(*saved_th)
+    # and make_forward under each mode still computes the same values
+    d, h, b = 8, 32, 8
+    params = mlp.shard_params(
+        mlp.init_params(jax.random.PRNGKey(0), d, h), mesh)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    x = jax.device_put(rng.standard_normal((b, d)).astype(np.float32),
+                       NamedSharding(mesh, P(mlp.DP_AXIS, None)))
+    y0 = np.asarray(mlp.make_forward(mesh, overlap=False)(params, x))
+    y1 = np.asarray(mlp.make_forward(mesh, overlap=True)(params, x))
+    np.testing.assert_allclose(y1, y0, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# dispatch plumbing
+# ---------------------------------------------------------------------------
+
+def test_select_new_operations(accl):
+    """Dispatch plumbing for the overlap ops (the exact threshold-edge
+    bytes are pinned in test_algorithms.py with the other registers):
+    off-ICI never auto-selects the kernels, explicit requests win, and
+    unsupported families are rejected."""
+    from accl_tpu.config import TransportBackend
+    from accl_tpu.constants import operation
+
+    comm = accl.global_comm()
+    ici = accl.config.replace(transport=TransportBackend.ICI)
+    for op, th in ((operation.allgather_matmul, ici.ag_matmul_threshold),
+                   (operation.matmul_reduce_scatter,
+                    ici.rs_matmul_threshold)):
+        assert algorithms.select(op, th, comm, accl.config) == Algorithm.XLA
+        # explicit request wins; unsupported families are rejected
+        assert algorithms.select(op, 0, comm, ici,
+                                 Algorithm.PALLAS) == Algorithm.PALLAS
+        with pytest.raises(ValueError):
+            algorithms.select(op, th, comm, ici, Algorithm.RING)
+
+
+def test_threshold_write_through_gates_session_default(accl, monkeypatch):
+    """The tuned size registers reach the DEVICE-API path: at
+    overlap=None the kernel module's write-through thresholds decide
+    fused-vs-XLA (DISABLED pins the pair), while an explicit
+    overlap=True bypasses them (the per-call force)."""
+    from accl_tpu.bench.autotune import DISABLED
+    from accl_tpu.compat import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    monkeypatch.setattr(cm, "_kernels_available", lambda: True)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("accl",))
+    m, k, n = 16, 64, 64
+
+    def trace(overlap):
+        def body(xs, ws):
+            return cm.all_gather_matmul_body(xs, ws, axis="accl",
+                                             overlap=overlap)
+
+        return str(jax.make_jaxpr(shard_map(
+            body, mesh=mesh, in_specs=(P("accl"), P(None)),
+            out_specs=P("accl"), check_vma=False))(
+            jnp.zeros((4 * m, k), jnp.float32),
+            jnp.zeros((k, n), jnp.float32)))
+
+    saved = accl.config
+    try:
+        shard_bytes = m * k * 4
+        # register above the payload -> session default resolves to XLA
+        accl.config = accl.config.replace(
+            ag_matmul_threshold=shard_bytes + 1)
+        assert cm.get_overlap_thresholds()[0] == shard_bytes + 1
+        assert "pallas_call" not in trace(overlap=None)
+        assert "pallas_call" in trace(overlap=True)   # per-call force
+        # at/below the payload -> fused engages by default
+        accl.config = accl.config.replace(ag_matmul_threshold=shard_bytes)
+        assert "pallas_call" in trace(overlap=None)
+        # the autotune DISABLED sentinel turns overlap off by default
+        accl.config = accl.config.replace(ag_matmul_threshold=DISABLED)
+        assert "pallas_call" not in trace(overlap=None)
+    finally:
+        accl.config = saved
+
+
+def test_device_api_entry_points(accl, rng):
+    """device_api.all_gather_matmul / matmul_reduce_scatter compose in a
+    shard_map body (the in-kernel collective discipline)."""
+    from accl_tpu import device_api as dapi
+    from accl_tpu.parallel.primitives import _smap
+
+    comm = _comm(4)
+    W, m, k, n = 4, 8, 32, 16
+    x = _ints(rng, (W, m, k))
+    w = _ints(rng, (W, k, n))
+
+    def body(xs, ws):
+        y = dapi.all_gather_matmul(xs[0], ws[0])
+        z = dapi.matmul_reduce_scatter(y.astype(xs.dtype),
+                                       jnp.transpose(ws[0]))
+        return z[None]
+
+    out = np.asarray(_smap(comm, body, 2)(_put(comm, x), _put(comm, w)))
+    xg = x.reshape(W * m, k).astype(np.float64)
+    full = np.stack([xg @ w[r] for r in range(W)])          # (W, W*m, n)
+    z_full = (full @ np.transpose(w, (0, 2, 1)).astype(np.float64)).sum(0)
+    for r in range(W):
+        np.testing.assert_array_equal(
+            out[r], z_full[r * m:(r + 1) * m].astype(np.float32))
