@@ -18,6 +18,7 @@ from . import multiproc as _multiproc
 
 _multiproc.ensure_initialized()
 
+from . import obs
 from .accl import ACCL
 from .arithconfig import ArithConfig, DEFAULT_ARITH_CONFIG
 from .buffer import BaseBuffer, Buffer, BufferSlice, DummyBuffer
@@ -62,6 +63,7 @@ __all__ = [
     "compressionFlags",
     "dataType",
     "errorCode",
+    "obs",
     "operation",
     "reduceFunction",
     "requestStatus",

@@ -27,6 +27,8 @@ import numpy as np
 
 from . import constants
 from .arithconfig import DEFAULT_ARITH_CONFIG, ArithConfig
+from .obs import metrics as _metrics
+from .obs import trace as _trace
 from .buffer import BaseBuffer, Buffer, BufferSlice, DummyBuffer
 from .communicator import Communicator
 from .config import ACCLConfig, Algorithm, TransportBackend
@@ -48,6 +50,16 @@ from .utils.logging import get_logger
 log = get_logger("accl")
 
 BufLike = Union[Buffer, BufferSlice]
+
+# pre-built label tuples for the scheduler counters: the pump loop is the
+# hottest host path, so even label construction stays off it
+_L_PARK = (("event", "park"),)
+_L_RESUME = (("event", "resume"),)
+_L_REPUMP = (("event", "repump"),)
+_L_EAGER = (("protocol", "eager"),)
+_L_RDV = (("protocol", "rendezvous"),)
+_L_EAGER_X = (("protocol", "eager_cross"),)
+_L_RDV_X = (("protocol", "rendezvous_cross"),)
 
 
 class ACCL:
@@ -141,6 +153,10 @@ class ACCL:
                 timeout=self.config.timeout,
                 eager_window=self.config.eager_rx_buffer_count,
                 eager_seg_bytes=self.config.eager_rx_buffer_size)
+        # metrics baseline: ACCL.stats() reports the delta since THIS
+        # bring-up, so a long-lived process with several sessions gets
+        # per-session attribution out of one process-global registry
+        self._metrics_baseline = _metrics.snapshot()
         self._initialized = True
         log.info("initialized: %s", self.parse_hwid())
 
@@ -163,7 +179,19 @@ class ACCL:
         """Per-device topology/memory introspection — the ``xclbin_scan``
         analog (``driver/xrt/src/xclbin_scan.cpp``: ip_layout discovery of
         CCLO instances and connectivity; here: device kind, ICI coords,
-        host process and live HBM stats per mesh participant)."""
+        host process and live HBM stats per mesh participant). Ranks this
+        controller owns also report its LIVE protocol state — in-flight
+        queue depth, parked continuations, eager rx-pool free/total slots
+        — so one scan is a real introspection surface, not just a static
+        topology table (ISSUE r8)."""
+        me = jax.process_index()
+        pool = (self.matcher().rx_pool if self._matchers else None)
+        live = {
+            "queue_depth": len(self._queue.inflight),
+            "parked_continuations": len(self._parked_calls),
+            "rx_pool_free": pool.free_slots if pool else None,
+            "rx_pool_total": pool.size if pool else None,
+        }
         out = []
         for rank, d in enumerate(self._devices):
             rec = {
@@ -173,6 +201,11 @@ class ACCL:
                 "kind": getattr(d, "device_kind", d.platform),
                 "process_index": getattr(d, "process_index", 0),
             }
+            if getattr(d, "process_index", 0) == me:
+                # controller-local state: the supervising host's view for
+                # the ranks it owns (a remote controller's scan reports
+                # its own)
+                rec.update(live)
             coords = getattr(d, "coords", None)
             if coords is not None:
                 rec["coords"] = tuple(coords)          # ICI topology position
@@ -545,7 +578,12 @@ class ACCL:
         self._queue.push(req)
         if run_async:
             return req
-        req.wait(timeout=self.config.timeout)
+        # request lifecycle: the wait covers complete + finalize (device
+        # readiness + host-mirror sync) — the tail of enqueue -> launch ->
+        # complete -> finalize; dispatch itself is the caller's span
+        with _trace.span(f"req.{scenario.name}.wait", cat="request",
+                         req=req.id):
+            req.wait(timeout=self.config.timeout)
         return None
 
     def _key(self, comm: Communicator, op: operation, *extra):
@@ -704,13 +742,19 @@ class ACCL:
         comm: Optional[Communicator] = None,
     ) -> Optional[Request]:
         """Per-rank device copy (``ACCL::copy``; fw copy ccl_offload_control.c:533-549)."""
+        t0 = _metrics.tick()
         comm = comm or self.comms[0]
         self._check_count(srcbuf, count, "copy src")
         self._check_count(dstbuf, count, "copy dst")
         x = self._input(srcbuf, count, from_device)
-        prog = self._programs.get(*self._spec_copy(comm, count, srcbuf.dtype))
-        y = prog(x).astype(dstbuf.jnp_dtype)
-        self._store(dstbuf, count, y)
+        key, build = self._spec_copy(comm, count, srcbuf.dtype)
+        with _trace.span("accl.copy", cat="collective", count=count):
+            prog = self._programs.get(key, build)
+            y = prog(x).astype(dstbuf.jnp_dtype)
+            self._store(dstbuf, count, y)
+        _metrics.note_call(operation.copy,
+                           count * constants.dtype_size(srcbuf.dtype),
+                           srcbuf.dtype, key, t0)
         return self._finish(operation.copy, dstbuf, y, to_device, run_async, comm)
 
     def combine(
@@ -728,6 +772,7 @@ class ACCL:
     ) -> Optional[Request]:
         """Per-rank elementwise reduce of two buffers (``ACCL::combine``;
         fw combine :553-571; reduce_ops plugin)."""
+        t0 = _metrics.tick()
         comm = comm or self.comms[0]
         for b, w in ((val1, "combine op0"), (val2, "combine op1"), (result, "combine res")):
             self._check_count(b, count, w)
@@ -735,10 +780,14 @@ class ACCL:
             raise ACCLError(errorCode.ARITH_ERROR, "combine operand dtype mismatch")
         a = self._input(val1, count, val1_from_device)
         b = self._input(val2, count, val2_from_device)
-        prog = self._programs.get(
-            *self._spec_combine(comm, count, val1.dtype, function))
-        y = prog(a, b).astype(result.jnp_dtype)
-        self._store(result, count, y)
+        key, build = self._spec_combine(comm, count, val1.dtype, function)
+        with _trace.span("accl.combine", cat="collective", count=count):
+            prog = self._programs.get(key, build)
+            y = prog(a, b).astype(result.jnp_dtype)
+            self._store(result, count, y)
+        _metrics.note_call(operation.combine,
+                           count * constants.dtype_size(val1.dtype),
+                           val1.dtype, key, t0)
         return self._finish(operation.combine, result, y, to_device, run_async, comm)
 
     # ------------------------------------------------------------------
@@ -785,9 +834,11 @@ class ACCL:
                 cont = self._parked_calls.get(call_id)
                 if cont is None:
                     continue
+                _metrics.inc("accl_sched_events_total", labels=_L_REPUMP)
                 new_step = cont(step)
                 if new_step is None:
                     del self._parked_calls[call_id]
+                    _metrics.inc("accl_sched_events_total", labels=_L_RESUME)
                     progressed = True
                 else:
                     self._sched.push_retry(call_id, new_step)
@@ -825,6 +876,8 @@ class ACCL:
         self._next_call_id += 1
         self._parked_calls[call_id] = cont
         self._sched.push_retry(call_id, step)
+        _metrics.inc("accl_sched_events_total", labels=_L_PARK)
+        _trace.instant("sched.park", cat="sched", call_id=call_id, step=step)
 
     def _cross_send(self, srcbuf, count, src, dst, tag, from_device,
                     run_async, comm, compress_dtype,
@@ -864,12 +917,16 @@ class ACCL:
 
         if nbytes > self.config.max_eager_size and not compressing:
             # rendezvous: zero-copy handoff, done only when moved (fw :595-612)
+            _metrics.inc("accl_sendrecv_protocol_total", labels=_L_RDV_X)
+            _metrics.note_call(operation.send, nbytes, srcbuf.dtype)
             seq = fab.announce(sdev, ddev, tag, payload, "r", 0)
             if not run_async:
-                self._drive_until(
-                    lambda: not fab.send_pending(sdev, ddev, seq),
-                    f"rendezvous send {src}->{dst}: no recv accepted "
-                    f"within {self.config.timeout}s")
+                with _trace.span("xsend.rendezvous", cat="fabric",
+                                 src=src, dst=dst, nbytes=nbytes):
+                    self._drive_until(
+                        lambda: not fab.send_pending(sdev, ddev, seq),
+                        f"rendezvous send {src}->{dst}: no recv accepted "
+                        f"within {self.config.timeout}s")
                 return self._finish(operation.send, None, payload, True,
                                     False, comm)
             req = Request(operation.send.name, outputs=None, external=True,
@@ -898,18 +955,25 @@ class ACCL:
         # ordering of dma_mover.cpp:581-610)
         nseg = fab.nsegments(count * payload.dtype.itemsize)
         seq = fab.next_seq(sdev, ddev)
+        _metrics.inc("accl_sendrecv_protocol_total", labels=_L_EAGER_X)
+        _metrics.note_call(operation.send, nbytes, srcbuf.dtype)
         if not run_async:
-            try:
-                self._drive_until(
-                    lambda: fab.eager_can_announce(sdev, ddev, seq, nseg),
-                    f"eager window to rank {dst} full for "
-                    f"{self.config.timeout}s (no recv consuming segments)")
-            except ACCLError:
-                # never strand the reserved seq: the pair stream must stay
-                # advanceable for the receiver after this send fails
-                fab.announce_cancel(sdev, ddev, seq)
-                raise
-            fab.announce(sdev, ddev, tag, payload, "e", nseg, seq=seq)
+            with _trace.span("xsend.eager", cat="fabric",
+                             src=src, dst=dst, nbytes=nbytes, nseg=nseg):
+                try:
+                    self._drive_until(
+                        lambda: fab.eager_can_announce(sdev, ddev, seq,
+                                                       nseg),
+                        f"eager window to rank {dst} full for "
+                        f"{self.config.timeout}s (no recv consuming "
+                        f"segments)")
+                except ACCLError:
+                    # never strand the reserved seq: the pair stream must
+                    # stay advanceable for the receiver after this send
+                    # fails
+                    fab.announce_cancel(sdev, ddev, seq)
+                    raise
+                fab.announce(sdev, ddev, tag, payload, "e", nseg, seq=seq)
             return self._finish(operation.send, None, payload, True, False,
                                 comm)
 
@@ -950,6 +1014,9 @@ class ACCL:
                 errorCode.CONFIG_ERROR,
                 f"process {jax.process_index()} does not own dst rank {dst}")
         self._check_count(dstbuf, count, "recv")
+        _metrics.note_call(operation.recv,
+                           count * constants.dtype_size(dstbuf.dtype),
+                           dstbuf.dtype)
         arith = self._arith(dstbuf.dtype, compress_dtype)
         sdev, ddev = comm.device(src).id, comm.device(dst).id
         fab = self._fabric
@@ -979,14 +1046,17 @@ class ACCL:
             return True
 
         if not run_async:
-            self._drive_until(
-                match_once,
-                f"recv {dst}<-{src}: no matching send within "
-                f"{self.config.timeout}s")
-            self._drive_until(
-                lambda: bool(delivered),
-                f"recv {dst}<-{src}: accepted but the move never "
-                f"executed within {self.config.timeout}s")
+            with _trace.span("xrecv.match", cat="fabric", src=src, dst=dst):
+                self._drive_until(
+                    match_once,
+                    f"recv {dst}<-{src}: no matching send within "
+                    f"{self.config.timeout}s")
+            with _trace.span("xrecv.deliver", cat="fabric",
+                             src=src, dst=dst):
+                self._drive_until(
+                    lambda: bool(delivered),
+                    f"recv {dst}<-{src}: accepted but the move never "
+                    f"executed within {self.config.timeout}s")
             return self._finish(operation.recv, dstbuf, None, to_device,
                                 False, comm)
 
@@ -1075,9 +1145,13 @@ class ACCL:
         if nbytes > self.config.max_eager_size and not compressing:
             # rendezvous: one zero-copy post, no rx buffer (fw :595-612;
             # compressed messages always take the eager path, like the fw)
+            _metrics.inc("accl_sendrecv_protocol_total", labels=_L_RDV)
+            _metrics.note_call(operation.send, nbytes, srcbuf.dtype)
             post = SendPost(src=src, dst=dst, tag=tag, data=data, count=count)
             matcher.post_send(post)
             return self._finish(operation.send, None, data, True, run_async, comm)
+        _metrics.inc("accl_sendrecv_protocol_total", labels=_L_EAGER)
+        _metrics.note_call(operation.send, nbytes, srcbuf.dtype)
         return self._eager_send(matcher, data, count, srcbuf.dtype,
                                 src, dst, tag, run_async)
 
@@ -1223,6 +1297,9 @@ class ACCL:
                                     compress_dtype)
         self._pump()
         self._check_count(dstbuf, count, "recv")
+        _metrics.note_call(operation.recv,
+                           count * constants.dtype_size(dstbuf.dtype),
+                           dstbuf.dtype)
         matcher = self.matcher(comm)
 
         assembled: list = []
@@ -1339,17 +1416,22 @@ class ACCL:
         """One-sided put: write ``src``'s shard into ``dst``'s shard of
         ``dstbuf`` with no matching recv (``ACCL::stream_put`` analog — the
         one-sided primitive, accl.hpp stream_put)."""
+        t0 = _metrics.tick()
         comm = comm or self.comms[0]
         self._check_count(srcbuf, count, "put src")
         self._check_count(dstbuf, count, "put dst")
         x = self._input(srcbuf, count, from_device)
         dest = self._input(dstbuf, count, True)
-        prog = self._programs.get(
-            self._key(comm, operation.put, count, dstbuf.dtype, src, dst),
-            lambda: primitives.build_move(comm, src, dst),
-        )
-        moved = prog(x.astype(dest.dtype), dest)
-        self._store(dstbuf, count, moved)
+        with _trace.span("accl.put", cat="collective", count=count):
+            prog = self._programs.get(
+                self._key(comm, operation.put, count, dstbuf.dtype, src, dst),
+                lambda: primitives.build_move(comm, src, dst),
+            )
+            moved = prog(x.astype(dest.dtype), dest)
+            self._store(dstbuf, count, moved)
+        _metrics.note_call(operation.put,
+                           count * constants.dtype_size(srcbuf.dtype),
+                           srcbuf.dtype, None, t0)
         return self._finish(operation.put, dstbuf, moved, to_device, run_async, comm)
 
     # ------------------------------------------------------------------
@@ -1369,14 +1451,19 @@ class ACCL:
         algorithm: Optional[Algorithm] = None,
     ) -> Optional[Request]:
         """``ACCL::bcast`` (accl.cpp; fw :798-990)."""
+        t0 = _metrics.tick()
         comm = comm or self.comms[0]
         self._check_count(buf, count, "bcast")
         x = self._input(buf, count, from_device)
-        prog = self._programs.get(
-            *self._spec_bcast(comm, count, buf.dtype, root, compress_dtype,
-                              algorithm))
-        y = prog(x)
-        self._store(buf, count, y)
+        key, build = self._spec_bcast(comm, count, buf.dtype, root,
+                                      compress_dtype, algorithm)
+        with _trace.span("accl.bcast", cat="collective", count=count):
+            prog = self._programs.get(key, build)
+            y = prog(x)
+            self._store(buf, count, y)
+        _metrics.note_call(operation.bcast,
+                           count * constants.dtype_size(buf.dtype),
+                           buf.dtype, key, t0)
         return self._finish(operation.bcast, buf, y, to_device, run_async, comm)
 
     def scatter(
@@ -1394,16 +1481,21 @@ class ACCL:
     ) -> Optional[Request]:
         """``ACCL::scatter``: root's ``count*world`` buffer chunked over ranks
         (fw :994-1125)."""
+        t0 = _metrics.tick()
         comm = comm or self.comms[0]
         world = comm.world_size
         self._check_count(sendbuf, count * world, "scatter send")
         self._check_count(recvbuf, count, "scatter recv")
         x = self._input(sendbuf, count * world, from_device)
-        prog = self._programs.get(
-            *self._spec_scatter(comm, count, sendbuf.dtype, root,
-                                compress_dtype, algorithm))
-        y = prog(x).astype(recvbuf.jnp_dtype)
-        self._store(recvbuf, count, y)
+        key, build = self._spec_scatter(comm, count, sendbuf.dtype, root,
+                                        compress_dtype, algorithm)
+        with _trace.span("accl.scatter", cat="collective", count=count):
+            prog = self._programs.get(key, build)
+            y = prog(x).astype(recvbuf.jnp_dtype)
+            self._store(recvbuf, count, y)
+        _metrics.note_call(operation.scatter,
+                           count * world * constants.dtype_size(sendbuf.dtype),
+                           sendbuf.dtype, key, t0)
         return self._finish(operation.scatter, recvbuf, y, to_device, run_async, comm)
 
     def gather(
@@ -1420,17 +1512,22 @@ class ACCL:
         algorithm: Optional[Algorithm] = None,
     ) -> Optional[Request]:
         """``ACCL::gather``: concat all sends at root (fw :1130-1296)."""
+        t0 = _metrics.tick()
         comm = comm or self.comms[0]
         world = comm.world_size
         self._check_count(sendbuf, count, "gather send")
         self._check_count(recvbuf, count * world, "gather recv")
         x = self._input(sendbuf, count, from_device)
         r = self._input(recvbuf, count * world, True)
-        prog = self._programs.get(
-            *self._spec_gather(comm, count, sendbuf.dtype, root,
-                               compress_dtype, algorithm))
-        y = prog(x, r)
-        self._store(recvbuf, count * world, y)
+        key, build = self._spec_gather(comm, count, sendbuf.dtype, root,
+                                       compress_dtype, algorithm)
+        with _trace.span("accl.gather", cat="collective", count=count):
+            prog = self._programs.get(key, build)
+            y = prog(x, r)
+            self._store(recvbuf, count * world, y)
+        _metrics.note_call(operation.gather,
+                           count * constants.dtype_size(sendbuf.dtype),
+                           sendbuf.dtype, key, t0)
         return self._finish(operation.gather, recvbuf, y, to_device, run_async, comm)
 
     def allgather(
@@ -1446,16 +1543,21 @@ class ACCL:
         algorithm: Optional[Algorithm] = None,
     ) -> Optional[Request]:
         """``ACCL::allgather`` (fw :1299-1505)."""
+        t0 = _metrics.tick()
         comm = comm or self.comms[0]
         world = comm.world_size
         self._check_count(sendbuf, count, "allgather send")
         self._check_count(recvbuf, count * world, "allgather recv")
         x = self._input(sendbuf, count, from_device)
-        prog = self._programs.get(
-            *self._spec_allgather(comm, count, sendbuf.dtype, compress_dtype,
-                                  algorithm))
-        y = prog(x).astype(recvbuf.jnp_dtype)
-        self._store(recvbuf, count * world, y)
+        key, build = self._spec_allgather(comm, count, sendbuf.dtype,
+                                          compress_dtype, algorithm)
+        with _trace.span("accl.allgather", cat="collective", count=count):
+            prog = self._programs.get(key, build)
+            y = prog(x).astype(recvbuf.jnp_dtype)
+            self._store(recvbuf, count * world, y)
+        _metrics.note_call(operation.allgather,
+                           count * constants.dtype_size(sendbuf.dtype),
+                           sendbuf.dtype, key, t0)
         return self._finish(operation.allgather, recvbuf, y, to_device, run_async, comm)
 
     def reduce(
@@ -1473,16 +1575,21 @@ class ACCL:
         algorithm: Optional[Algorithm] = None,
     ) -> Optional[Request]:
         """``ACCL::reduce`` (fw :1509-1744)."""
+        t0 = _metrics.tick()
         comm = comm or self.comms[0]
         self._check_count(sendbuf, count, "reduce send")
         self._check_count(recvbuf, count, "reduce recv")
         x = self._input(sendbuf, count, from_device)
         r = self._input(recvbuf, count, True)
-        prog = self._programs.get(
-            *self._spec_reduce(comm, count, sendbuf.dtype, root, function,
-                               compress_dtype, algorithm))
-        y = prog(x, r)
-        self._store(recvbuf, count, y)
+        key, build = self._spec_reduce(comm, count, sendbuf.dtype, root,
+                                       function, compress_dtype, algorithm)
+        with _trace.span("accl.reduce", cat="collective", count=count):
+            prog = self._programs.get(key, build)
+            y = prog(x, r)
+            self._store(recvbuf, count, y)
+        _metrics.note_call(operation.reduce,
+                           count * constants.dtype_size(sendbuf.dtype),
+                           sendbuf.dtype, key, t0)
         return self._finish(operation.reduce, recvbuf, y, to_device, run_async, comm)
 
     def allreduce(
@@ -1499,15 +1606,20 @@ class ACCL:
         algorithm: Optional[Algorithm] = None,
     ) -> Optional[Request]:
         """``ACCL::allreduce`` (accl.cpp:796-842; fw :1855-2075) — the hot path."""
+        t0 = _metrics.tick()
         comm = comm or self.comms[0]
         self._check_count(sendbuf, count, "allreduce send")
         self._check_count(recvbuf, count, "allreduce recv")
         x = self._input(sendbuf, count, from_device)
-        prog = self._programs.get(
-            *self._spec_allreduce(comm, count, sendbuf.dtype, function,
-                                  compress_dtype, algorithm))
-        y = prog(x).astype(recvbuf.jnp_dtype)
-        self._store(recvbuf, count, y)
+        key, build = self._spec_allreduce(comm, count, sendbuf.dtype,
+                                          function, compress_dtype, algorithm)
+        with _trace.span("accl.allreduce", cat="collective", count=count):
+            prog = self._programs.get(key, build)
+            y = prog(x).astype(recvbuf.jnp_dtype)
+            self._store(recvbuf, count, y)
+        _metrics.note_call(operation.allreduce,
+                           count * constants.dtype_size(sendbuf.dtype),
+                           sendbuf.dtype, key, t0)
         return self._finish(operation.allreduce, recvbuf, y, to_device, run_async, comm)
 
     def reduce_scatter(
@@ -1525,16 +1637,23 @@ class ACCL:
     ) -> Optional[Request]:
         """``ACCL::reduce_scatter``: ``count*world`` in, ``count`` out per rank
         (fw :1748-1852)."""
+        t0 = _metrics.tick()
         comm = comm or self.comms[0]
         world = comm.world_size
         self._check_count(sendbuf, count * world, "reduce_scatter send")
         self._check_count(recvbuf, count, "reduce_scatter recv")
         x = self._input(sendbuf, count * world, from_device)
-        prog = self._programs.get(
-            *self._spec_reduce_scatter(comm, count, sendbuf.dtype, function,
-                                       compress_dtype, algorithm))
-        y = prog(x).astype(recvbuf.jnp_dtype)
-        self._store(recvbuf, count, y)
+        key, build = self._spec_reduce_scatter(comm, count, sendbuf.dtype,
+                                               function, compress_dtype,
+                                               algorithm)
+        with _trace.span("accl.reduce_scatter", cat="collective",
+                         count=count):
+            prog = self._programs.get(key, build)
+            y = prog(x).astype(recvbuf.jnp_dtype)
+            self._store(recvbuf, count, y)
+        _metrics.note_call(operation.reduce_scatter,
+                           count * world * constants.dtype_size(sendbuf.dtype),
+                           sendbuf.dtype, key, t0)
         return self._finish(operation.reduce_scatter, recvbuf, y, to_device, run_async, comm)
 
     def alltoall(
@@ -1550,16 +1669,21 @@ class ACCL:
         algorithm: Optional[Algorithm] = None,
     ) -> Optional[Request]:
         """``ACCL::alltoall`` (fw :2123-2218)."""
+        t0 = _metrics.tick()
         comm = comm or self.comms[0]
         world = comm.world_size
         self._check_count(sendbuf, count * world, "alltoall send")
         self._check_count(recvbuf, count * world, "alltoall recv")
         x = self._input(sendbuf, count * world, from_device)
-        prog = self._programs.get(
-            *self._spec_alltoall(comm, count, sendbuf.dtype,
-                                 compress_dtype, algorithm))
-        y = prog(x).astype(recvbuf.jnp_dtype)
-        self._store(recvbuf, count * world, y)
+        key, build = self._spec_alltoall(comm, count, sendbuf.dtype,
+                                         compress_dtype, algorithm)
+        with _trace.span("accl.alltoall", cat="collective", count=count):
+            prog = self._programs.get(key, build)
+            y = prog(x).astype(recvbuf.jnp_dtype)
+            self._store(recvbuf, count * world, y)
+        _metrics.note_call(operation.alltoall,
+                           count * world * constants.dtype_size(sendbuf.dtype),
+                           sendbuf.dtype, key, t0)
         return self._finish(operation.alltoall, recvbuf, y, to_device, run_async, comm)
 
     def barrier(self, comm: Optional[Communicator] = None) -> None:
@@ -1569,6 +1693,7 @@ class ACCL:
         Multi-process: adds a host-level coordination-service barrier (the
         zero-byte notification gather/scatter analog) on top of the
         device-level psum, which every controller enters SPMD."""
+        t0 = _metrics.tick()
         comm = comm or self.comms[0]
         # flush only THIS communicator's traffic — a sub-communicator
         # barrier must not block on unrelated communicators (reference
@@ -1598,7 +1723,11 @@ class ACCL:
             token = jax.device_put(
                 np.ones((comm.world_size,), dtype=np.int32), comm.sharding()
             )
-        jax.block_until_ready(prog(token))
+        with _trace.span("accl.barrier", cat="collective"):
+            jax.block_until_ready(prog(token))
+        # a barrier moves no payload; its "dispatch" histogram entry is
+        # the whole synchronization (drain + host barrier + device psum)
+        _metrics.note_call(operation.barrier, 0, dataType.int32, None, t0)
 
     @staticmethod
     def _comm_tag(comm: Communicator) -> str:
@@ -1612,13 +1741,85 @@ class ACCL:
     # introspection (accl.cpp:980-1064 dump_* analogs)
     # ------------------------------------------------------------------
 
+    def stats(self) -> dict:
+        """Structured introspection snapshot — the firmware ``dump_*``
+        family as ONE JSON-serializable object (round-trips through
+        ``json.dumps`` by construction): resolved config, program-cache
+        stats, in-flight queue depth, cooperative-scheduler state (parked
+        continuations + retry-queue depths), per-communicator matcher /
+        rx-pool / sequence-counter state, the cross-process fabric's
+        control/data byte accounting, and the metrics delta since
+        ``initialize()`` (the PERFCNT readout for this session)."""
+        import json as _json
+
+        progs, hits, misses = self._programs.stats()
+        fresh, retry = self._sched.depths
+        comms = []
+        for comm in self.comms:
+            m = self._matchers.get(id(comm))
+            ns, nr = m.n_pending if m else (0, 0)
+            pool = m.rx_pool if m else None
+            if m is not None and m.is_native and comm.world_size <= 64:
+                # the native engine owns the counters; enumerate pairs
+                # through it (bounded: introspection stays O(P^2)-scan
+                # free on big meshes — the python dicts below are then
+                # simply empty, like the reference capping its dumps)
+                P = comm.world_size
+                out_seq = {f"{s}->{d}": v
+                           for s in range(P) for d in range(P)
+                           if (v := m.outbound_seq(s, d))}
+                in_seq = {f"{s}->{d}": v
+                          for s in range(P) for d in range(P)
+                          if (v := m.inbound_seq(s, d))}
+            else:
+                # python engine: active pairs only — a quiet mesh dumps {}
+                out_seq = {f"{s}->{d}": v for (s, d), v
+                           in comm._outbound_seq.items()}
+                in_seq = {f"{s}->{d}": v for (s, d), v
+                          in comm._inbound_seq.items()}
+            comms.append({
+                "world_size": comm.world_size,
+                "is_multiprocess": bool(comm.is_multiprocess),
+                "pending_sends": ns,
+                "pending_recvs": nr,
+                "rx_pool": ({"free": pool.free_slots, "total": pool.size}
+                            if pool else None),
+                "outbound_seq": out_seq,
+                "inbound_seq": in_seq,
+            })
+        fabric = None
+        if self._fabric is not None:
+            fabric = {
+                "session": self._fabric.ns,
+                "kv_bytes": self._fabric.kv_bytes,
+                "moved_bytes": self._fabric.moved_bytes,
+                "staged_messages": len(self._fabric._staged),
+                "pooled_messages": len(self._fabric._pool),
+            }
+        return {
+            "schema": _metrics.SCHEMA_VERSION,
+            "hwid": self.parse_hwid(),
+            "config": _json.loads(self.config.to_json()),
+            "program_cache": {"programs": progs, "hits": hits,
+                              "misses": misses},
+            "queue": {"inflight": len(self._queue.inflight)},
+            "scheduler": {"parked_continuations": len(self._parked_calls),
+                          "fresh_depth": fresh, "retry_depth": retry},
+            "comms": comms,
+            "fabric": fabric,
+            "metrics": _metrics.delta(self._metrics_baseline),
+        }
+
     def dump_state(self) -> str:
         progs, hits, misses = self._programs.stats()
+        fresh, retry = self._sched.depths
         lines = [
             "ACCL-TPU state:",
             f"  {self.parse_hwid()}",
             f"  program cache: {progs} programs, {hits} hits, {misses} misses",
             f"  inflight requests: {len(self._queue.inflight)}",
+            f"  scheduler: {len(self._parked_calls)} parked continuations, "
+            f"queue depths fresh={fresh} retry={retry}",
         ]
         for comm in self.comms:
             lines.append(comm.dump())

@@ -617,19 +617,39 @@ def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
             "autotune: world=1 — collective crossovers are degenerate; "
             "keeping default thresholds (flash bwd crossover still runs)")
         return autotune_flash_bwd(acc, reps=reps)
-    cfg = autotune_allreduce(acc, pows=pows, reps=reps, dt=dt)
+    from ..obs import trace as _trace
+
+    with _trace.span("autotune.allreduce", cat="autotune"):
+        cfg = autotune_allreduce(acc, pows=pows, reps=reps, dt=dt)
     acc.config, saved = cfg, acc.config
+    # each stage under its own span: an autotune sweep is minutes of
+    # opaque mesh traffic otherwise — the trace names which crossover
+    # measurement the wall time went to
+    stages = [
+        ("allgather", lambda c: autotune_allgather(
+            acc, c, pows=pows, reps=reps, dt=dt)),
+        ("reduce_scatter", lambda c: autotune_reduce_scatter(
+            acc, c, pows=pows, reps=reps, dt=dt)),
+        ("bcast", lambda c: autotune_bcast(
+            acc, c, pows=pows, reps=reps, dt=dt)),
+        ("gather", lambda c: autotune_gather(
+            acc, c, pows=pows, reps=reps, dt=dt)),
+        ("scatter", lambda c: autotune_scatter(
+            acc, c, pows=pows, reps=reps, dt=dt)),
+        ("alltoall", lambda c: autotune_alltoall(
+            acc, c, pows=pows, reps=reps, dt=dt)),
+        ("reduce", lambda c: autotune_reduce(
+            acc, c, pows=pows, reps=reps, dt=dt)),
+        ("flat_tree", lambda c: autotune_flat_tree(
+            acc, c, reps=reps, dt=dt)),
+        ("collective_matmul", lambda c: autotune_collective_matmul(
+            acc, c, reps=reps, dt=dt)),
+        ("flash_bwd", lambda c: autotune_flash_bwd(acc, c, reps=reps)),
+    ]
     try:
-        cfg = autotune_allgather(acc, cfg, pows=pows, reps=reps, dt=dt)
-        cfg = autotune_reduce_scatter(acc, cfg, pows=pows, reps=reps, dt=dt)
-        cfg = autotune_bcast(acc, cfg, pows=pows, reps=reps, dt=dt)
-        cfg = autotune_gather(acc, cfg, pows=pows, reps=reps, dt=dt)
-        cfg = autotune_scatter(acc, cfg, pows=pows, reps=reps, dt=dt)
-        cfg = autotune_alltoall(acc, cfg, pows=pows, reps=reps, dt=dt)
-        cfg = autotune_reduce(acc, cfg, pows=pows, reps=reps, dt=dt)
-        cfg = autotune_flat_tree(acc, cfg, reps=reps, dt=dt)
-        cfg = autotune_collective_matmul(acc, cfg, reps=reps, dt=dt)
-        cfg = autotune_flash_bwd(acc, cfg, reps=reps)
+        for name, stage in stages:
+            with _trace.span(f"autotune.{name}", cat="autotune"):
+                cfg = stage(cfg)
     finally:
         acc.config = saved
     return cfg

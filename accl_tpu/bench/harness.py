@@ -35,6 +35,7 @@ import numpy as np
 from ..communicator import Communicator
 from ..config import ACCLConfig, Algorithm
 from ..constants import dataType, dtype_size, operation, reduceFunction, to_jax_dtype
+from ..obs import trace as _trace
 from ..parallel import algorithms, primitives
 from . import models
 
@@ -425,13 +426,15 @@ def run_sweep(
             args = case.make_inputs(n)
             nbytes = (case.payload_bytes(n) if case.payload_bytes
                       else n * dtype_size(dt))
-            if mode == "chain":
-                tm = time_chain(prog, args, case.chain_adapt, nbytes)
-            elif mode == "fused":
-                tm = time_fused(prog, args, case.chain_adapt, nbytes,
-                                traffic_multiplier=case.traffic_multiplier)
-            else:
-                tm = _time_block(prog, args, reps)
+            with _trace.span(f"sweep.{name}", cat="bench",
+                             nbytes=nbytes, mode=mode):
+                if mode == "chain":
+                    tm = time_chain(prog, args, case.chain_adapt, nbytes)
+                elif mode == "fused":
+                    tm = time_fused(prog, args, case.chain_adapt, nbytes,
+                                    traffic_multiplier=case.traffic_multiplier)
+                else:
+                    tm = _time_block(prog, args, reps)
             eff = models.efficiency(case.op, comm.world_size, nbytes,
                                     tm.best, bw=link_bw, rtt=rtt)
             rows.append(SweepRow(

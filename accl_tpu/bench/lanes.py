@@ -685,3 +685,66 @@ def small_op_latency_distribution(nbytes: int = 16 << 10,
         "jnp_add": dist(lambda _, v: v + b),
         "empty_body": dist(lambda _, v: v + 0.0),
     }
+
+
+def bench_obs_overhead(acc, count: int = 1 << 14, calls: int = 64,
+                       rounds: int = 5) -> dict:
+    """Telemetry overhead lane (ISSUE r8 acceptance): per-call host
+    dispatch latency of the session allreduce with the metrics registry
+    DISABLED vs ENABLED, plus the raw cost of the disabled-path guard
+    itself (one ENABLED check + return per instrumentation point — the
+    only code a no-obs build would not run). The guard cost over the
+    measured dispatch latency is the precise "added host latency with
+    telemetry disabled" figure the 1% budget is about; the enabled delta
+    prices the registry bumps for always-on deployments."""
+    from ..constants import dataType, operation, reduceFunction
+    from ..obs import metrics as _m
+
+    a = acc.create_buffer(count, dataType.float32)
+    b = acc.create_buffer(count, dataType.float32)
+    a.host[:] = 1.0
+    a.sync_to_device()
+
+    def per_call_s() -> float:
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            acc.allreduce(a, b, count, reduceFunction.SUM, from_device=True,
+                          to_device=True)
+        return (time.perf_counter() - t0) / calls
+
+    was = _m.ENABLED
+    try:
+        per_call_s()   # compile + warm the cached program
+        # interleave the accountings per round: back-to-back blocks read
+        # machine drift (GC, clocks, co-tenants) as telemetry overhead
+        dis, ena = [], []
+        for _ in range(rounds):
+            _m.disable()
+            dis.append(per_call_s())
+            _m.enable()
+            ena.append(per_call_s())
+        # the disabled guard alone, in isolation: exactly the calls the
+        # instrumented dispatch path makes per collective
+        _m.disable()
+        n = 20000
+        nbytes = count * 4
+        t0 = time.perf_counter()
+        for _ in range(n):
+            _m.note_call(operation.allreduce, nbytes, dataType.float32,
+                         None, _m.tick())
+        guard_s = (time.perf_counter() - t0) / n
+    finally:
+        (_m.enable if was else _m.disable)()
+
+    d_med = float(np.median(dis))
+    e_med = float(np.median(ena))
+    return {
+        "metric": "obs_overhead", "unit": "us", "bytes": count * 4,
+        "calls": calls, "rounds": rounds,
+        "dispatch_disabled_us": round(d_med * 1e6, 2),
+        "dispatch_enabled_us": round(e_med * 1e6, 2),
+        "enabled_delta_pct": round((e_med - d_med) / d_med * 100, 2),
+        "disabled_guard_ns": round(guard_s * 1e9, 1),
+        "disabled_guard_pct_of_dispatch": round(
+            guard_s / d_med * 100, 4),
+    }

@@ -43,6 +43,7 @@ compiled composite is cached per resolved selection).
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -51,6 +52,8 @@ from .buffer import BaseBuffer
 from .communicator import Communicator
 from .config import Algorithm
 from .constants import ACCLError, TAG_ANY, errorCode, operation, reduceFunction
+from .obs import metrics as _metrics
+from .obs import trace as _trace
 
 
 @dataclasses.dataclass
@@ -312,6 +315,7 @@ class CommandList:
                 f"tag={ps.tag}; record the matching recv before execute()")
         if not self._steps:
             return None
+        t0 = _metrics.tick()
         acc = self._accl
         order = list(self._buffers)
         slots = {bid: i for i, bid in enumerate(order)}
@@ -380,10 +384,21 @@ class CommandList:
         donate_slots = (tuple(sorted(written_slots - shared))
                         if donate and jax.default_backend() == "tpu"
                         and not acc._queue.has_inflight() else ())
-        fused = acc._programs.get(
-            self._composite_key([k for k, _ in resolved]) + (donate_slots,),
-            lambda: jax.jit(composite, donate_argnums=donate_slots))
-        results = fused(*arrays)
+        with _trace.span("cmdlist.execute", cat="cmdlist",
+                         steps=len(self._steps)):
+            fused = acc._programs.get(
+                self._composite_key([k for k, _ in resolved])
+                + (donate_slots,),
+                lambda: jax.jit(composite, donate_argnums=donate_slots))
+            results = fused(*arrays)
+        # one launch for the whole recorded sequence — count the chain
+        # length so dispatch amortization is attributable per artifact
+        _metrics.inc("accl_cmdlist_executes_total",
+                     labels=(("steps", str(len(self._steps))),))
+        if t0:
+            _metrics.observe("accl_dispatch_seconds",
+                             time.perf_counter() - t0,
+                             (("op", "cmdlist"),))
         written = {s.out_id for s in self._steps}
         out_bufs = []
         for bid, res in zip(order, results):

@@ -75,6 +75,14 @@ import numpy as np
 
 from . import constants
 from .constants import ACCLError, ACCLTimeoutError, errorCode
+from .obs import metrics as _metrics
+from .obs import trace as _trace
+
+# pre-built label tuples: the KV helpers sit under every control-plane
+# round-trip, so even label construction stays off the hot path
+_L_KV_GET = (("kvop", "get"),)
+_L_KV_SET = (("kvop", "set"),)
+_L_KV_INCR = (("kvop", "incr"),)
 
 _ENV_COORD = "ACCL_COORDINATOR"
 _ENV_NPROCS = "ACCL_NUM_PROCS"
@@ -305,6 +313,7 @@ class CrossProcessFabric:
                 # collecting (keep waiting) or we read a dead run's value
                 # before p0's overwrite landed (the re-read converges on
                 # the fresh nonce). Bounded by the session timeout.
+                _metrics.inc("accl_session_handshake_retries_total")
                 if time.monotonic() > deadline:
                     raise ACCLError(
                         errorCode.CONFIG_ERROR,
@@ -317,7 +326,11 @@ class CrossProcessFabric:
 
     def _kset(self, client, key: str, value: str) -> None:
         self.kv_bytes += len(key) + len(value)
+        t0 = _metrics.tick()
         client.key_value_set(key, value)
+        if t0:
+            _metrics.observe("accl_kv_seconds", time.perf_counter() - t0,
+                             _L_KV_SET)
 
     def _kset_force(self, client, key: str, value: str) -> None:
         """Tallied set that OVERWRITES — for bootstrap keys that may
@@ -334,8 +347,13 @@ class CrossProcessFabric:
 
     def _kincr(self, client, key: str, by: int = 1) -> int:
         self.kv_bytes += len(key) + 8
+        t0 = _metrics.tick()
         try:
-            return int(client.key_value_increment(key, by))
+            n = int(client.key_value_increment(key, by))
+            if t0:
+                _metrics.observe("accl_kv_seconds",
+                                 time.perf_counter() - t0, _L_KV_INCR)
+            return n
         except AttributeError:
             # Older coordination clients have no atomic increment.
             # Emulate with a DENSE CAS ladder: claim key#c<n> via
@@ -372,6 +390,11 @@ class CrossProcessFabric:
                 # hint is best-effort and <= some existing claim, so a
                 # stale hint only costs extra forward probes
                 self._kset_force(client, key + "#hint", str(nxt))
+                if t0:
+                    # the emulated ladder is ONE logical increment however
+                    # many claim RTTs it took — observed as one sample
+                    _metrics.observe("accl_kv_seconds",
+                                     time.perf_counter() - t0, _L_KV_INCR)
                 return nxt
 
     def _kcount(self, client, key: str) -> int:
@@ -410,18 +433,32 @@ class CrossProcessFabric:
         means missing). The AttributeError arm must not swallow into the
         generic None path: that made EVERY key look missing and stalled
         the whole eager protocol on such clients."""
+        t0 = _metrics.tick()
         try:
-            return client.key_value_try_get(key)
+            v = client.key_value_try_get(key)
+            if t0:
+                _metrics.observe("accl_kv_seconds",
+                                 time.perf_counter() - t0, _L_KV_GET)
+            return v
         except AttributeError:
             # 25 ms deadline: must cover a same-DC coordinator RTT, or
             # PRESENT keys read as missing and the protocol stalls; a
             # miss costs the full deadline, which only slows idle polls
             # (poll_sleep already backs off around them)
             try:
-                return client.blocking_key_value_get(key, 25)
+                v = client.blocking_key_value_get(key, 25)
             except Exception:
-                return None
+                v = None
+            if t0:
+                _metrics.observe("accl_kv_seconds",
+                                 time.perf_counter() - t0, _L_KV_GET)
+            return v
         except Exception:
+            if t0:
+                # a NOT_FOUND miss is still one coordinator RTT — the
+                # histogram must see the polling loop's dominant case
+                _metrics.observe("accl_kv_seconds",
+                                 time.perf_counter() - t0, _L_KV_GET)
             return None
 
     def _timeout_ms(self) -> int:
@@ -873,9 +910,14 @@ class CrossProcessFabric:
             shard = zeros_on(self._dev_by_id[ddev], wire)
         garr = jax.make_array_from_single_device_arrays(
             (2, wire), sharding, [shard])
-        out = prog(garr)
-        jax.block_until_ready(out)
+        with _trace.span("fabric.batch_move", cat="fabric",
+                         pair=f"{sdev}->{ddev}", members=len(ms),
+                         nbytes=total):
+            out = prog(garr)
+            jax.block_until_ready(out)
         self.moved_bytes += total
+        _metrics.inc("accl_fabric_moves_total",
+                     labels=(("kind", "batch"),))
         if i_send:
             if freed:
                 k = (sdev, ddev)
@@ -930,9 +972,13 @@ class CrossProcessFabric:
                 jnp.zeros((1, count), dtype=wdt), self._dev_by_id[ddev])
         garr = jax.make_array_from_single_device_arrays(
             (2, count), sharding, [shard])
-        out = prog(garr)
-        jax.block_until_ready(out)
+        with _trace.span("fabric.move", cat="fabric",
+                         pair=f"{sdev}->{ddev}", seq=seq):
+            out = prog(garr)
+            jax.block_until_ready(out)
         self.moved_bytes += count * np.dtype(wdt).itemsize
+        _metrics.inc("accl_fabric_moves_total",
+                     labels=(("kind", "single"),))
         if i_send:
             # return exactly the credits this message took (0 for
             # rendezvous — it never entered the eager window)

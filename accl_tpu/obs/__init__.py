@@ -1,0 +1,33 @@
+"""Observability tier: metrics registry, host-span tracing, state dumps.
+
+The reference exposes PERFCNT/RETCODE registers and the firmware ``dump_*``
+introspection calls; the TPU re-expression's analog is this package
+(SURVEY.md §5):
+
+* :mod:`accl_tpu.obs.metrics` — process-local counters / gauges /
+  histograms keyed by ``(operation, algorithm, dtype, size-bucket)``,
+  with ``snapshot()`` / ``delta()`` and JSON + Prometheus-text export.
+  The PERFCNT register bank, made a registry.
+* :mod:`accl_tpu.obs.trace` — host-side spans emitted as Chrome-trace
+  JSON (Perfetto / ``chrome://tracing``), each span doubling as a
+  ``jax.profiler.TraceAnnotation`` so host phases line up against the
+  device timeline inside an ``ACCL.profile()`` xprof capture.
+* ``ACCL.stats()`` (accl.py) — the firmware ``dump_*`` analog as one
+  structured, JSON-serializable snapshot.
+
+Both modules are guarded by ONE module-level flag each and allocate
+nothing on the hot path while disabled: a disabled call site costs a
+boolean attribute read plus a function call. Metrics default ON (cheap
+dict bumps, and the registry is what ``stats()`` and BENCH artifacts
+embed); tracing defaults OFF (span records allocate).
+
+This package depends only on the stdlib (plus a lazy ``jax.profiler``
+import inside active spans) so every layer of the stack — including
+:mod:`accl_tpu.multiproc`, which runs before backend bring-up — can
+import it without cycles.
+"""
+from __future__ import annotations
+
+from . import metrics, trace
+
+__all__ = ["metrics", "trace"]

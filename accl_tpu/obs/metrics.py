@@ -1,0 +1,324 @@
+"""Process-local collective metrics registry (the PERFCNT bank, made a
+registry).
+
+Counters, gauges and histograms keyed by a metric name plus a label
+tuple — the canonical label set for collective calls is ``(operation,
+algorithm, dtype, size-bucket)``. One :class:`MetricsRegistry` instance
+(:data:`REGISTRY`) serves the whole process; the module-level helpers
+(:func:`inc`, :func:`observe`, :func:`gauge_max`, :func:`note_call`)
+are the hot-path entry points and check :data:`ENABLED` first — a
+disabled call is one boolean read and a return, no allocation.
+
+Metric catalog (see docs/observability.md for the field reference):
+
+=============================================  =========  =================
+name                                           kind       labels
+=============================================  =========  =================
+``accl_calls_total``                           counter    op, algorithm, dtype, bucket
+``accl_bytes_total``                           counter    op, algorithm, dtype, bucket
+``accl_dispatch_seconds``                      histogram  op
+``accl_sendrecv_protocol_total``               counter    protocol (eager | rendezvous | eager_cross | rendezvous_cross)
+``accl_requests_total``                        counter    op, status
+``accl_request_duration_seconds``              histogram  op
+``accl_match_events_total``                    counter    event (send/recv x matched/parked)
+``accl_sched_events_total``                    counter    event (park | resume | repump)
+``accl_rx_pool_occupancy_highwater``           gauge      (none)
+``accl_rx_pool_exhausted_total``               counter    (none)
+``accl_algorithm_fallback_total``              counter    op, algorithm
+``accl_algorithm_selected_total``              counter    op, algorithm
+``accl_kv_seconds``                            histogram  kvop (get | set | incr)
+``accl_session_handshake_retries_total``       counter    (none)
+``accl_fabric_moves_total``                    counter    kind (single | batch)
+``accl_cmdlist_executes_total``                counter    steps
+=============================================  =========  =================
+
+Export formats: :meth:`MetricsRegistry.snapshot` (flat, JSON-safe dict),
+:meth:`MetricsRegistry.delta` (difference of two snapshots — what
+``ACCL.stats()`` embeds, scoped since ``initialize()``),
+:meth:`MetricsRegistry.to_json` and :meth:`MetricsRegistry.to_prometheus`
+(text exposition format, scrape-ready).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, Iterable, Optional, Tuple
+
+#: THE module-level hot-path guard. Flip with :func:`enable` /
+#: :func:`disable`; every helper below checks it before touching the
+#: registry, so a disabled process pays one attribute read per call site.
+ENABLED = True
+
+#: histogram bucket upper bounds in SECONDS (log-spaced, 1 µs .. 10 s);
+#: one shared geometry keeps the Prometheus exposition cumulative and
+#: the snapshot schema stable
+BUCKETS = (1e-6, 4e-6, 16e-6, 64e-6, 256e-6, 1e-3, 4e-3, 16e-3,
+           64e-3, 256e-3, 1.0, 10.0)
+
+_KiB = 1024
+
+
+def size_bucket(nbytes: int) -> str:
+    """Power-of-four byte bucket label: '<=1KiB', '<=4KiB', ... '>64MiB'.
+    Coarse on purpose — the label cardinality is what bounds registry
+    growth (ops x algos x dtypes x buckets)."""
+    edge = _KiB
+    while edge < nbytes:
+        if edge >= 64 * _KiB * _KiB:
+            return ">64MiB"
+        edge *= 4
+    if edge >= _KiB * _KiB:
+        return f"<={edge // (_KiB * _KiB)}MiB"
+    return f"<={edge // _KiB}KiB"
+
+
+def _label_str(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"
+
+
+class MetricsRegistry:
+    """Thread-safe counters / gauges / histograms with flat string keys.
+
+    Keys are the Prometheus series identity ``name{label="value",...}``
+    so snapshots are JSON-safe by construction and the exposition format
+    is a straight dump of the tables.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        # gauges hold (value); high-water gauges only move up via gauge_max
+        self._gauges: Dict[str, float] = {}
+        # histograms hold [bucket_counts..., sum, count]
+        self._hists: Dict[str, list] = {}
+
+    # -- write side --------------------------------------------------------
+
+    def inc(self, name: str, value: float = 1.0,
+            labels: Tuple[Tuple[str, str], ...] = ()) -> None:
+        key = name + _label_str(labels)
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def set_gauge(self, name: str, value: float,
+                  labels: Tuple[Tuple[str, str], ...] = ()) -> None:
+        key = name + _label_str(labels)
+        with self._lock:
+            self._gauges[key] = value
+
+    def gauge_max(self, name: str, value: float,
+                  labels: Tuple[Tuple[str, str], ...] = ()) -> None:
+        """High-water gauge: only ever moves up (rx-pool occupancy)."""
+        key = name + _label_str(labels)
+        with self._lock:
+            if value > self._gauges.get(key, float("-inf")):
+                self._gauges[key] = value
+
+    def observe(self, name: str, value: float,
+                labels: Tuple[Tuple[str, str], ...] = ()) -> None:
+        key = name + _label_str(labels)
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = [0] * len(BUCKETS) + [0.0, 0]
+                self._hists[key] = h
+            for i, edge in enumerate(BUCKETS):
+                if value <= edge:
+                    h[i] += 1
+                    break
+            h[-2] += value
+            h[-1] += 1
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+    # -- read side ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Flat, JSON-serializable copy of every table. Histograms export
+        as ``{"buckets": {le: n}, "sum": s, "count": n}``."""
+        with self._lock:
+            hists = {
+                k: {"buckets": {repr(e): h[i]
+                                for i, e in enumerate(BUCKETS)},
+                    "sum": h[-2], "count": h[-1]}
+                for k, h in self._hists.items()
+            }
+            return {"schema": SCHEMA_VERSION,
+                    "counters": dict(self._counters),
+                    "gauges": dict(self._gauges),
+                    "histograms": hists}
+
+    @staticmethod
+    def delta(since: dict, now: Optional[dict] = None) -> dict:
+        """Difference of two :meth:`snapshot` dicts (``now`` defaults to a
+        fresh snapshot of :data:`REGISTRY`): counters and histogram
+        sums/counts subtract; gauges report their CURRENT value (a
+        high-water mark has no meaningful difference)."""
+        if now is None:
+            now = REGISTRY.snapshot()
+        prev_c = since.get("counters", {})
+        counters = {k: v - prev_c.get(k, 0.0)
+                    for k, v in now.get("counters", {}).items()
+                    if v != prev_c.get(k, 0.0)}
+        prev_h = since.get("histograms", {})
+        hists = {}
+        for k, h in now.get("histograms", {}).items():
+            p = prev_h.get(k, {"buckets": {}, "sum": 0.0, "count": 0})
+            if h["count"] == p["count"]:
+                continue
+            hists[k] = {
+                "buckets": {le: n - p["buckets"].get(le, 0)
+                            for le, n in h["buckets"].items()},
+                "sum": h["sum"] - p["sum"],
+                "count": h["count"] - p["count"],
+            }
+        return {"schema": SCHEMA_VERSION,
+                "counters": counters,
+                "gauges": dict(now.get("gauges", {})),
+                "histograms": hists}
+
+    def to_json(self, indent: Optional[int] = None) -> str:
+        return json.dumps(self.snapshot(), indent=indent, sort_keys=True)
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition (0.0.4): counters and gauges dump
+        directly; histograms expand to cumulative ``_bucket`` series plus
+        ``_sum``/``_count``, with the standard ``+Inf`` bucket."""
+        lines = []
+        with self._lock:
+            for k in sorted(self._counters):
+                lines.append(f"{k} {self._counters[k]:g}")
+            for k in sorted(self._gauges):
+                lines.append(f"{k} {self._gauges[k]:g}")
+            for k in sorted(self._hists):
+                h = self._hists[k]
+                name, _, labels = k.partition("{")
+                labels = ("{" + labels) if labels else ""
+                inner = labels[1:-1] if labels else ""
+                cum = 0
+                for i, edge in enumerate(BUCKETS):
+                    cum += h[i]
+                    sep = "," if inner else ""
+                    lines.append(
+                        f'{name}_bucket{{{inner}{sep}le="{edge:g}"}} {cum}')
+                sep = "," if inner else ""
+                lines.append(f'{name}_bucket{{{inner}{sep}le="+Inf"}} '
+                             f"{h[-1]}")
+                lines.append(f"{name}_sum{labels} {h[-2]:g}")
+                lines.append(f"{name}_count{labels} {h[-1]}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+#: snapshot/export schema version — embedded in BENCH artifacts and
+#: ``ACCL.stats()`` so downstream tooling can detect drift
+SCHEMA_VERSION = 1
+
+#: the process-wide registry every helper below writes into
+REGISTRY = MetricsRegistry()
+
+
+def enable() -> None:
+    global ENABLED
+    ENABLED = True
+
+
+def disable() -> None:
+    global ENABLED
+    ENABLED = False
+
+
+def enabled() -> bool:
+    return ENABLED
+
+
+def reset() -> None:
+    REGISTRY.reset()
+
+
+def snapshot() -> dict:
+    return REGISTRY.snapshot()
+
+
+def delta(since: dict) -> dict:
+    return MetricsRegistry.delta(since)
+
+
+def to_prometheus() -> str:
+    return REGISTRY.to_prometheus()
+
+
+# ---------------------------------------------------------------------------
+# hot-path helpers: every one checks ENABLED first and allocates nothing
+# when disabled
+# ---------------------------------------------------------------------------
+
+def tick() -> float:
+    """Start-of-dispatch timestamp; 0.0 (no clock read) when disabled."""
+    if not ENABLED:
+        return 0.0
+    return time.perf_counter()
+
+
+def note_call(op, nbytes: int, dtype=None, key: Optional[Iterable] = None,
+              t0: float = 0.0) -> None:
+    """One collective/primitive host call: bumps ``accl_calls_total`` and
+    ``accl_bytes_total`` under (op, algorithm, dtype, size-bucket) and,
+    when ``t0`` came from :func:`tick`, observes the host dispatch
+    latency. ``key`` is the resolved program-cache key — the algorithm
+    label is read off it (the Algorithm member the ``_spec_*`` builders
+    embed) so selection is recorded exactly as dispatched."""
+    if not ENABLED:
+        return
+    algo = "-"
+    if key is not None:
+        for part in key:
+            # Algorithm enum members carry .value strings ('xla', 'ring'…)
+            v = getattr(part, "value", None)
+            if v is not None and part.__class__.__name__ == "Algorithm":
+                algo = v
+                break
+    labels = (("op", getattr(op, "name", str(op))),
+              ("algorithm", algo),
+              ("dtype", getattr(dtype, "name", str(dtype))),
+              ("bucket", size_bucket(int(nbytes))))
+    REGISTRY.inc("accl_calls_total", 1.0, labels)
+    REGISTRY.inc("accl_bytes_total", float(nbytes), labels)
+    if t0:
+        REGISTRY.observe("accl_dispatch_seconds",
+                         time.perf_counter() - t0,
+                         (("op", getattr(op, "name", str(op))),))
+
+
+def inc(name: str, value: float = 1.0,
+        labels: Tuple[Tuple[str, str], ...] = ()) -> None:
+    if not ENABLED:
+        return
+    REGISTRY.inc(name, value, labels)
+
+
+def observe(name: str, value: float,
+            labels: Tuple[Tuple[str, str], ...] = ()) -> None:
+    if not ENABLED:
+        return
+    REGISTRY.observe(name, value, labels)
+
+
+def gauge_max(name: str, value: float,
+              labels: Tuple[Tuple[str, str], ...] = ()) -> None:
+    if not ENABLED:
+        return
+    REGISTRY.gauge_max(name, value, labels)
+
+
+def set_gauge(name: str, value: float,
+              labels: Tuple[Tuple[str, str], ...] = ()) -> None:
+    if not ENABLED:
+        return
+    REGISTRY.set_gauge(name, value, labels)

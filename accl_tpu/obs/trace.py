@@ -1,0 +1,216 @@
+"""Host-side span tracing as Chrome-trace-event JSON.
+
+The timeline tier above the per-call counter (SURVEY.md §5: PERFCNT gives
+per-call cycles, xprof gives the device timeline — THIS gives the host
+protocol timeline). Spans cover the request lifecycle (enqueue → launch →
+complete → finalize), the cross-process send/recv phases (eager push,
+rendezvous handshake, park/resume), ``CommandList.execute`` and autotune
+stages; each span also opens a ``jax.profiler.TraceAnnotation`` with the
+same name, so when tracing runs inside an ``ACCL.profile()`` region the
+host spans line up against the device timeline in the xprof viewer.
+
+Output is the Chrome trace-event array format — ``{"traceEvents": [...]}``
+— which loads standalone in Perfetto (https://ui.perfetto.dev) and
+``chrome://tracing``. One track per (process, thread): ``pid`` is the
+controller's process index (``ACCL_PROC_ID`` under the launcher, the OS
+pid otherwise) so multi-controller runs merge into one aligned timeline
+per rank group; ``tid`` is a densified thread id.
+
+Disabled by default (span records allocate): :func:`start` flips the one
+module-level flag; a disabled :func:`span` returns a shared null context
+— no clock read, no allocation.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+#: THE module-level hot-path guard; flipped by :func:`start` / :func:`stop`
+ENABLED = False
+
+#: reusable no-op context for disabled call sites (nullcontext is
+#: stateless for a None enter result, so one shared instance is safe)
+_NULL = contextlib.nullcontext()
+
+
+def _pid() -> int:
+    """Track identity: the launcher's process id when running
+    multi-controller (stable across hosts, 0-based — one track per rank
+    group), else the OS pid. Never touches the JAX backend."""
+    env = os.environ.get("ACCL_PROC_ID")
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    return os.getpid()
+
+
+class SpanTracer:
+    """Collects complete ('X') trace events with µs timestamps."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+        self._tids: Dict[int, int] = {}     # thread ident -> dense tid
+        # one epoch per tracer: Chrome-trace ts is relative anyway, and a
+        # perf_counter epoch keeps span math monotonic and cheap
+        self._epoch = time.perf_counter()
+
+    # -- recording ---------------------------------------------------------
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        with self._lock:
+            tid = self._tids.get(ident)
+            if tid is None:
+                tid = len(self._tids)
+                self._tids[ident] = tid
+            return tid
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._epoch) * 1e6
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "host", **args):
+        """One complete event around the body; also a TraceAnnotation so
+        the name shows on the device timeline under ``ACCL.profile()``."""
+        ann = None
+        try:
+            import jax
+            ann = jax.profiler.TraceAnnotation(name)
+            ann.__enter__()
+        except Exception:   # pre-backend or stripped profiler builds
+            ann = None
+        t0 = self._now_us()
+        try:
+            yield
+        finally:
+            t1 = self._now_us()
+            if ann is not None:
+                try:
+                    ann.__exit__(None, None, None)
+                except Exception:   # telemetry never breaks the data path
+                    pass
+            ev = {"name": name, "cat": cat, "ph": "X",
+                  "ts": t0, "dur": t1 - t0,
+                  "pid": _pid(), "tid": self._tid()}
+            if args:
+                ev["args"] = {k: (v if isinstance(v, (int, float, bool,
+                                                      str, type(None)))
+                                  else str(v)) for k, v in args.items()}
+            with self._lock:
+                self._events.append(ev)
+
+    def instant(self, name: str, cat: str = "host", **args) -> None:
+        """Zero-duration marker (scope: thread)."""
+        ev = {"name": name, "cat": cat, "ph": "i", "s": "t",
+              "ts": self._now_us(), "pid": _pid(), "tid": self._tid()}
+        if args:
+            ev["args"] = {k: str(v) for k, v in args.items()}
+        with self._lock:
+            self._events.append(ev)
+
+    # -- export ------------------------------------------------------------
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def to_chrome_trace(self, since: int = 0) -> dict:
+        """The standalone JSON object format: the event array plus
+        process/thread name metadata so Perfetto labels the tracks.
+        ``since`` exports only events recorded after that index (a
+        ``len(tracer)`` snapshot) — how :func:`capture` scopes a region
+        without clearing foreign spans."""
+        with self._lock:
+            events = self._events[since:]
+            tids = dict(self._tids)
+        pid = _pid()
+        meta = [{"name": "process_name", "ph": "M", "pid": pid,
+                 "args": {"name": f"accl host p{pid}"}}]
+        for ident, tid in tids.items():
+            meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                         "tid": tid, "args": {"name": f"lane {tid}"}})
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+    def write(self, path: str, since: int = 0) -> str:
+        """Write the standalone Chrome-trace JSON; returns ``path``."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(since), f)
+        return path
+
+
+#: the process-wide tracer every module-level helper writes into
+TRACER = SpanTracer()
+
+
+def start() -> None:
+    """Enable span collection (idempotent; events accumulate until
+    :func:`stop`/:func:`clear`)."""
+    global ENABLED
+    ENABLED = True
+
+
+def stop() -> None:
+    global ENABLED
+    ENABLED = False
+
+
+def enabled() -> bool:
+    return ENABLED
+
+
+def clear() -> None:
+    TRACER.clear()
+
+
+def span(name: str, cat: str = "host", **args):
+    """Hot-path entry: a real span when tracing, the shared null context
+    otherwise (one boolean read, no allocation)."""
+    if not ENABLED:
+        return _NULL
+    return TRACER.span(name, cat, **args)
+
+
+def instant(name: str, cat: str = "host", **args) -> None:
+    if not ENABLED:
+        return
+    TRACER.instant(name, cat, **args)
+
+
+def write(path: str) -> Optional[str]:
+    """Dump collected events (even after :func:`stop`); None if empty."""
+    if len(TRACER) == 0:
+        return None
+    return TRACER.write(path)
+
+
+@contextlib.contextmanager
+def capture(path: str):
+    """Trace a region and write ONLY that region's spans on exit (events
+    already in the process-global tracer stay there, untouched)::
+
+        with obs.trace.capture("/tmp/accl_host_trace.json"):
+            acc.allreduce(...)
+    """
+    was = ENABLED
+    mark = len(TRACER)
+    start()
+    try:
+        yield TRACER
+    finally:
+        if not was:
+            stop()
+        TRACER.write(path, since=mark)

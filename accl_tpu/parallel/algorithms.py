@@ -22,6 +22,7 @@ from ..arithconfig import ArithConfig
 from ..communicator import Communicator
 from ..config import ACCLConfig, Algorithm, TransportBackend
 from ..constants import ACCLError, dataType, errorCode, operation, reduceFunction
+from ..obs import metrics as _metrics
 from . import flat, hierarchical, pallas_ring, primitives, ring, tree
 
 #: default payload size above which AUTO prefers the explicit ring (bytes);
@@ -104,7 +105,23 @@ def select(
 ) -> Algorithm:
     """Resolve the algorithm for one call — the tuning-register thresholds
     of the firmware's per-collective selection (flat vs binary tree:
-    ``ccl_offload_control.c:816`` bcast, ``:1533`` reduce)."""
+    ``ccl_offload_control.c:816`` bcast, ``:1533`` reduce). Every
+    resolution is counted (``accl_algorithm_selected_total``) so AUTO's
+    behavior over a workload is attributable after the fact."""
+    algo = _select(op, nbytes, comm, cfg, requested, count)
+    _metrics.inc("accl_algorithm_selected_total",
+                 labels=(("op", op.name), ("algorithm", algo.value)))
+    return algo
+
+
+def _select(
+    op: operation,
+    nbytes: int,
+    comm: Communicator,
+    cfg: ACCLConfig,
+    requested: Optional[Algorithm] = None,
+    count: Optional[int] = None,
+) -> Algorithm:
     algo = requested or cfg.algorithm
     if algo != Algorithm.AUTO:
         if supported(op, algo):
@@ -112,9 +129,13 @@ def select(
         if requested is not None:
             raise ValueError(f"{algo} not supported for {op.name}")
         # a global cfg.algorithm preference that this op cannot honor falls
-        # through to AUTO resolution rather than poisoning unrelated ops —
-        # observable via a one-time warning so a misconfigured session-wide
-        # preference is not silently masked
+        # through to AUTO resolution rather than poisoning unrelated ops.
+        # EVERY occurrence increments the fallback counter — the warn-once
+        # set dedupes only the LOG LINE, so the telemetry tier still shows
+        # how often the misconfiguration bit (ISSUE r8: the warn-once set
+        # suppressed all signal after the first hit)
+        _metrics.inc("accl_algorithm_fallback_total",
+                     labels=(("op", op.name), ("algorithm", algo.value)))
         if (algo, op) not in _warned_global_fallback:
             _warned_global_fallback.add((algo, op))
             from ..utils.logging import get_logger

@@ -22,6 +22,7 @@ from typing import Any, Callable, List, Optional
 import jax
 
 from .constants import ACCLTimeoutError, ACCLError, errorCode
+from .obs import metrics as _metrics
 
 
 class requestStatus(enum.Enum):
@@ -103,6 +104,17 @@ class Request:
                 self._duration_ns = time.monotonic_ns() - self._start_ns
             self._done = True
             self._cv.notify_all()
+        # retirement telemetry: completion counts by terminal status and
+        # the whole-request latency (issue -> complete, the PERFCNT
+        # duration) — the queue-level view the per-op dispatch histogram
+        # does not cover (async waits, external fulfillment)
+        _metrics.inc("accl_requests_total",
+                     labels=(("op", self.scenario),
+                             ("status", self.status.name.lower())))
+        if _metrics.ENABLED and self._duration_ns is not None:
+            _metrics.observe("accl_request_duration_seconds",
+                             self._duration_ns / 1e9,
+                             (("op", self.scenario),))
         if self._on_complete is not None:
             cb, self._on_complete = self._on_complete, None
             cb(self)

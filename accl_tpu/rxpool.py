@@ -21,6 +21,7 @@ import dataclasses
 from typing import List, Optional, Tuple
 
 from . import native as _native
+from .obs import metrics as _metrics
 
 #: slot lifecycle states (keep names aligned with the reference dump)
 IDLE = _native.SLOT_IDLE
@@ -50,6 +51,11 @@ class RxBufPool:
         self._slots: List[_Slot] = (
             [] if use_native else [_Slot() for _ in range(nslots)])
         self._nslots = nslots
+        # occupancy mirror for the high-water gauge: maintained from
+        # reserve/release outcomes so the metrics path never pays a
+        # free_slots recount (an O(nslots) scan, or a second native
+        # call) per eager segment
+        self._used = 0
 
     @property
     def is_native(self) -> bool:
@@ -63,12 +69,25 @@ class RxBufPool:
                 count: int) -> int:
         """Claim an IDLE slot for a parked segment; -1 when exhausted."""
         if self._native is not None:
-            return self._native.reserve(src, dst, tag, seqn, count)
-        for i, s in enumerate(self._slots):
-            if s.status == IDLE:
-                self._slots[i] = _Slot(ENQUEUED, src, dst, tag, seqn, count)
-                return i
-        return -1
+            slot = self._native.reserve(src, dst, tag, seqn, count)
+        else:
+            slot = -1
+            for i, s in enumerate(self._slots):
+                if s.status == IDLE:
+                    self._slots[i] = _Slot(ENQUEUED, src, dst, tag, seqn,
+                                           count)
+                    slot = i
+                    break
+        if slot >= 0:
+            self._used += 1
+            if _metrics.ENABLED:
+                # occupancy high-water: how deep eager backpressure ever
+                # drove the pool this session (the rx-ring headroom signal)
+                _metrics.gauge_max("accl_rx_pool_occupancy_highwater",
+                                   float(self._used))
+        elif _metrics.ENABLED:
+            _metrics.inc("accl_rx_pool_exhausted_total")
+        return slot
 
     def mark_reserved(self, slot: int) -> bool:
         if self._native is not None:
@@ -80,11 +99,15 @@ class RxBufPool:
 
     def release(self, slot: int) -> bool:
         if self._native is not None:
-            return self._native.release(slot)
-        if 0 <= slot < self._nslots and self._slots[slot].status != IDLE:
+            ok = self._native.release(slot)
+        elif 0 <= slot < self._nslots and self._slots[slot].status != IDLE:
             self._slots[slot] = _Slot()
-            return True
-        return False
+            ok = True
+        else:
+            ok = False
+        if ok and self._used > 0:
+            self._used -= 1
+        return ok
 
     @property
     def free_slots(self) -> int:
@@ -105,6 +128,7 @@ class RxBufPool:
             self._native.clear()
         else:
             self._slots = [_Slot() for _ in range(self._nslots)]
+        self._used = 0
 
     def dump(self) -> str:
         """``ACCL::dump_eager_rx_buffers`` analog (accl.cpp:999-1064)."""

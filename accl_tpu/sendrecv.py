@@ -29,9 +29,17 @@ import jax
 
 from .communicator import Communicator
 from .constants import TAG_ANY, ACCLError, errorCode
+from .obs import metrics as _metrics
 from .utils.logging import get_logger
 
 log = get_logger("sendrecv")
+
+# matcher-event labels, pre-built (post_send/post_recv sit under every
+# eager segment)
+_L_SEND_MATCHED = (("event", "send_matched"),)
+_L_SEND_PARKED = (("event", "send_parked"),)
+_L_RECV_MATCHED = (("event", "recv_matched"),)
+_L_RECV_PARKED = (("event", "recv_parked"),)
 
 
 @dataclasses.dataclass
@@ -136,9 +144,12 @@ class MatchingEngine:
                 self._release_slot(post)
                 if post.on_matched:
                     post.on_matched()
+                _metrics.inc("accl_match_events_total",
+                             labels=_L_SEND_MATCHED)
                 return True
             self._posts[sid] = post
             post._native_id = sid
+            _metrics.inc("accl_match_events_total", labels=_L_SEND_PARKED)
             return False
         prospective = self.comm.peek_outbound_seq(post.src, post.dst)
         candidate = None
@@ -164,8 +175,10 @@ class MatchingEngine:
             self._release_slot(post)
             if post.on_matched:
                 post.on_matched()
+            _metrics.inc("accl_match_events_total", labels=_L_SEND_MATCHED)
             return True
         self._pending_sends.append(post)
+        _metrics.inc("accl_match_events_total", labels=_L_SEND_PARKED)
         return False
 
     def post_recv(self, post: RecvPost) -> bool:
@@ -192,6 +205,9 @@ class MatchingEngine:
                 self._release_slot(s)
                 if s.on_matched:
                     s.on_matched()
+            _metrics.inc("accl_match_events_total",
+                         labels=(_L_RECV_MATCHED if rem == 0
+                                 else _L_RECV_PARKED))
             return rem == 0
         # pre-scan: refuse upfront if an eligible segment would straddle
         # this recv's boundary (consuming a prefix then parking forever
@@ -232,7 +248,9 @@ class MatchingEngine:
                 s.on_matched()
         if post.remaining > 0:
             self._pending_recvs.append(post)
+            _metrics.inc("accl_match_events_total", labels=_L_RECV_PARKED)
             return False
+        _metrics.inc("accl_match_events_total", labels=_L_RECV_MATCHED)
         return True
 
     def recv_capacity(self, src: int, dst: int, tag: int) -> int:

@@ -1,4 +1,18 @@
-"""Leveled logger (test/log/log.hpp:29-131 + ACCL_DEBUG host logging analog)."""
+"""Leveled logger (test/log/log.hpp:29-131 + ACCL_DEBUG host logging analog).
+
+Two multi-controller ergonomics on top of stdlib logging:
+
+* records are prefixed with this controller's process index once the
+  multiproc context is known (``[INFO accl_tpu.accl p2] ...``) — without
+  it, N workers' interleaved lines are indistinguishable. Resolution is
+  lazy and cached-on-success: the launcher env (``ACCL_PROC_ID``) wins,
+  else an already-initialized ``jax.distributed`` client's process id
+  (never touching backend bring-up), else no prefix (single-controller).
+* ``ACCL_LOG_LEVEL`` is re-read on every :func:`get_logger` call, so a
+  level change after the first import (e.g. a test flipping to DEBUG, or
+  a launcher exporting per-worker levels) takes effect instead of being
+  frozen by the first caller.
+"""
 from __future__ import annotations
 
 import logging
@@ -6,18 +20,76 @@ import os
 
 _LOGGER_NAME = "accl_tpu"
 
+#: cached process-index prefix; None = not yet resolved (re-probe),
+#: "" = resolved single-controller is NEVER cached — a context that
+#: appears later (jax.distributed.initialize after first log) must win
+_proc_prefix: str | None = None
+
+#: last OBSERVED value of the ACCL_LOG_LEVEL env var (sentinel = never
+#: read): the level is (re)applied only when the env actually changes, so
+#: an explicit set_log_level() is not fought by an unchanged environment
+_UNREAD = object()
+_seen_env: object = _UNREAD
+
+
+def _resolve_prefix() -> str:
+    """Process-index prefix, cached once KNOWN (a positive identity never
+    changes mid-process); unknown keeps re-probing cheaply."""
+    global _proc_prefix
+    if _proc_prefix is not None:
+        return _proc_prefix
+    env = os.environ.get("ACCL_PROC_ID")
+    if env is not None:
+        _proc_prefix = f" p{env}"
+        return _proc_prefix
+    try:
+        # read-only peek at an already-connected distributed client;
+        # never initializes anything
+        import sys
+        jd = sys.modules.get("jax")
+        if jd is not None:
+            from jax._src import distributed
+            st = distributed.global_state
+            if st.client is not None and st.process_id is not None:
+                _proc_prefix = f" p{st.process_id}"
+                return _proc_prefix
+    except Exception:
+        pass
+    return ""
+
+
+class _ContextFilter(logging.Filter):
+    """Injects ``accl_ctx`` (the rank/process prefix) into every record."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.accl_ctx = _resolve_prefix()
+        return True
+
 
 def get_logger(child: str | None = None) -> logging.Logger:
     name = _LOGGER_NAME if child is None else f"{_LOGGER_NAME}.{child}"
     logger = logging.getLogger(name)
-    if not logging.getLogger(_LOGGER_NAME).handlers:
+    root = logging.getLogger(_LOGGER_NAME)
+    if not root.handlers:
         handler = logging.StreamHandler()
         handler.setFormatter(
-            logging.Formatter("[%(levelname)s %(name)s] %(message)s")
+            logging.Formatter("[%(levelname)s %(name)s%(accl_ctx)s] "
+                              "%(message)s")
         )
-        root = logging.getLogger(_LOGGER_NAME)
+        handler.addFilter(_ContextFilter())
         root.addHandler(handler)
-        root.setLevel(os.environ.get("ACCL_LOG_LEVEL", "WARNING").upper())
+    # honor ACCL_LOG_LEVEL changes AFTER the first get_logger call: the
+    # env is re-read per call and applied exactly when it CHANGED, so a
+    # later export (or a test's monkeypatch.setenv) takes effect while a
+    # programmatic set_log_level() survives an unchanged environment
+    global _seen_env
+    env_val = os.environ.get("ACCL_LOG_LEVEL")
+    if env_val != _seen_env:
+        _seen_env = env_val
+        try:
+            root.setLevel((env_val or "WARNING").upper())
+        except ValueError:
+            root.setLevel("WARNING")
     return logger
 
 

@@ -63,9 +63,25 @@ SWEEP_POWS = ([12, 16] if os.environ.get("ACCL_BENCH_QUICK")
 _T0 = time.perf_counter()
 _BUDGET_S = float(os.environ.get("ACCL_BENCH_BUDGET_S", "540"))
 
+#: --trace destination directory; when set, every stage writes its own
+#: Chrome-trace JSON (one file per lane) beside the BENCH artifact
+_TRACE_DIR = None
+
 
 def _elapsed() -> float:
     return time.perf_counter() - _T0
+
+
+def _obs_blob() -> dict:
+    """Metrics snapshot + schema version for embedding in EVERY emitted
+    JSON line — including the crash stubs, so even a lost round says what
+    ran before it died. Keys are always present (None when the telemetry
+    package itself could not import)."""
+    try:
+        from accl_tpu.obs import metrics as _m
+        return {"obs_schema": _m.SCHEMA_VERSION, "metrics": _m.snapshot()}
+    except Exception:
+        return {"obs_schema": None, "metrics": None}
 
 
 def _log(msg: str) -> None:
@@ -87,13 +103,35 @@ def _run_stage(name: str, fn, retries: int = 1):
     attempt = 0
     while True:
         _log(f"{name}: start" + (f" (retry {attempt})" if attempt else ""))
+        _t = None
+        if _TRACE_DIR:
+            # per-lane host trace: the tracer is cleared per attempt so
+            # each lane's file holds exactly that attempt's spans
+            from accl_tpu.obs import trace as _t
+            _t.clear()
         try:
-            r = fn()
+            if _t is not None:
+                with _t.span(f"lane.{name}", cat="bench"):
+                    r = fn()
+                _t.TRACER.write(os.path.join(_TRACE_DIR,
+                                             f"{name}.trace.json"))
+            else:
+                r = fn()
             _log(f"{name}: done — {json.dumps(r, default=str)[:400]}")
             return r, None
         except BaseException as e:  # noqa: BLE001 — the artifact must land
             if isinstance(e, KeyboardInterrupt):
                 raise
+            if _t is not None:
+                # a crashed lane is the trace's whole reason to exist:
+                # keep every failed attempt's spans under a per-attempt
+                # name no retry (failed or successful) can clobber
+                try:
+                    _t.TRACER.write(os.path.join(
+                        _TRACE_DIR,
+                        f"{name}.failed{attempt}.trace.json"))
+                except Exception:
+                    pass
             err = f"{type(e).__name__}: {e}"
             _log(f"{name}: FAILED — {err[:500]}")
             if attempt < retries and _transient(e):
@@ -117,6 +155,10 @@ def _parse_args(argv=None):
         "--probe-timeout", type=float,
         default=float(os.environ.get("ACCL_BENCH_PROBE_S", "75")),
         help="TPU-backend preflight deadline in seconds (0 disables)")
+    ap.add_argument(
+        "--trace", default=os.environ.get("ACCL_BENCH_TRACE", ""),
+        help="directory for per-lane Chrome-trace JSON files (host spans; "
+             "loads in Perfetto / chrome://tracing); empty disables")
     return ap.parse_args(argv)
 
 
@@ -164,12 +206,21 @@ def main(argv=None) -> int:
                           "value": 0.0, "unit": "none",
                           "vs_baseline": 0.0,
                           "error": f"preflight: {probe_err}",
-                          "elapsed_s": round(_elapsed(), 1)}))
+                          "elapsed_s": round(_elapsed(), 1),
+                          **_obs_blob()}))
         return 1
 
     import accl_tpu
     from accl_tpu import Algorithm
     from accl_tpu.bench import harness
+
+    if args.trace:
+        global _TRACE_DIR
+        _TRACE_DIR = args.trace
+        os.makedirs(_TRACE_DIR, exist_ok=True)
+        from accl_tpu.obs import trace as _obs_trace
+        _obs_trace.start()
+        _log(f"tracing: per-lane Chrome-trace files under {_TRACE_DIR}")
 
     errors = []
 
@@ -188,7 +239,8 @@ def main(argv=None) -> int:
                           "value": 0.0, "unit": "none",
                           "vs_baseline": 0.0,
                           "errors": errors,
-                          "elapsed_s": round(_elapsed(), 1)}))
+                          "elapsed_s": round(_elapsed(), 1),
+                          **_obs_blob()}))
         return 1
     acc, comm = setup
     world = comm.world_size
@@ -279,6 +331,22 @@ def main(argv=None) -> int:
         out["value_chain"] = round(peak_chain, 3)
         out["sweep_chain"] = sweep_chain
 
+    # telemetry overhead lane (any world size): the precise number behind
+    # the "disabled telemetry adds <=1% host dispatch" budget, plus the
+    # enabled-registry delta for always-on deployments
+    if _lane_selected(lanes_filter, "obs_overhead") \
+            and _elapsed() <= _BUDGET_S:
+        from accl_tpu.bench import lanes as _obs_lanes
+
+        r, err = _run_stage("obs_overhead",
+                            lambda: _obs_lanes.bench_obs_overhead(acc))
+        if err:
+            errors.append(err)
+            out["obs_overhead"] = {"metric": "obs_overhead",
+                                   "error": err["error"]}
+        else:
+            out["obs_overhead"] = r
+
     if world > 1:
         # multi-chip: the collective-matmul overlap A/B lanes (the
         # fused-vs-(matmul + collective) efficiency beside resolved
@@ -363,6 +431,10 @@ def main(argv=None) -> int:
     if errors:
         out["errors"] = errors
     out["elapsed_s"] = round(_elapsed(), 1)
+    # every artifact carries the telemetry tier: the metrics snapshot
+    # (call/bytes/dispatch/fallback counters accumulated across all
+    # stages) and its schema version — context for what actually ran
+    out.update(_obs_blob())
     print(json.dumps(out))
     return 0
 
@@ -381,5 +453,6 @@ if __name__ == "__main__":
                           "value": 0.0, "unit": "none",
                           "vs_baseline": 0.0,
                           "error": f"{type(e).__name__}: {e}"[:1000],
-                          "elapsed_s": round(_elapsed(), 1)}))
+                          "elapsed_s": round(_elapsed(), 1),
+                          **_obs_blob()}))
         raise SystemExit(1)

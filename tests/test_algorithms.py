@@ -347,3 +347,26 @@ def test_global_algorithm_fallback_warns_once(accl, caplog):
         assert again == got
     assert sum("unsupported for scatter" in r.message
                for r in caplog.records) == 1
+
+
+def test_fallback_counter_counts_while_warning_dedupes(accl, caplog):
+    """Satellite regression (ISSUE r8): the warn-once set dedupes only
+    the LOG LINE — the fallback counter increments on EVERY occurrence,
+    so the telemetry tier keeps signal after the first hit."""
+    import logging
+
+    from accl_tpu.obs import metrics
+
+    cfg = accl.config.replace(algorithm=Algorithm.TREE)
+    comm = accl.global_comm()
+    algorithms._warned_global_fallback.discard(
+        (Algorithm.TREE, operation.allgather))
+    key = 'accl_algorithm_fallback_total{op="allgather",algorithm="tree"}'
+    before = metrics.snapshot()["counters"].get(key, 0.0)
+    with caplog.at_level(logging.WARNING, logger="accl_tpu.algorithms"):
+        for _ in range(3):
+            algorithms.select(operation.allgather, 1024, comm, cfg)
+    assert sum("unsupported for allgather" in r.message
+               for r in caplog.records) == 1        # log stays deduped
+    after = metrics.snapshot()["counters"][key]
+    assert after - before == 3.0                    # counter never dedupes

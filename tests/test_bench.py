@@ -116,18 +116,34 @@ def test_bench_script_lanes_filter_and_preflight(tmp_path):
     script = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "bench.py")
     env = dict(os.environ, JAX_PLATFORMS="cpu", ACCL_BENCH_QUICK="1")
-    # --lanes filter: sweep-only run emits the headline, skips lanes
-    r = subprocess.run([sys.executable, script, "--lanes", "sweep"],
+    # --lanes filter: sweep-only run emits the headline, skips lanes;
+    # --trace writes one Chrome-trace JSON per executed stage
+    trace_dir = str(tmp_path / "traces")
+    r = subprocess.run([sys.executable, script, "--lanes", "sweep",
+                        "--trace", trace_dir],
                       timeout=240, capture_output=True, text=True, env=env)
     assert r.returncode == 0, r.stderr[-800:]
     out = _json.loads(r.stdout.strip().splitlines()[-1])
     assert out["metric"] != "bench_crashed" and out["sweep"]
+    # ISSUE r8: the artifact embeds the metrics snapshot + schema version
+    # (the sweep measures compiled programs directly, so the snapshot's
+    # guarantee is structural — schema + the three tables always present)
+    assert out["obs_schema"] == 1
+    assert out["metrics"]["schema"] == 1
+    for table in ("counters", "gauges", "histograms"):
+        assert isinstance(out["metrics"][table], dict)
+    # per-lane trace file: standalone Chrome-trace JSON with the lane span
+    with open(os.path.join(trace_dir, "sweep_fused.trace.json")) as f:
+        doc = _json.load(f)
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "lane.sweep_fused" in names
     # a filter naming no stage skips the sweep too (fast no-op run)
     r = subprocess.run([sys.executable, script, "--lanes", "cmatmul_ag"],
                       timeout=240, capture_output=True, text=True, env=env)
     assert r.returncode == 0, r.stderr[-800:]
     out = _json.loads(r.stdout.strip().splitlines()[-1])
     assert out["sweep"] is None
+    assert "obs_schema" in out and "metrics" in out
     # preflight: an uninitializable backend dies in seconds with the stub
     env_bad = dict(env, JAX_PLATFORMS="no_such_tpu_plugin",
                    ACCL_BENCH_PROBE_S="30")
@@ -137,6 +153,8 @@ def test_bench_script_lanes_filter_and_preflight(tmp_path):
     out = _json.loads(r.stdout.strip().splitlines()[-1])
     assert out["metric"] == "bench_crashed"
     assert "preflight" in out["error"]
+    # even the crash stub carries the telemetry keys (ISSUE r8)
+    assert "obs_schema" in out and "metrics" in out
 
 
 def test_bw_fields_resolution_protocol(monkeypatch):
@@ -185,3 +203,18 @@ def test_bw_fields_resolution_protocol(monkeypatch):
     t = dict(base, per_op=honest, per_op_med=honest)
     f = lanes._bw_fields(t, nbytes, 3)
     assert f["resolved"] and f["value"] == round(bw(honest), 3)
+
+
+def test_obs_overhead_lane(accl):
+    """The telemetry-overhead lane reports disabled/enabled dispatch
+    latency plus the raw disabled-guard cost, and restores the metrics
+    flag it toggles."""
+    from accl_tpu.bench import lanes
+    from accl_tpu.obs import metrics
+
+    r = lanes.bench_obs_overhead(accl, count=1 << 10, calls=4, rounds=2)
+    assert r["metric"] == "obs_overhead" and r["unit"] == "us"
+    assert r["dispatch_disabled_us"] > 0
+    assert r["dispatch_enabled_us"] > 0
+    assert r["disabled_guard_ns"] >= 0
+    assert metrics.ENABLED        # the lane restores the flag

@@ -144,3 +144,40 @@ def test_timer():
     t.start()
     t.end()
     assert t.elapsed() >= 0.0
+
+
+def test_scan_reports_live_protocol_state(accl):
+    """ISSUE r8 satellite: scan() is a real introspection surface — ranks
+    owned by this controller report live queue depth, parked-continuation
+    count, and eager rx-pool free/total slots beside the topology facts."""
+    recs = accl.scan()
+    assert len(recs) == 8
+    for rec in recs:   # single-controller: every rank is local
+        assert rec["queue_depth"] == 0
+        assert rec["parked_continuations"] == 0
+        assert rec["rx_pool_total"] == accl.config.eager_rx_buffer_count
+        assert 0 <= rec["rx_pool_free"] <= rec["rx_pool_total"]
+    # an in-flight async request is visible through scan() until retired
+    a = accl.create_buffer(8, dataType.float32)
+    b = accl.create_buffer(8, dataType.float32)
+    req = accl.copy(a, b, 8, run_async=True)
+    assert accl.scan()[0]["queue_depth"] >= 1
+    req.wait()
+    assert accl.scan()[0]["queue_depth"] == 0
+
+
+def test_stats_roundtrips_json(accl):
+    """Acceptance (ISSUE r8): ACCL.stats() returns queue/matcher/rx-pool/
+    metrics state that round-trips through json.dumps."""
+    import json
+
+    s = accl.stats()
+    decoded = json.loads(json.dumps(s))
+    assert decoded["queue"]["inflight"] == 0
+    assert decoded["scheduler"]["parked_continuations"] == 0
+    assert decoded["comms"][0]["world_size"] == 8
+    assert decoded["comms"][0]["rx_pool"]["total"] == \
+        accl.config.eager_rx_buffer_count
+    assert decoded["config"]["segment_size"] == accl.config.segment_size
+    assert "counters" in decoded["metrics"]
+    assert decoded["program_cache"]["programs"] >= 0
