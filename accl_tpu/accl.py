@@ -90,6 +90,9 @@ class ACCL:
         _cm_ops.set_overlap_enabled(cfg.cmatmul_overlap)
         _cm_ops.set_overlap_thresholds(cfg.ag_matmul_threshold,
                                        cfg.rs_matmul_threshold)
+        _cm_ops.set_overlap_class_thresholds(
+            cfg.ag_matmul_class_thresholds, cfg.rs_matmul_class_thresholds)
+        _cm_ops.set_wire_dtype(cfg.cmatmul_wire_dtype)
 
     def __init__(
         self,
@@ -124,9 +127,12 @@ class ACCL:
         """accl.cpp:1082-1130 analog."""
         if self._initialized:
             return
-        # fresh session: the once-per-pair fallback warning set is
+        # fresh session: the once-per-pair fallback warning sets are
         # module-global and must not inherit a prior session's silence
         algorithms.reset_global_fallback_warnings()
+        from .ops import collective_matmul as _cm_ops
+
+        _cm_ops.reset_fallback_warnings()
         if self.config.transport is None:
             from .utils.bringup import detect_backend
 

@@ -468,12 +468,15 @@ def measure_collective_matmul(comm, ms: Sequence[int],
                               dt: dataType = dataType.float32,
                               reps: int = 3,
                               bidirectional: bool = True,
-                              ops: Sequence[str] = ("agmm", "mmrs")) -> dict:
+                              ops: Sequence[str] = ("agmm", "mmrs"),
+                              wire_dtype=None) -> dict:
     """Per-algorithm best-of-`reps` wall time for the fused collective
     matmuls over a sweep of per-rank row counts ``ms``. Returns
     ``{op_name: {algo: [t, ...]}}`` for ``agmm`` (allgather_matmul,
     LHS shard (m, k)) and ``mmrs`` (matmul_reduce_scatter, local rows
-    (m*world, k) so the scattered chunk is (m, n))."""
+    (m*world, k) so the scattered chunk is (m, n)). ``wire_dtype`` is
+    passed through to the builders so the measured programs stage the
+    wire the CALLER's config says, not the module session register."""
     import jax
     W = comm.world_size
     npdt = np.dtype(to_jax_dtype(dt))
@@ -481,9 +484,9 @@ def measure_collective_matmul(comm, ms: Sequence[int],
     w = jax.device_put(np.full((W, k, n), 1e-3, npdt), comm.sharding())
     for algo in algos:
         ag_prog = algorithms.build_allgather_matmul(
-            comm, algo, bidirectional=bidirectional)
+            comm, algo, bidirectional=bidirectional, wire_dtype=wire_dtype)
         rs_prog = algorithms.build_matmul_reduce_scatter(
-            comm, algo, bidirectional=bidirectional)
+            comm, algo, bidirectional=bidirectional, wire_dtype=wire_dtype)
         for m in ms:
             if "agmm" in ops:
                 x = jax.device_put(np.full((W, m, k), 1e-3, npdt),
@@ -498,20 +501,33 @@ def measure_collective_matmul(comm, ms: Sequence[int],
     return out
 
 
+#: (k, n) block shapes the collective-matmul autotune sweeps — one per
+#: aspect-ratio class (square / wide / tall): the fused-vs-XLA
+#: crossover depends on the block shape (a wide block amortizes each
+#: hop's transfer over more MXU work), so one fixed (512, 512) point
+#: (rounds 7-8) could not see the dependence (ROADMAP open item).
+CMATMUL_ASPECT_CLASSES = ((512, 512), (256, 1024), (1024, 256))
+
+
 def autotune_collective_matmul(acc, cfg: Optional[ACCLConfig] = None,
                                pows: Sequence[int] = (7, 9, 11),
-                               k: int = 512, n: int = 512,
+                               k: Optional[int] = None,
+                               n: Optional[int] = None,
                                reps: int = 3,
-                               dt: dataType = dataType.float32
+                               dt: dataType = dataType.float32,
+                               classes: Optional[Sequence] = None
                                ) -> ACCLConfig:
     """Measure the comm/compute-overlapped collective matmuls against the
-    unfused XLA pairs on the live mesh and write the crossovers to
-    ``ag_matmul_threshold`` / ``rs_matmul_threshold`` (the overlap-vs-XLA
-    registers select() reads for the allgather_matmul /
-    matmul_reduce_scatter operations). Units match select()'s byte
-    conventions: the (m, k) LHS shard for agmm, the (m, n) f32
-    travelling accumulator for mmrs. ICI only — the kernels would
-    measure the simulator anywhere else."""
+    unfused XLA pairs on the live mesh, one crossover per (k, n)
+    ASPECT-RATIO CLASS (``CMATMUL_ASPECT_CLASSES``; explicit ``k``/``n``
+    or ``classes`` narrow the sweep), and write the results to the
+    per-class registers ``ag_matmul_class_thresholds`` /
+    ``rs_matmul_class_thresholds`` — the square class also lands in the
+    scalar ``ag_matmul_threshold`` / ``rs_matmul_threshold`` select()
+    reads. Units match select()'s byte conventions: the (m, k) LHS
+    shard for agmm, the (m, n) f32 travelling accumulator for mmrs
+    (both in EFFECTIVE wire bytes under the session wire dtype). ICI
+    only — the kernels would measure the simulator anywhere else."""
     from ..ops import collective_matmul as cm
 
     cfg = cfg or acc.config
@@ -521,37 +537,63 @@ def autotune_collective_matmul(acc, cfg: Optional[ACCLConfig] = None,
     W = comm.world_size
     if W == 1:
         return cfg
+    if classes is None:
+        classes = (((k or 512), (n or 512)),) \
+            if (k is not None or n is not None) else CMATMUL_ASPECT_CLASSES
     bidir = acc.config.bidirectional_rings
-    elem = np.dtype(to_jax_dtype(dt)).itemsize
     npdt = to_jax_dtype(dt)
-    # sweep only sizes whose overlap PLAN fits: beyond the VMEM budget
-    # the "PALLAS" builder silently runs the XLA fallback, and a
-    # crossover computed over those points would time XLA against
-    # itself and write DISABLED on a healthy mesh
-    ms_ag = [m for m in (2 ** p for p in pows)
-             if cm.agmm_plan(m, k, n, W, npdt, bidir) is not None]
-    ms_rs = [m for m in (2 ** p for p in pows)
-             if cm.mmrs_plan(W * m, k, n, W, npdt, bidir) is not None]
+    # "off" pins full precision when the TUNED config has no wire dtype
+    # (never inherit the module session register mid-measurement): the
+    # SAME resolved wire request feeds the measured programs (via the
+    # builders) and the crossover byte units below
+    wire = cfg.cmatmul_wire_dtype or "off"
+    ag_elem = cm.wire_itemsize(npdt, wire)      # shard wire bytes/elem
+    rs_elem = cm.wire_itemsize(np.float32, wire)  # f32 acc wire bytes
     algos = [Algorithm.XLA, Algorithm.PALLAS]
-    if ms_ag:
-        t = measure_collective_matmul(comm, ms_ag, algos, k=k, n=n, dt=dt,
-                                      reps=reps, bidirectional=bidir,
-                                      ops=("agmm",))
-        ag_at = _crossover([m * k for m in ms_ag],
-                           t["agmm"][Algorithm.XLA],
-                           t["agmm"][Algorithm.PALLAS], elem)
-        cfg = cfg.replace(
-            ag_matmul_threshold=ag_at if ag_at is not None else DISABLED)
-    if ms_rs:
-        t = measure_collective_matmul(comm, ms_rs, algos, k=k, n=n, dt=dt,
-                                      reps=reps, bidirectional=bidir,
-                                      ops=("mmrs",))
-        rs_at = _crossover([m * n for m in ms_rs],
-                           t["mmrs"][Algorithm.XLA],
-                           t["mmrs"][Algorithm.PALLAS], 4)  # f32 acc
-        cfg = cfg.replace(
-            rs_matmul_threshold=rs_at if rs_at is not None else DISABLED)
-    return cfg
+    ag_classes = dict(cfg.ag_matmul_class_thresholds)
+    rs_classes = dict(cfg.rs_matmul_class_thresholds)
+    for kk, nn in classes:
+        cls = cm.aspect_class(kk, nn)
+        # sweep only sizes whose overlap PLAN engages (resident OR
+        # streaming): where even the k-blocked plan misses, the
+        # "PALLAS" builder runs the XLA fallback, and a crossover over
+        # those points would time XLA against itself and write
+        # DISABLED on a healthy mesh
+        # the admission plan must resolve the SAME wire dtype as the
+        # measured programs, or a size that only plans under the
+        # (cheaper) wire staging is silently dropped from the sweep
+        ag_wdt = cm._resolve_wire(wire, npdt)
+        rs_wdt = cm._resolve_wire(wire, np.float32)
+        ms_ag = [m for m in (2 ** p for p in pows)
+                 if cm.agmm_plan(m, kk, nn, W, npdt, bidir,
+                                 wire_dtype=ag_wdt) is not None]
+        ms_rs = [m for m in (2 ** p for p in pows)
+                 if cm.mmrs_plan(W * m, kk, nn, W, npdt, bidir,
+                                 wire_dtype=rs_wdt) is not None]
+        if ms_ag:
+            t = measure_collective_matmul(comm, ms_ag, algos, k=kk, n=nn,
+                                          dt=dt, reps=reps,
+                                          bidirectional=bidir,
+                                          ops=("agmm",), wire_dtype=wire)
+            ag_at = _crossover([m * kk for m in ms_ag],
+                               t["agmm"][Algorithm.XLA],
+                               t["agmm"][Algorithm.PALLAS], ag_elem)
+            ag_classes[cls] = ag_at if ag_at is not None else DISABLED
+            if cls == "square":
+                cfg = cfg.replace(ag_matmul_threshold=ag_classes[cls])
+        if ms_rs:
+            t = measure_collective_matmul(comm, ms_rs, algos, k=kk, n=nn,
+                                          dt=dt, reps=reps,
+                                          bidirectional=bidir,
+                                          ops=("mmrs",), wire_dtype=wire)
+            rs_at = _crossover([m * nn for m in ms_rs],
+                               t["mmrs"][Algorithm.XLA],
+                               t["mmrs"][Algorithm.PALLAS], rs_elem)
+            rs_classes[cls] = rs_at if rs_at is not None else DISABLED
+            if cls == "square":
+                cfg = cfg.replace(rs_matmul_threshold=rs_classes[cls])
+    return cfg.replace(ag_matmul_class_thresholds=ag_classes,
+                       rs_matmul_class_thresholds=rs_classes)
 
 
 def autotune_flash_bwd(acc, cfg: Optional[ACCLConfig] = None,

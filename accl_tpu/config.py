@@ -133,6 +133,25 @@ class ACCLConfig:
     cmatmul_overlap: bool = True
     ag_matmul_threshold: int = 256 * 1024       # allgather_matmul (bytes)
     rs_matmul_threshold: int = 256 * 1024       # matmul_reduce_scatter
+    # per-aspect-class overrides of the scalar registers above, keyed by
+    # collective_matmul.aspect_class ("square" / "wide" / "tall") — the
+    # fused-vs-XLA crossover depends on the (k, n) block shape, so
+    # autotune_collective_matmul sweeps 2-3 classes and records each
+    # class's measured crossover here; a class with no entry uses the
+    # scalar register. Same write-through as the scalars.
+    ag_matmul_class_thresholds: dict = dataclasses.field(
+        default_factory=dict)
+    rs_matmul_class_thresholds: dict = dataclasses.field(
+        default_factory=dict)
+    # wire dtype for collective-matmul staging (None = operand dtype):
+    # "bf16" stages shards (agmm, wgrad) and the travelling accumulator
+    # (mmrs) on the ICI at half the bytes while every accumulation
+    # stays f32 on-chip — the hp_compression "compress on the wire,
+    # accumulate wide" shape. Write-through to
+    # collective_matmul.set_wire_dtype; per-call override on every
+    # entry point ("off" forces full precision for one call). The
+    # select()/engage size registers see EFFECTIVE wire bytes.
+    cmatmul_wire_dtype: Optional[str] = None
 
     # flash-attention backward: "fused" runs the single-pass dK/dV+dQ
     # kernel wherever its VMEM plan fits (two-pass beyond); "two_pass"

@@ -122,33 +122,43 @@ def all_to_all(x, axis: str = AXIS):
 
 def all_gather_matmul(x, w, axis: str = AXIS, mesh_axes=None,
                       overlap: Optional[bool] = None,
-                      bidirectional: bool = True):
+                      bidirectional: bool = True,
+                      wire_dtype=None):
     """In-kernel comm/compute-overlapped ``all_gather(x, rows) @ w``
     (Megatron column-parallel forward over a row-sharded LHS): each
     arriving ring shard is multiplied while the next hop's remote DMA
     is in flight (ops/collective_matmul.py). ``overlap=None`` follows
-    the session default (``ACCLConfig.cmatmul_overlap``); the policy
-    falls back to the unfused XLA pair when the staged shard misses the
-    scoped-VMEM budget. On a multi-axis mesh pass the mesh's axis-name
+    the session default (``ACCLConfig.cmatmul_overlap``); shapes whose
+    full shard misses the scoped-VMEM budget pipeline through VMEM in
+    k-blocks (streaming mode), with the unfused XLA pair only as the
+    last-resort fallback. ``wire_dtype=None`` follows
+    ``ACCLConfig.cmatmul_wire_dtype`` (e.g. "bf16": the shard rides
+    the ICI at half the bytes, f32 accumulation on-chip; "off" forces
+    full precision). On a multi-axis mesh pass the mesh's axis-name
     order as ``mesh_axes`` (ring neighbors need flat device ids).
-    Differentiable — the backward runs the dual overlapped kernel."""
+    Differentiable — the backward runs the dual overlapped kernel for
+    dx AND the fused gathered wgrad for dw."""
     from .ops import collective_matmul as cm
     mesh_axes = tuple(mesh_axes) if mesh_axes else None
     return cm.all_gather_matmul(x, w, axis, mesh_axes, overlap,
-                                bidirectional)
+                                bidirectional, wire_dtype)
 
 
 def matmul_reduce_scatter(x, w, axis: str = AXIS, mesh_axes=None,
                           overlap: Optional[bool] = None,
-                          bidirectional: bool = True):
+                          bidirectional: bool = True,
+                          wire_dtype=None):
     """In-kernel comm/compute-overlapped ``reduce_scatter(x @ w, rows)``
     (row-parallel combine): the per-hop partial product is computed on
-    the MXU while the travelling accumulator's remote DMA is in flight.
+    the MXU while the travelling accumulator's remote DMA is in flight
+    (k-blocked from HBM when the chunk grid misses the VMEM budget).
+    ``wire_dtype`` stages the travelling accumulator on the wire in a
+    narrower dtype (every fold decompresses and accumulates in f32).
     Same policy/fallback semantics as :func:`all_gather_matmul`."""
     from .ops import collective_matmul as cm
     mesh_axes = tuple(mesh_axes) if mesh_axes else None
     return cm.matmul_reduce_scatter(x, w, axis, mesh_axes, overlap,
-                                    bidirectional)
+                                    bidirectional, wire_dtype)
 
 
 def put_next(x, axis: str = AXIS, offset: int = 1):

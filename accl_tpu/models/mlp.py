@@ -79,11 +79,14 @@ def param_specs() -> MLPParams:
 
 
 def _forward_local(p: MLPParams, x, overlap: Optional[bool] = False,
-                   mesh_axes=(DP_AXIS, TP_AXIS)):
+                   mesh_axes=(DP_AXIS, TP_AXIS), wire_dtype=None):
     """Per-rank forward; ``overlap`` picks the TP datapath (same math).
     None follows the session default and the tuned size registers
     (``cm.agmm_engages``/``mmrs_engages``, resolved at trace = build
-    time); an explicit True forces the fused kernels at any size."""
+    time); an explicit True forces the fused kernels at any size.
+    ``wire_dtype`` stages the collective-matmul ring payloads
+    compressed (None: session default ``ACCLConfig.cmatmul_wire_dtype``;
+    "off": full precision) — f32 accumulation on-chip either way."""
     from ..ops import collective_matmul as cm
 
     tp = lax.axis_size(TP_AXIS)
@@ -95,9 +98,11 @@ def _forward_local(p: MLPParams, x, overlap: Optional[bool] = False,
     # holds and would be strictly slower than the psum baseline
     if (tp > 1 and rows % tp == 0
             and cm.agmm_engages(rows // tp, x.shape[1], h_loc, tp,
-                                x.dtype, overlap)
+                                x.dtype, overlap, wire_dtype=wire_dtype,
+                                w_dtype=p.w1.dtype)
             and cm.mmrs_engages(rows, h_loc, p.w2.shape[1], tp,
-                                x.dtype, overlap)):
+                                x.dtype, overlap, wire_dtype=wire_dtype,
+                                w_dtype=p.w2.dtype)):
         # overlapped datapath: the column-parallel matmul regenerates
         # the full batch rows from each rank's row shard hop by hop
         # (x is tp-replicated, so the shards ARE x's row blocks), and
@@ -108,11 +113,13 @@ def _forward_local(p: MLPParams, x, overlap: Optional[bool] = False,
             x, lax.axis_index(TP_AXIS) * ms, ms, axis=0)
         h = dapi.all_gather_matmul(x_s, p.w1, axis=TP_AXIS,
                                    mesh_axes=mesh_axes,
-                                   overlap=overlap) + p.b1
+                                   overlap=overlap,
+                                   wire_dtype=wire_dtype) + p.b1
         h = jax.nn.gelu(h)
         y_s = dapi.matmul_reduce_scatter(h.astype(x.dtype), p.w2,
                                          axis=TP_AXIS, mesh_axes=mesh_axes,
-                                         overlap=overlap)
+                                         overlap=overlap,
+                                         wire_dtype=wire_dtype)
         # rebuild the dp-rank's full rows (the scattered halves of the
         # psum: all_gather(psum_scatter(p)) == psum(p))
         y = lax.all_gather(y_s, TP_AXIS, axis=0, tiled=True) + p.b2
@@ -129,14 +136,17 @@ def make_mesh(devices, dp: int, tp: int) -> Mesh:
     return Mesh(devs, (DP_AXIS, TP_AXIS))
 
 
-def make_forward(mesh: Mesh, overlap: Optional[bool] = None):
+def make_forward(mesh: Mesh, overlap: Optional[bool] = None,
+                 wire_dtype=None):
     """Jitted forward over the (dp, tp) mesh. ``overlap`` picks the TP
-    datapath (None: session default; see the module docstring)."""
+    datapath (None: session default; see the module docstring);
+    ``wire_dtype`` the collective-matmul wire staging."""
     specs = param_specs()
     axes = tuple(mesh.axis_names)
 
     def fwd(p, x):
-        return _forward_local(p, x, overlap=overlap, mesh_axes=axes)
+        return _forward_local(p, x, overlap=overlap, mesh_axes=axes,
+                              wire_dtype=wire_dtype)
 
     return jax.jit(
         shard_map(fwd, mesh=mesh, in_specs=(specs, P(DP_AXIS, None)),
@@ -145,7 +155,8 @@ def make_forward(mesh: Mesh, overlap: Optional[bool] = None):
 
 
 def make_train_step(mesh: Mesh, lr: float = 1e-2,
-                    overlap: Optional[bool] = None):
+                    overlap: Optional[bool] = None,
+                    wire_dtype=None):
     """One fused program: forward + backward + dp gradient allreduce + SGD.
 
     Returns ``step(params, x, targets) -> (new_params, loss)`` with params
@@ -153,7 +164,11 @@ def make_train_step(mesh: Mesh, lr: float = 1e-2,
     framework's north-star property applied to training). With
     ``overlap`` the TP matmuls of BOTH passes ride the collective-matmul
     kernels (their custom VJPs are each other's duals), producing the
-    same loss trajectory as the psum baseline to float tolerance.
+    same loss trajectory as the psum baseline to float tolerance. With
+    ``wire_dtype`` BOTH passes' ring payloads (shards, travelling
+    accumulators, gathered wgrad operands) ride the ICI compressed
+    while every accumulation stays f32 (tolerance-bounded; see
+    docs/kernels.md).
     """
     specs = param_specs()
     dp_size = mesh.shape[DP_AXIS]
@@ -161,7 +176,8 @@ def make_train_step(mesh: Mesh, lr: float = 1e-2,
 
     def local_step(p: MLPParams, x, t):
         def loss_fn(p_):
-            y = _forward_local(p_, x, overlap=overlap, mesh_axes=axes)
+            y = _forward_local(p_, x, overlap=overlap, mesh_axes=axes,
+                               wire_dtype=wire_dtype)
             return jnp.mean((y - t) ** 2)
 
         loss, grads = jax.value_and_grad(loss_fn)(p)

@@ -38,9 +38,37 @@ collective-matmul duality), registered as ``jax.custom_vjp``:
                             dw = xᵀ @ all_gather(dy).
 
 A block-geometry policy (:func:`agmm_plan` / :func:`mmrs_plan`) sizes
-the staged shard against the scoped-VMEM budget and falls back to the
-unfused XLA pair when it misses — the same fallback shape the flash
-backward policy established (``ops/flash.py``).
+the staged shard against the scoped-VMEM budget.  When the WHOLE staged
+shard fits, the fully VMEM-resident kernels above run (``mode:
+resident``).  When it does not, the plan no longer falls back to XLA:
+it picks a ``k_block`` and the **streaming** kernels run (``mode:
+stream``) — the ``pallas_chunked`` segmentation discipline applied to
+the matmul operand.  The per-hop shard pipelines HBM→VMEM in k-blocks
+through the same double-buffered credit-semaphore staging; only the
+k-BLOCK (not the shard) must fit the scoped-VMEM budget.  The unfused
+XLA pair remains only for kernels-unavailable rungs, thresholds, and
+degenerate geometries (every fallback is counted in
+``accl_cmatmul_fallback_total`` by reason).
+
+**Fused dgrad/wgrad** (round 9): both ``custom_vjp`` backward rules now
+overlap BOTH gradients.  dx was already the dual kernel; dw — formerly
+an unfused ``all_gather`` + matmul — runs :func:`gathered_wgrad_body`:
+the all-gather of x (agmm) / dy (mmrs) is folded into the dw matmul's
+k-sweep, each arriving ring shard contributing its ``xᵀ@dy`` partial
+(a dim-0-contracting ``dot_general``, the flash-backward idiom) while
+the next hop's remote DMA is in flight.
+
+**bf16 wire staging**: shards and travelling accumulators can ride the
+ICI in a narrower wire dtype while every accumulation stays f32
+on-chip — the reference's ``hp_compression`` shape ("compress on the
+wire, accumulate wide"), via ``ops/compression.pallas_cast`` on the
+staged operand and in-kernel wire staging for the travelling mm×rs
+accumulator.  Halves ICI bytes; gated by the
+``ACCLConfig.cmatmul_wire_dtype`` write-through register with a
+per-call ``wire_dtype`` override on every entry point.  agmm's wire
+payload is the INPUT shard (rounded once — bit-exact whenever the
+inputs are wire-representable); mm×rs rounds the travelling PARTIAL
+SUM once per hop (tolerance-bounded; see docs/kernels.md).
 """
 from __future__ import annotations
 
@@ -53,6 +81,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..obs import metrics as _metrics
 from ..parallel import pallas_ring as _pr
 from ..parallel.pallas_ring import _LANES, _sublane
 
@@ -111,6 +140,161 @@ def set_overlap_thresholds(ag_bytes: int, rs_bytes: int) -> None:
 
 def get_overlap_thresholds() -> Tuple[int, int]:
     return _AG_THRESHOLD, _RS_THRESHOLD
+
+
+#: per-aspect-class overrides of the scalar registers above, keyed by
+#: :func:`aspect_class` name — the autotune crossover is shape-dependent
+#: (a wide (k, n) amortizes the ring differently than a tall one), so
+#: ``bench.autotune_collective_matmul`` sweeps 2-3 aspect classes and
+#: records each class's crossover here (config write-through:
+#: ``ACCLConfig.ag_matmul_class_thresholds`` / ``rs_…``). A class with
+#: no entry falls back to the scalar register.
+_AG_CLASS_THRESHOLDS: dict = {}
+_RS_CLASS_THRESHOLDS: dict = {}
+
+
+def aspect_class(k: int, n: int) -> str:
+    """Aspect-ratio class of the (k, n) weight block: ``wide`` when the
+    output dim dominates (n >= 2k), ``tall`` when the contraction dim
+    does (k >= 2n), else ``square``. The autotune sweep measures one
+    crossover per class."""
+    if n >= 2 * k:
+        return "wide"
+    if k >= 2 * n:
+        return "tall"
+    return "square"
+
+
+def set_overlap_class_thresholds(ag: dict, rs: dict) -> None:
+    """Install the per-aspect-class crossover registers (config
+    write-through; autotuned). Keys are :func:`aspect_class` names."""
+    global _AG_CLASS_THRESHOLDS, _RS_CLASS_THRESHOLDS
+    _AG_CLASS_THRESHOLDS = dict(ag or {})
+    _RS_CLASS_THRESHOLDS = dict(rs or {})
+
+
+def get_overlap_class_thresholds() -> Tuple[dict, dict]:
+    return dict(_AG_CLASS_THRESHOLDS), dict(_RS_CLASS_THRESHOLDS)
+
+
+def _ag_threshold(k: int, n: int) -> int:
+    return int(_AG_CLASS_THRESHOLDS.get(aspect_class(k, n), _AG_THRESHOLD))
+
+
+def _rs_threshold(k: int, n: int) -> int:
+    return int(_RS_CLASS_THRESHOLDS.get(aspect_class(k, n), _RS_THRESHOLD))
+
+
+# ---------------------------------------------------------------------------
+# wire staging (compress on the wire, accumulate wide)
+# ---------------------------------------------------------------------------
+
+#: session wire-dtype register (``ACCLConfig.cmatmul_wire_dtype``
+#: write-through). None = wire rides the operand dtype (no compression).
+_WIRE_DTYPE_DEFAULT: Optional[str] = None
+
+_WIRE_NAMES = {
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+    "f16": jnp.float16, "float16": jnp.float16,
+}
+
+
+def set_wire_dtype(name) -> None:
+    """Set the session wire dtype for collective-matmul staging (config
+    write-through). ``None`` disables compression; per-call override:
+    the ``wire_dtype`` argument on every entry point (``"off"`` forces
+    full precision for one call)."""
+    global _WIRE_DTYPE_DEFAULT
+    if name is not None and not isinstance(name, str):
+        name = jnp.dtype(name).name
+    if name is not None and name not in _WIRE_NAMES:
+        raise ValueError(f"unsupported cmatmul wire dtype {name!r}; "
+                         f"one of {sorted(set(_WIRE_NAMES))} or None")
+    _WIRE_DTYPE_DEFAULT = name
+
+
+def get_wire_dtype() -> Optional[str]:
+    return _WIRE_DTYPE_DEFAULT
+
+
+def _resolve_wire(wire_dtype, operand_dtype):
+    """Resolve a per-call wire request against the session register to a
+    jnp dtype, or None for a full-precision wire. ``None`` follows the
+    session default; ``"off"``/``False`` force full precision. Never
+    upcasts: a wire dtype at least as wide as the operand resolves to
+    None (nothing to compress)."""
+    w = _WIRE_DTYPE_DEFAULT if wire_dtype is None else wire_dtype
+    if w in (None, "off", False):
+        return None
+    if isinstance(w, str):
+        if w not in _WIRE_NAMES:
+            # the per-call override is the only unvalidated input path
+            # (the session register validates in set_wire_dtype) — a
+            # typo must fail with the valid names, not a bare KeyError
+            raise ValueError(
+                f"unsupported cmatmul wire dtype {w!r}; one of "
+                f"{sorted(set(_WIRE_NAMES))}, 'off', or None")
+        wdt = _WIRE_NAMES[w]
+    else:
+        wdt = w
+    if jnp.dtype(wdt).itemsize >= jnp.dtype(operand_dtype).itemsize:
+        return None
+    return wdt
+
+
+def wire_itemsize(dtype, wire_dtype=None) -> int:
+    """EFFECTIVE per-element wire bytes for a collective-matmul payload
+    under the given wire request (session default at None) — what the
+    size thresholds must see (a bf16-staged f32 shard moves half the
+    bytes, so it clears a byte register at twice the element count)."""
+    wdt = _resolve_wire(wire_dtype, dtype)
+    return jnp.dtype(wdt if wdt is not None else dtype).itemsize
+
+
+def _wire_cast(x, wdt):
+    """Stage an operand into the wire dtype via the hp_compression Pallas
+    lane (the cast the packetizer-front lane performs in the reference);
+    identity when no compression resolved."""
+    if wdt is None or x.dtype == jnp.dtype(wdt):
+        return x
+    from . import compression
+    return compression.pallas_cast(x, wdt)
+
+
+# ---------------------------------------------------------------------------
+# fallback accounting: every plan/policy fallback is counted by reason
+# (the round-8 telemetry sees what the warn-once log hides)
+# ---------------------------------------------------------------------------
+
+#: (op, reason) pairs already warned about — log dedup only; the counter
+#: increments on EVERY fallback. Session-scoped like the algorithms
+#: fallback set: ACCL.initialize() clears it via
+#: :func:`reset_fallback_warnings`.
+_warned_fallback: set = set()
+
+
+def reset_fallback_warnings() -> None:
+    """Session hook (called by ``ACCL.initialize``): forget which
+    (op, reason) fallbacks were already warned about."""
+    _warned_fallback.clear()
+
+
+def _note_fallback(op: str, reason: str) -> None:
+    """One collective-matmul fused-path fallback: bump
+    ``accl_cmatmul_fallback_total{op, reason}`` (reasons: ``vmem_miss``
+    — no plan geometry fits even a k-block; ``no_interpret`` — no
+    backend that can execute remote DMA; ``threshold`` — the session
+    size register declined) and warn once per (op, reason). Runs at
+    trace/build time, so the count is per compiled program, not per
+    step."""
+    _metrics.inc("accl_cmatmul_fallback_total",
+                 labels=(("op", op), ("reason", reason)))
+    if (op, reason) not in _warned_fallback:
+        _warned_fallback.add((op, reason))
+        from ..utils.logging import get_logger
+        get_logger("collective_matmul").warning(
+            "collective matmul %s: fused kernel fallback (%s); "
+            "running the unfused XLA pair", op, reason)
 
 
 # ---------------------------------------------------------------------------
@@ -282,8 +466,9 @@ def _agmm_call(x, w, *, P: int, axis: str, mesh_axes: Tuple[str, ...],
 # ---------------------------------------------------------------------------
 
 def _mmrs_kernel(x_ref, w_ref, o_ref, acc_buf, recv_buf, send_sem,
-                 recv_sem, cap_sem, *, P: int, axis: str,
-                 mesh_axes: Tuple[str, ...], bidirectional: bool):
+                 recv_sem, cap_sem, *rest, P: int, axis: str,
+                 mesh_axes: Tuple[str, ...], bidirectional: bool,
+                 wire=None):
     """x_ref: (P, cp, kp) own LHS rows grouped by output chunk; w_ref:
     (kp, n); o_ref: (cp, n) f32; all VMEM.
 
@@ -296,7 +481,15 @@ def _mmrs_kernel(x_ref, w_ref, o_ref, acc_buf, recv_buf, send_sem,
 
     The seed partial (own chunk) is NOT overlapped — it gates hop 0's
     send — but every one of the P-1 hop partials is.
+
+    ``wire`` (a jnp dtype) adds a wire staging buffer (``rest[0]``):
+    the remote DMA carries the travelling accumulator compressed to the
+    wire dtype; the fold decompresses and accumulates in f32 — the
+    ``pallas_chunked`` per-hop wire discipline ("compress on the wire,
+    accumulate wide"). ``acc_buf`` stays f32; the rdma source switches
+    to the wire buffer, whose reuse ``rdma.wait_send()`` guards.
     """
+    wire_buf = rest[0] if wire is not None else None
     nchan = 2 if bidirectional else 1
     cp = o_ref.shape[0]
     ch = cp // nchan
@@ -314,7 +507,7 @@ def _mmrs_kernel(x_ref, w_ref, o_ref, acc_buf, recv_buf, send_sem,
     def _rdma(chan, slot):
         dst, _, _ = _dirs(chan, left, right, bidirectional)
         return pltpu.make_async_remote_copy(
-            src_ref=acc_buf.at[chan],
+            src_ref=(acc_buf if wire is None else wire_buf).at[chan],
             dst_ref=recv_buf.at[chan, slot],
             send_sem=send_sem.at[chan],
             recv_sem=recv_sem.at[chan, slot],
@@ -324,6 +517,8 @@ def _mmrs_kernel(x_ref, w_ref, o_ref, acc_buf, recv_buf, send_sem,
 
     for chan in range(nchan):
         acc_buf[chan] = partial(chan, pos)   # seed: own chunk's partial
+        if wire is not None:
+            wire_buf[chan] = acc_buf[chan].astype(wire)
 
     def hop(s, _):
         s = jnp.int32(s)
@@ -347,7 +542,8 @@ def _mmrs_kernel(x_ref, w_ref, o_ref, acc_buf, recv_buf, send_sem,
             p = partial(chan, idx)
 
             rdma.wait_recv()
-            folded = recv_buf[chan, slot] + p
+            # decompress at the fold: accumulation stays f32 on-chip
+            folded = recv_buf[chan, slot].astype(o_ref.dtype) + p
 
             # recv slot consumed -> grant upstream a credit for s+2
             @pl.when(s + 2 <= hops - 1)
@@ -358,6 +554,8 @@ def _mmrs_kernel(x_ref, w_ref, o_ref, acc_buf, recv_buf, send_sem,
 
             rdma.wait_send()          # send staging drained
             acc_buf[chan] = folded
+            if wire is not None:
+                wire_buf[chan] = folded.astype(wire)   # compress lane
         return 0
 
     lax.fori_loop(0, hops, hop, 0)
@@ -366,24 +564,29 @@ def _mmrs_kernel(x_ref, w_ref, o_ref, acc_buf, recv_buf, send_sem,
 
 
 def _mmrs_call(x, w, *, P: int, axis: str, mesh_axes: Tuple[str, ...],
-               out_dtype, bidirectional: bool):
+               out_dtype, bidirectional: bool, wire=None):
     _, cp, kp = x.shape
     n = w.shape[1]
     nchan = 2 if bidirectional else 1
+    scratch = [
+        pltpu.VMEM((nchan, cp // nchan, n), out_dtype),     # acc_buf
+        pltpu.VMEM((nchan, 2, cp // nchan, n),
+                   wire if wire is not None else out_dtype),  # recv_buf
+        pltpu.SemaphoreType.DMA((nchan,)),                  # send_sem
+        pltpu.SemaphoreType.DMA((nchan, 2)),                # recv_sem
+        pltpu.SemaphoreType.REGULAR((nchan,)),              # cap_sem
+    ]
+    if wire is not None:
+        scratch.append(pltpu.VMEM((nchan, cp // nchan, n), wire))
     return pl.pallas_call(
         functools.partial(_mmrs_kernel, P=P, axis=axis,
-                          mesh_axes=mesh_axes, bidirectional=bidirectional),
+                          mesh_axes=mesh_axes, bidirectional=bidirectional,
+                          wire=wire),
         out_shape=jax.ShapeDtypeStruct((cp, n), out_dtype),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
                   pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        scratch_shapes=[
-            pltpu.VMEM((nchan, cp // nchan, n), out_dtype),     # acc_buf
-            pltpu.VMEM((nchan, 2, cp // nchan, n), out_dtype),  # recv_buf
-            pltpu.SemaphoreType.DMA((nchan,)),                  # send_sem
-            pltpu.SemaphoreType.DMA((nchan, 2)),                # recv_sem
-            pltpu.SemaphoreType.REGULAR((nchan,)),              # cap_sem
-        ],
+        scratch_shapes=scratch,
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True, collective_id=9),
         interpret=_interpret_params(),
@@ -391,59 +594,684 @@ def _mmrs_call(x, w, *, P: int, axis: str, mesh_axes: Tuple[str, ...],
 
 
 # ---------------------------------------------------------------------------
-# block-geometry policy (the flash fallback shape: a plan, or None -> XLA)
+# k-blocked STREAMING all-gather x matmul: payload, weights and output
+# stay in HBM; the per-hop shard pipelines through VMEM in k-blocks
+# ---------------------------------------------------------------------------
+
+def _agmm_stream_kernel(x_ref, w_ref, o_ref, bounce_ref, send_buf,
+                        recv_buf, wbuf, acc, send_sem, recv_sem, cap_sem,
+                        ld_sem, wld_sem, st_sem, ost_sem, *, P: int,
+                        axis: str, mesh_axes: Tuple[str, ...],
+                        bidirectional: bool, nkb: int):
+    """x_ref: (nkb, mp, kb) own LHS shard, SEGMENT-major (the wrapper
+    splits the k dim so every DMA below is a leading-index copy);
+    w_ref: (nkb, kb, n); o_ref: (P, mp, n) f32 — all HBM (``pl.ANY``).
+    ``bounce_ref``: (nchan, nkb, mh, kb) HBM relay scratch (an extra
+    output the wrapper discards, the ``_chunked_alltoall_kernel``
+    bounce idiom).
+
+    The ``pallas_chunked`` segmentation discipline applied to the
+    matmul operand: global step ``u = t*nkb + j`` moves SEGMENT j of
+    transfer t (t = 0: the own shard, loaded from x_ref; t > 0: the
+    relay of the previous hop's arrival, reloaded from the bounce —
+    ``_chunked_gather_kernel``'s store-and-forward). Each arriving
+    (mh, kb) segment is multiplied against the staged (kb, n) w block
+    and accumulated into the hop's resident f32 (mh, n) accumulator;
+    on the hop's last segment the block flushes to HBM. Our own send
+    is always in flight during the step's MXU work, so the per-hop
+    comm/compute overlap of the resident kernel survives segmentation.
+
+    Output phases (local block = phase 0, hop t = phase t+1) alternate
+    the two accumulator slots; a phase's flush is consumed exactly once
+    — by phase+2's first accumulate, or the epilogue. Credit discipline
+    verbatim from the resident kernels: recv slots key on step parity,
+    a writer gates on the consumer having matmul'd AND flushed the
+    slot's previous content, grants == gates, every semaphore drains
+    to zero.
+    """
+    nchan = 2 if bidirectional else 1
+    mh = acc.shape[2]
+    pos, _, left, right = _flat_ids(axis, mesh_axes, P)
+    _pr._ring_barrier(left, right)
+    hops = P - 1
+    U = hops * nkb          # static: total segment transfers per channel
+
+    def rows(chan):
+        return pl.ds(chan * mh, mh)
+
+    def _rdma(chan, slot):
+        dst, _, _ = _dirs(chan, left, right, bidirectional)
+        return pltpu.make_async_remote_copy(
+            src_ref=send_buf.at[chan, slot],
+            dst_ref=recv_buf.at[chan, slot],
+            send_sem=send_sem.at[chan, slot],
+            recv_sem=recv_sem.at[chan, slot],
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    def wait_ost(chan, aslot):
+        """Consume one accumulator-flush completion (descriptor
+        recreated for its size — the chunked wait_store pattern)."""
+        pltpu.make_async_copy(
+            acc.at[chan, aslot], o_ref.at[0, rows(chan)],
+            ost_sem.at[chan, aslot]).wait()
+
+    def step(u, _):
+        u = jnp.int32(u)
+        t = u // jnp.int32(nkb)
+        j = lax.rem(u, jnp.int32(nkb))
+        slot = lax.rem(u, jnp.int32(2))
+        aslot = lax.rem(t + jnp.int32(1), jnp.int32(2))
+        local_phase = t == 0
+
+        # the step's w k-block fetch overlaps the sends + the wire wait
+        wld = pltpu.make_async_copy(w_ref.at[j], wbuf, wld_sem)
+        wld.start()
+
+        # ---- send side: transfer (t, j) -------------------------------
+        for chan in range(nchan):
+            # deferred drain: this send slot's u-2 transfer completes
+            # before the reload overwrites it (chunked_scatter root)
+            @pl.when(u >= 2)
+            def _drain(chan=chan, slot=slot):
+                _rdma(chan, slot).wait_send()
+
+            # stage the outgoing segment: own shard at t = 0, the relay
+            # of the previous hop's arrival (bounce) after
+            @pl.when(local_phase)
+            def _own(chan=chan, slot=slot, j=j):
+                d = pltpu.make_async_copy(
+                    x_ref.at[j, rows(chan)], send_buf.at[chan, slot],
+                    ld_sem.at[chan])
+                d.start()
+                d.wait()
+
+            @pl.when(jnp.logical_not(local_phase))
+            def _relay(chan=chan, slot=slot, j=j):
+                d = pltpu.make_async_copy(
+                    bounce_ref.at[chan, j], send_buf.at[chan, slot],
+                    ld_sem.at[chan])
+                d.start()
+                d.wait()
+
+            # credit gate: downstream consumed its slot's u-2 content
+            @pl.when(u >= 2)
+            def _gate(chan=chan):
+                pltpu.semaphore_wait(cap_sem.at[chan], 1)
+
+            _rdma(chan, slot).start()
+
+        wld.wait()
+
+        # ---- compute + recv side --------------------------------------
+        for chan in range(nchan):
+            _, upstream, sign = _dirs(chan, left, right, bidirectional)
+            src_idx = lax.rem(pos + sign * (t + jnp.int32(1))
+                              + jnp.int32(2 * P), jnp.int32(P))
+
+            # local block (phase 0): the staged own segment, same w
+            # block — its matmul hides transfer 0, as in the resident
+            # kernel's prologue
+            @pl.when(local_phase)
+            def _local(chan=chan, slot=slot, j=j):
+                p = jnp.dot(send_buf[chan, slot], wbuf[...],
+                            preferred_element_type=jnp.float32)
+                acc[chan, 0] = jnp.where(j == 0, p, acc[chan, 0] + p)
+
+                @pl.when(j == jnp.int32(nkb - 1))
+                def _store0(chan=chan):
+                    pltpu.make_async_copy(
+                        acc.at[chan, 0], o_ref.at[pos, rows(chan)],
+                        ost_sem.at[chan, 0]).start()
+
+            _rdma(chan, slot).wait_recv()
+
+            # phase t+1 reuses the slot phase t-1 flushed from: consume
+            # that store exactly once before the first accumulate
+            @pl.when(jnp.logical_and(j == 0, t >= 1))
+            def _accgate(chan=chan, aslot=aslot):
+                wait_ost(chan, aslot)
+
+            p = jnp.dot(recv_buf[chan, slot], wbuf[...],
+                        preferred_element_type=jnp.float32)
+            acc[chan, aslot] = jnp.where(j == 0, p, acc[chan, aslot] + p)
+
+            # flush the arrival for the relay at (t+1, j); the wait
+            # lands the store before the reload reads it (the
+            # chunked_gather store-and-forward discipline)
+            @pl.when(t < hops - 1)
+            def _flush(chan=chan, slot=slot, j=j):
+                st = pltpu.make_async_copy(
+                    recv_buf.at[chan, slot], bounce_ref.at[chan, j],
+                    st_sem.at[chan])
+                st.start()
+                st.wait()
+
+            # recv slot consumed (matmul + flush) -> grant upstream a
+            # credit for its step u+2 (grants == gates)
+            @pl.when(u + 2 <= U - 1)
+            def _grant(chan=chan, upstream=upstream):
+                pltpu.semaphore_signal(
+                    cap_sem.at[chan], inc=1, device_id=upstream,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+            @pl.when(j == jnp.int32(nkb - 1))
+            def _store(chan=chan, aslot=aslot, src_idx=src_idx):
+                pltpu.make_async_copy(
+                    acc.at[chan, aslot], o_ref.at[src_idx, rows(chan)],
+                    ost_sem.at[chan, aslot]).start()
+        return 0
+
+    lax.fori_loop(0, U, step, 0)
+
+    # epilogue: the last two sends and the last two accumulator flushes
+    # (phases P-2 and P-1) are still undrained — consume each exactly once
+    for chan in range(nchan):
+        _rdma(chan, (U - 1) % 2).wait_send()
+        if U >= 2:
+            _rdma(chan, (U - 2) % 2).wait_send()
+        wait_ost(chan, (P - 1) % 2)
+        wait_ost(chan, (P - 2) % 2)
+
+
+def _agmm_stream_call(xseg, wseg, *, P: int, axis: str,
+                      mesh_axes: Tuple[str, ...], bidirectional: bool,
+                      nkb: int, mp: int, np_: int):
+    """xseg: (nkb, mp, kb) segment-major shard; wseg: (nkb, kb, np_).
+    Returns the (P, mp, np_) f32 output (the HBM bounce is discarded)."""
+    kb = xseg.shape[2]
+    nchan = 2 if bidirectional else 1
+    mh = mp // nchan
+    out = pl.pallas_call(
+        functools.partial(_agmm_stream_kernel, P=P, axis=axis,
+                          mesh_axes=mesh_axes, bidirectional=bidirectional,
+                          nkb=nkb),
+        out_shape=(jax.ShapeDtypeStruct((P, mp, np_), jnp.float32),
+                   jax.ShapeDtypeStruct((nchan, nkb, mh, kb), xseg.dtype)),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)),
+        scratch_shapes=[
+            pltpu.VMEM((nchan, 2, mh, kb), xseg.dtype),    # send_buf
+            pltpu.VMEM((nchan, 2, mh, kb), xseg.dtype),    # recv_buf
+            pltpu.VMEM((kb, np_), wseg.dtype),             # wbuf
+            pltpu.VMEM((nchan, 2, mh, np_), jnp.float32),  # acc
+            pltpu.SemaphoreType.DMA((nchan, 2)),           # send_sem
+            pltpu.SemaphoreType.DMA((nchan, 2)),           # recv_sem
+            pltpu.SemaphoreType.REGULAR((nchan,)),         # cap_sem
+            pltpu.SemaphoreType.DMA((nchan,)),             # ld_sem
+            pltpu.SemaphoreType.DMA,                       # wld_sem
+            pltpu.SemaphoreType.DMA((nchan,)),             # st_sem
+            pltpu.SemaphoreType.DMA((nchan, 2)),           # ost_sem
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=10),
+        interpret=_interpret_params(),
+    )(xseg, wseg)
+    return out[0]
+
+
+# ---------------------------------------------------------------------------
+# k-blocked STREAMING matmul x reduce-scatter: the per-hop partial's
+# k-sweep streams from HBM while the accumulator is on the wire
+# ---------------------------------------------------------------------------
+
+def _mmrs_stream_kernel(x_ref, w_ref, o_ref, acc_buf, recv_buf, pacc,
+                        xblk, wblk, send_sem, recv_sem, cap_sem,
+                        xld_sem, wld_sem, *rest, P: int, axis: str,
+                        mesh_axes: Tuple[str, ...], bidirectional: bool,
+                        nkb: int, wire=None):
+    """x_ref: (P, nkb, cp, kb) segment-major chunk grid in HBM; w_ref:
+    (nkb, kb, n) in HBM; o_ref: (cp, n) f32 VMEM.
+
+    Ring schedule is ``_mmrs_kernel``'s verbatim (same slots, credits
+    and realignment contract); only the per-hop partial changes: it
+    streams (ch, kb) x-blocks and (kb, n) w-blocks from HBM and
+    accumulates in the f32 ``pacc`` scratch while the travelling
+    accumulator's remote DMA is in flight — so the k-sweep's HBM
+    traffic AND MXU work both hide under the wire time. ``wire`` adds
+    the compressed staging buffer (``rest[0]``) exactly as in the
+    resident kernel.
+    """
+    wire_buf = rest[0] if wire is not None else None
+    nchan = 2 if bidirectional else 1
+    cp = o_ref.shape[0]
+    ch = cp // nchan
+    pos, _, left, right = _flat_ids(axis, mesh_axes, P)
+    _pr._ring_barrier(left, right)
+    hops = P - 1
+
+    def rows(chan):
+        return pl.ds(chan * ch, ch)
+
+    def _rdma(chan, slot):
+        dst, _, _ = _dirs(chan, left, right, bidirectional)
+        return pltpu.make_async_remote_copy(
+            src_ref=(acc_buf if wire is None else wire_buf).at[chan],
+            dst_ref=recv_buf.at[chan, slot],
+            send_sem=send_sem.at[chan],
+            recv_sem=recv_sem.at[chan, slot],
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    def ksweep(idx_of, into):
+        """Streamed partial: ``into[chan] = Σ_j x[idx, j] @ w[j]``. The
+        block loads are waited immediately (single-slot staging); the
+        whole sweep runs while the hop's remote DMA is in flight."""
+        def kstep(j, _):
+            j = jnp.int32(j)
+            wld = pltpu.make_async_copy(w_ref.at[j], wblk, wld_sem)
+            wld.start()
+            wld.wait()
+            for chan in range(nchan):
+                xld = pltpu.make_async_copy(
+                    x_ref.at[idx_of(chan), j, rows(chan)], xblk,
+                    xld_sem)
+                xld.start()
+                xld.wait()
+                p = jnp.dot(xblk[...], wblk[...],
+                            preferred_element_type=o_ref.dtype)
+                into[chan] = jnp.where(j == 0, p, into[chan] + p)
+            return 0
+
+        lax.fori_loop(0, nkb, kstep, 0)
+
+    # seed: own chunk's partial (gates hop 0's send, as in the resident)
+    ksweep(lambda chan: pos, acc_buf)
+    if wire is not None:
+        for chan in range(nchan):
+            wire_buf[chan] = acc_buf[chan].astype(wire)
+
+    def hop(s, _):
+        s = jnp.int32(s)
+        slot = lax.rem(s, jnp.int32(2))
+
+        for chan in range(nchan):
+            # credit gate: downstream's fold of this slot's s-2 content
+            @pl.when(s >= 2)
+            def _gate(chan=chan):
+                pltpu.semaphore_wait(cap_sem.at[chan], 1)
+
+            _rdma(chan, slot).start()
+
+        def idx_of(chan):
+            _, _, sign = _dirs(chan, left, right, bidirectional)
+            return lax.rem(pos + sign * (s + jnp.int32(1))
+                           + jnp.int32(2 * P), jnp.int32(P))
+
+        # the hop's partial streams + computes while the wire flies
+        ksweep(idx_of, pacc)
+
+        for chan in range(nchan):
+            _, upstream, _ = _dirs(chan, left, right, bidirectional)
+            _rdma(chan, slot).wait_recv()
+            # decompress at the fold: accumulation stays f32 on-chip
+            folded = recv_buf[chan, slot].astype(o_ref.dtype) + pacc[chan]
+
+            @pl.when(s + 2 <= hops - 1)
+            def _grant(chan=chan, upstream=upstream):
+                pltpu.semaphore_signal(
+                    cap_sem.at[chan], inc=1, device_id=upstream,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+            _rdma(chan, slot).wait_send()
+            acc_buf[chan] = folded
+            if wire is not None:
+                wire_buf[chan] = folded.astype(wire)
+        return 0
+
+    lax.fori_loop(0, hops, hop, 0)
+    for chan in range(nchan):
+        o_ref[rows(chan)] = acc_buf[chan]
+
+
+def _mmrs_stream_call(xseg, wseg, *, P: int, axis: str,
+                      mesh_axes: Tuple[str, ...], out_dtype,
+                      bidirectional: bool, nkb: int, cp: int, np_: int,
+                      wire=None):
+    """xseg: (P, nkb, cp, kb) segment-major chunk grid; wseg:
+    (nkb, kb, np_). Returns the (cp, np_) f32 folded chunk (pre-
+    realignment, as the resident call)."""
+    kb = xseg.shape[3]
+    nchan = 2 if bidirectional else 1
+    ch = cp // nchan
+    scratch = [
+        pltpu.VMEM((nchan, ch, np_), out_dtype),            # acc_buf
+        pltpu.VMEM((nchan, 2, ch, np_),
+                   wire if wire is not None else out_dtype),  # recv_buf
+        pltpu.VMEM((nchan, ch, np_), out_dtype),            # pacc
+        pltpu.VMEM((ch, kb), xseg.dtype),                   # xblk
+        pltpu.VMEM((kb, np_), wseg.dtype),                  # wblk
+        pltpu.SemaphoreType.DMA((nchan,)),                  # send_sem
+        pltpu.SemaphoreType.DMA((nchan, 2)),                # recv_sem
+        pltpu.SemaphoreType.REGULAR((nchan,)),              # cap_sem
+        pltpu.SemaphoreType.DMA,                            # xld_sem
+        pltpu.SemaphoreType.DMA,                            # wld_sem
+    ]
+    if wire is not None:
+        scratch.append(pltpu.VMEM((nchan, ch, np_), wire))  # wire_buf
+    return pl.pallas_call(
+        functools.partial(_mmrs_stream_kernel, P=P, axis=axis,
+                          mesh_axes=mesh_axes, bidirectional=bidirectional,
+                          nkb=nkb, wire=wire),
+        out_shape=jax.ShapeDtypeStruct((cp, np_), out_dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=scratch,
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=11),
+        interpret=_interpret_params(),
+    )(xseg, wseg)
+
+
+# ---------------------------------------------------------------------------
+# fused gathered wgrad: the all-gather folded into dw's k-sweep
+# ---------------------------------------------------------------------------
+
+def _wgrad_kernel(trav_ref, loc_ref, o_ref, buf, lbuf, send_sem, recv_sem,
+                  cap_sem, lld_sem, *, P: int, axis: str,
+                  mesh_axes: Tuple[str, ...], bidirectional: bool,
+                  travel_lhs: bool):
+    """trav_ref: (msp, ctp) own shard of the GATHERED operand (VMEM);
+    loc_ref: (P, msp, clp) the resident operand's blocks by source rank
+    (HBM); o_ref: (ctp, clp) f32 (``travel_lhs``) / (clp, ctp) — the dw
+    accumulator panel.
+
+    ``dw = Σ_p shard_pᵀ @ loc_p`` (or the mirror): the gathered
+    operand's ring IS dw's k-sweep — each arriving shard contributes
+    its dim-0-contracting ``dot_general`` partial (the flash-backward
+    idiom) while the next hop's transfer is in flight. Ring schedule,
+    slots and credit discipline are ``_agmm_kernel``'s verbatim
+    (forward-before-compute, grants == gates); the local shard's
+    contribution overlaps transfer 0. Both row-half channels fold into
+    the SAME panel (the contraction dim is the row dim, so halves sum).
+    """
+    nchan = 2 if bidirectional else 1
+    msh = trav_ref.shape[0] // nchan
+    pos, _, left, right = _flat_ids(axis, mesh_axes, P)
+    _pr._ring_barrier(left, right)
+    hops = P - 1
+
+    def rows(chan):
+        return pl.ds(chan * msh, msh)
+
+    def _rdma(chan, src_slot, dst_slot, use_own: bool):
+        dst, _, _ = _dirs(chan, left, right, bidirectional)
+        src = (trav_ref.at[rows(chan), :] if use_own
+               else buf.at[chan, src_slot])
+        return pltpu.make_async_remote_copy(
+            src_ref=src,
+            dst_ref=buf.at[chan, dst_slot],
+            send_sem=send_sem.at[chan, dst_slot],
+            recv_sem=recv_sem.at[chan, dst_slot],
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    def ldloc(chan, idx):
+        d = pltpu.make_async_copy(loc_ref.at[idx, rows(chan)],
+                                  lbuf.at[chan], lld_sem.at[chan])
+        d.start()
+        d.wait()
+
+    def contrib(chan, seg):
+        loc = lbuf[chan]
+        if seg.dtype != loc.dtype:
+            # a narrow wire shard meets a wider local block:
+            # lax.dot_general requires matching operand dtypes (unlike
+            # jnp.dot), so up-convert to the common type. Matching
+            # operands (e.g. bf16 x bf16 training) keep their dtype —
+            # preferred_element_type=f32 already accumulates wide, and
+            # an unconditional f32 upcast would forfeit the bf16 MXU
+            # rate the fused path exists to win
+            wide = jnp.promote_types(seg.dtype, loc.dtype)
+            seg = seg.astype(wide)
+            loc = loc.astype(wide)
+        a, b = (seg, loc) if travel_lhs else (loc, seg)
+        return lax.dot_general(a, b, (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+    # prologue: launch transfer 0, then fold the LOCAL shard's
+    # contribution while the ring moves — hop 0 is already overlapped
+    for chan in range(nchan):
+        _rdma(chan, 0, 0, use_own=True).start()
+    for chan in range(nchan):
+        ldloc(chan, pos)
+        c = contrib(chan, trav_ref[rows(chan), :])
+        if chan == 0:
+            o_ref[...] = c
+        else:
+            o_ref[...] = o_ref[...] + c
+
+    def hop(t, _):
+        t = jnp.int32(t)
+        slot = lax.rem(t, jnp.int32(2))
+        nslot = lax.rem(t + 1, jnp.int32(2))
+
+        for chan in range(nchan):
+            _, upstream, sign = _dirs(chan, left, right, bidirectional)
+            src_idx = lax.rem(pos + sign * (t + jnp.int32(1))
+                              + jnp.int32(2 * P), jnp.int32(P))
+
+            _rdma(chan, slot, slot, use_own=False).wait_recv()
+
+            # forward the arrival before its matmul so transfer t+1 is
+            # in flight during the MXU work of hop t
+            @pl.when(t + 1 <= hops - 1)
+            def _fwd(chan=chan, slot=slot, nslot=nslot):
+                @pl.when(t + 1 >= 2)
+                def _gate():
+                    pltpu.semaphore_wait(cap_sem.at[chan], 1)
+                _rdma(chan, slot, nslot, use_own=False).start()
+
+            ldloc(chan, src_idx)
+            o_ref[...] = o_ref[...] + contrib(chan, buf[chan, slot])
+
+            @pl.when(t + 1 <= hops - 1)
+            def _drain(chan=chan, slot=slot, nslot=nslot):
+                _rdma(chan, slot, nslot, use_own=False).wait_send()
+
+            @pl.when(t == 0)
+            def _drain0(chan=chan):
+                _rdma(chan, 0, 0, use_own=True).wait_send()
+
+            @pl.when(t + 2 <= hops - 1)
+            def _grant(chan=chan, upstream=upstream):
+                pltpu.semaphore_signal(
+                    cap_sem.at[chan], inc=1, device_id=upstream,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+        return 0
+
+    lax.fori_loop(0, hops, hop, 0)
+
+
+def _wgrad_call(trav, loc, *, P: int, axis: str,
+                mesh_axes: Tuple[str, ...], bidirectional: bool,
+                travel_lhs: bool):
+    msp, ctp = trav.shape
+    clp = loc.shape[2]
+    nchan = 2 if bidirectional else 1
+    oshape = (ctp, clp) if travel_lhs else (clp, ctp)
+    return pl.pallas_call(
+        functools.partial(_wgrad_kernel, P=P, axis=axis,
+                          mesh_axes=mesh_axes, bidirectional=bidirectional,
+                          travel_lhs=travel_lhs),
+        out_shape=jax.ShapeDtypeStruct(oshape, jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((nchan, 2, msp // nchan, ctp), trav.dtype),  # buf
+            pltpu.VMEM((nchan, msp // nchan, clp), loc.dtype),      # lbuf
+            pltpu.SemaphoreType.DMA((nchan, 2)),                # send_sem
+            pltpu.SemaphoreType.DMA((nchan, 2)),                # recv_sem
+            pltpu.SemaphoreType.REGULAR((nchan,)),              # cap_sem
+            pltpu.SemaphoreType.DMA((nchan,)),                  # lld_sem
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=12),
+        interpret=_interpret_params(),
+    )(trav, loc)
+
+
+# ---------------------------------------------------------------------------
+# block-geometry policy: a resident plan when the whole staged shard
+# fits, a streaming plan when a k-BLOCK does, None only when even the
+# minimum k-block misses (caller falls back to the unfused XLA pair)
 # ---------------------------------------------------------------------------
 
 def _pad_to(v: int, mult: int) -> int:
     return -(-v // mult) * mult
 
 
+def _shrink_kb(kp: int, fits) -> Optional[int]:
+    """Largest lane-aligned k-block (halving sweep from the full padded
+    k) accepted by ``fits``; None when even the 128-lane minimum
+    misses."""
+    kb = kp
+    while kb > _LANES and not fits(kb):
+        kb = max(_LANES, _pad_to(kb // 2, _LANES))
+    return kb if fits(kb) else None
+
+
 def agmm_plan(m: int, k: int, n: int, P: int, dtype,
-              bidirectional: bool) -> Optional[dict]:
-    """Geometry for the overlapped all-gather-matmul, or None when the
-    staged shard misses the scoped-VMEM budget (caller falls back to
-    the unfused XLA pair). Everything is VMEM-resident: the shard, the
-    weight block, the (P, m, n) output and the double-buffered recv
-    slots must fit together."""
+              bidirectional: bool, w_dtype=None,
+              wire_dtype=None) -> Optional[dict]:
+    """Geometry for the overlapped all-gather-matmul.
+
+    ``mode: resident`` — everything VMEM-resident (the shard, the
+    weight block, the (P, m, n) f32 output panel and the
+    double-buffered recv slots fit together); ``mode: stream`` — the
+    shard pipelines through VMEM in lane-aligned ``kb`` k-blocks
+    (payload, weights and output stay in HBM; only 2 send + 2 recv
+    (mh, kb) slots, one (kb, n) weight block and 2 (mh, n) f32
+    accumulators per channel are resident). None only when even the
+    128-lane k-block misses (the irreducible m×n accumulator floor) —
+    the caller falls back to the unfused XLA pair.
+
+    ``wire_dtype`` sizes the staged/transferred x terms (wire staging
+    halves them under bf16); ``w_dtype`` sizes the weight terms when it
+    differs from the operand dtype."""
     if m < 1 or k < 1 or n < 1 or P < 1:
         return None
-    isz = jnp.dtype(dtype).itemsize
-    sub = _sublane(dtype)
+    xdt = jnp.dtype(wire_dtype) if wire_dtype is not None \
+        else jnp.dtype(dtype)
+    isz = xdt.itemsize
+    wisz = jnp.dtype(w_dtype).itemsize if w_dtype is not None \
+        else jnp.dtype(dtype).itemsize
+    sub = _sublane(xdt)
     nchan = 2 if (bidirectional and P >= 4) else 1
     mp = _pad_to(max(m, 1), sub * nchan)
     kp = _pad_to(max(k, 1), _LANES)   # lane dim of x, sublane dim of w
     np_ = _pad_to(max(n, 1), _LANES)
     est = (mp * kp * isz            # x shard
-           + kp * np_ * isz         # w block
+           + kp * np_ * wisz        # w block
            + P * mp * np_ * 4       # f32 output blocks
            + 2 * mp * kp * isz)     # recv slots (nchan halves sum to mp)
-    if est > _VMEM_BUDGET:
+    if est <= _VMEM_BUDGET:
+        return {"mode": "resident", "mp": mp, "kp": kp, "np": np_,
+                "nchan": nchan, "bidirectional": nchan == 2,
+                "kb": kp, "nkb": 1, "vmem_bytes": est}
+
+    def est_stream(kb):
+        return (4 * mp * kb * isz      # 2 send + 2 recv slots
+                + 2 * mp * np_ * 4     # double-buffered f32 accumulators
+                + kb * np_ * wisz)     # staged w k-block
+
+    kb = _shrink_kb(kp, lambda b: est_stream(b) <= _VMEM_BUDGET)
+    if kb is None:
         return None
-    return {"mp": mp, "kp": kp, "np": np_, "nchan": nchan,
-            "bidirectional": nchan == 2, "vmem_bytes": est}
+    nkb = -(-kp // kb)
+    return {"mode": "stream", "mp": mp, "kp": nkb * kb, "np": np_,
+            "nchan": nchan, "bidirectional": nchan == 2,
+            "kb": kb, "nkb": nkb, "vmem_bytes": est_stream(kb)}
 
 
 def mmrs_plan(m: int, k: int, n: int, P: int, dtype,
-              bidirectional: bool) -> Optional[dict]:
-    """Geometry for the overlapped matmul-reduce-scatter, or None when
-    the staged operands miss the scoped-VMEM budget. ``m`` is the FULL
-    local row count (must divide by P; the wrapper checks)."""
+              bidirectional: bool, w_dtype=None,
+              wire_dtype=None) -> Optional[dict]:
+    """Geometry for the overlapped matmul-reduce-scatter. ``m`` is the
+    FULL local row count (must divide by P; the wrapper checks).
+
+    ``mode: resident`` — the full chunk grid, weight block and
+    travelling accumulator are VMEM-resident; ``mode: stream`` — the
+    per-hop partial's k-sweep streams (cp, kb) x-blocks and (kb, n)
+    w-blocks from HBM while the travelling accumulator is on the wire
+    (the accumulator, recv slots, partial buffer and output chunk stay
+    VMEM-resident — they are the wire payload). ``wire_dtype`` sizes
+    the travelling-accumulator wire terms (staged/transferred as the
+    wire dtype, folded in f32)."""
     if m < 1 or k < 1 or n < 1 or P < 1 or m % P:
         return None
     isz = jnp.dtype(dtype).itemsize
+    acc_wisz = jnp.dtype(wire_dtype).itemsize if wire_dtype is not None \
+        else 4
+    wisz = jnp.dtype(w_dtype).itemsize if w_dtype is not None else isz
     sub = _sublane(dtype)
     nchan = 2 if (bidirectional and P >= 4) else 1
     cp = _pad_to(max(m // P, 1), sub * nchan)
     kp = _pad_to(max(k, 1), _LANES)   # lane dim of the chunk grid
     np_ = _pad_to(max(n, 1), _LANES)
+    wire_extra = cp * np_ * acc_wisz if wire_dtype is not None else 0
     est = (P * cp * kp * isz        # x grouped by chunk
-           + kp * np_ * isz         # w block
+           + kp * np_ * wisz        # w block
            + cp * np_ * 4           # f32 output chunk
            + cp * np_ * 4           # acc
-           + 2 * cp * np_ * 4)      # recv slots
+           + 2 * cp * np_ * acc_wisz  # recv slots (wire dtype)
+           + wire_extra)            # wire staging buffer
+    if est <= _VMEM_BUDGET:
+        return {"mode": "resident", "cp": cp, "kp": kp, "np": np_,
+                "nchan": nchan, "bidirectional": nchan == 2,
+                "kb": kp, "nkb": 1, "vmem_bytes": est}
+
+    def est_stream(kb):
+        return (cp * np_ * 4                # f32 output chunk
+                + cp * np_ * 4              # acc
+                + cp * np_ * 4              # per-hop partial (pacc)
+                + 2 * cp * np_ * acc_wisz   # recv slots
+                + wire_extra                # wire staging buffer
+                + (cp // nchan) * kb * isz  # streamed x block
+                + kb * np_ * wisz)          # streamed w block
+
+    kb = _shrink_kb(kp, lambda b: est_stream(b) <= _VMEM_BUDGET)
+    if kb is None:
+        return None
+    nkb = -(-kp // kb)
+    return {"mode": "stream", "cp": cp, "kp": nkb * kb, "np": np_,
+            "nchan": nchan, "bidirectional": nchan == 2,
+            "kb": kb, "nkb": nkb, "vmem_bytes": est_stream(kb)}
+
+
+def wgrad_plan(ms: int, ct: int, cl: int, P: int, trav_dtype, loc_dtype,
+               bidirectional: bool) -> Optional[dict]:
+    """Geometry for the fused gathered-wgrad kernel (``dw = Σ_p
+    contribution(shard_p, loc_block_p)``): the travelling shard
+    (ms, ct), its double-buffered recv slots, one per-channel local
+    block (ms/nchan, cl) and the f32 (ct, cl) accumulator output must
+    be VMEM-resident together. None -> the VJP keeps the unfused
+    gathered dw (same math, no overlap)."""
+    if ms < 1 or ct < 1 or cl < 1 or P < 1:
+        return None
+    tisz = jnp.dtype(trav_dtype).itemsize
+    lisz = jnp.dtype(loc_dtype).itemsize
+    # rows are the CONTRACTION dim here; pad by the stricter sublane of
+    # the two operands so both slice cleanly into row halves
+    sub = max(_sublane(trav_dtype), _sublane(loc_dtype))
+    nchan = 2 if (bidirectional and P >= 4) else 1
+    msp = _pad_to(max(ms, 1), sub * nchan)
+    ctp = _pad_to(max(ct, 1), _LANES)
+    clp = _pad_to(max(cl, 1), _LANES)
+    est = (msp * ctp * tisz          # own travelling shard
+           + 2 * msp * ctp * tisz    # recv slots (nchan halves sum)
+           + msp * clp * lisz        # per-channel local blocks
+           + ctp * clp * 4)          # f32 dw accumulator
     if est > _VMEM_BUDGET:
         return None
-    return {"cp": cp, "kp": kp, "np": np_, "nchan": nchan,
+    return {"msp": msp, "ctp": ctp, "clp": clp, "nchan": nchan,
             "bidirectional": nchan == 2, "vmem_bytes": est}
 
 
@@ -492,68 +1320,126 @@ def _resolve(overlap: Optional[bool], nbytes: int, threshold: int) -> bool:
 
 def agmm_engages(m: int, k: int, n: int, P: int, dtype,
                  overlap: Optional[bool] = None,
-                 bidirectional: bool = True) -> bool:
+                 bidirectional: bool = True,
+                 wire_dtype=None, w_dtype=None) -> bool:
     """True when :func:`all_gather_matmul` would run the FUSED kernel
     for these shapes under the given overlap mode — the session
-    registers, the VMEM plan, and kernel availability all resolved.
-    Lets callers that RESTRUCTURE around the fused kernels (the mlp's
-    sequence-sharded datapath) fall back to their own baseline instead
-    of a degraded unfused rendition of the restructured program."""
-    nbytes = m * k * jnp.dtype(dtype).itemsize
-    return (_resolve(overlap, nbytes, _AG_THRESHOLD)
-            and agmm_plan(m, k, n, P, dtype, bidirectional) is not None)
+    registers (aspect-class aware), the VMEM plan (resident OR
+    streaming), and kernel availability all resolved. The size check
+    sees EFFECTIVE wire bytes (a bf16-staged shard moves half the
+    payload). Pass ``w_dtype`` when the weight dtype differs from the
+    operand dtype — the body plans with the REAL weight dtype, and an
+    engage verdict computed without it can diverge from dispatch. Lets callers that RESTRUCTURE around the fused kernels
+    (the mlp's sequence-sharded datapath) fall back to their own
+    baseline instead of a degraded unfused rendition of the
+    restructured program."""
+    wdt = _resolve_wire(wire_dtype, dtype)
+    nbytes = m * k * jnp.dtype(wdt if wdt is not None else dtype).itemsize
+    return (_resolve(overlap, nbytes, _ag_threshold(k, n))
+            and agmm_plan(m, k, n, P, dtype, bidirectional,
+                          w_dtype=w_dtype, wire_dtype=wdt) is not None)
 
 
 def mmrs_engages(m: int, k: int, n: int, P: int, dtype,
                  overlap: Optional[bool] = None,
-                 bidirectional: bool = True) -> bool:
-    """:func:`agmm_engages`' sibling for :func:`matmul_reduce_scatter`."""
+                 bidirectional: bool = True,
+                 wire_dtype=None, w_dtype=None) -> bool:
+    """:func:`agmm_engages`' sibling for :func:`matmul_reduce_scatter`
+    (the traveller is the f32 accumulator, so wire bytes key off f32)."""
     if P < 1 or m % P:
         return False
-    nbytes = (m // P) * n * 4
-    return (_resolve(overlap, nbytes, _RS_THRESHOLD)
-            and mmrs_plan(m, k, n, P, dtype, bidirectional) is not None)
+    wdt = _resolve_wire(wire_dtype, jnp.float32)
+    nbytes = (m // P) * n * (jnp.dtype(wdt).itemsize
+                             if wdt is not None else 4)
+    return (_resolve(overlap, nbytes, _rs_threshold(k, n))
+            and mmrs_plan(m, k, n, P, dtype, bidirectional,
+                          w_dtype=w_dtype, wire_dtype=wdt) is not None)
+
+
+def _fallback_reason(overlap: Optional[bool], op: str) -> None:
+    """Count a policy-level fallback (the plan was never consulted).
+    An overlap=False REQUEST — per call or session-wide
+    (``cmatmul_overlap=False``) — is a requested XLA pair, not a
+    fallback; only size-register declines and impossible requests
+    count (a ``threshold`` label must mean a size register actually
+    declined, or the counter sends operators chasing phantom
+    crossovers)."""
+    if overlap is not None and not overlap:
+        return
+    if overlap is None and not _OVERLAP_DEFAULT:
+        return
+    _note_fallback(op, "no_interpret" if not _kernels_available()
+                   else "threshold")
 
 
 def all_gather_matmul_body(x, w, *, axis: str = AXIS,
                            mesh_axes: Optional[Tuple[str, ...]] = None,
                            overlap: Optional[bool] = None,
-                           bidirectional: bool = True):
+                           bidirectional: bool = True,
+                           wire_dtype=None):
     """Per-rank body: x (m, k) row shard, w (k, n) local block ->
     (P*m, n) f32 — ``all_gather(x, rows) @ w`` with per-hop overlap.
-    Falls back to the unfused XLA pair when overlap is off or the plan
-    misses the VMEM budget."""
+    The plan picks the VMEM-resident kernel or the k-blocked streaming
+    kernel; the unfused XLA pair remains only for kernels-unavailable
+    rungs, declined thresholds and geometries whose minimum k-block
+    misses the budget (each counted by reason). ``wire_dtype`` stages
+    the shard on the wire in a narrower dtype (f32 accumulation
+    on-chip); the fallback pair always runs full precision."""
     m, k = x.shape
     k2, n = w.shape
     if k != k2:
         raise ValueError(f"contraction mismatch: x {x.shape} vs w {w.shape}")
     P = lax.axis_size(axis)
     mesh_axes = tuple(mesh_axes) if mesh_axes else (axis,)
-    shard_bytes = m * k * jnp.dtype(x.dtype).itemsize
-    plan = agmm_plan(m, k, n, P, x.dtype, bidirectional) \
-        if _resolve(overlap, shard_bytes, _AG_THRESHOLD) else None
     if P == 1:
         return jnp.dot(x, w, preferred_element_type=jnp.float32)
+    wdt = _resolve_wire(wire_dtype, x.dtype)
+    shard_bytes = m * k * jnp.dtype(wdt if wdt is not None
+                                    else x.dtype).itemsize
+    plan = None
+    if _resolve(overlap, shard_bytes, _ag_threshold(k, n)):
+        plan = agmm_plan(m, k, n, P, x.dtype, bidirectional,
+                         w_dtype=w.dtype, wire_dtype=wdt)
+        if plan is None:
+            _note_fallback("allgather_matmul", "vmem_miss")
+    else:
+        _fallback_reason(overlap, "allgather_matmul")
     if plan is None:
         return xla_all_gather_matmul(x, w, axis)
     mp, kp, np_ = plan["mp"], plan["kp"], plan["np"]
-    xp = jnp.zeros((mp, kp), x.dtype)
-    xp = lax.dynamic_update_slice(xp, x, (0, 0))
+    xw = _wire_cast(x, wdt)
+    xp = jnp.zeros((mp, kp), xw.dtype)
+    xp = lax.dynamic_update_slice(xp, xw, (0, 0))
     wp = jnp.zeros((kp, np_), w.dtype)
     wp = lax.dynamic_update_slice(wp, w, (0, 0))
-    out = _agmm_call(xp, wp, P=P, axis=axis, mesh_axes=mesh_axes,
-                     out_dtype=jnp.float32,
-                     bidirectional=plan["bidirectional"])
+    if plan["mode"] == "resident":
+        out = _agmm_call(xp, wp, P=P, axis=axis, mesh_axes=mesh_axes,
+                         out_dtype=jnp.float32,
+                         bidirectional=plan["bidirectional"])
+    else:
+        kb, nkb = plan["kb"], plan["nkb"]
+        # segment-major split of the contraction dim: every staged DMA
+        # in the streaming kernel becomes a leading-index copy
+        xseg = xp.reshape(mp, nkb, kb).transpose(1, 0, 2)
+        wseg = wp.reshape(nkb, kb, np_)
+        out = _agmm_stream_call(xseg, wseg, P=P, axis=axis,
+                                mesh_axes=mesh_axes,
+                                bidirectional=plan["bidirectional"],
+                                nkb=nkb, mp=mp, np_=np_)
     return out[:, :m, :n].reshape(P * m, n)
 
 
 def matmul_reduce_scatter_body(x, w, *, axis: str = AXIS,
                                mesh_axes: Optional[Tuple[str, ...]] = None,
                                overlap: Optional[bool] = None,
-                               bidirectional: bool = True):
+                               bidirectional: bool = True,
+                               wire_dtype=None):
     """Per-rank body: x (m, k) local rows, w (k, n) local block ->
     (m/P, n) f32 — ``reduce_scatter(x @ w, rows)`` with the per-hop
-    partial computed while the accumulator is on the wire."""
+    partial computed while the accumulator is on the wire (k-blocked
+    from HBM in streaming mode). ``wire_dtype`` stages the TRAVELLING
+    accumulator on the wire in a narrower dtype; every fold
+    decompresses and accumulates in f32 on-chip."""
     m, k = x.shape
     k2, n = w.shape
     if k != k2:
@@ -564,9 +1450,17 @@ def matmul_reduce_scatter_body(x, w, *, axis: str = AXIS,
     mesh_axes = tuple(mesh_axes) if mesh_axes else (axis,)
     if P == 1:
         return jnp.dot(x, w, preferred_element_type=jnp.float32)
-    acc_bytes = (m // P) * n * 4   # the travelling f32 accumulator
-    plan = mmrs_plan(m, k, n, P, x.dtype, bidirectional) \
-        if _resolve(overlap, acc_bytes, _RS_THRESHOLD) else None
+    wdt = _resolve_wire(wire_dtype, jnp.float32)   # the traveller is f32
+    acc_bytes = (m // P) * n * (jnp.dtype(wdt).itemsize
+                                if wdt is not None else 4)
+    plan = None
+    if _resolve(overlap, acc_bytes, _rs_threshold(k, n)):
+        plan = mmrs_plan(m, k, n, P, x.dtype, bidirectional,
+                         w_dtype=w.dtype, wire_dtype=wdt)
+        if plan is None:
+            _note_fallback("matmul_reduce_scatter", "vmem_miss")
+    else:
+        _fallback_reason(overlap, "matmul_reduce_scatter")
     if plan is None:
         return xla_matmul_reduce_scatter(x, w, axis)
     cp, kp, np_ = plan["cp"], plan["kp"], plan["np"]
@@ -578,9 +1472,19 @@ def matmul_reduce_scatter_body(x, w, *, axis: str = AXIS,
         grid, x.reshape(P, mc, k), (0, 0, 0))
     wp = jnp.zeros((kp, np_), w.dtype)
     wp = lax.dynamic_update_slice(wp, w, (0, 0))
-    out = _mmrs_call(grid, wp, P=P, axis=axis, mesh_axes=mesh_axes,
-                     out_dtype=jnp.float32,
-                     bidirectional=plan["bidirectional"])
+    if plan["mode"] == "resident":
+        out = _mmrs_call(grid, wp, P=P, axis=axis, mesh_axes=mesh_axes,
+                         out_dtype=jnp.float32,
+                         bidirectional=plan["bidirectional"], wire=wdt)
+    else:
+        kb, nkb = plan["kb"], plan["nkb"]
+        xseg = grid.reshape(P, cp, nkb, kb).transpose(0, 2, 1, 3)
+        wseg = wp.reshape(nkb, kb, np_)
+        out = _mmrs_stream_call(xseg, wseg, P=P, axis=axis,
+                                mesh_axes=mesh_axes,
+                                out_dtype=jnp.float32,
+                                bidirectional=plan["bidirectional"],
+                                nkb=nkb, cp=cp, np_=np_, wire=wdt)
     fwd = [(i, (i + 1) % P) for i in range(P)]
     if plan["bidirectional"]:
         # channel 0 (top half rows) ended at chunk (pos+1), channel 1
@@ -598,79 +1502,162 @@ def matmul_reduce_scatter_body(x, w, *, axis: str = AXIS,
 
 
 # ---------------------------------------------------------------------------
+# fused dgrad/wgrad body: the all-gather folded into dw's k-sweep
+# ---------------------------------------------------------------------------
+
+def gathered_wgrad_body(trav, loc, *, axis: str = AXIS,
+                        mesh_axes: Optional[Tuple[str, ...]] = None,
+                        overlap: Optional[bool] = None,
+                        bidirectional: bool = True,
+                        wire_dtype=None,
+                        travel_lhs: bool = True,
+                        op: str = "allgather_matmul"):
+    """Per-rank body for the fused wgrad: ``trav`` is this rank's
+    (ms, ct) shard of the operand the backward must gather (x for
+    d(ag×mm), dy for d(mm×rs)); ``loc`` is the (P*ms, cl) resident
+    operand whose row blocks pair with each gathered shard.
+
+    ``travel_lhs=True`` returns (ct, cl) = ``all_gather(trav)ᵀ @ loc``;
+    False returns (cl, ct) = ``locᵀ @ all_gather(trav)``. The fused
+    kernel folds the gather into the contraction sweep — each arriving
+    ring shard contributes its partial while the next hop's transfer
+    is in flight. Falls back to the unfused all_gather + dot_general
+    (same math, no overlap) when the plan misses or the policy
+    declines; fallbacks are counted under ``{op}_dw``."""
+    ms, ct = trav.shape
+    ml, cl = loc.shape
+    P = lax.axis_size(axis)
+    mesh_axes = tuple(mesh_axes) if mesh_axes else (axis,)
+    if ml != P * ms:
+        raise ValueError(
+            f"wgrad row mismatch: loc rows {ml} != world {P} x shard {ms}")
+
+    def _unfused(gathered):
+        a, b = (gathered, loc) if travel_lhs else (loc, gathered)
+        return lax.dot_general(a, b.astype(a.dtype),
+                               (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+    if P == 1:
+        return _unfused(trav)
+    wdt = _resolve_wire(wire_dtype, trav.dtype)
+    nbytes = ms * ct * jnp.dtype(wdt if wdt is not None
+                                 else trav.dtype).itemsize
+    # the travelling payload is the agmm-style shard for d(ag×mm) and
+    # the dy shard for d(mm×rs): key each on its forward op's register
+    th = _ag_threshold(ct, cl) if travel_lhs else _rs_threshold(cl, ct)
+    plan = None
+    if _resolve(overlap, nbytes, th):
+        plan = wgrad_plan(ms, ct, cl, P,
+                          wdt if wdt is not None else trav.dtype,
+                          loc.dtype, bidirectional)
+        if plan is None:
+            _note_fallback(op + "_dw", "vmem_miss")
+    else:
+        _fallback_reason(overlap, op + "_dw")
+    if plan is None:
+        return _unfused(lax.all_gather(trav, axis, axis=0, tiled=True))
+    msp, ctp, clp = plan["msp"], plan["ctp"], plan["clp"]
+    tw = _wire_cast(trav, wdt)
+    tp_ = jnp.zeros((msp, ctp), tw.dtype)
+    tp_ = lax.dynamic_update_slice(tp_, tw, (0, 0))
+    lp = jnp.zeros((P, msp, clp), loc.dtype)
+    lp = lax.dynamic_update_slice(lp, loc.reshape(P, ms, cl), (0, 0, 0))
+    out = _wgrad_call(tp_, lp, P=P, axis=axis, mesh_axes=mesh_axes,
+                      bidirectional=plan["bidirectional"],
+                      travel_lhs=travel_lhs)
+    return out[:ct, :cl] if travel_lhs else out[:cl, :ct]
+
+
+# ---------------------------------------------------------------------------
 # differentiable entry points (the collective-matmul duality as a VJP)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
 def all_gather_matmul(x, w, axis: str = AXIS,
                       mesh_axes: Optional[Tuple[str, ...]] = None,
                       overlap: Optional[bool] = None,
-                      bidirectional: bool = True):
+                      bidirectional: bool = True,
+                      wire_dtype=None):
     """``all_gather(x, rows) @ w`` with per-hop comm/compute overlap.
 
     x: (m, k) per-rank row shard of the LHS; w: (k, n) local weight
     block (column-parallel). Returns (P*m, n) f32. ``overlap=None``
     follows the session default (``ACCLConfig.cmatmul_overlap``);
-    False pins the unfused XLA pair. Differentiable: the backward runs
-    the dual ``matmul_reduce_scatter`` for dx (overlapped too)."""
+    False pins the unfused XLA pair. ``wire_dtype=None`` follows
+    ``ACCLConfig.cmatmul_wire_dtype`` ("off" forces full precision).
+    Differentiable: the backward runs the dual ``matmul_reduce_scatter``
+    for dx AND the fused gathered wgrad for dw — both overlapped."""
     return all_gather_matmul_body(x, w, axis=axis, mesh_axes=mesh_axes,
                                   overlap=overlap,
-                                  bidirectional=bidirectional)
+                                  bidirectional=bidirectional,
+                                  wire_dtype=wire_dtype)
 
 
-def _agmm_fwd(x, w, axis, mesh_axes, overlap, bidirectional):
+def _agmm_fwd(x, w, axis, mesh_axes, overlap, bidirectional, wire_dtype):
     y = all_gather_matmul_body(x, w, axis=axis, mesh_axes=mesh_axes,
-                               overlap=overlap, bidirectional=bidirectional)
+                               overlap=overlap, bidirectional=bidirectional,
+                               wire_dtype=wire_dtype)
     return y, (x, w)
 
 
-def _agmm_bwd(axis, mesh_axes, overlap, bidirectional, res, dy):
+def _agmm_bwd(axis, mesh_axes, overlap, bidirectional, wire_dtype, res, dy):
     x, w = res
     # dX_full = psum_p(dy_p w_pᵀ); our row shard of it is exactly the
     # dual overlapped kernel
     dx = matmul_reduce_scatter_body(
         dy.astype(x.dtype), jnp.transpose(w).astype(x.dtype),
         axis=axis, mesh_axes=mesh_axes, overlap=overlap,
-        bidirectional=bidirectional).astype(x.dtype)
-    xg = lax.all_gather(x, axis, axis=0, tiled=True)
-    dw = jnp.dot(jnp.transpose(xg), dy.astype(xg.dtype),
-                 preferred_element_type=jnp.float32).astype(w.dtype)
+        bidirectional=bidirectional, wire_dtype=wire_dtype).astype(x.dtype)
+    # dw = all_gather(x)ᵀ @ dy with the gather folded into the k-sweep
+    dw = gathered_wgrad_body(
+        x, dy.astype(x.dtype), axis=axis, mesh_axes=mesh_axes,
+        overlap=overlap, bidirectional=bidirectional,
+        wire_dtype=wire_dtype, travel_lhs=True,
+        op="allgather_matmul").astype(w.dtype)
     return dx, dw
 
 
 all_gather_matmul.defvjp(_agmm_fwd, _agmm_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
 def matmul_reduce_scatter(x, w, axis: str = AXIS,
                           mesh_axes: Optional[Tuple[str, ...]] = None,
                           overlap: Optional[bool] = None,
-                          bidirectional: bool = True):
+                          bidirectional: bool = True,
+                          wire_dtype=None):
     """``reduce_scatter(x @ w, rows)`` with per-hop comm/compute
     overlap. x: (m, k) local rows (m divisible by world); w: (k, n)
     local block (row-parallel). Returns (m/P, n) f32. Differentiable:
-    dx runs the dual overlapped ``all_gather_matmul``."""
+    dx runs the dual overlapped ``all_gather_matmul``; dw the fused
+    gathered wgrad (the all-gather of dy folded into its k-sweep)."""
     return matmul_reduce_scatter_body(x, w, axis=axis, mesh_axes=mesh_axes,
                                       overlap=overlap,
-                                      bidirectional=bidirectional)
+                                      bidirectional=bidirectional,
+                                      wire_dtype=wire_dtype)
 
 
-def _mmrs_fwd(x, w, axis, mesh_axes, overlap, bidirectional):
+def _mmrs_fwd(x, w, axis, mesh_axes, overlap, bidirectional, wire_dtype):
     y = matmul_reduce_scatter_body(x, w, axis=axis, mesh_axes=mesh_axes,
                                    overlap=overlap,
-                                   bidirectional=bidirectional)
+                                   bidirectional=bidirectional,
+                                   wire_dtype=wire_dtype)
     return y, (x, w)
 
 
-def _mmrs_bwd(axis, mesh_axes, overlap, bidirectional, res, dy):
+def _mmrs_bwd(axis, mesh_axes, overlap, bidirectional, wire_dtype, res, dy):
     x, w = res
     dx = all_gather_matmul_body(
         dy.astype(x.dtype), jnp.transpose(w).astype(x.dtype),
         axis=axis, mesh_axes=mesh_axes, overlap=overlap,
-        bidirectional=bidirectional).astype(x.dtype)
-    dyg = lax.all_gather(dy, axis, axis=0, tiled=True)
-    dw = jnp.dot(jnp.transpose(x), dyg.astype(x.dtype),
-                 preferred_element_type=jnp.float32).astype(w.dtype)
+        bidirectional=bidirectional, wire_dtype=wire_dtype).astype(x.dtype)
+    # dw = xᵀ @ all_gather(dy) with the gather folded into the k-sweep
+    dw = gathered_wgrad_body(
+        dy.astype(x.dtype), x, axis=axis, mesh_axes=mesh_axes,
+        overlap=overlap, bidirectional=bidirectional,
+        wire_dtype=wire_dtype, travel_lhs=False,
+        op="matmul_reduce_scatter").astype(w.dtype)
     return dx, dw
 
 

@@ -68,11 +68,16 @@ def _pallas_cast_rowmajor(x, dst):
 
 def pallas_cast(x, dst_dtype):
     """Cast via the Pallas lane, any shape (pads to the tile grid); 2D
-    operands whose trailing dim divides the tile keep their leading dim
-    as a grid axis (no flatten relayout)."""
+    operands whose trailing dim divides the LANE width keep their
+    leading dim as a grid axis (no flatten relayout) — a partial
+    trailing row-block is masked by the grid, so the trailing dim need
+    NOT reach a full (rows x lanes) tile. The collective-matmul wire
+    staging path casts (m, k) shards with lane-aligned k well below the
+    tile; requiring a full-tile multiple (rounds 4-8) sent exactly
+    those shapes through the flatten+pad path."""
     shape = x.shape
     tile = _BLOCK_ROWS * _LANES
-    if len(shape) == 2 and shape[1] >= tile and shape[1] % tile == 0:
+    if len(shape) == 2 and shape[1] >= _LANES and shape[1] % _LANES == 0:
         out = _pallas_cast_rowmajor(
             x.reshape(shape[0], -1, _LANES), dst_dtype)
         return out.reshape(shape)
@@ -120,7 +125,7 @@ def pallas_compress_stochastic(x, dst_dtype, seed: int = 0):
         return x.astype(dst_dtype)
     shape = x.shape
     tile = _BLOCK_ROWS * _LANES
-    if len(shape) == 2 and shape[1] >= tile and shape[1] % tile == 0:
+    if len(shape) == 2 and shape[1] >= _LANES and shape[1] % _LANES == 0:
         out = _pallas_sr_rowmajor(
             x.reshape(shape[0], -1, _LANES), dst_dtype, seed)
         return out.reshape(shape)

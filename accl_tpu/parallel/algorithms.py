@@ -95,6 +95,36 @@ def reset_global_fallback_warnings() -> None:
     _warned_global_fallback.clear()
 
 
+def cmatmul_wire_bytes(op: operation, nbytes: int, cfg: ACCLConfig,
+                       count: Optional[int] = None) -> int:
+    """Effective ICI bytes for a collective-matmul payload under the
+    session wire dtype (``ACCLConfig.cmatmul_wire_dtype``).
+
+    ``nbytes`` follows the op's operand-byte convention (agmm: LHS
+    shard bytes in the operand dtype; mmrs: travelling f32 accumulator
+    bytes); ``count`` (elements) resolves the operand width exactly —
+    without it the f32 default is assumed, so callers dispatching
+    NON-f32 agmm operands MUST pass count or select() will scale bytes
+    the wire cannot actually compress (the kernel-module resolution
+    never narrows same-width operands and is authoritative for
+    device-side dispatch). Full-precision sessions (and wire dtypes at
+    least as wide as the operand) return nbytes unchanged."""
+    name = cfg.cmatmul_wire_dtype
+    if not name:
+        return nbytes
+    from ..ops import collective_matmul as cm
+    wdt = cm._WIRE_NAMES.get(name)
+    if wdt is None:
+        return nbytes
+    import jax.numpy as jnp
+
+    wisz = jnp.dtype(wdt).itemsize
+    op_isz = (nbytes // count) if count else 4
+    if op_isz <= wisz or op_isz <= 0:
+        return nbytes   # the wire never upcasts
+    return (nbytes // op_isz) * wisz
+
+
 def select(
     op: operation,
     nbytes: int,
@@ -180,10 +210,20 @@ def _select(
             # overlap-vs-XLA thresholds for the collective-matmul family
             # (allgather_matmul: LHS shard bytes; matmul_reduce_scatter:
             # travelling f32 accumulator bytes) — autotuned by
-            # bench.autotune_collective_matmul
+            # bench.autotune_collective_matmul (the per-aspect-class
+            # registers live on the kernel module's session-default
+            # resolution; select() reads the scalar square-class ones)
             operation.allgather_matmul: cfg.ag_matmul_threshold,
             operation.matmul_reduce_scatter: cfg.rs_matmul_threshold,
         }.get(op)
+        if op in (operation.allgather_matmul,
+                  operation.matmul_reduce_scatter):
+            # the register compares WIRE bytes: under a session wire
+            # dtype (ACCLConfig.cmatmul_wire_dtype) the payload moves
+            # fewer bytes than the caller's operand-byte convention, so
+            # the comparison scales nbytes to effective wire bytes —
+            # select() and the kernel-module resolution stay in one unit
+            nbytes = cmatmul_wire_bytes(op, nbytes, cfg, count)
         if pallas_at is not None and nbytes >= pallas_at:
             return Algorithm.PALLAS
     if op == operation.allreduce and nbytes >= cfg.hier_threshold \
@@ -335,11 +375,14 @@ def build_allreduce(comm, func: reduceFunction, dt: dataType, algo: Algorithm,
 
 
 def build_allgather_matmul(comm, algo: Algorithm,
-                           bidirectional: bool = True) -> Callable:
+                           bidirectional: bool = True,
+                           wire_dtype=None) -> Callable:
     """(world, m, k) sharded LHS row shards + (world, k, n) sharded
     weight blocks -> (world, world*m, n): ``all_gather(x, rows) @ w``.
     PALLAS runs the comm/compute-overlapped ring kernel
-    (ops/collective_matmul.py); anything else the unfused XLA pair."""
+    (ops/collective_matmul.py; resident or k-blocked streaming per the
+    plan); anything else the unfused XLA pair. ``wire_dtype`` stages
+    the ring payload compressed ("off" pins full precision)."""
     from ..ops import collective_matmul as cm
     if algo == Algorithm.PALLAS:
         pallas_ring._check_multiprocess(comm)
@@ -348,14 +391,15 @@ def build_allgather_matmul(comm, algo: Algorithm,
         y = cm.all_gather_matmul_body(
             x[0], w[0], axis=primitives.AXIS,
             overlap=(algo == Algorithm.PALLAS),
-            bidirectional=bidirectional)
+            bidirectional=bidirectional, wire_dtype=wire_dtype)
         return y[None]
 
     return primitives._smap(comm, body, 2)
 
 
 def build_matmul_reduce_scatter(comm, algo: Algorithm,
-                                bidirectional: bool = True) -> Callable:
+                                bidirectional: bool = True,
+                                wire_dtype=None) -> Callable:
     """(world, m, k) sharded local rows + (world, k, n) sharded weight
     blocks -> (world, m/world, n): ``reduce_scatter(x @ w, rows)`` with
     the per-hop partial folded into the ring under PALLAS."""
@@ -367,7 +411,7 @@ def build_matmul_reduce_scatter(comm, algo: Algorithm,
         y = cm.matmul_reduce_scatter_body(
             x[0], w[0], axis=primitives.AXIS,
             overlap=(algo == Algorithm.PALLAS),
-            bidirectional=bidirectional)
+            bidirectional=bidirectional, wire_dtype=wire_dtype)
         return y[None]
 
     return primitives._smap(comm, body, 2)

@@ -353,6 +353,7 @@ def main(argv=None) -> int:
         # flags; on a single chip the ring is degenerate — stubbed)
         from accl_tpu.bench import lanes as _lanes
 
+        bidir = acc.config.bidirectional_rings
         wanted = [name for name in ("cmatmul_ag", "cmatmul_rs")
                   if _lane_selected(lanes_filter, name)]
         cm_rows = []
@@ -362,7 +363,6 @@ def main(argv=None) -> int:
                        for name in wanted]
         elif wanted:
             # measure the ring mode the session actually dispatches
-            bidir = acc.config.bidirectional_rings
             r, err = _run_stage("cmatmul",
                                 lambda: _lanes.bench_cmatmul(
                                     comm, ops=wanted, bidirectional=bidir))
@@ -372,6 +372,27 @@ def main(argv=None) -> int:
                            for name in wanted]
             else:
                 cm_rows = r
+        # round-9 lanes: fused-wgrad overlap and k-blocked streaming +
+        # bf16 wire A/B — fault-isolated and budget-gated like the rest
+        for name, fn in (
+            ("cmatmul_dw",
+             lambda: _lanes.bench_cmatmul_dw(comm, bidirectional=bidir)),
+            ("cmatmul_stream",
+             lambda: _lanes.bench_cmatmul_stream(comm,
+                                                 bidirectional=bidir)),
+        ):
+            if not _lane_selected(lanes_filter, name):
+                continue
+            if _elapsed() > _BUDGET_S:
+                cm_rows.append({"metric": name, "skipped": True,
+                                "reason": f"budget {_BUDGET_S}s exceeded"})
+                continue
+            r, err = _run_stage(name, fn)
+            if err:
+                errors.append(err)
+                cm_rows.append({"metric": name, "error": err["error"]})
+            else:
+                cm_rows.extend(r)
         if cm_rows:
             out["lanes"] = cm_rows
 

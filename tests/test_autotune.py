@@ -348,7 +348,8 @@ def test_autotune_collective_matmul_crossover_on_ici(accl, monkeypatch):
     seen = {}
 
     def fake_measure(comm, ms, algos, k=512, n=512, dt=None, reps=1,
-                     bidirectional=True, ops=("agmm", "mmrs")):
+                     bidirectional=True, ops=("agmm", "mmrs"),
+                     wire_dtype=None):
         seen[ops[0]] = list(ms)
         # every requested size must have a live overlap plan
         for m in ms:
@@ -387,3 +388,67 @@ def test_autotune_collective_matmul_noop_off_ici(accl):
     tuned = autotune.autotune_collective_matmul(accl, accl.config)
     assert tuned.ag_matmul_threshold == accl.config.ag_matmul_threshold
     assert tuned.rs_matmul_threshold == accl.config.rs_matmul_threshold
+
+def test_autotune_collective_matmul_aspect_classes(accl, monkeypatch):
+    """Round 9: the default sweep measures one crossover per (k, n)
+    aspect-ratio class and records it in the per-class registers (the
+    square class also lands in the scalar select() reads). The sweep
+    filter admits STREAMING plans — shapes that fell out of the round-8
+    sweep as 'no plan' now measure the k-blocked kernel."""
+    from accl_tpu.config import TransportBackend
+    from accl_tpu.ops import collective_matmul as cm
+
+    seen = []
+
+    def fake_measure(comm, ms, algos, k=512, n=512, dt=None, reps=1,
+                     bidirectional=True, ops=("agmm", "mmrs"),
+                     wire_dtype=None):
+        # the tuned config carries no wire dtype -> the measured
+        # programs must be pinned to full precision explicitly, never
+        # inheriting the module session register (review-r9 finding)
+        assert wire_dtype == "off"
+        seen.append((cm.aspect_class(k, n), ops[0], tuple(ms)))
+        return {op: {Algorithm.XLA: [1.0] * len(ms),
+                     Algorithm.PALLAS: [0.5] * len(ms)} for op in ops}
+
+    monkeypatch.setattr(autotune, "measure_collective_matmul", fake_measure)
+    orig = accl.config
+    try:
+        accl.config = accl.config.replace(transport=TransportBackend.ICI)
+        tuned = autotune.autotune_collective_matmul(accl, pows=(7,),
+                                                    reps=1)
+        classes = {c for c, _, _ in seen}
+        assert classes == {"square", "wide", "tall"}
+        # every class recorded; the square crossover is also the scalar
+        assert set(tuned.ag_matmul_class_thresholds) == classes
+        assert set(tuned.rs_matmul_class_thresholds) == classes
+        assert tuned.ag_matmul_threshold \
+            == tuned.ag_matmul_class_thresholds["square"] == 128 * 512 * 4
+        # wide class crossover keys on ITS k (256): different register
+        assert tuned.ag_matmul_class_thresholds["wide"] == 128 * 256 * 4
+        # the tuned dicts write through to the kernel-module resolution
+        accl.config = tuned
+        assert cm._ag_threshold(256, 1024) \
+            == tuned.ag_matmul_class_thresholds["wide"]
+        # explicit k/n narrows the sweep to that single class
+        seen.clear()
+        autotune.autotune_collective_matmul(accl, pows=(7,), k=512, n=512,
+                                            reps=1)
+        assert {c for c, _, _ in seen} == {"square"}
+    finally:
+        accl.config = orig
+
+
+def test_autotune_collective_matmul_sweeps_streaming_shapes(accl,
+                                                            monkeypatch):
+    """The plan filter admits mode=stream sizes: a row count whose
+    resident plan misses the budget stays IN the sweep now (round 8
+    dropped it, timing nothing)."""
+    from accl_tpu.config import TransportBackend
+    from accl_tpu.ops import collective_matmul as cm
+
+    W = accl.global_comm().world_size
+    # 2^13 rows at (512, 512): resident output panel alone busts the
+    # budget; the k-blocked plan must not (it keeps (mh, n) f32 accs)
+    plan = cm.agmm_plan(2 ** 13, 512, 512, W, np.float32, True)
+    assert plan is None or plan["mode"] == "stream"

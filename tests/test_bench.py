@@ -218,3 +218,41 @@ def test_obs_overhead_lane(accl):
     assert r["dispatch_enabled_us"] > 0
     assert r["disabled_guard_ns"] >= 0
     assert metrics.ENABLED        # the lane restores the flag
+
+def test_cmatmul_dw_and_stream_lanes_schema(accl):
+    """Round-9 lanes follow the resolution protocol on every rung: the
+    dw lane's honesty flag mirrors the wgrad plan + rung, the stream
+    lane pins which plan MODE ran (a resident or fallback rung must
+    never report a streaming win), and the bf16 wire A/B fields are
+    always on the record."""
+    from accl_tpu.bench import lanes
+    from accl_tpu.ops import collective_matmul as cm
+
+    rows = lanes.bench_cmatmul_dw(accl.global_comm(), m=8, k=32, n=24,
+                                  rounds=2)
+    assert [r["metric"] for r in rows] == ["cmatmul_dw"]
+    r = rows[0]
+    assert r["unit"] == "ratio"
+    assert r["wgrad_plan"] is not None       # tiny shapes fit VMEM
+    assert r["fused_engaged"] == cm._kernels_available()
+    assert r["resolved"] == r["fused_engaged"]
+    assert r["raw_overlap_eff_med"] > 0
+    assert r["fused_us"] > 0 and r["matmul_us"] > 0
+    if not r["resolved"]:
+        assert r["value"] == 0.0
+
+    rows = lanes.bench_cmatmul_stream(accl.global_comm(), m=16, n=128,
+                                      ks=(8192, 16384), rounds=2)
+    assert [r["metric"] for r in rows] == ["cmatmul_stream"]
+    r = rows[0]
+    assert r["unit"] == "ratio"
+    assert r["plan_mode"] in ("resident", "stream", None)
+    streaming = r["plan_mode"] == "stream"
+    assert r["fused_engaged"] == (cm._kernels_available() and streaming)
+    assert r["resolved"] == r["fused_engaged"]
+    assert r["wire_bytes_ratio"] == 0.5
+    assert r["wire_fused_us"] > 0 and r["fused_us"] > 0
+    if streaming:
+        assert r["k_block"] is not None and r["k_block"] % 128 == 0
+    if not r["resolved"]:
+        assert r["value"] == 0.0 and r["wire_speedup"] is None

@@ -81,9 +81,9 @@ def test_agmm_uneven_lowers_multihost(tpu_comm):
 
 def test_mlp_train_step_lowers_multihost():
     """The flagship workload end to end: the overlapped train step (fwd
-    collective matmuls + their dual backward kernels) AOT-compiles for a
-    (2, 4) dp x tp mesh on the 2-host topology — four fused kernels in
-    one program."""
+    collective matmuls + their dual backward kernels + the round-9
+    fused dw wgrads) AOT-compiles for a (2, 4) dp x tp mesh on the
+    2-host topology — six fused kernels in one program."""
     from accl_tpu.models import mlp
 
     devices = aot_topology_devices("v5e:2x4")
@@ -108,5 +108,71 @@ def test_mlp_train_step_lowers_multihost():
             (2 * b, d), jnp.float32,
             sharding=NamedSharding(mesh, P(mlp.DP_AXIS, None)))
         compiled = step.lower(params, xs, xs).compile()
-    # fwd agmm + fwd mmrs + bwd duals = at least 4 Mosaic kernels
-    assert_aot_lowered(compiled, 4)
+    # fwd agmm + fwd mmrs + bwd dx duals + bwd dw wgrads = at least 6
+    # Mosaic kernels (round 9: dw no longer an unfused gathered matmul)
+    assert_aot_lowered(compiled, 6)
+
+
+@pytest.mark.parametrize("bidir", [False, True])
+def test_agmm_streaming_lowers_multihost(tpu_comm, bidir):
+    """Round 9: a shape whose RESIDENT plan misses the 12 MiB budget
+    (the (K, N) weight block alone is 16 MiB) lowers through the
+    k-blocked STREAMING kernel — before round 9 these shapes silently
+    compiled to the unfused XLA pair. The plan geometry is pinned so a
+    k-block policy change is a visible diff."""
+    m, k, n = 256, 8192, 512
+    plan = cm.agmm_plan(m, k, n, WORLD, jnp.float32, bidir)
+    assert plan is not None and plan["mode"] == "stream"
+    assert plan["kb"] % 128 == 0 and plan["nkb"] == plan["kp"] // plan["kb"]
+    assert plan["vmem_bytes"] <= cm._VMEM_BUDGET
+    fn = algorithms.build_allgather_matmul(
+        tpu_comm, Algorithm.PALLAS, bidirectional=bidir)
+    compiled = _aot_compile(fn, tpu_comm, (WORLD, m, k), (WORLD, k, n))
+    assert_aot_lowered(compiled, 1)
+
+
+def test_mmrs_streaming_lowers_multihost(tpu_comm):
+    m, k, n = 256, 8192, 512
+    plan = cm.mmrs_plan(WORLD * m, k, n, WORLD, jnp.float32, True)
+    assert plan is not None and plan["mode"] == "stream"
+    fn = algorithms.build_matmul_reduce_scatter(
+        tpu_comm, Algorithm.PALLAS, bidirectional=True)
+    compiled = _aot_compile(fn, tpu_comm, (WORLD, WORLD * m, k),
+                            (WORLD, k, n))
+    assert_aot_lowered(compiled, 1)
+
+
+def test_agmm_wire_lowers_multihost(tpu_comm):
+    """bf16 wire staging lowers: the hp_compression cast lane plus the
+    ring kernel whose staged slots are half the bytes."""
+    plan = cm.agmm_plan(M, K, N, WORLD, jnp.float32, True,
+                        wire_dtype=jnp.bfloat16)
+    assert plan is not None
+    fn = algorithms.build_allgather_matmul(
+        tpu_comm, Algorithm.PALLAS, bidirectional=True, wire_dtype="bf16")
+    compiled = _aot_compile(fn, tpu_comm, (WORLD, M, K), (WORLD, K, N))
+    assert_aot_lowered(compiled, 2)
+
+
+@pytest.mark.parametrize("travel_lhs", [True, False])
+def test_wgrad_lowers_multihost(tpu_comm, travel_lhs):
+    """The fused gathered-wgrad kernel (both orientations) lowers for
+    the 2-host topology, pinned to its plan geometry."""
+    from jax.sharding import PartitionSpec as P
+
+    from accl_tpu.parallel.primitives import AXIS, _smap
+
+    ms, ct, cl = 256, 512, 512
+    plan = cm.wgrad_plan(ms, ct, cl, WORLD, jnp.float32, jnp.float32,
+                         True)
+    assert plan is not None and plan["vmem_bytes"] <= cm._VMEM_BUDGET
+
+    def body(ts, ls):
+        return cm.gathered_wgrad_body(
+            ts[0], ls[0], axis=AXIS, overlap=True,
+            travel_lhs=travel_lhs)[None]
+
+    fn = _smap(tpu_comm, body, 2, in_specs=(P(AXIS), P(AXIS)))
+    compiled = _aot_compile(fn, tpu_comm, (WORLD, ms, ct),
+                            (WORLD, WORLD * ms, cl))
+    assert_aot_lowered(compiled, 1)

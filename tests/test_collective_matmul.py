@@ -452,3 +452,647 @@ def test_device_api_entry_points(accl, rng):
     for r in range(W):
         np.testing.assert_array_equal(
             out[r], z_full[r * m:(r + 1) * m].astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# round 9: k-blocked streaming plans (every rung)
+# ---------------------------------------------------------------------------
+
+def test_plan_streaming_engages():
+    """Shapes whose FULL staged shard misses the 12 MiB budget no longer
+    return None — the plan picks a lane-aligned k-block and streams
+    (the acceptance shape class that previously fell back to XLA)."""
+    # resident keeps its mode + degenerate k-block fields
+    p = cm.agmm_plan(16, 128, 128, 4, jnp.float32, False)
+    assert p["mode"] == "resident" and (p["kb"], p["nkb"]) == (128, 1)
+    # big-k: the (kp, n) weight block alone busts the resident budget
+    p = cm.agmm_plan(256, 8192, 512, 8, jnp.float32, False)
+    assert p is not None and p["mode"] == "stream"
+    assert p["kb"] % 128 == 0 and p["nkb"] == -(-p["kp"] // p["kb"])
+    assert p["vmem_bytes"] <= cm._VMEM_BUDGET
+    assert p["kb"] * p["nkb"] == p["kp"] and p["kp"] >= 8192
+    p = cm.mmrs_plan(8 * 256, 8192, 512, 8, jnp.float32, False)
+    assert p is not None and p["mode"] == "stream"
+    assert p["vmem_bytes"] <= cm._VMEM_BUDGET
+    # bidirectional streaming keeps the channel split
+    p = cm.agmm_plan(256, 8192, 512, 8, jnp.float32, True)
+    assert p["mode"] == "stream" and p["nchan"] == 2
+    # the m x n accumulator floor is irreducible by k-blocking: those
+    # shapes still return None (the only remaining vmem_miss class)
+    assert cm.agmm_plan(4096, 4096, 4096, 8, jnp.float32, False) is None
+
+
+def test_plan_wire_sizing():
+    """A wire dtype halves the staged/transferred terms: a shape whose
+    f32 plan streams can become resident under bf16 staging, and the
+    row padding follows the WIRE dtype's sublane tiles."""
+    # resident: the staged x terms halve
+    full = cm.agmm_plan(64, 1024, 256, 4, jnp.float32, False)
+    half = cm.agmm_plan(64, 1024, 256, 4, jnp.float32, False,
+                        wire_dtype=jnp.bfloat16)
+    assert full["mode"] == half["mode"] == "resident"
+    assert half["vmem_bytes"] < full["vmem_bytes"]
+    # streaming: cheaper per-block staging affords a k-block at least
+    # as large (fewer segments for the same budget)
+    full = cm.agmm_plan(256, 4096, 512, 8, jnp.float32, False)
+    half = cm.agmm_plan(256, 4096, 512, 8, jnp.float32, False,
+                        wire_dtype=jnp.bfloat16)
+    assert full["mode"] == half["mode"] == "stream"
+    assert half["kb"] >= full["kb"]
+    # bf16 staging pads rows to 16-row sublane tiles
+    p = cm.agmm_plan(8, 128, 128, 4, jnp.float32, False,
+                     wire_dtype=jnp.bfloat16)
+    assert p["mp"] == 16
+    # mmrs: the travelling accumulator's wire terms shrink
+    full = cm.mmrs_plan(8 * 64, 512, 2048, 8, jnp.float32, False)
+    half = cm.mmrs_plan(8 * 64, 512, 2048, 8, jnp.float32, False,
+                        wire_dtype=jnp.bfloat16)
+    assert half["vmem_bytes"] < full["vmem_bytes"]
+
+
+def test_wgrad_plan_pins():
+    """The fused-wgrad geometry contract: padded rows by the stricter
+    sublane, lane-padded panels, VMEM under budget — and None beyond
+    (the VJP keeps the unfused gathered dw there)."""
+    p = cm.wgrad_plan(256, 512, 512, 8, jnp.float32, jnp.float32, True)
+    assert (p["msp"], p["ctp"], p["clp"], p["nchan"]) == (256, 512, 512, 2)
+    assert p["vmem_bytes"] <= cm._VMEM_BUDGET
+    # bf16 travelling shard: 16-row sublane padding
+    p = cm.wgrad_plan(8, 64, 64, 4, jnp.bfloat16, jnp.float32, False)
+    assert p["msp"] == 16
+    # a dw panel beyond the budget falls back
+    assert cm.wgrad_plan(256, 8192, 8192, 8, jnp.float32, jnp.float32,
+                         True) is None
+    assert cm.wgrad_plan(0, 64, 64, 4, jnp.float32, jnp.float32,
+                         False) is None
+
+
+# ---------------------------------------------------------------------------
+# aspect-class thresholds + wire registers (every rung)
+# ---------------------------------------------------------------------------
+
+def test_aspect_class_thresholds(accl):
+    """Per-class registers override the scalar for their class only and
+    write through from the config like every other cmatmul knob."""
+    assert cm.aspect_class(512, 512) == "square"
+    assert cm.aspect_class(256, 1024) == "wide"
+    assert cm.aspect_class(1024, 256) == "tall"
+    saved = accl.config
+    saved_cls = cm.get_overlap_class_thresholds()
+    try:
+        accl.config = accl.config.replace(
+            ag_matmul_class_thresholds={"wide": 64},
+            rs_matmul_class_thresholds={"tall": 128})
+        assert cm.get_overlap_class_thresholds() == ({"wide": 64},
+                                                     {"tall": 128})
+        assert cm._ag_threshold(256, 1024) == 64          # class override
+        assert cm._ag_threshold(512, 512) == \
+            accl.config.ag_matmul_threshold                # scalar fallback
+        assert cm._rs_threshold(1024, 256) == 128
+    finally:
+        accl.config = saved
+        cm.set_overlap_class_thresholds(*saved_cls)
+
+
+def test_wire_write_through_and_validation(accl):
+    """ACCLConfig.cmatmul_wire_dtype lands in the kernel module on every
+    config assignment; bad names fail loudly; per-call resolution never
+    upcasts and honors the "off" override."""
+    saved = accl.config
+    try:
+        accl.config = accl.config.replace(cmatmul_wire_dtype="bf16")
+        assert cm.get_wire_dtype() == "bf16"
+        # session default resolves; "off" forces full precision
+        assert cm._resolve_wire(None, jnp.float32) == jnp.bfloat16
+        assert cm._resolve_wire("off", jnp.float32) is None
+        # never upcasts: bf16 operands have nothing to compress
+        assert cm._resolve_wire(None, jnp.bfloat16) is None
+        assert cm.wire_itemsize(jnp.float32) == 2          # session bf16
+        assert cm.wire_itemsize(jnp.float32, "off") == 4
+        accl.config = accl.config.replace(cmatmul_wire_dtype=None)
+        assert cm.get_wire_dtype() is None
+        assert cm.wire_itemsize(jnp.float32) == 4
+        with pytest.raises(ValueError, match="wire dtype"):
+            cm.set_wire_dtype("int3")
+        # the per-call override validates too (a typo must name the
+        # valid lanes, not die with a bare KeyError at trace time)
+        with pytest.raises(ValueError, match="wire dtype"):
+            cm._resolve_wire("fp16", jnp.float32)
+    finally:
+        accl.config = saved
+
+
+def test_wire_effective_bytes_gate_engage(accl, monkeypatch):
+    """The size registers see EFFECTIVE wire bytes: a shard exactly at
+    the f32 threshold disengages under bf16 staging (it moves half the
+    bytes, so it no longer clears the byte register)."""
+    monkeypatch.setattr(cm, "_kernels_available", lambda: True)
+    m, k, n = 16, 64, 64
+    saved_th = cm.get_overlap_thresholds()
+    saved_w = cm.get_wire_dtype()
+    try:
+        cm.set_overlap_thresholds(m * k * 4, 0)
+        cm.set_wire_dtype(None)
+        assert cm.agmm_engages(m, k, n, 4, jnp.float32, None) is True
+        cm.set_wire_dtype("bf16")
+        assert cm.agmm_engages(m, k, n, 4, jnp.float32, None) is False
+        # the explicit per-call force still bypasses the register
+        assert cm.agmm_engages(m, k, n, 4, jnp.float32, True) is True
+    finally:
+        cm.set_overlap_thresholds(*saved_th)
+        cm.set_wire_dtype(saved_w)
+
+
+def test_select_sees_effective_wire_bytes(accl):
+    """parallel.algorithms.select scales the matmul ops' nbytes to wire
+    bytes under the session wire dtype — the same payload that clears
+    the register at f32 no longer clears it staged bf16."""
+    from accl_tpu.config import TransportBackend
+    from accl_tpu.constants import operation
+
+    comm = accl.global_comm()
+    ici = accl.config.replace(transport=TransportBackend.ICI)
+    th = ici.ag_matmul_threshold
+    assert algorithms.select(operation.allgather_matmul, th, comm,
+                             ici) == Algorithm.PALLAS
+    wired = ici.replace(cmatmul_wire_dtype="bf16")
+    assert algorithms.select(operation.allgather_matmul, th, comm,
+                             wired) == Algorithm.XLA
+    # twice the payload clears it again (half the bytes on the wire)
+    assert algorithms.select(operation.allgather_matmul, 2 * th, comm,
+                             wired) == Algorithm.PALLAS
+    # cmatmul_wire_bytes: count resolves the operand width exactly
+    assert algorithms.cmatmul_wire_bytes(
+        operation.allgather_matmul, 1024, wired) == 512
+    assert algorithms.cmatmul_wire_bytes(
+        operation.allgather_matmul, 1024, wired, count=512) == 1024
+
+
+# ---------------------------------------------------------------------------
+# trace-level coverage of the new kernels (every rung: tracing a
+# pallas_call runs the whole kernel Python abstractly)
+# ---------------------------------------------------------------------------
+
+def _trace_body(monkeypatch, fn, xshape, wshape, out_spec=None):
+    from accl_tpu.compat import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    monkeypatch.setattr(cm, "_kernels_available", lambda: True)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("accl",))
+    return str(jax.make_jaxpr(shard_map(
+        fn, mesh=mesh, in_specs=(P("accl"), P(None)),
+        out_specs=out_spec or P("accl"), check_vma=False))(
+        jnp.zeros(xshape, jnp.float32), jnp.zeros(wshape, jnp.float32)))
+
+
+def test_streaming_traces_kernels(accl, monkeypatch):
+    """The streaming shapes now trace the fused kernel (before round 9
+    they traced the unfused XLA pair): full kernel-Python coverage of
+    the segment schedule on every rung."""
+    m, k, n = 64, 8192, 256
+    assert cm.agmm_plan(m, k, n, 4, jnp.float32, True)["mode"] == "stream"
+    t = _trace_body(monkeypatch,
+                    lambda xs, ws: cm.all_gather_matmul_body(
+                        xs, ws, axis="accl", overlap=True),
+                    (4 * m, k), (k, n))
+    assert "pallas_call" in t
+    assert cm.mmrs_plan(4 * m, k, n, 4, jnp.float32, True)["mode"] \
+        == "stream"
+    t = _trace_body(monkeypatch,
+                    lambda xs, ws: cm.matmul_reduce_scatter_body(
+                        xs, ws, axis="accl", overlap=True),
+                    (4 * m, k), (k, n))
+    assert "pallas_call" in t
+
+
+def test_wire_traces_cast_and_kernel(accl, monkeypatch):
+    """bf16 wire staging traces the hp_compression cast lane plus the
+    ring kernel for agmm (the shard is staged compressed), and the
+    in-kernel wire buffer for mmrs (the accumulator compresses inside
+    the kernel — no separate cast)."""
+    t = _trace_body(monkeypatch,
+                    lambda xs, ws: cm.all_gather_matmul_body(
+                        xs, ws, axis="accl", overlap=True,
+                        wire_dtype="bf16"),
+                    (4 * 16, 128, ), (128, 128))
+    assert t.count("pallas_call") == 2      # pallas_cast + ring kernel
+    t = _trace_body(monkeypatch,
+                    lambda xs, ws: cm.matmul_reduce_scatter_body(
+                        xs, ws, axis="accl", overlap=True,
+                        wire_dtype="bf16"),
+                    (4 * 16, 128), (128, 128))
+    assert t.count("pallas_call") == 1      # in-kernel wire staging
+
+
+def test_vjp_traces_fused_dw(accl, monkeypatch):
+    """Both custom VJPs now trace THREE fused kernels: the forward, the
+    dual dx kernel, and the fused gathered-wgrad dw kernel (dw was an
+    unfused all_gather + matmul through round 8)."""
+    from accl_tpu.compat import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    monkeypatch.setattr(cm, "_kernels_available", lambda: True)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("accl",))
+
+    def grad_trace(entry):
+        def body(xs, ws):
+            def loss(w_):
+                return jnp.sum(entry(xs, w_, "accl", None, True))
+            return jax.grad(loss)(ws)
+
+        return str(jax.make_jaxpr(shard_map(
+            body, mesh=mesh, in_specs=(P("accl"), P(None)),
+            out_specs=P(None), check_vma=False))(
+            jnp.zeros((4 * 16, 64), jnp.float32),
+            jnp.zeros((64, 64), jnp.float32)))
+
+    assert grad_trace(cm.all_gather_matmul).count("pallas_call") == 3
+    assert grad_trace(cm.matmul_reduce_scatter).count("pallas_call") == 3
+
+
+# ---------------------------------------------------------------------------
+# fallback telemetry: every plan/policy fallback counted by reason
+# ---------------------------------------------------------------------------
+
+def test_fallback_counter_reasons(accl, monkeypatch):
+    """accl_cmatmul_fallback_total counts EVERY fused-path fallback
+    labelled by reason — what the warn-once log hides (ISSUE r9). An
+    explicit overlap=False is a requested XLA pair, never counted."""
+    from accl_tpu.compat import shard_map
+    from accl_tpu.obs import metrics as obs_metrics
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("accl",))
+
+    def trace(overlap, kavail, shape=(16, 64, 64)):
+        monkeypatch.setattr(cm, "_kernels_available", lambda: kavail)
+        m, k, n = shape
+
+        def body(xs, ws):
+            return cm.all_gather_matmul_body(xs, ws, axis="accl",
+                                             overlap=overlap)
+
+        jax.make_jaxpr(shard_map(
+            body, mesh=mesh, in_specs=(P("accl"), P(None)),
+            out_specs=P("accl"), check_vma=False))(
+            jnp.zeros((4 * m, k), jnp.float32),
+            jnp.zeros((k, n), jnp.float32))
+
+    def delta(fn):
+        before = obs_metrics.snapshot()
+        fn()
+        d = obs_metrics.delta(before)["counters"]
+        return {key: v for key, v in d.items()
+                if key.startswith("accl_cmatmul_fallback_total")}
+
+    key = ('accl_cmatmul_fallback_total{op="allgather_matmul",'
+           'reason="%s"}')
+    # kernels unavailable on the rung -> no_interpret
+    d = delta(lambda: trace(True, False))
+    assert d.get(key % "no_interpret") == 1
+    # session default declined by the size register -> threshold
+    saved_th = cm.get_overlap_thresholds()
+    try:
+        cm.set_overlap_thresholds(1 << 62, 0)
+        d = delta(lambda: trace(None, True))
+        assert d.get(key % "threshold") == 1
+    finally:
+        cm.set_overlap_thresholds(*saved_th)
+    # overlap requested but no geometry fits even a k-block -> vmem_miss
+    d = delta(lambda: trace(True, True, shape=(4096, 4096, 4096)))
+    assert d.get(key % "vmem_miss") == 1
+    # an explicit overlap=False is a REQUEST, not a fallback — per call
+    d = delta(lambda: trace(False, True))
+    assert d == {}
+    # ... and session-wide (cmatmul_overlap=False): no size register was
+    # consulted, so a "threshold" label would be a phantom decline
+    saved_ov = cm.get_overlap_enabled()
+    try:
+        cm.set_overlap_enabled(False)
+        d = delta(lambda: trace(None, True))
+        assert d == {}
+    finally:
+        cm.set_overlap_enabled(saved_ov)
+    # the warn set dedupes the LOG only; the counter keeps counting
+    d = delta(lambda: (trace(True, False), trace(True, False)))
+    assert d.get(key % "no_interpret") == 2
+    # session hook clears the warn set (ACCL.initialize discipline)
+    cm._warned_fallback.add(("x", "y"))
+    cm.reset_fallback_warnings()
+    assert cm._warned_fallback == set()
+
+
+# ---------------------------------------------------------------------------
+# gathered wgrad body: both orientations vs host math (every rung — the
+# kernel-less rung runs the unfused fallback, same math by construction)
+# ---------------------------------------------------------------------------
+
+def test_wgrad_body_both_orientations(accl, rng):
+    from accl_tpu.parallel.primitives import AXIS, _smap
+
+    comm = _comm(4)
+    W, ms, ct, cl = 4, 8, 32, 16
+    trav = _ints(rng, (W, ms, ct), lo=-3, hi=4)
+    loc = _ints(rng, (W, W * ms, cl), lo=-3, hi=4)
+
+    def run(travel_lhs):
+        def body(ts, ls):
+            return cm.gathered_wgrad_body(
+                ts[0], ls[0], axis=AXIS, travel_lhs=travel_lhs)[None]
+
+        from jax.sharding import PartitionSpec as P
+        return np.asarray(_smap(comm, body, 2,
+                                in_specs=(P(AXIS), P(AXIS)))(
+            _put(comm, trav), _put(comm, loc)))
+
+    gathered = trav.reshape(W * ms, ct).astype(np.float64)
+    lhs, rhs = run(True), run(False)
+    for r in range(W):
+        np.testing.assert_array_equal(
+            lhs[r], (gathered.T @ loc[r].astype(np.float64))
+            .astype(np.float32))
+        np.testing.assert_array_equal(
+            rhs[r], (loc[r].astype(np.float64).T @ gathered)
+            .astype(np.float32))
+
+
+def test_wgrad_body_rejects_row_mismatch(accl):
+    from accl_tpu.compat import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("accl",))
+
+    def body(ts, ls):
+        return cm.gathered_wgrad_body(ts, ls, axis="accl")
+
+    with pytest.raises(ValueError, match="row mismatch"):
+        jax.make_jaxpr(shard_map(
+            body, mesh=mesh, in_specs=(P("accl"), P(None)),
+            out_specs=P(None), check_vma=False))(
+            jnp.zeros((4 * 8, 16), jnp.float32),
+            jnp.zeros((3 * 8, 16), jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# interpreter parity: streaming kernels, fused wgrad, bf16 wire
+# (needs simulated remote DMA — skips on rungs without the TPU interpreter)
+# ---------------------------------------------------------------------------
+
+def _budget(monkeypatch, nbytes):
+    monkeypatch.setattr(cm, "_VMEM_BUDGET", nbytes)
+
+
+@requires_interpret_rdma
+@pytest.mark.parametrize("W", [2, 4, 8])
+@pytest.mark.parametrize("bidir", [False, True])
+def test_agmm_stream_parity_bit_exact(accl, rng, monkeypatch, W, bidir):
+    """k-blocked streaming agmm is bit-exact vs the unfused pair. The
+    budget is pinched so modest shapes stream with several k-blocks
+    (multi-segment relay + accumulator phases all exercised)."""
+    if bidir and W < 4:
+        pytest.skip("bidirectional needs P >= 4")
+    m, k, n = 16, 512, 128
+    _budget(monkeypatch, 192 << 10)
+    plan = cm.agmm_plan(m, k, n, W, jnp.float32, bidir)
+    assert plan is not None and plan["mode"] == "stream"
+    assert plan["nkb"] >= 2
+    x = _ints(rng, (W, m, k), lo=-2, hi=3)
+    w = _ints(rng, (W, k, n), lo=-2, hi=3)
+    comm = _comm(W)
+    fused = _run_agmm(comm, x, w, Algorithm.PALLAS, bidir)
+    ref = _run_agmm(comm, x, w, Algorithm.XLA, bidir)
+    np.testing.assert_array_equal(fused, ref)
+
+
+@requires_interpret_rdma
+def test_agmm_stream_parity_real_budget(accl, rng):
+    """The acceptance shape: a shard whose RESIDENT plan misses the real
+    12 MiB budget (w block alone is 16 MiB) — previously fell back to
+    XLA, now streams — bit-exact vs the unfused pair at W=2."""
+    m, k, n = 16, 32768, 128
+    plan = cm.agmm_plan(m, k, n, 2, jnp.float32, False)
+    assert plan is not None and plan["mode"] == "stream"
+    x = _ints(rng, (2, m, k), lo=-1, hi=2)
+    w = _ints(rng, (2, k, n), lo=-1, hi=2)
+    comm = _comm(2)
+    fused = _run_agmm(comm, x, w, Algorithm.PALLAS, False)
+    ref = _run_agmm(comm, x, w, Algorithm.XLA, False)
+    np.testing.assert_array_equal(fused, ref)
+
+
+@requires_interpret_rdma
+@pytest.mark.parametrize("W", [2, 4, 8])
+@pytest.mark.parametrize("bidir", [False, True])
+def test_mmrs_stream_parity_bit_exact(accl, rng, monkeypatch, W, bidir):
+    if bidir and W < 4:
+        pytest.skip("bidirectional needs P >= 4")
+    m, k, n = 16, 512, 128
+    _budget(monkeypatch, 192 << 10)
+    plan = cm.mmrs_plan(W * m, k, n, W, jnp.float32, bidir)
+    assert plan is not None and plan["mode"] == "stream"
+    assert plan["nkb"] >= 2
+    x = _ints(rng, (W, W * m, k), lo=-2, hi=3)
+    w = _ints(rng, (W, k, n), lo=-2, hi=3)
+    comm = _comm(W)
+    fused = _run_mmrs(comm, x, w, Algorithm.PALLAS, bidir)
+    ref = _run_mmrs(comm, x, w, Algorithm.XLA, bidir)
+    np.testing.assert_array_equal(fused, ref)
+
+
+@requires_interpret_rdma
+def test_stream_race_free(accl, rng, monkeypatch):
+    """The streaming kernels under the interpret-mode race detector:
+    the segment-level credit protocol (grants == gates, store-and-
+    forward relay, accumulator phase flushes) must hold."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    monkeypatch.setattr(
+        pallas_ring, "_interpret_params",
+        lambda: pltpu.InterpretParams(detect_races=True))
+    _budget(monkeypatch, 192 << 10)
+    W, m, k, n = 4, 16, 512, 128
+    comm = _comm(W)
+    x_ag = _ints(rng, (W, m, k), lo=-2, hi=3)
+    x_rs = _ints(rng, (W, W * m, k), lo=-2, hi=3)
+    w = _ints(rng, (W, k, n), lo=-2, hi=3)
+    for bidir in (False, True):
+        assert cm.agmm_plan(m, k, n, W, jnp.float32, bidir)["mode"] \
+            == "stream"
+        fused = _run_agmm(comm, x_ag, w, Algorithm.PALLAS, bidir)
+        np.testing.assert_array_equal(
+            fused, _run_agmm(comm, x_ag, w, Algorithm.XLA, bidir))
+        fused = _run_mmrs(comm, x_rs, w, Algorithm.PALLAS, bidir)
+        np.testing.assert_array_equal(
+            fused, _run_mmrs(comm, x_rs, w, Algorithm.XLA, bidir))
+
+
+@requires_interpret_rdma
+@pytest.mark.parametrize("W", [2, 4, 8])
+def test_fused_wgrad_parity_bit_exact(accl, rng, W):
+    """The fused dgrad/wgrad backward matches the unfused VJP bit-exact
+    (integer-valued operands): grads through both custom VJPs with the
+    fused dw kernels engaged vs the overlap=False unfused rendition."""
+    from accl_tpu.parallel.primitives import AXIS, _smap
+
+    comm = _comm(W)
+    m, k, n = 8, 64, 32
+    x = _ints(rng, (W, m, k), lo=-2, hi=3)
+    w = _ints(rng, (W, k, n), lo=-2, hi=3)
+
+    def make(overlap):
+        def body(xs, ws):
+            def loss(ws_):
+                y = cm.all_gather_matmul(xs[0], ws_, AXIS, None, overlap)
+                z = cm.matmul_reduce_scatter(
+                    y.astype(xs.dtype), jnp.transpose(ws_), AXIS, None,
+                    overlap)
+                return jnp.sum(z)
+
+            return jax.grad(loss)(ws[0])[None]
+
+        return _smap(comm, body, 2)
+
+    g_fused = np.asarray(make(True)(_put(comm, x), _put(comm, w)))
+    g_ref = np.asarray(make(False)(_put(comm, x), _put(comm, w)))
+    np.testing.assert_array_equal(g_fused, g_ref)
+
+
+@requires_interpret_rdma
+def test_wgrad_race_free(accl, rng, monkeypatch):
+    from jax.experimental.pallas import tpu as pltpu
+    from jax.sharding import PartitionSpec as P
+
+    from accl_tpu.parallel.primitives import AXIS, _smap
+
+    monkeypatch.setattr(
+        pallas_ring, "_interpret_params",
+        lambda: pltpu.InterpretParams(detect_races=True))
+    W, ms, ct, cl = 8, 16, 128, 64
+    comm = _comm(W)
+    trav = _ints(rng, (W, ms, ct), lo=-2, hi=3)
+    loc = _ints(rng, (W, W * ms, cl), lo=-2, hi=3)
+    for bidir in (False, True):
+        for lhs in (True, False):
+            def body(ts, ls, lhs=lhs, bidir=bidir):
+                return cm.gathered_wgrad_body(
+                    ts[0], ls[0], axis=AXIS, overlap=True,
+                    bidirectional=bidir, travel_lhs=lhs)[None]
+
+            got = np.asarray(_smap(comm, body, 2,
+                                   in_specs=(P(AXIS), P(AXIS)))(
+                _put(comm, trav), _put(comm, loc)))
+            gathered = trav.reshape(W * ms, ct).astype(np.float64)
+            for r in range(W):
+                want = (gathered.T @ loc[r].astype(np.float64) if lhs
+                        else loc[r].astype(np.float64).T @ gathered)
+                np.testing.assert_array_equal(
+                    got[r], want.astype(np.float32))
+
+
+@requires_interpret_rdma
+def test_agmm_wire_bit_exact_with_f32_accumulate(accl, rng):
+    """bf16 wire staging for agmm rounds the INPUT shard once: with
+    small-integer operands (exactly bf16-representable) the fused wire
+    path is bit-exact vs the full-precision pair, while the partial
+    sums exceed bf16's 8-bit-mantissa exact range — so an exact result
+    PROVES the accumulation ran wider than the wire (f32 on-chip)."""
+    W, m, k, n = 4, 16, 512, 128
+    comm = _comm(W)
+    # |entries| <= 3: bf16-lossless on the wire. k=512 terms of up to 9
+    # push partial sums past 256 — bf16 accumulation would round them.
+    x = _ints(rng, (W, m, k), lo=-3, hi=4)
+    w = _ints(rng, (W, k, n), lo=-3, hi=4)
+    prog = algorithms.build_allgather_matmul(
+        comm, Algorithm.PALLAS, bidirectional=True, wire_dtype="bf16")
+    fused = np.asarray(prog(_put(comm, x), _put(comm, w)))
+    ref = _run_agmm(comm, x, w, Algorithm.XLA, True)
+    assert np.abs(ref).max() > 256        # sums overflow bf16 exactness
+    np.testing.assert_array_equal(fused, ref)
+
+
+@requires_interpret_rdma
+def test_mmrs_wire_tolerance(accl, rng):
+    """bf16 wire staging for mmrs rounds the travelling PARTIAL SUM once
+    per hop — tolerance-bounded vs the f32 pair (docs/kernels.md states
+    the bound), and exact when every travelling partial is
+    bf16-representable."""
+    W, m, k, n = 4, 16, 64, 32
+    comm = _comm(W)
+    x = rng.standard_normal((W, W * m, k)).astype(np.float32)
+    w = rng.standard_normal((W, k, n)).astype(np.float32)
+    prog = algorithms.build_matmul_reduce_scatter(
+        comm, Algorithm.PALLAS, bidirectional=True, wire_dtype="bf16")
+    fused = np.asarray(prog(_put(comm, x), _put(comm, w)))
+    ref = _run_mmrs(comm, x, w, Algorithm.XLA, True)
+    # P-1 bf16 roundings of travelling partials: relative error bounded
+    # by ~(P-1) * 2^-8 on the partial scale
+    np.testing.assert_allclose(fused, ref, rtol=0.05,
+                               atol=0.05 * np.abs(ref).max())
+    # tiny integers: every travelling partial stays bf16-exact
+    xi = _ints(rng, (W, W * m, 8), lo=-1, hi=2)[:, :, :8]
+    wi = _ints(rng, (W, 8, n), lo=-1, hi=2)
+    prog = algorithms.build_matmul_reduce_scatter(
+        comm, Algorithm.PALLAS, bidirectional=False, wire_dtype="bf16")
+    fused = np.asarray(prog(_put(comm, xi), _put(comm, wi)))
+    ref = _run_mmrs(comm, xi, wi, Algorithm.XLA, False)
+    np.testing.assert_array_equal(fused, ref)
+
+
+# ---------------------------------------------------------------------------
+# mlp wire thread-through (every rung)
+# ---------------------------------------------------------------------------
+
+def test_mlp_wire_dtype_threads(rng):
+    """make_train_step(wire_dtype=...) builds and trains; on rungs where
+    the fused kernels cannot run the wire request is moot (full-
+    precision psum baseline), so the trajectories agree exactly."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from accl_tpu.models import mlp
+
+    d, h, b = 16, 64, 8
+    mesh = mlp.make_mesh(jax.devices()[:4], dp=1, tp=4)
+    params = mlp.shard_params(
+        mlp.init_params(jax.random.PRNGKey(1), d, h), mesh)
+    sh = NamedSharding(mesh, P(mlp.DP_AXIS, None))
+    x = jax.device_put(rng.standard_normal((b, d)).astype(np.float32), sh)
+    t = jax.device_put(rng.standard_normal((b, d)).astype(np.float32), sh)
+    traj = {}
+    for wd in ("off", "bf16"):
+        p = params
+        step = mlp.make_train_step(mesh, lr=5e-2, overlap=None,
+                                   wire_dtype=wd)
+        traj[wd] = []
+        for _ in range(3):
+            p, loss = step(p, x, t)
+            traj[wd].append(float(loss))
+    if not cm._kernels_available():
+        np.testing.assert_array_equal(traj["off"], traj["bf16"])
+    else:
+        np.testing.assert_allclose(traj["off"], traj["bf16"],
+                                   rtol=0.05, atol=1e-3)
+
+
+def test_wgrad_wire_traces(accl, monkeypatch):
+    """bf16 wire on the wgrad path: the travelling shard is cast once
+    (hp_compression lane) and the in-kernel contribution up-converts at
+    the fold — lax.dot_general requires matching operand dtypes, so a
+    bf16 arrival meeting a f32 local block must cast inside the kernel
+    (regression: round-9 review)."""
+    from accl_tpu.compat import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    monkeypatch.setattr(cm, "_kernels_available", lambda: True)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("accl",))
+    for lhs in (True, False):
+        def body(ts, ls, lhs=lhs):
+            return cm.gathered_wgrad_body(
+                ts, ls, axis="accl", overlap=True, wire_dtype="bf16",
+                travel_lhs=lhs)
+
+        t = str(jax.make_jaxpr(shard_map(
+            body, mesh=mesh, in_specs=(P("accl"), P(None)),
+            out_specs=P(None), check_vma=False))(
+            jnp.zeros((4 * 16, 64), jnp.float32),
+            jnp.zeros((4 * 16, 32), jnp.float32)))
+        assert t.count("pallas_call") == 2   # cast lane + wgrad kernel

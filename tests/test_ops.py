@@ -193,3 +193,28 @@ def test_pallas_cast_rowmajor_2d_path(rng, w):
     back = compression.pallas_cast(got, jnp.float32)
     np.testing.assert_allclose(np.asarray(back), np.asarray(x),
                                atol=0.02, rtol=0.02)
+
+
+@pytest.mark.parametrize("shape", [(3, 1000),      # 2D, nothing aligns
+                                   (12, 72),       # tiny wire shard
+                                   (256, 8192),    # wire shard: lane-
+                                                   # aligned, sub-tile
+                                   (300, 384),     # partial row block
+                                   (12, 128),      # single lane column
+                                   (257, 129),     # off-by-one both dims
+                                   (2, 32896),     # >tile, not multiple
+                                   (16, 48, 5)])   # 3D flatten path
+@pytest.mark.parametrize("src,dst", [(jnp.float32, jnp.bfloat16),
+                                     (jnp.bfloat16, jnp.float32)])
+def test_pallas_cast_off_tile_shapes(rng, shape, src, dst):
+    """Parity on shapes that are NOT a multiple of the (rows x lanes)
+    tile — the collective-matmul wire staging path casts (m, k) shards
+    with lane-aligned k far below the 32768-element tile, so the
+    lane-multiple fast path (round 9: partial trailing row blocks are
+    masked by the grid, no full-tile requirement) and the flatten+pad
+    path both need exactness pins."""
+    x = jnp.asarray(rng.standard_normal(shape)).astype(src)
+    got = compression.pallas_cast(x, dst)
+    assert got.shape == x.shape and got.dtype == dst
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.asarray(x.astype(dst)))
