@@ -83,6 +83,7 @@ class ACCL:
     @config.setter
     def config(self, cfg: ACCLConfig) -> None:
         self._config = cfg
+        from .ops import collective_alltoall as _a2a_ops
         from .ops import collective_matmul as _cm_ops
         from .ops import flash as _flash_ops
 
@@ -93,6 +94,8 @@ class ACCL:
         _cm_ops.set_overlap_class_thresholds(
             cfg.ag_matmul_class_thresholds, cfg.rs_matmul_class_thresholds)
         _cm_ops.set_wire_dtype(cfg.cmatmul_wire_dtype)
+        _a2a_ops.set_overlap_enabled(cfg.moe_overlap)
+        _a2a_ops.set_overlap_threshold(cfg.a2a_matmul_threshold)
 
     def __init__(
         self,

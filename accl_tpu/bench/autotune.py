@@ -596,6 +596,62 @@ def autotune_collective_matmul(acc, cfg: Optional[ACCLConfig] = None,
                        rs_matmul_class_thresholds=rs_classes)
 
 
+def autotune_moe_a2a(acc, cfg: Optional[ACCLConfig] = None,
+                     pows: Sequence[int] = (5, 7, 9),
+                     e_local: int = 2, d: int = 256, h: int = 512,
+                     reps: int = 3,
+                     dt: dataType = dataType.float32) -> ACCLConfig:
+    """Measure the fused a2a×expert-matmul dispatch against the unfused
+    ``lax.all_to_all`` + einsum pair on the live mesh over a capacity
+    sweep, and write the crossover to ``cfg.a2a_matmul_threshold`` — in
+    PER-DESTINATION block wire bytes, the unit the engage register and
+    ``select()`` compare (DISABLED when fused never wins). ICI only,
+    like the collective-matmul crossovers."""
+    import jax
+    from ..ops import collective_alltoall as ca
+    from ..ops import collective_matmul as cm
+
+    cfg = cfg or acc.config
+    if acc.config.transport != TransportBackend.ICI:
+        return cfg
+    comm = acc.global_comm()
+    W = comm.world_size
+    if W == 1:
+        return cfg
+    bidir = acc.config.bidirectional_rings
+    npdt = np.dtype(to_jax_dtype(dt))
+    wire = cfg.cmatmul_wire_dtype or "off"
+    wdt = cm._resolve_wire(wire, npdt)
+    elem = cm.wire_itemsize(npdt, wire)
+    # sweep only capacities whose plan engages — where it misses, the
+    # "PALLAS" builder runs the fallback and the crossover would time
+    # XLA against itself
+    Cs = [c for c in (2 ** p for p in pows)
+          if ca.a2a_plan(e_local, c, d, h, W, npdt, bidir,
+                         direction="dispatch", wire_dtype=wdt) is not None
+          and ca.a2a_plan(e_local, c, d, h, W, npdt, bidir,
+                          direction="combine",
+                          wire_dtype=cm._resolve_wire(wire, np.float32))
+          is not None]
+    if not Cs:
+        return cfg
+    E = W * e_local
+    wt = jax.device_put(np.full((W, e_local, d, h), 1e-3, npdt),
+                        comm.sharding())
+    times = {a: [] for a in (Algorithm.XLA, Algorithm.PALLAS)}
+    for algo in times:
+        prog = algorithms.build_alltoall_matmul(
+            comm, algo, bidirectional=bidir, wire_dtype=wire)
+        for c in Cs:
+            x = jax.device_put(np.full((W, E, c, d), 1e-3, npdt),
+                               comm.sharding())
+            times[algo].append(_time_prog(prog, x, wt, reps=reps))
+    at = _crossover([e_local * c * d for c in Cs],
+                    times[Algorithm.XLA], times[Algorithm.PALLAS], elem)
+    return cfg.replace(
+        a2a_matmul_threshold=at if at is not None else DISABLED)
+
+
 def autotune_flash_bwd(acc, cfg: Optional[ACCLConfig] = None,
                        H: int = 8, S: int = 2048, d: int = 128,
                        reps: int = 3) -> ACCLConfig:
@@ -686,6 +742,7 @@ def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
             acc, c, reps=reps, dt=dt)),
         ("collective_matmul", lambda c: autotune_collective_matmul(
             acc, c, reps=reps, dt=dt)),
+        ("moe_a2a", lambda c: autotune_moe_a2a(acc, c, reps=reps, dt=dt)),
         ("flash_bwd", lambda c: autotune_flash_bwd(acc, c, reps=reps)),
     ]
     try:

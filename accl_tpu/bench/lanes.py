@@ -739,6 +739,183 @@ def bench_cmatmul_stream(comm, m: int = 128, n: int = 512,
     return [row]
 
 
+def bench_moe_a2a(comm, e_local: int = 2, C: int = 128, d: int = 256,
+                  h: int = 512, rounds: int = 5,
+                  bidirectional: bool = True) -> List[dict]:
+    """The expert-parallel fused a2a overlap A/B: ``moe_a2a`` times the
+    fused dispatch kernel (all-to-all × expert ``w_in`` matmul,
+    ``ops/collective_alltoall.py``) against its sequential pieces — the
+    ``lax.all_to_all`` alone and the expert FFN matmul alone, each at
+    its own best. Overlap efficiency = (best a2a + best ffn)/fused;
+    ``fused_engaged``/``plan_mode`` are the honesty flags (the "fused"
+    time on a fallback rung measures the unfused pair, so the headline
+    zeroes). Resolution protocol as every overlap lane: the MEDIAN
+    round carries the flag, raw best/median stay on the record."""
+    import jax
+    from jax import lax as jlax
+    from jax.sharding import PartitionSpec as P
+
+    from ..config import Algorithm
+    from ..ops import collective_alltoall as ca
+    from ..ops import collective_matmul as cm
+    from ..parallel import algorithms
+    from ..parallel.primitives import AXIS, _smap
+
+    W = comm.world_size
+    E = W * e_local
+    rng = np.random.default_rng(0)
+    x = jax.device_put(
+        rng.standard_normal((W, E, C, d)).astype(np.float32) * 1e-2,
+        comm.sharding())
+    wt = jax.device_put(
+        rng.standard_normal((W, e_local, d, h)).astype(np.float32) * 1e-2,
+        comm.sharding())
+    recv = jax.device_put(
+        rng.standard_normal((W, e_local, W * C, d)).astype(np.float32)
+        * 1e-2, comm.sharding())
+
+    # the honesty flags below must judge the SAME program the lane
+    # times: resolve the session wire dtype once and feed it to both
+    # the builder and the plan check (a session bf16 wire can make a
+    # plan fit that misses at f32, and vice versa)
+    wire = cm.get_wire_dtype() or "off"
+    wdt = cm._resolve_wire(wire, np.float32)
+    fused = algorithms.build_alltoall_matmul(
+        comm, Algorithm.PALLAS, bidirectional=bidirectional,
+        wire_dtype=wire)
+    a2a_only = _smap(comm, lambda xs: jlax.all_to_all(
+        xs[0], AXIS, split_axis=0, concat_axis=1, tiled=True)[None], 1)
+    # the unfused pair's FFN operates on the RECEIVED (e_local, W*C, d)
+    # activations; measuring it on a pre-received tensor reproduces its
+    # shape/flops without paying the collective inside the matmul time
+    ffn_only = _smap(comm, lambda rs, ws: jnp.einsum(
+        "epd,edh->eph", rs[0], ws[0],
+        preferred_element_type=jnp.float32)[None], 2,
+        in_specs=(P(AXIS), P(AXIS)))
+
+    plan = ca.a2a_plan(e_local, C, d, h, W, jnp.float32, bidirectional,
+                       direction="dispatch", wire_dtype=wdt)
+    t_fused = _dist(fused, x, wt, rounds=rounds)
+    t_coll = _dist(a2a_only, x, rounds=rounds)
+    t_mm = _dist(ffn_only, recv, wt, rounds=rounds)
+    row = _overlap_row("moe_a2a", t_fused, t_mm, t_coll,
+                       cm._kernels_available() and plan is not None,
+                       rounds)
+    row.update({
+        "e_local": e_local, "C": C, "d": d, "h": h, "world": W,
+        "bidirectional": bool(bidirectional and W >= 4),
+        "wire_dtype": wire,
+        "overlap_plan": plan,
+        "plan_mode": plan["mode"] if plan is not None else None,
+    })
+    return [row]
+
+
+def bench_moe_a2a_bwd(comm, e_local: int = 2, C: int = 128, d: int = 256,
+                      h: int = 512, rounds: int = 5,
+                      bidirectional: bool = True) -> List[dict]:
+    """The fused a2a backward A/B: ``moe_a2a_bwd`` times the WHOLE
+    grad(dispatch) program — the forward dispatch kernel plus a
+    backward whose dx rides the DUAL fused combine kernel — against
+    the same program's sequential pieces, piece for piece: its
+    collectives alone (the forward dispatch a2a + the dx return a2a +
+    the dw gather a2a) and its matmuls alone (the forward FFN, dy·wᵀ,
+    recvᵀ·dy, on pre-gathered tensors). Both sides measure fwd+bwd, so
+    the ratio is a true overlap efficiency rather than being deflated
+    by forward work only one side pays. Same honesty flags as the
+    forward lane; the backward engages only when BOTH direction plans
+    fit (the dual kernel is the combine)."""
+    import jax
+    from jax import lax as jlax
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops import collective_alltoall as ca
+    from ..ops import collective_matmul as cm
+    from ..parallel.primitives import AXIS, _smap
+
+    W = comm.world_size
+    E = W * e_local
+    rng = np.random.default_rng(0)
+    x = jax.device_put(
+        rng.standard_normal((W, E, C, d)).astype(np.float32) * 1e-2,
+        comm.sharding())
+    wt = jax.device_put(
+        rng.standard_normal((W, e_local, d, h)).astype(np.float32) * 1e-2,
+        comm.sharding())
+    dy = jax.device_put(
+        rng.standard_normal((W, e_local, W * C, h)).astype(np.float32)
+        * 1e-2, comm.sharding())
+    recv = jax.device_put(
+        rng.standard_normal((W, e_local, W * C, d)).astype(np.float32)
+        * 1e-2, comm.sharding())
+
+    # resolve the session wire once: the plan checks must judge the
+    # program the lane actually times (see bench_moe_a2a)
+    wire = cm.get_wire_dtype() or "off"
+
+    def grad_body(xs, ws):
+        def loss(args):
+            x_, w_ = args
+            return jnp.sum(ca.alltoall_matmul(x_, w_, AXIS, None, True,
+                                              bidirectional, wire) ** 2)
+
+        gx, gw = jax.grad(loss)((xs[0], ws[0]))
+        # fold both grads into one live scalar: the timing harness takes
+        # one array, and a full-tensor sum keeps every gradient term in
+        # the program (a sliced output could shrink the matmuls)
+        return (jnp.sum(gx) + jnp.sum(gw))[None]
+
+    fused = _smap(comm, grad_body, 2)
+    # the grad program's wire traffic, piece for piece: the forward
+    # dispatch a2a, the dx blocks routing home (combine a2a), and the
+    # dw gather re-running the dispatch a2a
+    coll_only = _smap(comm, lambda ds, xs: (
+        jnp.sum(jlax.all_to_all(xs[0], AXIS, split_axis=0,
+                                concat_axis=1, tiled=True))
+        + jnp.sum(jlax.all_to_all(ds[0], AXIS, split_axis=1,
+                                  concat_axis=0, tiled=True))
+        # the dw gather repeats the dispatch a2a: perturb the operand
+        # so XLA cannot CSE the two collectives into one
+        + jnp.sum(jlax.all_to_all(xs[0] * np.float32(1.0 + 1e-6), AXIS,
+                                  split_axis=0, concat_axis=1,
+                                  tiled=True)))[None], 2)
+    # the grad program's MXU work on pre-gathered tensors: the forward
+    # FFN, drecv = dy·wᵀ, and dw = recvᵀ·dy
+    mm_only = _smap(comm, lambda ds, rs, ws: (
+        jnp.sum(jnp.einsum("epd,edh->eph", rs[0], ws[0],
+                           preferred_element_type=jnp.float32))
+        + jnp.sum(jnp.einsum("eph,edh->epd", ds[0], ws[0],
+                             preferred_element_type=jnp.float32))
+        + jnp.sum(jnp.einsum("epd,eph->edh", rs[0], ds[0],
+                             preferred_element_type=jnp.float32)))[None],
+        3, in_specs=(P(AXIS), P(AXIS), P(AXIS)))
+
+    d_plan = ca.a2a_plan(e_local, C, d, h, W, jnp.float32, bidirectional,
+                         direction="dispatch",
+                         wire_dtype=cm._resolve_wire(wire, np.float32))
+    c_plan = ca.a2a_plan(e_local, C, d, h, W, jnp.float32, bidirectional,
+                         direction="combine",
+                         wire_dtype=cm._resolve_wire(wire, np.float32))
+    engaged = (cm._kernels_available() and d_plan is not None
+               and c_plan is not None)
+    t_fused = _dist(fused, x, wt, rounds=rounds)
+    # the dx return a2a moves drecv-shaped blocks home; recv matches
+    t_coll = _dist(coll_only, recv, x, rounds=rounds)
+    t_mm = _dist(mm_only, dy, recv, wt, rounds=rounds)
+    row = _overlap_row("moe_a2a_bwd", t_fused, t_mm, t_coll, engaged,
+                       rounds)
+    row.update({
+        "e_local": e_local, "C": C, "d": d, "h": h, "world": W,
+        "bidirectional": bool(bidirectional and W >= 4),
+        "wire_dtype": wire,
+        "overlap_plan": d_plan,
+        "plan_mode": (d_plan["mode"] if d_plan is not None else None),
+        "combine_plan_mode": (c_plan["mode"] if c_plan is not None
+                              else None),
+    })
+    return [row]
+
+
 def bench_cmdlist_chain(acc, nbytes: int = 128 << 20, k: int = 64,
                         rounds: int = 7) -> dict:
     """A CommandList of ``k`` chained large combines executed as ONE

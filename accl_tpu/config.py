@@ -143,15 +143,31 @@ class ACCLConfig:
         default_factory=dict)
     rs_matmul_class_thresholds: dict = dataclasses.field(
         default_factory=dict)
-    # wire dtype for collective-matmul staging (None = operand dtype):
-    # "bf16" stages shards (agmm, wgrad) and the travelling accumulator
-    # (mmrs) on the ICI at half the bytes while every accumulation
-    # stays f32 on-chip — the hp_compression "compress on the wire,
-    # accumulate wide" shape. Write-through to
+    # wire dtype for collective-matmul AND fused-a2a staging (None =
+    # operand dtype): "bf16" stages shards (agmm, wgrad, a2a dispatch)
+    # and travelling partials (mmrs, a2a combine) on the ICI at half
+    # the bytes while every accumulation stays f32 on-chip — the
+    # hp_compression "compress on the wire, accumulate wide" shape.
+    # "bf16_sr" additionally routes the input-shard casts through the
+    # stochastic-rounding compress lane (pallas_compress_stochastic) —
+    # unbiased under repeated-compression workloads; in-kernel stagings
+    # still round deterministically. Write-through to
     # collective_matmul.set_wire_dtype; per-call override on every
     # entry point ("off" forces full precision for one call). The
     # select()/engage size registers see EFFECTIVE wire bytes.
     cmatmul_wire_dtype: Optional[str] = None
+
+    # expert-parallel fused all-to-all x expert matmul
+    # (ops/collective_alltoall.py): the MoE dispatch/combine datapath
+    # with each exchange hidden under the expert FFN's MXU time. The
+    # session A/B switch (write-through to
+    # collective_alltoall.set_overlap_enabled, like cmatmul_overlap;
+    # per-call override on every entry point) and the fused-vs-XLA
+    # engage register in PER-DESTINATION block wire bytes (the
+    # (e_local, C, d) token/output block each exchange moves), seeded
+    # by bench.autotune_moe_a2a on the live mesh.
+    moe_overlap: bool = True
+    a2a_matmul_threshold: int = 256 * 1024
 
     # flash-attention backward: "fused" runs the single-pass dK/dV+dQ
     # kernel wherever its VMEM plan fits (two-pass beyond); "two_pass"

@@ -52,6 +52,10 @@ class operation(enum.IntEnum):
     # enum — the collective and the matmul are one scenario here)
     allgather_matmul = 15
     matmul_reduce_scatter = 16
+    # expert-parallel fused all-to-all x expert-matmul pair (the MoE
+    # dispatch/combine datapath; reference alltoall :2123-2218)
+    alltoall_matmul = 17
+    matmul_alltoall = 18
     nop = 255
 
 

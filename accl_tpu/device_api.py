@@ -161,6 +161,45 @@ def matmul_reduce_scatter(x, w, axis: str = AXIS, mesh_axes=None,
                                     bidirectional, wire_dtype)
 
 
+def alltoall_matmul(x, w, axis: str = AXIS, mesh_axes=None,
+                    overlap: Optional[bool] = None,
+                    bidirectional: bool = True,
+                    wire_dtype=None):
+    """In-kernel comm/compute-overlapped MoE dispatch:
+    ``einsum(all_to_all(x), w)`` — x (E, C, d) per-destination token
+    blocks, w (e_local, d, h) local expert in-projections, out
+    (e_local, world*C, h) f32.  Each block rides a flat exchange
+    straight to its expert's rank while the previous arrival's expert
+    matmul runs on the MXU (ops/collective_alltoall.py); the local
+    block's FFN hides the first wire time.  ``overlap=None`` follows
+    ``ACCLConfig.moe_overlap`` + the ``a2a_matmul_threshold`` register;
+    shapes that miss the scoped-VMEM plan fall back to the unfused
+    ``lax.all_to_all`` + einsum pair (same math).  ``wire_dtype=None``
+    follows ``ACCLConfig.cmatmul_wire_dtype``.  Differentiable: dx
+    routes home through the dual fused combine kernel."""
+    from .ops import collective_alltoall as ca
+    mesh_axes = tuple(mesh_axes) if mesh_axes else None
+    return ca.alltoall_matmul(x, w, axis, mesh_axes, overlap,
+                              bidirectional, wire_dtype)
+
+
+def matmul_alltoall(h, w, axis: str = AXIS, mesh_axes=None,
+                    overlap: Optional[bool] = None,
+                    bidirectional: bool = True,
+                    wire_dtype=None):
+    """In-kernel comm/compute-overlapped MoE combine:
+    ``all_to_all(einsum(h, w))`` — h (e_local, world*C, hd) expert
+    activations by destination, w (e_local, hd, d), out (E, C, d) f32.
+    Each destination's ``w_out`` block is put on the wire while the
+    next destination's matmul runs.  Same policy/fallback semantics as
+    :func:`alltoall_matmul`; ``wire_dtype`` rounds each travelling
+    block once (f32 math on-chip)."""
+    from .ops import collective_alltoall as ca
+    mesh_axes = tuple(mesh_axes) if mesh_axes else None
+    return ca.matmul_alltoall(h, w, axis, mesh_axes, overlap,
+                              bidirectional, wire_dtype)
+
+
 def put_next(x, axis: str = AXIS, offset: int = 1):
     """One-sided put to rank+offset on the ring — the ``stream_put`` analog
     (vadd_put.cpp:26-86 sends its stream to the next rank)."""

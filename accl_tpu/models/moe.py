@@ -67,7 +67,9 @@ def shard_params(params: MoEParams, comm: Communicator) -> MoEParams:
 
 def build_moe_forward(comm: Communicator, n_experts: int,
                       capacity: int, top_k: int = 1,
-                      return_aux: bool = False) -> callable:
+                      return_aux: bool = False,
+                      overlap: bool = None,
+                      wire_dtype=None) -> callable:
     """Compile the expert-parallel MoE forward.
 
     Input x: (world, n, d) token-sharded; output same shape. ``capacity``
@@ -78,6 +80,32 @@ def build_moe_forward(comm: Communicator, n_experts: int,
     every token's first choice is slotted before any second choices, so
     capacity pressure drops second choices first.
 
+    ``overlap`` selects the dispatch/combine datapath (the A/B the
+    ``moe_a2a`` bench lane measures):
+
+    * **lax baseline** (``overlap=False``): two opaque
+      ``lax.all_to_all`` calls with the expert FFN serialized between
+      them — the wire idles during MXU time and vice versa;
+    * **fused** (``overlap=True``): dispatch rides
+      :func:`device_api.alltoall_matmul` (each arriving token block's
+      ``w_in`` matmul runs while the next exchange is in flight) and
+      combine rides :func:`device_api.matmul_alltoall` (each
+      destination's ``w_out`` block on the wire under the next block's
+      matmul) — ``ops/collective_alltoall.py``, in the backward too
+      (the kernels are ``custom_vjp`` duals). Same math: loss
+      trajectories match the baseline to float tolerance.
+
+    ``overlap=None`` (default) follows the session config
+    (``ACCLConfig.moe_overlap`` write-through + the
+    ``a2a_matmul_threshold`` register). The layer COMMITS to the fused
+    datapath only when the kernels actually engage for BOTH directions
+    (session registers + VMEM plan + rung — ``a2a_matmul_engages``);
+    otherwise the lax baseline runs unchanged (never a degraded unfused
+    rendition) and the decline is counted in
+    ``accl_cmatmul_fallback_total{op="moe_alltoall"}``. ``wire_dtype``
+    stages the a2a payloads compressed (None: session
+    ``ACCLConfig.cmatmul_wire_dtype``; "off": full precision).
+
     ``return_aux`` also returns the Switch auxiliary load-balancing loss
     computed over the GLOBAL batch (one ``psum`` across ranks):
     ``aux = E * Σ_e f_e · P_e`` with f_e the fraction of tokens whose
@@ -87,6 +115,8 @@ def build_moe_forward(comm: Communicator, n_experts: int,
     a (world,)-replicated scalar array; add ``λ·aux[0]`` to the loss.
     """
     world = comm.world_size
+    if n_experts % world != 0:
+        raise ValueError(f"n_experts {n_experts} % world {world} != 0")
     e_local = n_experts // world
     if not 1 <= top_k <= n_experts:
         raise ValueError(f"top_k {top_k} must be in [1, {n_experts}]")
@@ -125,19 +155,74 @@ def build_moe_forward(comm: Communicator, n_experts: int,
             prev_counts = prev_counts + oh.sum(axis=0)
 
         send = jnp.einsum("nec,nd->ecd", disp, x)      # (E, C, d)
-        # dispatch: expert-block e → rank e // e_local; received blocks
-        # stack in rank order along capacity → (E_local, world*C, d)
-        recv = lax.all_to_all(send, AXIS, split_axis=0, concat_axis=1,
-                              tiled=True)
+        # take the fused a2a×matmul datapath only when the kernels would
+        # ACTUALLY engage for BOTH directions (session registers + VMEM
+        # plan + rung) — anything less runs the lax baseline below
+        # unchanged, never a degraded unfused rendition
+        from ..ops import collective_alltoall as ca
+        d_hidden = params.w_in.shape[2]
+        # the dtypes the two datapaths must agree on: the baseline's h
+        # is einsum(recv, w_in) (promoted), its back/output einsum
+        # promotes through w_out — the fused path stages/returns in the
+        # SAME dtypes so the layer's output never flips with engagement
+        h_dtype = jnp.result_type(x.dtype, params.w_in.dtype)
+        out_dtype = jnp.result_type(h_dtype, params.w_out.dtype)
+        reason = None
+        if world > 1:
+            reason = (ca.a2a_engage_reason(
+                          e_local, capacity, d, d_hidden, world, x.dtype,
+                          overlap, wire_dtype=wire_dtype,
+                          w_dtype=params.w_in.dtype, direction="dispatch")
+                      or ca.a2a_engage_reason(
+                          e_local, capacity, d, d_hidden, world, h_dtype,
+                          overlap, wire_dtype=wire_dtype,
+                          w_dtype=params.w_out.dtype,
+                          direction="combine"))
+        fused = world > 1 and reason is None
+        if fused:
+            # dispatch: each destination rank's token block rides a flat
+            # exchange while the previous arrival's w_in matmul runs;
+            # combine: each destination's w_out block is on the wire
+            # under the next destination's matmul — the two lax
+            # collectives and the FFN matmuls become one overlapped
+            # schedule (ops/collective_alltoall.py)
+            from .. import device_api as dapi
+            h = jax.nn.relu(dapi.alltoall_matmul(
+                send, params.w_in, axis=AXIS, overlap=overlap,
+                wire_dtype=wire_dtype))
+            # stage the combine in the baseline's h dtype (matches the
+            # engage check's plan sizing) and return the baseline's
+            # promoted output dtype after the fused f32 output — the
+            # layer's dtypes must not flip between the fused and
+            # baseline datapaths (bf16 tokens would otherwise come back
+            # narrower or wider only where the kernels engage)
+            back = dapi.matmul_alltoall(
+                h.astype(h_dtype), params.w_out, axis=AXIS,
+                overlap=overlap,
+                wire_dtype=wire_dtype).astype(out_dtype)  # (E, C, d)
+        else:
+            if world > 1 and reason != "off":
+                # engage-honesty accounting: the committed-baseline
+                # decline carries the EXACT reason the engage check
+                # resolved ("off" is a requested baseline, not a
+                # fallback — never counted)
+                from ..ops.collective_matmul import _note_fallback
+                _note_fallback("moe_alltoall", reason)
+            # dispatch: expert-block e → rank e // e_local; received
+            # blocks stack in rank order along capacity →
+            # (E_local, world*C, d)
+            recv = lax.all_to_all(send, AXIS, split_axis=0, concat_axis=1,
+                                  tiled=True)
 
-        # local expert FFNs (batched over my e_local experts) — MXU matmuls;
-        # w_in/w_out arrive as the (E_local, ...) shard of the global array
-        h = jax.nn.relu(jnp.einsum("ecd,edh->ech", recv, params.w_in))
-        y = jnp.einsum("ech,ehd->ecd", h, params.w_out)
+            # local expert FFNs (batched over my e_local experts) — MXU
+            # matmuls; w_in/w_out arrive as the (E_local, ...) shard of
+            # the global array
+            h = jax.nn.relu(jnp.einsum("ecd,edh->ech", recv, params.w_in))
+            y = jnp.einsum("ech,ehd->ecd", h, params.w_out)
 
-        # inverse all-to-all: send each rank its tokens' outputs back
-        back = lax.all_to_all(y, AXIS, split_axis=1, concat_axis=0,
-                              tiled=True)              # (E, C, d)
+            # inverse all-to-all: send each rank its tokens' outputs back
+            back = lax.all_to_all(y, AXIS, split_axis=1, concat_axis=0,
+                                  tiled=True)          # (E, C, d)
         # gate-weighted combine; dropped choices contribute nothing (the
         # token keeps its residual, and surviving choices keep their
         # renormalized weights)

@@ -1,0 +1,759 @@
+"""Collective all-to-all × expert matmul: the MoE dispatch/combine
+datapath with the wire hidden under the expert FFN's MXU time.
+
+The reference's alltoall (``ccl_offload_control.c:2123-2218``) runs P
+fused FLAT trees — every rank sends a distinct block straight to every
+other rank — precisely for expert-parallel traffic; ACCL+ (arXiv
+2312.11742) makes the case for offloading that exchange so compute never
+stalls on the wire, and "Synthesizing Optimal Collective Algorithms"
+(arXiv 2008.08708) shows the win comes from co-scheduling the collective
+with its consumer.  Our MoE layer (``models/moe.py``) ran two opaque
+``lax.all_to_all`` calls with the expert FFN serialized between them —
+the one major model datapath none of the rounds 7-9 overlap work
+touched.  These kernels close it:
+
+* :func:`alltoall_matmul` — **dispatch**: each rank's ``(E, C, d)`` send
+  buffer holds one ``(e_local, C, d)`` token block per destination rank.
+  At step ``u`` the block for rank ``pos±u`` rides a ``make_async_
+  remote_copy`` STRAIGHT to its destination (the flat-tree shape — the
+  ICI routes; no relay ring, so each block moves once) while the
+  ``w_in`` expert matmul of the PREVIOUS arrival runs on the MXU.  The
+  local block's FFN hides the first wire time — the ``_agmm_kernel``
+  prologue verbatim — and the arrivals stage through double-buffered
+  VMEM slots under the credit-semaphore discipline (grants == gates,
+  every semaphore drains to zero).  Returns the expert activations
+  ``(e_local, P·C, h)`` in f32, source-rank-major — exactly
+  ``einsum(all_to_all(x), w_in)``.
+* :func:`matmul_alltoall` — **combine** (the mm×rs shape): each
+  destination's ``w_out`` output block is computed on the MXU and put on
+  the wire while the NEXT destination's matmul runs; arrivals land
+  write-once in the caller-visible output at the sender's source-rank
+  block (no slot reuse → no credit protocol needed on the receive side;
+  the send staging double-buffers and self-gates on its own drain).
+
+``bidirectional=True`` (P >= 4) counter-rotates the two channels:
+channel 0 exchanges with partners at distances ``+1..+⌈(P-1)/2⌉``,
+channel 1 at ``-1..-⌊(P-1)/2⌋`` — together covering every distance
+exactly once, so both directions of every ICI link carry payload and
+the step count halves (the ``_dirs(chan)`` idiom applied to flat
+exchanges).
+
+Backward passes are the SAME kernels with roles swapped (dispatch and
+combine are transposes of each other), registered as ``jax.custom_vjp``:
+
+* d(alltoall_matmul):  dx = matmul_alltoall(dy, w_inᵀ)  — each source's
+  cotangent block routed home through the fused combine kernel;
+  dw_in[e] = all_to_all(x)[e]ᵀ @ dy[e] (one unfused a2a);
+* d(matmul_alltoall):  dh = alltoall_matmul(dy, w_outᵀ) — the fused
+  dispatch kernel; dw_out[e] = h[e]ᵀ @ all_to_all(dy)[e].
+
+A block-geometry policy (:func:`a2a_plan`) sizes the resident working
+set (payload blocks, expert weights, output panel, staging slots)
+against the 12 MiB scoped-VMEM budget; a miss falls back to the
+unfused ``lax.all_to_all`` + einsum pair (same math, no overlap), and
+every fallback is counted in ``accl_cmatmul_fallback_total{op, reason}``
+alongside the collective-matmul ops.  ``ACCLConfig.moe_overlap`` is the
+session A/B switch (write-through, like ``cmatmul_overlap``) and
+``ACCLConfig.a2a_matmul_threshold`` the autotuned engage register, in
+per-destination block wire bytes.
+
+**Wire staging** rides the existing ``cmatmul_wire_dtype`` machinery:
+dispatch casts the token payload once (``pallas_cast``, or the
+``bf16_sr`` stochastic-rounding codec) and every expert matmul
+accumulates f32 on-chip — bit-exact whenever the inputs are
+wire-representable; combine rounds each computed y block once at the
+send staging (in-kernel, deterministic — the mm×rs traveller shape),
+the local block included for uniformity, and the wrapper returns f32.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..parallel import pallas_ring as _pr
+from ..parallel.pallas_ring import _LANES, _sublane
+from . import collective_matmul as cm
+
+AXIS = _pr.AXIS
+
+#: scoped-VMEM budget (the flash/cmatmul policy's number)
+_VMEM_BUDGET = cm._VMEM_BUDGET
+
+
+def _interpret_params():
+    # late-bound through pallas_ring so tests patching it (e.g. the race
+    # detector) cover these kernels too
+    return _pr._interpret_params()
+
+
+# ---------------------------------------------------------------------------
+# session-level overlap switch + engage register
+# (ACCLConfig.moe_overlap / a2a_matmul_threshold write-through)
+# ---------------------------------------------------------------------------
+
+_OVERLAP_DEFAULT = True
+#: engage-at-or-above PER-DESTINATION block wire bytes for the
+#: overlap=None session-default resolution (dispatch: the (e_local, C, d)
+#: token block; combine: the f32/wire y block — same element count).
+#: 0 until a session installs a tuned value: overlap-by-default. An
+#: EXPLICIT overlap=True bypasses it, like a requested Algorithm.PALLAS.
+_A2A_THRESHOLD = 0
+
+
+def set_overlap_enabled(enabled: bool) -> None:
+    """Module default for the fused MoE a2a path
+    (``ACCLConfig.moe_overlap`` lands here at every config assignment).
+    Per-call override: the entry points' ``overlap`` argument."""
+    global _OVERLAP_DEFAULT
+    _OVERLAP_DEFAULT = bool(enabled)
+
+
+def get_overlap_enabled() -> bool:
+    return _OVERLAP_DEFAULT
+
+
+def set_overlap_threshold(nbytes: int) -> None:
+    """Install the session's fused-vs-XLA block-size register (config
+    write-through; seeded by ``bench.autotune_moe_a2a``)."""
+    global _A2A_THRESHOLD
+    _A2A_THRESHOLD = int(nbytes)
+
+
+def get_overlap_threshold() -> int:
+    return _A2A_THRESHOLD
+
+
+def _resolve(overlap: Optional[bool], nbytes: int) -> bool:
+    """overlap=None: session default AND the block clears the tuned size
+    register; True/False: forced. Either way the kernels must be
+    executable on this rung (``cm._kernels_available``)."""
+    if overlap is None:
+        on = _OVERLAP_DEFAULT and nbytes >= _A2A_THRESHOLD
+    else:
+        on = bool(overlap)
+    return on and cm._kernels_available()
+
+
+def _fallback_reason(overlap: Optional[bool], op: str) -> None:
+    """Count a policy-level fallback (plan never consulted) under the
+    shared ``accl_cmatmul_fallback_total`` counter — an explicit
+    overlap=False (per call or session ``moe_overlap=False``) is a
+    requested baseline, never a fallback."""
+    if overlap is not None and not overlap:
+        return
+    if overlap is None and not _OVERLAP_DEFAULT:
+        return
+    cm._note_fallback(op, "no_interpret" if not cm._kernels_available()
+                      else "threshold")
+
+
+# ---------------------------------------------------------------------------
+# flat exchange geometry
+# ---------------------------------------------------------------------------
+
+def _chan_steps(P: int, nchan: int) -> Tuple[Tuple[int, int], ...]:
+    """Per-channel ``(sign, n_steps)``: channel 0 exchanges with the
+    partners at ring distances ``+1..+T0``, channel 1 (bidirectional) at
+    ``-1..-T1`` — together covering every distance ``1..P-1`` exactly
+    once, so both directions of every link carry payload and the step
+    count halves (the counter-rotating ``_dirs(chan)`` idiom applied to
+    flat exchanges)."""
+    if nchan == 1:
+        return ((1, P - 1),)
+    return ((1, P // 2), (-1, (P - 1) // 2))
+
+
+def _flat_of(axis: str, mesh_axes: Tuple[str, ...], P: int, offset):
+    """LOGICAL flat device id of the rank at ring position
+    ``(pos + offset) % P`` — the multi-axis fold of ``cm._flat_ids``
+    generalized to arbitrary ring offsets (flat trees address every
+    peer, not just neighbors)."""
+    tpos = lax.rem(lax.axis_index(axis) + jnp.int32(offset)
+                   + jnp.int32(2 * P), jnp.int32(P))
+    fid = jnp.int32(0)
+    for name in mesh_axes:
+        size = jnp.int32(lax.axis_size(name))
+        idx = lax.axis_index(name)
+        fid = fid * size + (tpos if name == axis else idx)
+    return fid
+
+
+def _flat_barrier(axis: str, mesh_axes: Tuple[str, ...], P: int) -> None:
+    """Full-mesh entry barrier: flat exchanges write remote buffers on
+    NON-neighbor ranks, so the neighbor-only ``_ring_barrier`` is not
+    enough — signal every peer, wait for every peer."""
+    sem = pltpu.get_barrier_semaphore()
+    for t in range(1, P):
+        pltpu.semaphore_signal(
+            sem, inc=1, device_id=_flat_of(axis, mesh_axes, P, t),
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(sem, P - 1)
+
+
+# ---------------------------------------------------------------------------
+# dispatch kernel: all-to-all x expert w_in matmul
+# ---------------------------------------------------------------------------
+
+def _a2a_mm_kernel(x_ref, w_ref, o_ref, buf, send_sem, recv_sem, cap_sem, *,
+                   P: int, axis: str, mesh_axes: Tuple[str, ...],
+                   bidirectional: bool, e_local: int):
+    """x_ref: (P, e_local, cp, dp) token blocks by DESTINATION rank;
+    w_ref: (e_local, dp, hp); o_ref: (e_local, P*cp, hp) f32 — all VMEM.
+    ``buf``: (nchan, 2, e_local, cp, dp) double-buffered recv slots.
+
+    Step ``u`` on channel ``(sign)`` sends my block for rank
+    ``pos + sign*u`` STRAIGHT to that rank's slot ``u % 2`` (flat tree —
+    sends source from x_ref, never a relay) while the expert matmuls of
+    the step-``u-1`` arrival run on the MXU; the local block's FFN hides
+    step 1's wire time.  Credit discipline on the recv slots (grants ==
+    gates, drains to zero): the writer of my slot at step ``u+2`` gets
+    its credit only after the matmul consumed the slot's step-``u``
+    content.  Unlike the ring kernels — where all grants come from ONE
+    fixed upstream neighbor, so a counting semaphore is ordered by that
+    device's program order — every exchange step here has a DIFFERENT
+    granting device, and independent granters can signal out of order;
+    the credits are therefore keyed PER STEP (``cap_sem[chan, step]``),
+    so a later step's early credit can never satisfy an earlier step's
+    gate and overwrite an unconsumed remote slot.  Steps unroll at
+    trace time (P is static), so every DMA below is a static-slot
+    descriptor.
+    """
+    nchan = 2 if bidirectional else 1
+    cp = buf.shape[3]
+    pos = lax.axis_index(axis)
+    _flat_barrier(axis, mesh_axes, P)
+
+    def peer(off):
+        return _flat_of(axis, mesh_axes, P, off)
+
+    def ringpos(off):
+        return lax.rem(pos + jnp.int32(off) + jnp.int32(2 * P),
+                       jnp.int32(P))
+
+    def _rdma(chan, sign, u):
+        return pltpu.make_async_remote_copy(
+            src_ref=x_ref.at[ringpos(sign * u)],
+            dst_ref=buf.at[chan, u % 2],
+            send_sem=send_sem.at[chan, u % 2],
+            recv_sem=recv_sem.at[chan, u % 2],
+            device_id=peer(sign * u),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    def ffn(block, src):
+        # batched expert matmul: block (e_local, cp, dp) against
+        # (e_local, dp, hp), each expert's rows landing at the source
+        # rank's row block of the activations panel — f32 accumulate
+        # (the wire dtype, if any, up-converts at the MXU)
+        for e in range(e_local):
+            o_ref[e, pl.ds(src * cp, cp), :] = jnp.dot(
+                block[e], w_ref[e], preferred_element_type=jnp.float32)
+
+    chans = _chan_steps(P, nchan)
+    # prologue: every channel's step-1 send goes out first; the LOCAL
+    # block's FFN then hides the first wire time (the agmm prologue)
+    for chan, (sign, T) in enumerate(chans):
+        if T >= 1:
+            _rdma(chan, sign, 1).start()
+    ffn(x_ref[pos], pos)
+
+    for u in range(1, max(T for _, T in chans) + 1):
+        for chan, (sign, T) in enumerate(chans):
+            if u > T:
+                continue
+            _rdma(chan, sign, u).wait_recv()
+            if u + 1 <= T:
+                # credit gate: slot (u+1)%2 at the destination still
+                # holds its step-(u-1) arrival until consumed — waited
+                # on the STEP's own credit slot (the granter differs
+                # per step; see the docstring)
+                if u + 1 >= 3:
+                    pltpu.semaphore_wait(cap_sem.at[chan, u + 1], 1)
+                # next send in flight during this arrival's MXU work
+                _rdma(chan, sign, u + 1).start()
+            ffn(buf[chan, u % 2], ringpos(-sign * u))
+            _rdma(chan, sign, u).wait_send()
+            if u + 2 <= T:
+                # slot consumed -> grant the rank that writes it at u+2,
+                # into that step's credit slot
+                pltpu.semaphore_signal(
+                    cap_sem.at[chan, u + 2], inc=1,
+                    device_id=peer(-sign * (u + 2)),
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+
+def _a2a_mm_call(xp, wp, *, P: int, axis: str, mesh_axes: Tuple[str, ...],
+                 bidirectional: bool, e_local: int):
+    _, _, cp, dp = xp.shape
+    hp = wp.shape[2]
+    nchan = 2 if bidirectional else 1
+    return pl.pallas_call(
+        functools.partial(_a2a_mm_kernel, P=P, axis=axis,
+                          mesh_axes=mesh_axes, bidirectional=bidirectional,
+                          e_local=e_local),
+        out_shape=jax.ShapeDtypeStruct((e_local, P * cp, hp), jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((nchan, 2, e_local, cp, dp), xp.dtype),  # buf
+            pltpu.SemaphoreType.DMA((nchan, 2)),                # send_sem
+            pltpu.SemaphoreType.DMA((nchan, 2)),                # recv_sem
+            # per-STEP credit slots (distinct granters per step must
+            # not alias one counter); steps run 1..P-1
+            pltpu.SemaphoreType.REGULAR((nchan, P + 1)),        # cap_sem
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=13),
+        interpret=_interpret_params(),
+    )(xp, wp)
+
+
+# ---------------------------------------------------------------------------
+# combine kernel: expert w_out matmul x all-to-all
+# ---------------------------------------------------------------------------
+
+def _mm_a2a_kernel(h_ref, w_ref, o_ref, ybuf, send_sem, recv_sem, *,
+                   P: int, axis: str, mesh_axes: Tuple[str, ...],
+                   bidirectional: bool, e_local: int):
+    """h_ref: (e_local, P*cp, hp) expert activations by destination rank;
+    w_ref: (e_local, hp, dp); o_ref: (P, e_local, cp, dp) output blocks
+    by SOURCE rank (f32, or the wire dtype — the wrapper up-converts).
+
+    Step ``u`` computes destination ``pos + sign*(u+1)``'s y block into
+    the staging slot while step ``u``'s block is on the wire — each
+    expert's ``w_out`` partial output put on the wire while the next
+    destination's matmul runs (the mm×rs shape, without a fold: this is
+    transport, not a reduction).  Arrivals land WRITE-ONCE in my output
+    at the sender's source-rank block, so the receive side needs no
+    credit protocol; the send staging double-buffers and self-gates on
+    its own drain.  The local block (my experts' outputs for my own
+    tokens) is computed straight into ``o_ref[pos]`` while step 1's
+    send flies — it never rides the wire.
+    """
+    nchan = 2 if bidirectional else 1
+    cp = o_ref.shape[2]
+    odt = o_ref.dtype
+    pos = lax.axis_index(axis)
+    _flat_barrier(axis, mesh_axes, P)
+
+    def peer(off):
+        return _flat_of(axis, mesh_axes, P, off)
+
+    def ringpos(off):
+        return lax.rem(pos + jnp.int32(off) + jnp.int32(2 * P),
+                       jnp.int32(P))
+
+    def _rdma(chan, sign, u):
+        # my y block rides straight to its destination, landing at MY
+        # source-rank block of the destination's output (the dst slice
+        # indices are sender-computed — pos names me on both sides)
+        return pltpu.make_async_remote_copy(
+            src_ref=ybuf.at[chan, u % 2],
+            dst_ref=o_ref.at[pos],
+            send_sem=send_sem.at[chan, u % 2],
+            recv_sem=recv_sem.at[chan, u % 2],
+            device_id=peer(sign * u),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    def yblock(chan, slot, dst):
+        # destination dst's (e_local, cp, dp) block: each expert's w_out
+        # applied to that destination's activation rows; computed f32 on
+        # the MXU, rounded ONCE at the staging store when a wire dtype
+        # is set (the mm×rs in-kernel wire discipline)
+        for e in range(e_local):
+            ybuf[chan, slot, e] = jnp.dot(
+                h_ref[e, pl.ds(dst * cp, cp), :], w_ref[e],
+                preferred_element_type=jnp.float32).astype(odt)
+
+    chans = _chan_steps(P, nchan)
+    for chan, (sign, T) in enumerate(chans):
+        if T >= 1:
+            yblock(chan, 1 % 2, ringpos(sign))
+            _rdma(chan, sign, 1).start()
+    # the local block's matmul hides step 1's wire time (one rounding
+    # like every other block, for uniform wire semantics)
+    for e in range(e_local):
+        o_ref[pos, e] = jnp.dot(
+            h_ref[e, pl.ds(pos * cp, cp), :], w_ref[e],
+            preferred_element_type=jnp.float32).astype(odt)
+
+    for u in range(1, max(T for _, T in chans) + 1):
+        for chan, (sign, T) in enumerate(chans):
+            if u > T:
+                continue
+            if u + 1 <= T:
+                # staging slot (u+1)%2 last carried step u-1's block:
+                # drain that send before overwriting (self-gating — the
+                # only writer of the slot is this rank)
+                if u - 1 >= 1:
+                    _rdma(chan, sign, u - 1).wait_send()
+                yblock(chan, (u + 1) % 2, ringpos(sign * (u + 1)))
+                _rdma(chan, sign, u + 1).start()
+            # drain this step's arrival accounting (the block landed
+            # write-once at its sender's output slot)
+            _rdma(chan, sign, u).wait_recv()
+    # epilogue: the last two sends per channel are still undrained
+    for chan, (sign, T) in enumerate(chans):
+        if T >= 1:
+            _rdma(chan, sign, T).wait_send()
+        if T >= 2:
+            _rdma(chan, sign, T - 1).wait_send()
+
+
+def _mm_a2a_call(hp_, wp, *, P: int, axis: str, mesh_axes: Tuple[str, ...],
+                 bidirectional: bool, e_local: int, out_dtype):
+    cp = hp_.shape[1] // P
+    dp = wp.shape[2]
+    nchan = 2 if bidirectional else 1
+    return pl.pallas_call(
+        functools.partial(_mm_a2a_kernel, P=P, axis=axis,
+                          mesh_axes=mesh_axes, bidirectional=bidirectional,
+                          e_local=e_local),
+        out_shape=jax.ShapeDtypeStruct((P, e_local, cp, dp), out_dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((nchan, 2, e_local, cp, dp), out_dtype),  # ybuf
+            pltpu.SemaphoreType.DMA((nchan, 2)),                 # send_sem
+            pltpu.SemaphoreType.DMA((nchan, 2)),                 # recv_sem
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=14),
+        interpret=_interpret_params(),
+    )(hp_, wp)
+
+
+# ---------------------------------------------------------------------------
+# block-geometry policy
+# ---------------------------------------------------------------------------
+
+def a2a_plan(e_local: int, C: int, d: int, h: int, P: int, dtype,
+             bidirectional: bool, direction: str = "dispatch",
+             w_dtype=None, wire_dtype=None) -> Optional[dict]:
+    """Geometry for one fused a2a×matmul direction — everything is
+    VMEM-resident (payload blocks, expert weights, output panel, staging
+    slots), None on a 12 MiB scoped-VMEM miss (→ the unfused
+    ``lax.all_to_all`` + einsum pair; counted ``vmem_miss``).  No
+    streaming mode: MoE block shapes are capacity-bounded by
+    construction, so the resident plan either fits or the capacity is
+    mis-sized for the chip.
+
+    ``direction``: ``"dispatch"`` (token blocks (e_local, C, d) in, f32
+    activations (e_local, P·C, h) out — the wire dtype sizes the staged
+    payload terms) or ``"combine"`` (activations in, (P·e_local, C, d)
+    blocks out — the wire dtype sizes the travelling y terms)."""
+    if e_local < 1 or C < 1 or d < 1 or h < 1 or P < 1:
+        return None
+    if direction not in ("dispatch", "combine"):
+        raise ValueError(f"unknown a2a direction {direction!r}")
+    isz = jnp.dtype(dtype).itemsize
+    wisz = jnp.dtype(w_dtype).itemsize if w_dtype is not None else isz
+    nchan = 2 if (bidirectional and P >= 4) else 1
+    dp = cm._pad_to(max(d, 1), _LANES)
+    hp = cm._pad_to(max(h, 1), _LANES)
+    if direction == "dispatch":
+        xdt = jnp.dtype(wire_dtype) if wire_dtype is not None \
+            else jnp.dtype(dtype)
+        cp = cm._pad_to(max(C, 1), _sublane(xdt))
+        xi = xdt.itemsize
+        est = (P * e_local * cp * dp * xi        # token blocks by dest
+               + e_local * dp * hp * wisz        # w_in
+               + e_local * P * cp * hp * 4       # f32 activations panel
+               + nchan * 2 * e_local * cp * dp * xi)   # recv slots
+    else:
+        oi = jnp.dtype(wire_dtype).itemsize if wire_dtype is not None else 4
+        sub = max(_sublane(dtype),
+                  _sublane(wire_dtype) if wire_dtype is not None else 0)
+        cp = cm._pad_to(max(C, 1), sub)
+        est = (e_local * P * cp * hp * isz       # activations payload
+               + e_local * hp * dp * wisz        # w_out
+               + P * e_local * cp * dp * oi      # output blocks by source
+               + nchan * 2 * e_local * cp * dp * oi)   # y staging slots
+    if est > _VMEM_BUDGET:
+        return None
+    return {"mode": "resident", "cp": cp, "dp": dp, "hp": hp,
+            "nchan": nchan, "bidirectional": nchan == 2,
+            "vmem_bytes": est}
+
+
+def a2a_engage_reason(e_local: int, C: int, d: int, h: int, P: int, dtype,
+                      overlap: Optional[bool] = None,
+                      bidirectional: bool = True,
+                      wire_dtype=None, w_dtype=None,
+                      direction: str = "dispatch") -> Optional[str]:
+    """None when the fused kernel would actually run for these shapes
+    under the given overlap mode; otherwise the decline reason —
+    ``"off"`` (an explicit/session overlap-off request: a requested
+    baseline, never counted as a fallback), ``"no_interpret"``,
+    ``"threshold"``, or ``"vmem_miss"``.  THE single resolution of the
+    session register (block wire bytes), kernel availability, and the
+    VMEM plan — the engage checks and the MoE layer's committed-
+    baseline telemetry both read it, so the counted label can never
+    drift from the actual decision.  ``dtype`` must be the dtype the
+    body will ACTUALLY see for that direction (dispatch: the token
+    payload x; combine: the activations h as passed — the MoE layer
+    stages the combine in the baseline's promoted h dtype for exactly
+    this agreement); a verdict computed with a different dtype can
+    diverge from dispatch near the VMEM budget."""
+    if direction == "dispatch":
+        wdt = cm._resolve_wire(wire_dtype, dtype)
+        nbytes = e_local * C * d * jnp.dtype(
+            wdt if wdt is not None else dtype).itemsize
+    else:
+        wdt = cm._resolve_wire(wire_dtype, jnp.float32)
+        nbytes = e_local * C * d * (jnp.dtype(wdt).itemsize
+                                    if wdt is not None else 4)
+    if (overlap is not None and not overlap) or \
+            (overlap is None and not _OVERLAP_DEFAULT):
+        return "off"
+    if not cm._kernels_available():
+        return "no_interpret"
+    if overlap is None and nbytes < _A2A_THRESHOLD:
+        return "threshold"
+    if a2a_plan(e_local, C, d, h, P, dtype, bidirectional,
+                direction=direction, w_dtype=w_dtype,
+                wire_dtype=wdt) is None:
+        return "vmem_miss"
+    return None
+
+
+def a2a_matmul_engages(e_local: int, C: int, d: int, h: int, P: int, dtype,
+                       overlap: Optional[bool] = None,
+                       bidirectional: bool = True,
+                       wire_dtype=None, w_dtype=None,
+                       direction: str = "dispatch") -> bool:
+    """True when the fused kernel would actually run for these shapes —
+    :func:`a2a_engage_reason` with the verdict collapsed to a bool.
+    Lets callers that RESTRUCTURE around the fused kernels (the MoE
+    layer) commit to the fused datapath only when it engages for BOTH
+    directions, else keep their own ``lax.all_to_all`` baseline —
+    never a degraded unfused rendition of the restructured program."""
+    return a2a_engage_reason(e_local, C, d, h, P, dtype, overlap,
+                             bidirectional, wire_dtype, w_dtype,
+                             direction) is None
+
+
+# ---------------------------------------------------------------------------
+# unfused XLA references (the fallback pair, and the parity oracle)
+# ---------------------------------------------------------------------------
+
+def xla_alltoall_matmul(x, w, axis: str = AXIS):
+    """The sequential pair: blocking all-to-all, then the expert FFN
+    matmul — the pre-fusion MoE dispatch datapath."""
+    recv = lax.all_to_all(x, axis, split_axis=0, concat_axis=1, tiled=True)
+    return jnp.einsum("epd,edh->eph", recv, w,
+                      preferred_element_type=jnp.float32)
+
+
+def xla_matmul_alltoall(h, w, axis: str = AXIS):
+    """The sequential pair: full expert output matmul, then the blocking
+    return all-to-all."""
+    y = jnp.einsum("eph,ehd->epd", h, w,
+                   preferred_element_type=jnp.float32)
+    return lax.all_to_all(y, axis, split_axis=1, concat_axis=0, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# per-rank bodies (padding + policy around the kernels)
+# ---------------------------------------------------------------------------
+
+def alltoall_matmul_body(x, w, *, axis: str = AXIS,
+                         mesh_axes: Optional[Tuple[str, ...]] = None,
+                         overlap: Optional[bool] = None,
+                         bidirectional: bool = True,
+                         wire_dtype=None):
+    """Per-rank dispatch body: x (E, C, d) token blocks by destination
+    expert-owner rank, w (e_local, d, h) local expert in-projections ->
+    (e_local, P*C, h) f32 — ``einsum(all_to_all(x), w)`` with each
+    arriving block's expert matmul hiding the next exchange's wire time.
+    Falls back to the unfused pair on VMEM miss / declined threshold /
+    kernel-less rungs (each counted by reason)."""
+    E, C, d = x.shape
+    el, d2, h = w.shape
+    if d2 != d:
+        raise ValueError(f"contraction mismatch: x {x.shape} vs w {w.shape}")
+    P = lax.axis_size(axis)
+    if E % P or el != E // P:
+        raise ValueError(
+            f"expert blocks {E} must be world {P} x local experts {el}")
+    mesh_axes = tuple(mesh_axes) if mesh_axes else (axis,)
+    if P == 1:
+        return jnp.einsum("ecd,edh->ech", x, w,
+                          preferred_element_type=jnp.float32)
+    wdt, sr = cm._resolve_wire_codec(wire_dtype, x.dtype)
+    block_bytes = el * C * d * jnp.dtype(
+        wdt if wdt is not None else x.dtype).itemsize
+    plan = None
+    if _resolve(overlap, block_bytes):
+        plan = a2a_plan(el, C, d, h, P, x.dtype, bidirectional,
+                        direction="dispatch", w_dtype=w.dtype,
+                        wire_dtype=wdt)
+        if plan is None:
+            cm._note_fallback("alltoall_matmul", "vmem_miss")
+    else:
+        _fallback_reason(overlap, "alltoall_matmul")
+    if plan is None:
+        return xla_alltoall_matmul(x, w, axis)
+    cp, dp, hp = plan["cp"], plan["dp"], plan["hp"]
+    xw = cm._wire_cast(x, wdt, stochastic=sr)
+    xp = jnp.zeros((P, el, cp, dp), xw.dtype)
+    xp = lax.dynamic_update_slice(xp, xw.reshape(P, el, C, d), (0, 0, 0, 0))
+    wp = jnp.zeros((el, dp, hp), w.dtype)
+    wp = lax.dynamic_update_slice(wp, w, (0, 0, 0))
+    out = _a2a_mm_call(xp, wp, P=P, axis=axis, mesh_axes=mesh_axes,
+                       bidirectional=plan["bidirectional"], e_local=el)
+    return out.reshape(el, P, cp, hp)[:, :, :C, :h].reshape(el, P * C, h)
+
+
+def matmul_alltoall_body(h, w, *, axis: str = AXIS,
+                         mesh_axes: Optional[Tuple[str, ...]] = None,
+                         overlap: Optional[bool] = None,
+                         bidirectional: bool = True,
+                         wire_dtype=None):
+    """Per-rank combine body: h (e_local, P*C, hd) expert activations by
+    destination rank, w (e_local, hd, d) local out-projections ->
+    (E, C, d) f32 — ``all_to_all(einsum(h, w))`` with each destination's
+    block put on the wire while the next destination's matmul runs.
+    ``wire_dtype`` rounds each travelling y block once (local block
+    included, for uniform semantics); the fallback pair always runs
+    full precision."""
+    el, PC, hd = h.shape
+    el2, h2, d = w.shape
+    if h2 != hd or el2 != el:
+        raise ValueError(f"contraction mismatch: h {h.shape} vs w {w.shape}")
+    P = lax.axis_size(axis)
+    if PC % P:
+        raise ValueError(f"activation rows {PC} not divisible by world {P}")
+    C = PC // P
+    mesh_axes = tuple(mesh_axes) if mesh_axes else (axis,)
+    if P == 1:
+        return jnp.einsum("eph,ehd->epd", h, w,
+                          preferred_element_type=jnp.float32)
+    wdt = cm._resolve_wire(wire_dtype, jnp.float32)  # the traveller is f32
+    block_bytes = el * C * d * (jnp.dtype(wdt).itemsize
+                                if wdt is not None else 4)
+    plan = None
+    if _resolve(overlap, block_bytes):
+        plan = a2a_plan(el, C, d, hd, P, h.dtype, bidirectional,
+                        direction="combine", w_dtype=w.dtype,
+                        wire_dtype=wdt)
+        if plan is None:
+            cm._note_fallback("matmul_alltoall", "vmem_miss")
+    else:
+        _fallback_reason(overlap, "matmul_alltoall")
+    if plan is None:
+        return xla_matmul_alltoall(h, w, axis)
+    cp, dp, hp = plan["cp"], plan["dp"], plan["hp"]
+    hpad = jnp.zeros((el, P, cp, hp), h.dtype)
+    hpad = lax.dynamic_update_slice(
+        hpad, h.reshape(el, P, C, hd), (0, 0, 0, 0))
+    wp = jnp.zeros((el, hp, dp), w.dtype)
+    wp = lax.dynamic_update_slice(wp, w, (0, 0, 0))
+    out = _mm_a2a_call(hpad.reshape(el, P * cp, hp), wp, P=P, axis=axis,
+                       mesh_axes=mesh_axes,
+                       bidirectional=plan["bidirectional"], e_local=el,
+                       out_dtype=wdt if wdt is not None else jnp.float32)
+    out = out.astype(jnp.float32)
+    return out[:, :, :C, :d].reshape(P * el, C, d)
+
+
+# ---------------------------------------------------------------------------
+# differentiable entry points (dispatch and combine are transposes)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def alltoall_matmul(x, w, axis: str = AXIS,
+                    mesh_axes: Optional[Tuple[str, ...]] = None,
+                    overlap: Optional[bool] = None,
+                    bidirectional: bool = True,
+                    wire_dtype=None):
+    """MoE dispatch: ``einsum(all_to_all(x), w)`` with per-exchange
+    comm/compute overlap.  x: (E, C, d) per-destination token blocks;
+    w: (e_local, d, h) local expert weights.  Returns (e_local, P·C, h)
+    f32.  ``overlap=None`` follows the session default
+    (``ACCLConfig.moe_overlap`` + the ``a2a_matmul_threshold``
+    register); False pins the unfused pair.  ``wire_dtype=None``
+    follows ``ACCLConfig.cmatmul_wire_dtype``.  Differentiable: dx
+    routes home through the dual fused combine kernel."""
+    return alltoall_matmul_body(x, w, axis=axis, mesh_axes=mesh_axes,
+                                overlap=overlap,
+                                bidirectional=bidirectional,
+                                wire_dtype=wire_dtype)
+
+
+def _a2amm_fwd(x, w, axis, mesh_axes, overlap, bidirectional, wire_dtype):
+    y = alltoall_matmul_body(x, w, axis=axis, mesh_axes=mesh_axes,
+                             overlap=overlap, bidirectional=bidirectional,
+                             wire_dtype=wire_dtype)
+    return y, (x, w)
+
+
+def _a2amm_bwd(axis, mesh_axes, overlap, bidirectional, wire_dtype, res, dy):
+    x, w = res
+    # each source's cotangent block routed home through the DUAL fused
+    # kernel: d(dispatch) = combine with w transposed
+    dx = matmul_alltoall_body(
+        dy.astype(x.dtype), jnp.transpose(w, (0, 2, 1)).astype(x.dtype),
+        axis=axis, mesh_axes=mesh_axes, overlap=overlap,
+        bidirectional=bidirectional, wire_dtype=wire_dtype).astype(x.dtype)
+    # dw[e] = all_to_all(x)[e]ᵀ @ dy[e]: the gather is the plain a2a —
+    # the dw payload moves exactly once either way
+    recv = lax.all_to_all(x, axis, split_axis=0, concat_axis=1, tiled=True)
+    dw = jnp.einsum("epd,eph->edh", recv, dy.astype(recv.dtype),
+                    preferred_element_type=jnp.float32).astype(w.dtype)
+    return dx, dw
+
+
+alltoall_matmul.defvjp(_a2amm_fwd, _a2amm_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def matmul_alltoall(h, w, axis: str = AXIS,
+                    mesh_axes: Optional[Tuple[str, ...]] = None,
+                    overlap: Optional[bool] = None,
+                    bidirectional: bool = True,
+                    wire_dtype=None):
+    """MoE combine: ``all_to_all(einsum(h, w))`` with each destination's
+    expert output put on the wire while the next destination's matmul
+    runs.  h: (e_local, P·C, hd) activations by destination; w:
+    (e_local, hd, d).  Returns (E, C, d) f32.  Differentiable: dh runs
+    the dual fused dispatch kernel."""
+    return matmul_alltoall_body(h, w, axis=axis, mesh_axes=mesh_axes,
+                                overlap=overlap,
+                                bidirectional=bidirectional,
+                                wire_dtype=wire_dtype)
+
+
+def _mma2a_fwd(h, w, axis, mesh_axes, overlap, bidirectional, wire_dtype):
+    y = matmul_alltoall_body(h, w, axis=axis, mesh_axes=mesh_axes,
+                             overlap=overlap, bidirectional=bidirectional,
+                             wire_dtype=wire_dtype)
+    return y, (h, w)
+
+
+def _mma2a_bwd(axis, mesh_axes, overlap, bidirectional, wire_dtype, res, dy):
+    h, w = res
+    # d(combine) = dispatch with w transposed: route every destination's
+    # cotangent block back and apply w_outᵀ per expert — the fused dual
+    dh = alltoall_matmul_body(
+        dy.astype(h.dtype), jnp.transpose(w, (0, 2, 1)).astype(h.dtype),
+        axis=axis, mesh_axes=mesh_axes, overlap=overlap,
+        bidirectional=bidirectional, wire_dtype=wire_dtype).astype(h.dtype)
+    # dw[e] = h[e]ᵀ @ all_to_all(dy)[e] (one unfused a2a)
+    recv_dy = lax.all_to_all(dy.astype(h.dtype), axis, split_axis=0,
+                             concat_axis=1, tiled=True)
+    dw = jnp.einsum("eph,epd->ehd", h, recv_dy,
+                    preferred_element_type=jnp.float32).astype(w.dtype)
+    return dh, dw
+
+
+matmul_alltoall.defvjp(_mma2a_fwd, _mma2a_bwd)

@@ -198,6 +198,20 @@ _WIRE_NAMES = {
     "f16": jnp.float16, "float16": jnp.float16,
 }
 
+#: stochastic-rounding wire codecs: same wire dtype, but the INPUT-SHARD
+#: cast runs ``compression.pallas_compress_stochastic`` — unbiased under
+#: the repeated compress/accumulate cycles of multi-step training
+#: (ROADMAP round-9 leftover). In-kernel stagings (the mm×rs travelling
+#: accumulator, the a2a combine's y blocks) still round
+#: deterministically: ``astype`` is the only cast available inside a
+#: kernel, and those payloads are rounded once per element anyway.
+_SR_WIRE_NAMES = {
+    "bf16_sr": jnp.bfloat16, "bfloat16_sr": jnp.bfloat16,
+}
+
+#: every accepted wire-dtype name -> jnp dtype (deterministic + SR)
+_ALL_WIRE_NAMES = {**_WIRE_NAMES, **_SR_WIRE_NAMES}
+
 
 def set_wire_dtype(name) -> None:
     """Set the session wire dtype for collective-matmul staging (config
@@ -207,9 +221,9 @@ def set_wire_dtype(name) -> None:
     global _WIRE_DTYPE_DEFAULT
     if name is not None and not isinstance(name, str):
         name = jnp.dtype(name).name
-    if name is not None and name not in _WIRE_NAMES:
+    if name is not None and name not in _ALL_WIRE_NAMES:
         raise ValueError(f"unsupported cmatmul wire dtype {name!r}; "
-                         f"one of {sorted(set(_WIRE_NAMES))} or None")
+                         f"one of {sorted(set(_ALL_WIRE_NAMES))} or None")
     _WIRE_DTYPE_DEFAULT = name
 
 
@@ -217,29 +231,40 @@ def get_wire_dtype() -> Optional[str]:
     return _WIRE_DTYPE_DEFAULT
 
 
-def _resolve_wire(wire_dtype, operand_dtype):
-    """Resolve a per-call wire request against the session register to a
-    jnp dtype, or None for a full-precision wire. ``None`` follows the
-    session default; ``"off"``/``False`` force full precision. Never
-    upcasts: a wire dtype at least as wide as the operand resolves to
-    None (nothing to compress)."""
+def _resolve_wire_codec(wire_dtype, operand_dtype):
+    """Resolve a per-call wire request against the session register to
+    ``(jnp dtype | None, stochastic: bool)`` — None for a full-precision
+    wire. ``None`` follows the session default; ``"off"``/``False``
+    force full precision. The ``*_sr`` names select the stochastic-
+    rounding compress lane for input-shard casts (in-kernel stagings
+    always round deterministically). Never upcasts: a wire dtype at
+    least as wide as the operand resolves to None (nothing to
+    compress)."""
     w = _WIRE_DTYPE_DEFAULT if wire_dtype is None else wire_dtype
     if w in (None, "off", False):
-        return None
+        return None, False
+    sr = False
     if isinstance(w, str):
-        if w not in _WIRE_NAMES:
+        if w not in _ALL_WIRE_NAMES:
             # the per-call override is the only unvalidated input path
             # (the session register validates in set_wire_dtype) — a
             # typo must fail with the valid names, not a bare KeyError
             raise ValueError(
                 f"unsupported cmatmul wire dtype {w!r}; one of "
-                f"{sorted(set(_WIRE_NAMES))}, 'off', or None")
-        wdt = _WIRE_NAMES[w]
+                f"{sorted(set(_ALL_WIRE_NAMES))}, 'off', or None")
+        wdt = _ALL_WIRE_NAMES[w]
+        sr = w in _SR_WIRE_NAMES
     else:
         wdt = w
     if jnp.dtype(wdt).itemsize >= jnp.dtype(operand_dtype).itemsize:
-        return None
-    return wdt
+        return None, False
+    return wdt, sr
+
+
+def _resolve_wire(wire_dtype, operand_dtype):
+    """Dtype-only view of :func:`_resolve_wire_codec` (the plan/engage
+    callers size staged terms and never care how the cast rounds)."""
+    return _resolve_wire_codec(wire_dtype, operand_dtype)[0]
 
 
 def wire_itemsize(dtype, wire_dtype=None) -> int:
@@ -251,13 +276,29 @@ def wire_itemsize(dtype, wire_dtype=None) -> int:
     return jnp.dtype(wdt if wdt is not None else dtype).itemsize
 
 
-def _wire_cast(x, wdt):
+def _wire_cast(x, wdt, stochastic: bool = False):
     """Stage an operand into the wire dtype via the hp_compression Pallas
     lane (the cast the packetizer-front lane performs in the reference);
-    identity when no compression resolved."""
+    identity when no compression resolved. ``stochastic`` selects the
+    stochastic-rounding lane (the ``bf16_sr`` codec) — unbiased under
+    repeated compression, falling back to the deterministic cast on
+    rungs without the TPU PRNG (compression handles the gate)."""
     if wdt is None or x.dtype == jnp.dtype(wdt):
         return x
     from . import compression
+    if stochastic:
+        # per-execution seed folded over the WHOLE payload's bits: a
+        # constant (or degenerate — e.g. sampled padding zeros) seed
+        # would replay the same PRNG stream every training step, so
+        # boundary elements would round the same way each time —
+        # re-introducing exactly the accumulated bias SR exists to
+        # kill. The wrapping int32 sum sees every bit flip anywhere in
+        # the payload (no FP absorption) and costs one fused pass next
+        # to the O(n) cast itself.
+        bits = lax.bitcast_convert_type(
+            x.astype(jnp.float32).reshape(-1), jnp.int32)
+        seed = jnp.sum(bits, dtype=jnp.int32)
+        return compression.pallas_compress_stochastic(x, wdt, seed=seed)
     return compression.pallas_cast(x, wdt)
 
 
@@ -1393,7 +1434,7 @@ def all_gather_matmul_body(x, w, *, axis: str = AXIS,
     mesh_axes = tuple(mesh_axes) if mesh_axes else (axis,)
     if P == 1:
         return jnp.dot(x, w, preferred_element_type=jnp.float32)
-    wdt = _resolve_wire(wire_dtype, x.dtype)
+    wdt, sr = _resolve_wire_codec(wire_dtype, x.dtype)
     shard_bytes = m * k * jnp.dtype(wdt if wdt is not None
                                     else x.dtype).itemsize
     plan = None
@@ -1407,7 +1448,7 @@ def all_gather_matmul_body(x, w, *, axis: str = AXIS,
     if plan is None:
         return xla_all_gather_matmul(x, w, axis)
     mp, kp, np_ = plan["mp"], plan["kp"], plan["np"]
-    xw = _wire_cast(x, wdt)
+    xw = _wire_cast(x, wdt, stochastic=sr)
     xp = jnp.zeros((mp, kp), xw.dtype)
     xp = lax.dynamic_update_slice(xp, xw, (0, 0))
     wp = jnp.zeros((kp, np_), w.dtype)
@@ -1540,7 +1581,7 @@ def gathered_wgrad_body(trav, loc, *, axis: str = AXIS,
 
     if P == 1:
         return _unfused(trav)
-    wdt = _resolve_wire(wire_dtype, trav.dtype)
+    wdt, sr = _resolve_wire_codec(wire_dtype, trav.dtype)
     nbytes = ms * ct * jnp.dtype(wdt if wdt is not None
                                  else trav.dtype).itemsize
     # the travelling payload is the agmm-style shard for d(ag×mm) and
@@ -1558,7 +1599,7 @@ def gathered_wgrad_body(trav, loc, *, axis: str = AXIS,
     if plan is None:
         return _unfused(lax.all_gather(trav, axis, axis=0, tiled=True))
     msp, ctp, clp = plan["msp"], plan["ctp"], plan["clp"]
-    tw = _wire_cast(trav, wdt)
+    tw = _wire_cast(trav, wdt, stochastic=sr)
     tp_ = jnp.zeros((msp, ctp), tw.dtype)
     tp_ = lax.dynamic_update_slice(tp_, tw, (0, 0))
     lp = jnp.zeros((P, msp, clp), loc.dtype)

@@ -99,10 +99,10 @@ def _sr_kernel(x_ref, seed_ref, o_ref, *, dst):
     o_ref[:] = pltpu.stochastic_round(x_ref[:], bits, target_dtype=dst)
 
 
-def _pallas_sr_rowmajor(x3, dst_dtype, seed: int):
+def _pallas_sr_rowmajor(x3, dst_dtype, seed):
     """Stochastic-round cast over (W, rows, lanes) — same grid-axis
     leading dim as :func:`_pallas_cast_rowmajor` (no flatten relayout);
-    the seed rides SMEM unchanged."""
+    the seed (a Python int or traced scalar) rides SMEM unchanged."""
     w, m, _ = x3.shape
     spec = pl.BlockSpec((1, _BLOCK_ROWS, _LANES),
                         lambda wi, i: (wi, i, 0),
@@ -113,14 +113,18 @@ def _pallas_sr_rowmajor(x3, dst_dtype, seed: int):
         grid=(w, pl.cdiv(m, _BLOCK_ROWS)),
         in_specs=[spec, pl.BlockSpec(memory_space=pltpu.SMEM)],
         out_specs=spec,
-    )(x3, jnp.array([seed], dtype=jnp.int32))
+    )(x3, jnp.asarray(seed, jnp.int32).reshape(1))
 
 
-def pallas_compress_stochastic(x, dst_dtype, seed: int = 0):
+def pallas_compress_stochastic(x, dst_dtype, seed=0):
     """f32 -> bf16 compress with stochastic rounding: unbiased under the
     repeated compress/reduce cycles of multi-hop ring collectives (TPU-only;
     no reference analog — the FPGA lane truncates). 2D operands keep
-    their leading dim as a grid axis like the deterministic lane."""
+    their leading dim as a grid axis like the deterministic lane.
+    ``seed`` may be a Python int or a traced scalar — callers running
+    inside a compiled step should derive it per execution (a constant
+    replays the same PRNG stream every step, defeating the
+    unbiasedness; see ``collective_matmul._wire_cast``)."""
     if jax.default_backend() != "tpu":  # stochastic_round is TPU-only
         return x.astype(dst_dtype)
     shape = x.shape

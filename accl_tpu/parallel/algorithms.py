@@ -72,6 +72,9 @@ _SUPPORTED = {
     # XLA pair exist as families
     operation.allgather_matmul: {Algorithm.XLA, Algorithm.PALLAS},
     operation.matmul_reduce_scatter: {Algorithm.XLA, Algorithm.PALLAS},
+    # the expert-parallel fused a2a pair: same two-family structure
+    operation.alltoall_matmul: {Algorithm.XLA, Algorithm.PALLAS},
+    operation.matmul_alltoall: {Algorithm.XLA, Algorithm.PALLAS},
 }
 
 
@@ -97,12 +100,14 @@ def reset_global_fallback_warnings() -> None:
 
 def cmatmul_wire_bytes(op: operation, nbytes: int, cfg: ACCLConfig,
                        count: Optional[int] = None) -> int:
-    """Effective ICI bytes for a collective-matmul payload under the
-    session wire dtype (``ACCLConfig.cmatmul_wire_dtype``).
+    """Effective ICI bytes for a collective-matmul/fused-a2a payload
+    under the session wire dtype (``ACCLConfig.cmatmul_wire_dtype``).
 
     ``nbytes`` follows the op's operand-byte convention (agmm: LHS
     shard bytes in the operand dtype; mmrs: travelling f32 accumulator
-    bytes); ``count`` (elements) resolves the operand width exactly —
+    bytes; alltoall_matmul: per-destination token-block bytes;
+    matmul_alltoall: f32 y-block bytes); ``count`` (elements) resolves
+    the operand width exactly —
     without it the f32 default is assumed, so callers dispatching
     NON-f32 agmm operands MUST pass count or select() will scale bytes
     the wire cannot actually compress (the kernel-module resolution
@@ -113,7 +118,7 @@ def cmatmul_wire_bytes(op: operation, nbytes: int, cfg: ACCLConfig,
     if not name:
         return nbytes
     from ..ops import collective_matmul as cm
-    wdt = cm._WIRE_NAMES.get(name)
+    wdt = cm._ALL_WIRE_NAMES.get(name)
     if wdt is None:
         return nbytes
     import jax.numpy as jnp
@@ -215,9 +220,18 @@ def _select(
             # resolution; select() reads the scalar square-class ones)
             operation.allgather_matmul: cfg.ag_matmul_threshold,
             operation.matmul_reduce_scatter: cfg.rs_matmul_threshold,
+            # the fused MoE a2a pair shares ONE register: both
+            # directions move the same (e_local, C, d) block per
+            # exchange (dispatch: token blocks in the operand dtype;
+            # combine: f32 y blocks) — autotuned by
+            # bench.autotune_moe_a2a
+            operation.alltoall_matmul: cfg.a2a_matmul_threshold,
+            operation.matmul_alltoall: cfg.a2a_matmul_threshold,
         }.get(op)
         if op in (operation.allgather_matmul,
-                  operation.matmul_reduce_scatter):
+                  operation.matmul_reduce_scatter,
+                  operation.alltoall_matmul,
+                  operation.matmul_alltoall):
             # the register compares WIRE bytes: under a session wire
             # dtype (ACCLConfig.cmatmul_wire_dtype) the payload moves
             # fewer bytes than the caller's operand-byte convention, so
@@ -410,6 +424,51 @@ def build_matmul_reduce_scatter(comm, algo: Algorithm,
     def body(x, w):
         y = cm.matmul_reduce_scatter_body(
             x[0], w[0], axis=primitives.AXIS,
+            overlap=(algo == Algorithm.PALLAS),
+            bidirectional=bidirectional, wire_dtype=wire_dtype)
+        return y[None]
+
+    return primitives._smap(comm, body, 2)
+
+
+def build_alltoall_matmul(comm, algo: Algorithm,
+                          bidirectional: bool = True,
+                          wire_dtype=None) -> Callable:
+    """(world, E, C, d) per-destination token blocks + (world, e_local,
+    d, h) expert in-projections -> (world, e_local, world*C, h):
+    ``einsum(all_to_all(x), w)``.  PALLAS runs the comm/compute-
+    overlapped flat-exchange kernel (ops/collective_alltoall.py — each
+    arriving block's expert matmul hides the next exchange's wire
+    time); anything else the unfused XLA pair. ``wire_dtype`` stages
+    the token payload compressed ("off" pins full precision)."""
+    from ..ops import collective_alltoall as ca
+    if algo == Algorithm.PALLAS:
+        pallas_ring._check_multiprocess(comm)
+
+    def body(x, w):
+        y = ca.alltoall_matmul_body(
+            x[0], w[0], axis=primitives.AXIS,
+            overlap=(algo == Algorithm.PALLAS),
+            bidirectional=bidirectional, wire_dtype=wire_dtype)
+        return y[None]
+
+    return primitives._smap(comm, body, 2)
+
+
+def build_matmul_alltoall(comm, algo: Algorithm,
+                          bidirectional: bool = True,
+                          wire_dtype=None) -> Callable:
+    """(world, e_local, world*C, hd) expert activations + (world,
+    e_local, hd, d) out-projections -> (world, E, C, d):
+    ``all_to_all(einsum(h, w))`` with each destination's block on the
+    wire while the next destination's matmul runs under PALLAS."""
+    from ..ops import collective_alltoall as ca
+    if algo == Algorithm.PALLAS:
+        pallas_ring._check_multiprocess(comm)
+
+    def body(h, w):
+        y = ca.matmul_alltoall_body(
+            h[0], w[0], axis=primitives.AXIS,
             overlap=(algo == Algorithm.PALLAS),
             bidirectional=bidirectional, wire_dtype=wire_dtype)
         return y[None]

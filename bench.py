@@ -372,14 +372,19 @@ def main(argv=None) -> int:
                            for name in wanted]
             else:
                 cm_rows = r
-        # round-9 lanes: fused-wgrad overlap and k-blocked streaming +
-        # bf16 wire A/B — fault-isolated and budget-gated like the rest
+        # round-9/10 lanes: fused-wgrad overlap, k-blocked streaming +
+        # bf16 wire A/B, and the expert-parallel fused a2a pair —
+        # fault-isolated and budget-gated like the rest
         for name, fn in (
             ("cmatmul_dw",
              lambda: _lanes.bench_cmatmul_dw(comm, bidirectional=bidir)),
             ("cmatmul_stream",
              lambda: _lanes.bench_cmatmul_stream(comm,
                                                  bidirectional=bidir)),
+            ("moe_a2a",
+             lambda: _lanes.bench_moe_a2a(comm, bidirectional=bidir)),
+            ("moe_a2a_bwd",
+             lambda: _lanes.bench_moe_a2a_bwd(comm, bidirectional=bidir)),
         ):
             if not _lane_selected(lanes_filter, name):
                 continue

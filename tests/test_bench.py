@@ -256,3 +256,41 @@ def test_cmatmul_dw_and_stream_lanes_schema(accl):
         assert r["k_block"] is not None and r["k_block"] % 128 == 0
     if not r["resolved"]:
         assert r["value"] == 0.0 and r["wire_speedup"] is None
+
+
+def test_moe_a2a_lanes_schema(accl):
+    """The expert-parallel a2a lanes follow the resolution protocol on
+    every rung: honesty flags mirror plan + rung (the bwd lane needs
+    BOTH direction plans — its dx rides the dual kernel), plan_mode is
+    pinned, raw ratios stay on the record, and an unengaged lane zeroes
+    its headline."""
+    from accl_tpu.bench import lanes
+    from accl_tpu.ops import collective_alltoall as ca
+    from accl_tpu.ops import collective_matmul as cm
+
+    rows = lanes.bench_moe_a2a(accl.global_comm(), e_local=2, C=8, d=32,
+                               h=48, rounds=2)
+    assert [r["metric"] for r in rows] == ["moe_a2a"]
+    r = rows[0]
+    assert r["unit"] == "ratio"
+    assert r["overlap_plan"] is not None     # tiny shapes fit VMEM
+    assert r["plan_mode"] == "resident"
+    assert r["fused_engaged"] == cm._kernels_available()
+    assert r["resolved"] == r["fused_engaged"]
+    assert r["raw_overlap_eff_med"] > 0
+    assert r["fused_us"] > 0 and r["matmul_us"] > 0
+    if not r["resolved"]:
+        assert r["value"] == 0.0
+
+    rows = lanes.bench_moe_a2a_bwd(accl.global_comm(), e_local=2, C=8,
+                                   d=32, h=48, rounds=2)
+    assert [r["metric"] for r in rows] == ["moe_a2a_bwd"]
+    r = rows[0]
+    assert r["unit"] == "ratio"
+    assert r["plan_mode"] == "resident"
+    assert r["combine_plan_mode"] == "resident"
+    assert r["fused_engaged"] == cm._kernels_available()
+    assert r["resolved"] == r["fused_engaged"]
+    assert r["raw_overlap_eff_med"] > 0
+    if not r["resolved"]:
+        assert r["value"] == 0.0

@@ -1096,3 +1096,110 @@ def test_wgrad_wire_traces(accl, monkeypatch):
             jnp.zeros((4 * 16, 64), jnp.float32),
             jnp.zeros((4 * 16, 32), jnp.float32)))
         assert t.count("pallas_call") == 2   # cast lane + wgrad kernel
+
+
+# ---------------------------------------------------------------------------
+# stochastic-rounding wire codec (round 10): "bf16_sr" as a cmatmul/a2a
+# wire dtype — the ROADMAP round-9 leftover
+# ---------------------------------------------------------------------------
+
+def test_wire_sr_codec_resolution(accl):
+    """"bf16_sr" is a full wire codec: accepted by the session register
+    (write-through), sized like bf16 everywhere (plans, effective wire
+    bytes, select()), and resolved to (bfloat16, stochastic=True) for
+    the input-shard casts."""
+    from accl_tpu.config import TransportBackend
+    from accl_tpu.constants import operation
+
+    assert cm._resolve_wire_codec("bf16_sr", jnp.float32) == \
+        (jnp.bfloat16, True)
+    assert cm._resolve_wire_codec("bf16", jnp.float32) == \
+        (jnp.bfloat16, False)
+    # never upcasts, SR or not
+    assert cm._resolve_wire_codec("bf16_sr", jnp.bfloat16) == (None, False)
+    assert cm.wire_itemsize(jnp.float32, "bf16_sr") == 2
+    saved = accl.config
+    try:
+        accl.config = accl.config.replace(cmatmul_wire_dtype="bf16_sr")
+        assert cm.get_wire_dtype() == "bf16_sr"
+        assert cm._resolve_wire(None, jnp.float32) == jnp.bfloat16
+        assert cm._resolve_wire_codec(None, jnp.float32)[1] is True
+        # select() scales the matmul ops' bytes exactly as under bf16
+        ici = accl.config.replace(transport=TransportBackend.ICI)
+        th = ici.ag_matmul_threshold
+        assert algorithms.select(operation.allgather_matmul, th, comm=accl
+                                 .global_comm(), cfg=ici) == Algorithm.XLA
+        assert algorithms.select(operation.allgather_matmul, 2 * th,
+                                 comm=accl.global_comm(),
+                                 cfg=ici) == Algorithm.PALLAS
+        assert algorithms.cmatmul_wire_bytes(
+            operation.allgather_matmul, 1024, ici) == 512
+    finally:
+        accl.config = saved
+    with pytest.raises(ValueError, match="wire dtype"):
+        cm.set_wire_dtype("bf16_sr_typo")
+    with pytest.raises(ValueError, match="wire dtype"):
+        cm._resolve_wire_codec("fp16_sr", jnp.float32)
+
+
+def test_wire_sr_cast_bounded_bias(rng):
+    """The SR compress lane's parity contract vs the deterministic cast:
+    every SR output is one of the two bf16 neighbors of its input (a
+    rounding, never a perturbation), and the MEAN rounding bias over
+    repeated compression is bounded by the deterministic cast's. Off
+    TPU the lane degrades to the deterministic cast (TPU PRNG
+    unavailable) — the bias bound then holds with equality."""
+    x = (rng.standard_normal((64, 128)).astype(np.float32)
+         * (1.0 + 2 ** -9))   # off the bf16 grid: rounding must happen
+    det = np.asarray(jnp.asarray(x).astype(jnp.bfloat16).astype(jnp.float32))
+    on_tpu = jax.default_backend() == "tpu"
+    from accl_tpu.ops import compression
+
+    seeds = range(8) if on_tpu else (0,)
+    outs = []
+    for s in seeds:
+        sr = np.asarray(compression.pallas_compress_stochastic(
+            jnp.asarray(x), jnp.bfloat16, seed=s).astype(jnp.float32))
+        outs.append(sr)
+        # each element is a bf16 NEIGHBOR of x: |sr - x| <= one bf16 ulp
+        ulp = np.maximum(np.abs(x) * 2 ** -7, np.finfo(np.float32).tiny)
+        assert np.all(np.abs(sr - x) <= ulp)
+    mean_sr = np.mean(outs, axis=0)
+    det_bias = abs(float(np.mean(det - x)))
+    sr_bias = abs(float(np.mean(mean_sr - x)))
+    if on_tpu:
+        # unbiasedness: averaged over seeds, SR's bias must not exceed
+        # the deterministic cast's (it converges to zero)
+        assert sr_bias <= det_bias + 1e-6
+    else:
+        np.testing.assert_array_equal(mean_sr, det)   # documented degrade
+
+
+def test_wire_sr_threads_through_kernels(accl, monkeypatch):
+    """bf16_sr reaches the agmm/wgrad staged-cast path: on-TPU it adds
+    the SR cast kernel; off-TPU the cast degrades to a plain astype, so
+    only the ring kernel traces — either way the ring kernel engages
+    with half-width staging exactly as under bf16."""
+    on_tpu = jax.default_backend() == "tpu"
+    casts = 1 if on_tpu else 0
+    t = _trace_body(monkeypatch,
+                    lambda xs, ws: cm.all_gather_matmul_body(
+                        xs, ws, axis="accl", overlap=True,
+                        wire_dtype="bf16_sr"),
+                    (4 * 16, 128), (128, 128))
+    assert t.count("pallas_call") == 1 + casts
+    for lhs in (True, False):
+        def body(ts, ls, lhs=lhs):
+            return cm.gathered_wgrad_body(
+                ts, ls, axis="accl", overlap=True, wire_dtype="bf16_sr",
+                travel_lhs=lhs)
+
+        from accl_tpu.compat import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()[:4]), ("accl",))
+        t = str(jax.make_jaxpr(shard_map(
+            body, mesh=mesh, in_specs=(P("accl"), P(None)),
+            out_specs=P(None), check_vma=False))(
+            jnp.zeros((4 * 16, 64), jnp.float32),
+            jnp.zeros((4 * 16, 32), jnp.float32)))
+        assert t.count("pallas_call") == 1 + casts

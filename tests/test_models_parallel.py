@@ -96,6 +96,63 @@ def test_moe_rejects_bad_top_k(accl):
 def test_moe_rejects_indivisible_experts(accl):
     with pytest.raises(ValueError):
         moe.init_params(jax.random.PRNGKey(0), accl.global_comm(), 8, 16, 9)
+    # the builder validates too: an uneven expert count would silently
+    # mis-shard the all-to-all blocks (e_local truncates)
+    with pytest.raises(ValueError, match="n_experts"):
+        moe.build_moe_forward(accl.global_comm(), n_experts=9, capacity=4)
+
+
+def test_moe_top2_capacity_pressure_strict_priority(accl, rng):
+    """top_k=2 under HARD capacity pressure (C=1): every expert takes at
+    most one token, so most second choices — and some first choices —
+    drop to the residual path. Parity vs the host reference, plus
+    explicit host-math checks that (a) drops actually happened (the
+    residual path is exercised, not vacuously green) and (b) choice
+    priority is strict: a second choice never takes a slot that a
+    later-arriving FIRST choice was denied."""
+    comm = accl.global_comm()
+    n, d, h, E, C = 16, 32, 64, 8, 1
+    gp = moe.init_params(jax.random.PRNGKey(7), comm, d, h, E)
+    params = moe.shard_params(gp, comm)
+    fwd = moe.build_moe_forward(comm, n_experts=E, capacity=C, top_k=2)
+    x = rng.standard_normal((WORLD, n, d)).astype(np.float32)
+    out = np.asarray(fwd(params, jax.device_put(x, comm.sharding())))
+    host_params = moe.MoEParams(*(np.asarray(p) for p in gp))
+    expect = moe.reference_moe(host_params, x, n_experts=E, capacity=C,
+                               top_k=2)
+    np.testing.assert_allclose(out, expect, rtol=2e-4, atol=2e-4)
+    # host routing: with 2n choices per rank and only E slots, drops
+    # must occur — and under strict priority no second choice may hold
+    # a slot while any first choice for the same expert was dropped
+    router = np.asarray(gp.router, np.float64)
+    for r in range(WORLD):
+        logits = x[r].astype(np.float64) @ router
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        order = np.argsort(-p, axis=-1)[:, :2]
+        counts = {e: 0 for e in range(E)}
+        kept = np.zeros((n, 2), bool)
+        for j in range(2):
+            for t in range(n):
+                e = int(order[t, j])
+                if counts[e] < C:
+                    counts[e] += 1
+                    kept[t, j] = True
+        assert kept.sum() < 2 * n          # capacity pressure bit
+        for e in range(E):
+            first_dropped = any(int(order[t, 0]) == e and not kept[t, 0]
+                                for t in range(n))
+            second_kept = any(int(order[t, 1]) == e and kept[t, 1]
+                              for t in range(n))
+            # strict priority: a dropped FIRST choice for e implies its
+            # slots were filled by other first choices, so no second
+            # choice can hold one
+            assert not (first_dropped and second_kept)
+        # tokens with BOTH choices dropped ride the pure residual path
+        both_dropped = [t for t in range(n) if not kept[t].any()]
+        for t in both_dropped:
+            np.testing.assert_allclose(out[r, t], x[r, t],
+                                       rtol=2e-5, atol=2e-5)
 
 
 @pytest.mark.parametrize("n_micro", [1, 4, 8])

@@ -128,10 +128,11 @@ def _recipes(accl):
 
 
 #: members with no direct host-call path: config is not a data op, nop is
-#: the firmware filler, and the collective-matmul scenarios dispatch
-#: through device_api/jit (no eager host call to count)
+#: the firmware filler, and the collective-matmul / fused-a2a scenarios
+#: dispatch through device_api/jit (no eager host call to count)
 _UNCOUNTED = {operation.config, operation.nop,
-              operation.allgather_matmul, operation.matmul_reduce_scatter}
+              operation.allgather_matmul, operation.matmul_reduce_scatter,
+              operation.alltoall_matmul, operation.matmul_alltoall}
 
 
 def test_matrix_covers_every_operation(accl):
