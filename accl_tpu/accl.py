@@ -96,6 +96,10 @@ class ACCL:
         _cm_ops.set_wire_dtype(cfg.cmatmul_wire_dtype)
         _a2a_ops.set_overlap_enabled(cfg.moe_overlap)
         _a2a_ops.set_overlap_threshold(cfg.a2a_matmul_threshold)
+        from .models import zero as _zero_model
+
+        _zero_model.set_overlap_enabled(cfg.zero_overlap)
+        _zero_model.set_prefetch_enabled(cfg.zero_prefetch)
 
     def __init__(
         self,

@@ -652,6 +652,65 @@ def autotune_moe_a2a(acc, cfg: Optional[ACCLConfig] = None,
         a2a_matmul_threshold=at if at is not None else DISABLED)
 
 
+def autotune_zero_fsdp(acc, cfg: Optional[ACCLConfig] = None,
+                       n_layers: int = 2, d_model: int = 256,
+                       d_hidden: int = 1024, n_heads: int = 4,
+                       batch_per_rank: int = 128,
+                       reps: int = 3) -> ACCLConfig:
+    """Measure one LAYERWISE fused ZeRO/FSDP train step against the
+    flat-ravel baseline step of the same transformer stack on the live
+    mesh (dp = world, tp = 1) and write the winner to
+    ``cfg.zero_overlap`` — the session A/B register the layerwise
+    builder's ``overlap=None`` resolution consults. The fused legs'
+    size/wire policy stays with the cmatmul registers (seeded by
+    ``autotune_collective_matmul``); this stage resolves only the
+    schedule-level go/no-go, like ``autotune_flash_bwd`` resolves the
+    backward mode. ICI only — anywhere else the kernels would measure
+    the simulator — and a geometry whose plans do not engage passes the
+    config through untouched (there is nothing to measure)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..models import zero
+    from ..ops import collective_matmul as cm
+
+    cfg = cfg or acc.config
+    if acc.config.transport != TransportBackend.ICI:
+        return cfg
+    comm = acc.global_comm()
+    W = comm.world_size
+    if W == 1:
+        return cfg
+    wire = cfg.cmatmul_wire_dtype or "off"
+    if not zero.fsdp_engages(d_model, d_hidden, batch_per_rank, W, 1,
+                             overlap=True,
+                             bidirectional=cfg.bidirectional_rings,
+                             wire_dtype=cm._resolve_wire(wire, np.float32)):
+        return cfg
+    mesh = zero.make_mesh(comm.devices, W, 1)
+    state = zero.init_zero_fsdp(jax.random.PRNGKey(0), mesh, n_layers,
+                                d_model, d_hidden, n_heads)
+    rng = np.random.default_rng(0)
+    sh = NamedSharding(mesh, P(zero.DP_AXIS, None))
+    x = jax.device_put(rng.standard_normal(
+        (W * batch_per_rank, d_model)).astype(np.float32) * 1e-1, sh)
+    y = jax.device_put(rng.standard_normal(
+        (W * batch_per_rank, d_model)).astype(np.float32) * 1e-1, sh)
+    times = {}
+    for name, ov in (("fused", True), ("flat", False)):
+        step = zero.build_zero_fsdp_train_step(
+            mesh, n_layers, d_model, d_hidden, n_heads, overlap=ov,
+            bidirectional=cfg.bidirectional_rings, wire_dtype=wire)
+        jax.block_until_ready(step(state, x, y))  # compile + warm
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(step(state, x, y))
+            ts.append(time.perf_counter() - t0)
+        times[name] = float(np.min(ts))
+    return cfg.replace(zero_overlap=times["fused"] <= times["flat"])
+
+
 def autotune_flash_bwd(acc, cfg: Optional[ACCLConfig] = None,
                        H: int = 8, S: int = 2048, d: int = 128,
                        reps: int = 3) -> ACCLConfig:
@@ -699,7 +758,8 @@ def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
     ring/hier(/pallas), allgather + reduce_scatter ring crossovers, the
     flat-tree rank/count/fan-in registers (accl.cpp:1214-1224 analog,
     measured instead of frozen), the collective-matmul overlap-vs-XLA
-    crossovers (ICI), and the single-chip flash fused/two-pass backward
+    crossovers (ICI), the layerwise ZeRO/FSDP fused-vs-flat schedule
+    register (ICI), and the single-chip flash fused/two-pass backward
     crossover (any world size)."""
     if acc.global_comm().world_size == 1:
         # Every threshold select() reads splits INTER-DEVICE algorithm
@@ -743,6 +803,7 @@ def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
         ("collective_matmul", lambda c: autotune_collective_matmul(
             acc, c, reps=reps, dt=dt)),
         ("moe_a2a", lambda c: autotune_moe_a2a(acc, c, reps=reps, dt=dt)),
+        ("zero_fsdp", lambda c: autotune_zero_fsdp(acc, c, reps=reps)),
         ("flash_bwd", lambda c: autotune_flash_bwd(acc, c, reps=reps)),
     ]
     try:

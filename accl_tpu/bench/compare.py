@@ -1,0 +1,139 @@
+"""Diff two bench.py artifacts per lane — the regression gate.
+
+``bench.py`` emits one JSON line per round (BENCH_rNN.json); until now
+comparing two rounds meant eyeballing nested dicts. This module lines
+the two artifacts up lane by lane and flags regressions, so the first
+on-silicon run of a new round lands against a comparable baseline
+instead of a diff nobody reads:
+
+* every lane's headline ``value`` is compared (all lane values are
+  higher-is-better by construction: GB/s, TFLOP/s, overlap-efficiency
+  ratios), plus the artifact's own headline metric;
+* a lane regresses when the new value drops more than ``threshold``
+  (default 10%) below the baseline value — both sides must be RESOLVED
+  measurements (the lane protocol's honesty flags are honored: a lane
+  that was flagged/zeroed on either side is reported ``incomparable``,
+  never a regression);
+* lanes present on only one side are reported (``added`` / ``removed``)
+  — a silently dropped lane is itself a finding.
+
+CLI: ``python -m accl_tpu.bench.compare BASE.json NEW.json
+[--threshold 0.1]`` — prints one JSON document and exits 1 when any
+lane regressed (CI-gateable), 0 otherwise.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+
+def load_artifact(path: str) -> dict:
+    """Read a bench.py artifact: the LAST parseable JSON line of the
+    file (bench.py streams log lines to stderr, but a captured combined
+    stream still ends with the artifact line)."""
+    doc = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+    if doc is None:
+        raise ValueError(f"no JSON artifact line found in {path}")
+    return doc
+
+
+def _resolved_value(row: dict) -> Optional[float]:
+    """A lane's comparable headline: its ``value`` when the row is a
+    resolved measurement, else None (flagged/errored/skipped lanes are
+    incomparable — the resolution protocol's zeroed headline must not
+    read as a 100% regression)."""
+    if not isinstance(row, dict) or "value" not in row:
+        return None
+    if row.get("error") or row.get("skipped"):
+        return None
+    if "resolved" in row and not row["resolved"]:
+        return None
+    try:
+        v = float(row["value"])
+    except (TypeError, ValueError):
+        return None
+    return v if v > 0 else None
+
+
+def lane_values(doc: dict) -> Dict[str, dict]:
+    """metric-name -> row for every comparable row in an artifact: the
+    headline itself, every entry of ``lanes``, and the singleton
+    ``obs_overhead`` blob (excluded — latency rows have no single
+    higher-is-better headline)."""
+    rows: Dict[str, dict] = {}
+    if doc.get("metric") and "value" in doc:
+        rows[doc["metric"]] = doc
+    for row in doc.get("lanes") or []:
+        name = row.get("metric")
+        if name:
+            rows[name] = row
+    return rows
+
+
+def compare(base: dict, new: dict, threshold: float = 0.10) -> dict:
+    """Per-lane diff of two artifacts. Returns a JSON-ready document:
+    ``rows`` (one per lane present on either side, with base/new values,
+    ratio, and a ``status`` of ok / regression / improvement /
+    incomparable / added / removed), ``regressions`` (the lane names
+    that dropped > threshold), and the threshold used."""
+    b_rows, n_rows = lane_values(base), lane_values(new)
+    rows: List[dict] = []
+    regressions: List[str] = []
+    for name in sorted(set(b_rows) | set(n_rows)):
+        if name not in b_rows:
+            rows.append({"metric": name, "status": "added",
+                         "new": n_rows[name].get("value")})
+            continue
+        if name not in n_rows:
+            rows.append({"metric": name, "status": "removed",
+                         "base": b_rows[name].get("value")})
+            continue
+        bv = _resolved_value(b_rows[name])
+        nv = _resolved_value(n_rows[name])
+        if bv is None or nv is None:
+            rows.append({"metric": name, "status": "incomparable",
+                         "base": b_rows[name].get("value"),
+                         "new": n_rows[name].get("value")})
+            continue
+        ratio = nv / bv
+        if ratio < 1.0 - threshold:
+            status = "regression"
+            regressions.append(name)
+        elif ratio > 1.0 + threshold:
+            status = "improvement"
+        else:
+            status = "ok"
+        rows.append({"metric": name, "status": status,
+                     "base": bv, "new": nv, "ratio": round(ratio, 4)})
+    return {"metric": "bench_compare", "threshold": threshold,
+            "rows": rows, "regressions": regressions,
+            "regressed": bool(regressions)}
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("base", help="baseline BENCH_*.json artifact")
+    ap.add_argument("new", help="new BENCH_*.json artifact")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative drop that flags a regression "
+                         "(default 0.10 = 10%%)")
+    args = ap.parse_args(argv)
+    out = compare(load_artifact(args.base), load_artifact(args.new),
+                  threshold=args.threshold)
+    print(json.dumps(out, indent=1, sort_keys=True))
+    return 1 if out["regressed"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

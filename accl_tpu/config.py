@@ -169,6 +169,25 @@ class ACCLConfig:
     moe_overlap: bool = True
     a2a_matmul_threshold: int = 256 * 1024
 
+    # layerwise overlapped ZeRO/FSDP (models/zero.py): the training-step
+    # datapath whose per-layer parameter gather IS allgather_matmul and
+    # whose gradient reduction IS matmul_reduce_scatter (with the fused
+    # wgrad). zero_overlap is the session A/B switch (write-through to
+    # models.zero.set_overlap_enabled, the cmatmul_overlap shape;
+    # per-call override on build_zero_fsdp_train_step): when the
+    # per-layer plans do not ALL engage, the step commits to the
+    # flat-ravel baseline schedule (one monolithic all_gather +
+    # psum_scatter — never a degraded unfused layerwise rendition),
+    # counted under accl_cmatmul_fallback_total{op="zero_fsdp"}.
+    # zero_prefetch gates the cross-layer gather prefetch (layer l+1's
+    # attention-bucket all_gather issued under layer l's compute,
+    # double-buffered at the schedule level); hits/declines are counted
+    # in accl_zero_prefetch_total. The fused legs' size/wire policy
+    # rides the existing cmatmul registers (ag/rs_matmul_threshold,
+    # cmatmul_wire_dtype) — one register set for the whole family.
+    zero_overlap: bool = True
+    zero_prefetch: bool = True
+
     # flash-attention backward: "fused" runs the single-pass dK/dV+dQ
     # kernel wherever its VMEM plan fits (two-pass beyond); "two_pass"
     # pins the classic kernel pair everywhere — the A/B switch and the

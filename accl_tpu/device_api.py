@@ -161,6 +161,31 @@ def matmul_reduce_scatter(x, w, axis: str = AXIS, mesh_axes=None,
                                     bidirectional, wire_dtype)
 
 
+def fsdp_matmul(x, wt_shard, axis: str = AXIS, mesh_axes=None,
+                overlap: Optional[bool] = None,
+                bidirectional: bool = True,
+                wire_dtype=None):
+    """In-kernel ZeRO/FSDP forward matmul: ``x @ all_gather(wt_shard)ᵀ``
+    with the PARAMETER gather folded into the matmul — x (m, k) local
+    rows, ``wt_shard`` (n/P, k) this rank's column shard of the weight
+    in travel (transposed) layout, out (m, n) f32. The agmm kernel IS
+    FSDP's forward: each arriving ring shard's output block is computed
+    while the next hop's remote DMA is in flight, and the full (k, n)
+    weight never materializes in one buffer. Differentiable with the
+    whole FSDP communication pattern fused: d(wt_shard) rides the dual
+    ``matmul_reduce_scatter`` (the ZeRO gradient reduce-scatter — every
+    rank receives only ITS shard's dp-summed gradient) and dx rides the
+    fused gathered-wgrad kernel (the backward parameter RE-gather folded
+    into dx's contraction). Policy/fallback/wire semantics are
+    :func:`all_gather_matmul`'s — same registers, same counted
+    fallbacks."""
+    from .ops import collective_matmul as cm
+    mesh_axes = tuple(mesh_axes) if mesh_axes else None
+    yt = cm.all_gather_matmul(wt_shard, jnp.transpose(x), axis, mesh_axes,
+                              overlap, bidirectional, wire_dtype)
+    return jnp.transpose(yt)
+
+
 def alltoall_matmul(x, w, axis: str = AXIS, mesh_axes=None,
                     overlap: Optional[bool] = None,
                     bidirectional: bool = True,

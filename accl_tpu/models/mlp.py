@@ -72,6 +72,16 @@ def init_params(key, d_model: int, d_hidden: int) -> MLPParams:
     )
 
 
+def apply(p: MLPParams, x):
+    """Dense single-device MLP forward (gelu between the two matmuls,
+    f32 accumulation) — ONE copy of the block math shared by the ZeRO
+    flat-ravel demo (``models/zero.py``) and the host parity
+    references, so the trained model and its oracle can never drift."""
+    h = jnp.dot(x, p.w1, preferred_element_type=jnp.float32) + p.b1
+    h = jax.nn.gelu(h)
+    return jnp.dot(h, p.w2, preferred_element_type=jnp.float32) + p.b2
+
+
 def param_specs() -> MLPParams:
     return MLPParams(
         w1=P(None, TP_AXIS), b1=P(TP_AXIS), w2=P(TP_AXIS, None), b2=P(None)

@@ -1,38 +1,111 @@
 """ZeRO-style fully-sharded data parallelism (the FSDP family).
 
-The reference is the layer below model parallelism (SURVEY.md §2.6); this
-module is the canonical training-side CONSUMER of the two collectives
-whose perf core this framework builds — allgather and reduce-scatter:
+Two generations of the same idea live here:
 
-* parameters and Adam state live permanently SHARDED 1/world per rank
-  (the ZeRO memory win: a rank never holds full optimizer state);
-* each step: ``all_gather`` the parameter shards -> forward/backward on
-  the local batch -> ``psum_scatter`` the gradients (every rank receives
-  only ITS shard, already dp-reduced) -> Adam update on the shard alone;
-* everything is ONE jitted shard_map program over the communicator's
-  mesh axis — compute fused with collectives, host only launches, the
-  vadd_put pattern (``driver/hls/accl_hls.h``) scaled to a real
-  optimizer step.
+* the original **flat-ravel demo** (:func:`build_zero_train_step`): one
+  monolithic ``lax.all_gather`` of the whole parameter vector, compute,
+  one monolithic ``lax.psum_scatter`` of the whole gradient — zero
+  comm/compute overlap.  It remains as the parity oracle and the
+  honest committed fallback of the layerwise step;
+* the **layerwise overlapped step** (:func:`build_zero_fsdp_train_step`),
+  ZeRO-3/FSDP (Rajbhandari et al.) rebuilt on the fused comm×compute
+  kernel family so FSDP's communication *is* the kernels:
 
-On hardware the two collectives are exactly the ops served by the
-chunked Pallas kernels at HBM scale, so the same autotuned thresholds
-govern a training step's communication.
+  - each layer's matmul-weight shards travel the ring of
+    ``all_gather_matmul`` — the agmm kernel IS FSDP's forward: every
+    arriving parameter shard's output block is computed while the next
+    hop's remote DMA is in flight, and the full weight never
+    materializes in one buffer (the shard is stored pre-transposed in
+    "travel layout" so no per-step transposes are paid);
+  - the gradient reduction IS ``matmul_reduce_scatter``: the agmm
+    ``custom_vjp``'s dual kernel delivers each rank ONLY its shard of
+    the dp-summed weight gradient (ZeRO's defining move), with the
+    backward parameter RE-gather folded into dx's contraction by the
+    round-9 fused wgrad kernel;
+  - the attention/bucket leg (parameters with no adjacent matmul to
+    fuse into) gathers per layer with **cross-layer prefetch**: layer
+    l+1's bucket ``all_gather`` is issued under layer l's compute —
+    the double-buffered two-slot schedule, the ``pallas_chunked``
+    credit idiom lifted to the schedule level (two gathered buckets
+    live at any time; XLA's latency-hiding scheduler overlaps the
+    independent collective).  Its GRADIENT rides the wire bucketized
+    and compressed via the ``cmatmul_wire_dtype`` machinery (bf16 /
+    bf16_sr; rounded once before the wire — tolerance-bounded like the
+    mm×rs travelling accumulator).
+
+The flagship workload is a multi-layer transformer-block train step
+(attention via ``ops/flash.py``, MLP via the collective-matmul family)
+over a (dp × tp) mesh: ZeRO shards every parameter 1/dp along the dp
+axis, Megatron splits heads/hidden along tp, and the whole
+forward + backward + Adam runs as ONE jitted shard_map program — the
+first program composing flash, cmatmul and the wire codecs.
+
+Plan-policy honesty (the mlp/moe discipline): the layerwise step
+COMMITS to the fused datapath only when the per-layer kernel plans all
+engage (session registers + VMEM plans + rung —
+:func:`fsdp_engage_reason`); anything less runs the flat-ravel baseline
+schedule unchanged — never a degraded unfused rendition of the
+layerwise program — counted under
+``accl_cmatmul_fallback_total{op="zero_fsdp"}``.
 """
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.flatten_util import ravel_pytree
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..communicator import Communicator
+from ..obs import metrics as _metrics
 from ..parallel.primitives import AXIS, _smap
 from . import mlp
+from .mlp import DP_AXIS, TP_AXIS, make_mesh  # noqa: F401  (re-export)
+
+#: the fallback-counter op label of the layerwise step's committed
+#: baseline (accl_cmatmul_fallback_total{op="zero_fsdp"})
+FSDP_OP = "zero_fsdp"
+
+
+# ---------------------------------------------------------------------------
+# session registers (ACCLConfig.zero_overlap / zero_prefetch write-through,
+# the cmatmul_overlap shape); per-call override on the builder
+# ---------------------------------------------------------------------------
+
+_OVERLAP_DEFAULT = True
+_PREFETCH_DEFAULT = True
+
+
+def set_overlap_enabled(enabled: bool) -> None:
+    """Set the module-default overlap mode (``ACCLConfig.zero_overlap``
+    lands here at every config assignment). Per-call override: the
+    builder's ``overlap`` argument."""
+    global _OVERLAP_DEFAULT
+    _OVERLAP_DEFAULT = bool(enabled)
+
+
+def get_overlap_enabled() -> bool:
+    return _OVERLAP_DEFAULT
+
+
+def set_prefetch_enabled(enabled: bool) -> None:
+    """Set the module-default cross-layer prefetch mode
+    (``ACCLConfig.zero_prefetch`` write-through)."""
+    global _PREFETCH_DEFAULT
+    _PREFETCH_DEFAULT = bool(enabled)
+
+
+def get_prefetch_enabled() -> bool:
+    return _PREFETCH_DEFAULT
+
+
+# ===========================================================================
+# the original flat-ravel demo (single MLP, 1-D communicator axis)
+# ===========================================================================
 
 
 class ZeroState(NamedTuple):
@@ -46,7 +119,7 @@ class ZeroState(NamedTuple):
 
 
 @functools.lru_cache(maxsize=None)
-def _template(d_model: int, d_hidden: int) -> Tuple[int, callable]:
+def _template(d_model: int, d_hidden: int) -> Tuple[int, Callable]:
     """(flat length, unravel) for the MLP parameter pytree — cached per
     geometry so the throwaway sizing init runs at most once per process
     (init_zero_state derives its own from the real init and never calls
@@ -93,15 +166,16 @@ def build_zero_train_step(comm: Communicator, d_model: int, d_hidden: int,
         params = unravel(full[:n])
 
         def loss_fn(p):
-            h = jnp.dot(x, p.w1, preferred_element_type=jnp.float32) + p.b1
-            h = jax.nn.gelu(h)
-            out = jnp.dot(h, p.w2, preferred_element_type=jnp.float32) + p.b2
-            return jnp.mean((out - y) ** 2)
+            return jnp.mean((mlp.apply(p, x) - y) ** 2)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         gvec = ravel_pytree(grads)[0]
-        gvec = jnp.concatenate(
-            [gvec, jnp.zeros((w.shape[0] * world - n,), gvec.dtype)])
+        pad = w.shape[0] * world - n
+        if pad:
+            # divisible geometries skip the traced concat entirely — the
+            # common case pays no copy for padding it does not need
+            gvec = jnp.concatenate(
+                [gvec, jnp.zeros((pad,), gvec.dtype)])
         # dp-reduce AND shard in one collective: each rank receives only
         # its slice of the mean gradient (ZeRO's defining move)
         gsh = lax.psum_scatter(gvec, AXIS, tiled=True) / world
@@ -131,7 +205,496 @@ def build_zero_train_step(comm: Communicator, d_model: int, d_hidden: int,
 def gather_params(state: ZeroState, comm: Communicator, d_model: int,
                   d_hidden: int) -> mlp.MLPParams:
     """Materialize the full parameter pytree from the shards (host-side
-    convenience for eval/checkpointing)."""
+    convenience for eval/checkpointing).
+
+    HOST-side by construction: every shard must be process-addressable.
+    Under multi-process execution some shards live on other hosts, where
+    the old ``np.asarray`` path failed with an opaque runtime error —
+    now rejected up front with the remediation in the message."""
     n, unravel = _template(d_model, d_hidden)
+    if not getattr(state.w, "is_fully_addressable", True):
+        raise NotImplementedError(
+            "gather_params assembles shards on the host, which requires "
+            "every shard to be process-addressable; this array spans "
+            "non-addressable devices (multi-process mesh). Gather on "
+            "device instead (a jitted lax.all_gather over the mesh axis) "
+            "or save per-rank shards.")
     flat = np.asarray(state.w).reshape(-1)[:n]
     return unravel(jnp.asarray(flat))
+
+
+# ===========================================================================
+# layerwise overlapped ZeRO/FSDP — the transformer-block flagship
+# ===========================================================================
+
+
+class FSDPParams(NamedTuple):
+    """Per-layer ZeRO shards over a (dp, tp) mesh, one entry per layer.
+
+    * ``attn``: (tp, n_attn_pad) — the flat attention bucket (Wqkv ‖ Wo
+      raveled + pad) per tp rank, dp-sharded along the flat dim
+      (spec ``P(tp, dp)``). Gathered unfused with cross-layer prefetch;
+      its gradient rides the bucketized wire-staged reduce-scatter.
+    * ``w1t``: (d_hidden, d_model) — W1ᵀ in travel layout; rows split
+      tp-major then dp (spec ``P((tp, dp), None)``), so each device's
+      block IS the agmm travelling shard of its tp column block.
+    * ``w2t``: (d_model, d_hidden) — W2ᵀ in travel layout; rows dp,
+      cols tp (spec ``P(dp, tp)``).
+    """
+
+    attn: Tuple[jax.Array, ...]
+    w1t: Tuple[jax.Array, ...]
+    w2t: Tuple[jax.Array, ...]
+
+
+class ZeroFSDPState(NamedTuple):
+    p: FSDPParams
+    m: FSDPParams
+    v: FSDPParams
+    t: jax.Array  # () int32, replicated
+
+
+def _attn_sizes(d_model: int, tp: int) -> Tuple[int, int]:
+    """(dtp, n_attn): per-tp-rank attention column width d/tp and the
+    unpadded flat bucket length 4·d·dtp (Wqkv (d, 3·dtp) + Wo (dtp, d))."""
+    dtp = d_model // tp
+    return dtp, 4 * d_model * dtp
+
+
+def fsdp_param_specs(n_layers: int) -> FSDPParams:
+    per = lambda s: tuple(s for _ in range(n_layers))
+    return FSDPParams(
+        attn=per(P(TP_AXIS, DP_AXIS)),
+        w1t=per(P((TP_AXIS, DP_AXIS), None)),
+        w2t=per(P(DP_AXIS, TP_AXIS)),
+    )
+
+
+def _validate_geometry(dp: int, tp: int, d_model: int, d_hidden: int,
+                       n_heads: int) -> None:
+    if d_model % n_heads:
+        raise ValueError(f"d_model {d_model} % n_heads {n_heads} != 0")
+    if n_heads % tp or d_model % tp or d_hidden % tp:
+        raise ValueError(
+            f"tp {tp} must divide n_heads {n_heads}, d_model {d_model} "
+            f"and d_hidden {d_hidden}")
+    if (d_hidden // tp) % dp or d_model % dp:
+        raise ValueError(
+            f"dp {dp} must divide the tp-local hidden {d_hidden // tp} "
+            f"and d_model {d_model} (the ZeRO column shards)")
+
+
+def init_zero_fsdp(key, mesh, n_layers: int, d_model: int, d_hidden: int,
+                   n_heads: int) -> ZeroFSDPState:
+    """Initialize the L-layer transformer block stack and shard every
+    parameter 1/dp across the mesh's dp axis (travel layout for the
+    matmul weights, flat buckets for attention), with zeroed Adam
+    moments — no rank ever holds a full optimizer state."""
+    dp, tp = mesh.shape[DP_AXIS], mesh.shape[TP_AXIS]
+    _validate_geometry(dp, tp, d_model, d_hidden, n_heads)
+    dtp, n_attn = _attn_sizes(d_model, tp)
+    n_attn_pad = n_attn + (-n_attn) % dp
+    s_attn = d_model ** -0.5
+    s1 = (2.0 / d_model) ** 0.5
+    s2 = (2.0 / d_hidden) ** 0.5
+
+    attn, w1t, w2t = [], [], []
+    for lk in jax.random.split(key, n_layers):
+        kq, kk, kv, ko, k1, k2 = jax.random.split(lk, 6)
+        wq, wk, wv = (np.asarray(jax.random.normal(
+            kx, (d_model, d_model), jnp.float32)) * s_attn
+            for kx in (kq, kk, kv))
+        wo = np.asarray(jax.random.normal(
+            ko, (d_model, d_model), jnp.float32)) * s_attn
+        rows = []
+        for s in range(tp):
+            cols = slice(s * dtp, (s + 1) * dtp)
+            wqkv_s = np.concatenate([wq[:, cols], wk[:, cols], wv[:, cols]],
+                                    axis=1)              # (d, 3·dtp)
+            wo_s = wo[cols, :]                           # (dtp, d)
+            rows.append(np.concatenate(
+                [wqkv_s.ravel(), wo_s.ravel(),
+                 np.zeros(n_attn_pad - n_attn, np.float32)]))
+        attn.append(np.stack(rows))                      # (tp, n_attn_pad)
+        w1 = np.asarray(jax.random.normal(
+            k1, (d_model, d_hidden), jnp.float32)) * s1
+        w2 = np.asarray(jax.random.normal(
+            k2, (d_hidden, d_model), jnp.float32)) * s2
+        w1t.append(np.ascontiguousarray(w1.T))           # (h, d) travel
+        w2t.append(np.ascontiguousarray(w2.T))           # (d, h) travel
+
+    specs = fsdp_param_specs(n_layers)
+    put = lambda a, s: jax.device_put(a, NamedSharding(mesh, s))
+    p = FSDPParams(
+        attn=tuple(put(a, s) for a, s in zip(attn, specs.attn)),
+        w1t=tuple(put(a, s) for a, s in zip(w1t, specs.w1t)),
+        w2t=tuple(put(a, s) for a, s in zip(w2t, specs.w2t)),
+    )
+    def zeros_like_sharded():
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(np.zeros(a.shape, a.dtype),
+                                     a.sharding), p)
+
+    return ZeroFSDPState(p=p, m=zeros_like_sharded(),
+                         v=zeros_like_sharded(),
+                         t=jnp.zeros((), jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# engage policy: commit to the fused datapath only when every per-layer
+# kernel plan engages (the mlp/moe discipline)
+# ---------------------------------------------------------------------------
+
+
+def fsdp_engage_reason(d_model: int, d_hidden: int, batch: int,
+                       dp: int, tp: int,
+                       overlap: Optional[bool] = None,
+                       bidirectional: bool = True,
+                       wire_dtype=None) -> Optional[str]:
+    """None when the layerwise fused datapath would actually run for
+    this geometry — BOTH forward agmm gathers (w1, w2; the travelling
+    operand is the parameter column shard), both dual mmrs gradient
+    reductions AND both fused gathered-wgrad activation-gradient legs
+    resolve to the fused kernels (session registers + VMEM plans +
+    rung). Otherwise the first decline reason, in the
+    ``accl_cmatmul_fallback_total`` vocabulary (``"off"`` is a
+    requested baseline, never counted). ``batch`` is the PER-DP-RANK
+    row count the step will trace with. Every layer shares one
+    geometry, so one resolution covers the stack."""
+    from ..ops import collective_matmul as cm
+
+    h_tp = d_hidden // tp
+    f32 = jnp.float32
+    checks = (
+        # forward gathers: trav = (h_tp/dp, d) and (d/dp, h_tp) shards,
+        # the matmul operand is the (k, batch) activation panel
+        lambda: cm.agmm_engage_reason(
+            h_tp // dp, d_model, batch, dp, f32, overlap, bidirectional,
+            wire_dtype=wire_dtype, w_dtype=f32),
+        lambda: cm.agmm_engage_reason(
+            d_model // dp, h_tp, batch, dp, f32, overlap, bidirectional,
+            wire_dtype=wire_dtype, w_dtype=f32),
+        # gradient reductions: the custom_vjp duals —
+        # mmrs(dy (h_tp, b), xᵀᵀ (b, d)) and mmrs(dy (d, b), uᵀ (b, h_tp))
+        lambda: cm.mmrs_engage_reason(
+            h_tp, batch, d_model, dp, f32, overlap, bidirectional,
+            wire_dtype=wire_dtype, w_dtype=f32),
+        lambda: cm.mmrs_engage_reason(
+            d_model, batch, h_tp, dp, f32, overlap, bidirectional,
+            wire_dtype=wire_dtype, w_dtype=f32),
+        # activation gradients: the agmm VJPs' dx — the fused
+        # gathered-wgrad (trav = the weight shard, loc = dy; resident
+        # only, so a dw panel that misses VMEM must decline the WHOLE
+        # commit, never run silently unfused inside a "fused" schedule)
+        lambda: cm.wgrad_engage_reason(
+            h_tp // dp, d_model, batch, dp, f32, overlap, bidirectional,
+            wire_dtype=wire_dtype, loc_dtype=f32),
+        lambda: cm.wgrad_engage_reason(
+            d_model // dp, h_tp, batch, dp, f32, overlap, bidirectional,
+            wire_dtype=wire_dtype, loc_dtype=f32),
+    )
+    for check in checks:
+        reason = check()
+        if reason is not None:
+            return reason
+    return None
+
+
+def fsdp_engages(d_model: int, d_hidden: int, batch: int, dp: int, tp: int,
+                 overlap: Optional[bool] = None,
+                 bidirectional: bool = True,
+                 wire_dtype=None) -> bool:
+    """:func:`fsdp_engage_reason` collapsed to a bool (dp == 1 is the
+    degenerate single-shard case — nothing to overlap)."""
+    return dp > 1 and fsdp_engage_reason(
+        d_model, d_hidden, batch, dp, tp, overlap, bidirectional,
+        wire_dtype) is None
+
+
+# ---------------------------------------------------------------------------
+# the bucket gather: unfused all_gather whose GRADIENT is the bucketized
+# wire-staged reduce-scatter (rounded once before the wire, accumulated
+# across dp hops in the wire dtype — the mm×rs tolerance class)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _bucket_gather(shard, axis: str, wire_dtype):
+    return lax.all_gather(shard, axis, axis=0, tiled=True)
+
+
+def _bucket_gather_fwd(shard, axis, wire_dtype):
+    return _bucket_gather(shard, axis, wire_dtype), None
+
+
+def _bucket_gather_bwd(axis, wire_dtype, _res, g):
+    from ..ops import collective_matmul as cm
+
+    wdt, sr = cm._resolve_wire_codec(wire_dtype, g.dtype)
+    gw = cm._wire_cast(g, wdt, stochastic=sr)
+    gs = lax.psum_scatter(gw, axis, scatter_dimension=0, tiled=True)
+    return (gs.astype(g.dtype),)
+
+
+_bucket_gather.defvjp(_bucket_gather_fwd, _bucket_gather_bwd)
+
+
+# ---------------------------------------------------------------------------
+# block math (ONE copy shared by the fused and flat schedules — the two
+# datapaths must agree on every non-collective op for trajectory parity)
+# ---------------------------------------------------------------------------
+
+
+def _attention(q, k, v):
+    """(H, S, dh) scaled-dot-product attention: the flash kernel when
+    the sequence fits its 128-block tiling, the identical-math jnp
+    online path otherwise (tiny smoke geometries). Both SCHEDULES of a
+    given geometry take the same branch, so parity never crosses it."""
+    if q.shape[1] % 128 == 0:
+        from ..ops import flash
+        return flash.flash_attention(q, k, v)
+    sc = 1.0 / float(np.sqrt(q.shape[-1]))
+    s = jnp.einsum("hqd,hkd->hqk", q, k,
+                   preferred_element_type=jnp.float32) * sc
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("hqk,hkd->hqd", p, v,
+                      preferred_element_type=jnp.float32)
+
+
+def _attn_sublayer(x, bucket, d_model: int, tp: int, n_heads: int):
+    """x (b, d) + the layer's gathered attention bucket -> x + attn(x).
+    Heads are tp-sharded (Megatron): each tp rank runs its n_heads/tp
+    heads through flash and the output projection's partial products
+    combine with one tp psum."""
+    dtp, _ = _attn_sizes(d_model, tp)
+    wqkv = bucket[:3 * d_model * dtp].reshape(d_model, 3 * dtp)
+    wo = bucket[3 * d_model * dtp:4 * d_model * dtp].reshape(dtp, d_model)
+    qkv = jnp.dot(x, wqkv, preferred_element_type=jnp.float32)
+    q, k, v = jnp.split(qkv, 3, axis=1)          # (b, dtp) each
+    heads_tp = n_heads // tp
+    dh = dtp // heads_tp
+
+    def to_heads(t):
+        return t.reshape(-1, heads_tp, dh).transpose(1, 0, 2)
+
+    o = _attention(to_heads(q), to_heads(k), to_heads(v))
+    o = o.transpose(1, 0, 2).reshape(-1, dtp).astype(jnp.float32)
+    a = jnp.dot(o, wo, preferred_element_type=jnp.float32)
+    if tp > 1:
+        a = lax.psum(a, TP_AXIS)
+    return x + a
+
+
+def _mlp_sublayer(x, mm1, mm2, tp: int):
+    """x (b, d) -> x + W2(gelu(W1 x)) with the two matmuls supplied by
+    the schedule (fused agmm closures or plain dots over gathered
+    weights). The activations stay in the transposed panel layout
+    between the matmuls — the agmm output feeds the next agmm's matmul
+    operand directly, no transposes on the hot path."""
+    u = jax.nn.gelu(mm1(x.T))                    # (h_tp, b) f32
+    yt = mm2(u)                                  # (d, b) f32
+    if tp > 1:
+        yt = lax.psum(yt, TP_AXIS)
+    return x + yt.T
+
+
+# ---------------------------------------------------------------------------
+# the layerwise fused train step (and its committed flat-ravel fallback)
+# ---------------------------------------------------------------------------
+
+
+def build_zero_fsdp_train_step(mesh, n_layers: int, d_model: int,
+                               d_hidden: int, n_heads: int,
+                               lr: float = 1e-2, b1: float = 0.9,
+                               b2: float = 0.999, eps: float = 1e-8,
+                               overlap: Optional[bool] = None,
+                               prefetch: Optional[bool] = None,
+                               wire_dtype=None,
+                               bidirectional: bool = True):
+    """``step(state, x, y) -> (state, loss)`` — one jitted layerwise
+    ZeRO/FSDP train step over the (dp, tp) mesh.
+
+    ``x``/``y``: (B, d_model) global, rows sharded over dp (replicated
+    over tp). ``overlap=None`` follows ``ACCLConfig.zero_overlap`` plus
+    the cmatmul session registers; True forces the fused kernels, False
+    pins the flat-ravel baseline schedule. ``prefetch=None`` follows
+    ``ACCLConfig.zero_prefetch``. ``wire_dtype`` stages the fused legs'
+    ring payloads AND the bucketized attention-gradient leg compressed
+    (None: session ``ACCLConfig.cmatmul_wire_dtype``; "off": full
+    precision) — the flat baseline always runs full precision.
+
+    The commit decision is honest and counted: the fused datapath runs
+    only when :func:`fsdp_engage_reason` resolves None at the traced
+    batch shape; otherwise the flat schedule runs unchanged and the
+    decline lands in ``accl_cmatmul_fallback_total{op="zero_fsdp"}``
+    (an explicit/session overlap-off is a requested baseline — never
+    counted)."""
+    dp, tp = mesh.shape[DP_AXIS], mesh.shape[TP_AXIS]
+    _validate_geometry(dp, tp, d_model, d_hidden, n_heads)
+    axes = tuple(mesh.axis_names)
+    L = n_layers
+    dtp, n_attn = _attn_sizes(d_model, tp)
+    n_attn_pad = n_attn + (-n_attn) % dp
+    h_tp = d_hidden // tp
+    la, l1, l2 = n_attn_pad // dp, (h_tp // dp) * d_model, \
+        (d_model // dp) * h_tp
+    per = la + l1 + l2
+
+    def _resolved_overlap():
+        if overlap is None:
+            return None if _OVERLAP_DEFAULT else False
+        return overlap
+
+    def _fused_loss(p: FSDPParams, x, y, do_prefetch: bool, ov):
+        from ..ops import collective_matmul as cm
+
+        def agmm(trav, panel):
+            return cm.all_gather_matmul(trav, panel, DP_AXIS, axes, ov,
+                                        bidirectional, wire_dtype)
+
+        def gather(l):
+            return _bucket_gather(p.attn[l][0], DP_AXIS, wire_dtype)
+
+        h = x
+        nxt = gather(0)
+        for l in range(L):
+            bucket = nxt
+            if l + 1 < L and do_prefetch:
+                # cross-layer prefetch: layer l+1's bucket gather is
+                # issued BEFORE layer l's compute — independent of h, so
+                # the collective overlaps flash + the fused matmuls
+                # (double-buffered: at most two gathered buckets live)
+                nxt = gather(l + 1)
+            h = _attn_sublayer(h, bucket, d_model, tp, n_heads)
+            h = _mlp_sublayer(
+                h,
+                lambda xt, l=l: agmm(p.w1t[l], xt),
+                lambda u, l=l: agmm(p.w2t[l], u),
+                tp)
+            if l + 1 < L and not do_prefetch:
+                # prefetch declined: tie the next gather's operand to
+                # this layer's output (a zero-valued scalar dependency —
+                # this jax's optimization_barrier has no AD rule) so the
+                # collective cannot be hoisted above the layer boundary
+                shard = p.attn[l + 1][0] \
+                    + (h[0, 0] * 0.0).astype(p.attn[l + 1].dtype)
+                nxt = _bucket_gather(shard, DP_AXIS, wire_dtype)
+        return jnp.mean((h - y) ** 2)
+
+    def _flat_step_grads(p: FSDPParams, x, y):
+        """The flat-ravel schedule: ONE monolithic all_gather of every
+        layer's shards, compute with fully materialized weights, ONE
+        monolithic psum_scatter of the raveled gradient — the baseline
+        the fused step's overlap efficiency is measured against."""
+        flat = jnp.concatenate(
+            [seg for l in range(L)
+             for seg in (p.attn[l][0], p.w1t[l].ravel(),
+                         p.w2t[l].ravel())])
+        full = lax.all_gather(flat, DP_AXIS, axis=0,
+                              tiled=True).reshape(dp, L * per)
+        af, w1f, w2f = [], [], []
+        for l in range(L):
+            off = l * per
+            af.append(full[:, off:off + la].reshape(-1))
+            w1f.append(full[:, off + la:off + la + l1]
+                       .reshape(dp, h_tp // dp, d_model)
+                       .reshape(h_tp, d_model))
+            w2f.append(full[:, off + la + l1:off + per]
+                       .reshape(dp, d_model // dp, h_tp)
+                       .reshape(d_model, h_tp))
+
+        def loss_fn(fulls):
+            afl, w1l, w2l = fulls
+            h = x
+            for l in range(L):
+                h = _attn_sublayer(h, afl[l], d_model, tp, n_heads)
+                h = _mlp_sublayer(
+                    h,
+                    lambda xt, l=l: jnp.dot(
+                        w1l[l], xt, preferred_element_type=jnp.float32),
+                    lambda u, l=l: jnp.dot(
+                        w2l[l], u, preferred_element_type=jnp.float32),
+                    tp)
+            return jnp.mean((h - y) ** 2)
+
+        loss, (ga, g1, g2) = jax.value_and_grad(loss_fn)(
+            (tuple(af), tuple(w1f), tuple(w2f)))
+        segs = []
+        for l in range(L):
+            segs.append(ga[l].reshape(dp, la))
+            segs.append(g1[l].reshape(dp, h_tp // dp, d_model)
+                        .reshape(dp, l1))
+            segs.append(g2[l].reshape(dp, d_model // dp, h_tp)
+                        .reshape(dp, l2))
+        flatg = jnp.concatenate(segs, axis=1).reshape(-1)
+        gsh = lax.psum_scatter(flatg, DP_AXIS, scatter_dimension=0,
+                               tiled=True)
+        gattn, gw1t, gw2t = [], [], []
+        for l in range(L):
+            off = l * per
+            gattn.append(gsh[off:off + la].reshape(1, la))
+            gw1t.append(gsh[off + la:off + la + l1]
+                        .reshape(h_tp // dp, d_model))
+            gw2t.append(gsh[off + la + l1:off + per]
+                        .reshape(d_model // dp, h_tp))
+        return loss, FSDPParams(tuple(gattn), tuple(gw1t), tuple(gw2t))
+
+    def local_step(state: ZeroFSDPState, x, y):
+        p, m, v, t = state
+        b = x.shape[0]
+        ov = _resolved_overlap()
+        reason = None
+        if dp > 1:
+            reason = fsdp_engage_reason(d_model, d_hidden, b, dp, tp, ov,
+                                        bidirectional, wire_dtype)
+        fused = dp > 1 and reason is None
+        if fused:
+            do_prefetch = (_PREFETCH_DEFAULT if prefetch is None
+                           else bool(prefetch))
+            if L > 1:
+                _metrics.note_zero_prefetch(
+                    "hit" if do_prefetch else "decline", L - 1)
+            loss, grads = jax.value_and_grad(
+                _fused_loss, argnums=0)(p, x, y, do_prefetch, ov)
+        else:
+            if dp > 1 and reason != "off":
+                from ..ops.collective_matmul import _note_fallback
+                _note_fallback(FSDP_OP, reason)
+            loss, grads = _flat_step_grads(p, x, y)
+        # the collectives above deliver Σ_r (each rank's local-loss
+        # contribution); the training objective is the GLOBAL batch mean
+        grads = jax.tree_util.tree_map(lambda g: g / dp, grads)
+        t_new = t + 1
+        tf = t_new.astype(jnp.float32)
+
+        def adam(pw, mw, vw, gw):
+            m_new = b1 * mw + (1 - b1) * gw
+            v_new = b2 * vw + (1 - b2) * gw * gw
+            mhat = m_new / (1 - b1 ** tf)
+            vhat = v_new / (1 - b2 ** tf)
+            return pw - lr * mhat / (jnp.sqrt(vhat) + eps), m_new, v_new
+
+        new_p, new_m, new_v = [], [], []
+        flat_p, treedef = jax.tree_util.tree_flatten(p)
+        flat_m = jax.tree_util.tree_leaves(m)
+        flat_v = jax.tree_util.tree_leaves(v)
+        flat_g = jax.tree_util.tree_leaves(grads)
+        for pw, mw, vw, gw in zip(flat_p, flat_m, flat_v, flat_g):
+            a, bm, bv = adam(pw, mw, vw, gw)
+            new_p.append(a)
+            new_m.append(bm)
+            new_v.append(bv)
+        unflat = lambda leaves: jax.tree_util.tree_unflatten(treedef, leaves)
+        loss = lax.psum(loss, DP_AXIS) / dp
+        return (ZeroFSDPState(unflat(new_p), unflat(new_m),
+                              unflat(new_v), t_new), loss)
+
+    from ..compat import shard_map
+    specs = fsdp_param_specs(L)
+    state_specs = ZeroFSDPState(p=specs, m=specs, v=specs, t=P())
+    return jax.jit(shard_map(
+        local_step, mesh=mesh,
+        in_specs=(state_specs, P(DP_AXIS, None), P(DP_AXIS, None)),
+        out_specs=((state_specs, P())),
+        check_vma=False,
+    ))

@@ -297,6 +297,20 @@ def note_call(op, nbytes: int, dtype=None, key: Optional[Iterable] = None,
                          (("op", getattr(op, "name", str(op))),))
 
 
+def note_zero_prefetch(event: str, count: int = 1) -> None:
+    """Layerwise-ZeRO prefetch accounting: bump
+    ``accl_zero_prefetch_total{event}`` — ``event`` is ``"hit"`` (a
+    layer's attention-bucket gather issued under the PREVIOUS layer's
+    compute, the double-buffered schedule) or ``"decline"`` (prefetch
+    disabled: the gather serializes behind the layer boundary). Counted
+    at trace/build time like the cmatmul fallback counters, so the
+    count is per compiled program, not per step."""
+    if not ENABLED:
+        return
+    REGISTRY.inc("accl_zero_prefetch_total", float(count),
+                 (("event", event),))
+
+
 def inc(name: str, value: float = 1.0,
         labels: Tuple[Tuple[str, str], ...] = ()) -> None:
     if not ENABLED:

@@ -1359,26 +1359,78 @@ def _resolve(overlap: Optional[bool], nbytes: int, threshold: int) -> bool:
     return on and _kernels_available()
 
 
+def agmm_engage_reason(m: int, k: int, n: int, P: int, dtype,
+                       overlap: Optional[bool] = None,
+                       bidirectional: bool = True,
+                       wire_dtype=None, w_dtype=None) -> Optional[str]:
+    """None when :func:`all_gather_matmul` would run the FUSED kernel
+    for these shapes under the given overlap mode; otherwise the
+    decline reason — ``"off"`` (an explicit/session overlap-off
+    request: a requested baseline, never counted as a fallback),
+    ``"no_interpret"``, ``"threshold"``, or ``"vmem_miss"``. THE
+    single resolution of the session registers (aspect-class aware, in
+    EFFECTIVE wire bytes), kernel availability, and the VMEM plan
+    (resident OR streaming) — the engage checks and the restructuring
+    consumers' committed-baseline telemetry (the mlp, the layerwise
+    ZeRO step) both read it, so a counted label can never drift from
+    the actual decision. Pass ``w_dtype`` when the weight dtype
+    differs from the operand dtype — the body plans with the REAL
+    weight dtype, and a verdict computed without it can diverge from
+    dispatch near the VMEM budget."""
+    wdt = _resolve_wire(wire_dtype, dtype)
+    nbytes = m * k * jnp.dtype(wdt if wdt is not None else dtype).itemsize
+    if (overlap is not None and not overlap) or \
+            (overlap is None and not _OVERLAP_DEFAULT):
+        return "off"
+    if not _kernels_available():
+        return "no_interpret"
+    if overlap is None and nbytes < _ag_threshold(k, n):
+        return "threshold"
+    if agmm_plan(m, k, n, P, dtype, bidirectional,
+                 w_dtype=w_dtype, wire_dtype=wdt) is None:
+        return "vmem_miss"
+    return None
+
+
 def agmm_engages(m: int, k: int, n: int, P: int, dtype,
                  overlap: Optional[bool] = None,
                  bidirectional: bool = True,
                  wire_dtype=None, w_dtype=None) -> bool:
     """True when :func:`all_gather_matmul` would run the FUSED kernel
-    for these shapes under the given overlap mode — the session
-    registers (aspect-class aware), the VMEM plan (resident OR
-    streaming), and kernel availability all resolved. The size check
-    sees EFFECTIVE wire bytes (a bf16-staged shard moves half the
-    payload). Pass ``w_dtype`` when the weight dtype differs from the
-    operand dtype — the body plans with the REAL weight dtype, and an
-    engage verdict computed without it can diverge from dispatch. Lets callers that RESTRUCTURE around the fused kernels
-    (the mlp's sequence-sharded datapath) fall back to their own
-    baseline instead of a degraded unfused rendition of the
+    for these shapes — :func:`agmm_engage_reason` with the verdict
+    collapsed to a bool. Lets callers that RESTRUCTURE around the
+    fused kernels (the mlp's sequence-sharded datapath) fall back to
+    their own baseline instead of a degraded unfused rendition of the
     restructured program."""
-    wdt = _resolve_wire(wire_dtype, dtype)
-    nbytes = m * k * jnp.dtype(wdt if wdt is not None else dtype).itemsize
-    return (_resolve(overlap, nbytes, _ag_threshold(k, n))
-            and agmm_plan(m, k, n, P, dtype, bidirectional,
-                          w_dtype=w_dtype, wire_dtype=wdt) is not None)
+    return agmm_engage_reason(m, k, n, P, dtype, overlap, bidirectional,
+                              wire_dtype, w_dtype) is None
+
+
+def mmrs_engage_reason(m: int, k: int, n: int, P: int, dtype,
+                       overlap: Optional[bool] = None,
+                       bidirectional: bool = True,
+                       wire_dtype=None, w_dtype=None) -> Optional[str]:
+    """:func:`agmm_engage_reason`'s sibling for
+    :func:`matmul_reduce_scatter` (the traveller is the f32
+    accumulator, so wire bytes key off f32). Geometries the kernel
+    cannot express at all (rows not divisible by world) report
+    ``"vmem_miss"``'s sibling class as ``"geometry"``."""
+    if P < 1 or m % P:
+        return "geometry"
+    wdt = _resolve_wire(wire_dtype, jnp.float32)
+    nbytes = (m // P) * n * (jnp.dtype(wdt).itemsize
+                             if wdt is not None else 4)
+    if (overlap is not None and not overlap) or \
+            (overlap is None and not _OVERLAP_DEFAULT):
+        return "off"
+    if not _kernels_available():
+        return "no_interpret"
+    if overlap is None and nbytes < _rs_threshold(k, n):
+        return "threshold"
+    if mmrs_plan(m, k, n, P, dtype, bidirectional,
+                 w_dtype=w_dtype, wire_dtype=wdt) is None:
+        return "vmem_miss"
+    return None
 
 
 def mmrs_engages(m: int, k: int, n: int, P: int, dtype,
@@ -1387,14 +1439,42 @@ def mmrs_engages(m: int, k: int, n: int, P: int, dtype,
                  wire_dtype=None, w_dtype=None) -> bool:
     """:func:`agmm_engages`' sibling for :func:`matmul_reduce_scatter`
     (the traveller is the f32 accumulator, so wire bytes key off f32)."""
-    if P < 1 or m % P:
-        return False
-    wdt = _resolve_wire(wire_dtype, jnp.float32)
-    nbytes = (m // P) * n * (jnp.dtype(wdt).itemsize
-                             if wdt is not None else 4)
-    return (_resolve(overlap, nbytes, _rs_threshold(k, n))
-            and mmrs_plan(m, k, n, P, dtype, bidirectional,
-                          w_dtype=w_dtype, wire_dtype=wdt) is not None)
+    return mmrs_engage_reason(m, k, n, P, dtype, overlap, bidirectional,
+                              wire_dtype, w_dtype) is None
+
+
+def wgrad_engage_reason(ms: int, ct: int, cl: int, P: int, dtype,
+                        overlap: Optional[bool] = None,
+                        bidirectional: bool = True,
+                        wire_dtype=None, loc_dtype=None,
+                        travel_lhs: bool = True) -> Optional[str]:
+    """:func:`agmm_engage_reason`'s sibling for the fused gathered-wgrad
+    leg of the VJPs (:func:`gathered_wgrad_body`): the travelling
+    (ms, ct) shard's wire bytes against the FORWARD op's register
+    (``travel_lhs`` keys the agmm vs mmrs table, as at dispatch) and
+    the :func:`wgrad_plan` VMEM resolution — resident only, there is
+    no streaming wgrad (the ROADMAP leftover). Restructuring consumers
+    (the layerwise ZeRO step) must consult this alongside the
+    forward/dual checks: a geometry whose agmm/mmrs plans fit but
+    whose dw panel misses would otherwise commit to a "fused" schedule
+    with its activation gradients silently unfused."""
+    if P < 2:
+        return "geometry"
+    wdt = _resolve_wire(wire_dtype, dtype)
+    nbytes = ms * ct * jnp.dtype(wdt if wdt is not None else dtype).itemsize
+    if (overlap is not None and not overlap) or \
+            (overlap is None and not _OVERLAP_DEFAULT):
+        return "off"
+    if not _kernels_available():
+        return "no_interpret"
+    th = _ag_threshold(ct, cl) if travel_lhs else _rs_threshold(cl, ct)
+    if overlap is None and nbytes < th:
+        return "threshold"
+    if wgrad_plan(ms, ct, cl, P, wdt if wdt is not None else dtype,
+                  loc_dtype if loc_dtype is not None else dtype,
+                  bidirectional) is None:
+        return "vmem_miss"
+    return None
 
 
 def _fallback_reason(overlap: Optional[bool], op: str) -> None:

@@ -431,6 +431,32 @@ def build_matmul_reduce_scatter(comm, algo: Algorithm,
     return primitives._smap(comm, body, 2)
 
 
+def build_fsdp_matmul(comm, algo: Algorithm,
+                      bidirectional: bool = True,
+                      wire_dtype=None) -> Callable:
+    """(world, m, k) sharded local rows + (world, n/world, k) sharded
+    weight-column shards in travel layout -> (world, m, n):
+    ``x @ all_gather(wt)ᵀ`` — the ZeRO/FSDP forward with the parameter
+    gather folded into the matmul. PALLAS runs the agmm ring kernel on
+    the TRAVELLING WEIGHT SHARD (ops/collective_matmul.py — FSDP's
+    forward, no materialized full weight); anything else the unfused
+    gather + matmul pair. Used by the ``zero_fsdp`` autotune/bench
+    path; the training step itself composes the same kernels through
+    :mod:`accl_tpu.models.zero`."""
+    from ..ops import collective_matmul as cm
+    if algo == Algorithm.PALLAS:
+        pallas_ring._check_multiprocess(comm)
+
+    def body(x, wt):
+        yt = cm.all_gather_matmul_body(
+            wt[0], x[0].T, axis=primitives.AXIS,
+            overlap=(algo == Algorithm.PALLAS),
+            bidirectional=bidirectional, wire_dtype=wire_dtype)
+        return yt.T[None]
+
+    return primitives._smap(comm, body, 2)
+
+
 def build_alltoall_matmul(comm, algo: Algorithm,
                           bidirectional: bool = True,
                           wire_dtype=None) -> Callable:

@@ -385,6 +385,10 @@ def main(argv=None) -> int:
              lambda: _lanes.bench_moe_a2a(comm, bidirectional=bidir)),
             ("moe_a2a_bwd",
              lambda: _lanes.bench_moe_a2a_bwd(comm, bidirectional=bidir)),
+            # round 11: the flagship end-to-end lane — layerwise fused
+            # ZeRO/FSDP train step vs the flat-ravel baseline schedule
+            ("zero_fsdp",
+             lambda: _lanes.bench_zero_fsdp(comm, bidirectional=bidir)),
         ):
             if not _lane_selected(lanes_filter, name):
                 continue

@@ -452,3 +452,22 @@ def test_autotune_collective_matmul_sweeps_streaming_shapes(accl,
     # budget; the k-blocked plan must not (it keeps (mh, n) f32 accs)
     plan = cm.agmm_plan(2 ** 13, 512, 512, W, np.float32, True)
     assert plan is None or plan["mode"] == "stream"
+
+
+def test_autotune_zero_fsdp_gates(accl):
+    """The layerwise ZeRO schedule register tunes only where a
+    measurement would mean something: off ICI the config passes through
+    untouched, and on ICI a rung whose kernels cannot run (so the fused
+    step would measure its own committed fallback) also passes through
+    — zero_overlap keeps its session value either way."""
+    from accl_tpu.config import TransportBackend
+
+    cfg = autotune.autotune_zero_fsdp(accl)         # SIM transport
+    assert cfg.zero_overlap == accl.config.zero_overlap
+    orig = accl.config
+    try:
+        accl.config = accl.config.replace(transport=TransportBackend.ICI)
+        cfg = autotune.autotune_zero_fsdp(accl)     # ICI, no kernels here
+        assert cfg.zero_overlap == accl.config.zero_overlap
+    finally:
+        accl.config = orig

@@ -258,6 +258,79 @@ def test_cmatmul_dw_and_stream_lanes_schema(accl):
         assert r["value"] == 0.0 and r["wire_speedup"] is None
 
 
+def test_zero_fsdp_lane_schema(accl):
+    """The flagship end-to-end lane follows the resolution protocol on
+    every rung: the honesty flag mirrors the layerwise engage
+    resolution (False here, where the kernels cannot run — the "fused"
+    time measures the committed flat fallback), plan modes are pinned,
+    raw ratios stay on the record, and an unengaged lane zeroes its
+    headline."""
+    from accl_tpu.bench import lanes
+    from accl_tpu.models import zero
+
+    rows = lanes.bench_zero_fsdp(accl.global_comm(), n_layers=1,
+                                 d_model=16, d_hidden=32, n_heads=4,
+                                 batch_per_rank=8, rounds=2)
+    assert [r["metric"] for r in rows] == ["zero_fsdp"]
+    r = rows[0]
+    assert r["unit"] == "ratio"
+    assert r["world"] == accl.world_size
+    assert r["dp"] * r["tp"] == r["world"]
+    assert r["fused_engaged"] == zero.fsdp_engages(
+        16, 32, 8, r["dp"], r["tp"], overlap=True)
+    assert r["resolved"] == r["fused_engaged"]
+    assert r["raw_overlap_eff_med"] > 0
+    assert r["fused_us"] > 0 and r["flat_us"] > 0
+    assert r["plan_mode"] in ("resident", "stream", None)
+    assert r["kernels_per_layer"] == 6
+    if not r["resolved"]:
+        assert r["value"] == 0.0
+
+
+def test_bench_compare_artifacts(tmp_path):
+    """bench/compare.py diffs two artifacts lane by lane: >10% drops
+    flag as regressions, honesty-flagged lanes are incomparable (a
+    zeroed headline must not read as a 100% regression), added/removed
+    lanes are findings, and the CLI exits 1 when anything regressed."""
+    import json as _json
+
+    from accl_tpu.bench import compare
+
+    base = {"metric": "allreduce_ring_algbw_8dev", "value": 10.0,
+            "lanes": [
+                {"metric": "cmatmul_ag", "value": 1.5, "resolved": True},
+                {"metric": "zero_fsdp", "value": 1.2, "resolved": True},
+                {"metric": "flagged", "value": 0.0, "resolved": False},
+                {"metric": "gone", "value": 2.0, "resolved": True}]}
+    new = {"metric": "allreduce_ring_algbw_8dev", "value": 9.5,
+           "lanes": [
+               {"metric": "cmatmul_ag", "value": 1.2, "resolved": True},
+               {"metric": "zero_fsdp", "value": 1.5, "resolved": True},
+               {"metric": "flagged", "value": 3.0, "resolved": False},
+               {"metric": "new_lane", "value": 1.0, "resolved": True}]}
+    a = tmp_path / "a.json"
+    a.write_text(_json.dumps(base) + "\n")
+    b = tmp_path / "b.json"
+    # the loader takes the LAST parseable JSON line (streamed logs above)
+    b.write_text("not json\n" + _json.dumps({"metric": "stale"})
+                 + "\n" + _json.dumps(new) + "\n")
+    out = compare.compare(compare.load_artifact(str(a)),
+                          compare.load_artifact(str(b)), threshold=0.10)
+    statuses = {r["metric"]: r["status"] for r in out["rows"]}
+    assert statuses == {
+        "allreduce_ring_algbw_8dev": "ok",     # -5% within threshold
+        "cmatmul_ag": "regression",            # -20%
+        "zero_fsdp": "improvement",            # +25%
+        "flagged": "incomparable",             # unresolved on both sides
+        "gone": "removed",
+        "new_lane": "added",
+    }
+    assert out["regressions"] == ["cmatmul_ag"]
+    assert out["regressed"]
+    assert compare.main([str(a), str(b)]) == 1           # CI-gateable
+    assert compare.main([str(a), str(a)]) == 0
+
+
 def test_moe_a2a_lanes_schema(accl):
     """The expert-parallel a2a lanes follow the resolution protocol on
     every rung: honesty flags mirror plan + rung (the bwd lane needs

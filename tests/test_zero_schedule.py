@@ -1,0 +1,99 @@
+"""Multi-host AOT lowering proof for the layerwise ZeRO/FSDP step.
+
+Mirrors ``test_cmatmul_schedule.py``: the flagship train step — flash
+attention + per-layer agmm parameter gathers + their dual mmrs/wgrad
+backward kernels + the prefetched bucket gathers — AOT-compiles against
+a real ``v5e:2x4`` TPU topology on a (dp=4, tp=2) mesh. A successful
+compile proves Mosaic accepted every fused kernel the layerwise
+schedule traces and XLA scheduled the composed program for a 2-host
+mesh; the kernel COUNT pins the acceptance bar (>= 6 collective-matmul
+kernels per transformer layer: 2 forward agmm gathers, 2 dual mmrs
+gradient reductions, 2 fused gathered-wgrad kernels — the ISSUE's
+">= 2 fused kernels per layer" with the full backward on top — plus
+the per-layer flash fwd/bwd pair)."""
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from accl_tpu.models import zero
+from accl_tpu.ops import collective_matmul as cm
+from accl_tpu.parallel import pallas_ring
+from conftest import assert_aot_lowered, aot_topology_devices
+
+WORLD, DP, TP = 8, 4, 2
+D, HID, HEADS, B_RANK = 256, 1024, 8, 128
+
+
+@pytest.fixture(scope="module")
+def fsdp_mesh():
+    devices = aot_topology_devices("v5e:2x4")
+    assert len(devices) == WORLD
+    return zero.make_mesh(devices, DP, TP)
+
+
+def _state_structs(mesh, n_layers):
+    specs = zero.fsdp_param_specs(n_layers)
+    _, n_attn = zero._attn_sizes(D, TP)
+    n_attn_pad = n_attn + (-n_attn) % DP
+
+    def leaf(shape, spec):
+        return jax.ShapeDtypeStruct(shape, jnp.float32,
+                                    sharding=NamedSharding(mesh, spec))
+
+    p = zero.FSDPParams(
+        attn=tuple(leaf((TP, n_attn_pad), s) for s in specs.attn),
+        w1t=tuple(leaf((HID, D), s) for s in specs.w1t),
+        w2t=tuple(leaf((D, HID), s) for s in specs.w2t),
+    )
+    t = jax.ShapeDtypeStruct((), jnp.int32,
+                             sharding=NamedSharding(mesh, P()))
+    return zero.ZeroFSDPState(p=p, m=p, v=p, t=t)
+
+
+def _x_struct(mesh):
+    return jax.ShapeDtypeStruct(
+        (DP * B_RANK, D), jnp.float32,
+        sharding=NamedSharding(mesh, P(zero.DP_AXIS, None)))
+
+
+def _compile(mesh, n_layers, **kw):
+    with jax.enable_x64(False), pallas_ring.aot_lowering():
+        step = zero.build_zero_fsdp_train_step(
+            mesh, n_layers, D, HID, HEADS, overlap=True, **kw)
+        st = _state_structs(mesh, n_layers)
+        xs = _x_struct(mesh)
+        return step.lower(st, xs, xs).compile()
+
+
+def test_fsdp_plans_resident():
+    """Geometry pin: both per-layer gather plans resolve VMEM-resident
+    at the flagship shapes (a padding/budget change is a visible diff,
+    not a silicon surprise)."""
+    h_tp = HID // TP
+    p1 = cm.agmm_plan(h_tp // DP, D, B_RANK, DP, jnp.float32, True)
+    p2 = cm.agmm_plan(D // DP, h_tp, B_RANK, DP, jnp.float32, True)
+    assert p1 is not None and p1["mode"] == "resident"
+    assert p2 is not None and p2["mode"] == "resident"
+    with pallas_ring.aot_lowering():
+        # kernels-available is forced, as at compile: the whole engage
+        # resolution (plans + registers) must say yes for these shapes
+        assert zero.fsdp_engages(D, HID, B_RANK, DP, TP, overlap=True)
+
+
+def test_fsdp_train_step_lowers_multihost(fsdp_mesh):
+    """The flagship workload end to end: TWO transformer layers of
+    (flash fwd/bwd + 6 collective-matmul kernels each) in ONE jitted
+    program lower for the 2-host (dp=4, tp=2) mesh."""
+    L = 2
+    compiled = _compile(fsdp_mesh, L)
+    # >= 6 cmatmul + 2 flash Mosaic kernels per layer
+    assert_aot_lowered(compiled, 8 * L)
+
+
+def test_fsdp_train_step_wire_lowers_multihost(fsdp_mesh):
+    """bf16 wire staging lowers: the ring kernels' staged slots at half
+    the bytes plus the hp_compression cast lanes (shard casts + the
+    bucketized gradient leg)."""
+    compiled = _compile(fsdp_mesh, 1, wire_dtype="bf16")
+    assert_aot_lowered(compiled, 9)
