@@ -100,6 +100,11 @@ class ACCL:
 
         _zero_model.set_overlap_enabled(cfg.zero_overlap)
         _zero_model.set_prefetch_enabled(cfg.zero_prefetch)
+        # the program cache's LRU bound follows the config on every
+        # assignment (the setter can run from __init__ before the cache
+        # exists — construction applies the bound itself then)
+        if hasattr(self, "_programs"):
+            self._programs.set_maxsize(cfg.program_cache_size)
 
     def __init__(
         self,
@@ -115,7 +120,7 @@ class ACCL:
                 from .utils.bringup import snake_order
                 self._devices = snake_order(self._devices)
         self.comms: List[Communicator] = []
-        self._programs = ProgramCache()
+        self._programs = ProgramCache(self.config.program_cache_size)
         self._queue = RequestQueue()
         self._matchers: dict[int, MatchingEngine] = {}
         self._arith_configs = dict(DEFAULT_ARITH_CONFIG)
@@ -140,6 +145,12 @@ class ACCL:
         from .ops import collective_matmul as _cm_ops
 
         _cm_ops.reset_fallback_warnings()
+        # the schedule-plan cache is module-global too: a new session's
+        # config (declared torus shape, cost params, seeds) must
+        # re-synthesize, never inherit another session's plans
+        from .parallel import synth as _synth
+
+        _synth.reset_plan_cache()
         if self.config.transport is None:
             from .utils.bringup import detect_backend
 
@@ -633,6 +644,15 @@ class ACCL:
                 lambda: algorithms.build_bcast(comm, root, algo, arith,
                                                dtype, seg))
 
+    def _mesh_shape(self, comm, algo):
+        """Resolved torus shape for a MULTIAXIS program — part of its
+        cache key (a redeclared topology must not reuse a stale
+        program); None for every other family."""
+        if algo != Algorithm.MULTIAXIS:
+            return None
+        from .parallel import synth
+        return synth.torus_shape(comm, self.config, allow_factor2d=True)
+
     def _spec_allgather(self, comm, count: int, dtype: dataType,
                         compress_dtype, algorithm):
         arith = self._arith(dtype, compress_dtype)
@@ -641,10 +661,12 @@ class ACCL:
             comm, self.config, algorithm)
         seg = self.config.segment_size
         bidir = self.config.bidirectional_rings
+        ms = self._mesh_shape(comm, algo)
         return (self._key(comm, operation.allgather, count, dtype,
-                          compress_dtype, algo, seg, bidir),
+                          compress_dtype, algo, seg, bidir, ms),
                 lambda: algorithms.build_allgather(comm, algo, arith, dtype,
-                                                   seg, bidir))
+                                                   seg, bidir,
+                                                   mesh_shape=ms))
 
     def _spec_scatter(self, comm, count: int, dtype: dataType, root: int,
                       compress_dtype, algorithm):
@@ -716,11 +738,14 @@ class ACCL:
         seg = self.config.segment_size
         bidir = self.config.bidirectional_rings
         on_dcn = self.config.transport == TransportBackend.DCN
+        ms = self._mesh_shape(comm, algo)
         return (self._key(comm, operation.allreduce, count, dtype, function,
-                          compress_dtype, algo, seg, fanin, bidir, on_dcn),
+                          compress_dtype, algo, seg, fanin, bidir, on_dcn,
+                          ms),
                 lambda: algorithms.build_allreduce(comm, function, dtype,
                                                    algo, arith, seg, fanin,
-                                                   bidir, on_dcn=on_dcn))
+                                                   bidir, on_dcn=on_dcn,
+                                                   mesh_shape=ms))
 
     def _spec_reduce_scatter(self, comm, count: int, dtype: dataType,
                              function: reduceFunction, compress_dtype,
@@ -734,11 +759,13 @@ class ACCL:
             comm, self.config, algorithm)
         seg = self.config.segment_size
         bidir = self.config.bidirectional_rings
+        ms = self._mesh_shape(comm, algo)
         return (self._key(comm, operation.reduce_scatter, count, dtype,
-                          function, compress_dtype, algo, seg, bidir),
+                          function, compress_dtype, algo, seg, bidir, ms),
                 lambda: algorithms.build_reduce_scatter(comm, function,
                                                         dtype, algo, arith,
-                                                        seg, bidir))
+                                                        seg, bidir,
+                                                        mesh_shape=ms))
 
     # ------------------------------------------------------------------
     # primitives: copy / combine
@@ -1814,7 +1841,9 @@ class ACCL:
             "hwid": self.parse_hwid(),
             "config": _json.loads(self.config.to_json()),
             "program_cache": {"programs": progs, "hits": hits,
-                              "misses": misses},
+                              "misses": misses,
+                              "evictions": self._programs.evictions,
+                              "max_size": self._programs.maxsize},
             "queue": {"inflight": len(self._queue.inflight)},
             "scheduler": {"parked_continuations": len(self._parked_calls),
                           "fresh_depth": fresh, "retry_depth": retry},

@@ -711,6 +711,62 @@ def autotune_zero_fsdp(acc, cfg: Optional[ACCLConfig] = None,
     return cfg.replace(zero_overlap=times["fused"] <= times["flat"])
 
 
+def autotune_sched_synth(acc, cfg: Optional[ACCLConfig] = None,
+                         pows: Sequence[int] = (14, 20),
+                         reps: int = 3,
+                         dt: dataType = dataType.float32) -> ACCLConfig:
+    """Validate the schedule synthesizer against the live mesh: calibrate
+    the α-β cost model from measured flat-ring allreduce times (a linear
+    fit of t(N) — the intercept prices a hop, the slope a link
+    direction) and A/B the synthesized multi-axis schedule against the
+    ring at the largest size, writing ``sched_alpha_us`` /
+    ``sched_beta_gbps`` and the ``sched_synthesis`` go/no-go. ICI only —
+    anywhere else the fit would calibrate the emulator — and a mesh with
+    no declared or coordinate-detected torus passes through untouched
+    (AUTO never dispatches the multi-axis plan there, so there is
+    nothing to seed)."""
+    import jax
+
+    from ..parallel import synth
+
+    cfg = cfg or acc.config
+    if acc.config.transport != TransportBackend.ICI:
+        return cfg
+    comm = acc.global_comm()
+    W = comm.world_size
+    if W == 1:
+        return cfg
+    shape = synth.torus_shape(comm, cfg)
+    if shape is None:
+        return cfg
+    counts = [2 ** p for p in pows]
+    elem = np.dtype(to_jax_dtype(dt)).itemsize
+    bidir = cfg.bidirectional_rings
+    t_ring = measure_allreduce(comm, counts, [Algorithm.RING], dt, reps,
+                               bidirectional=bidir)[Algorithm.RING]
+    # linear fit t(N) = a + b*N over the sweep: a amortizes 2(P-1) hops,
+    # b is the 2N(P-1)/P / (k*beta) slope of the ring's bandwidth term
+    ns = np.array([c * elem for c in counts], dtype=np.float64)
+    ts = np.array(t_ring, dtype=np.float64)
+    b, a = np.polyfit(ns, ts, 1) if len(ns) >= 2 else (0.0, ts[0])
+    k = 2 if (bidir and W >= 4) else 1
+    if b > 0:
+        alpha_us = max(a / (2 * (W - 1)) * 1e6, 1e-3)
+        beta_gbps = (2 * (W - 1) / W) / (b * k * 1e9)
+        cfg = cfg.replace(sched_alpha_us=float(round(alpha_us, 4)),
+                          sched_beta_gbps=float(round(beta_gbps, 3)))
+    # go/no-go at the largest size: the synthesized multi-axis schedule
+    # must actually beat the flat ring it claims to beat
+    npdt = np.dtype(to_jax_dtype(dt))
+    n = counts[-1]
+    prog = algorithms.build_allreduce(
+        comm, reduceFunction.SUM, dt, Algorithm.MULTIAXIS, None,
+        bidirectional=bidir, mesh_shape=shape)
+    x = jax.device_put(np.full((W, n), 1e-6, npdt), comm.sharding())
+    t_multi = _time_prog(prog, x, reps=reps)
+    return cfg.replace(sched_synthesis=bool(t_multi <= t_ring[-1]))
+
+
 def autotune_flash_bwd(acc, cfg: Optional[ACCLConfig] = None,
                        H: int = 8, S: int = 2048, d: int = 128,
                        reps: int = 3) -> ACCLConfig:
@@ -804,6 +860,8 @@ def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
             acc, c, reps=reps, dt=dt)),
         ("moe_a2a", lambda c: autotune_moe_a2a(acc, c, reps=reps, dt=dt)),
         ("zero_fsdp", lambda c: autotune_zero_fsdp(acc, c, reps=reps)),
+        ("sched_synth", lambda c: autotune_sched_synth(
+            acc, c, reps=reps, dt=dt)),
         ("flash_bwd", lambda c: autotune_flash_bwd(acc, c, reps=reps)),
     ]
     try:

@@ -38,7 +38,13 @@ reusable: ``execute`` can be called repeatedly (picking up fresh host
 writes each time). Algorithm selection is re-resolved at every
 ``execute`` from the CURRENT session config, so a list recorded before
 ``ACCL.autotune()`` runs with the tuned thresholds afterwards (the
-compiled composite is cached per resolved selection).
+compiled composite is cached per resolved selection). The same
+re-resolution picks up the schedule synthesizer's plans
+(``parallel/synth.py``): a bandwidth collective recorded here and
+resolved to ``Algorithm.MULTIAXIS`` compiles its whole multi-step
+axis-by-axis schedule into the one-launch composite — a synthesized
+collective is one cached cmdlist step like any other program (see
+``docs/scheduling.md``).
 """
 from __future__ import annotations
 

@@ -41,6 +41,8 @@ class Algorithm(enum.Enum):
     FLAT = "flat"          # flat tree (root-centric fan-in/out)
     HIERARCHICAL = "hier"  # 2D-mesh reduce -> bcast composition
     PALLAS = "pallas"      # Pallas ring kernels over async remote DMA
+    MULTIAXIS = "multiaxis"  # axis-by-axis torus decomposition
+    #                        # (parallel/synth.py schedule synthesis)
 
 
 @dataclasses.dataclass
@@ -195,6 +197,34 @@ class ACCLConfig:
     # config assignment; bench.autotune_flash_bwd measures the crossover
     # on the live chip and writes the winner here.
     flash_bwd: str = "fused"
+
+    # topology-aware schedule synthesis (parallel/synth.py): the α-β
+    # cost-model search over the multi-axis torus that replaces the
+    # scalar-threshold pile for the bandwidth collectives. sched_synthesis
+    # is the session A/B switch (off = the legacy ladder verbatim);
+    # sched_mesh_shape declares the torus factorization [rows, cols] when
+    # device coordinates cannot (the emulated-2x4 declaration; None =
+    # auto-detect from chip coords, single-axis when absent — AUTO never
+    # invents a torus). sched_alpha_us/sched_beta_gbps are the cost
+    # model's per-hop latency and per-link-direction bandwidth on
+    # ICI/SIM (the *_dcn_* pair on DCN), calibrated on the live mesh by
+    # bench.autotune_sched_synth. A legacy scalar threshold that differs
+    # from its default is an autotune seed and PINS the legacy decision
+    # for its op (the override contract — docs/scheduling.md).
+    sched_synthesis: bool = True
+    sched_mesh_shape: Optional[list] = None
+    sched_alpha_us: float = 1.0
+    sched_beta_gbps: float = 45.0
+    sched_dcn_alpha_us: float = 25.0
+    sched_dcn_beta_gbps: float = 5.0
+
+    # compiled-program cache (parallel/compiler.py) LRU bound: a
+    # long-lived serving session resolving many (shape, dtype, algo)
+    # keys must not grow the cache without limit. Generous by default —
+    # eviction is for runaway cardinality, not steady state; 0 disables
+    # the bound. Hits/misses/evictions export via obs/metrics
+    # (accl_program_cache_total) beside the stats() fields.
+    program_cache_size: int = 1024
 
     # snake-order auto-discovered TPU devices by chip coordinates so ring
     # neighbors are physical ICI neighbors (bringup.snake_order); explicit

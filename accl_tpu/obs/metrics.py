@@ -31,6 +31,11 @@ name                                           kind       labels
 ``accl_session_handshake_retries_total``       counter    (none)
 ``accl_fabric_moves_total``                    counter    kind (single | batch)
 ``accl_cmdlist_executes_total``                counter    steps
+``accl_sched_plan_total``                      counter    op, shape, source
+``accl_sched_plan_cache_total``                counter    event (hit | miss)
+``accl_select_decline_total``                  counter    op, reason
+``accl_program_cache_total``                   counter    event (hit | miss | evict)
+``accl_program_cache_size``                    gauge      (none)
 =============================================  =========  =================
 
 Export formats: :meth:`MetricsRegistry.snapshot` (flat, JSON-safe dict),

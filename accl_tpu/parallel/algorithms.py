@@ -23,7 +23,7 @@ from ..communicator import Communicator
 from ..config import ACCLConfig, Algorithm, TransportBackend
 from ..constants import ACCLError, dataType, errorCode, operation, reduceFunction
 from ..obs import metrics as _metrics
-from . import flat, hierarchical, pallas_ring, primitives, ring, tree
+from . import flat, hierarchical, pallas_ring, primitives, ring, synth, tree
 
 #: default payload size above which AUTO prefers the explicit ring (bytes);
 #: per-session values live in ACCLConfig.ring_threshold (autotunable)
@@ -59,10 +59,11 @@ _SUPPORTED = {
                        Algorithm.RING, Algorithm.PALLAS},
     operation.allreduce: {Algorithm.XLA, Algorithm.FLAT, Algorithm.TREE,
                           Algorithm.RING, Algorithm.HIERARCHICAL,
-                          Algorithm.PALLAS},
-    operation.allgather: {Algorithm.XLA, Algorithm.RING, Algorithm.PALLAS},
+                          Algorithm.PALLAS, Algorithm.MULTIAXIS},
+    operation.allgather: {Algorithm.XLA, Algorithm.RING, Algorithm.PALLAS,
+                          Algorithm.MULTIAXIS},
     operation.reduce_scatter: {Algorithm.XLA, Algorithm.RING,
-                               Algorithm.PALLAS},
+                               Algorithm.PALLAS, Algorithm.MULTIAXIS},
     operation.scatter: {Algorithm.XLA, Algorithm.FLAT, Algorithm.PALLAS},
     operation.gather: {Algorithm.XLA, Algorithm.FLAT, Algorithm.RING,
                        Algorithm.PALLAS},
@@ -142,7 +143,19 @@ def select(
     of the firmware's per-collective selection (flat vs binary tree:
     ``ccl_offload_control.c:816`` bcast, ``:1533`` reduce). Every
     resolution is counted (``accl_algorithm_selected_total``) so AUTO's
-    behavior over a workload is attributable after the fact."""
+    behavior over a workload is attributable after the fact.
+
+    For the bandwidth collectives (allreduce / allgather /
+    reduce_scatter) the scalar ladder below is the LEGACY layer of a
+    two-stage resolution: its decision feeds the topology-aware
+    schedule synthesizer (:mod:`accl_tpu.parallel.synth`), whose cached
+    α-β cost-model search may upgrade it to the multi-axis torus
+    decomposition (``Algorithm.MULTIAXIS``) on meshes with a declared or
+    coordinate-detected torus shape. Non-default scalar registers are
+    autotune seeds and pin the legacy decision; single-axis meshes with
+    default config resolve exactly as the ladder alone — see
+    ``docs/scheduling.md`` for the cost model, candidate space and
+    override/migration story."""
     algo = _select(op, nbytes, comm, cfg, requested, count)
     _metrics.inc("accl_algorithm_selected_total",
                  labels=(("op", op.name), ("algorithm", algo.value)))
@@ -180,6 +193,28 @@ def _select(
     world = comm.world_size
     if world == 1:
         return Algorithm.XLA
+    legacy = _select_legacy(op, nbytes, comm, cfg, count)
+    if op in synth.SYNTH_OPS:
+        # second stage: the schedule synthesizer may upgrade the ladder's
+        # decision to the multi-axis torus decomposition (cached per
+        # (op, topology, size-bucket); legacy seeds stay binding)
+        return synth.resolve(op, nbytes, comm, cfg, legacy,
+                             count=count).algorithm
+    return legacy
+
+
+def _select_legacy(
+    op: operation,
+    nbytes: int,
+    comm: Communicator,
+    cfg: ACCLConfig,
+    count: Optional[int] = None,
+) -> Algorithm:
+    """The scalar-threshold ladder — the pre-synthesis resolution,
+    preserved verbatim: it remains authoritative for every op outside
+    :data:`synth.SYNTH_OPS`, for single-axis meshes, and wherever a
+    non-default register (an autotune seed) overrides the cost model."""
+    world = comm.world_size
     on_dcn = cfg.transport == TransportBackend.DCN
     if on_dcn:
         # multi-host: long edges are expensive. Hierarchical allreduce as
@@ -189,10 +224,16 @@ def _select(
         # world-1 times). The early engage needs a HOST-aligned 2-D shape:
         # with one device per host the factor2d fallback would put the
         # bandwidth-heavy "intra-host" phase on DCN links — a perf trap,
-        # so fall through to the ICI thresholds instead (ADVICE r2 #4)
-        if op == operation.allreduce and nbytes >= cfg.dcn_hier_threshold \
-                and comm.hosts_shape() is not None:
-            return Algorithm.HIERARCHICAL
+        # so fall through to the ICI thresholds instead (ADVICE r2 #4).
+        # The silent fall-through is COUNTED (op + reason), mirroring the
+        # accl_cmatmul_fallback_total discipline: a non-host-aligned mesh
+        # losing the hierarchical engage is attributable, not invisible
+        if op == operation.allreduce and nbytes >= cfg.dcn_hier_threshold:
+            if comm.hosts_shape() is not None:
+                return Algorithm.HIERARCHICAL
+            _metrics.inc("accl_select_decline_total",
+                         labels=(("op", op.name),
+                                 ("reason", "dcn_no_host_shape")))
         if op in (operation.bcast, operation.reduce) \
                 and nbytes > cfg.max_eager_size:
             return Algorithm.TREE
@@ -240,9 +281,15 @@ def _select(
             nbytes = cmatmul_wire_bytes(op, nbytes, cfg, count)
         if pallas_at is not None and nbytes >= pallas_at:
             return Algorithm.PALLAS
-    if op == operation.allreduce and nbytes >= cfg.hier_threshold \
-            and _hier_shape(comm, on_dcn) is not None:
-        return Algorithm.HIERARCHICAL
+    if op == operation.allreduce and nbytes >= cfg.hier_threshold:
+        if _hier_shape(comm, on_dcn) is not None:
+            return Algorithm.HIERARCHICAL
+        # same visibility for the generic engage point: a prime world
+        # (no 2-D split) or a non-host-aligned DCN mesh declines here
+        _metrics.inc("accl_select_decline_total",
+                     labels=(("op", op.name),
+                             ("reason", "dcn_no_host_shape" if on_dcn
+                              else "no_2d_shape")))
     if op == operation.allreduce and nbytes >= cfg.ring_threshold:
         return Algorithm.RING
     if op == operation.allgather and nbytes >= cfg.ag_ring_threshold:
@@ -356,12 +403,37 @@ def build_reduce(comm, root: int, func: reduceFunction, dt: dataType,
     return primitives.build_reduce(comm, root, func, dt, arith)
 
 
+def _multiaxis_shape(comm, mesh_shape) -> tuple:
+    """(rows, cols) for an explicit/resolved MULTIAXIS build: the caller
+    passes the synthesizer's resolved torus shape when it has one; a
+    direct build without one falls back to the most-square split (the
+    ``_hier_shape`` discipline for explicit requests) and fails loudly
+    on prime worlds."""
+    if mesh_shape is not None:
+        rows, cols = int(mesh_shape[0]), int(mesh_shape[1])
+        if rows * cols != comm.world_size:
+            raise ValueError(
+                f"mesh_shape {rows}x{cols} != world {comm.world_size}")
+        return rows, cols
+    shape = hierarchical.factor2d(comm.world_size)
+    if shape is None:
+        raise ValueError(
+            "multiaxis collective needs a composite world with a 2-D "
+            f"torus factorization, got world={comm.world_size}")
+    return shape
+
+
 def build_allreduce(comm, func: reduceFunction, dt: dataType, algo: Algorithm,
                     arith: Optional[ArithConfig],
                     segment_bytes: Optional[int] = None,
                     fanin: int = 0,
                     bidirectional: bool = False,
-                    on_dcn: bool = False) -> Callable:
+                    on_dcn: bool = False,
+                    mesh_shape=None) -> Callable:
+    if algo == Algorithm.MULTIAXIS:
+        rows, cols = _multiaxis_shape(comm, mesh_shape)
+        return synth.build_multiaxis_allreduce(comm, rows, cols, func, dt,
+                                               arith)
     if algo == Algorithm.PALLAS:
         return pallas_ring.build_pallas_ring_allreduce(
             comm, func, dt, segment_bytes, arith=arith,
@@ -506,7 +578,11 @@ def build_allgather(comm, algo: Algorithm,
                     arith: Optional[ArithConfig],
                     dt: dataType,
                     segment_bytes: Optional[int] = None,
-                    bidirectional: bool = False) -> Callable:
+                    bidirectional: bool = False,
+                    mesh_shape=None) -> Callable:
+    if algo == Algorithm.MULTIAXIS:
+        rows, cols = _multiaxis_shape(comm, mesh_shape)
+        return synth.build_multiaxis_allgather(comm, rows, cols, arith)
     if algo == Algorithm.PALLAS:
         return pallas_ring.build_pallas_ring_allgather(
             comm, dt, segment_bytes, arith=arith,
@@ -520,7 +596,12 @@ def build_reduce_scatter(comm, func: reduceFunction, dt: dataType,
                          algo: Algorithm,
                          arith: Optional[ArithConfig],
                          segment_bytes: Optional[int] = None,
-                         bidirectional: bool = False) -> Callable:
+                         bidirectional: bool = False,
+                         mesh_shape=None) -> Callable:
+    if algo == Algorithm.MULTIAXIS:
+        rows, cols = _multiaxis_shape(comm, mesh_shape)
+        return synth.build_multiaxis_reduce_scatter(comm, rows, cols, func,
+                                                    dt, arith)
     if algo == Algorithm.PALLAS:
         return pallas_ring.build_pallas_ring_reduce_scatter(
             comm, func, dt, segment_bytes, arith=arith,

@@ -6,29 +6,65 @@ is tracing+compiling an XLA program. To make host-driven per-op dispatch fast
 ``(op, communicator, shape, dtype, static params)`` — the same role the
 firmware's cached communicator/arithcfg lookups play
 (``ccl_offload_control.c:2330-2360``).
+
+The cache is LRU-bounded (``ACCLConfig.program_cache_size``, generous by
+default): a long-lived serving session resolving many distinct
+(shape, dtype, algorithm) keys must not grow without limit, and an
+eviction storm — the bound set far too low for the workload's working
+set — must be *visible*, not a silent recompile tax. Hits, misses,
+evictions and the live size export through ``accl_tpu.obs.metrics``
+(``accl_program_cache_total{event}`` + the ``accl_program_cache_size``
+gauge) beside the ``stats()`` fields that have always been there.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Hashable, Tuple
+from collections import OrderedDict
+from typing import Callable, Hashable, Tuple
+
+from ..obs import metrics as _metrics
+
+_L_HIT = (("event", "hit"),)
+_L_MISS = (("event", "miss"),)
+_L_EVICT = (("event", "evict"),)
 
 
 class ProgramCache:
-    """Key -> jitted callable, with hit/miss counters for observability."""
+    """Key -> jitted callable, LRU-bounded, with hit/miss/eviction
+    counters for observability. ``maxsize <= 0`` disables the bound."""
 
-    def __init__(self):
-        self._cache: Dict[Hashable, Callable] = {}
+    def __init__(self, maxsize: int = 0):
+        self._cache: "OrderedDict[Hashable, Callable]" = OrderedDict()
+        self.maxsize = int(maxsize)
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
 
     def get(self, key: Hashable, builder: Callable[[], Callable]) -> Callable:
         fn = self._cache.get(key)
         if fn is None:
             self.misses += 1
+            _metrics.inc("accl_program_cache_total", labels=_L_MISS)
             fn = builder()
             self._cache[key] = fn
+            self._evict()
         else:
             self.hits += 1
+            _metrics.inc("accl_program_cache_total", labels=_L_HIT)
+            self._cache.move_to_end(key)
+        _metrics.set_gauge("accl_program_cache_size", len(self._cache))
         return fn
+
+    def _evict(self) -> None:
+        while self.maxsize > 0 and len(self._cache) > self.maxsize:
+            self._cache.popitem(last=False)
+            self.evictions += 1
+            _metrics.inc("accl_program_cache_total", labels=_L_EVICT)
+
+    def set_maxsize(self, maxsize: int) -> None:
+        """Config write-through: apply a new LRU bound (shrinking evicts
+        oldest-used programs immediately)."""
+        self.maxsize = int(maxsize)
+        self._evict()
 
     def clear(self) -> None:
         self._cache.clear()

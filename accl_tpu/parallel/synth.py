@@ -1,0 +1,880 @@
+"""Topology-aware schedule synthesis — cost-model search over the torus.
+
+``select()`` used to be a pile of ~15 hand-tuned scalar byte thresholds,
+and every bandwidth algorithm ran on ONE flat logical ring in rank order
+— which ignores that a v5e 2x4 (and any multi-pod slice) is a torus with
+independent link budgets per axis. This module replaces the guesswork
+for the bandwidth collectives (allreduce / allgather / reduce_scatter)
+with schedule *synthesis* in the style of "Synthesizing Optimal
+Collective Algorithms" (arxiv 2008.08708):
+
+* an **α-β cost model** per (op, topology, payload bytes, wire dtype):
+  each schedule step costs ``α·hops + link_bytes/(channels·β)`` where
+  ``link_bytes`` is the traffic through the *busiest link* of that step
+  and ``channels`` counts concurrently driven link directions
+  (counter-rotating rings double them);
+* **candidate generators** covering the whole historical family — flat
+  star, binary tree, single ring, k-concurrent counter-rotating rings,
+  the two-tier hierarchical split — plus the **multi-axis torus
+  decomposition** (axis-by-axis reduce-scatter → all-gather, the
+  closed-form-optimal shape of "Near-Optimal Wafer-Scale Reduce",
+  arxiv 2404.15888), which drives BOTH torus axes instead of one
+  logical ring and strictly lowers both the hop count (Σ(sᵢ−1) vs P−1
+  per leg) and the busiest-link bytes (the heavy leg moves
+  N·(s₀−1)/s₀ < N·(P−1)/P);
+* a :class:`SchedulePlan` object that :func:`resolve` synthesizes per
+  (op, topology, size-bucket) and caches — ONE plan object instead of N
+  scalars.  The legacy scalar thresholds are honored as **explicit
+  overrides**: a register that differs from its dataclass default (an
+  autotune seed or an operator's hand tune) pins the legacy decision
+  for the ops it governs, so existing tuned deployments keep resolving
+  exactly as before.
+
+Winning multi-step schedules compile into ONE cached XLA program (the
+:mod:`accl_tpu.cmdlist` "one launch per sequence" discipline): the
+multi-axis builders below trace every phase into a single ``shard_map``
+program over the communicator's 2-D mesh, so a whole synthesized
+collective launches as one unit and caches in the ProgramCache /
+CommandList composite like any other per-op program.
+
+Every candidate a generator emits is checkable: :func:`validate_plan`
+runs an ownership algebra over the step DAG proving each (chunk, rank)
+is covered exactly once, the dependencies are acyclic, and the per-axis
+hop counts match what the cost model charged.  See
+``docs/scheduling.md`` for the full model and migration story.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..config import ACCLConfig, Algorithm, TransportBackend
+from ..constants import dataType, operation, reduceFunction
+from ..obs import metrics as _metrics
+
+#: ops the synthesizer owns — the bandwidth collectives whose payload
+#: admits a chunk decomposition. Everything else keeps the legacy ladder.
+SYNTH_OPS = (operation.allreduce, operation.allgather,
+             operation.reduce_scatter)
+
+#: candidate shape names (the ``shape`` label of the plan counters)
+SHAPES = ("xla", "flat", "tree", "ring", "kring", "multiaxis", "hier")
+
+
+# ---------------------------------------------------------------------------
+# topology
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """What the synthesizer knows about the mesh: per-axis sizes (product
+    == world; a single entry means "no torus structure known"), the
+    transport the links ride, and whether both link directions are
+    drivable concurrently (counter-rotating rings)."""
+
+    axes: Tuple[int, ...]
+    transport: TransportBackend
+    bidirectional: bool
+
+    @property
+    def world(self) -> int:
+        p = 1
+        for s in self.axes:
+            p *= s
+        return p
+
+    @property
+    def multi_axis(self) -> bool:
+        return len(self.axes) >= 2
+
+
+def _coords_shape(devices) -> Optional[Tuple[int, int]]:
+    """(rows, cols) from TPU chip coordinates when the devices form a
+    full rectangular grid with >1 extent on exactly the x and one other
+    axis; None otherwise (CPU emulator devices carry no coords). cols is
+    the x extent — the fastest-varying coordinate under snake rank
+    order, so ``mesh2d(rows, cols)`` rows are physical x-runs."""
+    coords = []
+    for d in devices:
+        c = getattr(d, "coords", None)
+        if c is None:
+            return None
+        coords.append((tuple(c) + (0, 0, 0))[:3])
+    if len(set(coords)) != len(coords):
+        return None  # multiple cores per chip: grid accounting is off
+    ext = [len({c[i] for c in coords}) for i in range(3)]
+    if ext[0] * ext[1] * ext[2] != len(coords):
+        return None  # not a full rectangular grid
+    if ext[0] < 2 or sum(1 for e in ext if e > 1) != 2:
+        # a 3-D slice (e.g. v4 2x2x2) has no single second axis whose
+        # rings are physical links — collapsing y·z into "rows" would
+        # break the cost model's independent-link-budget premise
+        return None
+    cols = ext[0]
+    rows = len(coords) // cols
+    return (rows, cols)
+
+
+_COORDS_UNSET = object()
+
+
+def _coords_shape_cached(comm) -> Optional[Tuple[int, int]]:
+    """Per-communicator memo of :func:`_coords_shape` — the device list
+    is immutable after construction and the scan is O(world), but
+    ``resolve()`` runs on the per-op host dispatch path."""
+    cached = getattr(comm, "_synth_coords_shape", _COORDS_UNSET)
+    if cached is _COORDS_UNSET:
+        cached = _coords_shape(getattr(comm, "_devices", None)
+                               or comm.devices)
+        try:
+            comm._synth_coords_shape = cached
+        except AttributeError:
+            pass  # exotic comm without a writable __dict__: just rescan
+    return cached
+
+
+def torus_shape(comm, cfg: ACCLConfig,
+                allow_factor2d: bool = False) -> Optional[Tuple[int, int]]:
+    """The (rows, cols) torus factorization the multi-axis builders run
+    on: an explicit ``cfg.sched_mesh_shape`` wins (the emulated-topology
+    declaration), else the device-coordinate grid, else — only for
+    EXPLICIT ``Algorithm.MULTIAXIS`` requests (``allow_factor2d``) — the
+    most-square factorization, mirroring ``_hier_shape``'s fallback.
+    AUTO never invents a torus: with neither declaration nor coords the
+    mesh is treated as single-axis and the legacy ladder stands."""
+    ms = cfg.sched_mesh_shape
+    if ms:
+        rows, cols = int(ms[0]), int(ms[1])
+        if rows * cols == comm.world_size:
+            return (rows, cols)
+        if getattr(comm, "parent", None) is None:
+            # the declaration targets this (top-level) comm and is wrong:
+            # fail loudly rather than silently running single-axis
+            raise ValueError(
+                f"sched_mesh_shape {rows}x{cols} != world {comm.world_size}")
+        # a sub-communicator: the declaration describes the GLOBAL mesh,
+        # not this group — fall through to coords / single-axis
+    shape = _coords_shape_cached(comm)
+    if shape is not None:
+        return shape
+    if allow_factor2d:
+        from .hierarchical import factor2d
+        return factor2d(comm.world_size)
+    return None
+
+
+def topology_of(comm, cfg: ACCLConfig) -> Topology:
+    """Resolve the mesh's :class:`Topology` for plan synthesis."""
+    transport = cfg.transport or TransportBackend.SIM
+    shape = torus_shape(comm, cfg)
+    axes = tuple(shape) if shape is not None else (comm.world_size,)
+    return Topology(axes=axes, transport=transport,
+                    bidirectional=bool(cfg.bidirectional_rings))
+
+
+# ---------------------------------------------------------------------------
+# α-β cost model
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class CostModel:
+    """Per-transport α-β parameters: ``alpha_us`` is one hop's fixed
+    latency (launch + link), ``beta_gbps`` one link direction's
+    bandwidth. Seeded from config (autotune calibrates them on the live
+    mesh — ``bench.autotune_sched_synth``)."""
+
+    alpha_us: float
+    beta_gbps: float
+
+    @classmethod
+    def from_config(cls, cfg: ACCLConfig,
+                    transport: TransportBackend) -> "CostModel":
+        if transport == TransportBackend.DCN:
+            return cls(alpha_us=cfg.sched_dcn_alpha_us,
+                       beta_gbps=cfg.sched_dcn_beta_gbps)
+        return cls(alpha_us=cfg.sched_alpha_us,
+                   beta_gbps=cfg.sched_beta_gbps)
+
+    def step_us(self, hops: int, link_bytes: float, channels: int) -> float:
+        bw = link_bytes / (max(channels, 1) * self.beta_gbps * 1e3)
+        return self.alpha_us * hops + bw
+
+
+def _ceil_log2(n: int) -> int:
+    return max(1, math.ceil(math.log2(n))) if n > 1 else 0
+
+
+# ---------------------------------------------------------------------------
+# schedule plans
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ScheduleStep:
+    """One phase of a synthesized schedule.
+
+    ``axis`` indexes ``Topology.axes`` (None = the whole communicator as
+    one logical group — flat star / tree / single ring). ``hops`` is the
+    per-rank sequential hop count the cost model charges; ``link_bytes``
+    the traffic through the busiest link; ``channels`` the concurrently
+    driven link directions. ``deps`` are indices of steps that must
+    complete first."""
+
+    index: int
+    kind: str                    # reduce_scatter | all_gather | allreduce
+    #                            # | reduce | bcast
+    axis: Optional[int]
+    group: int                   # participating group size
+    hops: int
+    link_bytes: float
+    channels: int
+    deps: Tuple[int, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulePlan:
+    """A synthesized collective schedule: the step DAG, its predicted
+    α-β cost, the Algorithm family that executes it, and where the
+    decision came from (``cost_model`` — the search picked it;
+    ``override`` — a non-default legacy register pinned the legacy
+    choice; ``legacy`` — synthesis disabled / single-axis / DCN)."""
+
+    op: operation
+    shape: str
+    algorithm: Algorithm
+    topology: Topology
+    steps: Tuple[ScheduleStep, ...]
+    predicted_us: float
+    source: str
+    params: Tuple[Tuple[str, object], ...] = ()
+
+    def param(self, key: str, default=None):
+        for k, v in self.params:
+            if k == key:
+                return v
+        return default
+
+    def describe(self) -> str:
+        legs = " -> ".join(
+            f"{s.kind}[axis={'*' if s.axis is None else s.axis},"
+            f"g={s.group},h={s.hops}]" for s in self.steps)
+        return (f"{self.op.name}:{self.shape}({self.algorithm.value}) "
+                f"{legs} ~{self.predicted_us:.1f}us [{self.source}]")
+
+
+def _payload_total(op: operation, nbytes: int, world: int) -> int:
+    """Normalize select()'s per-op byte convention to the logical FULL
+    payload N the cost formulas are written in (allreduce: per-rank
+    payload; allgather: per-block bytes -> gathered result;
+    reduce_scatter: total input bytes)."""
+    if op == operation.allgather:
+        return nbytes * world
+    return nbytes
+
+
+def _mk_steps(specs, model: CostModel):
+    steps = []
+    for i, (kind, axis, group, hops, link_bytes, channels) in enumerate(specs):
+        steps.append(ScheduleStep(
+            index=i, kind=kind, axis=axis, group=group, hops=hops,
+            link_bytes=float(link_bytes), channels=channels,
+            deps=(i - 1,) if i else ()))
+    cost = sum(model.step_us(s.hops, s.link_bytes, s.channels)
+               for s in steps)
+    return tuple(steps), cost
+
+
+def _gen_xla(op, topo: Topology, N: int, model: CostModel):
+    """XLA single-shot: the latency-optimal "rendezvous single move" —
+    modeled at log-depth latency with ring-optimal bytes (XLA's own
+    fused schedules). One launch regardless; the step split below is
+    the cost/validation model, not the program structure."""
+    P, k = topo.world, 2 if topo.bidirectional else 1
+    lg, per = _ceil_log2(P), N * (P - 1) / P
+    if op == operation.allreduce:
+        specs = [("reduce_scatter", None, P, lg, per, k),
+                 ("all_gather", None, P, lg, per, k)]
+    elif op == operation.allgather:
+        specs = [("all_gather", None, P, lg, per, k)]
+    else:
+        specs = [("reduce_scatter", None, P, lg, per, k)]
+    steps, cost = _mk_steps(specs, model)
+    return SchedulePlan(op, "xla", Algorithm.XLA, topo, steps, cost, "")
+
+
+def _gen_ring(op, topo: Topology, N: int, model: CostModel,
+              channels: int, shape: str, algorithm: Algorithm):
+    """Single logical ring (channels=1) or k counter-rotating rings
+    (channels=2: every link direction busy, per-direction bytes
+    halved). The flat-ring path the multi-axis schedule A/Bs against."""
+    P = topo.world
+    per = N * (P - 1) / P
+    if op == operation.allreduce:
+        specs = [("reduce_scatter", None, P, P - 1, per, channels),
+                 ("all_gather", None, P, P - 1, per, channels)]
+    elif op == operation.allgather:
+        specs = [("all_gather", None, P, P - 1, per, channels)]
+    else:
+        specs = [("reduce_scatter", None, P, P - 1, per, channels)]
+    steps, cost = _mk_steps(specs, model)
+    return SchedulePlan(op, shape, algorithm, topo, steps, cost, "")
+
+
+def _gen_tree(op, topo: Topology, N: int, model: CostModel):
+    """Binary tree (recursive doubling): log-depth, full payload per
+    round — the latency family for rooted rendezvous, kept in the
+    candidate space for completeness (allreduce only)."""
+    if op != operation.allreduce:
+        return None
+    P, k = topo.world, 2 if topo.bidirectional else 1
+    lg = _ceil_log2(P)
+    specs = [("reduce", None, P, lg, N * lg, k),
+             ("bcast", None, P, lg, N * lg, k)]
+    steps, cost = _mk_steps(specs, model)
+    return SchedulePlan(op, "tree", Algorithm.TREE, topo, steps, cost, "")
+
+
+def _gen_flat(op, topo: Topology, N: int, model: CostModel):
+    """Flat star (root fan-in/out): 2 hops, root links carry (P-1)·N."""
+    if op != operation.allreduce:
+        return None
+    P = topo.world
+    specs = [("reduce", None, P, 1, N * (P - 1), 1),
+             ("bcast", None, P, 1, N * (P - 1), 1)]
+    steps, cost = _mk_steps(specs, model)
+    return SchedulePlan(op, "flat", Algorithm.FLAT, topo, steps, cost, "")
+
+
+def _gen_multiaxis(op, topo: Topology, N: int, model: CostModel):
+    """Axis-by-axis torus decomposition (arxiv 2404.15888): reduce-
+    scatter down every axis in order (payload shrinking by sᵢ each
+    leg), then all-gather back up in reverse — allreduce composes both
+    sweeps, allgather/reduce_scatter take one.  Per-axis leg i moves
+    Mᵢ·(sᵢ−1)/sᵢ through that AXIS's links only — the busiest link
+    carries N·(s₀−1)/s₀ < N·(P−1)/P of the flat ring, and the hop count
+    is Σ(sᵢ−1) < P−1."""
+    if not topo.multi_axis:
+        return None
+    k = 2 if topo.bidirectional else 1
+    rs_specs, ag_specs = [], []
+    m = float(N)
+    # scatter the LAST axis first (the builders' column axis — the heavy
+    # leg shrinks the payload fastest), gather back in reverse
+    for ax in reversed(range(len(topo.axes))):
+        s = topo.axes[ax]
+        rs_specs.append(("reduce_scatter", ax, s, s - 1,
+                         m * (s - 1) / s, k))
+        m /= s
+    for ax, s in enumerate(topo.axes):
+        ag_specs.append(("all_gather", ax, s, s - 1, m * (s - 1), k))
+        m *= s
+    if op == operation.allreduce:
+        specs = rs_specs + ag_specs
+    elif op == operation.allgather:
+        specs = ag_specs
+    else:
+        specs = rs_specs
+    steps, cost = _mk_steps(specs, model)
+    return SchedulePlan(
+        op, "multiaxis", Algorithm.MULTIAXIS, topo, steps, cost, "",
+        params=(("shape2d", tuple(topo.axes)),))
+
+
+def _gen_hier(op, topo: Topology, N: int, model: CostModel):
+    """The existing two-tier split (row reduce-scatter, cross-axis
+    allreduce on the shard, row all-gather) — kept as its own candidate
+    so the search covers the historical family."""
+    if op != operation.allreduce or len(topo.axes) != 2:
+        return None
+    rows, cols = topo.axes
+    k = 2 if topo.bidirectional else 1
+    m = N / cols
+    lg = _ceil_log2(rows)
+    specs = [("reduce_scatter", 1, cols, cols - 1, N * (cols - 1) / cols, k),
+             ("allreduce", 0, rows, 2 * lg, 2 * m * (rows - 1) / rows, k),
+             ("all_gather", 1, cols, cols - 1, N * (cols - 1) / cols, k)]
+    steps, cost = _mk_steps(specs, model)
+    return SchedulePlan(op, "hier", Algorithm.HIERARCHICAL, topo, steps,
+                        cost, "")
+
+
+def candidates(op: operation, topo: Topology, nbytes: int,
+               cfg: ACCLConfig) -> List[SchedulePlan]:
+    """The full candidate space for one (op, topology, payload):
+    every applicable generator's plan, cost-annotated."""
+    model = CostModel.from_config(cfg, topo.transport)
+    N = _payload_total(op, nbytes, topo.world)
+    out = [_gen_xla(op, topo, N, model),
+           _gen_multiaxis(op, topo, N, model),
+           _gen_hier(op, topo, N, model),
+           _gen_ring(op, topo, N, model, 1, "ring", Algorithm.RING),
+           (_gen_ring(op, topo, N, model, 2, "kring", Algorithm.RING)
+            if topo.world >= 4 else None),
+           _gen_tree(op, topo, N, model),
+           _gen_flat(op, topo, N, model)]
+    return [p for p in out if p is not None]
+
+
+def _plan_for_algo(algo: Algorithm, op: operation, topo: Topology,
+                   nbytes: int, cfg: ACCLConfig) -> SchedulePlan:
+    """The plan describing what a LEGACY Algorithm choice executes —
+    used when an override or disabled synthesis pins the old decision,
+    so the observability tier still names the shape that ran."""
+    model = CostModel.from_config(cfg, topo.transport)
+    N = _payload_total(op, nbytes, topo.world)
+    kring = topo.bidirectional and topo.world >= 4
+    if algo in (Algorithm.RING, Algorithm.PALLAS):
+        p = _gen_ring(op, topo, N, model, 2 if kring else 1,
+                      "kring" if kring else "ring", algo)
+    elif algo == Algorithm.HIERARCHICAL:
+        t2 = topo if len(topo.axes) == 2 else None
+        if t2 is None:
+            from .hierarchical import factor2d
+            shape = factor2d(topo.world)
+            t2 = dataclasses.replace(topo, axes=tuple(shape)) if shape \
+                else None
+        p = _gen_hier(op, t2, N, model) if t2 is not None else None
+        if p is None:
+            p = _gen_xla(op, topo, N, model)
+            p = dataclasses.replace(p, algorithm=algo)
+    elif algo == Algorithm.TREE:
+        p = _gen_tree(op, topo, N, model) or _gen_xla(op, topo, N, model)
+    elif algo == Algorithm.FLAT:
+        p = _gen_flat(op, topo, N, model) or _gen_xla(op, topo, N, model)
+    elif algo == Algorithm.MULTIAXIS:
+        p = _gen_multiaxis(op, topo, N, model)
+        if p is None:
+            raise ValueError(
+                "MULTIAXIS needs a multi-axis topology (declare "
+                "cfg.sched_mesh_shape or run on a coordinate grid)")
+    else:
+        p = dataclasses.replace(_gen_xla(op, topo, N, model), algorithm=algo)
+    return p
+
+
+# ---------------------------------------------------------------------------
+# plan resolution (cached; the select() hook)
+# ---------------------------------------------------------------------------
+
+#: non-default values in these registers are autotune seeds / operator
+#: hand tunes — they PIN the legacy decision for the op they govern
+#: (the override/migration contract; see docs/scheduling.md)
+_SEED_FIELDS: Dict[operation, Tuple[str, ...]] = {
+    operation.allreduce: ("ring_threshold", "hier_threshold",
+                          "dcn_hier_threshold", "pallas_threshold"),
+    operation.allgather: ("ag_ring_threshold", "ag_pallas_threshold"),
+    operation.reduce_scatter: ("rs_ring_threshold", "rs_pallas_threshold"),
+}
+
+_CFG_DEFAULTS = None
+
+
+def _seed_overridden(op: operation, cfg: ACCLConfig) -> bool:
+    global _CFG_DEFAULTS
+    if _CFG_DEFAULTS is None:
+        _CFG_DEFAULTS = ACCLConfig()
+    return any(getattr(cfg, f) != getattr(_CFG_DEFAULTS, f)
+               for f in _SEED_FIELDS.get(op, ()))
+
+
+_plan_cache: Dict[tuple, SchedulePlan] = {}
+_plan_lock = threading.Lock()
+
+
+def reset_plan_cache() -> None:
+    """Session hook (``ACCL.initialize()``): drop every cached plan so a
+    fresh session re-synthesizes under its own config."""
+    with _plan_lock:
+        _plan_cache.clear()
+
+
+def plan_cache_stats() -> Tuple[int, ...]:
+    with _plan_lock:
+        return (len(_plan_cache),)
+
+
+def _cost_fingerprint(cfg: ACCLConfig) -> tuple:
+    return (cfg.sched_synthesis, cfg.sched_alpha_us, cfg.sched_beta_gbps,
+            cfg.sched_dcn_alpha_us, cfg.sched_dcn_beta_gbps)
+
+
+def resolve(op: operation, nbytes: int, comm, cfg: ACCLConfig,
+            legacy: Algorithm, count: Optional[int] = None) -> SchedulePlan:
+    """Resolve THE schedule plan for one call — the cost-model search,
+    memoized per (op, topology, size-bucket, legacy decision, cost
+    params).  ``legacy`` is what the scalar-threshold ladder chose; the
+    plan deviates from it only when
+
+    * synthesis is enabled (``cfg.sched_synthesis``),
+    * the topology has ≥ 2 axes (declared or coordinate-detected) on a
+      single-slice transport (the DCN two-tier story stays with the
+      host-aligned hierarchical path),
+    * no governing legacy register carries an autotune seed
+      (:data:`_SEED_FIELDS` — seeds are explicit overrides), and
+    * the multi-axis candidate's predicted α-β cost beats the legacy
+      family's.
+
+    Everything else returns the legacy decision wrapped in its plan —
+    so single-axis meshes with default config resolve EXACTLY as before
+    the refactor (pinned by tests/test_synth.py equivalence tests)."""
+    topo = topology_of(comm, cfg)
+    # the governing legacy registers are part of the key: a seeded config
+    # must never hit a default-config plan (and vice versa) even when
+    # both ladders happened to pick the same legacy algorithm
+    seeds = tuple(getattr(cfg, f) for f in _SEED_FIELDS.get(op, ()))
+    key = (op, topo, _metrics.size_bucket(nbytes), legacy, seeds,
+           _cost_fingerprint(cfg))
+    with _plan_lock:
+        plan = _plan_cache.get(key)
+    if plan is not None:
+        _metrics.inc("accl_sched_plan_cache_total",
+                     labels=(("event", "hit"),))
+        return plan
+    _metrics.inc("accl_sched_plan_cache_total", labels=(("event", "miss"),))
+
+    if (not cfg.sched_synthesis or not topo.multi_axis
+            or topo.transport == TransportBackend.DCN
+            or op not in SYNTH_OPS):
+        plan = dataclasses.replace(
+            _plan_for_algo(legacy, op, topo, nbytes, cfg), source="legacy")
+    elif _seed_overridden(op, cfg):
+        plan = dataclasses.replace(
+            _plan_for_algo(legacy, op, topo, nbytes, cfg), source="override")
+    else:
+        legacy_plan = _plan_for_algo(legacy, op, topo, nbytes, cfg)
+        multi = _gen_multiaxis(
+            op, topo, _payload_total(op, nbytes, topo.world),
+            CostModel.from_config(cfg, topo.transport))
+        if (multi is not None and len(topo.axes) == 2
+                and multi.predicted_us < legacy_plan.predicted_us):
+            plan = dataclasses.replace(multi, source="cost_model")
+        else:
+            plan = dataclasses.replace(legacy_plan, source="cost_model")
+    _metrics.inc("accl_sched_plan_total",
+                 labels=(("op", op.name), ("shape", plan.shape),
+                         ("source", plan.source)))
+    with _plan_lock:
+        _plan_cache[key] = plan
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# schedule validation: the ownership algebra
+# ---------------------------------------------------------------------------
+
+def _rank_coords(rank: int, axes: Sequence[int]) -> Tuple[int, ...]:
+    out = []
+    for s in reversed(axes):
+        out.append(rank % s)
+        rank //= s
+    return tuple(reversed(out))
+
+
+def _axis_groups(axes: Sequence[int], axis: Optional[int],
+                 world: int) -> List[List[int]]:
+    if axis is None:
+        return [list(range(world))]
+    groups: Dict[tuple, List[int]] = {}
+    for r in range(world):
+        c = list(_rank_coords(r, axes))
+        c[axis] = -1
+        groups.setdefault(tuple(c), []).append(r)
+    return list(groups.values())
+
+
+def _expected_hops(shape: str, kind: str, group: int) -> int:
+    """What the cost model must have charged for one step of this shape
+    — the validator's independent recomputation."""
+    if shape in ("ring", "kring", "multiaxis"):
+        return group - 1
+    if shape == "flat":
+        return 1
+    if shape == "hier":
+        return (2 * _ceil_log2(group) if kind == "allreduce"
+                else group - 1)
+    # xla / tree: log-depth
+    return _ceil_log2(group)
+
+
+def validate_plan(plan: SchedulePlan) -> None:
+    """Prove a synthesized schedule correct by construction:
+
+    1. the step dependency graph is acyclic (a topological order
+       exists and every dep precedes its step);
+    2. running the ownership algebra over the steps covers each
+       (chunk, rank) requirement EXACTLY once — no chunk is delivered
+       twice, no contribution is folded twice, and the final state
+       matches the op's contract;
+    3. every step's hop count matches the cost model's charge for its
+       shape (α drift is a bug, not a tuning artifact).
+
+    Raises ``ValueError`` with a specific message on any violation."""
+    topo, P = plan.topology, plan.topology.world
+    axes = topo.axes
+
+    # -- 1. dependency DAG ------------------------------------------------
+    order: List[int] = []
+    done: set = set()
+    pending = {s.index: set(s.deps) for s in plan.steps}
+    while pending:
+        ready = [i for i, d in pending.items() if d <= done]
+        if not ready:
+            raise ValueError(f"cyclic step dependencies: {pending}")
+        for i in sorted(ready):
+            order.append(i)
+            done.add(i)
+            del pending[i]
+    steps = {s.index: s for s in plan.steps}
+
+    # -- 3. hop counts ----------------------------------------------------
+    for s in plan.steps:
+        want = _expected_hops(plan.shape, s.kind, s.group)
+        if s.hops != want:
+            raise ValueError(
+                f"step {s.index} ({plan.shape}/{s.kind}, group {s.group}): "
+                f"hops {s.hops} != cost-model {want}")
+
+    # -- 2. ownership algebra --------------------------------------------
+    # state[r] maps chunk -> (frozenset of folded source ranks, times the
+    # fully-formed chunk was DELIVERED to r). Chunks are the P-way
+    # decomposition; owner(chunk c) == rank c (the flat convention the
+    # multi-axis builders realign to).
+    gatherish = plan.op == operation.allgather
+    state: List[Dict[int, Tuple[frozenset, int]]] = []
+    for r in range(P):
+        if gatherish:
+            state.append({r: (frozenset([r]), 1)})
+        else:
+            state.append({c: (frozenset([r]), 1) for c in range(P)})
+
+    def fold(group: List[int], keep: Callable[[int, int], bool]) -> None:
+        """Reduce-flavored exchange over `group`: every live chunk's
+        contributions union across the group; member g keeps chunk c
+        iff keep(g, c). A source contributing twice is a double fold."""
+        live = sorted({c for g in group for c in state[g]})
+        merged = {}
+        for c in live:
+            srcs: List[frozenset] = [state[g][c][0]
+                                     for g in group if c in state[g]]
+            union = frozenset().union(*srcs)
+            if sum(len(s) for s in srcs) != len(union):
+                raise ValueError(
+                    f"chunk {c}: a source contribution folded twice "
+                    f"in group {group}")
+            merged[c] = union
+        for g in group:
+            state[g] = {c: (merged[c], 1) for c in live if keep(g, c)}
+
+    def gather(group: List[int]) -> None:
+        """All-gather over `group`: every member's chunks delivered to
+        every other member; receiving a chunk twice (or already holding
+        it) is double coverage."""
+        owners: Dict[int, List[int]] = {}
+        for g in group:
+            for c in state[g]:
+                owners.setdefault(c, []).append(g)
+        for c, who in owners.items():
+            if len(who) > 1:
+                raise ValueError(
+                    f"chunk {c} owned by {who} before all_gather: "
+                    f"would be delivered {len(who)} times")
+        for c, who in owners.items():
+            src = who[0]
+            val = state[src][c]
+            for g in group:
+                if g == src:
+                    continue
+                if c in state[g]:
+                    raise ValueError(
+                        f"chunk {c} re-delivered to rank {g}")
+                state[g][c] = (val[0], 1)
+
+    processed_axes: List[int] = []
+    for i in order:
+        s = steps[i]
+        groups = _axis_groups(axes, s.axis, P)
+        if s.kind == "reduce_scatter":
+            if s.axis is not None:
+                processed_axes.append(s.axis)
+            scattered = list(processed_axes)
+
+            def keep(g, c, scattered=scattered, axis=s.axis):
+                if axis is None:
+                    return c == g
+                gc, cc = _rank_coords(g, axes), _rank_coords(c, axes)
+                return all(gc[a] == cc[a] for a in scattered)
+
+            for grp in groups:
+                fold(grp, keep)
+        elif s.kind == "all_gather":
+            for grp in groups:
+                gather(grp)
+        elif s.kind == "allreduce":
+            for grp in groups:
+                fold(grp, lambda g, c: True)
+        elif s.kind == "reduce":
+            for grp in groups:
+                root = grp[0]
+                fold(grp, lambda g, c, root=root: g == root)
+        elif s.kind == "bcast":
+            for grp in groups:
+                gather(grp)
+        else:
+            raise ValueError(f"unknown step kind {s.kind!r}")
+
+    full = frozenset(range(P))
+    for r in range(P):
+        if plan.op == operation.allreduce:
+            want = set(range(P))
+        elif plan.op == operation.allgather:
+            want = set(range(P))
+        else:
+            want = {r}
+        have = set(state[r])
+        if have != want:
+            raise ValueError(
+                f"rank {r}: final chunks {sorted(have)} != "
+                f"required {sorted(want)}")
+        for c, (srcs, deliveries) in state[r].items():
+            if not gatherish and srcs != full:
+                raise ValueError(
+                    f"rank {r} chunk {c}: contributions {sorted(srcs)} "
+                    f"incomplete")
+            if deliveries != 1:
+                raise ValueError(
+                    f"rank {r} chunk {c}: delivered {deliveries} times")
+
+
+# ---------------------------------------------------------------------------
+# multi-axis program builders — the whole synthesized schedule traced
+# into ONE shard_map program (the cmdlist one-launch discipline)
+# ---------------------------------------------------------------------------
+
+def build_multiaxis_allreduce(comm, rows: int, cols: int,
+                              func: reduceFunction, dt: dataType,
+                              arith=None) -> Callable:
+    """Axis-by-axis torus allreduce: reduce-scatter down the column
+    axis, reduce-scatter down the row axis on the shard, then the dual
+    all-gathers back up — four per-axis XLA collectives over the true
+    2-D mesh, compiled as one launch. Per-link traffic N(c−1)/c on the
+    heavy axis (vs N(P−1)/P for the flat ring) at Σ(sᵢ−1) hops per
+    sweep."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .. import ops
+    from .hierarchical import COL_AXIS, ROW_AXIS, _smap2d
+    from .primitives import _unwire, _wire
+
+    if rows * cols != comm.world_size:
+        raise ValueError(f"{rows}x{cols} != world {comm.world_size}")
+    world = rows * cols
+    decompress_arith = (arith is not None and arith.decompress_before_arith)
+
+    def body(v):  # (1, 1, n)
+        n = v.shape[-1]
+        pad = (-n) % world
+        x = jnp.pad(v[0, 0], (0, pad))
+        w = _wire(x, arith)
+        if func == reduceFunction.SUM and not decompress_arith:
+            s1 = lax.psum_scatter(w.reshape(cols, -1), COL_AXIS,
+                                  scatter_dimension=0, tiled=False)
+            s2 = lax.psum_scatter(s1.reshape(rows, -1), ROW_AXIS,
+                                  scatter_dimension=0, tiled=False)
+            g1 = lax.all_gather(s2, ROW_AXIS, tiled=True)
+            full = lax.all_gather(g1, COL_AXIS, tiled=True)
+            out = _unwire(full, arith, v.dtype)
+        elif func == reduceFunction.SUM:
+            # decompress-before-arith wires: every hop carries the wire
+            # dtype, every fold runs at full precision (per-axis
+            # chunk exchange + local fold, the hierarchical discipline)
+            sw = lax.all_to_all(w.reshape(cols, -1), COL_AXIS,
+                                split_axis=0, concat_axis=0)
+            shard = ops.reduce_axis0(_unwire(sw, arith, x.dtype), func, dt)
+            sw2 = lax.all_to_all(_wire(shard, arith).reshape(rows, -1),
+                                 ROW_AXIS, split_axis=0, concat_axis=0)
+            shard2 = ops.reduce_axis0(_unwire(sw2, arith, x.dtype), func, dt)
+            g1 = lax.all_gather(_wire(shard2, arith), ROW_AXIS, tiled=True)
+            full = lax.all_gather(g1, COL_AXIS, tiled=True)
+            out = _unwire(full, arith, v.dtype)
+        elif func == reduceFunction.MAX:
+            # max of wire values == wire of max (monotone cast): exact
+            out = _unwire(lax.pmax(lax.pmax(w, COL_AXIS), ROW_AXIS),
+                          arith, v.dtype)
+        else:
+            raise ValueError(func)
+        return out[:n][None, None, :] if pad else out[None, None, :]
+
+    return _smap2d(comm, rows, cols, body)
+
+
+def build_multiaxis_reduce_scatter(comm, rows: int, cols: int,
+                                   func: reduceFunction, dt: dataType,
+                                   arith=None) -> Callable:
+    """Axis-by-axis reduce-scatter: the input's world chunks are
+    pre-permuted so the two per-axis scatters land rank (r, c) exactly
+    its FLAT chunk r·cols+c — the 1-D convention every caller and the
+    flat-ring path share."""
+    from jax import lax
+
+    from .. import ops
+    from .hierarchical import COL_AXIS, ROW_AXIS, _smap2d
+    from .primitives import _unwire, _wire
+
+    if rows * cols != comm.world_size:
+        raise ValueError(f"{rows}x{cols} != world {comm.world_size}")
+    world = rows * cols
+    decompress_arith = (arith is not None and arith.decompress_before_arith)
+
+    def body(v):  # (1, 1, world*count)
+        x = v[0, 0]
+        count = x.shape[-1] // world
+        # chunk (r, c) of the flat order sits at t[c, r]: after the
+        # column scatter (keep my c) then the row scatter (keep my r),
+        # rank (r, c) holds flat chunk r*cols + c
+        t = x.reshape(rows, cols, count).transpose(1, 0, 2)
+        w = _wire(t, arith)
+        if func == reduceFunction.SUM and not decompress_arith:
+            s1 = lax.psum_scatter(w, COL_AXIS, scatter_dimension=0,
+                                  tiled=False)              # (rows, count)
+            out = lax.psum_scatter(s1, ROW_AXIS, scatter_dimension=0,
+                                   tiled=False)             # (count,)
+            out = _unwire(out, arith, v.dtype)
+        else:
+            # general path (MAX, decompress-before-arith): per-axis
+            # chunk exchange + rank-ordered local fold at full precision
+            sw = lax.all_to_all(w, COL_AXIS, split_axis=0, concat_axis=0)
+            part = ops.reduce_axis0(_unwire(sw, arith, x.dtype), func, dt)
+            sw2 = lax.all_to_all(_wire(part, arith), ROW_AXIS,
+                                 split_axis=0, concat_axis=0)
+            out = ops.reduce_axis0(_unwire(sw2, arith, x.dtype), func, dt)
+            out = out.astype(v.dtype)
+        return out[None, None, :]
+
+    return _smap2d(comm, rows, cols, body)
+
+
+def build_multiaxis_allgather(comm, rows: int, cols: int,
+                              arith=None) -> Callable:
+    """Axis-by-axis all-gather (the reduce-scatter dual): gather up the
+    row axis, then the column axis, then un-permute so the result is in
+    flat chunk order."""
+    from jax import lax
+
+    from .hierarchical import COL_AXIS, ROW_AXIS, _smap2d
+    from .primitives import _unwire, _wire
+
+    if rows * cols != comm.world_size:
+        raise ValueError(f"{rows}x{cols} != world {comm.world_size}")
+
+    def body(v):  # (1, 1, count) -> (1, 1, world*count)
+        x = v[0, 0]
+        g1 = lax.all_gather(_wire(x, arith), ROW_AXIS)     # (rows, count)
+        g2 = lax.all_gather(g1, COL_AXIS)                  # (cols, rows, ·)
+        out = _unwire(g2, arith, v.dtype)
+        # g2[c, r] is rank (r, c)'s chunk = flat chunk r*cols + c
+        out = out.transpose(1, 0, 2).reshape(-1)
+        return out[None, None, :]
+
+    return _smap2d(comm, rows, cols, body)

@@ -67,6 +67,18 @@ _BUDGET_S = float(os.environ.get("ACCL_BENCH_BUDGET_S", "540"))
 #: Chrome-trace JSON (one file per lane) beside the BENCH artifact
 _TRACE_DIR = None
 
+#: every stage name --lanes can select (single-chip lanes included even
+#: on multi-chip rigs: a filter is validated against the catalog, not
+#: against what this world size happens to run)
+KNOWN_LANES = (
+    "sweep", "obs_overhead",
+    "cmatmul_ag", "cmatmul_rs", "cmatmul_dw", "cmatmul_stream",
+    "moe_a2a", "moe_a2a_bwd", "zero_fsdp", "sched_synth",
+    "hp_compression_cast_roundtrip", "combine_pallas_vs_jnp",
+    "flash_attention", "flash_bwd", "cmdlist_chain_combine",
+    "small_op_fused_latency",
+)
+
 
 def _elapsed() -> float:
     return time.perf_counter() - _T0
@@ -198,6 +210,22 @@ def _preflight_backend(deadline_s: float):
 def main(argv=None) -> int:
     args = _parse_args(argv)
     lanes_filter = [s.strip() for s in args.lanes.split(",") if s.strip()]
+
+    # an unknown lane name used to filter to an EMPTY run — minutes of
+    # setup for an artifact measuring nothing. Fail fast, list the menu.
+    unknown = [pat for pat in lanes_filter
+               if not any(name.startswith(pat) or pat.startswith(name)
+                          for name in KNOWN_LANES)]
+    if unknown:
+        msg = (f"unknown lane(s) {', '.join(unknown)}; available: "
+               + ", ".join(KNOWN_LANES))
+        _log(f"--lanes: {msg}")
+        print(json.dumps({"metric": "bench_usage_error",
+                          "value": 0.0, "unit": "none",
+                          "vs_baseline": 0.0, "error": msg,
+                          "elapsed_s": round(_elapsed(), 1),
+                          **_obs_blob()}))
+        return 2
 
     probe_err = _preflight_backend(args.probe_timeout)
     if probe_err:
@@ -389,6 +417,11 @@ def main(argv=None) -> int:
             # ZeRO/FSDP train step vs the flat-ravel baseline schedule
             ("zero_fsdp",
              lambda: _lanes.bench_zero_fsdp(comm, bidirectional=bidir)),
+            # round 12: the synthesized multi-axis torus schedule vs
+            # the flat logical ring (allreduce / reduce_scatter /
+            # all_gather), with the cost model's predictions on record
+            ("sched_synth",
+             lambda: _lanes.bench_sched_synth(comm, cfg=acc.config)),
         ):
             if not _lane_selected(lanes_filter, name):
                 continue

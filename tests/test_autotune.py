@@ -471,3 +471,31 @@ def test_autotune_zero_fsdp_gates(accl):
         assert cfg.zero_overlap == accl.config.zero_overlap
     finally:
         accl.config = orig
+
+
+def test_autotune_sched_synth_gates(accl):
+    """The schedule synthesizer's calibration stage measures only where
+    it would mean something: off ICI (this rung) the config passes
+    through untouched; on ICI a mesh with no declared or detected torus
+    also passes through (AUTO never dispatches the multi-axis plan
+    there, so there is nothing to seed)."""
+    from accl_tpu.config import TransportBackend
+
+    cfg = autotune.autotune_sched_synth(accl)       # SIM transport
+    assert cfg.sched_alpha_us == accl.config.sched_alpha_us
+    assert cfg.sched_synthesis == accl.config.sched_synthesis
+    orig = accl.config
+    try:
+        # ICI but no torus shape: untouched
+        accl.config = accl.config.replace(transport=TransportBackend.ICI)
+        cfg = autotune.autotune_sched_synth(accl)
+        assert cfg.sched_beta_gbps == accl.config.sched_beta_gbps
+        # ICI WITH a declared torus: the fit runs, α/β become measured
+        # values and the go/no-go resolves from a real A/B
+        accl.config = accl.config.replace(
+            transport=TransportBackend.ICI, sched_mesh_shape=[2, 4])
+        cfg = autotune.autotune_sched_synth(accl, pows=(8, 12), reps=1)
+        assert cfg.sched_alpha_us > 0 and cfg.sched_beta_gbps > 0
+        assert isinstance(cfg.sched_synthesis, bool)
+    finally:
+        accl.config = orig

@@ -1,0 +1,570 @@
+"""Topology-aware schedule synthesis (parallel/synth.py): cost-model
+resolution, schedule-validity property tests, multi-axis program parity,
+and the pre-refactor equivalence pins.
+
+Three layers:
+
+* **plan layer** — every candidate the generators emit passes the
+  ownership-algebra validator (each (chunk, rank) covered exactly once,
+  acyclic deps, hop counts matching the cost model), and corrupted
+  plans are rejected;
+* **resolution layer** — on an emulated 2x4 torus the cost model
+  selects the multi-axis allreduce over the flat logical ring for
+  large payloads, while single-axis meshes with default config resolve
+  EXACTLY as the scalar ladder did before the refactor (the
+  equivalence pins), and autotune-seeded registers stay binding;
+* **program layer** — the multi-axis builders are bit-exact against
+  the flat-ring and XLA paths (integer-valued operands), including the
+  chunk-order realignment of reduce_scatter/allgather, padding, MAX,
+  compressed wires, AUTO end-to-end dispatch and the CommandList
+  one-launch path.
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import accl_tpu
+from accl_tpu import Algorithm, dataType, reduceFunction
+from accl_tpu.config import ACCLConfig, TransportBackend
+from accl_tpu.constants import operation
+from accl_tpu.obs import metrics
+from accl_tpu.parallel import algorithms, synth
+
+WORLD = 8
+
+
+def _counter(key: str) -> float:
+    return metrics.snapshot()["counters"].get(key, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# topology resolution
+# ---------------------------------------------------------------------------
+
+def test_topology_declared_shape(accl):
+    comm = accl.global_comm()
+    cfg = accl.config.replace(sched_mesh_shape=[2, 4])
+    topo = synth.topology_of(comm, cfg)
+    assert topo.axes == (2, 4) and topo.multi_axis and topo.world == WORLD
+    with pytest.raises(ValueError, match="sched_mesh_shape"):
+        synth.torus_shape(comm, accl.config.replace(sched_mesh_shape=[3, 4]))
+
+
+def test_topology_default_single_axis(accl):
+    """The CPU emulator mesh has no chip coords and no declaration:
+    AUTO must never invent a torus (the factor2d fallback is reserved
+    for explicit MULTIAXIS requests)."""
+    comm = accl.global_comm()
+    topo = synth.topology_of(comm, accl.config)
+    assert topo.axes == (WORLD,) and not topo.multi_axis
+    assert synth.torus_shape(comm, accl.config) is None
+    assert synth.torus_shape(comm, accl.config,
+                             allow_factor2d=True) == (2, 4)
+
+
+class _FakeDev:
+    def __init__(self, coords):
+        self.coords = coords
+
+
+def test_coords_shape_detection():
+    """v5e-2x4-shaped coordinate grid -> (rows=2, cols=4); holes, dup
+    cores and 1-D lines stay None."""
+    grid = [_FakeDev((x, y, 0)) for y in range(2) for x in range(4)]
+    assert synth._coords_shape(grid) == (2, 4)
+    line = [_FakeDev((x, 0, 0)) for x in range(8)]
+    assert synth._coords_shape(line) is None
+    assert synth._coords_shape(grid[:-1] + [_FakeDev((0, 0, 0))]) is None
+    assert synth._coords_shape([object()] * 4) is None  # no coords attr
+
+
+def test_coords_shape_rejects_3d_grid():
+    """A v4-style 2x2x2 slice has no single second axis whose rings are
+    physical links — detection must NOT collapse y·z into "rows" (the
+    independent-link-budget premise would be false there)."""
+    cube = [_FakeDev((x, y, z))
+            for z in range(2) for y in range(2) for x in range(2)]
+    assert synth._coords_shape(cube) is None
+    # and a grid whose x extent is 1 can't honor "cols = x extent"
+    wall = [_FakeDev((0, y, z)) for z in range(2) for y in range(4)]
+    assert synth._coords_shape(wall) is None
+
+
+def test_declared_shape_ignored_on_sub_communicator(accl):
+    """cfg.sched_mesh_shape describes the GLOBAL mesh: a split
+    sub-communicator with a different world must fall back to
+    single-axis (legacy ladder), not crash select()."""
+    cfg = accl.config.replace(sched_mesh_shape=[2, 4])
+    sub = accl.global_comm().split([0, 1, 2, 3])
+    assert synth.torus_shape(sub, cfg) is None
+    topo = synth.topology_of(sub, cfg)
+    assert topo.axes == (4,) and not topo.multi_axis
+    # the full dispatch path resolves an algorithm instead of raising
+    algo = algorithms.select(operation.allreduce, 4 << 20, sub, cfg)
+    assert algo != Algorithm.MULTIAXIS
+
+
+# ---------------------------------------------------------------------------
+# plan layer: property tests over the whole candidate space
+# ---------------------------------------------------------------------------
+
+TOPOLOGIES = [(8,), (2, 4), (4, 2), (2, 2, 2), (4, 4), (3,)]
+
+
+@pytest.mark.parametrize("axes", TOPOLOGIES)
+@pytest.mark.parametrize("op", list(synth.SYNTH_OPS))
+@pytest.mark.parametrize("nbytes", [1024, 1 << 22])
+def test_all_candidates_validate(op, axes, nbytes):
+    """Every schedule any generator emits, at every topology and size:
+    (chunk, rank) coverage exactly once, acyclic step deps, per-axis
+    hop counts matching the cost model's charge."""
+    cfg = ACCLConfig()
+    for bidir in (False, True):
+        topo = synth.Topology(axes=tuple(axes),
+                              transport=TransportBackend.SIM,
+                              bidirectional=bidir)
+        cands = synth.candidates(op, topo, nbytes, cfg)
+        assert any(p.shape == "xla" for p in cands)
+        if len(axes) >= 2:
+            assert any(p.shape == "multiaxis" for p in cands)
+        for plan in cands:
+            synth.validate_plan(plan)
+            assert plan.predicted_us > 0
+
+
+def test_validator_rejects_cyclic_deps():
+    topo = synth.Topology((2, 4), TransportBackend.SIM, True)
+    plan = next(p for p in synth.candidates(
+        operation.allreduce, topo, 1 << 20, ACCLConfig())
+        if p.shape == "multiaxis")
+    steps = list(plan.steps)
+    steps[0] = dataclasses.replace(steps[0], deps=(1,))
+    bad = dataclasses.replace(plan, steps=tuple(steps))
+    with pytest.raises(ValueError, match="cyclic"):
+        synth.validate_plan(bad)
+
+
+def test_validator_rejects_hop_drift():
+    """A step charging hops the shape's cost model would not — the α
+    term silently drifting from the schedule — is a hard error."""
+    topo = synth.Topology((2, 4), TransportBackend.SIM, True)
+    plan = next(p for p in synth.candidates(
+        operation.allreduce, topo, 1 << 20, ACCLConfig())
+        if p.shape == "multiaxis")
+    steps = list(plan.steps)
+    steps[1] = dataclasses.replace(steps[1], hops=steps[1].hops + 1)
+    bad = dataclasses.replace(plan, steps=tuple(steps))
+    with pytest.raises(ValueError, match="hops"):
+        synth.validate_plan(bad)
+
+
+def test_validator_rejects_double_delivery():
+    """Re-gathering an already-gathered payload delivers every chunk
+    P times — the 'exactly once' half of the coverage property."""
+    topo = synth.Topology((8,), TransportBackend.SIM, False)
+    plan = next(p for p in synth.candidates(
+        operation.allgather, topo, 4096, ACCLConfig())
+        if p.shape == "ring")
+    s0 = plan.steps[0]
+    dup = dataclasses.replace(s0, index=1, deps=(0,))
+    bad = dataclasses.replace(plan, steps=(s0, dup))
+    with pytest.raises(ValueError, match="all_gather|delivered"):
+        synth.validate_plan(bad)
+
+
+def test_cost_model_ordering():
+    """Sanity of the α-β formulas: the multi-axis schedule beats the
+    flat logical ring at EVERY size on a 2x4 torus (equal wire time,
+    8 vs 14 hop-steps), while XLA's log-depth single shot keeps small
+    payloads; flat star is worst at large payloads."""
+    cfg = ACCLConfig()
+    topo = synth.Topology((2, 4), TransportBackend.SIM, True)
+
+    def cost(shape, nbytes):
+        return next(p for p in synth.candidates(
+            operation.allreduce, topo, nbytes, cfg)
+            if p.shape == shape).predicted_us
+
+    for nbytes in (1024, 1 << 20, 64 << 20):
+        assert cost("multiaxis", nbytes) < cost("kring", nbytes)
+        assert cost("multiaxis", nbytes) < cost("ring", nbytes)
+    assert cost("xla", 1024) < cost("multiaxis", 1024)
+    assert cost("flat", 64 << 20) > cost("ring", 64 << 20)
+
+
+# ---------------------------------------------------------------------------
+# resolution layer
+# ---------------------------------------------------------------------------
+
+#: the pre-refactor select() decision table — single-axis meshes with
+#: default config MUST keep resolving to exactly these (the equivalence
+#: pin of the ISSUE acceptance criteria)
+_EQUIVALENCE = [
+    (TransportBackend.SIM, operation.allreduce, 1024, Algorithm.XLA),
+    (TransportBackend.SIM, operation.allreduce, 4 << 20, Algorithm.RING),
+    (TransportBackend.SIM, operation.allreduce, 16 << 20, Algorithm.RING),
+    (TransportBackend.SIM, operation.allreduce, 64 << 20,
+     Algorithm.HIERARCHICAL),
+    (TransportBackend.SIM, operation.allgather, 1024, Algorithm.XLA),
+    (TransportBackend.SIM, operation.allgather, 4 << 20, Algorithm.RING),
+    (TransportBackend.SIM, operation.reduce_scatter, 1024, Algorithm.XLA),
+    (TransportBackend.SIM, operation.reduce_scatter, 4 << 20,
+     Algorithm.RING),
+    (TransportBackend.ICI, operation.allreduce, 1 << 20, Algorithm.PALLAS),
+    (TransportBackend.ICI, operation.allgather, 1 << 20, Algorithm.PALLAS),
+    (TransportBackend.ICI, operation.reduce_scatter, 8 << 20,
+     Algorithm.PALLAS),
+    (TransportBackend.ICI, operation.allreduce, 1024, Algorithm.XLA),
+    (TransportBackend.DCN, operation.allreduce, 4 << 20, Algorithm.RING),
+]
+
+
+@pytest.mark.parametrize("transport,op,nbytes,want", _EQUIVALENCE)
+def test_single_axis_equivalence_pins(accl, transport, op, nbytes, want):
+    """The refactor contract: with default config on a mesh with no
+    declared/detected torus, select() returns what the scalar ladder
+    alone returned before synthesis existed."""
+    comm = accl.global_comm()
+    cfg = accl.config.replace(transport=transport)
+    assert synth.torus_shape(comm, cfg) is None
+    assert algorithms.select(op, nbytes, comm, cfg) == want
+    # and byte-identical to the ladder itself
+    assert algorithms.select(op, nbytes, comm, cfg) \
+        == algorithms._select_legacy(op, nbytes, comm, cfg)
+
+
+def test_resolve_multiaxis_on_emulated_2x4(accl):
+    """THE acceptance pin: on an emulated 2x4 torus the cost model
+    selects the synthesized multi-axis allreduce over the flat logical
+    ring for every payload the ring used to own."""
+    comm = accl.global_comm()
+    cfg = accl.config.replace(sched_mesh_shape=[2, 4])
+    # the ring window [ring_threshold, hier_threshold) upgrades
+    for nbytes in (4 << 20, 16 << 20, 63 << 20):
+        assert algorithms.select(operation.allreduce, nbytes, comm, cfg) \
+            == Algorithm.MULTIAXIS
+    # small payloads keep XLA's log-depth single shot
+    assert algorithms.select(operation.allreduce, 1024, comm, cfg) \
+        == Algorithm.XLA
+    # the very top of the range ties the two-tier split -> legacy kept
+    assert algorithms.select(operation.allreduce, 128 << 20, comm, cfg) \
+        == Algorithm.HIERARCHICAL
+    # the dual ops ride the same window (per-op byte conventions)
+    assert algorithms.select(operation.allgather, 4 << 20, comm, cfg) \
+        == Algorithm.MULTIAXIS
+    assert algorithms.select(operation.reduce_scatter, 4 << 20, comm, cfg) \
+        == Algorithm.MULTIAXIS
+
+
+def test_resolve_seed_override_pins_legacy(accl):
+    """A register that differs from its default is an autotune seed /
+    operator hand tune: the legacy decision stays binding even on a
+    declared torus (the override/migration contract)."""
+    comm = accl.global_comm()
+    cfg = accl.config.replace(sched_mesh_shape=[2, 4],
+                              ring_threshold=64 * 1024)
+    got = algorithms.select(operation.allreduce, 4 << 20, comm, cfg)
+    assert got == Algorithm.RING
+    legacy = algorithms._select_legacy(operation.allreduce, 4 << 20, comm,
+                                       cfg)
+    plan = synth.resolve(operation.allreduce, 4 << 20, comm, cfg, legacy)
+    assert plan.source == "override" and plan.algorithm == Algorithm.RING
+    # an UNRELATED op's seed does not pin this op
+    cfg2 = accl.config.replace(sched_mesh_shape=[2, 4],
+                               ag_ring_threshold=64 * 1024)
+    assert algorithms.select(operation.allreduce, 4 << 20, comm, cfg2) \
+        == Algorithm.MULTIAXIS
+
+
+def test_resolve_synthesis_off_and_dcn_keep_legacy(accl):
+    comm = accl.global_comm()
+    off = accl.config.replace(sched_mesh_shape=[2, 4],
+                              sched_synthesis=False)
+    assert algorithms.select(operation.allreduce, 8 << 20, comm, off) \
+        == Algorithm.RING
+    # the DCN two-tier story stays with the host-aligned hierarchical
+    # path — synthesis never deviates on DCN transports
+    dcn = accl.config.replace(sched_mesh_shape=[2, 4],
+                              transport=TransportBackend.DCN)
+    legacy = algorithms._select_legacy(operation.allreduce, 8 << 20, comm,
+                                       dcn)
+    plan = synth.resolve(operation.allreduce, 8 << 20, comm, dcn, legacy)
+    assert plan.source == "legacy" and plan.algorithm == legacy
+
+
+def test_resolve_caches_and_counts(accl):
+    """Plans are memoized per (op, topology, size-bucket, legacy, cost
+    params) and the telemetry tier records both the cache traffic and
+    one plan-resolution counter per synthesized plan, keyed by the
+    chosen schedule shape."""
+    comm = accl.global_comm()
+    cfg = accl.config.replace(sched_mesh_shape=[2, 4],
+                              sched_alpha_us=1.0 + 1e-9)  # fresh cache keys
+    hit_k = 'accl_sched_plan_cache_total{event="hit"}'
+    miss_k = 'accl_sched_plan_cache_total{event="miss"}'
+    plan_k = ('accl_sched_plan_total{op="allreduce",shape="multiaxis",'
+              'source="cost_model"}')
+    h0, m0, p0 = _counter(hit_k), _counter(miss_k), _counter(plan_k)
+    legacy = algorithms._select_legacy(operation.allreduce, 8 << 20, comm,
+                                       cfg)
+    p1 = synth.resolve(operation.allreduce, 8 << 20, comm, cfg, legacy)
+    p2 = synth.resolve(operation.allreduce, 8 << 20, comm, cfg, legacy)
+    assert p1 is p2  # the cached object itself
+    assert p1.shape == "multiaxis" and p1.source == "cost_model"
+    assert _counter(miss_k) == m0 + 1
+    assert _counter(hit_k) == h0 + 1
+    assert _counter(plan_k) == p0 + 1  # one per synthesized plan, not per call
+    # the session hook drops the cache (fresh sessions re-synthesize)
+    synth.reset_plan_cache()
+    p3 = synth.resolve(operation.allreduce, 8 << 20, comm, cfg, legacy)
+    assert p3 is not p1 and p3 == p1
+
+
+def test_plan_describe_names_schedule(accl):
+    comm = accl.global_comm()
+    cfg = accl.config.replace(sched_mesh_shape=[2, 4])
+    legacy = algorithms._select_legacy(operation.allreduce, 8 << 20, comm,
+                                       cfg)
+    plan = synth.resolve(operation.allreduce, 8 << 20, comm, cfg, legacy)
+    d = plan.describe()
+    assert "multiaxis" in d and "reduce_scatter" in d and "all_gather" in d
+    assert plan.param("shape2d") == (2, 4)
+
+
+# ---------------------------------------------------------------------------
+# select() decline visibility (satellite)
+# ---------------------------------------------------------------------------
+
+def test_dcn_decline_counted(accl):
+    """The DCN hierarchical early-engage silently fell through when the
+    mesh is not host-aligned; now every decline is counted (op +
+    reason), mirroring the accl_cmatmul_fallback_total discipline."""
+    comm = accl.global_comm()
+    assert comm.hosts_shape() is None
+    dcn = accl.config.replace(transport=TransportBackend.DCN)
+    key = ('accl_select_decline_total{op="allreduce",'
+           'reason="dcn_no_host_shape"}')
+    before = _counter(key)
+    for _ in range(3):
+        got = algorithms.select(operation.allreduce,
+                                dcn.dcn_hier_threshold, comm, dcn)
+        assert got != Algorithm.HIERARCHICAL
+    assert _counter(key) - before == 3.0  # every occurrence, no dedupe
+
+
+def test_prime_world_hier_decline_counted(accl):
+    """The generic hier engage point's decline (no 2-D factorization)
+    is attributable too."""
+    comm = accl.global_comm().split(range(7))
+    key = 'accl_select_decline_total{op="allreduce",reason="no_2d_shape"}'
+    before = _counter(key)
+    got = algorithms.select(operation.allreduce, accl.config.hier_threshold,
+                            comm, accl.config)
+    assert got == Algorithm.RING  # falls through to the ring edge
+    assert _counter(key) - before == 1.0
+
+
+# ---------------------------------------------------------------------------
+# program layer: parity of the multi-axis builders
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("count", [64, 100])  # incl. the padding path
+def test_multiaxis_allreduce_bit_exact(accl, rng, count):
+    dt = dataType.float32
+    data = rng.integers(-100, 100, (WORLD, count)).astype(np.float32)
+    outs = {}
+    for algo in (Algorithm.RING, Algorithm.XLA, Algorithm.MULTIAXIS):
+        send = accl.create_buffer(count, dt)
+        recv = accl.create_buffer(count, dt)
+        send.host[:] = data
+        accl.allreduce(send, recv, count, reduceFunction.SUM,
+                       algorithm=algo)
+        outs[algo] = recv.host.copy()
+    np.testing.assert_array_equal(outs[Algorithm.MULTIAXIS],
+                                  outs[Algorithm.RING])
+    np.testing.assert_array_equal(outs[Algorithm.MULTIAXIS],
+                                  outs[Algorithm.XLA])
+    np.testing.assert_array_equal(outs[Algorithm.MULTIAXIS][0],
+                                  data.sum(0))
+
+
+def test_multiaxis_allreduce_max(accl, rng):
+    count, dt = 48, dataType.int32
+    data = rng.integers(-100, 100, (WORLD, count)).astype(np.int32)
+    send = accl.create_buffer(count, dt)
+    recv = accl.create_buffer(count, dt)
+    send.host[:] = data
+    accl.allreduce(send, recv, count, reduceFunction.MAX,
+                   algorithm=Algorithm.MULTIAXIS)
+    for r in range(WORLD):
+        np.testing.assert_array_equal(recv.host[r], data.max(0))
+
+
+def test_multiaxis_reduce_scatter_bit_exact(accl, rng):
+    """The chunk-order realignment: rank (r, c) must land FLAT chunk
+    r*cols+c — bit-identical to the 1-D ring path."""
+    count, dt = 48, dataType.int32
+    data = rng.integers(-50, 50, (WORLD, count * WORLD)).astype(np.int32)
+    outs = {}
+    for algo in (Algorithm.RING, Algorithm.MULTIAXIS):
+        send = accl.create_buffer(count * WORLD, dt)
+        recv = accl.create_buffer(count, dt)
+        send.host[:] = data
+        accl.reduce_scatter(send, recv, count, reduceFunction.SUM,
+                            algorithm=algo)
+        outs[algo] = recv.host.copy()
+    np.testing.assert_array_equal(outs[Algorithm.MULTIAXIS],
+                                  outs[Algorithm.RING])
+    for r in range(WORLD):
+        np.testing.assert_array_equal(
+            outs[Algorithm.MULTIAXIS][r],
+            data[:, r * count:(r + 1) * count].sum(0))
+
+
+def test_multiaxis_allgather_bit_exact(accl, rng):
+    count, dt = 33, dataType.float32
+    data = rng.standard_normal((WORLD, count)).astype(np.float32)
+    outs = {}
+    for algo in (Algorithm.RING, Algorithm.MULTIAXIS):
+        send = accl.create_buffer(count, dt)
+        recv = accl.create_buffer(count * WORLD, dt)
+        send.host[:] = data
+        accl.allgather(send, recv, count, algorithm=algo)
+        outs[algo] = recv.host.copy()
+    np.testing.assert_array_equal(outs[Algorithm.MULTIAXIS],
+                                  outs[Algorithm.RING])
+    for r in range(WORLD):
+        np.testing.assert_array_equal(outs[Algorithm.MULTIAXIS][r],
+                                      data.reshape(-1))
+
+
+def test_multiaxis_compressed_wire(accl, rng):
+    """Per-hop wire compression rides the multi-axis schedule like any
+    other: bf16 on every hop, folds at full precision."""
+    count, dt = 64, dataType.float32
+    data = rng.integers(-100, 100, (WORLD, count)).astype(np.float32)
+    send = accl.create_buffer(count, dt)
+    recv = accl.create_buffer(count, dt)
+    send.host[:] = data
+    accl.allreduce(send, recv, count, reduceFunction.SUM,
+                   compress_dtype=dataType.bfloat16,
+                   algorithm=Algorithm.MULTIAXIS)
+    expect = data.astype(np.float64).sum(0)
+    for r in range(WORLD):
+        np.testing.assert_allclose(recv.host[r], expect, rtol=0.1, atol=2.0)
+
+
+def test_auto_dispatches_multiaxis_end_to_end(accl, rng):
+    """AUTO on a declared 2x4 torus at a ring-window payload: the call
+    dispatches the synthesized schedule (selection counter) and the
+    result is exact."""
+    count = 1 << 20  # 4 MiB f32 — the ring window's lower edge
+    dt = dataType.float32
+    saved = accl.config
+    accl.config = saved.replace(sched_mesh_shape=[2, 4])
+    try:
+        key = ('accl_algorithm_selected_total{op="allreduce",'
+               'algorithm="multiaxis"}')
+        before = _counter(key)
+        data = rng.integers(-8, 8, (WORLD, count)).astype(np.float32)
+        send = accl.create_buffer(count, dt)
+        recv = accl.create_buffer(count, dt)
+        send.host[:] = data
+        accl.allreduce(send, recv, count, reduceFunction.SUM)
+        assert _counter(key) > before
+        np.testing.assert_array_equal(recv.host[0], data.sum(0))
+    finally:
+        accl.config = saved
+
+
+def test_cmdlist_multiaxis_one_launch(accl, rng):
+    """A synthesized schedule recorded in a CommandList compiles into
+    the ONE-launch composite and caches like any per-op program."""
+    count, dt = 64, dataType.float32
+    data = rng.integers(-100, 100, (WORLD, count)).astype(np.float32)
+    send = accl.create_buffer(count, dt)
+    recv = accl.create_buffer(count, dt)
+    send.host[:] = data
+    key = 'accl_cmdlist_executes_total{steps="2"}'
+    before = _counter(key)
+    cl = accl.command_list()
+    cl.allreduce(send, recv, count, reduceFunction.SUM,
+                 algorithm=Algorithm.MULTIAXIS)
+    cl.allgather(recv, accl.create_buffer(count * WORLD, dt), count,
+                 algorithm=Algorithm.MULTIAXIS)
+    cl.execute()
+    assert _counter(key) == before + 1
+    np.testing.assert_array_equal(recv.host[0], data.sum(0))
+
+
+def test_multiaxis_requires_composite_world(accl):
+    comm = accl.global_comm().split(range(7))
+    with pytest.raises(ValueError, match="composite world"):
+        algorithms.build_allreduce(comm, reduceFunction.SUM,
+                                   dataType.float32, Algorithm.MULTIAXIS,
+                                   None)
+
+
+def test_explicit_multiaxis_supported_everywhere_it_claims():
+    for op in synth.SYNTH_OPS:
+        assert algorithms.supported(op, Algorithm.MULTIAXIS)
+    assert not algorithms.supported(operation.bcast, Algorithm.MULTIAXIS)
+
+
+# ---------------------------------------------------------------------------
+# ProgramCache LRU bound (satellite)
+# ---------------------------------------------------------------------------
+
+def test_program_cache_lru_bound_and_metrics():
+    from accl_tpu.parallel.compiler import ProgramCache
+
+    pc = ProgramCache(maxsize=2)
+    hit_k = 'accl_program_cache_total{event="hit"}'
+    evict_k = 'accl_program_cache_total{event="evict"}'
+    h0, e0 = _counter(hit_k), _counter(evict_k)
+    pc.get("a", lambda: "A")
+    pc.get("b", lambda: "B")
+    assert pc.get("a", lambda: "FRESH") == "A"   # refreshes a's recency
+    pc.get("c", lambda: "C")                     # evicts b (LRU)
+    assert len(pc) == 2 and pc.evictions == 1
+    assert pc.get("b", lambda: "B2") == "B2"     # b was evicted, rebuilt
+    assert _counter(hit_k) == h0 + 1
+    assert _counter(evict_k) - e0 == 2           # c evicted b; b evicted a
+    assert metrics.snapshot()["gauges"]["accl_program_cache_size"] == 2.0
+    size, hits, misses = pc.stats()
+    assert (size, hits, misses) == (2, 1, 4)
+    # shrinking the bound evicts immediately (config write-through path)
+    pc.set_maxsize(1)
+    assert len(pc) == 1 and pc.evictions == 3
+    # 0 disables the bound
+    pc.set_maxsize(0)
+    for i in range(10):
+        pc.get(("k", i), lambda: i)
+    assert len(pc) == 11
+
+
+def test_program_cache_config_write_through():
+    import jax
+
+    acc = accl_tpu.ACCL(devices=jax.devices()[:1])
+    try:
+        assert acc._programs.maxsize == acc.config.program_cache_size
+        acc.config = acc.config.replace(program_cache_size=7)
+        assert acc._programs.maxsize == 7
+        st = acc.stats()["program_cache"]
+        assert st["max_size"] == 7 and "evictions" in st
+    finally:
+        acc.deinit()
+
+
+def test_config_roundtrip_with_sched_fields():
+    """The new registers survive the exact-schema save/load contract
+    (sched_mesh_shape serializes as a JSON list)."""
+    cfg = ACCLConfig(sched_mesh_shape=[2, 4], sched_alpha_us=0.5,
+                     program_cache_size=33)
+    back = ACCLConfig.from_json(cfg.to_json())
+    assert back.sched_mesh_shape == [2, 4]
+    assert back.sched_alpha_us == 0.5
+    assert back.program_cache_size == 33
+    assert back.sched_synthesis is True

@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# CI gate: the tier-1 verify command chained with the bench regression
+# differ (round 11's bench/compare.py, finally wired to a gate).
+#
+#   tools/ci_gate.sh [--threshold 0.10]
+#
+# 1. Runs the ROADMAP tier-1 verify command (the full fast test suite on
+#    the CPU emulator rung). A failure here fails the gate immediately.
+# 2. If at least TWO BENCH_*.json artifacts exist in the repo root, diffs
+#    the two most recent with `python -m accl_tpu.bench.compare` (base =
+#    the older of the pair) and propagates its exit code — a >threshold
+#    per-lane drop fails the gate. Fewer than two artifacts skips the
+#    bench leg with a note (first round on a fresh rig is not a failure).
+set -uo pipefail
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+
+THRESHOLD="0.10"
+if [[ "${1:-}" == "--threshold" && -n "${2:-}" ]]; then
+    THRESHOLD="$2"
+fi
+
+echo "[ci_gate] tier-1 verify..." >&2
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly 2>&1 | tee /tmp/_t1.log
+t1_rc=${PIPESTATUS[0]}
+echo "[ci_gate] tier-1 rc=${t1_rc} DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)" >&2
+if [[ $t1_rc -ne 0 ]]; then
+    echo "[ci_gate] FAIL: tier-1 verify failed (rc=${t1_rc})" >&2
+    exit "$t1_rc"
+fi
+
+# two most recent bench artifacts by NAME (version sort): round-numbered
+# names order correctly even on a fresh clone where every committed
+# artifact shares one mtime (ls -1t would pick the two oldest, reversed)
+mapfile -t ARTIFACTS < <(ls -1 BENCH_*.json 2>/dev/null | sort -V | tail -2)
+if [[ ${#ARTIFACTS[@]} -lt 2 ]]; then
+    echo "[ci_gate] bench compare: skipped (<2 BENCH_*.json artifacts)" >&2
+    echo "[ci_gate] PASS (tier-1 only)" >&2
+    exit 0
+fi
+BASE="${ARTIFACTS[0]}"
+NEW="${ARTIFACTS[1]}"
+echo "[ci_gate] bench compare: ${BASE} -> ${NEW} (threshold ${THRESHOLD})" >&2
+env JAX_PLATFORMS=cpu python -m accl_tpu.bench.compare "$BASE" "$NEW" \
+    --threshold "$THRESHOLD"
+cmp_rc=$?
+if [[ $cmp_rc -ne 0 ]]; then
+    echo "[ci_gate] FAIL: bench regression (rc=${cmp_rc})" >&2
+    exit "$cmp_rc"
+fi
+echo "[ci_gate] PASS" >&2
+exit 0
