@@ -88,6 +88,7 @@ class ACCL:
         from .ops import flash as _flash_ops
 
         _flash_ops.set_flash_bwd_mode(cfg.flash_bwd)
+        _flash_ops.set_flash_decode_mode(cfg.flash_decode)
         _cm_ops.set_overlap_enabled(cfg.cmatmul_overlap)
         _cm_ops.set_overlap_thresholds(cfg.ag_matmul_threshold,
                                        cfg.rs_matmul_threshold)
@@ -1192,8 +1193,58 @@ class ACCL:
             return self._finish(operation.send, None, data, True, run_async, comm)
         _metrics.inc("accl_sendrecv_protocol_total", labels=_L_EAGER)
         _metrics.note_call(operation.send, nbytes, srcbuf.dtype)
+        if (not run_async
+                and nbytes < self.config.latency_tier_threshold
+                and nbytes <= self.config.eager_rx_buffer_size):
+            # the latency-tier fast path: a sub-threshold payload is by
+            # construction a single segment, so the segmentation table,
+            # the capacity/slot prechecks sized for multi-segment
+            # messages, and the continuation machinery are pure overhead
+            # — one slot reserve + one post, dispatch timed at µs
+            # resolution
+            return self._eager_send_fast(matcher, data, count, src, dst,
+                                         tag)
         return self._eager_send(matcher, data, count, srcbuf.dtype,
                                 src, dst, tag, run_async)
+
+    def _eager_send_fast(self, matcher, data, count: int, src: int,
+                         dst: int, tag: int) -> Optional[Request]:
+        """Single-segment sync eager send — the latency-tier fast path
+        (``nbytes < latency_tier_threshold``, one rx-buffer segment).
+
+        Same protocol state transitions as :meth:`_eager_send` at n=1:
+        upfront capacity validation against a parked recv, one pool-slot
+        reserve (NOT_READY backpressure when exhausted, counted by the
+        pool), one post. Dispatch latency (fast-path entry → posted)
+        lands in the µs-resolution ``accl_latency_dispatch_seconds{path=
+        "eager_send"}`` histogram — the ms-scale dispatch bins cannot
+        resolve a p99 for ops whose whole budget is tens of µs."""
+        t0 = _metrics.tick()
+        cap = matcher.recv_capacity(src, dst, tag)
+        if cap >= 0 and cap < count:
+            raise ACCLError(
+                errorCode.INVALID_BUFFER_SIZE,
+                f"send {src}->{dst} count {count} overflows the pending "
+                f"recv's remaining capacity {cap}")
+        slot = matcher.rx_pool.reserve(
+            src, dst, tag, matcher.outbound_seq(src, dst), count)
+        if slot < 0:
+            raise ACCLError(
+                errorCode.NOT_READY_ERROR,
+                f"eager rx-buffer pool exhausted (0 free, 1 needed); "
+                f"drain pending recvs or raise "
+                f"config.eager_rx_buffer_count")
+        post = SendPost(src=src, dst=dst, tag=tag, data=data,
+                        count=count, rx_slot=slot)
+        try:
+            matcher.post_send(post)
+        except Exception:
+            # rejected before the seqn was consumed — give the slot back
+            matcher.rx_pool.release(slot)
+            raise
+        _metrics.note_latency_dispatch("eager_send", t0)
+        return self._finish(operation.send, None, data, True, False,
+                            matcher.comm)
 
     def _eager_send(self, matcher, data, count: int, dt: dataType,
                     src: int, dst: int, tag: int,
@@ -1595,9 +1646,11 @@ class ACCL:
             prog = self._programs.get(key, build)
             y = prog(x).astype(recvbuf.jnp_dtype)
             self._store(recvbuf, count * world, y)
-        _metrics.note_call(operation.allgather,
-                           count * constants.dtype_size(sendbuf.dtype),
-                           sendbuf.dtype, key, t0)
+        nbytes = count * constants.dtype_size(sendbuf.dtype)
+        _metrics.note_call(operation.allgather, nbytes, sendbuf.dtype,
+                           key, t0)
+        if nbytes < self.config.latency_tier_threshold:
+            _metrics.note_latency_dispatch("collective", t0)
         return self._finish(operation.allgather, recvbuf, y, to_device, run_async, comm)
 
     def reduce(
@@ -1657,9 +1710,14 @@ class ACCL:
             prog = self._programs.get(key, build)
             y = prog(x).astype(recvbuf.jnp_dtype)
             self._store(recvbuf, count, y)
-        _metrics.note_call(operation.allreduce,
-                           count * constants.dtype_size(sendbuf.dtype),
-                           sendbuf.dtype, key, t0)
+        nbytes = count * constants.dtype_size(sendbuf.dtype)
+        _metrics.note_call(operation.allreduce, nbytes, sendbuf.dtype,
+                           key, t0)
+        if nbytes < self.config.latency_tier_threshold:
+            # the latency tier's own dispatch instrument: µs-resolution
+            # buckets (the ms-scale accl_dispatch_seconds bins put every
+            # sub-threshold op in one bucket — no usable p99)
+            _metrics.note_latency_dispatch("collective", t0)
         return self._finish(operation.allreduce, recvbuf, y, to_device, run_async, comm)
 
     def reduce_scatter(
@@ -1691,9 +1749,11 @@ class ACCL:
             prog = self._programs.get(key, build)
             y = prog(x).astype(recvbuf.jnp_dtype)
             self._store(recvbuf, count, y)
-        _metrics.note_call(operation.reduce_scatter,
-                           count * world * constants.dtype_size(sendbuf.dtype),
+        nbytes = count * world * constants.dtype_size(sendbuf.dtype)
+        _metrics.note_call(operation.reduce_scatter, nbytes,
                            sendbuf.dtype, key, t0)
+        if nbytes < self.config.latency_tier_threshold:
+            _metrics.note_latency_dispatch("collective", t0)
         return self._finish(operation.reduce_scatter, recvbuf, y, to_device, run_async, comm)
 
     def alltoall(

@@ -13,6 +13,7 @@ points instead of defaults.
 """
 from __future__ import annotations
 
+import functools
 import time
 from typing import Dict, List, Optional, Sequence
 
@@ -807,6 +808,87 @@ def autotune_flash_bwd(acc, cfg: Optional[ACCLConfig] = None,
     return cfg.replace(flash_bwd=winner)
 
 
+def autotune_latency_tier(acc, cfg: Optional[ACCLConfig] = None,
+                          pows: Sequence[int] = (5, 8, 11, 13),
+                          reps: int = 3,
+                          dt: dataType = dataType.float32) -> ACCLConfig:
+    """Measure the latency family's flat star against XLA's log-depth
+    single shot over the sub-threshold sweep on the live mesh and write
+    the crossover into ``latency_tier_threshold``: the α-dominated tier
+    (``parallel/synth._latency_plan``) owns every payload strictly below
+    the first measured size where the flat star stops winning — 0
+    (tier disabled) when it never wins, the largest measured size when
+    it never loses (the tier must not claim beyond the sweep). ICI only:
+    anywhere else the measurement would tune the emulator, not the
+    fabric the α-β model describes."""
+    cfg = cfg or acc.config
+    if acc.config.transport != TransportBackend.ICI:
+        return cfg
+    comm = acc.global_comm()
+    if comm.world_size == 1:
+        return cfg
+    counts = [2 ** p for p in pows]
+    elem = np.dtype(to_jax_dtype(dt)).itemsize
+    t = measure_allreduce(comm, counts, [Algorithm.XLA, Algorithm.FLAT],
+                          dt, reps,
+                          bidirectional=cfg.bidirectional_rings)
+    nbytes = [c * elem for c in counts]
+    first_loss = next((i for i in range(len(counts))
+                       if t[Algorithm.FLAT][i] >= t[Algorithm.XLA][i]),
+                      None)
+    if first_loss == 0:
+        thr = 0
+    elif first_loss is None:
+        thr = nbytes[-1]
+    else:
+        thr = nbytes[first_loss]
+    return cfg.replace(latency_tier_threshold=int(thr))
+
+
+def autotune_decode(acc, cfg: Optional[ACCLConfig] = None,
+                    B: int = 8, H: int = 8, d: int = 128,
+                    page: int = 64, pages_max: int = 8,
+                    reps: int = 5) -> ACCLConfig:
+    """Measure the PAGED flash-decode kernel against the unpaged lax
+    reference over a ¾-full cache on the live chip and write the winner
+    to ``cfg.flash_decode`` — the serving-datapath A/B register (the
+    ``autotune_flash_bwd`` shape). Decode steps are latency-shaped, so
+    the comparison is per-launch wall time, not a chained slope. Only
+    meaningful on a real TPU backend: the interpret rung would measure
+    the emulator — any other backend passes through untouched.
+    Single-chip; runs at ANY world size."""
+    import jax
+    cfg = cfg or acc.config
+    if jax.default_backend() != "tpu":
+        return cfg
+    import jax.numpy as jnp
+    from ..ops import flash
+
+    rng = np.random.default_rng(0)
+    n_pages = B * pages_max
+    kp = jnp.asarray(rng.standard_normal(
+        (H, n_pages, page, d)).astype(np.float32) * 0.1)
+    vp = jnp.asarray(rng.standard_normal(
+        (H, n_pages, page, d)).astype(np.float32) * 0.1)
+    bt = jnp.arange(n_pages, dtype=jnp.int32).reshape(B, pages_max)
+    lens = jnp.full((B,), (3 * pages_max * page) // 4, jnp.int32)
+    q = jnp.asarray(rng.standard_normal((B, H, d))
+                    .astype(np.float32) * 0.1)
+    times = {}
+    for mode in ("paged", "unpaged"):
+        prog = jax.jit(functools.partial(flash.flash_decode,
+                                         decode_mode=mode))
+        jax.block_until_ready(prog(q, kp, vp, bt, lens))  # compile+warm
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(prog(q, kp, vp, bt, lens))
+            ts.append(time.perf_counter() - t0)
+        times[mode] = float(np.min(ts))
+    winner = "paged" if times["paged"] <= times["unpaged"] else "unpaged"
+    return cfg.replace(flash_decode=winner)
+
+
 def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
                      reps: int = 3,
                      dt: dataType = dataType.float32) -> ACCLConfig:
@@ -815,8 +897,9 @@ def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
     flat-tree rank/count/fan-in registers (accl.cpp:1214-1224 analog,
     measured instead of frozen), the collective-matmul overlap-vs-XLA
     crossovers (ICI), the layerwise ZeRO/FSDP fused-vs-flat schedule
-    register (ICI), and the single-chip flash fused/two-pass backward
-    crossover (any world size)."""
+    register (ICI), the small-message latency-tier crossover (ICI —
+    ``latency_tier_threshold``), and the single-chip flash backward and
+    decode paged/unpaged crossovers (any world size)."""
     if acc.global_comm().world_size == 1:
         # Every threshold select() reads splits INTER-DEVICE algorithm
         # families; at world=1 all of them are degenerate (a one-rank
@@ -829,8 +912,10 @@ def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
         from ..utils.logging import get_logger
         get_logger("accl").info(
             "autotune: world=1 — collective crossovers are degenerate; "
-            "keeping default thresholds (flash bwd crossover still runs)")
-        return autotune_flash_bwd(acc, reps=reps)
+            "keeping default thresholds (the single-chip flash bwd and "
+            "decode crossovers still run)")
+        return autotune_decode(acc, autotune_flash_bwd(acc, reps=reps),
+                               reps=reps)
     from ..obs import trace as _trace
 
     with _trace.span("autotune.allreduce", cat="autotune"):
@@ -862,6 +947,11 @@ def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
         ("zero_fsdp", lambda c: autotune_zero_fsdp(acc, c, reps=reps)),
         ("sched_synth", lambda c: autotune_sched_synth(
             acc, c, reps=reps, dt=dt)),
+        # round 13 (inference serving): the small-message latency-tier
+        # crossover (ICI) and the paged/unpaged decode A/B (TPU backend)
+        ("latency_tier", lambda c: autotune_latency_tier(
+            acc, c, reps=reps, dt=dt)),
+        ("decode", lambda c: autotune_decode(acc, c, reps=reps)),
         ("flash_bwd", lambda c: autotune_flash_bwd(acc, c, reps=reps)),
     ]
     try:

@@ -6,14 +6,18 @@ the two artifacts up lane by lane and flags regressions, so the first
 on-silicon run of a new round lands against a comparable baseline
 instead of a diff nobody reads:
 
-* every lane's headline ``value`` is compared (all lane values are
-  higher-is-better by construction: GB/s, TFLOP/s, overlap-efficiency
-  ratios), plus the artifact's own headline metric;
-* a lane regresses when the new value drops more than ``threshold``
-  (default 10%) below the baseline value — both sides must be RESOLVED
-  measurements (the lane protocol's honesty flags are honored: a lane
-  that was flagged/zeroed on either side is reported ``incomparable``,
-  never a regression);
+* every lane's headline ``value`` is compared, plus the artifact's own
+  headline metric. Lanes carry a **direction**: bandwidth/MFU/ratio
+  lanes are higher-is-better (the historical default), while the
+  round-13 latency lanes (p50/p99 µs) tag their rows ``direction:
+  "lower"`` and the differ inverts its polarity — a p99 going UP is
+  the regression (before this, a latency lane regressing 20% read as
+  an improvement);
+* a lane regresses when the new value moves more than ``threshold``
+  (default 10%) in its direction's bad sense relative to the baseline
+  — both sides must be RESOLVED measurements (the lane protocol's
+  honesty flags are honored: a lane that was flagged/zeroed on either
+  side is reported ``incomparable``, never a regression);
 * lanes present on only one side are reported (``added`` / ``removed``)
   — a silently dropped lane is itself a finding.
 
@@ -112,12 +116,26 @@ def lane_values(doc: dict) -> Dict[str, dict]:
     return rows
 
 
+def _direction(b_row: dict, n_row: dict) -> str:
+    """A lane's metric direction: ``"lower"`` (latency lanes — p50/p99
+    µs, lower is better) or ``"higher"`` (everything else). Read from
+    either side's row so a lane that GAINED the tag (a round upgrading
+    it) still compares correctly; a side-to-side CONFLICT would mean the
+    metric changed meaning — treated as lower-wins-over-default, since
+    only explicit tags exist."""
+    for row in (n_row, b_row):
+        if isinstance(row, dict) and row.get("direction") == "lower":
+            return "lower"
+    return "higher"
+
+
 def compare(base: dict, new: dict, threshold: float = 0.10) -> dict:
     """Per-lane diff of two artifacts. Returns a JSON-ready document:
     ``rows`` (one per lane present on either side, with base/new values,
-    ratio, and a ``status`` of ok / regression / improvement /
-    incomparable / added / removed), ``regressions`` (the lane names
-    that dropped > threshold), and the threshold used."""
+    ratio, direction, and a ``status`` of ok / regression / improvement
+    / incomparable / added / removed), ``regressions`` (the lane names
+    that moved > threshold in their direction's bad sense), and the
+    threshold used."""
     b_rows, n_rows = lane_values(base), lane_values(new)
     rows: List[dict] = []
     regressions: List[str] = []
@@ -137,16 +155,20 @@ def compare(base: dict, new: dict, threshold: float = 0.10) -> dict:
                          "base": b_rows[name].get("value"),
                          "new": n_rows[name].get("value")})
             continue
+        direction = _direction(b_rows[name], n_rows[name])
         ratio = nv / bv
-        if ratio < 1.0 - threshold:
+        # normalize to a goodness ratio: >1 always means "got better"
+        good = (bv / nv) if direction == "lower" else ratio
+        if good < 1.0 - threshold:
             status = "regression"
             regressions.append(name)
-        elif ratio > 1.0 + threshold:
+        elif good > 1.0 + threshold:
             status = "improvement"
         else:
             status = "ok"
         rows.append({"metric": name, "status": status,
-                     "base": bv, "new": nv, "ratio": round(ratio, 4)})
+                     "base": bv, "new": nv, "ratio": round(ratio, 4),
+                     "direction": direction})
     return {"metric": "bench_compare", "threshold": threshold,
             "rows": rows, "regressions": regressions,
             "regressed": bool(regressions)}

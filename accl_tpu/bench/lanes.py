@@ -1192,6 +1192,162 @@ def bench_obs_overhead(acc, count: int = 1 << 14, calls: int = 64,
     }
 
 
+def _latency_dist(prog, *args, rounds: int) -> Dict[str, float]:
+    """Per-call latency DISTRIBUTION (the serving accounting): one
+    compiled-program launch per sample, host wall time, no chaining —
+    a decode service pays dispatch + device per token, so unlike the
+    bandwidth lanes the launch cost is part of the measurement. The
+    warm-up call eats compile; p50 is the headline, p99 the tail the
+    latency tier exists to protect, raw best/worst stay on the record."""
+    jax.block_until_ready(prog(*args))      # compile + warm
+    ts = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        jax.block_until_ready(prog(*args))
+        ts.append(time.perf_counter() - t0)
+    return {"p50": float(np.percentile(ts, 50)),
+            "p99": float(np.percentile(ts, 99)),
+            "best": float(np.min(ts)), "worst": float(np.max(ts))}
+
+
+def _pctl_fields(t: Dict[str, float], resolved: bool) -> dict:
+    """Shared row assembly for the LATENCY lanes: the headline ``value``
+    is the p50 in µs and the lane is tagged ``direction: "lower"`` so
+    ``bench/compare.py`` inverts its regression polarity (a latency
+    number going UP is the regression). Raw best/worst always stay
+    beside the percentiles; an unresolved lane zeroes the headline but
+    keeps every raw field (the resolution protocol)."""
+    return {"unit": "us", "direction": "lower",
+            "resolved": resolved,
+            "value": round(t["p50"] * 1e6, 1) if resolved else 0.0,
+            "p50_us": round(t["p50"] * 1e6, 1),
+            "p99_us": round(t["p99"] * 1e6, 1),
+            "raw_best_us": round(t["best"] * 1e6, 1),
+            "raw_worst_us": round(t["worst"] * 1e6, 1)}
+
+
+def bench_flash_decode(B: int = 8, H: int = 8, d: int = 128,
+                       page: int = 64, pages_max: int = 8,
+                       rounds: int = 30) -> List[dict]:
+    """The decode-kernel latency lane (round 13): per-step p50/p99 of
+    one paged flash-decode launch over a ¾-full KV cache, dense
+    (H_kv = H) and GQA (H_kv = H/4) rows — the first lane reporting
+    LATENCY percentiles (every earlier lane reports bandwidth/MFU,
+    the wrong shape for a serving datapath).
+
+    Honesty flags: ``fused_engaged`` is True only when ``decode_plan``
+    admits the geometry AND the session decode mode is "paged" AND the
+    rung can execute the kernel (otherwise the timing measures the
+    unpaged lax reference — on-record via ``plan_mode``, headline
+    zeroed). Per-slot lengths are staggered so the dead-page skip is
+    exercised, not a uniform best case."""
+    from ..ops import flash
+
+    rng = np.random.default_rng(0)
+    rows = []
+    for name, hkv in (("flash_decode_dense", H),
+                      ("flash_decode_gqa", max(H // 4, 1))):
+        n_pages = B * pages_max
+        kp = jnp.asarray(rng.standard_normal(
+            (hkv, n_pages, page, d)).astype(np.float32) * 0.1)
+        vp = jnp.asarray(rng.standard_normal(
+            (hkv, n_pages, page, d)).astype(np.float32) * 0.1)
+        bt = jnp.arange(n_pages, dtype=jnp.int32).reshape(B, pages_max)
+        cap = pages_max * page
+        # staggered fills around ~3/4 capacity: per-slot lengths are the
+        # continuous-batching reality and exercise the tail-page mask
+        lens = jnp.asarray([(3 * cap) // 4 - (i * page) // 2
+                            for i in range(B)], jnp.int32)
+        q = jnp.asarray(rng.standard_normal((B, H, d))
+                        .astype(np.float32) * 0.1)
+        mode = flash.get_flash_decode_mode()
+        plan, reason = flash.decode_plan(B, H, hkv, d, page, pages_max,
+                                         q.dtype.itemsize)
+        # the decode kernel is single-chip (no remote DMA), so the
+        # honesty gate is the real backend: an interpreter-rung timing
+        # measures the interpreter, not the kernel
+        engaged = (mode == "paged" and plan is not None
+                   and jax.default_backend() == "tpu")
+        prog = jax.jit(flash.flash_decode)
+        t = _latency_dist(prog, q, kp, vp, bt, lens, rounds=rounds)
+        rows.append({
+            "metric": name,
+            "fused_engaged": engaged,
+            "plan_mode": "paged" if (mode == "paged" and plan is not None)
+            else "unpaged",
+            "plan_reason": reason,
+            "decode_plan": plan,
+            "B": B, "H": H, "H_kv": hkv, "d": d,
+            "page": page, "pages_max": pages_max,
+            "seq_lens": [int(x) for x in lens],
+            "rounds": rounds,
+            **_pctl_fields(t, engaged),
+        })
+    return rows
+
+
+def bench_coll_latency(comm, cfg=None, nbytes: int = 1024,
+                       rounds: int = 30) -> List[dict]:
+    """The small-message collective latency lane (round 13):
+    ``coll_latency_allreduce`` measures per-call p50/p99 of a
+    token-sized allreduce under the LATENCY TIER's resolved schedule
+    (the α-dominated flat/tree family below
+    ``cfg.latency_tier_threshold``) A/B'd against XLA's log-depth
+    single shot at the same size — the 2403.18374 crossover as a
+    measured artifact.
+
+    Honesty flags: ``plan_shape``/``plan_source`` pin what the
+    synthesizer actually resolved for this payload under the session
+    config, and ``resolved`` is True only when the tier owned the
+    decision (``source == "latency_tier"``) — a seeded/disabled config
+    reports its raw A/B but zeroes the headline, because AUTO would not
+    dispatch the schedule being measured. Lower is better
+    (``direction``); ``bench/compare.py`` inverts accordingly."""
+    from ..config import ACCLConfig, Algorithm
+    from ..constants import dataType, operation, reduceFunction
+    from ..parallel import algorithms, synth
+
+    cfg = cfg or ACCLConfig(transport=None)
+    W = comm.world_size
+    count = max(nbytes // 4, 1)
+    rng = np.random.default_rng(0)
+    x = jax.device_put(
+        rng.standard_normal((W, count)).astype(np.float32) * 1e-2,
+        comm.sharding())
+
+    legacy = algorithms._select_legacy(operation.allreduce, nbytes, comm,
+                                       cfg)
+    plan = synth.resolve(operation.allreduce, nbytes, comm, cfg, legacy)
+    tier_algo = plan.algorithm
+
+    def build(algo):
+        return algorithms.build_allreduce(
+            comm, reduceFunction.SUM, dataType.float32, algo, None,
+            bidirectional=cfg.bidirectional_rings)
+
+    t_tier = _latency_dist(build(tier_algo), x, rounds=rounds)
+    t_xla = _latency_dist(build(Algorithm.XLA), x, rounds=rounds)
+    resolved = plan.source == "latency_tier" and t_tier["p50"] > 0
+    return [{
+        "metric": "coll_latency_allreduce",
+        "bytes": nbytes, "world": W, "rounds": rounds,
+        "plan_shape": plan.shape,
+        "plan_source": plan.source,
+        "tier_algorithm": tier_algo.value,
+        "predicted_tier_us": round(plan.predicted_us, 2),
+        **_pctl_fields(t_tier, resolved),
+        "xla_p50_us": round(t_xla["p50"] * 1e6, 1),
+        "xla_p99_us": round(t_xla["p99"] * 1e6, 1),
+        "raw_xla_best_us": round(t_xla["best"] * 1e6, 1),
+        # >1 means the tier's schedule beat XLA's at this size — the
+        # go/no-go autotune_latency_tier measures on the live mesh
+        "speedup_p50": (round(t_xla["p50"] / t_tier["p50"], 3)
+                        if t_tier["p50"] > 0 else None),
+        "speedup_p99": (round(t_xla["p99"] / t_tier["p99"], 3)
+                        if t_tier["p99"] > 0 else None),
+    }]
+
+
 def bench_sched_synth(comm, count: int = 1 << 18, rounds: int = 5,
                       cfg=None,
                       ops: Optional[Sequence[str]] = None) -> List[dict]:

@@ -198,6 +198,27 @@ class ACCLConfig:
     # on the live chip and writes the winner here.
     flash_bwd: str = "fused"
 
+    # flash DECODE (inference serving): "paged" runs the paged-KV Pallas
+    # decode kernel wherever ``flash.decode_plan`` admits the geometry
+    # (unpaged lax reference beyond); "unpaged" pins the reference
+    # everywhere — the serving-datapath A/B switch, written through to
+    # ops.flash.set_flash_decode_mode like flash_bwd; per-call override
+    # via ``decode_mode`` on flash_decode().  Seeded on the live chip by
+    # bench.autotune_decode.
+    flash_decode: str = "paged"
+
+    # small-message latency tier (parallel/synth.py + the eager
+    # protocol): below this many payload bytes (each op's select() byte
+    # convention) the α-dominated regime rules — the schedule
+    # synthesizer may pick the latency-optimal flat/tree schedules over
+    # the ladder's choice (counted under accl_sched_plan_total with
+    # source="latency_tier"), and sub-threshold single-segment sends
+    # take the eager fast path (no segmentation table, dispatch timed
+    # into the µs-resolution accl_latency_dispatch_seconds histogram).
+    # 0 disables the tier; bench.autotune_latency_tier measures the
+    # flat/tree-vs-XLA crossover on the live mesh and writes it here.
+    latency_tier_threshold: int = 8 * 1024
+
     # topology-aware schedule synthesis (parallel/synth.py): the α-β
     # cost-model search over the multi-axis torus that replaces the
     # scalar-threshold pile for the bandwidth collectives. sched_synthesis

@@ -1,3 +1,3 @@
-from . import mlp, vadd
+from . import decode, mlp, vadd
 
-__all__ = ["mlp", "vadd"]
+__all__ = ["decode", "mlp", "vadd"]

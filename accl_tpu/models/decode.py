@@ -1,0 +1,394 @@
+"""Continuous-batching decode-step model — the inference-serving datapath.
+
+Everything the repo built through round 12 is throughput-shaped
+(training steps, MiB-scale payloads); a millions-of-users service is
+latency-shaped: one token per live sequence per step, a KV cache that
+grows every step, sequences arriving and finishing at arbitrary times.
+This module is that workload expressed on the framework:
+
+* the **paged KV cache** lives in :func:`accl_tpu.ops.flash.flash_decode`'s
+  layout — fixed-size pages per kv head indexed by a per-slot block
+  table, so cache growth NEVER changes an array shape (no recompilation
+  as sequences lengthen; the jitted step is compiled once and reused for
+  the whole serving session);
+* **continuous batching** is slot management over that layout:
+  :func:`admit` turns a free slot into a fresh sequence and
+  :func:`retire` releases it, both by rewriting table rows and lengths —
+  O(1) host work, no tensor reshapes, concurrent sequences of unequal
+  length decode in ONE kernel launch via per-slot ``seq_lens``;
+* the **decode step** (:func:`build_decode_step`) runs under tensor
+  parallelism: heads split over tp, the fused Wqkv projection rides
+  ``all_gather_matmul`` and the Wo row-parallel combine rides
+  ``matmul_reduce_scatter`` where the kernel plans engage (the mlp/zero
+  plan-policy discipline — anything less runs the psum baseline, same
+  math), the attention itself is :func:`flash.flash_decode` over each
+  rank's local heads (embarrassingly parallel: GQA groups never straddle
+  ranks), and the new token's K/V land in place via
+  :func:`flash.kv_cache_append` — the whole step is ONE jitted
+  ``shard_map`` program;
+* :func:`publish_tokens` is the serving tier's host-side small-message
+  traffic: one decode step's sampled token ids fanned out to the other
+  controllers' ranks as token-sized eager sends — the bursty
+  sub-threshold workload the round-13 latency tier (eager fast path +
+  flat/tree schedules, ``ACCLConfig.latency_tier_threshold``) exists
+  for, and the first consumer that actually stresses ``sendrecv.py``'s
+  matching engine and ``rxpool.py``'s slot pool with decode-shaped load.
+
+Invariants (enforced by construction in :func:`init_decode_state`, and
+what :func:`flash.kv_cache_append` relies on): block tables name
+DISJOINT pool pages across slots, every table entry is a valid pool
+index even while retired, and ``seq_lens[b] <= pages_max * page``.
+
+See ``docs/serving.md`` for the dataflow and the latency-tier story.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..compat import shard_map
+from .. import device_api as dapi
+from ..constants import dataType
+from .mlp import TP_AXIS
+
+__all__ = [
+    "DecodeParams", "DecodeState", "init_decode_params",
+    "init_decode_state", "admit", "retire", "free_slots", "full_slots",
+    "build_decode_step", "decode_step_reference", "decode_engages",
+    "make_decode_mesh", "shard_decode", "publish_tokens",
+]
+
+
+class DecodeParams(NamedTuple):
+    """One attention block's projections. Global shapes (sharded over tp
+    by :func:`param_specs` — q/k/v columns, o rows):
+
+    * ``wq``: (d_model, H·hd)      * ``wk``/``wv``: (d_model, H_kv·hd)
+    * ``wo``: (H·hd, d_model)
+
+    ``H % tp == 0`` and ``H_kv % tp == 0`` so each rank owns whole GQA
+    groups (g = H/H_kv query heads per kv head stay on one rank — the
+    decode kernel's tile never straddles ranks)."""
+
+    wq: jax.Array
+    wk: jax.Array
+    wv: jax.Array
+    wo: jax.Array
+
+
+class DecodeState(NamedTuple):
+    """The serving session's device-resident cache + slot bookkeeping.
+
+    * ``k_pages``/``v_pages``: (H_kv, n_pages, page, hd) page pools
+      (tp-sharded over kv heads);
+    * ``block_tables``: (slots, pages_max) int32 — slot b's page chain
+      (disjoint across slots, always valid pool indices);
+    * ``seq_lens``: (slots,) int32 live token counts;
+    * ``active``: (slots,) bool — admitted slots. Retired slots keep
+      valid table rows (the append kernel must name SOME row) but
+      never advance and output zeros.
+
+    Every shape is static in (slots, pages_max, page): admission,
+    retirement and growth are VALUE changes only — the jitted decode
+    step never recompiles over a sequence's lifetime."""
+
+    k_pages: jax.Array
+    v_pages: jax.Array
+    block_tables: jax.Array
+    seq_lens: jax.Array
+    active: jax.Array
+
+
+def init_decode_params(key, d_model: int, n_heads: int, n_kv_heads: int,
+                       head_dim: int, dtype=jnp.float32) -> DecodeParams:
+    if n_heads % n_kv_heads:
+        raise ValueError(f"n_heads {n_heads} % n_kv_heads {n_kv_heads}")
+    kq, kk, kv, ko = jax.random.split(key, 4)
+    s = (1.0 / d_model) ** 0.5
+    return DecodeParams(
+        wq=jax.random.normal(kq, (d_model, n_heads * head_dim), dtype) * s,
+        wk=jax.random.normal(kk, (d_model, n_kv_heads * head_dim), dtype) * s,
+        wv=jax.random.normal(kv, (d_model, n_kv_heads * head_dim), dtype) * s,
+        wo=jax.random.normal(ko, (n_heads * head_dim, d_model), dtype)
+        * (1.0 / (n_heads * head_dim)) ** 0.5,
+    )
+
+
+def param_specs() -> DecodeParams:
+    return DecodeParams(wq=P(None, TP_AXIS), wk=P(None, TP_AXIS),
+                        wv=P(None, TP_AXIS), wo=P(TP_AXIS, None))
+
+
+def state_specs() -> DecodeState:
+    return DecodeState(k_pages=P(TP_AXIS), v_pages=P(TP_AXIS),
+                       block_tables=P(), seq_lens=P(), active=P())
+
+
+def init_decode_state(slots: int, pages_max: int, page: int,
+                      n_kv_heads: int, head_dim: int,
+                      dtype=jnp.float32) -> DecodeState:
+    """Zeroed pools + the canonical DISJOINT block-table partition: slot
+    b owns pool pages ``[b·pages_max, (b+1)·pages_max)``. Slots start
+    retired; :func:`admit` brings them live."""
+    n_pages = slots * pages_max
+    shape = (n_kv_heads, n_pages, page, head_dim)
+    return DecodeState(
+        k_pages=jnp.zeros(shape, dtype),
+        v_pages=jnp.zeros(shape, dtype),
+        block_tables=jnp.arange(n_pages, dtype=jnp.int32
+                                ).reshape(slots, pages_max),
+        seq_lens=jnp.zeros((slots,), jnp.int32),
+        active=jnp.zeros((slots,), bool),
+    )
+
+
+def admit(state: DecodeState, slot: int) -> DecodeState:
+    """Admit a fresh sequence into ``slot``: length resets, the slot
+    goes live. O(1) bookkeeping — no pool traffic (stale page content
+    is unreachable past ``seq_lens``), no recompilation."""
+    return state._replace(
+        seq_lens=state.seq_lens.at[slot].set(0),
+        active=state.active.at[slot].set(True))
+
+
+def retire(state: DecodeState, slot: int) -> DecodeState:
+    """Release ``slot``: it stops advancing (the append masks it, the
+    kernel outputs zeros at length 0) and is free for re-admission. Its
+    block-table row stays valid — the append's scatter lane must name
+    SOME pool row even for inactive slots."""
+    return state._replace(
+        seq_lens=state.seq_lens.at[slot].set(0),
+        active=state.active.at[slot].set(False))
+
+
+def free_slots(state: DecodeState) -> list:
+    """Host-side admission helper: the slot indices currently retired."""
+    return [int(i) for i in np.nonzero(~np.asarray(state.active))[0]]
+
+
+def full_slots(state: DecodeState) -> list:
+    """Host-side eviction signal: active slots whose cache is at
+    capacity (``pages_max · page`` tokens). The decode step stops
+    appending for them (the capacity guard — growing past the table row
+    would corrupt an earlier page), so the serving loop should retire
+    or migrate them."""
+    page = state.k_pages.shape[2]
+    cap = state.block_tables.shape[1] * page
+    full = np.asarray(state.active) & (np.asarray(state.seq_lens) >= cap)
+    return [int(i) for i in np.nonzero(full)[0]]
+
+
+# ---------------------------------------------------------------------------
+# the decode step
+# ---------------------------------------------------------------------------
+
+def make_decode_mesh(devices, tp: int) -> Mesh:
+    devs = np.array(list(devices)[:tp])
+    return Mesh(devs, (TP_AXIS,))
+
+
+def shard_decode(params: DecodeParams, state: DecodeState,
+                 mesh: Mesh) -> Tuple[DecodeParams, DecodeState]:
+    """Place params/state under the tp sharding the step expects."""
+    put = lambda tree, specs: jax.tree_util.tree_map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), tree, specs)
+    return put(params, param_specs()), put(state, state_specs())
+
+
+def decode_engages(slots: int, d_model: int, n_heads: int,
+                   n_kv_heads: int, head_dim: int, tp: int,
+                   overlap: Optional[bool] = None,
+                   bidirectional: bool = True,
+                   wire_dtype=None, dtype=jnp.float32) -> bool:
+    """True when the tp projections of :func:`build_decode_step` would
+    ride the FUSED collective-matmul kernels at these shapes (session
+    registers + VMEM plans + rung — the mlp/zero honesty resolution;
+    the bench lane's ``fused_engaged`` flag). The attention kernel's
+    own paged/unpaged resolution is separate (``flash.decode_plan``)."""
+    from ..ops import collective_matmul as cm
+
+    if tp <= 1 or slots % tp or n_heads % tp or n_kv_heads % tp:
+        return False
+    qkv_cols = (n_heads + 2 * n_kv_heads) // tp * head_dim
+    return (cm.agmm_engages(slots // tp, d_model, qkv_cols, tp, dtype,
+                            overlap, bidirectional, wire_dtype=wire_dtype)
+            and cm.mmrs_engages(slots, n_heads // tp * head_dim, d_model,
+                                tp, dtype, overlap, bidirectional,
+                                wire_dtype=wire_dtype))
+
+
+def _step_local(p: DecodeParams, state: DecodeState, x,
+                overlap: Optional[bool], mesh_axes, wire_dtype,
+                decode_mode: Optional[str]):
+    """Per-rank decode step (inside shard_map): fused qkv projection →
+    in-place KV append → paged decode attention over the rank's local
+    heads → row-parallel output projection."""
+    from ..ops import collective_matmul as cm
+    from ..ops import flash
+
+    tp = lax.axis_size(TP_AXIS)
+    slots, d_model = x.shape
+    hkv_l, _, _, hd = state.k_pages.shape        # local kv heads
+    h_l = p.wq.shape[1] // hd                    # local q heads
+    # one fused projection: the local column blocks [q | k | v] ride a
+    # single all_gather_matmul when the plans engage (x is tp-replicated,
+    # so its row shards ARE the ring's travelling blocks — mlp idiom)
+    wqkv = jnp.concatenate([p.wq, p.wk, p.wv], axis=1)
+    fused = (tp > 1 and slots % tp == 0
+             and cm.agmm_engages(slots // tp, d_model, wqkv.shape[1], tp,
+                                 x.dtype, overlap,
+                                 wire_dtype=wire_dtype,
+                                 w_dtype=wqkv.dtype)
+             and cm.mmrs_engages(slots, h_l * hd, d_model, tp, x.dtype,
+                                 overlap, wire_dtype=wire_dtype,
+                                 w_dtype=p.wo.dtype))
+    if fused:
+        ms = slots // tp
+        x_s = lax.dynamic_slice_in_dim(
+            x, lax.axis_index(TP_AXIS) * ms, ms, axis=0)
+        qkv = dapi.all_gather_matmul(x_s, wqkv, axis=TP_AXIS,
+                                     mesh_axes=mesh_axes, overlap=overlap,
+                                     wire_dtype=wire_dtype)
+    else:
+        qkv = jnp.dot(x, wqkv, preferred_element_type=jnp.float32)
+    q, k_new, v_new = jnp.split(
+        qkv, [h_l * hd, (h_l + hkv_l) * hd], axis=1)
+    q = q.reshape(slots, h_l, hd).astype(x.dtype)
+    k_new = k_new.reshape(slots, hkv_l, hd)
+    v_new = v_new.reshape(slots, hkv_l, hd)
+
+    # append FIRST so the current token attends itself (flash_decode's
+    # contract); retired slots are masked — cache and length untouched.
+    # Slots AT capacity are masked too: one step past pages_max·page the
+    # append's page index would leave the block-table row and JAX's
+    # clamped gather would silently redirect the write (corrupting an
+    # earlier page) — a full slot instead stops advancing and keeps
+    # answering over its full cache until the host retires it
+    # (:func:`full_slots` is the admission loop's eviction signal)
+    _, _, page, _ = state.k_pages.shape
+    capacity = state.block_tables.shape[1] * page
+    can_grow = state.active & (state.seq_lens < capacity)
+    k_pages, v_pages, seq_lens = flash.kv_cache_append(
+        state.k_pages, state.v_pages, state.block_tables, state.seq_lens,
+        k_new, v_new, active=can_grow)
+
+    attn = flash.flash_decode(q, k_pages, v_pages, state.block_tables,
+                              seq_lens, decode_mode=decode_mode)
+    o = attn.reshape(slots, h_l * hd)
+
+    if fused:
+        y_s = dapi.matmul_reduce_scatter(o.astype(x.dtype), p.wo,
+                                         axis=TP_AXIS,
+                                         mesh_axes=mesh_axes,
+                                         overlap=overlap,
+                                         wire_dtype=wire_dtype)
+        y = lax.all_gather(y_s, TP_AXIS, axis=0, tiled=True)
+    else:
+        y = lax.psum(jnp.dot(o, p.wo, preferred_element_type=jnp.float32),
+                     TP_AXIS)
+    # a retired slot contributes exact zeros (its attention is zeros at
+    # length 0, but the projection bias-free matmul of a stale q row
+    # must not leak either — mask on the slot flag)
+    y = jnp.where(state.active[:, None], y.astype(x.dtype), 0)
+    return y, DecodeState(k_pages, v_pages, state.block_tables, seq_lens,
+                          state.active)
+
+
+def build_decode_step(mesh: Mesh, overlap: Optional[bool] = None,
+                      wire_dtype=None,
+                      decode_mode: Optional[str] = None):
+    """One jitted continuous-batching decode step over the tp mesh:
+    ``step(params, state, x) -> (y, state')`` where ``x`` is (slots,
+    d_model) — the current token's hidden state per slot — and ``y``
+    its attention-block output (retired slots: zeros).
+
+    Compiled ONCE per (slots, d_model, cache geometry): admission,
+    retirement and cache growth are value changes (`block_tables` /
+    ``seq_lens`` / ``active``), never shape changes. ``overlap`` /
+    ``wire_dtype`` steer the tp projections' collective-matmul ride
+    (None: session defaults); ``decode_mode`` pins the attention
+    kernel's paged/unpaged resolution per call
+    (None: ``ACCLConfig.flash_decode``)."""
+    axes = tuple(mesh.axis_names)
+    p_specs, s_specs = param_specs(), state_specs()
+
+    def step(p, state, x):
+        return _step_local(p, state, x, overlap, axes, wire_dtype,
+                           decode_mode)
+
+    return jax.jit(shard_map(
+        step, mesh=mesh,
+        in_specs=(p_specs, s_specs, P()),
+        out_specs=(P(), s_specs),
+        check_vma=False))
+
+
+def decode_step_reference(p: DecodeParams, state: DecodeState, x):
+    """Single-device oracle of one decode step — same math as the
+    sharded program (fused or baseline datapath): dense qkv projection,
+    masked append, unpaged attention over the gathered chains, dense
+    output projection. Operates on UNSHARDED (global) params/state."""
+    from ..ops import flash
+
+    slots = x.shape[0]
+    hkv, _, page, hd = state.k_pages.shape
+    h = p.wq.shape[1] // hd
+    q = jnp.dot(x, p.wq, preferred_element_type=jnp.float32)
+    k_new = jnp.dot(x, p.wk, preferred_element_type=jnp.float32)
+    v_new = jnp.dot(x, p.wv, preferred_element_type=jnp.float32)
+    capacity = state.block_tables.shape[1] * page
+    can_grow = state.active & (state.seq_lens < capacity)
+    k_pages, v_pages, seq_lens = flash.kv_cache_append(
+        state.k_pages, state.v_pages, state.block_tables, state.seq_lens,
+        k_new.reshape(slots, hkv, hd).astype(state.k_pages.dtype),
+        v_new.reshape(slots, hkv, hd).astype(state.v_pages.dtype),
+        active=can_grow)
+    attn = flash.flash_decode(
+        q.reshape(slots, h, hd).astype(x.dtype), k_pages, v_pages,
+        state.block_tables, seq_lens, decode_mode="unpaged")
+    y = jnp.dot(attn.reshape(slots, h * hd), p.wo,
+                preferred_element_type=jnp.float32)
+    y = jnp.where(state.active[:, None], y.astype(x.dtype), 0)
+    return y, DecodeState(k_pages, v_pages, state.block_tables, seq_lens,
+                          state.active)
+
+
+# ---------------------------------------------------------------------------
+# serving-tier token traffic (the latency tier's consumer)
+# ---------------------------------------------------------------------------
+
+def publish_tokens(acc, tokens, src: int, tag: int = 0, comm=None):
+    """Fan one decode step's sampled token ids out from rank ``src`` to
+    every other rank as token-sized **eager** messages — the
+    disaggregated-serving pattern (the sampling rank owns the logits;
+    every rank needs the ids to append next step), and exactly the
+    bursty sub-threshold traffic the round-13 latency tier serves: each
+    send is a single rx-buffer segment riding the eager fast path
+    (timed into ``accl_latency_dispatch_seconds{path="eager_send"}``),
+    with rx-pool slots as the backpressure when receivers lag.
+
+    ``tokens``: (slots,) int32 host array/list. Returns the list of
+    per-destination received arrays (each == ``tokens``). Sends are
+    posted as one burst FIRST, then matched by the recvs — world-1
+    concurrent parked token messages, the rxpool occupancy shape of a
+    real decode fleet."""
+    tokens = np.asarray(tokens, np.int32)
+    n = tokens.shape[0]
+    comm = comm or acc.global_comm()
+    world = comm.world_size
+    sbuf = acc.create_buffer(n, dataType.int32)
+    sbuf.host[src] = tokens
+    dsts = [d for d in range(world) if d != src]
+    for dst in dsts:                       # the burst: all posts park
+        acc.send(sbuf, n, src=src, dst=dst, tag=tag, comm=comm)
+    out = []
+    for dst in dsts:
+        rbuf = acc.create_buffer(n, dataType.int32)
+        acc.recv(rbuf, n, src=src, dst=dst, tag=tag, comm=comm)
+        out.append(np.asarray(rbuf.host[dst]))
+    return out

@@ -36,6 +36,8 @@ name                                           kind       labels
 ``accl_select_decline_total``                  counter    op, reason
 ``accl_program_cache_total``                   counter    event (hit | miss | evict)
 ``accl_program_cache_size``                    gauge      (none)
+``accl_latency_dispatch_seconds``              histogram  path (µs-resolution buckets)
+``accl_flash_decode_fallback_total``           counter    reason (mode | geometry | vmem_miss)
 =============================================  =========  =================
 
 Export formats: :meth:`MetricsRegistry.snapshot` (flat, JSON-safe dict),
@@ -61,6 +63,25 @@ ENABLED = True
 #: the snapshot schema stable
 BUCKETS = (1e-6, 4e-6, 16e-6, 64e-6, 256e-6, 1e-3, 4e-3, 16e-3,
            64e-3, 256e-3, 1.0, 10.0)
+
+#: microsecond-resolution bucket geometry for the latency-tier dispatch
+#: path: the default 4x-spaced buckets put everything from 64 µs to
+#: 256 µs in ONE bin — a sub-threshold op whose whole budget is tens of
+#: µs gets no usable p99 out of that. 2x spacing through the µs decade,
+#: coarse tail for the pathological cases.
+US_BUCKETS = (1e-6, 2e-6, 4e-6, 8e-6, 16e-6, 32e-6, 64e-6, 128e-6,
+              256e-6, 512e-6, 1e-3, 4e-3, 16e-3, 256e-3, 10.0)
+
+#: per-metric bucket geometry overrides (by metric NAME, before the
+#: label block); anything absent uses :data:`BUCKETS`
+_BUCKET_OVERRIDES = {
+    "accl_latency_dispatch_seconds": US_BUCKETS,
+}
+
+
+def _buckets_for(key: str):
+    name, _, _ = key.partition("{")
+    return _BUCKET_OVERRIDES.get(name, BUCKETS)
 
 _KiB = 1024
 
@@ -126,12 +147,13 @@ class MetricsRegistry:
     def observe(self, name: str, value: float,
                 labels: Tuple[Tuple[str, str], ...] = ()) -> None:
         key = name + _label_str(labels)
+        edges = _BUCKET_OVERRIDES.get(name, BUCKETS)
         with self._lock:
             h = self._hists.get(key)
             if h is None:
-                h = [0] * len(BUCKETS) + [0.0, 0]
+                h = [0] * len(edges) + [0.0, 0]
                 self._hists[key] = h
-            for i, edge in enumerate(BUCKETS):
+            for i, edge in enumerate(edges):
                 if value <= edge:
                     h[i] += 1
                     break
@@ -152,7 +174,7 @@ class MetricsRegistry:
         with self._lock:
             hists = {
                 k: {"buckets": {repr(e): h[i]
-                                for i, e in enumerate(BUCKETS)},
+                                for i, e in enumerate(_buckets_for(k))},
                     "sum": h[-2], "count": h[-1]}
                 for k, h in self._hists.items()
             }
@@ -209,7 +231,7 @@ class MetricsRegistry:
                 labels = ("{" + labels) if labels else ""
                 inner = labels[1:-1] if labels else ""
                 cum = 0
-                for i, edge in enumerate(BUCKETS):
+                for i, edge in enumerate(_buckets_for(k)):
                     cum += h[i]
                     sep = "," if inner else ""
                     lines.append(
@@ -300,6 +322,21 @@ def note_call(op, nbytes: int, dtype=None, key: Optional[Iterable] = None,
         REGISTRY.observe("accl_dispatch_seconds",
                          time.perf_counter() - t0,
                          (("op", getattr(op, "name", str(op))),))
+
+
+def note_latency_dispatch(path: str, t0: float) -> None:
+    """One sub-threshold (latency-tier) dispatch: observes host API
+    entry → posted/launched into ``accl_latency_dispatch_seconds{path}``
+    — the µs-resolution histogram (:data:`US_BUCKETS`; the default
+    4x-spaced buckets cannot resolve a p99 for ops whose whole budget is
+    tens of µs). ``path`` names the fast path that ran (``eager_send`` —
+    the single-segment eager fast path; ``collective`` — a bandwidth
+    collective below ``latency_tier_threshold``). No-op when disabled or
+    when ``t0`` is 0.0 (the disabled :func:`tick` sentinel)."""
+    if not ENABLED or not t0:
+        return
+    REGISTRY.observe("accl_latency_dispatch_seconds",
+                     time.perf_counter() - t0, (("path", path),))
 
 
 def note_zero_prefetch(event: str, count: int = 1) -> None:

@@ -1311,6 +1311,324 @@ def flash_attention_packed(q, k, v, causal: bool = False,
     return _unpack_heads(out)
 
 
+# ---------------------------------------------------------------------------
+# flash DECODE (round 13): single-query/GQA attention over a growing KV
+# cache in a PAGED layout — the inference-serving arm of the family.
+#
+# Layout: the cache is a pool of fixed-size pages per kv head,
+# ``k_pages``/``v_pages`` (H_kv, n_pages, page, d), and each slot's
+# logical sequence is the page chain named by its ``block_tables`` row —
+# so cache GROWTH never changes any array shape (no recompilation as
+# sequences lengthen; new tokens land in place via
+# :func:`kv_cache_append`, admission/retirement just rewrites table
+# rows).  The kernel walks the chain with the page index read from the
+# block table through the scalar-prefetch seam (the index map fetches
+# page ``bt[b, j]`` while step j-1 computes — the paged-attention
+# dataflow), so only live pages ever cross HBM->VMEM.
+#
+# Geometry is the round-5/6 fwd block policy retargeted at S_q = 1: the
+# k dimension (the page sweep) is the only sweep axis, the output is a
+# single (g, d) accumulator per (slot, kv head) — g = H/H_kv query rows
+# (the GQA group shares its kv pages in one tile; dense attention is
+# g = 1, padded to the 8-sublane tile), carried in VMEM scratch across
+# the page sweep exactly like the forward's online-softmax carry.  Dead
+# pages (page_start >= seq_len) skip both matmuls (``pl.when``) and the
+# tail page masks per column — causal masking AT the page boundary.
+# ``decode_plan`` is the honest block policy (the agmm/mmrs plan
+# discipline): geometry or VMEM misses decline to the unpaged lax
+# reference (same math over the gathered chain), COUNTED per reason
+# under ``accl_flash_decode_fallback_total``.
+# ---------------------------------------------------------------------------
+
+#: decode-path mode: "paged" runs the Pallas paged-KV kernel wherever
+#: ``decode_plan`` admits it (unpaged lax reference beyond), "unpaged"
+#: pins the reference everywhere — the A/B switch
+#: ``ACCLConfig.flash_decode`` writes through ``set_flash_decode_mode``.
+_DECODE_MODES = ("paged", "unpaged")
+_DECODE_MODE = "paged"
+
+
+def set_flash_decode_mode(mode: str) -> None:
+    """Set the module-default decode mode (``ACCLConfig.flash_decode``
+    lands here at session init). Per-call override: ``decode_mode``."""
+    global _DECODE_MODE
+    if mode not in _DECODE_MODES:
+        raise ValueError(f"flash_decode mode {mode!r} not in {_DECODE_MODES}")
+    _DECODE_MODE = mode
+
+
+def get_flash_decode_mode() -> str:
+    return _DECODE_MODE
+
+
+def _count_decode_fallback(reason: str) -> None:
+    from ..obs import metrics as _metrics
+    _metrics.inc("accl_flash_decode_fallback_total",
+                 labels=(("reason", reason),))
+
+
+def decode_plan(B: int, H: int, H_kv: int, d: int, page: int,
+                pages_max: int, itemsize: int = 2):
+    """Block-geometry policy of the paged decode kernel: the (gp, dp)
+    tile it runs at, or ``(None, reason)`` when the paged path must
+    decline (caller falls back to the unpaged lax reference).
+
+    * ``geometry``: the paged tile wants lane-exact head dims (d a
+      128-lane multiple — decode never pays the `_pad_head_dim` pass,
+      padding the whole PAGE POOL per step would defeat the layout) and
+      sublane-tiled pages (page % 8);
+    * ``vmem_miss``: double-buffered k/v pages + the (gp, dp) q/out/acc
+      tiles + the (gp, page) score/prob pair must fit the scoped-VMEM
+      budget.
+
+    ``gp`` is the GQA group size g = H/H_kv rounded up to the 8-sublane
+    tile (dense attention runs g = 1 on a padded tile — the pad rows
+    are zero queries whose output is sliced away).  Returns
+    ``({"gp", "dp", "vmem"}, "ok")`` on success."""
+    if H % H_kv or B < 1 or pages_max < 1:
+        return None, "geometry"
+    if d % 128 or d == 0:
+        return None, "geometry"
+    if page % 8 or page == 0:
+        return None, "geometry"
+    g = H // H_kv
+    gp = -(-g // 8) * 8
+    est = (4 * page * d * itemsize        # k/v pages, double-buffered
+           + 3 * gp * d * 4               # q + out + acc tiles
+           + 2 * gp * 128 * 4             # m/l carry
+           + 2 * gp * page * 4)           # s/p tiles
+    if est > _VMEM_BUDGET:
+        return None, "vmem_miss"
+    return {"gp": gp, "dp": d, "vmem": est}, "ok"
+
+
+def _resolve_decode(decode_mode: Optional[str]) -> str:
+    mode = decode_mode or _DECODE_MODE
+    if mode not in _DECODE_MODES:
+        raise ValueError(f"decode_mode {mode!r} not in {_DECODE_MODES}")
+    return mode
+
+
+def _decode_kernel(lens_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, page: int, scale: float):
+    b = pl.program_id(0)
+    j = pl.program_id(2)          # page sweep (innermost: scratch carries)
+    npg = pl.num_programs(2)
+    length = lens_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def _block():
+        q = q_ref[0, 0]                                     # (gp, dp)
+        # exp2-domain online softmax — the forward's carry loop with the
+        # page sweep as the only k axis (see _kernel)
+        s = jax.lax.dot_general(
+            q, k_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=_F32) * (scale * _LOG2E)  # (gp, page)
+        cols = j * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        # causal mask at the page boundary: the tail page's columns past
+        # the slot's live length contribute nothing
+        s = jnp.where(cols < length, s, _NEG_INF)
+        m_prev = m_ref[:]
+        row_max = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, row_max)
+        p = jnp.exp2(s - m_new[:, :1])
+        alpha = jnp.exp2(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, -1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=_F32)
+        acc_ref[:] = acc_ref[:] * alpha[:, :1] + pv
+        m_ref[:] = m_new
+
+    # dead pages (fully past the live length) skip both matmuls — the
+    # whole-block causal skip, per slot
+    pl.when(j * page < length)(_block)
+
+    @pl.when(j == npg - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l > 0, l, 1.0)
+        # a zero-length (retired) slot never folded a page: l == 0 and
+        # the output is exact zeros, not NaN
+        o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+
+
+def _flash_decode_paged(q4, k_pages, v_pages, block_tables, seq_lens,
+                        sc: float, gp: int):
+    B, hkv, _, dp = q4.shape
+    page = k_pages.shape[2]
+    pages_max = block_tables.shape[1]
+    kernel = functools.partial(_decode_kernel, page=page, scale=sc)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, hkv, pages_max),
+        in_specs=[
+            pl.BlockSpec((1, 1, gp, dp),
+                         lambda b, h, j, lens, bt: (b, h, 0, 0)),
+            # the paged dataflow: page j of slot b is whichever pool
+            # page the block table names — fetched while step j-1
+            # computes (scalar-prefetch index map)
+            pl.BlockSpec((1, 1, page, dp),
+                         lambda b, h, j, lens, bt: (h, bt[b, j], 0, 0)),
+            pl.BlockSpec((1, 1, page, dp),
+                         lambda b, h, j, lens, bt: (h, bt[b, j], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, gp, dp),
+                               lambda b, h, j, lens, bt: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((gp, dp), _F32),     # acc
+            pltpu.VMEM((gp, 128), _F32),    # running max (lane-replicated)
+            pltpu.VMEM((gp, 128), _F32),    # normalizer
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, hkv, gp, dp), q4.dtype),
+        # slots and kv heads are independent; only the page sweep is
+        # sequential (scratch carry)
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret_params() or False,
+    )(seq_lens, block_tables, q4, k_pages, v_pages)
+
+
+def _gather_pages(pages, block_tables):
+    """(H_kv, n_pages, page, d) pool + (B, pages_max) table ->
+    (B, H_kv, pages_max*page, d) materialized chains — the unpaged
+    reference's view of the cache."""
+    g = jnp.take(pages, block_tables, axis=1)   # (hkv, B, pmax, page, d)
+    hkv = pages.shape[0]
+    B, pmax = block_tables.shape
+    return jnp.moveaxis(g, 1, 0).reshape(B, hkv, pmax * pages.shape[2],
+                                         pages.shape[3])
+
+
+def _decode_reference(q, k_pages, v_pages, block_tables, seq_lens,
+                      sc: float):
+    """Unpaged lax decode reference — the honest fallback (same math:
+    gather the page chains, one dense masked softmax per slot)."""
+    B, H, d = q.shape
+    hkv = k_pages.shape[0]
+    g = H // hkv
+    k = _gather_pages(k_pages, block_tables).astype(_F32)  # (B, hkv, S, d)
+    v = _gather_pages(v_pages, block_tables).astype(_F32)
+    qg = q.reshape(B, hkv, g, d).astype(_F32)
+    s = jnp.einsum("bhgd,bhsd->bhgs", qg, k) * sc
+    live = (jnp.arange(k.shape[2])[None, :]
+            < seq_lens[:, None])[:, None, None, :]
+    s = jnp.where(live, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    p = jnp.where(live, p, 0.0)   # a fully-masked (retired) slot -> zeros
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhgs,bhsd->bhgd", p / jnp.where(l > 0, l, 1.0), v)
+    return out.reshape(B, H, d).astype(q.dtype)
+
+
+def flash_decode(q, k_pages, v_pages, block_tables, seq_lens,
+                 scale: Optional[float] = None,
+                 decode_mode: Optional[str] = None):
+    """Single-query attention over a paged KV cache — one decode step.
+
+    ``q``: (B, H, d) — the current token's query per slot; ``k_pages``/
+    ``v_pages``: (H_kv, n_pages, page, d) page pools with ``H % H_kv ==
+    0`` (grouped-query attention shares each kv head's pages across the
+    group in ONE kernel tile); ``block_tables``: (B, pages_max) int32
+    page chains per slot (entries past the live length must still be
+    valid pool indices — keep them 0); ``seq_lens``: (B,) int32 live
+    token counts (tokens ``0..len-1`` are attended, so append the
+    current token with :func:`kv_cache_append` FIRST).  A zero-length
+    slot (retired / not yet admitted) returns exact zeros.
+
+    Returns (B, H, d) in q's dtype.  Where ``decode_plan`` admits the
+    geometry the paged Pallas kernel runs (page chain walked via the
+    block table, online softmax carried in VMEM across the page sweep,
+    dead pages skipped); otherwise — or with ``decode_mode="unpaged"``
+    (``ACCLConfig.flash_decode`` A/B switch) — the unpaged lax
+    reference runs over the gathered chains, with the decline COUNTED
+    per reason (``accl_flash_decode_fallback_total``).  Cache growth
+    never recompiles: every shape is static in (pages, page), only
+    ``seq_lens``/``block_tables`` values change step to step."""
+    B, H, d = q.shape
+    if k_pages.shape != v_pages.shape or k_pages.ndim != 4 \
+            or k_pages.shape[3] != d:
+        raise ValueError(
+            f"k/v pages {k_pages.shape}/{v_pages.shape} incompatible with "
+            f"q {q.shape}: need (H_kv, n_pages, page, d)")
+    hkv = k_pages.shape[0]
+    if H % hkv:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {hkv}")
+    if block_tables.shape[0] != B or seq_lens.shape != (B,):
+        raise ValueError(
+            f"block_tables {block_tables.shape} / seq_lens "
+            f"{seq_lens.shape} must lead with the slot dim B={B}")
+    sc = scale if scale is not None else 1.0 / (d ** 0.5)
+    mode = _resolve_decode(decode_mode)
+    if mode != "paged":
+        _count_decode_fallback("mode")
+        return _decode_reference(q, k_pages, v_pages, block_tables,
+                                 seq_lens, sc)
+    page = k_pages.shape[2]
+    plan, reason = decode_plan(B, H, hkv, d, page,
+                               block_tables.shape[1], q.dtype.itemsize)
+    if plan is None:
+        _count_decode_fallback(reason)
+        return _decode_reference(q, k_pages, v_pages, block_tables,
+                                 seq_lens, sc)
+    g = H // hkv
+    gp = plan["gp"]
+    q4 = q.reshape(B, hkv, g, d)
+    if gp != g:
+        q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+    lens = seq_lens.astype(jnp.int32)
+    bt = block_tables.astype(jnp.int32)
+    out = _flash_decode_paged(q4, k_pages, v_pages, bt, lens, sc, gp)
+    return out[:, :, :g, :].reshape(B, H, d)
+
+
+def kv_cache_append(k_pages, v_pages, block_tables, seq_lens,
+                    k_new, v_new, active=None):
+    """Write each slot's NEW token into its page chain in place and
+    advance the length: ``k_new``/``v_new`` are (B, H_kv, d), the write
+    lands at logical position ``seq_lens[b]`` — pool page
+    ``block_tables[b, pos // page]``, row ``pos % page``.  Returns
+    ``(k_pages', v_pages', seq_lens')``.
+
+    ``active`` (optional (B,) bool) masks retired slots: an inactive
+    slot's cache and length are left untouched (its target row is
+    written back unchanged — a scatter lane must name SOME row, so
+    block-table rows stay valid-for-writing even while retired, which
+    slot disjointness guarantees).  Callers own two invariants: block
+    tables name DISJOINT pool pages across slots, and ``seq_lens`` never
+    grows past ``pages_max * page``.  Fully functional (jit/scan-safe):
+    XLA's donation turns the ``.at[].set`` into an in-place update in a
+    compiled step."""
+    B = k_new.shape[0]
+    page = k_pages.shape[2]
+    pos = seq_lens.astype(jnp.int32)
+    pidx = jnp.take_along_axis(block_tables.astype(jnp.int32),
+                               (pos // page)[:, None], axis=1)[:, 0]
+    off = pos % page
+    kn = jnp.swapaxes(k_new, 0, 1).astype(k_pages.dtype)   # (hkv, B, d)
+    vn = jnp.swapaxes(v_new, 0, 1).astype(v_pages.dtype)
+    if active is not None:
+        keep = active[None, :, None]
+        kn = jnp.where(keep, kn, k_pages[:, pidx, off, :])
+        vn = jnp.where(keep, vn, v_pages[:, pidx, off, :])
+        new_lens = seq_lens + active.astype(seq_lens.dtype)
+    else:
+        new_lens = seq_lens + 1
+    return (k_pages.at[:, pidx, off, :].set(kn),
+            v_pages.at[:, pidx, off, :].set(vn),
+            new_lens)
+
+
 def _flash_bwd_kv(q, k, v, do, lse, dd, causal, sc, block_q, block_k):
     H, S, d = q.shape
     nq, nk = S // block_q, S // block_k

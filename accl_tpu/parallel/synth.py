@@ -381,6 +381,27 @@ def _gen_multiaxis(op, topo: Topology, N: int, model: CostModel):
         params=(("shape2d", tuple(topo.axes)),))
 
 
+def _latency_plan(op: operation, topo: Topology, nbytes: int,
+                  cfg: ACCLConfig) -> SchedulePlan:
+    """The α-dominated small-message regime ("Optimizing Communication
+    for Latency Sensitive HPC Applications", arxiv 2403.18374: the
+    algorithm choice FLIPS at small sizes): below
+    ``cfg.latency_tier_threshold`` the bandwidth terms are noise and
+    hop count rules, so the candidate space is the latency family —
+    XLA's log-depth single shot, the 2-hop flat star (root links carry
+    (P−1)·N, irrelevant at token-sized payloads) and the binary tree —
+    and the argmin of predicted α-β cost wins.  Flat/tree only exist
+    for allreduce (the rooted builders); allgather/reduce_scatter keep
+    the log-depth single shot, still resolved (and counted) through
+    the tier so the decision is attributable."""
+    model = CostModel.from_config(cfg, topo.transport)
+    N = _payload_total(op, nbytes, topo.world)
+    cands = [p for p in (_gen_xla(op, topo, N, model),
+                         _gen_flat(op, topo, N, model),
+                         _gen_tree(op, topo, N, model)) if p is not None]
+    return min(cands, key=lambda p: p.predicted_us)
+
+
 def _gen_hier(op, topo: Topology, N: int, model: CostModel):
     """The existing two-tier split (row reduce-scatter, cross-axis
     allreduce on the shard, row all-gather) — kept as its own candidate
@@ -496,7 +517,8 @@ def plan_cache_stats() -> Tuple[int, ...]:
 
 def _cost_fingerprint(cfg: ACCLConfig) -> tuple:
     return (cfg.sched_synthesis, cfg.sched_alpha_us, cfg.sched_beta_gbps,
-            cfg.sched_dcn_alpha_us, cfg.sched_dcn_beta_gbps)
+            cfg.sched_dcn_alpha_us, cfg.sched_dcn_beta_gbps,
+            cfg.latency_tier_threshold)
 
 
 def resolve(op: operation, nbytes: int, comm, cfg: ACCLConfig,
@@ -507,24 +529,34 @@ def resolve(op: operation, nbytes: int, comm, cfg: ACCLConfig,
     plan deviates from it only when
 
     * synthesis is enabled (``cfg.sched_synthesis``),
-    * the topology has ≥ 2 axes (declared or coordinate-detected) on a
-      single-slice transport (the DCN two-tier story stays with the
-      host-aligned hierarchical path),
+    * the transport is single-slice (the DCN two-tier story stays with
+      the host-aligned hierarchical path),
     * no governing legacy register carries an autotune seed
       (:data:`_SEED_FIELDS` — seeds are explicit overrides), and
-    * the multi-axis candidate's predicted α-β cost beats the legacy
+    * EITHER the payload sits below ``cfg.latency_tier_threshold`` —
+      the α-dominated small-message tier, where the latency family
+      (flat / tree / xla log-depth) is searched on any topology
+      (:func:`_latency_plan`, source ``latency_tier``) — OR the
+      topology has ≥ 2 axes (declared or coordinate-detected) and the
+      multi-axis candidate's predicted α-β cost beats the legacy
       family's.
 
     Everything else returns the legacy decision wrapped in its plan —
-    so single-axis meshes with default config resolve EXACTLY as before
-    the refactor (pinned by tests/test_synth.py equivalence tests)."""
+    so meshes with default config resolve EXACTLY as before the
+    refactor for every payload at or above the latency threshold
+    (pinned by tests/test_synth.py equivalence tests)."""
     topo = topology_of(comm, cfg)
     # the governing legacy registers are part of the key: a seeded config
     # must never hit a default-config plan (and vice versa) even when
     # both ladders happened to pick the same legacy algorithm
     seeds = tuple(getattr(cfg, f) for f in _SEED_FIELDS.get(op, ()))
-    key = (op, topo, _metrics.size_bucket(nbytes), legacy, seeds,
-           _cost_fingerprint(cfg))
+    # the latency threshold cuts INSIDE a size bucket (8 KiB sits in the
+    # <=16KiB bin), so the tier membership must be part of the key — a
+    # sub-threshold payload must never be served the legacy plan its
+    # above-threshold bucket-mate cached (and vice versa)
+    in_latency_tier = nbytes < cfg.latency_tier_threshold
+    key = (op, topo, _metrics.size_bucket(nbytes), in_latency_tier,
+           legacy, seeds, _cost_fingerprint(cfg))
     with _plan_lock:
         plan = _plan_cache.get(key)
     if plan is not None:
@@ -533,9 +565,20 @@ def resolve(op: operation, nbytes: int, comm, cfg: ACCLConfig,
         return plan
     _metrics.inc("accl_sched_plan_cache_total", labels=(("event", "miss"),))
 
-    if (not cfg.sched_synthesis or not topo.multi_axis
+    if (not cfg.sched_synthesis
             or topo.transport == TransportBackend.DCN
             or op not in SYNTH_OPS):
+        plan = dataclasses.replace(
+            _plan_for_algo(legacy, op, topo, nbytes, cfg), source="legacy")
+    elif in_latency_tier and not _seed_overridden(op, cfg):
+        # the small-message latency tier: α dominates, so the cost model
+        # searches the latency family (flat/tree/xla) on ANY topology —
+        # single-axis meshes included (the one place synthesis deviates
+        # without a torus). Seeded registers still pin the ladder, and
+        # the DCN guard above keeps the two-tier story intact.
+        plan = dataclasses.replace(
+            _latency_plan(op, topo, nbytes, cfg), source="latency_tier")
+    elif not topo.multi_axis:
         plan = dataclasses.replace(
             _plan_for_algo(legacy, op, topo, nbytes, cfg), source="legacy")
     elif _seed_overridden(op, cfg):

@@ -77,6 +77,9 @@ KNOWN_LANES = (
     "hp_compression_cast_roundtrip", "combine_pallas_vs_jnp",
     "flash_attention", "flash_bwd", "cmdlist_chain_combine",
     "small_op_fused_latency",
+    # round 13 (inference serving): the first LATENCY lanes — p50/p99
+    # per launch, direction=lower (bench/compare.py inverts)
+    "flash_decode", "coll_latency",
 )
 
 
@@ -422,6 +425,18 @@ def main(argv=None) -> int:
             # all_gather), with the cost model's predictions on record
             ("sched_synth",
              lambda: _lanes.bench_sched_synth(comm, cfg=acc.config)),
+            # round 13 (inference serving): per-launch p50/p99 LATENCY
+            # lanes, direction=lower — the token-sized allreduce under
+            # the latency tier vs XLA, and the paged decode kernel
+            ("coll_latency",
+             lambda: _lanes.bench_coll_latency(comm, cfg=acc.config)),
+            # off-silicon the decode kernel runs per-element in the
+            # interpreter (~seconds per launch at the real shape) and
+            # the lane is unresolved anyway — keep the smoke tiny
+            ("flash_decode",
+             lambda: (_lanes.bench_flash_decode() if on_tpu
+                      else _lanes.bench_flash_decode(
+                          B=2, H=4, page=8, pages_max=2, rounds=3))),
         ):
             if not _lane_selected(lanes_filter, name):
                 continue
@@ -466,6 +481,9 @@ def main(argv=None) -> int:
                 ("combine_pallas_vs_jnp", lanes.bench_combine_pallas_vs_jnp),
                 ("flash_attention", lanes.bench_flash),
                 ("flash_bwd", lanes.bench_flash_bwd),
+                # round 13: the paged decode kernel's p50/p99 latency
+                # (direction=lower; single-chip — per-chip kernel)
+                ("flash_decode", lanes.bench_flash_decode),
                 ("cmdlist_chain_combine",
                  lambda: lanes.bench_cmdlist_chain(acc)),
                 ("small_op_fused_latency",

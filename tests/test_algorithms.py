@@ -161,8 +161,15 @@ def test_factor2d():
 def test_auto_selection_thresholds(accl):
     cfg = accl.config
     comm = accl.global_comm()
-    # small payload -> XLA
-    assert algorithms.select(operation.allreduce, 1024, comm, cfg) == Algorithm.XLA
+    # token-sized payload -> the latency tier's flat star (round 13: the
+    # α-dominated regime below latency_tier_threshold; 2 hops beat XLA's
+    # log-depth 6 at this world size)
+    assert algorithms.select(operation.allreduce, 1024, comm, cfg) \
+        == Algorithm.FLAT
+    # just above the latency threshold -> XLA, exactly as pre-refactor
+    assert algorithms.select(
+        operation.allreduce, cfg.latency_tier_threshold, comm, cfg) \
+        == Algorithm.XLA
     # large payload -> RING
     assert algorithms.select(
         operation.allreduce, 8 * 1024 * 1024, comm, cfg) == Algorithm.RING

@@ -392,8 +392,11 @@ def test_sched_synth_lane_schema(accl):
         assert r["flat_ring_us"] > 0 and r["multiaxis_us"] > 0
         assert r["predicted_multiaxis_us"] > 0
         assert r["predicted_flat_ring_us"] > r["predicted_multiaxis_us"]
-        assert r["plan_shape"] in ("xla", "ring", "kring", "multiaxis",
-                                   "hier")
+        # the small allreduce/allgather payloads here sit below the
+        # latency tier threshold, so the flat star joins the shapes the
+        # plan may resolve (round 13)
+        assert r["plan_shape"] in ("xla", "flat", "tree", "ring", "kring",
+                                   "multiaxis", "hier")
 
 
 def test_sched_synth_lane_resolves_on_declared_torus(accl):
@@ -473,3 +476,111 @@ def test_compare_loads_driver_wrapper_artifacts(tmp_path):
         {"n": 1, "rc": 1, "tail": "Traceback ...", "parsed": None}))
     with pytest.raises(ValueError, match="crashed round"):
         compare.load_artifact(str(crashed))
+
+
+def test_flash_decode_lane_schema():
+    """Round-13 latency lane protocol: dense + GQA rows report p50/p99
+    in µs with direction=lower, honesty flags pin the kernel that ran
+    (paged plan admitted, but fused_engaged False off-silicon — the
+    timing measures the interpreter), raws stay on the record, and an
+    unresolved lane zeroes its headline."""
+    from accl_tpu.bench import lanes
+
+    rows = lanes.bench_flash_decode(B=2, H=4, d=128, page=8,
+                                    pages_max=2, rounds=2)
+    assert [r["metric"] for r in rows] == ["flash_decode_dense",
+                                          "flash_decode_gqa"]
+    for r in rows:
+        assert r["unit"] == "us" and r["direction"] == "lower"
+        assert r["plan_mode"] == "paged"      # tiny shape fits the plan
+        assert r["plan_reason"] == "ok"
+        assert r["fused_engaged"] is False    # no TPU backend here
+        assert r["resolved"] == r["fused_engaged"]
+        assert r["value"] == 0.0              # unresolved -> zeroed
+        assert r["p50_us"] > 0 and r["p99_us"] >= r["p50_us"]
+        assert r["raw_best_us"] > 0 and r["raw_worst_us"] >= r["p50_us"]
+    assert rows[0]["H_kv"] == 4 and rows[1]["H_kv"] == 1
+
+
+def test_coll_latency_lane_schema(accl):
+    """The small-message collective latency lane: resolved only when
+    the latency tier OWNS the decision (source=latency_tier); a
+    disabled tier reports its raw A/B with a zeroed headline; both
+    sides' p50/p99 and the speedup ratios are always on the record."""
+    from accl_tpu.bench import lanes
+
+    comm = accl.global_comm()
+    rows = lanes.bench_coll_latency(comm, cfg=accl.config, nbytes=1024,
+                                    rounds=2)
+    assert [r["metric"] for r in rows] == ["coll_latency_allreduce"]
+    r = rows[0]
+    assert r["unit"] == "us" and r["direction"] == "lower"
+    assert r["plan_source"] == "latency_tier"
+    assert r["plan_shape"] == "flat"          # 8-rank α-dominated pick
+    assert r["resolved"] is True
+    assert r["value"] == r["p50_us"] > 0
+    assert r["p99_us"] >= r["p50_us"]
+    assert r["xla_p50_us"] > 0 and r["xla_p99_us"] > 0
+    assert r["speedup_p50"] is not None
+
+    off = accl.config.replace(latency_tier_threshold=0)
+    [r] = lanes.bench_coll_latency(comm, cfg=off, nbytes=1024, rounds=2)
+    assert r["plan_source"] == "legacy" and r["resolved"] is False
+    assert r["value"] == 0.0 and r["p50_us"] > 0   # raws survive
+
+
+def test_bench_compare_latency_direction(tmp_path):
+    """Satellite (ISSUE 8): lower-is-better lanes invert the regression
+    polarity — p99 UP 20% is the regression, DOWN 20% the improvement —
+    while untagged lanes keep the historical higher-is-better rule, and
+    the CLI exit-code contract (tools/ci_gate.sh) is unchanged."""
+    import json as _json
+
+    from accl_tpu.bench import compare
+
+    def art(lat_val, bw_val):
+        return {"metric": "allreduce_ring_algbw_8dev", "value": 10.0,
+                "lanes": [
+                    {"metric": "coll_latency_allreduce", "value": lat_val,
+                     "resolved": True, "direction": "lower"},
+                    {"metric": "cmatmul_ag", "value": bw_val,
+                     "resolved": True}]}
+
+    base = art(100.0, 1.5)
+    # latency UP 20% -> regression (pre-fix this read as "improvement")
+    out = compare.compare(base, art(120.0, 1.5))
+    st = {r["metric"]: r for r in out["rows"]}
+    assert st["coll_latency_allreduce"]["status"] == "regression"
+    assert st["coll_latency_allreduce"]["direction"] == "lower"
+    assert out["regressions"] == ["coll_latency_allreduce"]
+    # latency DOWN 20% -> improvement, not a regression
+    out = compare.compare(base, art(80.0, 1.5))
+    st = {r["metric"]: r["status"] for r in out["rows"]}
+    assert st["coll_latency_allreduce"] == "improvement"
+    assert not out["regressed"]
+    # higher-is-better lanes keep their polarity beside the tagged one
+    out = compare.compare(base, art(100.0, 1.0))
+    st = {r["metric"]: r["status"] for r in out["rows"]}
+    assert st["cmatmul_ag"] == "regression"
+    assert st["coll_latency_allreduce"] == "ok"
+    # a direction tag present on only ONE side still inverts (a round
+    # that ADDED the tag must not flip the comparison's meaning)
+    untagged = art(100.0, 1.5)
+    del untagged["lanes"][0]["direction"]
+    out = compare.compare(untagged, art(120.0, 1.5))
+    st = {r["metric"]: r["status"] for r in out["rows"]}
+    assert st["coll_latency_allreduce"] == "regression"
+    # CLI exit codes: 1 on regression, 0 clean (the ci_gate contract)
+    a = tmp_path / "a.json"
+    a.write_text(_json.dumps(base) + "\n")
+    b = tmp_path / "b.json"
+    b.write_text(_json.dumps(art(120.0, 1.5)) + "\n")
+    assert compare.main([str(a), str(b)]) == 1
+    assert compare.main([str(a), str(a)]) == 0
+
+
+def test_latency_lanes_in_known_lanes():
+    """bench.py --lanes accepts the round-13 lanes."""
+    from bench import KNOWN_LANES
+    assert "flash_decode" in KNOWN_LANES
+    assert "coll_latency" in KNOWN_LANES

@@ -209,7 +209,10 @@ def test_cmdlist_reselects_after_autotune(accl, monkeypatch):
         seen.clear()
         cl.execute()
         second = [g for o, g in seen if o.name == "allreduce"][-1]
-        assert first == Algorithm.XLA and second == Algorithm.RING
+        # first: the token-sized payload rides the latency tier's flat
+        # star (round 13); second: the shrunk ring_threshold is an
+        # autotune seed, which pins the legacy ladder -> RING
+        assert first == Algorithm.FLAT and second == Algorithm.RING
         np.testing.assert_array_equal(y.host, np.full((WORLD, n), WORLD))
     finally:
         accl.config = orig_cfg

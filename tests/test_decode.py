@@ -1,0 +1,282 @@
+"""Continuous-batching decode model (models/decode.py) + the
+decode-shaped small-message load it puts on the eager protocol.
+
+Three layers:
+
+* **model layer** — the tp-sharded decode step is bit-faithful to the
+  single-device oracle across multi-step serving traces with admission
+  and retirement mid-stream, retired slots output zeros and never
+  advance, and the state invariants (disjoint block tables, static
+  shapes) hold;
+* **latency-tier layer** — sub-threshold single-segment sends ride the
+  eager fast path and land in the µs-resolution
+  ``accl_latency_dispatch_seconds`` histogram; payloads past one
+  segment keep the segmented path;
+* **rxpool layer** (ISSUE 8 satellite) — decode-shaped bursty load:
+  many concurrent token-sized eager sends park without loss, the
+  occupancy/backpressure counters tell the story, and the pool
+  recovers fully after exhaustion.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accl_tpu import ACCLError, dataType, errorCode
+from accl_tpu.models import decode as dm
+from accl_tpu.obs import metrics
+
+WORLD = 8
+
+
+def _counter(key: str) -> float:
+    return metrics.snapshot()["counters"].get(key, 0.0)
+
+
+def _mk(rng, *shape, scale=0.1):
+    return jnp.asarray(rng.standard_normal(shape).astype(np.float32)
+                       * np.float32(scale))
+
+
+# ---------------------------------------------------------------------------
+# model layer
+# ---------------------------------------------------------------------------
+
+def _setup(rng, slots=4, d_model=64, H=8, Hkv=4, hd=128, page=8,
+           pmax=2, tp=2):
+    params = dm.init_decode_params(jax.random.PRNGKey(0), d_model, H,
+                                   Hkv, hd)
+    state = dm.init_decode_state(slots, pmax, page, Hkv, hd)
+    mesh = dm.make_decode_mesh(jax.devices()[:tp], tp)
+    return params, state, mesh
+
+
+def test_decode_state_invariants():
+    state = dm.init_decode_state(4, 3, 8, 2, 128)
+    bt = np.asarray(state.block_tables)
+    # disjoint page chains across slots (the kv_cache_append contract)
+    assert len(set(bt.ravel().tolist())) == bt.size
+    assert state.k_pages.shape == (2, 12, 8, 128)
+    assert dm.free_slots(state) == [0, 1, 2, 3]
+    state = dm.admit(state, 2)
+    assert dm.free_slots(state) == [0, 1, 3]
+    state = dm.retire(state, 2)
+    assert dm.free_slots(state) == [0, 1, 2, 3]
+    assert int(state.seq_lens[2]) == 0
+
+
+def test_decode_step_matches_reference(rng):
+    """One tp=2 decode step == the dense single-device oracle (fused or
+    baseline datapath — same math)."""
+    params, state, mesh = _setup(rng)
+    state = dm.admit(dm.admit(state, 0), 2)
+    p_sh, s_sh = dm.shard_decode(params, state, mesh)
+    step = dm.build_decode_step(mesh)
+    x = _mk(rng, 4, 64)
+    y, s1 = step(p_sh, s_sh, x)
+    y_ref, s1_ref = dm.decode_step_reference(params, state, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(np.asarray(s1.seq_lens),
+                                  np.asarray(s1_ref.seq_lens))
+    np.testing.assert_allclose(np.asarray(s1.k_pages),
+                               np.asarray(s1_ref.k_pages),
+                               rtol=1e-6, atol=1e-6)
+    # retired slots: zero output, no cache movement
+    np.testing.assert_array_equal(np.asarray(y[1]), 0.0)
+    assert list(np.asarray(s1.seq_lens)) == [1, 0, 1, 0]
+
+
+def test_decode_continuous_batching_trace(rng):
+    """A serving trace: admissions and retirements mid-stream, unequal
+    per-slot lengths throughout, ONE compiled step program for the whole
+    trace (static shapes), oracle parity at every step."""
+    params, state, mesh = _setup(rng)
+    step = dm.build_decode_step(mesh)
+    p_sh, _ = dm.shard_decode(params, state, mesh)
+    state = dm.admit(state, 0)
+    ref_state = state
+    schedule = {2: ("admit", 3), 4: ("retire", 0), 6: ("admit", 1)}
+    for i in range(8):
+        if i in schedule:
+            op, slot = schedule[i]
+            fn = dm.admit if op == "admit" else dm.retire
+            state, ref_state = fn(state, slot), fn(ref_state, slot)
+        x = _mk(rng, 4, 64)
+        y, state = step(p_sh, state, x)
+        y_ref, ref_state = dm.decode_step_reference(params, ref_state, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_array_equal(np.asarray(state.seq_lens),
+                                      np.asarray(ref_state.seq_lens))
+    # slot 0 retired at step 4 (4 tokens), slot 3 admitted at step 2
+    # (6 tokens), slot 1 at step 6 (2), slot 2 never admitted
+    assert list(np.asarray(state.seq_lens)) == [0, 2, 0, 6]
+    # a re-admitted slot starts a FRESH sequence over the same pages
+    state = dm.admit(state, 0)
+    x = _mk(rng, 4, 64)
+    _, state = step(p_sh, state, x)
+    assert int(state.seq_lens[0]) == 1
+
+
+def test_decode_step_per_slot_gqa_geometry(rng):
+    """GQA under tp: each rank's local heads keep whole groups
+    (H/tp = 4 q heads over Hkv/tp = 2 kv heads), outputs match the
+    oracle."""
+    params, state, mesh = _setup(rng, H=8, Hkv=4, tp=2)
+    state = dm.admit(dm.admit(dm.admit(state, 0), 1), 3)
+    p_sh, _ = dm.shard_decode(params, state, mesh)
+    step = dm.build_decode_step(mesh)
+    for _ in range(3):
+        x = _mk(rng, 4, 64)
+        y, state = step(p_sh, state, x)
+    # final-step parity (the trace test covers per-step)
+    x = _mk(rng, 4, 64)
+    y, s1 = step(p_sh, state, x)
+    # rebuild the oracle's state by replaying is unnecessary: the
+    # sharded state is already the truth — run the oracle FROM it
+    host_state = jax.device_get(state)
+    y_ref, _ = dm.decode_step_reference(params, dm.DecodeState(
+        *[jnp.asarray(a) for a in host_state]), x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_decode_engages_honesty():
+    """The bench lane's fused_engaged flag: False on this rung (no
+    kernel backend), False at tp=1 or indivisible heads — never a
+    degraded unfused claim."""
+    assert dm.decode_engages(8, 64, 8, 4, 128, tp=1) is False
+    assert dm.decode_engages(7, 64, 8, 4, 128, tp=2) is False   # slots%tp
+    assert dm.decode_engages(8, 64, 6, 3, 128, tp=4) is False   # heads%tp
+    from accl_tpu.ops import collective_matmul as cm
+    assert dm.decode_engages(8, 64, 8, 4, 128, tp=2, overlap=True) \
+        == cm._kernels_available()
+
+
+# ---------------------------------------------------------------------------
+# latency-tier layer: the eager fast path
+# ---------------------------------------------------------------------------
+
+def _hist_count(path: str) -> float:
+    h = metrics.snapshot()["histograms"].get(
+        f'accl_latency_dispatch_seconds{{path="{path}"}}')
+    return h["count"] if h else 0
+
+
+def test_eager_fast_path_timed_in_us_histogram(accl, rng):
+    """A sub-threshold single-segment send rides the fast path and is
+    timed into accl_latency_dispatch_seconds{path="eager_send"}; a
+    payload past the threshold keeps the segmented path (no fast-path
+    observation)."""
+    count = 16   # 64 B at f32 — token-sized
+    s = accl.create_buffer(count, dataType.float32)
+    d = accl.create_buffer(count, dataType.float32)
+    s.host[:] = rng.standard_normal((WORLD, count)).astype(np.float32)
+    before = _hist_count("eager_send")
+    accl.send(s, count, src=0, dst=1, tag=21)
+    assert _hist_count("eager_send") == before + 1
+    accl.recv(d, count, src=0, dst=1, tag=21)
+    np.testing.assert_array_equal(d.host[1], s.host[0])
+
+    # 12 KiB: below max_eager but past the 8 KiB latency threshold ->
+    # the segmented path, not the fast path
+    big = 3 * 1024
+    s2 = accl.create_buffer(big, dataType.float32)
+    d2 = accl.create_buffer(big, dataType.float32)
+    s2.host[:] = rng.standard_normal((WORLD, big)).astype(np.float32)
+    before = _hist_count("eager_send")
+    accl.send(s2, big, src=0, dst=1, tag=22)
+    accl.recv(d2, big, src=0, dst=1, tag=22)
+    assert _hist_count("eager_send") == before
+    np.testing.assert_array_equal(d2.host[1], s2.host[0])
+
+
+def test_eager_fast_path_capacity_and_ordering(accl, rng):
+    """The fast path keeps the protocol contract: capacity overflow
+    against a parked recv fails loudly BEFORE consuming a seqn, and
+    seqn ordering across fast/slow paths is preserved."""
+    count = 8
+    s = accl.create_buffer(count, dataType.float32)
+    s.host[:] = rng.standard_normal((WORLD, count)).astype(np.float32)
+    r = accl.create_buffer(4, dataType.float32)
+    req = accl.recv(r, 4, src=2, dst=3, tag=5, run_async=True)
+    with pytest.raises(ACCLError) as e:
+        accl.send(s, count, src=2, dst=3, tag=5)
+    assert e.value.code == errorCode.INVALID_BUFFER_SIZE
+    # the failed send consumed no seqn: a correctly-sized pair drains
+    s4 = accl.create_buffer(4, dataType.float32)
+    s4.host[:] = rng.standard_normal((WORLD, 4)).astype(np.float32)
+    accl.send(s4, 4, src=2, dst=3, tag=5)
+    req.wait()
+    r.sync_from_device()
+    np.testing.assert_array_equal(r.host[3], s4.host[2])
+
+
+# ---------------------------------------------------------------------------
+# rxpool layer (satellite): decode-shaped bursty load
+# ---------------------------------------------------------------------------
+
+def test_publish_tokens_burst_parks_and_drains(accl):
+    """One decode step's token fan-out: world-1 concurrent token-sized
+    eager sends park (one rx-pool slot each), then drain exactly once
+    each — the match-event counters account for every message, and the
+    pool returns to empty."""
+    matcher = accl.matcher()
+    assert matcher.rx_pool.free_slots == matcher.rx_pool.size
+    parked_k = 'accl_match_events_total{event="send_parked"}'
+    matched_k = 'accl_match_events_total{event="recv_matched"}'
+    p0, m0 = _counter(parked_k), _counter(matched_k)
+    tokens = np.arange(4, dtype=np.int32) + 100
+    got = dm.publish_tokens(accl, tokens, src=0, tag=31)
+    assert len(got) == WORLD - 1
+    for arr in got:
+        np.testing.assert_array_equal(arr, tokens)
+    assert _counter(parked_k) - p0 == WORLD - 1
+    assert _counter(matched_k) - m0 == WORLD - 1
+    assert matcher.rx_pool.free_slots == matcher.rx_pool.size
+
+
+def test_rxpool_occupancy_highwater_under_burst(accl):
+    """The burst's peak occupancy is visible in the high-water gauge
+    (the rx-ring headroom signal a serving deployment sizes the pool
+    by)."""
+    dm.publish_tokens(accl, np.zeros(2, np.int32), src=1, tag=33)
+    hw = metrics.snapshot()["gauges"].get(
+        "accl_rx_pool_occupancy_highwater", 0.0)
+    assert hw >= WORLD - 1
+
+
+def test_rxpool_exhaustion_and_recovery(accl, rng):
+    """Decode-shaped backpressure end to end: token-sized sends on ONE
+    pair until the pool is exhausted (the 17th send gets NOT_READY and
+    the exhaustion counter ticks — a retryable state, not corruption),
+    then a receiver drains everything in order and the pool serves new
+    traffic again."""
+    matcher = accl.matcher()
+    pool = matcher.rx_pool
+    nslots = pool.size
+    assert pool.free_slots == nslots
+    count = 8
+    s = accl.create_buffer(count, dataType.float32)
+    s.host[:] = rng.standard_normal((WORLD, count)).astype(np.float32)
+    ex_k = "accl_rx_pool_exhausted_total"
+    e0 = _counter(ex_k)
+    for _ in range(nslots):
+        accl.send(s, count, src=4, dst=5, tag=44)
+    assert pool.free_slots == 0
+    with pytest.raises(ACCLError) as e:
+        accl.send(s, count, src=4, dst=5, tag=44)
+    assert e.value.code == errorCode.NOT_READY_ERROR
+    assert _counter(ex_k) == e0 + 1
+    # drain: every parked segment delivers in seqn order
+    r = accl.create_buffer(count, dataType.float32)
+    for _ in range(nslots):
+        accl.recv(r, count, src=4, dst=5, tag=44)
+    assert pool.free_slots == nslots
+    # recovered: the pair serves new traffic
+    accl.send(s, count, src=4, dst=5, tag=45)
+    accl.recv(r, count, src=4, dst=5, tag=45)
+    r.sync_from_device()
+    np.testing.assert_array_equal(r.host[5], s.host[4])

@@ -495,3 +495,231 @@ def test_flash_packed_fallback_envelope(rng):
             np.asarray(flash.flash_attention_packed(q, k, v)),
             np.asarray(flash.flash_attention(q, k, v)),
             rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# flash DECODE (round 13): single-query/GQA paged-KV attention
+# ---------------------------------------------------------------------------
+
+import jax.numpy as jnp  # noqa: E402
+
+
+def _mk_paged(rng, hkv, B, pages_max, page, d, shuffle=True):
+    """A filled page pool + per-slot block tables. ``shuffle`` permutes
+    the pool pages so the table indirection is actually exercised (an
+    identity table would hide a broken index map)."""
+    n_pages = B * pages_max
+    kp = jnp.asarray(rng.standard_normal((hkv, n_pages, page, d))
+                     .astype(np.float32) * 0.1)
+    vp = jnp.asarray(rng.standard_normal((hkv, n_pages, page, d))
+                     .astype(np.float32) * 0.1)
+    perm = (rng.permutation(n_pages) if shuffle
+            else np.arange(n_pages)).astype(np.int32)
+    bt = jnp.asarray(perm.reshape(B, pages_max))
+    return kp, vp, bt
+
+
+def _decode_ref(q, kp, vp, bt, lens):
+    """fp64 host oracle: gather each slot's chain, one masked softmax."""
+    q, kp, vp = (np.asarray(a, np.float64) for a in (q, kp, vp))
+    bt, lens = np.asarray(bt), np.asarray(lens)
+    B, H, d = q.shape
+    hkv, _, page, _ = kp.shape
+    g = H // hkv
+    out = np.zeros((B, H, d))
+    for b in range(B):
+        if lens[b] == 0:
+            continue
+        k = kp[:, bt[b]].reshape(hkv, -1, d)[:, :lens[b]]
+        v = vp[:, bt[b]].reshape(hkv, -1, d)[:, :lens[b]]
+        for h in range(H):
+            s = k[h // g] @ q[b, h] / np.sqrt(d)
+            s -= s.max()
+            w = np.exp(s)
+            w /= w.sum()
+            out[b, h] = w @ v[h // g]
+    return out
+
+
+@pytest.mark.parametrize("H,hkv", [(4, 4), (8, 2)])
+def test_flash_decode_matches_reference(rng, H, hkv):
+    """Dense + GQA paged decode vs the fp64 oracle, per-slot lengths
+    covering zero (retired), a partial tail page, an exact page
+    boundary, and a full cache."""
+    B, d, page, pmax = 4, 128, 8, 4
+    kp, vp, bt = _mk_paged(rng, hkv, B, pmax, page, d)
+    lens = jnp.asarray([0, 5, 16, 32], jnp.int32)
+    q = jnp.asarray(rng.standard_normal((B, H, d)).astype(np.float32) * 0.1)
+    out = flash.flash_decode(q, kp, vp, bt, lens)
+    np.testing.assert_allclose(np.asarray(out),
+                               _decode_ref(q, kp, vp, bt, lens),
+                               rtol=2e-5, atol=2e-5)
+    # the retired slot is exact zeros, not NaN
+    assert not np.isnan(np.asarray(out)).any()
+    np.testing.assert_array_equal(np.asarray(out[0]), 0.0)
+    # and the paged kernel agrees with the unpaged lax reference bitwise
+    # in geometry (same shapes), closely in value
+    ref = flash.flash_decode(q, kp, vp, bt, lens, decode_mode="unpaged")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_flash_decode_causal_page_boundary(rng):
+    """Tokens AT or past each slot's live length contribute nothing:
+    poisoning every dead position (tail-page remainder + dead pages)
+    with huge values must not move the output — the causal mask at the
+    page boundary."""
+    B, H, d, page, pmax = 2, 4, 128, 8, 3
+    kp, vp, bt = _mk_paged(rng, H, B, pmax, page, d)
+    lens = jnp.asarray([5, 17], jnp.int32)
+    q = jnp.asarray(rng.standard_normal((B, H, d)).astype(np.float32) * 0.1)
+    clean = np.asarray(flash.flash_decode(q, kp, vp, bt, lens))
+    kp_np, vp_np = np.asarray(kp).copy(), np.asarray(vp).copy()
+    bt_np, lens_np = np.asarray(bt), np.asarray(lens)
+    for b in range(B):
+        for j in range(pmax):
+            pg = bt_np[b, j]
+            dead_from = max(0, min(page, int(lens_np[b]) - j * page))
+            kp_np[:, pg, dead_from:] = 1e6
+            vp_np[:, pg, dead_from:] = 1e6
+    poisoned = np.asarray(flash.flash_decode(
+        q, jnp.asarray(kp_np), jnp.asarray(vp_np), bt, lens))
+    np.testing.assert_allclose(poisoned, clean, rtol=1e-6, atol=1e-6)
+
+
+def test_flash_decode_growing_lengths(rng):
+    """The serving loop: append a token, decode, repeat — paged output
+    tracks the oracle at every length, across page boundaries, with NO
+    shape change anywhere (the no-recompilation contract)."""
+    B, H, d, page, pmax = 2, 4, 128, 8, 3
+    kp, vp, bt = _mk_paged(rng, H, B, pmax, page, d)
+    kp = jnp.zeros_like(kp)
+    vp = jnp.zeros_like(vp)
+    lens = jnp.zeros((B,), jnp.int32)
+    shapes = (kp.shape, vp.shape)
+    for step in range(12):
+        k_new = jnp.asarray(rng.standard_normal((B, H, d))
+                            .astype(np.float32) * 0.1)
+        v_new = jnp.asarray(rng.standard_normal((B, H, d))
+                            .astype(np.float32) * 0.1)
+        kp, vp, lens = flash.kv_cache_append(kp, vp, bt, lens,
+                                             k_new, v_new)
+        q = jnp.asarray(rng.standard_normal((B, H, d))
+                        .astype(np.float32) * 0.1)
+        out = flash.flash_decode(q, kp, vp, bt, lens)
+        np.testing.assert_allclose(np.asarray(out),
+                                   _decode_ref(q, kp, vp, bt, lens),
+                                   rtol=2e-5, atol=2e-5)
+        assert (kp.shape, vp.shape) == shapes
+        assert list(np.asarray(lens)) == [step + 1] * B
+
+
+def test_kv_cache_append_placement(rng):
+    """The append lands each slot's token at pool page
+    ``bt[b, len//page]`` row ``len%page`` — pinned across a page
+    boundary — and the ``active`` mask leaves retired slots' cache AND
+    length untouched."""
+    B, hkv, d, page, pmax = 3, 2, 128, 8, 2
+    kp, vp, bt = _mk_paged(rng, hkv, B, pmax, page, d)
+    before_k = np.asarray(kp).copy()
+    lens = jnp.asarray([7, 8, 3], jnp.int32)   # boundary, fresh page, mid
+    k_new = jnp.asarray(rng.standard_normal((B, hkv, d))
+                        .astype(np.float32))
+    v_new = jnp.asarray(rng.standard_normal((B, hkv, d))
+                        .astype(np.float32))
+    active = jnp.asarray([True, True, False])
+    kp2, vp2, lens2 = flash.kv_cache_append(kp, vp, bt, lens, k_new,
+                                            v_new, active=active)
+    assert list(np.asarray(lens2)) == [8, 9, 3]
+    kp2_np, bt_np = np.asarray(kp2), np.asarray(bt)
+    # slot 0: row 7 of its page 0 (last row before the boundary)
+    np.testing.assert_array_equal(kp2_np[:, bt_np[0, 0], 7],
+                                  np.asarray(k_new)[0])
+    # slot 1: row 0 of its SECOND page (crossed the boundary)
+    np.testing.assert_array_equal(kp2_np[:, bt_np[1, 1], 0],
+                                  np.asarray(k_new)[1])
+    # retired slot 2: its would-be row is untouched
+    np.testing.assert_array_equal(kp2_np[:, bt_np[2, 0], 3],
+                                  before_k[:, bt_np[2, 0], 3])
+
+
+def test_decode_plan_policy():
+    """The paged path's block policy: lane-exact head dims and
+    sublane-tiled pages or it declines with the right reason; the GQA
+    group tile is the 8-sublane round-up; a page geometry that misses
+    the VMEM budget declines as vmem_miss."""
+    plan, r = flash.decode_plan(4, 8, 2, 128, 16, 8)
+    assert r == "ok" and plan["gp"] == 8 and plan["dp"] == 128
+    plan, r = flash.decode_plan(4, 16, 1, 128, 16, 8)   # g=16 -> gp=16
+    assert r == "ok" and plan["gp"] == 16
+    assert flash.decode_plan(4, 8, 2, 64, 16, 8) == (None, "geometry")
+    assert flash.decode_plan(4, 8, 2, 128, 12, 8) == (None, "geometry")
+    assert flash.decode_plan(4, 8, 3, 128, 16, 8) == (None, "geometry")
+    # a page so deep the double-buffered pair overflows scoped VMEM
+    assert flash.decode_plan(4, 8, 2, 128, 1 << 14, 2, itemsize=4) \
+        == (None, "vmem_miss")
+
+
+def test_flash_decode_fallback_counted_and_correct(rng):
+    """Declines are COUNTED per reason and the unpaged reference that
+    runs instead is still correct (d=64 misses the lane-exact geometry
+    -> reason=geometry; decode_mode=unpaged -> reason=mode)."""
+    from accl_tpu.obs import metrics
+
+    def counter(reason):
+        return metrics.snapshot()["counters"].get(
+            f'accl_flash_decode_fallback_total{{reason="{reason}"}}', 0.0)
+
+    B, H, d, page, pmax = 2, 4, 64, 8, 2
+    kp, vp, bt = _mk_paged(rng, H, B, pmax, page, d)
+    lens = jnp.asarray([3, 9], jnp.int32)
+    q = jnp.asarray(rng.standard_normal((B, H, d)).astype(np.float32) * 0.1)
+    g0 = counter("geometry")
+    out = flash.flash_decode(q, kp, vp, bt, lens)
+    assert counter("geometry") == g0 + 1
+    np.testing.assert_allclose(np.asarray(out),
+                               _decode_ref(q, kp, vp, bt, lens),
+                               rtol=2e-5, atol=2e-5)
+    m0 = counter("mode")
+    flash.flash_decode(q, kp, vp, bt, lens, decode_mode="unpaged")
+    assert counter("mode") == m0 + 1
+
+
+def test_flash_decode_mode_wiring(accl):
+    """ACCLConfig.flash_decode writes through to the kernel module on
+    EVERY config assignment (the flash_bwd discipline), and bogus modes
+    fail loudly at both seams."""
+    fmod = flash
+    assert fmod.get_flash_decode_mode() == "paged"
+    orig = accl.config
+    try:
+        accl.config = accl.config.replace(flash_decode="unpaged")
+        assert fmod.get_flash_decode_mode() == "unpaged"
+    finally:
+        accl.config = orig
+    assert fmod.get_flash_decode_mode() == "paged"
+    with pytest.raises(ValueError, match="flash_decode"):
+        fmod.set_flash_decode_mode("nope")
+    with pytest.raises(ValueError, match="decode_mode"):
+        flash.flash_decode(
+            jnp.zeros((1, 4, 128), jnp.float32),
+            jnp.zeros((4, 2, 8, 128), jnp.float32),
+            jnp.zeros((4, 2, 8, 128), jnp.float32),
+            jnp.zeros((1, 2), jnp.int32),
+            jnp.zeros((1,), jnp.int32), decode_mode="bogus")
+
+
+def test_flash_decode_rejects_bad_shapes(rng):
+    kp = jnp.zeros((2, 4, 8, 128), jnp.float32)
+    vp = jnp.zeros((2, 4, 8, 128), jnp.float32)
+    bt = jnp.zeros((2, 2), jnp.int32)
+    lens = jnp.zeros((2,), jnp.int32)
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        flash.flash_decode(jnp.zeros((2, 3, 128), jnp.float32),
+                           kp, vp, bt, lens)
+    with pytest.raises(ValueError, match="incompatible"):
+        flash.flash_decode(jnp.zeros((2, 4, 64), jnp.float32),
+                           kp, vp, bt, lens)
+    with pytest.raises(ValueError, match="slot dim"):
+        flash.flash_decode(jnp.zeros((3, 4, 128), jnp.float32),
+                           kp, vp, bt, jnp.zeros((3,), jnp.int32))

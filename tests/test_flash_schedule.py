@@ -152,3 +152,57 @@ def test_flash_packed_fused_bwd_lowers_for_v5e(tpu_dev):
                (H, S, d), (H, S, d), (H, S, d), min_kernels=2)
     from conftest import MOSAIC_CALL
     assert len(MOSAIC_CALL.findall(txt)) == 2
+
+
+@pytest.mark.parametrize("H,hkv,geometry", [
+    (8, 8, "dense decode (g=1 padded to the 8-sublane tile)"),
+    (8, 2, "GQA decode (g=4 group in one tile)"),
+])
+def test_flash_decode_lowers_for_v5e(tpu_dev, H, hkv, geometry):
+    """Round 13: the paged decode kernel Mosaic-compiles for v5e at both
+    head layouts, as EXACTLY one kernel — a second kernel (or zero)
+    means the unpaged lax reference silently engaged — and the plan the
+    policy resolves is pinned."""
+    from conftest import MOSAIC_CALL
+    B, d, page, pmax = 4, 128, 64, 8
+    plan, reason = flash.decode_plan(B, H, hkv, d, page, pmax, 2)
+    assert reason == "ok" and plan["gp"] == 8 and plan["dp"] == d, geometry
+
+    sh = jax.sharding.SingleDeviceSharding(tpu_dev)
+    n_pages = B * pmax
+    args = [
+        jax.ShapeDtypeStruct((B, H, d), jnp.bfloat16, sharding=sh),
+        jax.ShapeDtypeStruct((hkv, n_pages, page, d), jnp.bfloat16,
+                             sharding=sh),
+        jax.ShapeDtypeStruct((hkv, n_pages, page, d), jnp.bfloat16,
+                             sharding=sh),
+        jax.ShapeDtypeStruct((B, pmax), jnp.int32, sharding=sh),
+        jax.ShapeDtypeStruct((B,), jnp.int32, sharding=sh),
+    ]
+    with jax.enable_x64(False), pallas_ring.aot_lowering():
+        compiled = jax.jit(flash.flash_decode).lower(*args).compile()
+    txt = assert_aot_lowered(compiled, 1)
+    assert len(MOSAIC_CALL.findall(txt)) == 1, geometry
+
+
+def test_flash_decode_step_with_append_lowers_for_v5e(tpu_dev):
+    """The serving step's device half — in-place KV append feeding the
+    paged decode kernel — compiles as one program whose buffer plan
+    fits the chip (the .at[].set donation must not double the pools)."""
+    B, H, d, page, pmax = 4, 8, 128, 64, 8
+    sh = jax.sharding.SingleDeviceSharding(tpu_dev)
+    n_pages = B * pmax
+
+    def step(q, kn, vn, kp, vp, bt, lens):
+        kp, vp, lens = flash.kv_cache_append(kp, vp, bt, lens, kn, vn)
+        return flash.flash_decode(q, kp, vp, bt, lens), kp, vp, lens
+
+    f16 = lambda s: jax.ShapeDtypeStruct(s, jnp.bfloat16, sharding=sh)
+    i32 = lambda s: jax.ShapeDtypeStruct(s, jnp.int32, sharding=sh)
+    args = [f16((B, H, d)), f16((B, H, d)), f16((B, H, d)),
+            f16((H, n_pages, page, d)), f16((H, n_pages, page, d)),
+            i32((B, pmax)), i32((B,))]
+    with jax.enable_x64(False), pallas_ring.aot_lowering():
+        compiled = jax.jit(step, donate_argnums=(3, 4)).lower(
+            *args).compile()
+    assert_aot_lowered(compiled, 1)

@@ -178,9 +178,16 @@ def test_dispatch_histogram_and_algorithm_labels(accl):
                 if k.startswith("accl_dispatch_seconds")
                 and 'op="allreduce"' in k]
     assert h["count"] == 1 and h["sum"] > 0
-    # the algorithm label names the family that actually dispatched
-    assert any('algorithm="xla"' in k and 'op="allreduce"' in k
+    # the algorithm label names the family that actually dispatched —
+    # a 32-byte allreduce rides the latency tier's flat star (round 13)
+    assert any('algorithm="flat"' in k and 'op="allreduce"' in k
                for k in d["counters"])
+    # and the sub-threshold dispatch also lands in the µs-resolution
+    # latency-tier histogram
+    [(k, h)] = [(k, h) for k, h in d["histograms"].items()
+                if k.startswith("accl_latency_dispatch_seconds")
+                and 'path="collective"' in k]
+    assert h["count"] == 1 and h["sum"] > 0
 
 
 def test_metrics_disabled_records_nothing(accl):
@@ -436,3 +443,33 @@ def test_request_and_match_event_counters(accl):
     assert c.get('accl_requests_total{op="recv",status="completed"}') >= 1.0
     assert any(k.startswith("accl_request_duration_seconds")
                for k in d["histograms"])
+
+
+def test_latency_histogram_us_bucket_geometry():
+    """Round-13 satellite: accl_latency_dispatch_seconds uses the
+    µs-resolution bucket override (2x-spaced through the µs decade) in
+    BOTH export formats, while every other histogram keeps the default
+    edges — a 5 µs and a 100 µs observation must land in different
+    bins (the default 4x buckets put 64-256 µs in ONE bin)."""
+    metrics.observe("accl_latency_dispatch_seconds", 5e-6,
+                    (("path", "test"),))
+    metrics.observe("accl_latency_dispatch_seconds", 100e-6,
+                    (("path", "test"),))
+    snap = metrics.snapshot()
+    h = snap["histograms"]['accl_latency_dispatch_seconds{path="test"}']
+    assert len(h["buckets"]) == len(metrics.US_BUCKETS)
+    assert set(h["buckets"]) == {repr(e) for e in metrics.US_BUCKETS}
+    assert h["buckets"][repr(8e-06)] == 1      # the 5 µs observation
+    assert h["buckets"][repr(0.000128)] == 1   # the 100 µs observation
+    assert h["count"] == 2
+    # a default-bucket histogram is untouched by the override
+    metrics.observe("accl_dispatch_seconds", 5e-6, (("op", "test"),))
+    hd = metrics.snapshot()["histograms"][
+        'accl_dispatch_seconds{op="test"}']
+    assert len(hd["buckets"]) == len(metrics.BUCKETS)
+    # prometheus exposition carries the µs edges cumulatively
+    text = metrics.to_prometheus()
+    line = [ln for ln in text.splitlines()
+            if ln.startswith("accl_latency_dispatch_seconds_bucket")
+            and 'path="test"' in ln and 'le="0.000128"' in ln]
+    assert line and line[0].rstrip().endswith(" 2")

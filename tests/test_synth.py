@@ -197,25 +197,28 @@ def test_cost_model_ordering():
 # resolution layer
 # ---------------------------------------------------------------------------
 
-#: the pre-refactor select() decision table — single-axis meshes with
-#: default config MUST keep resolving to exactly these (the equivalence
-#: pin of the ISSUE acceptance criteria)
+#: the pre-refactor select() decision table AT OR ABOVE the latency
+#: threshold — single-axis meshes with default config MUST keep resolving
+#: to exactly these (the equivalence pin of the ISSUE acceptance
+#: criteria; sub-threshold payloads belong to the latency tier below)
 _EQUIVALENCE = [
-    (TransportBackend.SIM, operation.allreduce, 1024, Algorithm.XLA),
+    (TransportBackend.SIM, operation.allreduce, 8 << 10, Algorithm.XLA),
+    (TransportBackend.SIM, operation.allreduce, 64 << 10, Algorithm.XLA),
     (TransportBackend.SIM, operation.allreduce, 4 << 20, Algorithm.RING),
     (TransportBackend.SIM, operation.allreduce, 16 << 20, Algorithm.RING),
     (TransportBackend.SIM, operation.allreduce, 64 << 20,
      Algorithm.HIERARCHICAL),
-    (TransportBackend.SIM, operation.allgather, 1024, Algorithm.XLA),
+    (TransportBackend.SIM, operation.allgather, 8 << 10, Algorithm.XLA),
     (TransportBackend.SIM, operation.allgather, 4 << 20, Algorithm.RING),
-    (TransportBackend.SIM, operation.reduce_scatter, 1024, Algorithm.XLA),
+    (TransportBackend.SIM, operation.reduce_scatter, 8 << 10,
+     Algorithm.XLA),
     (TransportBackend.SIM, operation.reduce_scatter, 4 << 20,
      Algorithm.RING),
     (TransportBackend.ICI, operation.allreduce, 1 << 20, Algorithm.PALLAS),
     (TransportBackend.ICI, operation.allgather, 1 << 20, Algorithm.PALLAS),
     (TransportBackend.ICI, operation.reduce_scatter, 8 << 20,
      Algorithm.PALLAS),
-    (TransportBackend.ICI, operation.allreduce, 1024, Algorithm.XLA),
+    (TransportBackend.ICI, operation.allreduce, 8 << 10, Algorithm.XLA),
     (TransportBackend.DCN, operation.allreduce, 4 << 20, Algorithm.RING),
 ]
 
@@ -224,14 +227,109 @@ _EQUIVALENCE = [
 def test_single_axis_equivalence_pins(accl, transport, op, nbytes, want):
     """The refactor contract: with default config on a mesh with no
     declared/detected torus, select() returns what the scalar ladder
-    alone returned before synthesis existed."""
+    alone returned before synthesis existed — for every payload at or
+    above ``latency_tier_threshold`` (below it the latency tier may
+    deviate; see the latency-tier tests)."""
     comm = accl.global_comm()
     cfg = accl.config.replace(transport=transport)
+    assert nbytes >= cfg.latency_tier_threshold
     assert synth.torus_shape(comm, cfg) is None
     assert algorithms.select(op, nbytes, comm, cfg) == want
     # and byte-identical to the ladder itself
     assert algorithms.select(op, nbytes, comm, cfg) \
         == algorithms._select_legacy(op, nbytes, comm, cfg)
+
+
+# ---------------------------------------------------------------------------
+# the small-message latency tier (round 13)
+# ---------------------------------------------------------------------------
+
+def test_latency_tier_resolves_flat_below_threshold(accl):
+    """Below ``latency_tier_threshold`` the α-dominated cost model rules:
+    on this 8-rank mesh the 2-hop flat star beats XLA's 6-hop log-depth
+    schedule for token-sized allreduces (arxiv 2403.18374: the algorithm
+    choice flips at small sizes), on ANY topology — single-axis meshes
+    included. The decision is attributable through the existing
+    accl_sched_plan_total labels with source="latency_tier"."""
+    comm = accl.global_comm()
+    # a perturbed α forces fresh cache keys so the plan counter below
+    # increments deterministically (the session plan cache is global)
+    cfg = accl.config.replace(sched_alpha_us=1.0 + 2e-9)
+    assert cfg.latency_tier_threshold == 8 * 1024
+    key = ('accl_sched_plan_total{op="allreduce",shape="flat",'
+           'source="latency_tier"}')
+    before = _counter(key)
+    for nbytes in (64, 1024, 8 * 1024 - 1):
+        assert algorithms.select(operation.allreduce, nbytes, comm, cfg) \
+            == Algorithm.FLAT
+    assert _counter(key) > before
+    # the boundary byte itself belongs to the legacy ladder (exclusive)
+    assert algorithms.select(operation.allreduce, 8 * 1024, comm, cfg) \
+        == Algorithm.XLA
+    # the duals have no rooted flat/tree builders: the tier resolves the
+    # log-depth single shot, still counted through the tier
+    legacy = algorithms._select_legacy(operation.allgather, 1024, comm, cfg)
+    plan = synth.resolve(operation.allgather, 1024, comm, cfg, legacy)
+    assert plan.shape == "xla" and plan.source == "latency_tier"
+
+
+def test_latency_tier_threshold_zero_disables(accl):
+    """latency_tier_threshold=0 switches the tier off: sub-8KiB payloads
+    resolve exactly as the scalar ladder again."""
+    comm = accl.global_comm()
+    off = accl.config.replace(latency_tier_threshold=0)
+    for nbytes in (64, 1024):
+        assert algorithms.select(operation.allreduce, nbytes, comm, off) \
+            == Algorithm.XLA
+        assert algorithms.select(operation.allreduce, nbytes, comm, off) \
+            == algorithms._select_legacy(operation.allreduce, nbytes,
+                                         comm, off)
+
+
+def test_latency_tier_seed_override_pins_legacy(accl):
+    """An autotune-seeded register pins the ladder below the threshold
+    too — seeds are explicit overrides everywhere."""
+    comm = accl.global_comm()
+    cfg = accl.config.replace(ring_threshold=2 * 1024 * 1024)
+    legacy = algorithms._select_legacy(operation.allreduce, 1024, comm, cfg)
+    plan = synth.resolve(operation.allreduce, 1024, comm, cfg, legacy)
+    assert plan.algorithm == legacy == Algorithm.XLA
+    assert plan.source != "latency_tier"
+
+
+def test_latency_tier_dcn_and_synthesis_off_keep_legacy(accl):
+    """The DCN guard and the sched_synthesis switch outrank the tier."""
+    comm = accl.global_comm()
+    dcn = accl.config.replace(transport=TransportBackend.DCN)
+    assert algorithms.select(operation.allreduce, 1024, comm, dcn) \
+        == Algorithm.XLA
+    off = accl.config.replace(sched_synthesis=False)
+    assert algorithms.select(operation.allreduce, 1024, comm, off) \
+        == Algorithm.XLA
+
+
+def test_latency_tier_cache_key_splits_at_threshold(accl):
+    """The threshold byte cuts INSIDE the <=16KiB size bucket, so tier
+    membership must be part of the plan-cache key: a sub-threshold
+    payload and its above-threshold bucket-mate resolve independently
+    (the first caller must not poison the other's plan)."""
+    comm = accl.global_comm()
+    cfg = accl.config
+    legacy = algorithms._select_legacy(operation.allreduce, 12 << 10,
+                                       comm, cfg)
+    above = synth.resolve(operation.allreduce, 12 << 10, comm, cfg, legacy)
+    assert above.source == "legacy" and above.algorithm == Algorithm.XLA
+    legacy2 = algorithms._select_legacy(operation.allreduce, 6 << 10,
+                                        comm, cfg)
+    below = synth.resolve(operation.allreduce, 6 << 10, comm, cfg, legacy2)
+    assert below.source == "latency_tier"
+    assert below.algorithm == Algorithm.FLAT
+    # same bucket, different plans — and both stay cached independently
+    assert metrics.size_bucket(12 << 10) == metrics.size_bucket(6 << 10)
+    assert synth.resolve(operation.allreduce, 12 << 10, comm, cfg,
+                         legacy) is above
+    assert synth.resolve(operation.allreduce, 6 << 10, comm, cfg,
+                         legacy2) is below
 
 
 def test_resolve_multiaxis_on_emulated_2x4(accl):
@@ -244,9 +342,10 @@ def test_resolve_multiaxis_on_emulated_2x4(accl):
     for nbytes in (4 << 20, 16 << 20, 63 << 20):
         assert algorithms.select(operation.allreduce, nbytes, comm, cfg) \
             == Algorithm.MULTIAXIS
-    # small payloads keep XLA's log-depth single shot
+    # small payloads ride the latency tier (α-dominated: the 2-hop flat
+    # star beats log depth at this world size — round 13)
     assert algorithms.select(operation.allreduce, 1024, comm, cfg) \
-        == Algorithm.XLA
+        == Algorithm.FLAT
     # the very top of the range ties the two-tier split -> legacy kept
     assert algorithms.select(operation.allreduce, 128 << 20, comm, cfg) \
         == Algorithm.HIERARCHICAL
