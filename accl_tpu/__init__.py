@@ -24,8 +24,10 @@ from .arithconfig import ArithConfig, DEFAULT_ARITH_CONFIG
 from .buffer import BaseBuffer, Buffer, BufferSlice, DummyBuffer
 from .communicator import Communicator, Rank
 from .config import ACCLConfig, Algorithm, TransportBackend
+from . import fault
 from .constants import (
     ACCLError,
+    ACCLPeerFailedError,
     ACCLTimeoutError,
     TAG_ANY,
     cfgFunc,
@@ -44,6 +46,7 @@ __all__ = [
     "ACCL",
     "ACCLConfig",
     "ACCLError",
+    "ACCLPeerFailedError",
     "ACCLTimeoutError",
     "Algorithm",
     "ArithConfig",
@@ -63,6 +66,7 @@ __all__ = [
     "compressionFlags",
     "dataType",
     "errorCode",
+    "fault",
     "obs",
     "operation",
     "reduceFunction",

@@ -61,6 +61,13 @@ _L_RDV = (("protocol", "rendezvous"),)
 _L_EAGER_X = (("protocol", "eager_cross"),)
 _L_RDV_X = (("protocol", "rendezvous_cross"),)
 
+#: terminal request states a parked continuation must stand down on —
+#: PEER_FAILED included: a request retired by the death verdict must not
+#: keep announcing/matching (delivering into the caller's buffer after
+#: the failure was surfaced)
+_TERMINAL = (requestStatus.COMPLETED, requestStatus.ERROR,
+             requestStatus.PEER_FAILED)
+
 
 class ACCL:
     """Entry point. One instance supervises one device group.
@@ -106,6 +113,15 @@ class ACCL:
         # exists — construction applies the bound itself then)
         if hasattr(self, "_programs"):
             self._programs.set_maxsize(cfg.program_cache_size)
+        # resilience registers write through to the live fabric (the
+        # flash_bwd pattern): the retry/backoff policy and the heartbeat
+        # lease cadence/staleness window follow every config assignment
+        if getattr(self, "_fabric", None) is not None:
+            from . import fault as _fault
+
+            self._fabric.set_resilience(
+                _fault.policy_from_config(cfg),
+                cfg.heartbeat_interval_s, cfg.heartbeat_timeout_s)
 
     def __init__(
         self,
@@ -172,12 +188,16 @@ class ACCL:
         # session nonce (SPMD call discipline keeps it mesh-aligned)
         self._tune_round = 0
         if comm.is_multiprocess:
+            from . import fault as _fault
             from .multiproc import CrossProcessFabric
 
             self._fabric = CrossProcessFabric(
                 timeout=self.config.timeout,
                 eager_window=self.config.eager_rx_buffer_count,
-                eager_seg_bytes=self.config.eager_rx_buffer_size)
+                eager_seg_bytes=self.config.eager_rx_buffer_size,
+                retry_policy=_fault.policy_from_config(self.config),
+                heartbeat_interval_s=self.config.heartbeat_interval_s,
+                heartbeat_timeout_s=self.config.heartbeat_timeout_s)
         # metrics baseline: ACCL.stats() reports the delta since THIS
         # bring-up, so a long-lived process with several sessions gets
         # per-session attribution out of one process-global registry
@@ -216,6 +236,11 @@ class ACCL:
             "parked_continuations": len(self._parked_calls),
             "rx_pool_free": pool.free_slots if pool else None,
             "rx_pool_total": pool.size if pool else None,
+            # liveness verdicts this controller has latched (heartbeat
+            # leases, docs/resilience.md): dead peer PROCESS ids —
+            # every rank a listed process owns is presumed failed
+            "dead_peers": (self._fabric.dead_peers
+                           if self._fabric is not None else []),
         }
         out = []
         for rank, d in enumerate(self._devices):
@@ -286,6 +311,50 @@ class ACCL:
         for comm in self.comms:
             comm.reset_sequences()
         self._programs.clear()
+
+    def recover(self, process_ids: Optional[List[int]] = None) -> int:
+        """Elastic session re-handshake (docs/resilience.md): converge a
+        FRESH session epoch after a peer failure, among every controller
+        that calls this (SPMD-aligned, like any fabric operation) — the
+        surviving ranks after a death verdict, plus any rank whose own
+        session state was poisoned (an injected/caught ``RankDeath``)
+        rejoining elastically.
+
+        Steps, all through the existing reset paths: cancel parked
+        externals and drop the cooperative retry queue; clear matcher /
+        rx-pool / per-pair sequence state; invalidate the program and
+        schedule-plan caches (a recovered mesh must re-resolve, never
+        replay a dead epoch's plans); then bump the fabric epoch — a
+        fresh nonce-derived key namespace, making every leftover
+        announcement/schedule/barrier/lease key of the poisoned epoch
+        unreachable — and re-run the bootstrap handshake (every
+        participant arrives at the new epoch's barrier). Returns the new
+        epoch number (0 when no fabric: single-process recovery is just
+        the local resets).
+
+        The caller contract is fail-stop-per-call, elastic-per-session:
+        the interrupted collective is NOT resumed — its requests were
+        retired with PEER_FAILED/cancel verdicts — the application
+        re-issues work in the new epoch."""
+        # ONE local-reset implementation: soft_reset owns the ordering
+        # invariants (retry queue dropped before matcher state, fabric
+        # tombstones — harmless extra writes to the abandoned namespace)
+        self.soft_reset()
+        from .parallel import synth as _synth
+
+        _synth.reset_plan_cache()
+        epoch = 0
+        if self._fabric is not None:
+            epoch = self._fabric.bump_epoch()
+            # bootstrap re-handshake: all recovering controllers meet at
+            # the fresh namespace's first barrier round (the arrival
+            # counter starts at 0 there by construction). process_ids
+            # names the SURVIVOR set when a rank is truly gone and will
+            # not rejoin; default is the full mesh (elastic rejoin)
+            self._fabric.barrier("epoch", process_ids=process_ids,
+                                 pump=self._pump)
+        log.info("recovered: session epoch %d", epoch)
+        return epoch
 
     # ------------------------------------------------------------------
     # config calls (cfgFunc runtime tier)
@@ -895,7 +964,9 @@ class ACCL:
         """Drive the full cooperative scheduler (parked continuations AND
         the cross-process mover — a parked async send may still need to
         announce while this process blocks here) until ``pred()`` holds;
-        NOT_READY on session timeout."""
+        NOT_READY on session timeout, PEER_FAILED (well inside it) when
+        the heartbeat leases say the peer this wait depends on is dead —
+        the bounded-failure contract of docs/resilience.md."""
         from .multiproc import CrossProcessFabric
 
         deadline = time.monotonic() + self.config.timeout
@@ -906,8 +977,20 @@ class ACCL:
                 CrossProcessFabric.poll_sleep(idle)
             else:
                 idle = 0
+            if self._fabric is not None:
+                self._fabric.raise_if_peer_failed(what)
             if time.monotonic() > deadline:
                 raise ACCLError(errorCode.NOT_READY_ERROR, what)
+
+    def _pump_waiting(self) -> bool:
+        """:meth:`_pump` for blocked request waits: additionally enforces
+        the peer-liveness verdict, so an async request parked on a dead
+        peer retires with PEER_FAILED (Request.wait catches the raise and
+        completes the request with it) instead of pumping forever."""
+        progressed = self._pump()
+        if self._fabric is not None:
+            self._fabric.raise_if_peer_failed("request wait")
+        return progressed
 
     def _park_continuation(self, cont, step: int) -> None:
         """Park a resumable continuation on the cooperative retry queue
@@ -972,12 +1055,12 @@ class ACCL:
                                     False, comm)
             req = Request(operation.send.name, outputs=None, external=True,
                           on_complete=self._queue.retire,
-                          progress=self._pump, comm=comm,
+                          progress=self._pump_waiting, comm=comm,
                           native_registry=self._reqreg)
             self._queue.push(req)
 
             def cont_rdv(step: int) -> Optional[int]:
-                if req.status in (requestStatus.COMPLETED, requestStatus.ERROR):
+                if req.status in _TERMINAL:
                     return None
                 fab.drive()
                 if not fab.send_pending(sdev, ddev, seq):
@@ -1019,12 +1102,12 @@ class ACCL:
                                 comm)
 
         req = Request(operation.send.name, outputs=None, external=True,
-                      on_complete=self._queue.retire, progress=self._pump,
+                      on_complete=self._queue.retire, progress=self._pump_waiting,
                       comm=comm, native_registry=self._reqreg)
         self._queue.push(req)
 
         def cont_eager(step: int) -> Optional[int]:
-            if req.status in (requestStatus.COMPLETED, requestStatus.ERROR):
+            if req.status in _TERMINAL:
                 # cancelled while parked: tombstone the reserved seq so the
                 # receiver's fetch cursor is not stalled forever
                 fab.announce_cancel(sdev, ddev, seq)
@@ -1107,13 +1190,13 @@ class ACCL:
 
         req = Request(operation.recv.name, outputs=None, finalizer=finalizer,
                       external=True, on_complete=self._queue.retire,
-                      progress=self._pump, comm=comm,
+                      progress=self._pump_waiting, comm=comm,
                       native_registry=self._reqreg)
         self._queue.push(req)
         matched: list = []
 
         def cont_recv(step: int) -> Optional[int]:
-            if req.status in (requestStatus.COMPLETED, requestStatus.ERROR):
+            if req.status in _TERMINAL:
                 return None
             try:
                 if not matched and match_once():
@@ -1323,12 +1406,12 @@ class ACCL:
 
         # async: post what fits now, park the rest with current_step
         req = Request(operation.send.name, outputs=data, external=True,
-                      on_complete=self._queue.retire, progress=self._pump,
+                      on_complete=self._queue.retire, progress=self._pump_waiting,
                       comm=matcher.comm, native_registry=self._reqreg)
         self._queue.push(req)
 
         def continue_from(step: int) -> Optional[int]:
-            if req.status in (requestStatus.COMPLETED, requestStatus.ERROR):
+            if req.status in _TERMINAL:
                 return None  # cancelled/errored: do not post tail segments
             i = step
             try:
@@ -1481,7 +1564,7 @@ class ACCL:
 
         req = Request(operation.recv.name, outputs=None, finalizer=finalizer,
                       external=True, on_complete=self._queue.retire,
-                      progress=self._pump, comm=comm,
+                      progress=self._pump_waiting, comm=comm,
                       native_registry=self._reqreg)
         pending_req.append(req)
         try:
@@ -1891,10 +1974,13 @@ class ACCL:
         if self._fabric is not None:
             fabric = {
                 "session": self._fabric.ns,
+                "epoch": self._fabric.epoch,
                 "kv_bytes": self._fabric.kv_bytes,
                 "moved_bytes": self._fabric.moved_bytes,
                 "staged_messages": len(self._fabric._staged),
                 "pooled_messages": len(self._fabric._pool),
+                "heartbeats": self._fabric._hb_count,
+                "dead_peers": self._fabric.dead_peers,
             }
         return {
             "schema": _metrics.SCHEMA_VERSION,

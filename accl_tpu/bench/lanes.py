@@ -1192,6 +1192,90 @@ def bench_obs_overhead(acc, count: int = 1 << 14, calls: int = 64,
     }
 
 
+def bench_fault_overhead(acc, count: int = 1 << 10, calls: int = 64,
+                         rounds: int = 5) -> dict:
+    """Fault-injection harness overhead lane (ISSUE r14 acceptance): the
+    per-call host latency of the eager send/recv pair — the datapath
+    whose protocol loop crosses the injection points (rx-pool reserve,
+    segment post, the wait pump) — with the harness DISABLED vs armed
+    with an inert plan (specs that can never fire: the full enabled-path
+    registry scan with zero behavior change), interleaved per round like
+    ``obs_overhead`` so machine drift never reads as harness overhead.
+    Plus the raw disabled-path guard cost in isolation (one ENABLED read
+    per site — the only code an unarmed process runs), the precise
+    number behind the ≤5% budget asserted in tests/test_fault.py.
+
+    Honesty note: on shared-core emulator hosts the A/B's per-call
+    dispatch swings far more between rounds than the ns-scale harness
+    cost, so ``enabled_delta_pct`` there is machine weather — the
+    stable, budget-relevant figures are ``disabled_guard_ns`` /
+    ``disabled_guard_pct_of_dispatch``; read the A/B on silicon."""
+    from .. import fault as _f
+    from ..constants import dataType
+
+    a = acc.create_buffer(count, dataType.float32)
+    b = acc.create_buffer(count, dataType.float32)
+    a.host[:] = 1.0
+    a.sync_to_device()
+    # an in-process pair (self-pair on a 1-rank controller): the matcher
+    # datapath, valid on every rig shape without SPMD choreography
+    local = acc.global_comm().local_ranks
+    src = local[0]
+    dst = local[1] if len(local) > 1 else local[0]
+
+    def per_call_s() -> float:
+        t0 = time.perf_counter()
+        for i in range(calls):
+            acc.send(a, count, src=src, dst=dst, tag=5000 + i)
+            acc.recv(b, count, src=src, dst=dst, tag=5000 + i)
+        return (time.perf_counter() - t0) / calls
+
+    # inert plan: 'after' pushes every spec past any reachable hit count,
+    # so the armed path pays the full point() registry scan and fires
+    # nothing — the pure enabled-path cost
+    inert = _f.FaultPlan([
+        _f.FaultSpec("eager.segment", after=1 << 30),
+        _f.FaultSpec("rank.death", kind="die", after=1 << 30),
+    ])
+    assert not _f.ENABLED, "fault harness armed entering the bench lane"
+    try:
+        per_call_s()   # warm the programs
+        dis, ena = [], []
+        for _ in range(rounds):
+            _f.clear()
+            dis.append(per_call_s())
+            _f.install(inert)
+            ena.append(per_call_s())
+    finally:
+        _f.clear()
+
+    # the disabled guard alone: exactly the checks one eager segment's
+    # path makes (reserve site + post site + wait-pump death site)
+    n = 20000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        if _f.ENABLED:
+            _f.absorb("eager.segment", kinds=("fail", "prob", "drop",
+                                              "die"))
+        if _f.ENABLED:
+            _f.point("eager.segment", kinds=("delay",))
+        if _f.ENABLED:
+            _f.point("rank.death")
+    guard_s = (time.perf_counter() - t0) / n
+
+    d_med = float(np.median(dis))
+    e_med = float(np.median(ena))
+    return {
+        "metric": "fault_overhead", "unit": "us", "bytes": count * 4,
+        "calls": calls, "rounds": rounds,
+        "dispatch_disabled_us": round(d_med * 1e6, 2),
+        "dispatch_enabled_us": round(e_med * 1e6, 2),
+        "enabled_delta_pct": round((e_med - d_med) / d_med * 100, 2),
+        "disabled_guard_ns": round(guard_s * 1e9, 1),
+        "disabled_guard_pct_of_dispatch": round(guard_s / d_med * 100, 4),
+    }
+
+
 def _latency_dist(prog, *args, rounds: int) -> Dict[str, float]:
     """Per-call latency DISTRIBUTION (the serving accounting): one
     compiled-program launch per sample, host wall time, no chaining —

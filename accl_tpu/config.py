@@ -110,6 +110,39 @@ class ACCLConfig:
     # timeout for request waits, in seconds (HOUSEKEEP_TIMEOUT analog)
     timeout: float = 60.0
 
+    # resilience tier (accl_tpu/fault.py + multiproc heartbeats). The
+    # rpc_retry_* fields configure THE one retry/backoff implementation
+    # (fault.RetryPolicy) every coordination-RPC call site shares:
+    # transient faults — injected by the chaos harness or real
+    # UNAVAILABLE/connection-reset RPC errors — are absorbed with
+    # escalating jittered backoff (counted accl_rpc_retry_total{point})
+    # up to the session timeout; permanent errors surface immediately.
+    # Write-through to the live fabric on every config assignment, like
+    # flash_bwd.
+    rpc_retry_initial_ms: float = 2.0
+    rpc_retry_backoff: float = 2.0
+    rpc_retry_max_ms: float = 100.0
+    rpc_retry_jitter: float = 0.25
+    # peer liveness: each controller refreshes a heartbeat lease key in
+    # the coordination KV (nonce-namespaced) from its progress loop
+    # every heartbeat_interval_s; a waiter whose peer's lease value
+    # stays unchanged for heartbeat_timeout_s declares the peer dead —
+    # blocked waits then retire with PEER_FAILED (counted
+    # accl_peer_death_total) instead of blocking past any timeout, and
+    # ACCL.recover() re-handshakes a fresh session epoch.
+    # heartbeat_timeout_s = 0 disables liveness (the pre-round-14
+    # fail-stop contract). Staleness is measured on the WAITER's clock
+    # against lease-value changes, so cross-process clock skew cannot
+    # fake a death. IMPORTANT: leases refresh only while the controller
+    # pumps (progress IS liveness in this cooperative fabric), so size
+    # the window above the longest non-pumping stretch a healthy rank
+    # can hit while a peer is blocked on it (big XLA compiles,
+    # application compute between ACCL calls) — a false verdict is
+    # latched until the next epoch. The 20 s default is 1/3 of the
+    # session timeout; raise it for compile-heavy bring-ups.
+    heartbeat_interval_s: float = 1.0
+    heartbeat_timeout_s: float = 20.0
+
     # feature gates (EN_ARITH / EN_COMPRESS analog; always on by default)
     enable_arith: bool = True
     enable_compression: bool = True

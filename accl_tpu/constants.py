@@ -168,6 +168,12 @@ class errorCode(enum.IntFlag):
     CONFIG_ERROR = 1 << 21
     NOT_READY_ERROR = 1 << 22
     TIMEOUT_ERROR = 1 << 23
+    # TPU-only addition (beyond the reference's bitmask): a peer
+    # controller's heartbeat lease went stale while this side was
+    # blocked on it — the bounded-failure verdict of the resilience
+    # tier (docs/resilience.md). Distinct from TIMEOUT_ERROR: the
+    # operation did not merely run out of budget, the peer is gone.
+    PEER_FAILED = 1 << 24
 
 
 # NOTE: the reference's streamFlags / hostFlags operand descriptors
@@ -214,3 +220,19 @@ class ACCLError(Exception):
 class ACCLTimeoutError(ACCLError):
     def __init__(self, context: str = ""):
         super().__init__(errorCode.TIMEOUT_ERROR, context)
+
+
+class ACCLPeerFailedError(ACCLError):
+    """A blocked wait detected a dead peer through the heartbeat leases
+    (docs/resilience.md): the peer's lease value stopped changing for
+    longer than ``heartbeat_timeout_s``. Carries the dead controller
+    process ids so callers can re-handshake among the survivors
+    (``ACCL.recover()``)."""
+
+    def __init__(self, procs, context: str = ""):
+        self.procs = sorted(procs)
+        super().__init__(
+            errorCode.PEER_FAILED,
+            f"{context}: peer controller process(es) {self.procs} stopped "
+            f"heartbeating — rank(s) presumed dead; survivors may "
+            f"re-handshake a fresh epoch via ACCL.recover()")

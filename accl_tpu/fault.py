@@ -1,0 +1,368 @@
+"""Resilience tier: deterministic fault injection + the unified retry policy.
+
+Every distributed layer in this repo (session handshake, eager/rendezvous
+protocol, barrier, rx pool, request scheduler) was fail-stop until round 14:
+a transient coordination-RPC fault crashed the collective and nothing could
+*prove* the failure paths worked, because there was no way to inject a
+fault. This module is the missing harness, in two coupled pieces:
+
+* **Named injection points** — a process-local registry of the places a
+  coordination fault can strike (:data:`POINTS`), threaded through
+  :mod:`accl_tpu.multiproc` (the KV helpers, announce, fetch, barrier,
+  session handshake), :mod:`accl_tpu.rxpool` / :mod:`accl_tpu.sendrecv`
+  (eager segment lifecycle) and :mod:`accl_tpu.request` (the wait pump).
+  A :class:`FaultPlan` (seeded PRNG + per-point :class:`FaultSpec`) makes
+  chaos runs reproducible; the module-level :data:`ENABLED` flag makes the
+  disabled cost one boolean read per call site (the ``obs.metrics``
+  pattern, asserted ≤5% of dispatch by ``tests/test_fault.py``). Every
+  fired injection counts ``accl_fault_injected_total{point,kind}``.
+
+* **One retry/backoff implementation** — :class:`RetryPolicy` replaces the
+  ad-hoc poll ladders (``_resolve_session``'s fixed poll, ``poll_sleep``'s
+  two-level escalation, ``Request.wait``'s doubling interval): escalating
+  jittered intervals, an optional deadline, and counted absorption of
+  transient faults (``accl_rpc_retry_total{point}``). The jitter PRNG is
+  deterministic per (seed, process), so many ranks polling the same KV key
+  decorrelate without losing reproducibility.
+
+Failure-model contract (docs/resilience.md): ``fail``/``prob``/``drop``
+faults are *transient* — the policy absorbs them within its deadline and
+the collective completes with identical results; ``delay`` stretches the
+schedule without changing it; ``die`` raises :class:`RankDeath` (a
+``BaseException``, so no protocol-level ``except Exception`` can swallow a
+death) and is never retried — survivors detect it through the heartbeat
+leases (:meth:`multiproc.CrossProcessFabric.check_peers`) and re-handshake
+via ``ACCL.recover()``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import random
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .obs import metrics as _metrics
+
+#: THE module-level hot-path guard (the ``obs.metrics.ENABLED`` pattern):
+#: every injection-point call site checks it before calling :func:`point`,
+#: so a production process pays one attribute read per site and nothing
+#: else. Flipped by :func:`install` / :func:`clear` only.
+ENABLED = False
+
+#: the injection-point catalog — the only names :class:`FaultPlan` accepts
+#: (see docs/resilience.md for where each point binds)
+POINTS = (
+    "kv.get",             # coordination-KV read (multiproc._try_get/_fetch)
+    "kv.set",             # coordination-KV write (multiproc._kset[_force])
+    "kv.incr",            # atomic counter bump (multiproc._kincr)
+    "eager.announce",     # eager/rendezvous header publish (fabric.announce)
+    "eager.segment",      # eager segment lifecycle (rxpool.reserve:
+    #                     # fail/drop/die; sendrecv.post_send: delay)
+    "barrier.arrive",     # barrier arrival (fabric.barrier, pre-increment)
+    "handshake.confirm",  # session-nonce confirm read (_resolve_session)
+    "rank.death",         # progress loops (fabric.drive, Request.wait)
+)
+
+KINDS = ("fail", "prob", "delay", "drop", "die")
+
+
+class FaultInjected(Exception):
+    """A transient injected coordination fault — absorbed (and counted) by
+    :meth:`RetryPolicy.call`, exactly like a transient RPC error."""
+
+    def __init__(self, point: str, kind: str, hit: int):
+        self.point, self.kind, self.hit = point, kind, hit
+        super().__init__(f"injected {kind} fault at {point} (hit {hit})")
+
+
+class RankDeath(BaseException):
+    """An injected rank death. Deliberately a ``BaseException``: the
+    protocol layers catch broad ``Exception`` in several places (error
+    routing into requests, NOT_FOUND emulation) and none of them may
+    swallow a death — it must propagate out of the ACCL call like a real
+    crash, leaving the lease to expire for the survivors."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One injection rule: which point, what kind, and when it fires.
+
+    ``after`` skips the first N hits; ``times`` caps total fires (<0 =
+    unlimited — the natural choice for ``prob``/``delay``); ``proc``
+    restricts the rule to one controller process index (-1 = all), so a
+    single shared plan drives an asymmetric chaos scenario.
+    """
+
+    point: str
+    kind: str = "fail"
+    times: int = 1
+    probability: float = 1.0
+    delay_ms: float = 0.0
+    after: int = 0
+    proc: int = -1
+
+
+class FaultPlan:
+    """A reproducible chaos scenario: a seed plus a list of specs.
+
+    The per-spec PRNGs derive from ``(seed, spec index, process index)``,
+    so the same plan fires identically across runs and differently (but
+    deterministically) across ranks.
+    """
+
+    def __init__(self, specs: Sequence[FaultSpec], seed: int = 0):
+        for s in specs:
+            if s.point not in POINTS:
+                raise ValueError(
+                    f"unknown injection point {s.point!r}; catalog: {POINTS}")
+            if s.kind not in KINDS:
+                raise ValueError(
+                    f"unknown fault kind {s.kind!r}; kinds: {KINDS}")
+            if not (0.0 <= s.probability <= 1.0):
+                raise ValueError(f"probability {s.probability} not in [0, 1]")
+        self.specs: Tuple[FaultSpec, ...] = tuple(specs)
+        self.seed = int(seed)
+
+    def __repr__(self) -> str:
+        return f"FaultPlan(seed={self.seed}, specs={list(self.specs)})"
+
+
+def _proc_index() -> int:
+    """Controller process index without importing jax (the launcher env;
+    0 in single-process sessions)."""
+    try:
+        return int(os.environ.get("ACCL_PROC_ID", "0") or 0)
+    except ValueError:
+        return 0
+
+
+_plan: Optional[FaultPlan] = None
+_by_point: Dict[str, List[int]] = {}
+_hits: Dict[int, int] = {}
+_fires: Dict[int, int] = {}
+_rngs: Dict[int, random.Random] = {}
+
+
+def install(plan: FaultPlan) -> None:
+    """Arm the harness with ``plan`` (replacing any installed plan) and
+    flip :data:`ENABLED`. Specs scoped to other processes are dropped at
+    install time so the per-hit path never re-filters."""
+    global ENABLED, _plan
+    me = _proc_index()
+    _by_point.clear()
+    _hits.clear()
+    _fires.clear()
+    _rngs.clear()
+    _plan = plan
+    for i, s in enumerate(plan.specs):
+        if s.proc >= 0 and s.proc != me:
+            continue
+        _by_point.setdefault(s.point, []).append(i)
+        _hits[i] = 0
+        _fires[i] = 0
+        _rngs[i] = random.Random(plan.seed * 1000003 + i * 101 + me)
+    ENABLED = True
+
+
+def clear() -> None:
+    """Disarm the harness (back to the one-boolean-read disabled path)."""
+    global ENABLED, _plan
+    ENABLED = False
+    _plan = None
+    _by_point.clear()
+    _hits.clear()
+    _fires.clear()
+    _rngs.clear()
+
+
+def active() -> Optional[FaultPlan]:
+    return _plan
+
+
+def hits() -> Dict[str, int]:
+    """Per-point hit counts of the installed plan (introspection for
+    chaos assertions; fires are in ``accl_fault_injected_total``)."""
+    out: Dict[str, int] = {}
+    if _plan is None:
+        return out
+    for name, idxs in _by_point.items():
+        out[name] = sum(_hits[i] for i in idxs)
+    return out
+
+
+def point(name: str, kinds: Optional[Tuple[str, ...]] = None) -> None:
+    """One injection-point hit. Call ONLY behind ``if fault.ENABLED:`` —
+    the guard, not this function, is the hot-path contract.
+
+    ``kinds`` restricts which spec kinds are eligible at this call site
+    (e.g. the segment *post* site honors ``delay`` only while the pool
+    *reserve* site owns ``fail``/``drop``); an ineligible spec does not
+    consume a hit, so per-site hit counting stays deterministic.
+
+    A fired spec counts ``accl_fault_injected_total{point,kind}`` then:
+    ``delay`` sleeps inline and returns; ``die`` raises :class:`RankDeath`;
+    ``fail``/``prob``/``drop`` raise :class:`FaultInjected`.
+    """
+    if _plan is None:
+        return
+    for i in _by_point.get(name, ()):
+        spec = _plan.specs[i]
+        if kinds is not None and spec.kind not in kinds:
+            continue
+        n = _hits[i] + 1
+        _hits[i] = n
+        if n <= spec.after:
+            continue
+        # `times` caps FIRES, not eligible hits: a prob spec keeps
+        # drawing until it has actually fired `times` faults (for the
+        # deterministic kinds the two countings coincide)
+        if spec.times >= 0 and _fires[i] >= spec.times:
+            continue
+        if spec.kind == "prob" and _rngs[i].random() >= spec.probability:
+            continue
+        _fires[i] += 1
+        _metrics.inc("accl_fault_injected_total",
+                     labels=(("point", name), ("kind", spec.kind)))
+        if spec.kind == "delay":
+            time.sleep(spec.delay_ms / 1e3)
+            continue
+        if spec.kind == "die":
+            raise RankDeath(f"injected rank death at {name} (hit {n})")
+        raise FaultInjected(name, spec.kind, n)
+
+
+# ---------------------------------------------------------------------------
+# the unified retry/backoff policy
+# ---------------------------------------------------------------------------
+
+_TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED",
+                      "Connection reset", "Connection refused",
+                      "Socket closed")
+
+
+def is_transient(e: BaseException) -> bool:
+    """Whether an error is worth retrying: injected transients always;
+    real coordination-RPC errors by status-name heuristics (NOT_FOUND and
+    ALREADY_EXISTS are protocol verdicts, never retried); a
+    :class:`RankDeath` never."""
+    if isinstance(e, FaultInjected):
+        return True
+    if isinstance(e, RankDeath):
+        return False
+    s = f"{type(e).__name__}: {e}"
+    return any(m in s for m in _TRANSIENT_MARKERS)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """THE backoff implementation: escalating jittered intervals.
+
+    ``interval(attempt)`` = ``min(initial_s * backoff**attempt, max_s)``
+    times a deterministic jitter factor in ``[1, 1+jitter]`` drawn from the
+    caller's PRNG. Configured per session via the ``ACCLConfig
+    rpc_retry_*`` fields (write-through to the fabric, the ``flash_bwd``
+    pattern); module-level instances below re-express the legacy ladders.
+    """
+
+    initial_s: float = 0.002
+    backoff: float = 2.0
+    max_s: float = 0.1
+    jitter: float = 0.25
+
+    def interval(self, attempt: int,
+                 rng: Optional[random.Random] = None) -> float:
+        if self.initial_s <= 0.0:
+            # zero-initial policies ("retry immediately") never escalate;
+            # short-circuiting also keeps the raw pow below from running
+            # with an uncapped exponent
+            return 0.0
+        a = max(int(attempt), 0)
+        if a and self.backoff > 1.0:
+            # cap the exponent at the point the product clears max_s:
+            # the callers feed UNBOUNDED idle counters in here (a wait
+            # blocked for seconds reaches attempt in the thousands), and
+            # an uncapped float pow overflows long before the session
+            # timeout would fire
+            cap = math.log(max(self.max_s / self.initial_s, 1.0),
+                           self.backoff)
+            a = min(a, int(cap) + 1)
+        v = self.initial_s * (self.backoff ** a)
+        if v > self.max_s:
+            v = self.max_s
+        if rng is not None and self.jitter > 0.0:
+            v *= 1.0 + self.jitter * rng.random()
+        return v
+
+    def call(self, fn: Callable, point: str = "",
+             rng: Optional[random.Random] = None,
+             deadline_s: Optional[float] = None,
+             retryable: Optional[Callable[[BaseException], bool]] = None,
+             sleep: Callable[[float], None] = time.sleep):
+        """Run ``fn``, absorbing transient faults with counted escalating
+        backoff (``accl_rpc_retry_total{point}`` per retry). Permanent
+        errors re-raise immediately; transient ones re-raise once
+        ``deadline_s`` is exhausted — so a permanent outage still surfaces
+        the existing clear error within the session deadline instead of
+        retrying forever."""
+        check = retryable or is_transient
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None else None)
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except RankDeath:
+                raise
+            except Exception as e:
+                if not check(e):
+                    raise
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise
+                _metrics.inc("accl_rpc_retry_total",
+                             labels=(("point", point),))
+                sleep(self.interval(attempt, rng))
+                attempt += 1
+
+
+def absorb(name: str, kinds: Optional[Tuple[str, ...]] = None,
+           policy: Optional["RetryPolicy"] = None,
+           deadline_s: float = 60.0) -> None:
+    """Fire injection point ``name`` and absorb transient injected faults
+    INLINE (counted as RPC retries) — for call sites whose own protocol
+    retry IS the operation (the rx-pool slot claim): there is no RPC to
+    re-issue, so the fault is consumed on the spot under the poll
+    policy's backoff. ``die`` still raises :class:`RankDeath`; ``delay``
+    still sleeps. Bounded like every other absorption path: an
+    unlimited-fail spec re-raises :class:`FaultInjected` once
+    ``deadline_s`` is spent instead of spinning forever. Call ONLY
+    behind ``if fault.ENABLED:``."""
+    (policy or POLL_POLICY).call(
+        lambda: point(name, kinds), point=name, deadline_s=deadline_s,
+        retryable=lambda e: isinstance(e, FaultInjected))
+
+
+#: the progress-loop poll ladder, re-expressed: ~200 µs while the peer is
+#: mid-protocol, escalating to the 2 ms idle poll over ~8 iterations —
+#: the measured two-level ladder of round 5 (each poll costs a KV RTT and
+#: idle polling starves a shared-core peer), now with jitter so many ranks
+#: polling one key don't stampede the coordinator in lockstep
+POLL_POLICY = RetryPolicy(initial_s=2e-4, backoff=1.4, max_s=2e-3,
+                          jitter=0.25)
+
+#: Request.wait's external-fulfillment pump interval (was the hand-rolled
+#: 5 ms-doubling-to-250 ms loop); jitter-free — it paces an in-process
+#: condition-variable wait, not a shared coordinator
+WAIT_POLICY = RetryPolicy(initial_s=0.005, backoff=2.0, max_s=0.25,
+                          jitter=0.0)
+
+
+def policy_from_config(cfg) -> RetryPolicy:
+    """Build the session's coordination-RPC policy from the ``ACCLConfig``
+    ``rpc_retry_*`` register tier."""
+    return RetryPolicy(
+        initial_s=float(cfg.rpc_retry_initial_ms) / 1e3,
+        backoff=float(cfg.rpc_retry_backoff),
+        max_s=float(cfg.rpc_retry_max_ms) / 1e3,
+        jitter=float(cfg.rpc_retry_jitter))

@@ -66,15 +66,19 @@ Environment contract (set by :mod:`accl_tpu.launch`):
 from __future__ import annotations
 
 import collections
+import dataclasses
 import json
 import os
+import random
 import time
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from . import constants
-from .constants import ACCLError, ACCLTimeoutError, errorCode
+from . import fault as _fault
+from .constants import (ACCLError, ACCLPeerFailedError, ACCLTimeoutError,
+                        errorCode)
 from .obs import metrics as _metrics
 from .obs import trace as _trace
 
@@ -95,6 +99,19 @@ _initialized = False
 # program order, so the index aligns across processes (the fallback
 # session-nonce channel is keyed by it)
 _fabric_seq = 0
+
+# deterministic per-process jitter PRNG for the shared poll backoff
+# (thundering-herd avoidance: many ranks polling one KV key decorrelate
+# without losing reproducibility — the seed is a pure function of the
+# process index). Lazily built so the env read happens after launch.
+_poll_rng: Optional[random.Random] = None
+
+
+def _poll_jitter_rng() -> random.Random:
+    global _poll_rng
+    if _poll_rng is None:
+        _poll_rng = random.Random(0x5EED0 + _fault._proc_index())
+    return _poll_rng
 
 
 def launched() -> bool:
@@ -173,10 +190,36 @@ class CrossProcessFabric:
     """
 
     def __init__(self, timeout: float, eager_window: int,
-                 eager_seg_bytes: int = 16 * 1024):
+                 eager_seg_bytes: int = 16 * 1024,
+                 retry_policy: Optional[_fault.RetryPolicy] = None,
+                 heartbeat_interval_s: float = 1.0,
+                 heartbeat_timeout_s: float = 20.0):
         import jax
 
         self.timeout = timeout
+        #: THE coordination-RPC retry/backoff policy (fault.RetryPolicy):
+        #: every KV helper routes through it, so transient faults —
+        #: injected or real — are absorbed with one counted escalating
+        #: backoff implementation instead of N ad-hoc ladders
+        self._retry = retry_policy or _fault.RetryPolicy()
+        #: deterministic jitter PRNG for the retry backoff, seeded per
+        #: process so concurrent retries decorrelate reproducibly
+        self._rng = random.Random(0xFA17 + jax.process_index())
+        #: peer-liveness lease cadence/staleness window (docs/resilience.md);
+        #: heartbeat_timeout <= 0 disables liveness entirely
+        self.heartbeat_interval = float(heartbeat_interval_s)
+        self.heartbeat_timeout = float(heartbeat_timeout_s)
+        #: session epoch: bumped by ACCL.recover()'s elastic re-handshake;
+        #: every epoch gets a fresh key namespace (bump_epoch)
+        self.epoch = 0
+        self._hb_count = 0          # heartbeats this process published
+        self._hb_last = 0.0         # monotonic of the last publish
+        self._peer_check_last = 0.0
+        #: proc -> (last lease value seen, local monotonic when it changed):
+        #: staleness is measured on THIS clock against value CHANGES, so
+        #: cross-process clock skew cannot fake a death
+        self._peer_seen: Dict[int, Tuple[Optional[str], float]] = {}
+        self._dead_peers: set = set()
         #: credit window: max staged-but-unmoved eager segments per pair
         self.eager_window = max(int(eager_window), 1)
         self.eager_seg_bytes = max(int(eager_seg_bytes), 1)
@@ -257,6 +300,10 @@ class CrossProcessFabric:
         # name -> (target count still owed, participant count) — consumed
         # by the next call, which must use the same participant set
         self._barrier_pending: Dict[str, Tuple[int, int]] = {}
+        # lease the session EAGERLY: a controller that dies before its
+        # first wait loop ever runs must still be detectable — the lease
+        # exists from bring-up, frozen the moment progress stops
+        self._maybe_heartbeat(_client())
 
     def _resolve_session(self) -> str:
         """ACCL_SESSION (minted once per job by the launcher) when
@@ -299,12 +346,34 @@ class CrossProcessFabric:
                              "1")
             return s
         deadline = time.monotonic() + self.timeout
-        poll_ms = max(min(2000, self._timeout_ms()), 1)
+        # confirm-poll pacing rides THE retry policy (was a fixed
+        # min(2s, timeout) poll): short first polls converge fast on the
+        # common no-contention path, and the escalation tops out at the
+        # LEGACY 2 s ceiling, not the RPC-retry cap — while p0 slowly
+        # collects acks on a big world, hundreds of waiting ranks must
+        # idle toward ~0.5 poll/s, not hammer the coordinator at the
+        # 100 ms retry cadence. The ack write happens only when the
+        # nonce CHANGES (once on the happy path), never per poll.
+        pacing = dataclasses.replace(
+            self._retry, max_s=max(self._retry.max_s, 2.0))
+        attempt = 0
+        s = None
         while True:
-            s = client.blocking_key_value_get(key, self._timeout_ms())
-            self._kset_force(
-                client, f"accl/sess_ack/{self.instance}/{s}/{self._me}", s)
+            s2 = client.blocking_key_value_get(key, self._timeout_ms())
+            if s2 != s:
+                s = s2
+                self._kset_force(
+                    client, f"accl/sess_ack/{self.instance}/{s}/{self._me}",
+                    s)
             try:
+                if _fault.ENABLED:
+                    # an injected confirm-read fault (drop/fail) lands in
+                    # the except arm below: counted as a handshake retry,
+                    # converging exactly like a raced stale nonce
+                    _fault.point("handshake.confirm")
+                poll_ms = max(
+                    int(pacing.interval(attempt, self._rng) * 1e3), 1)
+                attempt += 1
                 client.blocking_key_value_get(
                     f"accl/sess_ok/{self.instance}/{s}", poll_ms)
                 return s
@@ -323,11 +392,62 @@ class CrossProcessFabric:
                         f"to skip the bootstrap handshake entirely")
 
     # -- KV helpers (all writes tallied) -----------------------------------
+    #
+    # Every helper routes its coordination RPC through :meth:`_kv_call` —
+    # THE retry/backoff implementation (fault.RetryPolicy, configured by
+    # the ACCLConfig rpc_retry_* register tier): transient faults, whether
+    # injected at the named point by the chaos harness or real
+    # UNAVAILABLE/connection-reset RPC errors, are absorbed with counted
+    # escalating jittered backoff (accl_rpc_retry_total{point}) bounded by
+    # the session timeout; permanent errors (NOT_FOUND, ALREADY_EXISTS,
+    # config mistakes) surface immediately, exactly as before.
 
-    def _kset(self, client, key: str, value: str) -> None:
+    def _kv_call(self, point: str, fn: Callable, retry_real: bool = True):
+        """Run one coordination RPC under the session retry policy.
+
+        ``retry_real=False`` restricts absorption to INJECTED faults (the
+        harness fires before the RPC, so a retry is always safe) while
+        real errors propagate as before — for non-idempotent RPCs like
+        the native atomic increment, where a blind re-issue after an
+        ambiguous failure could apply twice."""
+        if _fault.ENABLED:
+            inner = fn
+
+            def fn():
+                _fault.point(point)
+                return inner()
+
+            check = (_fault.is_transient if retry_real
+                     else (lambda e: isinstance(e, _fault.FaultInjected)))
+        elif not retry_real:
+            return fn()
+        else:
+            check = _fault.is_transient
+        return self._retry.call(fn, point=point, rng=self._rng,
+                                deadline_s=self.timeout, retryable=check)
+
+    def _kset(self, client, key: str, value: str,
+              point: str = "kv.set") -> None:
         self.kv_bytes += len(key) + len(value)
         t0 = _metrics.tick()
-        client.key_value_set(key, value)
+
+        def put():
+            try:
+                client.key_value_set(key, value)
+            except Exception as e:
+                # an ambiguous transient failure (connection reset AFTER
+                # the coordinator applied the set) makes the policy's
+                # retry land on ALREADY_EXISTS — but the retried
+                # (key, value) pair is identical, so if the stored value
+                # matches, the publish already succeeded. A genuinely
+                # conflicting existing value still raises (that is a
+                # protocol bug, not a retry echo).
+                if "ALREADY_EXISTS" not in f"{type(e).__name__}: {e}":
+                    raise
+                if self._try_get_raw(client, key) != value:
+                    raise
+
+        self._kv_call(point, put)
         if t0:
             _metrics.observe("accl_kv_seconds", time.perf_counter() - t0,
                              _L_KV_SET)
@@ -336,20 +456,31 @@ class CrossProcessFabric:
         """Tallied set that OVERWRITES — for bootstrap keys that may
         survive an earlier run on a long-lived coordination service."""
         self.kv_bytes += len(key) + len(value)
-        try:
-            client.key_value_set(key, value, allow_overwrite=True)
-        except TypeError:  # older client without the kwarg
+
+        def put():
             try:
-                client.key_value_delete(key)
-            except Exception:
-                pass
-            client.key_value_set(key, value)
+                client.key_value_set(key, value, allow_overwrite=True)
+            except TypeError:  # older client without the kwarg
+                try:
+                    client.key_value_delete(key)
+                except Exception:
+                    pass
+                client.key_value_set(key, value)
+
+        self._kv_call("kv.set", put)
 
     def _kincr(self, client, key: str, by: int = 1) -> int:
         self.kv_bytes += len(key) + 8
         t0 = _metrics.tick()
         try:
-            n = int(client.key_value_increment(key, by))
+            # retry_real=False: the native increment is not idempotent —
+            # a blind re-issue after an AMBIGUOUS real failure could
+            # apply twice and leave a hole in the gap-free schedule
+            # index. Injected faults fire before the RPC, so absorbing
+            # them is always safe.
+            n = int(self._kv_call(
+                "kv.incr", lambda: client.key_value_increment(key, by),
+                retry_real=False))
             if t0:
                 _metrics.observe("accl_kv_seconds",
                                  time.perf_counter() - t0, _L_KV_INCR)
@@ -421,11 +552,35 @@ class CrossProcessFabric:
         Escalation is quicker and deeper than the original 32-iter/2 ms
         ladder: each poll costs a KV RTT, and on a shared-core host the
         idle side's polling directly starves the busy peer (profiled:
-        ~23% of the eager sender's wall time was idle-poll try_gets)."""
-        time.sleep(0.0002 if idle_iters < 8 else 0.002)
+        ~23% of the eager sender's wall time was idle-poll try_gets).
+
+        Re-expressed through :data:`fault.POLL_POLICY` (round 14) so there
+        is exactly ONE backoff implementation in the codebase: the same
+        ~200 µs → 2 ms escalation over ~8 idle iterations, now with
+        deterministic per-process jitter — many ranks polling the same KV
+        key decorrelate (no thundering herd on the coordinator) without
+        losing run-to-run reproducibility."""
+        time.sleep(_fault.POLL_POLICY.interval(idle_iters,
+                                               _poll_jitter_rng()))
+
+    def _try_get(self, client, key: str) -> Optional[str]:
+        """:meth:`_try_get_raw` under the ``kv.get`` injection point: an
+        armed harness may fault the read, absorbed by the retry policy
+        (counted). The disabled path is ONE boolean read on top of the
+        raw RPC — this sits under every poll-loop iteration. Note the
+        raw read maps ANY client failure to a miss (None), so a real
+        transient kv.get error in production degrades to one poll-miss
+        iteration — absorbed by the enclosing poll loop's backoff, not
+        by the counted policy (docs/resilience.md)."""
+        if not _fault.ENABLED:
+            return self._try_get_raw(client, key)
+        return self._retry.call(
+            lambda: (_fault.point("kv.get"),
+                     self._try_get_raw(client, key))[1],
+            point="kv.get", rng=self._rng, deadline_s=self.timeout)
 
     @staticmethod
-    def _try_get(client, key: str) -> Optional[str]:
+    def _try_get_raw(client, key: str) -> Optional[str]:
         """try_get that treats a missing key as None (the client raises
         NOT_FOUND rather than returning a sentinel). Older clients have
         no key_value_try_get at all — there, a ~1 ms blocking get is the
@@ -535,8 +690,13 @@ class CrossProcessFabric:
             self._staged_segs[k] = self._staged_segs.get(k, 0) + credits
         header = {"tag": int(tag), "dt": str(payload.dtype),
                   "n": int(payload.shape[-1]), "k": kind, "g": int(nseg)}
+        # the header publish carries its own injection point: a dropped
+        # announce is THE canonical eager-protocol fault (the header is
+        # the message as far as the control plane knows) — absorbed by
+        # the retry policy like any transient set, re-publishing the
+        # same (seq, header) idempotently
         self._kset(client, f"{self.ns}/m/{sdev}.{ddev}/{seq}",
-                   json.dumps(header))
+                   json.dumps(header), point="eager.announce")
         return seq
 
     def announce_cancel(self, sdev: int, ddev: int, seq: int) -> None:
@@ -580,7 +740,15 @@ class CrossProcessFabric:
         new = {}
         if self._dir_get_ok:
             try:
-                for key, v in client.key_value_dir_get(prefix):
+                # through the retry policy (kv.get point): an injected or
+                # real TRANSIENT fault is absorbed instead of permanently
+                # demoting the fetch path to per-seq gets; only a
+                # persistent failure (or a client without dir_get) still
+                # takes the fallback below
+                entries = self._kv_call(
+                    "kv.get",
+                    lambda: list(client.key_value_dir_get(prefix)))
+                for key, v in entries:
                     try:
                         q = int(str(key).rsplit("/", 1)[1])
                     except ValueError:
@@ -999,8 +1167,22 @@ class CrossProcessFabric:
         """Advance the global move schedule: execute (or skip) every
         published record from the cursor on, in index order — the
         cooperative dispatch loop (``wait_for_call`` round-robin,
-        ccl_offload_control.c:2264-2288). Returns whether anything ran."""
+        ccl_offload_control.c:2264-2288). Returns whether anything ran.
+
+        Also refreshes this controller's heartbeat lease: progress IS
+        liveness here (the cooperative single-threaded dispatch model),
+        so the lease is renewed from the same loop that executes moves —
+        a controller that stops driving stops leasing, and its peers'
+        blocked waits can retire with PEER_FAILED instead of hanging."""
+        if _fault.ENABLED:
+            # the chaos harness's rank-death site: fires in the progress
+            # loop like a real mid-protocol crash (RankDeath is a
+            # BaseException — no protocol except-arm may swallow it).
+            # die/delay only: nothing absorbs a transient here, so a
+            # fail-kind spec would leak a raw FaultInjected into the app
+            _fault.point("rank.death", kinds=("die", "delay"))
         client = _client()
+        self._maybe_heartbeat(client)
         progressed = False
         while True:
             v = self._try_get(client, f"{self.ns}/s/{self._cursor}")
@@ -1017,6 +1199,140 @@ class CrossProcessFabric:
                 self._execute(rec)
                 progressed = True
             self._cursor += 1
+
+    # -- peer liveness (heartbeat leases) ----------------------------------
+
+    def set_resilience(self, retry_policy: _fault.RetryPolicy,
+                       heartbeat_interval_s: float,
+                       heartbeat_timeout_s: float) -> None:
+        """Config write-through (the ``flash_bwd`` pattern): applied by
+        the ACCL config setter on EVERY assignment, so a replaced config
+        never leaves the fabric on a stale retry/liveness policy."""
+        self._retry = retry_policy
+        self.heartbeat_interval = float(heartbeat_interval_s)
+        self.heartbeat_timeout = float(heartbeat_timeout_s)
+
+    def _maybe_heartbeat(self, client) -> None:
+        """Refresh this controller's lease key at most once per
+        ``heartbeat_interval`` (the cheap common case is one monotonic
+        read). The lease VALUE is a local counter, not a timestamp:
+        peers measure staleness as value-unchanged-for-too-long on their
+        OWN clock, so skew between hosts cannot fake a death."""
+        if self.heartbeat_timeout <= 0:
+            return
+        now = time.monotonic()
+        if now - self._hb_last < self.heartbeat_interval:
+            return
+        self._hb_last = now
+        self._hb_count += 1
+        self._kset_force(client, f"{self.ns}/hb/{self._me}",
+                         str(self._hb_count))
+
+    def check_peers(self, procs: Optional[list] = None) -> List[int]:
+        """Poll peer heartbeat leases (rate-limited to one sweep per
+        ``heartbeat_interval``); returns the known-dead process ids among
+        ``procs`` (default: every other process). A peer is dead when its
+        OBSERVED lease value has not changed for ``heartbeat_timeout``
+        seconds of local watching. A lease must exist before it can
+        expire: a peer that has not published in this epoch yet (still
+        importing, still recovering into the epoch) is merely unknown,
+        not dead — its waits stay bounded by the ordinary operation
+        timeouts instead. This is what lets recovering ranks race into a
+        fresh epoch at different speeds without false-positive verdicts.
+        Each death is counted once (``accl_peer_death_total{proc}``) and
+        latched until the next epoch (``bump_epoch`` clears them)."""
+        if self.heartbeat_timeout <= 0:
+            return []
+        # fast path FIRST: the wait loops call this per iteration, so
+        # between sweeps the whole cost is one monotonic read and an
+        # empty-set check — nothing below (import, process enumeration,
+        # sorting) runs unless a sweep is due or a verdict is latched
+        now = time.monotonic()
+        if now - self._peer_check_last >= self.heartbeat_interval:
+            self._peer_check_last = now
+            import jax
+
+            sweep = (range(jax.process_count()) if procs is None else procs)
+            client = _client()
+            for p in sweep:
+                if p == self._me or p in self._dead_peers:
+                    continue
+                v = self._try_get(client, f"{self.ns}/hb/{p}")
+                if v is None:
+                    continue  # no lease in this epoch yet: unknown, not dead
+                seen = self._peer_seen.get(p)
+                if seen is None or seen[0] != v:
+                    self._peer_seen[p] = (v, now)
+                elif now - seen[1] > self.heartbeat_timeout:
+                    self._dead_peers.add(p)
+                    _metrics.inc("accl_peer_death_total",
+                                 labels=(("proc", str(p)),))
+        if not self._dead_peers:
+            return []
+        if procs is None:
+            return sorted(self._dead_peers)
+        return sorted(p for p in self._dead_peers if p in procs)
+
+    def raise_if_peer_failed(self, what: str,
+                             procs: Optional[list] = None) -> None:
+        """Bounded-failure verdict for blocked waits: raise
+        :class:`ACCLPeerFailedError` when a peer this wait depends on is
+        dead, instead of blocking until the (much longer) operation
+        timeout. The no-death fast path costs one monotonic read."""
+        dead = self.check_peers(procs)
+        if dead:
+            raise ACCLPeerFailedError(dead, what)
+
+    @property
+    def dead_peers(self) -> List[int]:
+        """Latched liveness verdicts (introspection for stats()/scan())."""
+        return sorted(self._dead_peers)
+
+    def bump_epoch(self) -> int:
+        """Elastic re-handshake step (``ACCL.recover``): abandon the
+        current key namespace WHOLESALE — a poisoned session's leftover
+        announcements, schedule records, barrier counters and leases all
+        live under the old nonce-derived prefix, so a fresh epoch suffix
+        makes them unreachable rather than trying to repair them (the
+        same crashed-rerun discipline the session nonce itself follows).
+        Local per-pair protocol state resets with it: seqs restart at 1,
+        the schedule cursor at the fresh namespace's counter, barrier
+        rounds at 0. Compiled pair-move programs are pure functions of
+        (pair, shape, dtype) and survive. Liveness verdicts clear — a
+        recovered rank may rejoin (elastic rejoin), and a truly-gone rank
+        is simply never heard from again in the new epoch."""
+        self.epoch += 1
+        self.ns = (f"accl/{self.session[-8:]}.{self.instance}"
+                   f".e{self.epoch}")
+        for d in (self._out_seq, self._staged, self._staged_segs,
+                  self._fetch_seq, self._parked_ann, self._accepts,
+                  self._pool, self._pool_segs, self._batch_hdrs,
+                  self._barrier_pending, self._peer_seen):
+            d.clear()
+        self._reserved.clear()
+        self._dead_peers.clear()
+        self._pending_deletes.clear()
+        self._hb_last = 0.0
+        self._peer_check_last = 0.0
+        self._hb_count = 0
+        self._cursor = self._kcount(_client(), f"{self.ns}/sn") + 1
+        # publish the epoch under the EPOCH-INDEPENDENT base prefix:
+        # self.epoch alone is local state, and a controller that
+        # restarts from scratch mid-job (outside today's recover()
+        # contract — every participant must be a live fabric calling
+        # recover() in step) would otherwise have no way to discover
+        # which namespace the mesh moved to. This key is the hook the
+        # restart-rejoin extension reads; last-writer-wins is fine (all
+        # recovering controllers write the same value).
+        self._kset_force(_client(),
+                         f"accl/{self.session[-8:]}.{self.instance}/epoch",
+                         str(self.epoch))
+        # lease the new epoch immediately: recovering peers racing into
+        # the epoch at different speeds see this controller as alive the
+        # moment it arrives, not one progress-loop later
+        self._maybe_heartbeat(_client())
+        _metrics.inc("accl_session_epoch_total")
+        return self.epoch
 
     # -- barrier -----------------------------------------------------------
 
@@ -1063,7 +1379,13 @@ class CrossProcessFabric:
                 f"barrier {name!r}: retry with {n} participants, but the "
                 f"pending timed-out round expected {pending[1]}")
         if pending is None:
-            arrive = self._kincr(client, key)
+            # the arrival rides the barrier.arrive injection point:
+            # delay stretches the round (a laggard rank), fail/prob/drop
+            # lose the arrival ATTEMPT (fired before the increment, so
+            # the policy's retry never double-counts), die kills the rank
+            arrive = self._kv_call(
+                "barrier.arrive", lambda: self._kincr(client, key),
+                retry_real=False)
             target = ((arrive - 1) // n + 1) * n
             self._barrier_pending[key] = (target, n)
         else:
@@ -1077,6 +1399,12 @@ class CrossProcessFabric:
                 self.poll_sleep(idle)
             else:
                 idle = 0
+            # bounded failure: a dead participant retires this wait with
+            # PEER_FAILED well inside the timeout — the arrival stays
+            # pending, so a post-recovery retry keeps the same-round
+            # semantics documented above
+            self.raise_if_peer_failed(f"barrier {name!r}",
+                                      procs=process_ids)
             if time.monotonic() > deadline:
                 raise ACCLTimeoutError(
                     f"barrier {name!r}: {self._kcount(client, key)}/"

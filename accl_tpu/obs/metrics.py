@@ -38,6 +38,10 @@ name                                           kind       labels
 ``accl_program_cache_size``                    gauge      (none)
 ``accl_latency_dispatch_seconds``              histogram  path (µs-resolution buckets)
 ``accl_flash_decode_fallback_total``           counter    reason (mode | geometry | vmem_miss)
+``accl_fault_injected_total``                  counter    point, kind (fault.py chaos harness)
+``accl_rpc_retry_total``                       counter    point (RetryPolicy absorbed transients)
+``accl_peer_death_total``                      counter    proc (heartbeat-lease death verdicts)
+``accl_session_epoch_total``                   counter    (none; recover() epoch bumps)
 =============================================  =========  =================
 
 Export formats: :meth:`MetricsRegistry.snapshot` (flat, JSON-safe dict),

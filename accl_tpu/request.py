@@ -21,17 +21,24 @@ from typing import Any, Callable, List, Optional
 
 import jax
 
+from . import fault as _fault
 from .constants import ACCLTimeoutError, ACCLError, errorCode
 from .obs import metrics as _metrics
 
 
 class requestStatus(enum.Enum):
-    """acclrequest.hpp operationStatus analog."""
+    """acclrequest.hpp operationStatus analog.
+
+    ``PEER_FAILED`` is a TPU-only terminal status (round 14): the wait's
+    progress pump detected a dead peer through the heartbeat leases and
+    retired the request with a bounded-failure verdict instead of
+    blocking past any timeout (docs/resilience.md)."""
 
     QUEUED = 0
     EXECUTING = 1
     COMPLETED = 2
     ERROR = 3
+    PEER_FAILED = 4
 
 
 class Request:
@@ -89,6 +96,10 @@ class Request:
             self._error = error
             if error is None:
                 self.status = requestStatus.COMPLETED
+            elif (isinstance(error, ACCLError)
+                  and error.code == errorCode.PEER_FAILED):
+                self.status = requestStatus.PEER_FAILED
+                self.retcode = error.code
             else:
                 self.status = requestStatus.ERROR
                 if isinstance(error, ACCLError):
@@ -143,18 +154,35 @@ class Request:
         if self._external:
             # wait for fulfill() from a future matching post, pumping the
             # cooperative scheduler so parked operations can finish. The
-            # poll interval backs off exponentially while pumps make no
-            # progress (idle waits park on the CV instead of spinning) and
-            # snaps back to fast polling the moment anything moves.
+            # poll interval is fault.WAIT_POLICY (the one backoff
+            # implementation): it escalates while pumps make no progress
+            # (idle waits park on the CV instead of spinning) and snaps
+            # back to fast polling the moment anything moves.
             deadline = ((time.monotonic() + timeout)
                         if timeout is not None else None)
-            interval = 0.005
+            idle = 0
             while True:
+                if _fault.ENABLED:
+                    # the wait pump is a progress loop too: the chaos
+                    # harness's rank death fires here for requests parked
+                    # on external fulfillment (die/delay only — nothing
+                    # absorbs a transient at this site)
+                    _fault.point("rank.death", kinds=("die", "delay"))
                 if self._progress is not None:
-                    if self._progress():
-                        interval = 0.005
-                    else:
-                        interval = min(interval * 2, 0.25)
+                    try:
+                        if self._progress():
+                            idle = 0
+                        else:
+                            idle += 1
+                    except ACCLError as e:
+                        if e.code == errorCode.PEER_FAILED:
+                            # bounded-failure verdict from the pump's
+                            # liveness check: retire the request with the
+                            # PEER_FAILED terminal status (counted), then
+                            # surface the error to the caller
+                            self._complete(e)
+                        raise
+                    interval = _fault.WAIT_POLICY.interval(idle)
                 with self._cv:
                     if self._cv.wait_for(
                         lambda: self._done or not self._external,
@@ -250,14 +278,22 @@ class RequestQueue:
         flush in ccl_offload_control.c:2081-2090). Requests already failed or
         cancelled are skipped — their error surfaces on the caller's wait().
         With ``comm``, only that communicator's requests are flushed — a
-        sub-communicator barrier must not block on unrelated traffic."""
+        sub-communicator barrier must not block on unrelated traffic.
+
+        ``timeout`` bounds the WHOLE drain: one shared deadline is computed
+        up front and each request's wait gets the remaining budget (passing
+        the full timeout to every wait in sequence made draining N parked
+        requests take up to N×timeout)."""
+        deadline = ((time.monotonic() + timeout)
+                    if timeout is not None else None)
         with self._lock:
             pending = [r for r in self._inflight
                        if comm is None or r.comm is None or r.comm is comm]
         for r in pending:
-            if r.status == requestStatus.ERROR:
+            if r.status in (requestStatus.ERROR, requestStatus.PEER_FAILED):
                 continue
-            r.wait(timeout=timeout)
+            r.wait(timeout=(None if deadline is None
+                            else max(deadline - time.monotonic(), 0.0)))
         with self._lock:
             for r in pending:
                 if r in self._inflight:

@@ -20,6 +20,7 @@ from __future__ import annotations
 import dataclasses
 from typing import List, Optional, Tuple
 
+from . import fault as _fault
 from . import native as _native
 from .obs import metrics as _metrics
 
@@ -67,7 +68,16 @@ class RxBufPool:
 
     def reserve(self, src: int, dst: int, tag: int, seqn: int,
                 count: int) -> int:
-        """Claim an IDLE slot for a parked segment; -1 when exhausted."""
+        """Claim an IDLE slot for a parked segment; -1 when exhausted.
+
+        Carries the ``eager.segment`` injection point: a transient
+        injected fault on the claim is absorbed INLINE under the poll
+        policy (counted as an RPC retry) — the claim is its own retry,
+        there is no RPC to re-issue — so the protocol above sees only
+        the claim's real verdicts (a slot, exhaustion, or rank death)."""
+        if _fault.ENABLED:
+            _fault.absorb("eager.segment",
+                          kinds=("fail", "prob", "drop", "die"))
         if self._native is not None:
             slot = self._native.reserve(src, dst, tag, seqn, count)
         else:

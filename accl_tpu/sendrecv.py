@@ -27,6 +27,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 
+from . import fault as _fault
 from .communicator import Communicator
 from .constants import TAG_ANY, ACCLError, errorCode
 from .obs import metrics as _metrics
@@ -125,6 +126,12 @@ class MatchingEngine:
         Capacity validation happens *before* the seqn is consumed, so a
         rejected send leaves the pair's ordering state untouched.
         """
+        if _fault.ENABLED:
+            # the post site honors DELAY only (a slowed segment — the
+            # wire-latency chaos knob); fail/drop/die belong to the pool
+            # claim upstream (rxpool.reserve), so per-site hit counting
+            # stays deterministic
+            _fault.point("eager.segment", kinds=("delay",))
         if self._native is not None:
             from . import native as _n
             sid, matched, seqn, rem = self._native.post_send(

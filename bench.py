@@ -71,7 +71,7 @@ _TRACE_DIR = None
 #: on multi-chip rigs: a filter is validated against the catalog, not
 #: against what this world size happens to run)
 KNOWN_LANES = (
-    "sweep", "obs_overhead",
+    "sweep", "obs_overhead", "fault_overhead",
     "cmatmul_ag", "cmatmul_rs", "cmatmul_dw", "cmatmul_stream",
     "moe_a2a", "moe_a2a_bwd", "zero_fsdp", "sched_synth",
     "hp_compression_cast_roundtrip", "combine_pallas_vs_jnp",
@@ -377,6 +377,22 @@ def main(argv=None) -> int:
                                    "error": err["error"]}
         else:
             out["obs_overhead"] = r
+
+    # fault-injection harness overhead lane (any world size): the
+    # interleaved disabled/armed-inert A/B behind the resilience tier's
+    # ≤5% disabled-path budget (the obs_overhead shape)
+    if _lane_selected(lanes_filter, "fault_overhead") \
+            and _elapsed() <= _BUDGET_S:
+        from accl_tpu.bench import lanes as _f_lanes
+
+        r, err = _run_stage("fault_overhead",
+                            lambda: _f_lanes.bench_fault_overhead(acc))
+        if err:
+            errors.append(err)
+            out["fault_overhead"] = {"metric": "fault_overhead",
+                                     "error": err["error"]}
+        else:
+            out["fault_overhead"] = r
 
     if world > 1:
         # multi-chip: the collective-matmul overlap A/B lanes (the

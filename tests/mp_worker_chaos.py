@@ -1,0 +1,195 @@
+"""Chaos-matrix worker driven by ``python -m accl_tpu.launch`` (the mpirun
+rung of tests/test_fault.py).
+
+Two scenarios, selected by ``ACCL_CHAOS``:
+
+* ``transient`` — every controller arms the SAME seeded :class:`FaultPlan`
+  (3 transient failures at each KV injection point, a dropped eager
+  announce, a delayed barrier arrival, failed + slowed eager segments)
+  and runs the cross-process matrix: eager send/recv, rendezvous
+  send/recv, a bandwidth collective, a barrier. The matrix must complete
+  with IDENTICAL results — the faults are absorbed by the unified retry
+  policy — and both ``accl_fault_injected_total`` and
+  ``accl_rpc_retry_total`` must be non-zero.
+
+* ``death`` — process 1 arms ``rank.death``: its next progress-loop
+  iteration raises :class:`RankDeath` out of the blocked recv (the
+  mid-protocol crash). Process 0, blocked on a recv from the dead rank,
+  must observe ``PEER_FAILED`` through the heartbeat leases WELL inside
+  the session timeout (no unbounded block). Then every controller calls
+  ``ACCL.recover()`` — the elastic epoch re-handshake — and proves the
+  fresh epoch with bit-exact send/recv round-trips both ways plus the
+  collective matrix.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+import accl_tpu
+from accl_tpu import dataType, fault, reduceFunction
+from accl_tpu.fault import FaultPlan, FaultSpec, RankDeath
+from accl_tpu.obs import metrics
+
+import jax
+
+
+def _counters_total(prefix: str) -> float:
+    return sum(v for k, v in metrics.snapshot()["counters"].items()
+               if k.startswith(prefix))
+
+
+def transient() -> int:
+    me = jax.process_index()
+    acc = accl_tpu.ACCL()
+    comm = acc.global_comm()
+    W = acc.world_size
+    n = 300
+    payload = np.arange(n, dtype=np.float32)
+    src, dst = 0, W - 1
+
+    fault.install(FaultPlan([
+        FaultSpec("kv.get", times=3),
+        FaultSpec("kv.set", times=3),
+        FaultSpec("kv.incr", times=3),
+        FaultSpec("eager.announce", kind="drop", times=1),
+        FaultSpec("barrier.arrive", kind="delay", delay_ms=50, times=1),
+        FaultSpec("eager.segment", kind="fail", times=2),
+        FaultSpec("eager.segment", kind="delay", delay_ms=5, times=2),
+    ], seed=42))
+
+    # ---- eager cross-process send/recv under the armed harness ---------
+    sb = acc.create_buffer(n, dataType.float32)
+    rb = acc.create_buffer(n, dataType.float32)
+    if comm.rank_is_local(src):
+        sb.host[src] = payload
+        acc.send(sb, n, src=src, dst=dst, tag=7)
+    if comm.rank_is_local(dst):
+        acc.recv(rb, n, src=src, dst=dst, tag=7)
+        assert np.array_equal(rb.host[dst], payload), "eager corrupted"
+    print(f"[p{me}] chaos eager ok", flush=True)
+
+    # ---- rendezvous (payload > max_eager_size) -------------------------
+    big = acc.config.max_eager_size // 4 + 999
+    want_big = np.arange(big, dtype=np.float32)
+    sb2 = acc.create_buffer(big, dataType.float32)
+    rb2 = acc.create_buffer(big, dataType.float32)
+    if comm.rank_is_local(src):
+        sb2.host[src] = want_big
+        acc.send(sb2, big, src=src, dst=dst, tag=9)
+    if comm.rank_is_local(dst):
+        acc.recv(rb2, big, src=src, dst=dst, tag=9)
+        assert np.array_equal(rb2.host[dst], want_big), "rendezvous corrupted"
+    print(f"[p{me}] chaos rendezvous ok", flush=True)
+
+    # ---- one bandwidth collective (integer-valued: bit-exact) ----------
+    s = acc.create_buffer(n, dataType.float32)
+    r = acc.create_buffer(n, dataType.float32)
+    for rank in range(W):
+        s.host[rank] = rank + 1
+    acc.allreduce(s, r, n, reduceFunction.SUM)
+    want = np.full(n, float(sum(range(1, W + 1))), np.float32)
+    for rank in comm.local_ranks:
+        assert np.array_equal(r.host[rank], want), "allreduce corrupted"
+    print(f"[p{me}] chaos allreduce ok", flush=True)
+
+    # ---- barrier under the delayed arrival -----------------------------
+    acc.barrier()
+    fault.clear()
+
+    injected = _counters_total("accl_fault_injected_total")
+    retries = _counters_total("accl_rpc_retry_total")
+    assert injected > 0, "chaos run fired no injections"
+    assert retries > 0, "chaos run counted no retries"
+    print(f"[p{me}] injected={injected:.0f} retries={retries:.0f}",
+          flush=True)
+    print(f"[p{me}] CHAOS-OK", flush=True)
+    return 0
+
+
+def death() -> int:
+    me = jax.process_index()
+    cfg = accl_tpu.ACCLConfig(timeout=45.0, heartbeat_interval_s=0.2,
+                              heartbeat_timeout_s=2.0)
+    acc = accl_tpu.ACCL(config=cfg)
+    comm = acc.global_comm()
+    W = acc.world_size
+    assert W == 2, "death scenario is a 2-controller script"
+    n = 64
+    payload = np.arange(n, dtype=np.float32)
+    sb = acc.create_buffer(n, dataType.float32)
+    rb = acc.create_buffer(n, dataType.float32)
+
+    acc.barrier()  # epoch-0 warmup: both controllers' leases published
+    t0 = time.monotonic()
+
+    if me == 1:
+        # die mid-protocol: the next progress-loop iteration raises — the
+        # blocked recv never completes, the lease stops refreshing
+        fault.install(FaultPlan([FaultSpec("rank.death", kind="die")]))
+        try:
+            acc.recv(rb, n, src=0, dst=1, tag=5)
+            raise AssertionError("injected rank death did not fire")
+        except RankDeath:
+            pass
+        fault.clear()
+        print(f"[p{me}] died mid-protocol (injected)", flush=True)
+    else:
+        # blocked on the dead rank: the heartbeat leases must retire this
+        # wait with PEER_FAILED well inside the 45 s session timeout
+        try:
+            acc.recv(rb, n, src=1, dst=0, tag=9)
+            raise AssertionError("wait on the dead peer did not fail")
+        except accl_tpu.ACCLError as e:
+            assert e.code == accl_tpu.errorCode.PEER_FAILED, e
+        elapsed = time.monotonic() - t0
+        assert elapsed < 20.0, f"death detection took {elapsed:.1f}s"
+        snap = metrics.snapshot()["counters"]
+        assert snap.get('accl_peer_death_total{proc="1"}', 0) >= 1
+        assert acc.stats()["fabric"]["dead_peers"] == [1]
+        print(f"[p{me}] PEER_FAILED in {elapsed:.1f}s", flush=True)
+
+    # ---- elastic re-handshake: every controller converges epoch 1 -----
+    epoch = acc.recover()
+    assert epoch == 1, epoch
+    assert acc.stats()["fabric"]["epoch"] == 1
+    print(f"[p{me}] recovered into epoch {epoch}", flush=True)
+
+    # ---- the fresh epoch round-trips bit-exactly, both directions ------
+    if me == 0:
+        sb.host[0] = payload
+        acc.send(sb, n, src=0, dst=1, tag=21)
+        acc.recv(rb, n, src=1, dst=0, tag=22)
+        assert np.array_equal(rb.host[0], payload * 3)
+    else:
+        acc.recv(rb, n, src=0, dst=1, tag=21)
+        assert np.array_equal(rb.host[1], payload)
+        sb.host[1] = payload * 3
+        acc.send(sb, n, src=1, dst=0, tag=22)
+    # drain the pair moves before entering a full-mesh device program
+    # (cooperative progress: the barrier pumps both controllers)
+    acc.barrier()
+
+    # ---- and the collective matrix is alive again ----------------------
+    s = acc.create_buffer(n, dataType.float32)
+    r = acc.create_buffer(n, dataType.float32)
+    for rank in range(W):
+        s.host[rank] = rank + 1
+    acc.allreduce(s, r, n, reduceFunction.SUM)
+    for rank in comm.local_ranks:
+        assert np.array_equal(r.host[rank], np.full(n, 3.0, np.float32))
+    acc.barrier()
+    print(f"[p{me}] CHAOS-DEATH-OK", flush=True)
+    return 0
+
+
+def main() -> int:
+    scenario = os.environ.get("ACCL_CHAOS", "transient")
+    if scenario == "death":
+        return death()
+    return transient()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -219,6 +219,27 @@ def test_obs_overhead_lane(accl):
     assert r["disabled_guard_ns"] >= 0
     assert metrics.ENABLED        # the lane restores the flag
 
+
+def test_fault_overhead_lane(accl):
+    """The round-14 fault-injection overhead lane: interleaved
+    disabled/armed-inert send-recv dispatch A/B (the obs_overhead
+    shape), raw disabled-guard cost on the record, harness disarmed on
+    exit, and the lane name in the bench catalog."""
+    from bench import KNOWN_LANES
+    from accl_tpu import fault
+    from accl_tpu.bench import lanes
+
+    assert "fault_overhead" in KNOWN_LANES
+    r = lanes.bench_fault_overhead(accl, count=1 << 8, calls=4, rounds=2)
+    assert r["metric"] == "fault_overhead" and r["unit"] == "us"
+    assert r["dispatch_disabled_us"] > 0
+    assert r["dispatch_enabled_us"] > 0
+    assert r["disabled_guard_ns"] >= 0
+    assert "enabled_delta_pct" in r
+    assert "disabled_guard_pct_of_dispatch" in r
+    assert not fault.ENABLED      # the lane disarms the harness
+
+
 def test_cmatmul_dw_and_stream_lanes_schema(accl):
     """Round-9 lanes follow the resolution protocol on every rung: the
     dw lane's honesty flag mirrors the wgrad plan + rung, the stream

@@ -1,0 +1,810 @@
+"""Resilience tier (round 14): deterministic fault injection, the unified
+retry/backoff policy, peer liveness and elastic epoch re-handshake.
+
+Three rungs, mirroring the repo's test ladder:
+
+* **unit** — FaultPlan/FaultSpec validation and deterministic firing,
+  RetryPolicy escalation/jitter/deadline semantics, the shared-deadline
+  Request.drain fix, PEER_FAILED request retirement;
+* **in-process fabric** — a real :class:`CrossProcessFabric` against an
+  in-memory coordination client (the KV API surface the fabric uses), so
+  every KV injection point, the barrier retry semantics
+  (multiproc.py "retry with a different participant set" rejection +
+  pending-arrival-consumed-on-retry), the handshake.confirm drop, the
+  heartbeat-lease death verdict and the epoch bump run fast with zero
+  subprocesses;
+* **chaos matrix** (the mpirun rung) — ``tests/mp_worker_chaos.py`` under
+  the real launcher: the collectives matrix under injected transient
+  faults completes with identical results and non-zero retry counters,
+  and an injected ``rank.death`` leaves the survivor observing
+  PEER_FAILED within the session timeout, with ``ACCL.recover()``
+  converging a fresh epoch whose send/recv round-trips bit-exactly.
+"""
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import accl_tpu
+from accl_tpu import fault, multiproc
+from accl_tpu.constants import (ACCLError, ACCLPeerFailedError,
+                                ACCLTimeoutError, dataType, errorCode,
+                                reduceFunction)
+from accl_tpu.fault import FaultInjected, FaultPlan, FaultSpec, RankDeath, RetryPolicy
+from accl_tpu.obs import metrics
+from accl_tpu.request import Request, RequestQueue, requestStatus
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _counter(name: str, **labels) -> float:
+    snap = metrics.snapshot()["counters"]
+    key = name
+    if labels:
+        key += "{" + ",".join(f'{k}="{v}"' for k, v in labels.items()) + "}"
+    return snap.get(key, 0.0)
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Every test leaves the harness disarmed (the module is process-global)."""
+    yield
+    fault.clear()
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan / point() unit semantics
+# ---------------------------------------------------------------------------
+
+def test_plan_validation():
+    with pytest.raises(ValueError, match="unknown injection point"):
+        FaultPlan([FaultSpec("kv.bogus")])
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultPlan([FaultSpec("kv.get", kind="explode")])
+    with pytest.raises(ValueError, match="probability"):
+        FaultPlan([FaultSpec("kv.get", kind="prob", probability=1.5)])
+    # every catalog point constructs
+    FaultPlan([FaultSpec(p) for p in fault.POINTS])
+
+
+def test_fail_n_times_then_clean():
+    fault.install(FaultPlan([FaultSpec("kv.get", times=3)]))
+    base = _counter("accl_fault_injected_total", point="kv.get", kind="fail")
+    fired = 0
+    for _ in range(10):
+        try:
+            fault.point("kv.get")
+        except FaultInjected:
+            fired += 1
+    assert fired == 3
+    assert fault.hits()["kv.get"] == 10
+    assert _counter("accl_fault_injected_total",
+                    point="kv.get", kind="fail") == base + 3
+
+
+def test_after_skips_first_hits():
+    fault.install(FaultPlan([FaultSpec("kv.set", times=1, after=2)]))
+    outcomes = []
+    for _ in range(4):
+        try:
+            fault.point("kv.set")
+            outcomes.append("ok")
+        except FaultInjected:
+            outcomes.append("fail")
+    assert outcomes == ["ok", "ok", "fail", "ok"]
+
+
+def test_prob_deterministic_across_installs():
+    def run():
+        fault.install(FaultPlan(
+            [FaultSpec("kv.incr", kind="prob", times=-1, probability=0.5)],
+            seed=99))
+        pat = []
+        for _ in range(32):
+            try:
+                fault.point("kv.incr")
+                pat.append(0)
+            except FaultInjected:
+                pat.append(1)
+        return pat
+
+    a, b = run(), run()
+    assert a == b and 0 < sum(a) < 32
+
+
+def test_kinds_filter_does_not_consume_spec():
+    # a delay-only site skips a fail spec without consuming its fire
+    fault.install(FaultPlan([FaultSpec("eager.segment", times=1)]))
+    fault.point("eager.segment", kinds=("delay",))  # ineligible: no raise
+    with pytest.raises(FaultInjected):
+        fault.point("eager.segment")  # the one fire is still owed
+
+
+def test_delay_sleeps_inline():
+    fault.install(FaultPlan(
+        [FaultSpec("barrier.arrive", kind="delay", delay_ms=30, times=1)]))
+    t0 = time.monotonic()
+    fault.point("barrier.arrive")   # fires: sleeps, returns
+    fault.point("barrier.arrive")   # exhausted: immediate
+    assert time.monotonic() - t0 >= 0.025
+
+
+def test_rank_death_is_base_exception():
+    fault.install(FaultPlan([FaultSpec("rank.death", kind="die")]))
+    with pytest.raises(RankDeath):
+        try:
+            fault.point("rank.death")
+        except Exception:  # noqa: BLE001 — the point of the test
+            pytest.fail("RankDeath must not be swallowed by except Exception")
+
+
+def test_proc_scoped_spec_dropped_at_install(monkeypatch):
+    monkeypatch.setenv("ACCL_PROC_ID", "0")
+    fault.install(FaultPlan([FaultSpec("kv.get", proc=3, times=-1)]))
+    fault.point("kv.get")  # other process's spec: never fires here
+    assert fault.hits().get("kv.get", 0) == 0
+
+
+def test_absorb_counts_and_converges():
+    fault.install(FaultPlan([FaultSpec("eager.segment", times=2)]))
+    base = _counter("accl_rpc_retry_total", point="eager.segment")
+    fault.absorb("eager.segment")   # swallows both fires inline
+    assert _counter("accl_rpc_retry_total",
+                    point="eager.segment") == base + 2
+
+
+def test_absorb_deadline_bounds_unlimited_fault():
+    """Regression: an unlimited-fail spec at an absorb site must surface
+    within the deadline, not spin forever (the bound every other
+    absorption path enforces)."""
+    fault.install(FaultPlan([FaultSpec("eager.segment", times=-1)]))
+    t0 = time.monotonic()
+    with pytest.raises(FaultInjected):
+        fault.absorb("eager.segment", deadline_s=0.05)
+    assert 0.03 <= time.monotonic() - t0 < 2.0
+
+
+def test_prob_times_caps_fires_not_trials():
+    """Regression: `times` is documented as capping total FIRES — a prob
+    spec must keep drawing until it has actually fired that many, not
+    stop after `times` eligible hits."""
+    fault.install(FaultPlan(
+        [FaultSpec("kv.get", kind="prob", probability=0.3, times=3)],
+        seed=11))
+    fires = 0
+    for _ in range(200):
+        try:
+            fault.point("kv.get")
+        except FaultInjected:
+            fires += 1
+    assert fires == 3
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy — THE backoff implementation
+# ---------------------------------------------------------------------------
+
+def test_interval_escalates_and_caps():
+    p = RetryPolicy(initial_s=0.002, backoff=2.0, max_s=0.1, jitter=0.0)
+    ivs = [p.interval(i) for i in range(10)]
+    assert ivs[0] == pytest.approx(0.002)
+    assert ivs[1] == pytest.approx(0.004)
+    assert ivs[-1] == pytest.approx(0.1)
+    assert all(b <= a for a, b in zip(ivs[1:], ivs))  # monotone
+
+
+def test_interval_unbounded_attempt_no_overflow():
+    """Regression: the wait loops feed UNBOUNDED idle counters into
+    interval() — a wait blocked a few seconds reaches attempts in the
+    thousands, and the uncapped float pow raised OverflowError long
+    before any session timeout could fire."""
+    for p in (fault.POLL_POLICY, fault.WAIT_POLICY,
+              RetryPolicy(initial_s=1e-6, backoff=10.0, max_s=5.0)):
+        assert p.interval(2110) == pytest.approx(p.max_s)
+        assert p.interval(10 ** 6) == pytest.approx(p.max_s)
+        assert p.interval(10 ** 6, random.Random(1)) <= p.max_s * 1.3
+
+
+def test_jitter_bounded_and_deterministic():
+    p = fault.POLL_POLICY
+    seq1 = [p.interval(i, random.Random(5)) for i in range(8)]
+    seq2 = [p.interval(i, random.Random(5)) for i in range(8)]
+    assert seq1 == seq2
+    base = [p.interval(i) for i in range(8)]
+    assert all(b <= j <= b * (1 + p.jitter) + 1e-12
+               for b, j in zip(base, seq1))
+    # the poll ladder's envelope matches the measured round-5 ladder
+    assert base[0] == pytest.approx(2e-4)
+    assert base[7] == pytest.approx(2e-3)
+
+
+def test_poll_sleep_rides_the_policy(monkeypatch):
+    slept = []
+    monkeypatch.setattr(multiproc.time, "sleep", slept.append)
+    multiproc.CrossProcessFabric.poll_sleep(0)
+    multiproc.CrossProcessFabric.poll_sleep(20)
+    lo = fault.POLL_POLICY.interval(0)
+    hi = fault.POLL_POLICY.interval(20)
+    assert lo <= slept[0] <= lo * 1.25 + 1e-12
+    assert hi <= slept[1] <= hi * 1.25 + 1e-12
+
+
+def test_call_absorbs_transients_counted():
+    fails = [3]
+
+    def flaky():
+        if fails[0]:
+            fails[0] -= 1
+            raise FaultInjected("kv.get", "fail", 1)
+        return "ok"
+
+    base = _counter("accl_rpc_retry_total", point="unit")
+    p = RetryPolicy(initial_s=1e-4, max_s=1e-3)
+    assert p.call(flaky, point="unit") == "ok"
+    assert _counter("accl_rpc_retry_total", point="unit") == base + 3
+
+
+def test_call_permanent_error_immediate():
+    calls = [0]
+
+    def bad():
+        calls[0] += 1
+        raise ValueError("schema mismatch")
+
+    with pytest.raises(ValueError):
+        RetryPolicy().call(bad, point="unit2")
+    assert calls[0] == 1
+
+
+def test_call_deadline_bounds_retries():
+    def always():
+        raise FaultInjected("kv.set", "fail", 1)
+
+    p = RetryPolicy(initial_s=5e-3, backoff=1.0, max_s=5e-3)
+    t0 = time.monotonic()
+    with pytest.raises(FaultInjected):
+        p.call(always, point="unit3", deadline_s=0.08)
+    assert 0.05 <= time.monotonic() - t0 < 2.0
+
+
+def test_call_never_retries_rank_death():
+    calls = [0]
+
+    def die():
+        calls[0] += 1
+        raise RankDeath("x")
+
+    with pytest.raises(RankDeath):
+        RetryPolicy().call(die, point="unit4")
+    assert calls[0] == 1
+
+
+def test_is_transient_classification():
+    assert fault.is_transient(FaultInjected("kv.get", "fail", 1))
+    assert fault.is_transient(RuntimeError("UNAVAILABLE: conn dropped"))
+    assert fault.is_transient(OSError("Connection reset by peer"))
+    assert not fault.is_transient(RankDeath("x"))
+    assert not fault.is_transient(ValueError("NOT_FOUND-ish but not"))
+    assert not fault.is_transient(KeyError("plain miss"))
+
+
+def test_policy_from_config():
+    cfg = accl_tpu.ACCLConfig(rpc_retry_initial_ms=7.0, rpc_retry_backoff=3.0,
+                              rpc_retry_max_ms=70.0, rpc_retry_jitter=0.1)
+    p = fault.policy_from_config(cfg)
+    assert p.initial_s == pytest.approx(0.007)
+    assert p.backoff == 3.0
+    assert p.max_s == pytest.approx(0.07)
+    assert p.jitter == 0.1
+
+
+# ---------------------------------------------------------------------------
+# Request: shared drain deadline + PEER_FAILED retirement
+# ---------------------------------------------------------------------------
+
+def test_drain_shares_one_deadline():
+    """Regression (round-14 satellite): drain(timeout=T) used to hand EACH
+    request the full T, so N parked requests could take N*T. One request
+    fulfills at 0.35 s, the other never — the whole drain must stop at
+    ~T, not 0.35 + T."""
+    q = RequestQueue()
+    r1 = Request("recv", external=True)
+    r2 = Request("recv", external=True)
+    q.push(r1)
+    q.push(r2)
+    threading.Timer(0.35, lambda: r1.fulfill(outputs=None)).start()
+    t0 = time.monotonic()
+    with pytest.raises(ACCLTimeoutError):
+        q.drain(timeout=0.7)
+    elapsed = time.monotonic() - t0
+    assert 0.6 <= elapsed < 0.98, elapsed  # old behavior: >= 1.05
+    r2.cancel()
+
+
+def test_peer_failed_retires_request_counted():
+    dead = ACCLPeerFailedError([1], "request wait")
+
+    def pump() -> bool:
+        raise dead
+
+    req = Request("recv", external=True, progress=pump)
+    base = _counter("accl_requests_total", op="recv", status="peer_failed")
+    with pytest.raises(ACCLError) as ei:
+        req.wait(timeout=1.0)
+    assert ei.value.code == errorCode.PEER_FAILED
+    assert req.status == requestStatus.PEER_FAILED
+    assert req.get_retcode() == errorCode.PEER_FAILED
+    assert _counter("accl_requests_total",
+                    op="recv", status="peer_failed") == base + 1
+    # a PEER_FAILED request is terminal: drain skips it
+    q = RequestQueue()
+    q.push(req)
+    q.drain(timeout=0.1)
+
+
+def test_rank_death_fires_in_wait_pump():
+    fault.install(FaultPlan([FaultSpec("rank.death", kind="die")]))
+    req = Request("recv", external=True, progress=lambda: False)
+    with pytest.raises(RankDeath):
+        req.wait(timeout=1.0)
+
+
+def test_rank_death_site_ignores_transient_kinds():
+    """A fail-kind spec on rank.death is ineligible at the death sites
+    (nothing absorbs a transient there) — the wait times out normally
+    instead of leaking a raw FaultInjected into application code."""
+    fault.install(FaultPlan([FaultSpec("rank.death", times=-1)]))  # "fail"
+    req = Request("recv", external=True, progress=lambda: False)
+    with pytest.raises(ACCLTimeoutError):
+        req.wait(timeout=0.05)
+
+
+def test_terminal_guard_includes_peer_failed():
+    """Parked continuations must stand down on a PEER_FAILED retirement —
+    a request retired by the death verdict must not keep announcing or
+    delivering into the caller's buffer."""
+    from accl_tpu import accl as accl_mod
+    assert requestStatus.PEER_FAILED in accl_mod._TERMINAL
+    assert requestStatus.ERROR in accl_mod._TERMINAL
+    assert requestStatus.COMPLETED in accl_mod._TERMINAL
+
+
+def test_interval_zero_initial_no_overflow():
+    """rpc_retry_initial_ms=0 ('retry immediately') is a legal register
+    value: interval() must return 0.0 at any attempt, not overflow."""
+    p = RetryPolicy(initial_s=0.0, backoff=2.0, max_s=0.1)
+    assert p.interval(0) == 0.0
+    assert p.interval(10 ** 6) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# in-process fabric rung: a real CrossProcessFabric over an in-memory KV
+# ---------------------------------------------------------------------------
+
+class FakeKVClient:
+    """In-memory stand-in for the jax.distributed coordination client —
+    exactly the API surface CrossProcessFabric touches."""
+
+    def __init__(self):
+        self.kv = {}
+        self.incr_calls = 0
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        if not allow_overwrite and key in self.kv:
+            raise RuntimeError(f"ALREADY_EXISTS: {key}")
+        self.kv[key] = str(value)
+
+    def key_value_try_get(self, key):
+        if key not in self.kv:
+            raise KeyError(f"NOT_FOUND: {key}")
+        return self.kv[key]
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        if key in self.kv:
+            return self.kv[key]
+        raise TimeoutError(f"deadline waiting for {key}")
+
+    def key_value_increment(self, key, by=1):
+        self.incr_calls += 1
+        n = int(self.kv.get(key, "0")) + by
+        self.kv[key] = str(n)
+        return n
+
+    def key_value_delete(self, key):
+        self.kv.pop(key, None)
+
+    def key_value_dir_get(self, prefix):
+        return [(k, v) for k, v in self.kv.items() if k.startswith(prefix)]
+
+
+@pytest.fixture()
+def fab(monkeypatch):
+    monkeypatch.delenv("ACCL_SESSION", raising=False)
+    fake = FakeKVClient()
+    monkeypatch.setattr(multiproc, "_client", lambda: fake)
+    f = multiproc.CrossProcessFabric(
+        timeout=5.0, eager_window=4,
+        retry_policy=RetryPolicy(initial_s=1e-4, max_s=1e-3),
+        heartbeat_interval_s=0.02, heartbeat_timeout_s=0.0)
+    yield f, fake
+    fault.clear()
+
+
+@pytest.mark.parametrize("point,kind", [
+    ("kv.get", "fail"), ("kv.set", "fail"), ("kv.incr", "fail"),
+    ("kv.get", "drop"), ("kv.set", "drop"),
+])
+def test_kv_points_absorb_transients(fab, point, kind):
+    """3 transient failures at every KV injection point are absorbed by
+    the retry policy — the op still succeeds and the retries are counted
+    (the acceptance-criteria injection (a), on the fast rung)."""
+    f, fake = fab
+    fake.kv["have"] = "42"
+    fault.install(FaultPlan([FaultSpec(point, kind=kind, times=3)]))
+    inj = _counter("accl_fault_injected_total", point=point, kind=kind)
+    ret = _counter("accl_rpc_retry_total", point=point)
+    if point == "kv.get":
+        assert f._try_get(fake, "have") == "42"
+    elif point == "kv.set":
+        f._kset(fake, "put", "v")
+        assert fake.kv["put"] == "v"
+    else:
+        assert f._kincr(fake, "ctr") == 1
+        assert fake.incr_calls >= 1
+    assert _counter("accl_fault_injected_total",
+                    point=point, kind=kind) == inj + 3
+    assert _counter("accl_rpc_retry_total", point=point) == ret + 3
+
+
+def test_kv_permanent_fault_surfaces_within_deadline(fab):
+    """An unlimited injected fault is NOT absorbed forever: the retry
+    policy re-raises once the session deadline is spent — permanent
+    outages still surface, bounded."""
+    f, fake = fab
+    f.timeout = 0.15
+    fault.install(FaultPlan([FaultSpec("kv.set", times=-1)]))
+    t0 = time.monotonic()
+    with pytest.raises(FaultInjected):
+        f._kset(fake, "k", "v")
+    assert 0.1 <= time.monotonic() - t0 < 3.0
+
+
+def test_kset_retry_after_ambiguous_landed_set(fab):
+    """Regression: a REAL transient failure after the coordinator applied
+    a create-only set makes the policy's retry land on ALREADY_EXISTS.
+    The retried (key, value) pair is identical, so the publish already
+    succeeded — absorbed; a genuinely conflicting value still raises."""
+    f, fake = fab
+
+    class AmbiguousClient(FakeKVClient):
+        def __init__(self):
+            super().__init__()
+            self.tripped = False
+
+        def key_value_set(self, key, value, allow_overwrite=False):
+            super().key_value_set(key, value, allow_overwrite)
+            if key == "amb" and not self.tripped:
+                self.tripped = True   # applied, then the ack was lost
+                raise RuntimeError("UNAVAILABLE: connection reset")
+
+    c = AmbiguousClient()
+    f._kset(c, "amb", "v1")
+    assert c.kv["amb"] == "v1"
+    c.kv["other"] = "old"
+    with pytest.raises(RuntimeError, match="ALREADY_EXISTS"):
+        f._kset(c, "other", "new")
+
+
+def test_announce_drop_absorbed(fab):
+    """Acceptance injection (b): a dropped eager announce re-publishes
+    under the retry policy — the header lands, the seq is committed."""
+    f, fake = fab
+
+    class _Payload:
+        dtype = np.dtype(np.float32)
+        shape = (1, 8)
+
+    fault.install(FaultPlan(
+        [FaultSpec("eager.announce", kind="drop", times=1)]))
+    ret = _counter("accl_rpc_retry_total", point="eager.announce")
+    seq = f.announce(0, 1, tag=7, payload=_Payload(), kind="e", nseg=1)
+    assert seq == 1
+    assert f"{f.ns}/m/0.1/1" in fake.kv
+    assert _counter("accl_rpc_retry_total",
+                    point="eager.announce") == ret + 1
+
+
+def test_barrier_under_arrive_faults(fab):
+    """Acceptance injection (c): failed + delayed barrier arrivals are
+    absorbed (fail retried before the increment — never double-counted;
+    delay stretches the round) and the single-participant round still
+    completes with exactly ONE arrival recorded."""
+    f, fake = fab
+    fault.install(FaultPlan([
+        FaultSpec("barrier.arrive", kind="fail", times=2),
+        FaultSpec("barrier.arrive", kind="delay", delay_ms=20, times=1),
+    ]))
+    f.barrier("t", process_ids=[0])
+    assert fake.kv[f"{f.ns}/b/t"] == "1"
+    f.barrier("t", process_ids=[0])  # next round unaffected
+    assert fake.kv[f"{f.ns}/b/t"] == "2"
+
+
+def test_barrier_retry_different_participants_rejected(fab):
+    """multiproc.py documented rejection: a timed-out arrival stays
+    pending, and retrying under a DIFFERENT participant set is a
+    CONFIG_ERROR (same-name same-scope retry contract)."""
+    f, fake = fab
+    f.timeout = 0.2
+    with pytest.raises(ACCLTimeoutError):
+        f.barrier("x", process_ids=[0, 1])   # peer never arrives
+    with pytest.raises(ACCLError) as ei:
+        f.barrier("x", process_ids=[0])
+    assert ei.value.code == errorCode.CONFIG_ERROR
+    assert "participants" in str(ei.value)
+
+
+def test_barrier_pending_arrival_consumed_on_retry(fab):
+    """multiproc.py documented retry semantics: the retry re-waits on the
+    recorded target WITHOUT incrementing again — otherwise the retry's
+    own arrival would complete the broken round with no peer present."""
+    f, fake = fab
+    f.timeout = 0.2
+    key = f"{f.ns}/b/y"
+    with pytest.raises(ACCLTimeoutError):
+        f.barrier("y", process_ids=[0, 1])
+    assert fake.kv[key] == "1"
+    fake.key_value_increment(key)        # the laggard peer finally arrives
+    f.timeout = 5.0
+    f.barrier("y", process_ids=[0, 1])   # retry: passes, no new arrival
+    assert fake.kv[key] == "2"
+    # a FRESH round after the consumed retry increments again
+    fake.key_value_increment(key)        # peer's round-2 arrival
+    f.barrier("y", process_ids=[0, 1])
+    assert fake.kv[key] == "4"
+
+
+def test_handshake_confirm_drop_converges(fab, monkeypatch):
+    """Satellite: an injected handshake.confirm drop bumps
+    accl_session_handshake_retries_total and the nonce handshake still
+    converges (exercised on the non-p0 reader path)."""
+    f, fake = fab
+    g = object.__new__(multiproc.CrossProcessFabric)
+    g.timeout = 5.0
+    g.instance = 7
+    g._me = 1
+    g.kv_bytes = 0
+    g._retry = RetryPolicy(initial_s=1e-4, max_s=1e-3)
+    g._rng = random.Random(0)
+    fake.kv["accl/sess/7"] = "sX"
+    fake.kv["accl/sess_ok/7/sX"] = "1"
+    fault.install(FaultPlan(
+        [FaultSpec("handshake.confirm", kind="drop", times=2)]))
+    base = _counter("accl_session_handshake_retries_total")
+    assert multiproc.CrossProcessFabric._resolve_session(g) == "sX"
+    assert _counter("accl_session_handshake_retries_total") == base + 2
+    assert fake.kv["accl/sess_ack/7/sX/1"] == "sX"
+
+
+def test_heartbeat_lease_publish_and_death_verdict(fab):
+    """The lease protocol end to end on one fabric: publish rate-limited
+    by the interval; a watched peer whose lease value stops changing goes
+    dead after the staleness window (counted once, latched); an
+    unpublished lease is 'unknown', never 'dead'."""
+    f, fake = fab
+    f.set_resilience(f._retry, 0.02, 0.15)
+    f._maybe_heartbeat(fake)
+    assert fake.kv[f"{f.ns}/hb/0"] == "1"
+    f._maybe_heartbeat(fake)             # inside the interval: no publish
+    assert fake.kv[f"{f.ns}/hb/0"] == "1"
+
+    # peer 1 never published: unknown, not dead
+    time.sleep(0.03)
+    assert f.check_peers(procs=[1]) == []
+    time.sleep(0.2)
+    assert f.check_peers(procs=[1]) == []
+
+    # peer 1 publishes once, then stops: dead after the window
+    fake.kv[f"{f.ns}/hb/1"] = "5"
+    base = _counter("accl_peer_death_total", proc="1")
+    time.sleep(0.03)                          # past the sweep rate-limit
+    assert f.check_peers(procs=[1]) == []     # first observation
+    time.sleep(0.2)
+    assert f.check_peers(procs=[1]) == [1]
+    assert f.dead_peers == [1]
+    assert _counter("accl_peer_death_total", proc="1") == base + 1
+    time.sleep(0.03)
+    assert f.check_peers(procs=[1]) == [1]    # latched, counted once
+    assert _counter("accl_peer_death_total", proc="1") == base + 1
+    with pytest.raises(ACCLPeerFailedError) as ei:
+        f.raise_if_peer_failed("unit wait", procs=[1])
+    assert ei.value.code == errorCode.PEER_FAILED
+    assert ei.value.procs == [1]
+
+    # a beating peer never trips the verdict
+    fake.kv[f"{f.ns}/hb/2"] = "1"
+    f.check_peers(procs=[2])
+    time.sleep(0.03)
+    fake.kv[f"{f.ns}/hb/2"] = "2"
+    f.check_peers(procs=[2])
+    assert 2 not in f.dead_peers
+
+
+def test_bump_epoch_fresh_namespace_and_state(fab):
+    f, fake = fab
+    old_ns = f.ns
+    f._out_seq[(0, 1)] = 5
+    f._dead_peers.add(1)
+    f._barrier_pending["x"] = (2, 2)
+    base = _counter("accl_session_epoch_total")
+    assert f.bump_epoch() == 1
+    assert f.ns != old_ns and f.ns.endswith(".e1")
+    assert f.epoch == 1
+    assert not f._out_seq and not f._barrier_pending
+    assert f.dead_peers == []
+    assert f._cursor == 1
+    assert _counter("accl_session_epoch_total") == base + 1
+    # seqs restart cleanly in the new namespace
+    assert f.next_seq(0, 1) == 1
+
+
+def test_config_write_through_to_fabric(fab):
+    f, fake = fab
+    pol = RetryPolicy(initial_s=0.5, backoff=9.0, max_s=2.0, jitter=0.0)
+    f.set_resilience(pol, 3.0, 33.0)
+    assert f._retry is pol
+    assert f.heartbeat_interval == 3.0
+    assert f.heartbeat_timeout == 33.0
+
+
+# ---------------------------------------------------------------------------
+# in-process chaos matrix: send/recv + a bandwidth collective + barrier
+# under each injection kind (seeded, deterministic)
+# ---------------------------------------------------------------------------
+
+N = 257
+
+
+def _roundtrip(accl, tag: int) -> None:
+    payload = np.arange(64, dtype=np.float32) + tag
+    sb = accl.create_buffer(64, dataType.float32)
+    rb = accl.create_buffer(64, dataType.float32)
+    sb.host[0] = payload
+    accl.send(sb, 64, src=0, dst=1, tag=tag)
+    accl.recv(rb, 64, src=0, dst=1, tag=tag)
+    assert np.array_equal(rb.host[1], payload)
+
+
+@pytest.mark.parametrize("kind", ["fail", "prob", "drop", "delay"])
+def test_chaos_matrix_inprocess(accl, kind):
+    """The tier-1 chaos matrix (single-controller rung): send/recv, one
+    bandwidth collective and a barrier complete with IDENTICAL results
+    under every transient injection kind at the eager-segment lifecycle
+    points, every fire counted. (The KV points live on the cross-process
+    rung — covered above against the in-memory client and end-to-end by
+    the launcher scenario below.)"""
+    spec = FaultSpec("eager.segment", kind=kind, times=6,
+                     probability=0.5, delay_ms=3)
+    fault.install(FaultPlan([spec], seed=21))
+    inj = sum(v for k, v in metrics.snapshot()["counters"].items()
+              if k.startswith("accl_fault_injected_total"))
+    try:
+        _roundtrip(accl, tag=100)
+        s = accl.create_buffer(N, dataType.float32)
+        r = accl.create_buffer(N, dataType.float32)
+        for rank in range(accl.world_size):
+            s.host[rank] = rank + 1
+        accl.allreduce(s, r, N, reduceFunction.SUM)
+        want = sum(range(1, accl.world_size + 1))
+        assert np.allclose(r.host, want)
+        accl.barrier()
+    finally:
+        fired = fault.hits().get("eager.segment", 0)
+        fault.clear()
+    assert fired >= 1
+    if kind != "prob":  # prob may legitimately skip fires, hits still count
+        assert sum(v for k, v in metrics.snapshot()["counters"].items()
+                   if k.startswith("accl_fault_injected_total")) > inj
+
+
+def test_chaos_rank_death_then_recover_inprocess(accl):
+    """rank.death on the single-controller rung: an async request's wait
+    pump dies mid-protocol; recover() resets the session state and the
+    matrix runs clean afterwards (the cross-process epoch re-handshake is
+    the launcher scenario's job)."""
+    rb = accl.create_buffer(64, dataType.float32)
+    req = accl.recv(rb, 64, src=0, dst=1, tag=777, run_async=True)
+    fault.install(FaultPlan([FaultSpec("rank.death", kind="die")]))
+    with pytest.raises(RankDeath):
+        req.wait(timeout=5.0)
+    fault.clear()
+    assert accl.recover() == 0   # no fabric: local resets only
+    _roundtrip(accl, tag=778)
+
+
+# ---------------------------------------------------------------------------
+# disabled-path overhead: the ENABLED guard is the whole cost
+# ---------------------------------------------------------------------------
+
+def test_disabled_guard_overhead_budget(accl):
+    """Acceptance: disabled injection points + liveness checks cost <=5%
+    of one measured dispatch (the obs.metrics pattern — one boolean read
+    per site; the fault_overhead bench lane reports precise figures)."""
+    a = accl.create_buffer(1024, dataType.float32)
+    b = accl.create_buffer(1024, dataType.float32)
+    accl.allreduce(a, b, 1024, reduceFunction.SUM,
+                   from_device=True, to_device=True)  # warm the program
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        accl.allreduce(a, b, 1024, reduceFunction.SUM,
+                       from_device=True, to_device=True)
+        ts.append(time.perf_counter() - t0)
+    t_op = float(np.median(ts))
+
+    assert not fault.ENABLED
+    n = 5000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        # every guard the armed build adds to one eager segment's path:
+        # the reserve site, the post site, and the wait-pump death site
+        if fault.ENABLED:
+            fault.absorb("eager.segment",
+                         kinds=("fail", "prob", "drop", "die"))
+        if fault.ENABLED:
+            fault.point("eager.segment", kinds=("delay",))
+        if fault.ENABLED:
+            fault.point("rank.death")
+    per_dispatch_guard = (time.perf_counter() - t0) / n
+    assert per_dispatch_guard < 0.05 * t_op, (
+        f"disabled fault guard {per_dispatch_guard * 1e6:.2f}us vs "
+        f"dispatch {t_op * 1e6:.1f}us")
+
+
+# ---------------------------------------------------------------------------
+# the mpirun rung: full chaos matrix + death/recover under the launcher
+# ---------------------------------------------------------------------------
+
+def _run_launcher(args, timeout=420, extra_env=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("ACCL_COORDINATOR", None)
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "accl_tpu.launch", *args],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout)
+
+
+def test_chaos_matrix_cross_process():
+    """Acceptance criteria (a)+(b)+(c) end to end: 3 transient failures at
+    every KV point, a dropped eager announce, a delayed barrier arrival —
+    the cross-process matrix completes with identical results and
+    non-zero accl_rpc_retry_total / accl_fault_injected_total."""
+    res = _run_launcher(
+        ["-np", "2", "--devices-per-proc", "1",
+         os.path.join("tests", "mp_worker_chaos.py")],
+        extra_env={"ACCL_CHAOS": "transient"})
+    sys.stdout.write(res.stdout)
+    sys.stderr.write(res.stderr)
+    assert res.returncode == 0, f"launcher rc={res.returncode}"
+    assert res.stdout.count("CHAOS-OK") == 2
+
+
+def test_chaos_rank_death_peer_failed_and_recover():
+    """Acceptance criterion (d): with rank.death injected on one
+    controller, the survivor observes PEER_FAILED well within the session
+    timeout (no unbounded block), and ACCL.recover() converges a fresh
+    epoch whose send/recv round-trips bit-exactly."""
+    res = _run_launcher(
+        ["-np", "2", "--devices-per-proc", "1",
+         os.path.join("tests", "mp_worker_chaos.py")],
+        extra_env={"ACCL_CHAOS": "death"})
+    sys.stdout.write(res.stdout)
+    sys.stderr.write(res.stderr)
+    assert res.returncode == 0, f"launcher rc={res.returncode}"
+    assert res.stdout.count("CHAOS-DEATH-OK") == 2

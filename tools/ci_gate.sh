@@ -2,11 +2,16 @@
 # CI gate: the tier-1 verify command chained with the bench regression
 # differ (round 11's bench/compare.py, finally wired to a gate).
 #
-#   tools/ci_gate.sh [--threshold 0.10]
+#   tools/ci_gate.sh [--threshold 0.10] [--chaos]
 #
 # 1. Runs the ROADMAP tier-1 verify command (the full fast test suite on
 #    the CPU emulator rung). A failure here fails the gate immediately.
-# 2. If at least TWO BENCH_*.json artifacts exist in the repo root, diffs
+# 2. With --chaos, re-runs the round-14 chaos matrix STANDALONE
+#    (tests/test_fault.py: the fault-injection sweep, the cross-process
+#    transient matrix and the rank-death/recover scenario) — a clean
+#    isolated pass proves the resilience tier independent of suite
+#    ordering/fixture reuse. A failure fails the gate.
+# 3. If at least TWO BENCH_*.json artifacts exist in the repo root, diffs
 #    the two most recent with `python -m accl_tpu.bench.compare` (base =
 #    the older of the pair) and propagates its exit code — a >threshold
 #    per-lane drop fails the gate. Fewer than two artifacts skips the
@@ -17,9 +22,23 @@ REPO="$(cd "$(dirname "$0")/.." && pwd)"
 cd "$REPO"
 
 THRESHOLD="0.10"
-if [[ "${1:-}" == "--threshold" && -n "${2:-}" ]]; then
-    THRESHOLD="$2"
-fi
+CHAOS=0
+while [[ $# -gt 0 ]]; do
+    case "$1" in
+        --threshold)
+            THRESHOLD="${2:?--threshold needs a value}"
+            shift 2
+            ;;
+        --chaos)
+            CHAOS=1
+            shift
+            ;;
+        *)
+            echo "[ci_gate] unknown argument: $1" >&2
+            exit 2
+            ;;
+    esac
+done
 
 echo "[ci_gate] tier-1 verify..." >&2
 rm -f /tmp/_t1.log
@@ -31,6 +50,19 @@ echo "[ci_gate] tier-1 rc=${t1_rc} DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]
 if [[ $t1_rc -ne 0 ]]; then
     echo "[ci_gate] FAIL: tier-1 verify failed (rc=${t1_rc})" >&2
     exit "$t1_rc"
+fi
+
+if [[ $CHAOS -eq 1 ]]; then
+    echo "[ci_gate] chaos matrix (tests/test_fault.py standalone)..." >&2
+    timeout -k 10 450 env JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_fault.py -q --continue-on-collection-errors \
+        -p no:cacheprovider -p no:xdist -p no:randomly
+    chaos_rc=$?
+    if [[ $chaos_rc -ne 0 ]]; then
+        echo "[ci_gate] FAIL: chaos matrix failed (rc=${chaos_rc})" >&2
+        exit "$chaos_rc"
+    fi
+    echo "[ci_gate] chaos matrix PASS" >&2
 fi
 
 # two most recent bench artifacts by NAME (version sort): round-numbered
