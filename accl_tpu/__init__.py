@@ -26,6 +26,7 @@ from .communicator import Communicator, Rank
 from .config import ACCLConfig, Algorithm, TransportBackend
 from . import fault
 from .constants import (
+    ACCLCommInvalidatedError,
     ACCLError,
     ACCLPeerFailedError,
     ACCLTimeoutError,
@@ -44,6 +45,7 @@ __version__ = "0.3.0"
 
 __all__ = [
     "ACCL",
+    "ACCLCommInvalidatedError",
     "ACCLConfig",
     "ACCLError",
     "ACCLPeerFailedError",

@@ -108,6 +108,7 @@ class ACCL:
 
         _zero_model.set_overlap_enabled(cfg.zero_overlap)
         _zero_model.set_prefetch_enabled(cfg.zero_prefetch)
+        _zero_model.set_replicas_enabled(cfg.shard_replicas)
         # the program cache's LRU bound follows the config on every
         # assignment (the setter can run from __init__ before the cache
         # exists — construction applies the bound itself then)
@@ -168,6 +169,12 @@ class ACCL:
         from .parallel import synth as _synth
 
         _synth.reset_plan_cache()
+        # session epoch: bumped by every recover() and baked into the
+        # program-cache keys AND the synth plan-cache keys, so a plan or
+        # program resolved before a rank death is unreachable afterwards
+        # even where the rest of the key collides (docs/resilience.md §5)
+        self._epoch = 0
+        _synth.set_session_epoch(0)
         if self.config.transport is None:
             from .utils.bringup import detect_backend
 
@@ -241,6 +248,10 @@ class ACCL:
             # every rank a listed process owns is presumed failed
             "dead_peers": (self._fabric.dead_peers
                            if self._fabric is not None else []),
+            # processes a survivor-subset recovery removed for good
+            # (distinct from the per-epoch dead_peers verdicts)
+            "excluded_peers": (self._fabric.excluded_peers
+                               if self._fabric is not None else []),
         }
         out = []
         for rank, d in enumerate(self._devices):
@@ -335,7 +346,26 @@ class ACCL:
         The caller contract is fail-stop-per-call, elastic-per-session:
         the interrupted collective is NOT resumed — its requests were
         retired with PEER_FAILED/cancel verdicts — the application
-        re-issues work in the new epoch."""
+        re-issues work in the new epoch.
+
+        **Shrink mode (survivor-subset recovery, round 15).** When a
+        rank is TRULY gone — ``process_ids`` omitted while the fabric
+        has latched death verdicts (``fabric.dead_peers``), or an
+        explicit ``process_ids`` naming a strict subset of the mesh's
+        processes — the session recovers onto the SURVIVOR mesh instead
+        of waiting forever for the full world: ``process_ids`` defaults
+        to every mesh process minus the dead set (the full-world
+        re-handshake stays available by passing it explicitly), only the
+        survivors meet at the epoch barrier, and after convergence the
+        mesh itself shrinks (:meth:`_shrink_mesh`): the global
+        communicator is rebuilt via ``Communicator.split`` over the
+        surviving rank indices — dense new local ranks, original devices
+        (and process ids) retained for addressing — while every
+        communicator spanning a dead rank is invalidated and counted.
+        Each recovery counts ``accl_recover_total{mode=shrink|full}``,
+        and the session epoch is baked into the program- and
+        schedule-plan cache keys so nothing resolved before the death is
+        dispatchable after it."""
         # ONE local-reset implementation: soft_reset owns the ordering
         # invariants (retry queue dropped before matcher state, fabric
         # tombstones — harmless extra writes to the abandoned namespace)
@@ -344,7 +374,13 @@ class ACCL:
 
         _synth.reset_plan_cache()
         epoch = 0
+        mode = "full"
+        dead_procs: List[int] = []
         if self._fabric is not None:
+            mesh_procs = sorted({getattr(d, "process_index", 0)
+                                 for d in self.comms[0].devices})
+            process_ids, dead_procs, mode = self._recover_participants(
+                process_ids, mesh_procs)
             epoch = self._fabric.bump_epoch()
             # bootstrap re-handshake: all recovering controllers meet at
             # the fresh namespace's first barrier round (the arrival
@@ -353,8 +389,84 @@ class ACCL:
             # not rejoin; default is the full mesh (elastic rejoin)
             self._fabric.barrier("epoch", process_ids=process_ids,
                                  pump=self._pump)
-        log.info("recovered: session epoch %d", epoch)
+            if dead_procs:
+                self._shrink_mesh(dead_procs)
+                # rank loss is a commitment: the excluded processes stay
+                # outside the liveness sweeps for the whole session (an
+                # epoch bump clears ordinary verdicts for elastic
+                # rejoin; a shrunk-away process must never re-latch one)
+                self._fabric.exclude_peers(dead_procs)
+        # epoch-keyed caches: every recovery (the fabric-less rung
+        # included) bumps the session epoch, so no pre-death program or
+        # plan cache key can collide with a post-recovery resolution
+        self._epoch += 1
+        _synth.set_session_epoch(self._epoch)
+        _metrics.inc("accl_recover_total", labels=(("mode", mode),))
+        log.info("recovered: session epoch %d (%s)", epoch, mode)
         return epoch
+
+    def _recover_participants(self, process_ids, mesh_procs):
+        """Resolve the epoch re-handshake participant set: ``(process_ids
+        or None, dead mesh procs, mode)``. The round-15 ergonomics: with
+        no ``process_ids`` and latched death verdicts on mesh processes,
+        the SURVIVOR set is the default (a full-world re-handshake with a
+        truly-gone rank can never converge; the full-world form stays
+        available by passing the full list explicitly). An explicit
+        ``process_ids`` that names a strict subset of the mesh's
+        processes also shrinks."""
+        dead = set(self._fabric.dead_peers)
+        if process_ids is None:
+            dead_procs = [p for p in mesh_procs if p in dead]
+            if dead_procs:
+                return ([p for p in mesh_procs if p not in dead],
+                        dead_procs, "shrink")
+            return None, [], "full"
+        dead_procs = [p for p in mesh_procs if p not in set(process_ids)]
+        return (list(process_ids), dead_procs,
+                "shrink" if dead_procs else "full")
+
+    def _shrink_mesh(self, dead_procs: List[int]) -> None:
+        """Degrade the session's mesh after TRUE rank loss
+        (docs/resilience.md §5): replace the global communicator with its
+        ``split()`` over the surviving rank indices — dense new local
+        ranks, original devices/process ids retained for addressing — and
+        invalidate (never repair) every communicator that spans a dead
+        rank: groups are cheap to re-create from the shrunk global
+        communicator, and a program over a dead device could never
+        converge. Surviving sub-communicators (all ranks alive) keep
+        working untouched."""
+        old = self.comms[0]
+        dead_ranks = old.ranks_of_processes(dead_procs)
+        if not dead_ranks:
+            return
+        survivors = [i for i in range(old.world_size)
+                     if i not in set(dead_ranks)]
+        new_global = old.split(survivors)
+        # the survivor mesh genuinely LOST topology (vs an ordinary
+        # sub-group): synth's degraded-decline accounting keys off this
+        new_global.degraded_from = old.world_size
+        keep: List[Communicator] = []
+        for comm in self.comms:
+            if comm.ranks_of_processes(dead_procs):
+                comm.invalidate(
+                    f"communicator spans rank(s) owned by dead controller "
+                    f"process(es) {sorted(dead_procs)}; re-create the "
+                    f"group from the shrunk global communicator")
+                _metrics.inc("accl_comm_invalidated_total")
+                self._matchers.pop(id(comm), None)
+            else:
+                keep.append(comm)
+        self.comms = [new_global] + keep
+        # the shrunk mesh IS the session's world now: scan(), world_size
+        # and default-comm dispatch all follow it
+        self._devices = new_global.devices
+        self._matchers[id(new_global)] = MatchingEngine(
+            new_global, rx_buffer_count=self.config.eager_rx_buffer_count)
+        self._reqreg = self._matchers[id(new_global)]._native
+        log.warning(
+            "mesh shrunk %d -> %d ranks: dead rank(s) %s on controller "
+            "process(es) %s", old.world_size, new_global.world_size,
+            dead_ranks, sorted(dead_procs))
 
     # ------------------------------------------------------------------
     # config calls (cfgFunc runtime tier)
@@ -583,7 +695,10 @@ class ACCL:
         return comm
 
     def matcher(self, comm: Optional[Communicator] = None) -> MatchingEngine:
-        return self._matchers[id(comm or self.comms[0])]
+        # through the validity guard: a shrink recovery popped the
+        # invalidated comms' engines, so the clear COMM_INVALIDATED
+        # verdict must fire here too, never a bare KeyError
+        return self._matchers[id(self._comm(comm))]
 
     def command_list(self, comm: Optional[Communicator] = None):
         """Record collective calls and run them as ONE device launch — the
@@ -681,7 +796,22 @@ class ACCL:
         return None
 
     def _key(self, comm: Communicator, op: operation, *extra):
-        return (id(comm), op, *extra)
+        # the session epoch leads the key: recover() also clears the
+        # cache, but the epoch makes a pre-death program unreachable by
+        # construction even if a future refactor drops the clear
+        return (self._epoch, id(comm), op, *extra)
+
+    def _comm(self, comm: Optional[Communicator]) -> Communicator:
+        """Resolve the call's communicator (default: the session-global
+        one) and enforce the survivor-subset invalidation verdict: a
+        group spanning a rank lost to a shrink recovery raises
+        ``COMM_INVALIDATED`` instead of compiling a program that could
+        never converge. One attribute read on the healthy path."""
+        if comm is None:
+            comm = self.comms[0]
+        if comm._invalid_reason is not None:
+            comm.check_valid()
+        return comm
 
     # ------------------------------------------------------------------
     # per-op program specs: (cache key, builder) pairs shared by the
@@ -853,7 +983,7 @@ class ACCL:
     ) -> Optional[Request]:
         """Per-rank device copy (``ACCL::copy``; fw copy ccl_offload_control.c:533-549)."""
         t0 = _metrics.tick()
-        comm = comm or self.comms[0]
+        comm = self._comm(comm)
         self._check_count(srcbuf, count, "copy src")
         self._check_count(dstbuf, count, "copy dst")
         x = self._input(srcbuf, count, from_device)
@@ -883,7 +1013,7 @@ class ACCL:
         """Per-rank elementwise reduce of two buffers (``ACCL::combine``;
         fw combine :553-571; reduce_ops plugin)."""
         t0 = _metrics.tick()
-        comm = comm or self.comms[0]
+        comm = self._comm(comm)
         for b, w in ((val1, "combine op0"), (val2, "combine op1"), (result, "combine res")):
             self._check_count(b, count, w)
         if val1.dtype != val2.dtype:
@@ -1241,7 +1371,7 @@ class ACCL:
         one zero-copy post, no rx buffer (:595-612). ``compress_dtype``
         compresses the wire payload only (ETH_COMPRESSED semantics).
         """
-        comm = comm or self.comms[0]
+        comm = self._comm(comm)
         arith = self._arith(srcbuf.dtype, compress_dtype)
         if arith is not None and arith.quant_scale is not None:
             # BOTH two-sided delivery paths (move_at and the cross-process
@@ -1341,6 +1471,7 @@ class ACCL:
                 errorCode.INVALID_BUFFER_SIZE,
                 f"send {src}->{dst} count {count} overflows the pending "
                 f"recv's remaining capacity {cap}")
+        posted: List[SendPost] = []
 
         def post_segment(i: int) -> bool:
             """Reserve a pool slot then post segment i; False when the pool
@@ -1359,6 +1490,7 @@ class ACCL:
                 # rejected before the seqn was consumed — give the slot back
                 matcher.rx_pool.release(slot)
                 raise
+            posted.append(post)
             return True
 
         if not run_async:
@@ -1405,8 +1537,27 @@ class ACCL:
                                 matcher.comm)
 
         # async: post what fits now, park the rest with current_step
+        def abort_undelivered() -> None:
+            """Failure retirement (PEER_FAILED / ERROR, incl. cancel):
+            posted-but-undelivered segments are aborted — removed from
+            the pending store, counted CONSUMED so the pair stream never
+            strands on a hole, and their eager rx-pool slots released.
+            Without this every death-retired send permanently shrank the
+            pool until the next epoch reset (the round-15 rx-pool leak).
+            Delivered segments already returned their slots; the abort
+            skips them (rx_slot == -1)."""
+            for p in posted:
+                if p.rx_slot >= 0:
+                    matcher.abort_send(p)
+
+        def on_done(r: Request) -> None:
+            self._queue.retire(r)
+            if r.status in (requestStatus.ERROR,
+                            requestStatus.PEER_FAILED):
+                abort_undelivered()
+
         req = Request(operation.send.name, outputs=data, external=True,
-                      on_complete=self._queue.retire, progress=self._pump_waiting,
+                      on_complete=on_done, progress=self._pump_waiting,
                       comm=matcher.comm, native_registry=self._reqreg)
         self._queue.push(req)
 
@@ -1455,7 +1606,7 @@ class ACCL:
         recv parks like a rendezvous address announcement and its request
         completes on match — ``current_step`` counts delivered segments.
         """
-        comm = comm or self.comms[0]
+        comm = self._comm(comm)
         arith = self._arith(dstbuf.dtype, compress_dtype)  # validate the pair
         if arith is not None and arith.quant_scale is not None:
             # mirror send(): a quantized send is always rejected, so a
@@ -1591,7 +1742,7 @@ class ACCL:
         ``dstbuf`` with no matching recv (``ACCL::stream_put`` analog — the
         one-sided primitive, accl.hpp stream_put)."""
         t0 = _metrics.tick()
-        comm = comm or self.comms[0]
+        comm = self._comm(comm)
         self._check_count(srcbuf, count, "put src")
         self._check_count(dstbuf, count, "put dst")
         x = self._input(srcbuf, count, from_device)
@@ -1626,7 +1777,7 @@ class ACCL:
     ) -> Optional[Request]:
         """``ACCL::bcast`` (accl.cpp; fw :798-990)."""
         t0 = _metrics.tick()
-        comm = comm or self.comms[0]
+        comm = self._comm(comm)
         self._check_count(buf, count, "bcast")
         x = self._input(buf, count, from_device)
         key, build = self._spec_bcast(comm, count, buf.dtype, root,
@@ -1656,7 +1807,7 @@ class ACCL:
         """``ACCL::scatter``: root's ``count*world`` buffer chunked over ranks
         (fw :994-1125)."""
         t0 = _metrics.tick()
-        comm = comm or self.comms[0]
+        comm = self._comm(comm)
         world = comm.world_size
         self._check_count(sendbuf, count * world, "scatter send")
         self._check_count(recvbuf, count, "scatter recv")
@@ -1687,7 +1838,7 @@ class ACCL:
     ) -> Optional[Request]:
         """``ACCL::gather``: concat all sends at root (fw :1130-1296)."""
         t0 = _metrics.tick()
-        comm = comm or self.comms[0]
+        comm = self._comm(comm)
         world = comm.world_size
         self._check_count(sendbuf, count, "gather send")
         self._check_count(recvbuf, count * world, "gather recv")
@@ -1718,7 +1869,7 @@ class ACCL:
     ) -> Optional[Request]:
         """``ACCL::allgather`` (fw :1299-1505)."""
         t0 = _metrics.tick()
-        comm = comm or self.comms[0]
+        comm = self._comm(comm)
         world = comm.world_size
         self._check_count(sendbuf, count, "allgather send")
         self._check_count(recvbuf, count * world, "allgather recv")
@@ -1752,7 +1903,7 @@ class ACCL:
     ) -> Optional[Request]:
         """``ACCL::reduce`` (fw :1509-1744)."""
         t0 = _metrics.tick()
-        comm = comm or self.comms[0]
+        comm = self._comm(comm)
         self._check_count(sendbuf, count, "reduce send")
         self._check_count(recvbuf, count, "reduce recv")
         x = self._input(sendbuf, count, from_device)
@@ -1783,7 +1934,7 @@ class ACCL:
     ) -> Optional[Request]:
         """``ACCL::allreduce`` (accl.cpp:796-842; fw :1855-2075) — the hot path."""
         t0 = _metrics.tick()
-        comm = comm or self.comms[0]
+        comm = self._comm(comm)
         self._check_count(sendbuf, count, "allreduce send")
         self._check_count(recvbuf, count, "allreduce recv")
         x = self._input(sendbuf, count, from_device)
@@ -1819,7 +1970,7 @@ class ACCL:
         """``ACCL::reduce_scatter``: ``count*world`` in, ``count`` out per rank
         (fw :1748-1852)."""
         t0 = _metrics.tick()
-        comm = comm or self.comms[0]
+        comm = self._comm(comm)
         world = comm.world_size
         self._check_count(sendbuf, count * world, "reduce_scatter send")
         self._check_count(recvbuf, count, "reduce_scatter recv")
@@ -1853,7 +2004,7 @@ class ACCL:
     ) -> Optional[Request]:
         """``ACCL::alltoall`` (fw :2123-2218)."""
         t0 = _metrics.tick()
-        comm = comm or self.comms[0]
+        comm = self._comm(comm)
         world = comm.world_size
         self._check_count(sendbuf, count * world, "alltoall send")
         self._check_count(recvbuf, count * world, "alltoall recv")
@@ -1877,7 +2028,7 @@ class ACCL:
         zero-byte notification gather/scatter analog) on top of the
         device-level psum, which every controller enters SPMD."""
         t0 = _metrics.tick()
-        comm = comm or self.comms[0]
+        comm = self._comm(comm)
         # flush only THIS communicator's traffic — a sub-communicator
         # barrier must not block on unrelated communicators (reference
         # barrier flushes per-communicator seqn state, fw :2081-2090)
@@ -1981,10 +2132,14 @@ class ACCL:
                 "pooled_messages": len(self._fabric._pool),
                 "heartbeats": self._fabric._hb_count,
                 "dead_peers": self._fabric.dead_peers,
+                "excluded_peers": self._fabric.excluded_peers,
             }
         return {
             "schema": _metrics.SCHEMA_VERSION,
             "hwid": self.parse_hwid(),
+            # local recovery count — the epoch baked into program/plan
+            # cache keys (the fabric's epoch is under "fabric" below)
+            "session_epoch": self._epoch,
             "config": _json.loads(self.config.to_json()),
             "program_cache": {"programs": progs, "hits": hits,
                               "misses": misses,
@@ -2011,7 +2166,9 @@ class ACCL:
         ]
         for comm in self.comms:
             lines.append(comm.dump())
-            lines.append(self._matchers[id(comm)].dump())
+            m = self._matchers.get(id(comm))
+            if m is not None:
+                lines.append(m.dump())
         return "\n".join(lines)
 
     def dump_communicator(self, comm: Optional[Communicator] = None) -> str:

@@ -1276,6 +1276,41 @@ def bench_fault_overhead(acc, count: int = 1 << 10, calls: int = 64,
     }
 
 
+def bench_recover_time(acc, rounds: int = 5) -> dict:
+    """Recovery-cost lane (round 15, ``direction: lower``): per-call
+    latency of ``ACCL.recover()`` — the local resets, the epoch bump and
+    (with a fabric) the survivor re-handshake barrier — measured as a
+    p50/p99 distribution like the serving lanes, so the first on-silicon
+    run can A/B the recovery machinery's cost beside ``fault_overhead``.
+
+    Honesty flags: ``mode`` names what actually ran — ``"local"``
+    (single controller: the resets and cache invalidation only) or
+    ``"full"`` (a live fabric epoch re-handshake, all controllers
+    entering SPMD like any collective). The SHRINK mode is deliberately
+    never benched — it would need a genuinely dead rank, which is the
+    chaos suite's job (tests/mp_worker_chaos.py kill-1-of-4); this lane
+    prices the machinery both modes share. ``resolved`` is True only
+    for the fabric path, and ``detection_bound_s`` reports the
+    configured heartbeat ceiling (interval + timeout) that bounds the
+    detection leg in front of every real recovery — the full
+    detection→recovered-epoch budget is detection_bound_s + p50."""
+    cfg = acc.config
+    ts = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        acc.recover()
+        ts.append(time.perf_counter() - t0)
+    mode = "local" if acc._fabric is None else "full"
+    t = {"p50": float(np.percentile(ts, 50)),
+         "p99": float(np.percentile(ts, 99)),
+         "best": float(np.min(ts)), "worst": float(np.max(ts))}
+    row = {"metric": "recover_time", "rounds": rounds, "mode": mode,
+           "detection_bound_s": round(
+               cfg.heartbeat_timeout_s + cfg.heartbeat_interval_s, 3)}
+    row.update(_pctl_fields(t, resolved=(mode == "full")))
+    return row
+
+
 def _latency_dist(prog, *args, rounds: int) -> Dict[str, float]:
     """Per-call latency DISTRIBUTION (the serving accounting): one
     compiled-program launch per sample, host wall time, no chaining —

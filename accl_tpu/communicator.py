@@ -63,6 +63,17 @@ class Communicator:
         ]
         self._parent = _parent
         self._parent_indices = list(_parent_indices) if _parent_indices else None
+        #: survivor-subset recovery (ACCL.recover shrink mode) marks a
+        #: communicator spanning a dead rank unusable rather than letting
+        #: its programs hang forever; None = valid
+        self._invalid_reason: Optional[str] = None
+        #: set (to the pre-death world size) on communicators BUILT BY a
+        #: shrink recovery: this group genuinely LOST topology (a rank
+        #: died out of it), unlike an ordinary sub-communicator that
+        #: never had its own torus shape — synth's degraded-decline
+        #: counters fire only for marked groups, so routine group
+        #: creation can never masquerade as a degradation event
+        self.degraded_from: Optional[int] = None
         # per-pair monotonic sequence numbers, exchange-memory analog:
         # outbound[(src, dst)] counts messages posted src->dst,
         # inbound[(src, dst)] counts messages consumed at dst from src.
@@ -85,6 +96,41 @@ class Communicator:
     def sharding(self, spec: Optional[P] = None) -> NamedSharding:
         """Sharding that places axis 0 of a (world, ...) array one-shard-per-rank."""
         return NamedSharding(self.mesh, spec if spec is not None else P(self.AXIS))
+
+    # ---- liveness / invalidation (survivor-subset recovery) --------------
+
+    @property
+    def is_invalidated(self) -> bool:
+        return self._invalid_reason is not None
+
+    @property
+    def invalid_reason(self) -> Optional[str]:
+        return self._invalid_reason
+
+    def invalidate(self, reason: str) -> None:
+        """Mark this communicator permanently unusable (a dead rank sits
+        on its mesh — ``ACCL.recover()`` shrink mode). Idempotent; the
+        first reason wins."""
+        if self._invalid_reason is None:
+            self._invalid_reason = reason
+
+    def check_valid(self) -> None:
+        """Raise :class:`~accl_tpu.constants.ACCLCommInvalidatedError`
+        when a survivor-subset recovery invalidated this communicator —
+        the per-call guard every ACCL dispatch runs (one attribute read
+        on the healthy path)."""
+        if self._invalid_reason is not None:
+            from .constants import ACCLCommInvalidatedError
+            raise ACCLCommInvalidatedError(self._invalid_reason)
+
+    def ranks_of_processes(self, procs) -> List[int]:
+        """Ranks whose device is owned by any controller process in
+        ``procs`` — the rank-level footprint of a set of (dead)
+        processes, used by the shrink-mode recovery to derive survivor
+        indices and to decide which sub-communicators to invalidate."""
+        ps = set(procs)
+        return [i for i, d in enumerate(self._devices)
+                if getattr(d, "process_index", 0) in ps]
 
     # ---- multi-process topology (fixture.hpp per-rank driver analog) -----
 

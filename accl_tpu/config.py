@@ -142,6 +142,21 @@ class ACCLConfig:
     # session timeout; raise it for compile-heavy bring-ups.
     heartbeat_interval_s: float = 1.0
     heartbeat_timeout_s: float = 20.0
+    # buddy replication (models/zero.py + fault.py, round 15): when True,
+    # each rank's ZeRO parameter/optimizer shard is asynchronously
+    # mirrored to its ring successor after every optimizer step (the
+    # replica write piggybacks on the step's compiled program as one
+    # ppermute — no extra launch), optionally wire-dtype-staged via the
+    # cmatmul codecs. After a survivor-subset recovery
+    # (``ACCL.recover()`` shrink mode) the survivor holding a dead
+    # rank's replica re-materializes the lost shard and
+    # ``zero.restore_zero_state`` re-partitions over the smaller dp
+    # axis — training resumes without a host checkpoint. Single-failure
+    # guarantee: any ONE rank (or any set whose ring successors all
+    # survive) is recoverable. Off by default — the replica costs one
+    # shard-sized ppermute per step; write-through to
+    # models.zero.set_replicas_enabled like zero_overlap.
+    shard_replicas: bool = False
 
     # feature gates (EN_ARITH / EN_COMPRESS analog; always on by default)
     enable_arith: bool = True

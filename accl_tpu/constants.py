@@ -174,6 +174,12 @@ class errorCode(enum.IntFlag):
     # tier (docs/resilience.md). Distinct from TIMEOUT_ERROR: the
     # operation did not merely run out of budget, the peer is gone.
     PEER_FAILED = 1 << 24
+    # TPU-only: the communicator was invalidated by a survivor-subset
+    # recovery (``ACCL.recover()`` shrink mode, docs/resilience.md): it
+    # contains a rank owned by a dead controller, so no program over its
+    # mesh can ever converge. Callers must rebuild their groups from the
+    # shrunk global communicator.
+    COMM_INVALIDATED = 1 << 25
 
 
 # NOTE: the reference's streamFlags / hostFlags operand descriptors
@@ -220,6 +226,16 @@ class ACCLError(Exception):
 class ACCLTimeoutError(ACCLError):
     def __init__(self, context: str = ""):
         super().__init__(errorCode.TIMEOUT_ERROR, context)
+
+
+class ACCLCommInvalidatedError(ACCLError):
+    """The call targeted a communicator that a survivor-subset recovery
+    invalidated (it spans a dead rank — ``ACCL.recover()`` shrink mode,
+    docs/resilience.md). The group must be re-created over the shrunk
+    global communicator; its programs could never converge."""
+
+    def __init__(self, context: str = ""):
+        super().__init__(errorCode.COMM_INVALIDATED, context)
 
 
 class ACCLPeerFailedError(ACCLError):

@@ -358,6 +358,55 @@ WAIT_POLICY = RetryPolicy(initial_s=0.005, backoff=2.0, max_s=0.25,
                           jitter=0.0)
 
 
+# ---------------------------------------------------------------------------
+# buddy replication topology (state continuity across true rank loss)
+# ---------------------------------------------------------------------------
+#
+# The survivor-subset recovery story (docs/resilience.md §5) needs each
+# rank's ZeRO state shard to survive that rank's death. The replication
+# topology is the simplest one that matches the collectives' ring: rank r
+# mirrors its shard to its RING SUCCESSOR (r+1) % world after every
+# optimizer step (models/zero.py piggybacks the write on the step
+# program). These helpers are the topology algebra — pure, process-local,
+# shared by the replicate builder, the restore path and the chaos proofs.
+
+def buddy_rank(rank: int, world: int) -> int:
+    """The rank holding ``rank``'s replica: its ring successor."""
+    if world < 2:
+        raise ValueError("buddy replication needs world >= 2")
+    return (rank + 1) % world
+
+
+def survivors_of(world: int, dead) -> List[int]:
+    """The ordered survivor set after losing ``dead`` ranks — the dense
+    new rank order (old indices retained for addressing, the
+    ``Communicator.split`` convention)."""
+    ds = set(dead)
+    out = [r for r in range(world) if r not in ds]
+    if not out:
+        raise ValueError("no survivors")
+    return out
+
+
+def replica_holders(dead, world: int) -> Dict[int, int]:
+    """dead rank -> surviving buddy holding its replica. Raises when any
+    dead rank's buddy also died — the SINGLE-FAILURE guarantee of ring
+    buddy replication: any failure set whose ring successors all survive
+    is recoverable; adjacent ring deaths are not (that state is gone,
+    fall back to a host checkpoint)."""
+    ds = set(dead)
+    out: Dict[int, int] = {}
+    for k in ds:
+        b = buddy_rank(k, world)
+        if b in ds:
+            raise ValueError(
+                f"dead rank {k}'s replica holder {b} also died: ring buddy "
+                f"replication guarantees single (non-adjacent) failures "
+                f"only — restore from a checkpoint instead")
+        out[k] = b
+    return out
+
+
 def policy_from_config(cfg) -> RetryPolicy:
     """Build the session's coordination-RPC policy from the ``ACCLConfig``
     ``rpc_retry_*`` register tier."""

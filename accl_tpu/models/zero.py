@@ -60,6 +60,7 @@ from jax import lax
 from jax.flatten_util import ravel_pytree
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import fault as _fault
 from ..communicator import Communicator
 from ..obs import metrics as _metrics
 from ..parallel.primitives import AXIS, _smap
@@ -78,6 +79,7 @@ FSDP_OP = "zero_fsdp"
 
 _OVERLAP_DEFAULT = True
 _PREFETCH_DEFAULT = True
+_REPLICAS_DEFAULT = False
 
 
 def set_overlap_enabled(enabled: bool) -> None:
@@ -103,6 +105,18 @@ def get_prefetch_enabled() -> bool:
     return _PREFETCH_DEFAULT
 
 
+def set_replicas_enabled(enabled: bool) -> None:
+    """Set the module-default buddy-replication mode
+    (``ACCLConfig.shard_replicas`` write-through). Per-call override:
+    the ``replicate`` argument of :func:`build_zero_train_step`."""
+    global _REPLICAS_DEFAULT
+    _REPLICAS_DEFAULT = bool(enabled)
+
+
+def get_replicas_enabled() -> bool:
+    return _REPLICAS_DEFAULT
+
+
 # ===========================================================================
 # the original flat-ravel demo (single MLP, 1-D communicator axis)
 # ===========================================================================
@@ -116,6 +130,70 @@ class ZeroState(NamedTuple):
     m: jax.Array
     v: jax.Array
     t: jax.Array  # () int32, replicated
+
+
+class ZeroReplica(NamedTuple):
+    """Buddy replicas of the ZeRO state (docs/resilience.md §5): row ``r``
+    holds rank ``(r − 1) % world``'s shards — each rank mirrors its shard
+    to its RING SUCCESSOR (``fault.buddy_rank``), so after a single rank
+    loss the dead rank's state survives on its buddy and
+    :func:`restore_zero_state` re-materializes it. Same global shapes and
+    sharding as the state shards they mirror."""
+
+    w: jax.Array
+    m: jax.Array
+    v: jax.Array
+
+
+# -- multi-process-safe array construction -----------------------------------
+#
+# jax.device_put(full_np, sharding) requires every shard to be process-
+# addressable; on the multi-controller rung each process may only place
+# its own rows. These helpers build the same global arrays on both rungs
+# (every process computes the identical host value — the SPMD discipline
+# the session nonce handshake already assumes).
+
+
+def put_rows(comm: Communicator, rows: np.ndarray) -> jax.Array:
+    """Place a host ``(world, ...)`` array one-row-per-rank over the
+    communicator (axis 0 sharded, the ``comm.sharding()`` layout), on
+    either rung: plain ``device_put`` single-controller, per-local-rank
+    shard assembly multi-controller."""
+    if not comm.is_multiprocess:
+        return jax.device_put(rows, comm.sharding())
+    shards = [jax.device_put(rows[r:r + 1], comm.device(r))
+              for r in comm.local_ranks]
+    return jax.make_array_from_single_device_arrays(
+        rows.shape, comm.sharding(), shards)
+
+
+def put_replicated_scalar(comm: Communicator, value) -> jax.Array:
+    """A replicated () scalar usable as a ``P()`` shard_map operand on
+    both rungs (the Adam step counter)."""
+    val = np.asarray(value, np.int32)
+    if not comm.is_multiprocess:
+        return jnp.asarray(val)
+    return jax.make_array_from_callback(
+        (), comm.replicated_sharding(), lambda idx: val)
+
+
+def _local_row(arr: jax.Array, rank: int) -> np.ndarray:
+    """This process's host copy of row ``rank`` of a (world, ...) axis-0
+    sharded array; raises when the rank's shard lives on another
+    controller."""
+    for s in arr.addressable_shards:
+        idx = s.index[0]
+        if (idx.start or 0) == rank:
+            return np.asarray(s.data)[0]
+    raise ValueError(f"rank {rank}'s shard is not addressable on this "
+                     f"process")
+
+
+def _scalar_value(t) -> np.ndarray:
+    try:
+        return np.asarray(t.addressable_shards[0].data)
+    except (AttributeError, IndexError):
+        return np.asarray(t)
 
 
 @functools.lru_cache(maxsize=None)
@@ -139,25 +217,49 @@ def init_zero_state(key, comm: Communicator, d_model: int,
     pad = (-n) % world
     flat = np.concatenate([np.asarray(vec), np.zeros(pad, np.float32)])
     shards = flat.reshape(world, -1)
-    put = lambda a: jax.device_put(a, comm.sharding())
     return ZeroState(
-        w=put(shards),
-        m=put(np.zeros_like(shards)),
-        v=put(np.zeros_like(shards)),
-        t=jnp.zeros((), jnp.int32),
+        w=put_rows(comm, shards),
+        m=put_rows(comm, np.zeros_like(shards)),
+        v=put_rows(comm, np.zeros_like(shards)),
+        t=put_replicated_scalar(comm, 0),
     )
 
 
 def build_zero_train_step(comm: Communicator, d_model: int, d_hidden: int,
                           lr: float = 1e-2, b1: float = 0.9,
-                          b2: float = 0.999, eps: float = 1e-8):
+                          b2: float = 0.999, eps: float = 1e-8,
+                          replicate: Optional[bool] = None,
+                          replica_wire_dtype="off"):
     """``step(state, x, y) -> (state, loss)`` — one fused ZeRO step.
 
     ``x``/``y``: (world, batch, d_model) global arrays, batch sharded
     over the communicator axis (pure dp; compose with the tp MLP for 2-D).
-    """
+
+    ``replicate`` (None → the ``ACCLConfig.shard_replicas`` session
+    register) piggybacks a **buddy-replica write** on the step: after the
+    optimizer update, each rank's fresh shards ride ONE ``ppermute`` to
+    the ring successor inside the same compiled program (no extra
+    launch), and the step returns ``(state, loss, ZeroReplica)``. The
+    replica is what :func:`restore_zero_state` rebuilds a lost rank's
+    state from after a survivor-subset recovery. ``replica_wire_dtype``
+    stages the mirror hop through the existing cmatmul codecs ("off" —
+    the default — keeps it full precision, so restores are bit-exact;
+    "bf16"/"bf16_sr" halve the wire at a tolerance-bounded replica;
+    None follows the session ``cmatmul_wire_dtype`` register)."""
     world = comm.world_size
     n, unravel = _template(d_model, d_hidden)
+    do_replicate = (_REPLICAS_DEFAULT if replicate is None
+                    else bool(replicate))
+    if do_replicate:
+        perm = [(i, _fault.buddy_rank(i, world)) for i in range(world)]
+        _metrics.inc("accl_zero_replica_total",
+                     labels=(("event", "write"),))
+
+        def _mirror(arr):
+            from ..ops import collective_matmul as cm
+            wdt, sr = cm._resolve_wire_codec(replica_wire_dtype, arr.dtype)
+            staged = cm._wire_cast(arr, wdt, stochastic=sr)
+            return lax.ppermute(staged, AXIS, perm).astype(arr.dtype)
 
     def body(w, m, v, t, x, y):
         w, m, v = w[0], m[0], v[0]          # (n_pad/world,) local shards
@@ -187,16 +289,29 @@ def build_zero_train_step(comm: Communicator, d_model: int, d_hidden: int,
         vhat = v_new / (1 - b2 ** t_new.astype(jnp.float32))
         w_new = w - lr * mhat / (jnp.sqrt(vhat) + eps)
         loss = lax.psum(loss, AXIS) / world
+        if do_replicate:
+            # the buddy write piggybacks on the step program: the fresh
+            # shards ride one ppermute to the ring successor while XLA's
+            # scheduler overlaps it with the loss psum — rank r's output
+            # replica row holds rank (r-1)%world's new shards
+            rw, rm, rv = _mirror(w_new), _mirror(m_new), _mirror(v_new)
+            return (w_new[None], m_new[None], v_new[None], t_new, loss,
+                    rw[None], rm[None], rv[None])
         return (w_new[None], m_new[None], v_new[None], t_new, loss)
 
+    n_out = 8 if do_replicate else 5
     prog = _smap(
         comm, body, 6,
         in_specs=(P(AXIS), P(AXIS), P(AXIS), P(), P(AXIS), P(AXIS)),
-        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(), P()),
+        out_specs=tuple([P(AXIS), P(AXIS), P(AXIS), P(), P()]
+                        + [P(AXIS)] * (n_out - 5)),
     )
 
     def step(state: ZeroState, x, y):
-        w, m, v, t, loss = prog(state.w, state.m, state.v, state.t, x, y)
+        out = prog(state.w, state.m, state.v, state.t, x, y)
+        w, m, v, t, loss = out[:5]
+        if do_replicate:
+            return ZeroState(w, m, v, t), loss, ZeroReplica(*out[5:])
         return ZeroState(w, m, v, t), loss
 
     return step
@@ -221,6 +336,110 @@ def gather_params(state: ZeroState, comm: Communicator, d_model: int,
             "or save per-rank shards.")
     flat = np.asarray(state.w).reshape(-1)[:n]
     return unravel(jnp.asarray(flat))
+
+
+# ===========================================================================
+# buddy replication + survivor-subset restore (docs/resilience.md §5)
+# ===========================================================================
+
+
+def build_buddy_replicate(comm: Communicator, wire_dtype="off"):
+    """``replicate(state) -> ZeroReplica`` — one compiled program
+    mirroring each rank's (w, m, v) shards to its ring successor
+    (``fault.buddy_rank``) in a single ``ppermute`` per tensor. The
+    standalone form of the piggybacked write in
+    :func:`build_zero_train_step` — used to seed the replica before the
+    first step (a rank that dies at step 0 is still restorable) and to
+    re-seed it right after a restore. ``wire_dtype`` as on the step
+    builder ("off" = full precision, bit-exact restores)."""
+    world = comm.world_size
+    perm = [(i, _fault.buddy_rank(i, world)) for i in range(world)]
+    _metrics.inc("accl_zero_replica_total", labels=(("event", "write"),))
+
+    def body(w, m, v):
+        from ..ops import collective_matmul as cm
+
+        def mirror(arr):
+            a = arr[0]
+            wdt, sr = cm._resolve_wire_codec(wire_dtype, a.dtype)
+            staged = cm._wire_cast(a, wdt, stochastic=sr)
+            return lax.ppermute(staged, AXIS, perm).astype(a.dtype)[None]
+
+        return mirror(w), mirror(m), mirror(v)
+
+    prog = _smap(comm, body, 3,
+                 out_specs=(P(AXIS), P(AXIS), P(AXIS)))
+
+    def replicate(state: ZeroState) -> ZeroReplica:
+        return ZeroReplica(*prog(state.w, state.m, state.v))
+
+    return replicate
+
+
+def restore_zero_state(new_comm: Communicator, state: ZeroState,
+                       replica: ZeroReplica, survivors, dead,
+                       n: int) -> ZeroState:
+    """Re-materialize the ZeRO state on the SURVIVOR mesh after a true
+    rank loss — training resumes without a host checkpoint.
+
+    ``new_comm`` is the shrunk communicator (``ACCL.recover()`` shrink
+    mode rebuilt it over the survivor indices); ``survivors``/``dead``
+    are OLD rank indices (``fault.survivors_of`` order = new rank
+    order); ``n`` the unpadded flat parameter length
+    (``zero._template(d_model, d_hidden)[0]``). Every surviving
+    controller calls this SPMD, like any collective.
+
+    Protocol: each survivor contributes its own (w, m, v) shards plus
+    the replica rows it holds; one all-gather over the NEW mesh (the
+    recovered datapath, not the dead one) replicates all contributions;
+    each dead rank's shard is then read off its ring successor's replica
+    (``fault.replica_holders`` — raising when the buddy also died, the
+    single-failure guarantee), the full flat vectors are reassembled
+    bit-exactly (full-precision replicas) and re-partitioned over the
+    smaller dp axis. Counted ``accl_zero_replica_total{event="restore"}``.
+    """
+    survivors = list(survivors)
+    dead = list(dead)
+    P_old = len(survivors) + len(dead)
+    holders = _fault.replica_holders(dead, P_old)
+    nshard = state.w.shape[1]
+    dtype = np.dtype(state.w.dtype)
+
+    # per-new-rank contribution: [own w, m, v ‖ replica w, m, v]
+    rows = np.zeros((new_comm.world_size, 6, nshard), dtype)
+    for j in new_comm.local_ranks:
+        r = survivors[j]
+        for t_i, (own, rep) in enumerate(
+                zip((state.w, state.m, state.v), replica)):
+            rows[j, t_i] = _local_row(own, r)
+            rows[j, 3 + t_i] = _local_row(rep, r)
+    contrib = put_rows(new_comm, rows)
+
+    # one all-gather over the SURVIVOR mesh replicates every contribution
+    gather = _smap(
+        new_comm,
+        lambda v: lax.all_gather(v[0], AXIS, axis=0, tiled=False),
+        1, out_specs=P())
+    gathered = np.asarray(gather(contrib).addressable_shards[0].data)
+
+    full = np.zeros((3, P_old, nshard), dtype)
+    for j, r in enumerate(survivors):
+        full[:, r] = gathered[j, :3]
+    for k, b in holders.items():
+        full[:, k] = gathered[survivors.index(b), 3:]
+
+    P_new = len(survivors)
+    pad = (-n) % P_new
+    repart = []
+    for t_i in range(3):
+        flat = full[t_i].reshape(-1)[:n]
+        if pad:
+            flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+        repart.append(put_rows(new_comm, flat.reshape(P_new, -1)))
+    _metrics.inc("accl_zero_replica_total", labels=(("event", "restore"),))
+    return ZeroState(
+        w=repart[0], m=repart[1], v=repart[2],
+        t=put_replicated_scalar(new_comm, _scalar_value(state.t)))
 
 
 # ===========================================================================

@@ -220,6 +220,14 @@ class CrossProcessFabric:
         #: cross-process clock skew cannot fake a death
         self._peer_seen: Dict[int, Tuple[Optional[str], float]] = {}
         self._dead_peers: set = set()
+        #: processes a SURVIVOR-SUBSET recovery removed from the mesh
+        #: (ACCL.recover shrink mode): unlike ordinary death verdicts —
+        #: which clear at every epoch bump so elastic rejoin works — an
+        #: excluded process is gone for the session: liveness sweeps
+        #: skip it permanently (its lease will never reappear, and a
+        #: ghost write from its stale process must not re-latch a
+        #: verdict the mesh already acted on)
+        self._excluded: set = set()
         #: credit window: max staged-but-unmoved eager segments per pair
         self.eager_window = max(int(eager_window), 1)
         self.eager_seg_bytes = max(int(eager_seg_bytes), 1)
@@ -1255,7 +1263,8 @@ class CrossProcessFabric:
             sweep = (range(jax.process_count()) if procs is None else procs)
             client = _client()
             for p in sweep:
-                if p == self._me or p in self._dead_peers:
+                if (p == self._me or p in self._dead_peers
+                        or p in self._excluded):
                     continue
                 v = self._try_get(client, f"{self.ns}/hb/{p}")
                 if v is None:
@@ -1287,6 +1296,20 @@ class CrossProcessFabric:
     def dead_peers(self) -> List[int]:
         """Latched liveness verdicts (introspection for stats()/scan())."""
         return sorted(self._dead_peers)
+
+    def exclude_peers(self, procs) -> None:
+        """Remove processes from the fabric's world for the rest of the
+        session (the shrink recovery's rank-loss commitment): liveness
+        sweeps skip them forever — across epoch bumps, which clear
+        ordinary verdicts — so a shrunk mesh never re-litigates a death
+        it already recovered from."""
+        self._excluded.update(int(p) for p in procs)
+
+    @property
+    def excluded_peers(self) -> List[int]:
+        """Processes removed by survivor-subset recoveries (permanent,
+        unlike the per-epoch ``dead_peers`` verdicts)."""
+        return sorted(self._excluded)
 
     def bump_epoch(self) -> int:
         """Elastic re-handshake step (``ACCL.recover``): abandon the

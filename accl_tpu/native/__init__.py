@@ -75,6 +75,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
                                        c.c_int64]
     lib.accl_remove_recv.restype = c.c_int32
     lib.accl_remove_recv.argtypes = [c.c_void_p, c.c_int64]
+    lib.accl_abort_send.restype = c.c_int32
+    lib.accl_abort_send.argtypes = [c.c_void_p, c.c_int64]
     lib.accl_clear.argtypes = [c.c_void_p]
     for name in ("accl_pending_sends", "accl_pending_recvs"):
         fn = getattr(lib, name)
@@ -199,6 +201,13 @@ class NativeEngine:
 
     def remove_recv(self, rid: int) -> bool:
         return bool(self._lib.accl_remove_recv(self._h, rid))
+
+    def abort_send(self, sid: int) -> bool:
+        """Abort a parked send segment: removed AND counted consumed (the
+        inbound cursor advances past its seqn) so the pair stream never
+        strands on the hole a PEER_FAILED-retired message would leave.
+        False when the segment is not the next-expected one."""
+        return bool(self._lib.accl_abort_send(self._h, sid))
 
     def clear(self) -> None:
         self._lib.accl_clear(self._h)

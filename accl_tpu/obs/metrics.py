@@ -42,6 +42,9 @@ name                                           kind       labels
 ``accl_rpc_retry_total``                       counter    point (RetryPolicy absorbed transients)
 ``accl_peer_death_total``                      counter    proc (heartbeat-lease death verdicts)
 ``accl_session_epoch_total``                   counter    (none; recover() epoch bumps)
+``accl_recover_total``                         counter    mode (full | shrink: survivor-subset recoveries)
+``accl_comm_invalidated_total``                counter    (none; communicators spanning a dead rank)
+``accl_zero_replica_total``                    counter    event (write: per replicate-PROGRAM built, trace-time like the prefetch counter; restore: per restore call)
 =============================================  =========  =================
 
 Export formats: :meth:`MetricsRegistry.snapshot` (flat, JSON-safe dict),

@@ -117,6 +117,50 @@ def _coords_shape(devices) -> Optional[Tuple[int, int]]:
     return (rows, cols)
 
 
+def _coords_degraded(devices) -> bool:
+    """True when every device carries unique chip coordinates but they do
+    NOT fill a rectangular grid — the survivor-subset signature (a rank
+    died and the mesh shrank around the hole). Distinct from the benign
+    Nones of :func:`_coords_shape`: no coords (CPU emulator), duplicate
+    cores, 1-D lines and 3-D slices are legitimate single-axis verdicts,
+    a HOLED grid is a degraded one (counted by :func:`resolve` so the
+    lost multi-axis schedule is attributable, never invisible)."""
+    coords = []
+    for d in devices:
+        c = getattr(d, "coords", None)
+        if c is None:
+            return False
+        coords.append((tuple(c) + (0, 0, 0))[:3])
+    if len(set(coords)) != len(coords):
+        return False
+    ext = [len({c[i] for c in coords}) for i in range(3)]
+    return ext[0] * ext[1] * ext[2] != len(coords)
+
+
+def degraded_reason(comm, cfg: ACCLConfig) -> Optional[str]:
+    """Why this communicator LOST torus structure, or None when it never
+    had any to lose. Fires only for communicators a shrink recovery
+    built (``comm.degraded_from`` carries the pre-death world size) — an
+    ordinary sub-communicator routinely mismatches the global
+    ``sched_mesh_shape`` declaration and may sit on a partial coordinate
+    grid without anything being wrong, and counting those as
+    degradations would make a real shrink indistinguishable from group
+    creation. ``declared_shape_mismatch``: the declared shape describes
+    the pre-death world; ``holed_grid``: the survivors' device
+    coordinates no longer fill a rectangular grid. Either way the honest
+    resolution is the single-axis logical ring over the survivors —
+    never an invented multi-axis decomposition over missing links
+    (which holds for ALL single-axis verdicts, marked or not)."""
+    if getattr(comm, "degraded_from", None) is None:
+        return None
+    ms = cfg.sched_mesh_shape
+    if ms and int(ms[0]) * int(ms[1]) != comm.world_size:
+        return "declared_shape_mismatch"
+    if _coords_degraded(getattr(comm, "_devices", None) or comm.devices):
+        return "holed_grid"
+    return None
+
+
 _COORDS_UNSET = object()
 
 
@@ -502,6 +546,20 @@ def _seed_overridden(op: operation, cfg: ACCLConfig) -> bool:
 _plan_cache: Dict[tuple, SchedulePlan] = {}
 _plan_lock = threading.Lock()
 
+#: session epoch baked into every plan-cache key: bumped by
+#: ``ACCL.recover()`` so a plan synthesized before a rank death is
+#: unreachable afterwards even when the (op, topology, bucket) key
+#: collides — stale pre-death plans must never be dispatchable on the
+#: shrunk mesh (docs/resilience.md §5)
+_session_epoch = 0
+
+
+def set_session_epoch(epoch: int) -> None:
+    """Epoch hook (``ACCL.initialize()`` / ``ACCL.recover()``): key every
+    subsequently synthesized plan by the session epoch."""
+    global _session_epoch
+    _session_epoch = int(epoch)
+
 
 def reset_plan_cache() -> None:
     """Session hook (``ACCL.initialize()``): drop every cached plan so a
@@ -556,7 +614,7 @@ def resolve(op: operation, nbytes: int, comm, cfg: ACCLConfig,
     # above-threshold bucket-mate cached (and vice versa)
     in_latency_tier = nbytes < cfg.latency_tier_threshold
     key = (op, topo, _metrics.size_bucket(nbytes), in_latency_tier,
-           legacy, seeds, _cost_fingerprint(cfg))
+           legacy, seeds, _cost_fingerprint(cfg), _session_epoch)
     with _plan_lock:
         plan = _plan_cache.get(key)
     if plan is not None:
@@ -564,6 +622,16 @@ def resolve(op: operation, nbytes: int, comm, cfg: ACCLConfig,
                      labels=(("event", "hit"),))
         return plan
     _metrics.inc("accl_sched_plan_cache_total", labels=(("event", "miss"),))
+    if not topo.multi_axis:
+        # survivor-subset honesty: when this mesh HAD torus structure and
+        # lost it (a holed grid, a stale declared shape on a shrunk
+        # communicator), the single-axis fallback is the correct plan but
+        # the lost multi-axis schedule must be attributable — counted
+        # once per synthesized plan, the cmatmul-fallback discipline
+        reason = degraded_reason(comm, cfg)
+        if reason is not None:
+            _metrics.inc("accl_select_decline_total",
+                         labels=(("op", op.name), ("reason", reason)))
 
     if (not cfg.sched_synthesis
             or topo.transport == TransportBackend.DCN

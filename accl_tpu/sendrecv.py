@@ -271,6 +271,44 @@ class MatchingEngine:
                 return r.remaining
         return -1
 
+    def abort_send(self, post: SendPost) -> bool:
+        """Abort a parked send segment whose request was retired by a
+        terminal failure (PEER_FAILED / ERROR): the segment is removed
+        from the pending store, counted as CONSUMED (the inbound cursor
+        advances past its seqn exactly as a delivery would, so later
+        messages on the pair never stall on a hole) and its eager
+        rx-pool slot is released — the pool-leak fix of the round-15
+        satellite (a retired message must neither deliver stale data nor
+        pin pool capacity until the next epoch reset).
+
+        Best-effort by design: only the next-expected segment of the
+        pair can be aborted (callers sweep a message's segments in
+        ascending seqn order, so a contiguous run from the cursor clears
+        completely); a segment parked behind another live message's
+        undelivered head stays parked — exactly the pre-fix behavior,
+        never a corrupted stream. Returns whether the abort happened."""
+        if self._native is not None:
+            sid = getattr(post, "_native_id", None)
+            if sid is None or not self._native.abort_send(sid):
+                return False
+            self._posts.pop(sid, None)
+            self._release_slot(post)
+            return True
+        # identity scan, never equality: SendPost is a dataclass whose
+        # field-based __eq__ would compare the jax.Array payloads of two
+        # same-(src,dst,tag) posts — bool() of a multi-element array
+        # raises, right inside the failure-retirement callback
+        idx = next((i for i, s in enumerate(self._pending_sends)
+                    if s is post), None)
+        if idx is None:
+            return False
+        if post.seqn != self.comm.peek_inbound_seq(post.src, post.dst):
+            return False
+        self._pending_sends.pop(idx)
+        self.comm.next_inbound_seq(post.src, post.dst)
+        self._release_slot(post)
+        return True
+
     def remove_recv(self, post: RecvPost) -> None:
         """Un-park a recv (used when a sync recv fails NOT_READY, so the
         failed call doesn't steal a future send)."""

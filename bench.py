@@ -71,7 +71,7 @@ _TRACE_DIR = None
 #: on multi-chip rigs: a filter is validated against the catalog, not
 #: against what this world size happens to run)
 KNOWN_LANES = (
-    "sweep", "obs_overhead", "fault_overhead",
+    "sweep", "obs_overhead", "fault_overhead", "recover_time",
     "cmatmul_ag", "cmatmul_rs", "cmatmul_dw", "cmatmul_stream",
     "moe_a2a", "moe_a2a_bwd", "zero_fsdp", "sched_synth",
     "hp_compression_cast_roundtrip", "combine_pallas_vs_jnp",
@@ -393,6 +393,25 @@ def main(argv=None) -> int:
                                      "error": err["error"]}
         else:
             out["fault_overhead"] = r
+
+    # recovery-cost lane (round 15, any world size): p50/p99 of
+    # ACCL.recover() with honesty flags for which mode ran (local vs
+    # fabric re-handshake; shrink is the chaos suite's job). Placed
+    # after the overhead lanes: recover() drops the program caches, so
+    # running it mid-A/B would bill a recompile to whichever lane came
+    # next (later stages build their own programs from scratch anyway).
+    if _lane_selected(lanes_filter, "recover_time") \
+            and _elapsed() <= _BUDGET_S:
+        from accl_tpu.bench import lanes as _r_lanes
+
+        r, err = _run_stage("recover_time",
+                            lambda: _r_lanes.bench_recover_time(acc))
+        if err:
+            errors.append(err)
+            out["recover_time"] = {"metric": "recover_time",
+                                   "error": err["error"]}
+        else:
+            out["recover_time"] = r
 
     if world > 1:
         # multi-chip: the collective-matmul overlap A/B lanes (the

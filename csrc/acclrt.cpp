@@ -186,6 +186,27 @@ class Engine {
     return -1;
   }
 
+  // Abort a parked send segment (PEER_FAILED retirement must release its
+  // rx-pool slot without stranding the pair stream): the segment is
+  // removed AND counted as consumed — the inbound cursor advances past
+  // its seqn exactly as a delivery would, so later messages on the pair
+  // stay matchable. Only the next-expected parked segment can be aborted
+  // (aborting out of order would skip a live undelivered segment);
+  // callers abort a retired message's segments in ascending seqn order so
+  // a contiguous run from the cursor clears completely.
+  bool abort_send(int64_t id) {
+    std::lock_guard<std::mutex> g(mu_);
+    for (size_t i = 0; i < pending_sends_.size(); ++i) {
+      const Post& s = pending_sends_[i];
+      if (s.id != id) continue;
+      if (s.seqn != inbound_[{s.src, s.dst}]) return false;
+      inbound_[{s.src, s.dst}]++;
+      pending_sends_.erase(pending_sends_.begin() + i);
+      return true;
+    }
+    return false;
+  }
+
   bool remove_recv(int64_t id) {
     std::lock_guard<std::mutex> g(mu_);
     for (size_t i = 0; i < pending_recvs_.size(); ++i) {
@@ -437,6 +458,10 @@ int64_t accl_recv_capacity(void* e, int32_t src, int32_t dst, int64_t tag) {
 
 int32_t accl_remove_recv(void* e, int64_t id) {
   return static_cast<Engine*>(e)->remove_recv(id) ? 1 : 0;
+}
+
+int32_t accl_abort_send(void* e, int64_t id) {
+  return static_cast<Engine*>(e)->abort_send(id) ? 1 : 0;
 }
 
 void accl_clear(void* e) { static_cast<Engine*>(e)->clear(); }

@@ -151,7 +151,11 @@ def death() -> int:
         print(f"[p{me}] PEER_FAILED in {elapsed:.1f}s", flush=True)
 
     # ---- elastic re-handshake: every controller converges epoch 1 -----
-    epoch = acc.recover()
+    # the dead rank REJOINS here (its process survived the injected
+    # death), so this is the explicit full-world form: with no arguments
+    # recover() now defaults to the SURVIVOR set when death verdicts are
+    # latched (the shrink scenario below) — elastic rejoin must say so
+    epoch = acc.recover(process_ids=list(range(W)))
     assert epoch == 1, epoch
     assert acc.stats()["fabric"]["epoch"] == 1
     print(f"[p{me}] recovered into epoch {epoch}", flush=True)
@@ -184,10 +188,198 @@ def death() -> int:
     return 0
 
 
+def shrink() -> int:
+    """Kill 1 of 4 — TRUE rank loss (round 15, ISSUE acceptance): the
+    dead controller never comes back, the survivors observe PEER_FAILED
+    within the heartbeat bound, ``recover()`` with NO arguments
+    converges a 3-rank epoch (the survivor set is the default when
+    death verdicts are latched), the mesh shrinks (old communicator
+    invalidated, world 4 → 3), and send/recv + allreduce + a ZeRO train
+    step — its state restored from the buddy replica, no host
+    checkpoint — run bit-exact on the degraded mesh without restarting
+    any surviving process."""
+    import accl_tpu.multiproc as mp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from accl_tpu.models import zero as zmod
+    from accl_tpu.parallel.primitives import AXIS, _smap
+
+    me = jax.process_index()
+    cfg = accl_tpu.ACCLConfig(timeout=60.0, heartbeat_interval_s=0.2,
+                              heartbeat_timeout_s=2.5, shard_replicas=True)
+    acc = accl_tpu.ACCL(config=cfg)
+    old_comm = acc.global_comm()
+    W = acc.world_size
+    assert W == 4, "shrink scenario is a 4-controller, 1-device/proc script"
+    DEAD = 2                       # proc == rank here (1 device per proc)
+    SURVIVORS = [0, 1, 3]
+    DONE_KEY = "accl/chaos_shrink/done"
+    LOSS_KEY = "accl/chaos_shrink/loss"
+
+    # ---- ZeRO training with buddy replication (epoch 0, full mesh) -----
+    d_model, d_hidden, batch = 8, 16, 4
+    n, _ = zmod._template(d_model, d_hidden)
+    state = zmod.init_zero_state(jax.random.PRNGKey(7), old_comm,
+                                 d_model, d_hidden)
+    step = zmod.build_zero_train_step(old_comm, d_model, d_hidden)
+    rngn = np.random.default_rng(3)
+    x = zmod.put_rows(old_comm, rngn.standard_normal(
+        (W, batch, d_model)).astype(np.float32))
+    y = zmod.put_rows(old_comm, rngn.standard_normal(
+        (W, batch, d_model)).astype(np.float32))
+    replica = None
+    for _ in range(2):
+        # shard_replicas=True: the step returns the piggybacked replica
+        state, loss0, replica = step(state, x, y)
+    jax.block_until_ready(loss0)
+
+    # pre-death oracle: every controller keeps the FULL flat vectors
+    gat = _smap(old_comm,
+                lambda v: lax.all_gather(v[0], AXIS, axis=0, tiled=False),
+                1, out_specs=P())
+    snap = {t: np.asarray(gat(getattr(state, t))
+                          .addressable_shards[0].data).reshape(-1)[:n]
+            for t in ("w", "m", "v")}
+    print(f"[p{me}] zero warmup ok (2 replicated steps)", flush=True)
+
+    acc.barrier()
+    t0 = time.monotonic()
+    nb = 64
+    payload = np.arange(nb, dtype=np.float32)
+    rb = acc.create_buffer(nb, dataType.float32)
+
+    if me == DEAD:
+        # die mid-protocol and NEVER participate again — true rank loss
+        fault.install(FaultPlan([FaultSpec("rank.death", kind="die")]))
+        try:
+            acc.recv(rb, nb, src=0, dst=DEAD, tag=5)
+            raise AssertionError("injected rank death did not fire")
+        except RankDeath:
+            pass
+        fault.clear()
+        print(f"[p{me}] dead (true rank loss)", flush=True)
+        # stay OS-alive (the jax coordination service outlives the ACCL
+        # session) but protocol-dead: wait for the survivors' verdict
+        mp._client().blocking_key_value_get(DONE_KEY, 300_000)
+        print(f"[p{me}] CHAOS-SHRINK-DEAD-OK", flush=True)
+        return 0
+
+    # ---- survivors: bounded PEER_FAILED within the heartbeat window ----
+    if me == 0:
+        # blocked on the dead rank: the lease verdict must retire this
+        # wait well inside the 60 s session timeout
+        try:
+            acc.recv(rb, nb, src=DEAD, dst=0, tag=9)
+            raise AssertionError("wait on the dead peer did not fail")
+        except accl_tpu.ACCLError as e:
+            assert e.code == accl_tpu.errorCode.PEER_FAILED, e
+    else:
+        # not blocked on the dead rank: the liveness sweep alone latches
+        # the verdict (pumping keeps OUR lease fresh while we watch)
+        deadline = time.monotonic() + 20.0
+        while DEAD not in acc._fabric.dead_peers:
+            acc._pump()
+            acc._fabric.check_peers()
+            assert time.monotonic() < deadline, "death never detected"
+            time.sleep(0.05)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 20.0, f"death detection took {elapsed:.1f}s"
+    assert DEAD in acc._fabric.dead_peers
+    snapc = metrics.snapshot()["counters"]
+    assert snapc.get(f'accl_peer_death_total{{proc="{DEAD}"}}', 0) >= 1
+    print(f"[p{me}] PEER_FAILED({DEAD}) in {elapsed:.1f}s", flush=True)
+
+    # ---- recover() with NO arguments: survivor subset is the default ---
+    epoch = acc.recover()
+    assert epoch == 1, epoch
+    assert acc.world_size == 3, acc.world_size
+    new_comm = acc.global_comm()
+    assert [d.process_index for d in new_comm.devices] == SURVIVORS
+    snapc = metrics.snapshot()["counters"]
+    assert snapc.get('accl_recover_total{mode="shrink"}', 0) == 1
+    assert snapc.get("accl_comm_invalidated_total", 0) >= 1
+    # the dead process is excluded for the session (survives the epoch
+    # bump that cleared the ordinary verdicts)
+    assert acc.stats()["fabric"]["excluded_peers"] == [DEAD]
+    assert acc._fabric.dead_peers == []
+    # the old (full-world) communicator is invalidated, not repaired
+    assert old_comm.is_invalidated
+    try:
+        acc.barrier(comm=old_comm)
+        raise AssertionError("invalidated communicator accepted a call")
+    except accl_tpu.ACCLError as e:
+        assert e.code == accl_tpu.errorCode.COMM_INVALIDATED, e
+    me_new = new_comm.local_ranks[0]
+    print(f"[p{me}] shrunk epoch {epoch}: new rank {me_new}/3", flush=True)
+
+    # ---- send/recv bit-exact across the shrunk mesh (new ranks) --------
+    sb = acc.create_buffer(nb, dataType.float32)
+    rb2 = acc.create_buffer(nb, dataType.float32)
+    if me == 0:            # new rank 0 -> new rank 2 (old proc 3)
+        sb.host[0] = payload
+        acc.send(sb, nb, src=0, dst=2, tag=31)
+        acc.recv(rb2, nb, src=2, dst=0, tag=32)
+        assert np.array_equal(rb2.host[0], payload * 5)
+    elif me == 3:
+        acc.recv(rb2, nb, src=0, dst=2, tag=31)
+        assert np.array_equal(rb2.host[2], payload)
+        sb.host[2] = payload * 5
+        acc.send(sb, nb, src=2, dst=0, tag=32)
+    acc.barrier()
+
+    # ---- a bandwidth collective on the survivors (bit-exact) -----------
+    s3 = acc.create_buffer(nb, dataType.float32)
+    r3 = acc.create_buffer(nb, dataType.float32)
+    for rank in range(3):
+        s3.host[rank] = rank + 1
+    acc.allreduce(s3, r3, nb, reduceFunction.SUM)
+    for rank in new_comm.local_ranks:
+        assert np.array_equal(r3.host[rank], np.full(nb, 6.0, np.float32))
+    print(f"[p{me}] shrunk allreduce ok", flush=True)
+
+    # ---- ZeRO state restored from the buddy replica, bit-exact ---------
+    state3 = zmod.restore_zero_state(new_comm, state, replica,
+                                     SURVIVORS, [DEAD], n)
+    gat3 = _smap(new_comm,
+                 lambda v: lax.all_gather(v[0], AXIS, axis=0, tiled=False),
+                 1, out_specs=P())
+    for t in ("w", "m", "v"):
+        got = np.asarray(gat3(getattr(state3, t))
+                         .addressable_shards[0].data).reshape(-1)[:n]
+        assert np.array_equal(got, snap[t]), f"restored {t} not bit-exact"
+    assert int(zmod._scalar_value(state3.t)) == 2
+    # training resumes on the 3-rank dp axis — no host checkpoint
+    step3 = zmod.build_zero_train_step(new_comm, d_model, d_hidden)
+    x3 = zmod.put_rows(new_comm, rngn.standard_normal(
+        (3, batch, d_model)).astype(np.float32))
+    y3 = zmod.put_rows(new_comm, rngn.standard_normal(
+        (3, batch, d_model)).astype(np.float32))
+    state3, loss3, _rep3 = step3(state3, x3, y3)
+    lv = float(jax.block_until_ready(loss3))
+    assert np.isfinite(lv)
+    # bit-exact across survivors: every controller's replicated loss
+    # must match new-rank-0's exactly
+    client = mp._client()
+    if me == 0:
+        client.key_value_set(LOSS_KEY, repr(lv))
+    ref = float(client.blocking_key_value_get(LOSS_KEY, 60_000))
+    assert lv == ref, (lv, ref)
+    snapc = metrics.snapshot()["counters"]
+    assert snapc.get('accl_zero_replica_total{event="restore"}', 0) == 1
+    acc.barrier()
+    if me == 0:
+        client.key_value_set(DONE_KEY, "1")
+    print(f"[p{me}] CHAOS-SHRINK-OK", flush=True)
+    return 0
+
+
 def main() -> int:
     scenario = os.environ.get("ACCL_CHAOS", "transient")
     if scenario == "death":
         return death()
+    if scenario == "shrink":
+        return shrink()
     return transient()
 
 

@@ -240,6 +240,27 @@ def test_fault_overhead_lane(accl):
     assert not fault.ENABLED      # the lane disarms the harness
 
 
+def test_recover_time_lane(accl):
+    """The round-15 recovery-cost lane: p50/p99 of ACCL.recover() with
+    direction=lower (bench/compare.py inverts), the mode honesty flag
+    (local on this rung — no fabric, so the headline is zeroed under the
+    resolution protocol), and the configured detection ceiling on the
+    record beside the measured cost."""
+    from bench import KNOWN_LANES
+    from accl_tpu.bench import lanes
+
+    assert "recover_time" in KNOWN_LANES
+    r = lanes.bench_recover_time(accl, rounds=2)
+    assert r["metric"] == "recover_time" and r["unit"] == "us"
+    assert r["direction"] == "lower"
+    assert r["mode"] == "local" and r["resolved"] is False
+    assert r["value"] == 0.0            # unresolved headline zeroed
+    assert r["p50_us"] > 0
+    assert r["p99_us"] >= r["p50_us"] >= r["raw_best_us"] > 0
+    assert r["detection_bound_s"] == pytest.approx(
+        accl.config.heartbeat_timeout_s + accl.config.heartbeat_interval_s)
+
+
 def test_cmatmul_dw_and_stream_lanes_schema(accl):
     """Round-9 lanes follow the resolution protocol on every rung: the
     dw lane's honesty flag mirrors the wgrad plan + rung, the stream

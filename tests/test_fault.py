@@ -808,3 +808,27 @@ def test_chaos_rank_death_peer_failed_and_recover():
     sys.stderr.write(res.stderr)
     assert res.returncode == 0, f"launcher rc={res.returncode}"
     assert res.stdout.count("CHAOS-DEATH-OK") == 2
+
+
+def test_chaos_kill_one_of_four_survivor_subset():
+    """Round-15 acceptance (the survivor-subset proof): kill 1 of 4 —
+    TRUE rank loss, the dead controller never participates again — and
+    the survivors (no surviving process restarts):
+
+    * observe PEER_FAILED within the heartbeat bound,
+    * converge a 3-rank epoch with a NO-ARGUMENT recover() (the
+      survivor set is the default when death verdicts are latched),
+    * see the mesh shrink (world 4 → 3, the old communicator
+      invalidated, ``accl_recover_total{mode="shrink"}`` counted),
+    * run send/recv + allreduce bit-exactly on the degraded mesh,
+    * and resume ZeRO training with the dead rank's state restored
+      BIT-EXACTLY from its buddy replica — no host checkpoint."""
+    res = _run_launcher(
+        ["-np", "4", "--devices-per-proc", "1",
+         os.path.join("tests", "mp_worker_chaos.py")],
+        extra_env={"ACCL_CHAOS": "shrink"})
+    sys.stdout.write(res.stdout)
+    sys.stderr.write(res.stderr)
+    assert res.returncode == 0, f"launcher rc={res.returncode}"
+    assert res.stdout.count("CHAOS-SHRINK-OK") == 3
+    assert res.stdout.count("CHAOS-SHRINK-DEAD-OK") == 1

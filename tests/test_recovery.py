@@ -1,0 +1,449 @@
+"""Survivor-subset recovery (round 15): degraded-mesh operation after
+TRUE rank loss.
+
+Four layers, mirroring the tentpole's legs (the cross-process kill-1-of-4
+proof lives in tests/test_fault.py + tests/mp_worker_chaos.py):
+
+* **driver** — ``ACCL.recover()``'s survivor-set derivation (no-arg
+  recover defaults to the survivors when death verdicts are latched;
+  full-world stays available explicitly), the ``accl_recover_total``
+  counter, and the end-to-end fake-fabric recover;
+* **invalidation** — a communicator spanning a dead rank raises
+  ``COMM_INVALIDATED`` on every dispatch path instead of compiling a
+  program that could never converge;
+* **epoch-keyed caches** — no pre-death program or schedule plan is
+  dispatchable after the epoch bump (the key carries the session epoch,
+  belt-and-braces over the cache clears);
+* **state continuity** — ZeRO buddy replication: the piggybacked
+  replica write mirrors each rank's fresh shards to its ring successor
+  bit-exactly, ``restore_zero_state`` re-materializes a lost rank's
+  state from the buddy and re-partitions over the smaller dp axis, and
+  the single-failure guarantee rejects adjacent ring deaths.
+
+Plus the round-15 satellite regression: an eager send retired with
+``PEER_FAILED`` releases its reserved rx-pool segments (and the pair
+stream stays aligned) instead of shrinking the pool until epoch reset.
+"""
+import random
+
+import numpy as np
+import pytest
+
+import jax
+
+import accl_tpu
+from accl_tpu import fault, multiproc
+from accl_tpu.communicator import Communicator
+from accl_tpu.config import ACCLConfig, Algorithm, TransportBackend
+from accl_tpu.constants import (ACCLCommInvalidatedError, ACCLError,
+                                ACCLPeerFailedError, dataType, errorCode,
+                                operation, reduceFunction)
+from accl_tpu.fault import RetryPolicy
+from accl_tpu.models import zero
+from accl_tpu.obs import metrics
+from accl_tpu.parallel import synth
+from accl_tpu.request import requestStatus
+
+
+def _counter(name: str, **labels) -> float:
+    snap = metrics.snapshot()["counters"]
+    key = name
+    if labels:
+        key += "{" + ",".join(f'{k}="{v}"' for k, v in labels.items()) + "}"
+    return snap.get(key, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# fault.py buddy-topology algebra
+# ---------------------------------------------------------------------------
+
+def test_buddy_topology_helpers():
+    assert fault.buddy_rank(0, 4) == 1
+    assert fault.buddy_rank(3, 4) == 0
+    with pytest.raises(ValueError, match="world >= 2"):
+        fault.buddy_rank(0, 1)
+    assert fault.survivors_of(4, [2]) == [0, 1, 3]
+    assert fault.survivors_of(5, [0, 4]) == [1, 2, 3]
+    with pytest.raises(ValueError, match="no survivors"):
+        fault.survivors_of(2, [0, 1])
+    assert fault.replica_holders([2], 4) == {2: 3}
+    assert fault.replica_holders([3], 4) == {3: 0}  # ring wrap
+    # single-failure guarantee: adjacent ring deaths are unrecoverable
+    with pytest.raises(ValueError, match="also died"):
+        fault.replica_holders([1, 2], 4)
+    # non-adjacent multi-death IS covered (every buddy survives)
+    assert fault.replica_holders([0, 2], 4) == {0: 1, 2: 3}
+
+
+# ---------------------------------------------------------------------------
+# communicator invalidation
+# ---------------------------------------------------------------------------
+
+def test_invalidated_comm_rejects_dispatch(accl):
+    comm = accl.create_communicator([0, 1])
+    assert not comm.is_invalidated
+    comm.invalidate("unit: rank 1's controller died")
+    comm.invalidate("second reason never overwrites")
+    assert comm.is_invalidated
+    assert "rank 1" in comm.invalid_reason
+    b = accl.create_buffer(8, dataType.float32)
+    r = accl.create_buffer(8, dataType.float32)
+    for op in (lambda: accl.allreduce(b, r, 8, reduceFunction.SUM,
+                                      comm=comm),
+               lambda: accl.send(b, 8, src=0, dst=1, comm=comm),
+               lambda: accl.barrier(comm=comm)):
+        with pytest.raises(ACCLCommInvalidatedError) as ei:
+            op()
+        assert ei.value.code == errorCode.COMM_INVALIDATED
+    # the global communicator is untouched
+    accl.allreduce(b, r, 8, reduceFunction.SUM)
+    accl.comms.remove(comm)
+    accl._matchers.pop(id(comm), None)
+
+
+def test_ranks_of_processes(accl):
+    comm = accl.global_comm()
+    me = jax.process_index()
+    assert comm.ranks_of_processes([me]) == list(range(comm.world_size))
+    assert comm.ranks_of_processes([me + 1]) == []
+
+
+# ---------------------------------------------------------------------------
+# recover(): survivor-set derivation + fake-fabric end-to-end
+# ---------------------------------------------------------------------------
+
+class _FakeKV:
+    """Minimal in-memory coordination client (the test_fault.py shape)."""
+
+    def __init__(self):
+        self.kv = {}
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        if not allow_overwrite and key in self.kv:
+            raise RuntimeError(f"ALREADY_EXISTS: {key}")
+        self.kv[key] = str(value)
+
+    def key_value_try_get(self, key):
+        if key not in self.kv:
+            raise KeyError(f"NOT_FOUND: {key}")
+        return self.kv[key]
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        if key in self.kv:
+            return self.kv[key]
+        raise TimeoutError(f"deadline waiting for {key}")
+
+    def key_value_increment(self, key, by=1):
+        n = int(self.kv.get(key, "0")) + by
+        self.kv[key] = str(n)
+        return n
+
+    def key_value_delete(self, key):
+        self.kv.pop(key, None)
+
+    def key_value_dir_get(self, prefix):
+        return [(k, v) for k, v in self.kv.items() if k.startswith(prefix)]
+
+
+@pytest.fixture()
+def acc_fab(monkeypatch):
+    """A fresh 2-rank ACCL with a grafted in-memory-KV fabric, so the
+    recover() driver logic runs without subprocesses."""
+    monkeypatch.delenv("ACCL_SESSION", raising=False)
+    fake = _FakeKV()
+    monkeypatch.setattr(multiproc, "_client", lambda: fake)
+    acc = accl_tpu.ACCL(devices=jax.devices()[:2])
+    acc._fabric = multiproc.CrossProcessFabric(
+        timeout=5.0, eager_window=4,
+        retry_policy=RetryPolicy(initial_s=1e-4, max_s=1e-3),
+        heartbeat_interval_s=0.02, heartbeat_timeout_s=0.0)
+    yield acc, fake
+    acc._fabric = None
+    acc.deinit()
+
+
+def test_recover_participant_derivation(acc_fab):
+    """Satellite: no-arg recover() derives the SURVIVOR set from the
+    latched death verdicts (a full-world re-handshake with a truly-gone
+    rank can never converge); explicit process_ids stay authoritative,
+    and an explicit strict subset also shrinks."""
+    acc, _ = acc_fab
+    acc._fabric._dead_peers = {1}
+    assert acc._recover_participants(None, [0, 1, 2, 3]) == \
+        ([0, 2, 3], [1], "shrink")
+    # full-world re-handshake stays available EXPLICITLY (elastic rejoin)
+    assert acc._recover_participants([0, 1, 2, 3], [0, 1, 2, 3]) == \
+        ([0, 1, 2, 3], [], "full")
+    # explicit strict subset shrinks even without a latched verdict
+    acc._fabric._dead_peers = set()
+    assert acc._recover_participants([0, 2], [0, 1, 2]) == \
+        ([0, 2], [1], "shrink")
+    assert acc._recover_participants(None, [0, 1]) == (None, [], "full")
+    # a dead peer that owns no rank of THIS mesh does not shrink it
+    acc._fabric._dead_peers = {7}
+    assert acc._recover_participants(None, [0, 1]) == (None, [], "full")
+
+
+def test_recover_full_mode_counted_and_epoch_bumped(acc_fab):
+    acc, _ = acc_fab
+    base = _counter("accl_recover_total", mode="full")
+    e0 = acc._epoch
+    assert acc.recover() == 1          # fabric epoch
+    assert acc._fabric.epoch == 1
+    assert acc._epoch == e0 + 1
+    assert _counter("accl_recover_total", mode="full") == base + 1
+    assert acc.stats()["session_epoch"] == acc._epoch
+
+
+def test_recover_without_fabric_counts_full(accl):
+    base = _counter("accl_recover_total", mode="full")
+    e0 = accl._epoch
+    assert accl.recover() == 0
+    assert accl._epoch == e0 + 1
+    assert _counter("accl_recover_total", mode="full") == base + 1
+
+
+# ---------------------------------------------------------------------------
+# epoch-keyed caches: nothing pre-death is dispatchable post-bump
+# ---------------------------------------------------------------------------
+
+def test_program_cache_key_carries_session_epoch(accl):
+    comm = accl.global_comm()
+    k0 = accl._key(comm, operation.copy, 17)
+    accl.recover()
+    k1 = accl._key(comm, operation.copy, 17)
+    assert k0 != k1 and k0[1:] == k1[1:]
+    assert k1[0] == accl._epoch
+    # and the cache itself was dropped
+    assert accl._programs.stats()[0] == 0
+
+
+def test_plan_cache_key_carries_session_epoch(accl):
+    """A plan synthesized before the death must MISS after the epoch
+    bump even with an identical (op, topology, bucket) key — pinned
+    directly on the synth cache, independent of the clear."""
+    comm = accl.global_comm()
+    prev = synth._session_epoch
+    try:
+        synth.resolve(operation.allreduce, 1 << 21, comm, accl.config,
+                      Algorithm.RING)
+        h0 = _counter("accl_sched_plan_cache_total", event="hit")
+        synth.resolve(operation.allreduce, 1 << 21, comm, accl.config,
+                      Algorithm.RING)
+        assert _counter("accl_sched_plan_cache_total",
+                        event="hit") == h0 + 1
+        synth.set_session_epoch(prev + 977)   # the bump, WITHOUT a clear
+        m0 = _counter("accl_sched_plan_cache_total", event="miss")
+        synth.resolve(operation.allreduce, 1 << 21, comm, accl.config,
+                      Algorithm.RING)
+        assert _counter("accl_sched_plan_cache_total",
+                        event="miss") == m0 + 1
+    finally:
+        synth.set_session_epoch(prev)
+
+
+# ---------------------------------------------------------------------------
+# rx-pool PEER_FAILED leak (round-15 satellite regression)
+# ---------------------------------------------------------------------------
+
+def test_peer_failed_send_releases_rx_pool(accl):
+    """An async eager send parked on rx-pool slots and then retired with
+    PEER_FAILED must release its reserved segments — every death used to
+    permanently shrink the pool until the next epoch reset — and the
+    pair's seqn stream must stay aligned (aborted segments count as
+    consumed), so later traffic on the pair still matches."""
+    matcher = accl.matcher()
+    pool = matcher.rx_pool
+    free0 = pool.free_slots
+    seg_elems = accl.config.eager_rx_buffer_size // 4
+    count = seg_elems + seg_elems // 2          # 2 segments
+    sb = accl.create_buffer(count, dataType.float32)
+    sb.host[5] = np.arange(count, dtype=np.float32)
+    req = accl.send(sb, count, src=5, dst=6, tag=4242, run_async=True)
+    assert pool.free_slots == free0 - 2         # both segments parked
+    req.cancel(error=ACCLPeerFailedError([1], "unit death"))
+    assert req.status == requestStatus.PEER_FAILED
+    # retirement released the reservations (occupancy back to pre-send)
+    assert pool.free_slots == free0
+    ns, _ = matcher.n_pending
+    assert ns == 0
+    # the pair stream is still aligned: a fresh round-trip matches
+    payload = np.arange(64, dtype=np.float32)
+    sb2 = accl.create_buffer(64, dataType.float32)
+    rb2 = accl.create_buffer(64, dataType.float32)
+    sb2.host[5] = payload
+    accl.send(sb2, 64, src=5, dst=6, tag=4243)
+    accl.recv(rb2, 64, src=5, dst=6, tag=4243)
+    assert np.array_equal(rb2.host[6], payload)
+
+
+def test_error_retired_send_releases_rx_pool(accl):
+    """Plain cancellation (soft-reset's ERROR verdict) takes the same
+    cleanup path."""
+    pool = accl.matcher().rx_pool
+    free0 = pool.free_slots
+    sb = accl.create_buffer(128, dataType.float32)
+    req = accl.send(sb, 128, src=3, dst=4, tag=777, run_async=True)
+    assert pool.free_slots == free0 - 1
+    req.cancel()
+    assert req.status == requestStatus.ERROR
+    assert pool.free_slots == free0
+
+
+def test_abort_send_python_engine_identity():
+    """Regression (review): the python-fallback abort must scan the
+    pending store by IDENTITY — SendPost is a dataclass whose
+    field-based __eq__ reaches the jax.Array payload, and bool() of an
+    array comparison raises for two same-(src, dst, tag) posts. Also
+    pins the ordering contract: only the next-expected segment aborts."""
+    import jax.numpy as jnp
+
+    from accl_tpu.sendrecv import MatchingEngine, SendPost
+
+    comm = Communicator(jax.devices()[:2])
+    eng = MatchingEngine(comm, use_native=False)
+
+    def park(val):
+        slot = eng.rx_pool.reserve(0, 1, 7, eng.outbound_seq(0, 1), 4)
+        p = SendPost(src=0, dst=1, tag=7,
+                     data=jnp.arange(4.0)[None] + val, count=4,
+                     rx_slot=slot)
+        eng.post_send(p)
+        return p
+
+    p1, p2 = park(0.0), park(1.0)
+    free = eng.rx_pool.free_slots
+    assert not eng.abort_send(p2)          # parked behind p1: refused
+    assert eng.abort_send(p1)
+    assert eng.abort_send(p2)              # now next-expected
+    assert eng.rx_pool.free_slots == free + 2
+    assert eng.n_pending == (0, 0)
+    # the cursor advanced past both aborted seqns
+    assert eng.inbound_seq(0, 1) == 2
+
+
+# ---------------------------------------------------------------------------
+# ZeRO buddy replication + survivor restore (state continuity)
+# ---------------------------------------------------------------------------
+
+D_MODEL, D_HIDDEN, BATCH = 8, 16, 4
+
+
+def _train(comm, steps=2, replicate=True):
+    n, _ = zero._template(D_MODEL, D_HIDDEN)
+    state = zero.init_zero_state(jax.random.PRNGKey(7), comm,
+                                 D_MODEL, D_HIDDEN)
+    step = zero.build_zero_train_step(comm, D_MODEL, D_HIDDEN,
+                                      replicate=replicate)
+    rng = np.random.default_rng(3)
+    x = zero.put_rows(comm, rng.standard_normal(
+        (comm.world_size, BATCH, D_MODEL)).astype(np.float32))
+    y = zero.put_rows(comm, rng.standard_normal(
+        (comm.world_size, BATCH, D_MODEL)).astype(np.float32))
+    rep = None
+    for _ in range(steps):
+        out = step(state, x, y)
+        if replicate:
+            state, loss, rep = out
+        else:
+            state, loss = out
+    jax.block_until_ready(loss)
+    return n, state, rep, float(loss)
+
+
+def test_replica_mirrors_ring_successor():
+    """The piggybacked write: after the step, replica row r holds rank
+    (r-1)%world's FRESH shards, bit-exactly (full-precision wire)."""
+    comm = Communicator(jax.devices()[:4])
+    _n, state, rep, _ = _train(comm, steps=1)
+    w = np.asarray(state.w)
+    for t, rt in zip((state.w, state.m, state.v), rep):
+        a = np.asarray(t)
+        b = np.asarray(rt)
+        for r in range(4):
+            assert np.array_equal(b[r], a[(r - 1) % 4])
+    assert w.shape[0] == 4
+
+
+def test_replicate_default_off_and_write_through(accl):
+    """shard_replicas is off by default; the config register writes
+    through to the module default like zero_overlap."""
+    comm = Communicator(jax.devices()[:2])
+    assert not zero.get_replicas_enabled()
+    _n, _state, rep, _ = _train(comm, steps=1, replicate=None)
+    assert rep is None  # default-off: step returned (state, loss)
+    old = accl.config
+    try:
+        accl.config = accl.config.replace(shard_replicas=True)
+        assert zero.get_replicas_enabled()
+    finally:
+        accl.config = old
+        assert not zero.get_replicas_enabled()
+
+
+def test_standalone_replicate_program():
+    comm = Communicator(jax.devices()[:3])
+    state = zero.init_zero_state(jax.random.PRNGKey(1), comm,
+                                 D_MODEL, D_HIDDEN)
+    base = _counter("accl_zero_replica_total", event="write")
+    rep = zero.build_buddy_replicate(comm)(state)
+    assert _counter("accl_zero_replica_total", event="write") == base + 1
+    w = np.asarray(state.w)
+    rw = np.asarray(rep.w)
+    for r in range(3):
+        assert np.array_equal(rw[r], w[(r - 1) % 3])
+
+
+def test_restore_bit_exact_and_training_resumes():
+    """The acceptance shape on the single-controller rung: train with
+    replication, lose a rank, restore from the buddy, and the
+    re-partitioned state over the smaller dp axis is BIT-EXACT against
+    the pre-death full vectors; a further train step runs."""
+    comm = Communicator(jax.devices()[:4])
+    n, state, rep, _ = _train(comm, steps=2)
+    oracle = {t: np.asarray(getattr(state, t)).reshape(-1)[:n]
+              for t in ("w", "m", "v")}
+    dead, survivors = [2], [0, 1, 3]
+    new_comm = comm.split(survivors)
+    base = _counter("accl_zero_replica_total", event="restore")
+    st3 = zero.restore_zero_state(new_comm, state, rep, survivors,
+                                  dead, n)
+    assert _counter("accl_zero_replica_total",
+                    event="restore") == base + 1
+    for t in ("w", "m", "v"):
+        got = np.asarray(getattr(st3, t)).reshape(-1)[:n]
+        assert np.array_equal(got, oracle[t]), f"{t} not bit-exact"
+    assert int(zero._scalar_value(st3.t)) == 2
+    assert st3.w.shape[0] == 3                  # the smaller dp axis
+    # training resumes on the shrunk mesh without a host checkpoint
+    step3 = zero.build_zero_train_step(new_comm, D_MODEL, D_HIDDEN,
+                                       replicate=False)
+    rng = np.random.default_rng(9)
+    x3 = zero.put_rows(new_comm, rng.standard_normal(
+        (3, BATCH, D_MODEL)).astype(np.float32))
+    y3 = zero.put_rows(new_comm, rng.standard_normal(
+        (3, BATCH, D_MODEL)).astype(np.float32))
+    _st4, loss = step3(st3, x3, y3)
+    assert np.isfinite(float(loss))
+
+
+def test_restore_rejects_adjacent_deaths():
+    comm = Communicator(jax.devices()[:4])
+    n, state, rep, _ = _train(comm, steps=1)
+    new_comm = comm.split([0, 3])
+    with pytest.raises(ValueError, match="also died"):
+        zero.restore_zero_state(new_comm, state, rep, [0, 3], [1, 2], n)
+
+
+def test_wire_staged_replica_tolerance():
+    """A bf16-staged replica halves the mirror's wire at a bounded
+    rounding cost (the mm×rs tolerance class) — close, not bit-exact."""
+    comm = Communicator(jax.devices()[:2])
+    state = zero.init_zero_state(jax.random.PRNGKey(2), comm,
+                                 D_MODEL, D_HIDDEN)
+    rep = zero.build_buddy_replicate(comm, wire_dtype="bf16")(state)
+    w = np.asarray(state.w)
+    rw = np.asarray(rep.w)
+    assert rw.dtype == w.dtype                  # staged, returned wide
+    assert np.allclose(rw[1], w[0], rtol=1e-2, atol=1e-2)
+    assert not np.array_equal(rw[1], w[0])      # it really rode bf16

@@ -91,6 +91,91 @@ def test_coords_shape_rejects_3d_grid():
     assert synth._coords_shape(wall) is None
 
 
+class _FakeComm:
+    """Just enough communicator surface for topology_of/resolve: a
+    device list with coords, an optional parent and the shrink-recovery
+    ``degraded_from`` mark."""
+
+    def __init__(self, devs, parent=None, degraded_from=None):
+        self._devices = list(devs)
+        self.world_size = len(self._devices)
+        self.parent = parent
+        self.degraded_from = degraded_from
+
+    @property
+    def devices(self):
+        return list(self._devices)
+
+
+def test_holed_grid_never_resolves_multiaxis():
+    """Round-15 pin (survivor-subset planning): a 2x4 grid that lost one
+    chip is NOT a torus — resolution must fall back to the single-axis
+    logical ring over the survivors (never invent a multi-axis
+    decomposition over missing links) and, on a shrink-built
+    communicator, count the degraded decline."""
+    holed = [_FakeDev((x, y, 0)) for y in range(2) for x in range(4)][:-1]
+    assert synth._coords_shape(holed) is None
+    assert synth._coords_degraded(holed)
+    comm = _FakeComm(holed, degraded_from=8)   # built by a shrink recovery
+    cfg = ACCLConfig(transport=TransportBackend.SIM)
+    d0 = _counter('accl_select_decline_total{op="allreduce",'
+                  'reason="holed_grid"}')
+    plan = synth.resolve(operation.allreduce, 9 << 20, comm, cfg,
+                         Algorithm.RING)
+    assert plan.algorithm != Algorithm.MULTIAXIS
+    assert plan.shape in ("ring", "kring")
+    assert plan.topology.axes == (7,)          # the survivor ring
+    assert _counter('accl_select_decline_total{op="allreduce",'
+                    'reason="holed_grid"}') == d0 + 1
+    # cached resolution does not re-count
+    synth.resolve(operation.allreduce, 9 << 20, comm, cfg, Algorithm.RING)
+    assert _counter('accl_select_decline_total{op="allreduce",'
+                    'reason="holed_grid"}') == d0 + 1
+    # an ORDINARY sub-group on the same holed coords (no shrink mark):
+    # identical single-axis resolution, but routine group creation must
+    # never count as a degradation event
+    plain = _FakeComm(holed)
+    plan2 = synth.resolve(operation.allreduce, 13 << 20, plain, cfg,
+                          Algorithm.RING)
+    assert plan2.algorithm != Algorithm.MULTIAXIS
+    assert _counter('accl_select_decline_total{op="allreduce",'
+                    'reason="holed_grid"}') == d0 + 1
+    # the intact grid is NOT degraded (the counter is for real holes)
+    full = [_FakeDev((x, y, 0)) for y in range(2) for x in range(4)]
+    assert not synth._coords_degraded(full)
+    # no-coords and 3-D slices are benign single-axis, never "degraded"
+    assert not synth._coords_degraded([object()] * 4)
+    cube = [_FakeDev((x, y, z))
+            for z in range(2) for y in range(2) for x in range(2)]
+    assert not synth._coords_degraded(cube)
+
+
+def test_stale_declared_shape_on_shrunk_comm_counted():
+    """A sched_mesh_shape declared for the pre-death world no longer
+    matches the survivor-subset communicator: resolution falls back to
+    single-axis (the sub-communicator rule) and the degraded decline is
+    counted — but ONLY on the shrink-built group; an ordinary
+    sub-communicator mismatching the global declaration stays benign."""
+    devs = [object() for _ in range(7)]        # no coords (emulator rung)
+    comm = _FakeComm(devs, parent=object(), degraded_from=8)
+    cfg = ACCLConfig(transport=TransportBackend.SIM,
+                     sched_mesh_shape=[2, 4])
+    d0 = _counter('accl_select_decline_total{op="reduce_scatter",'
+                  'reason="declared_shape_mismatch"}')
+    plan = synth.resolve(operation.reduce_scatter, 11 << 20, comm, cfg,
+                         Algorithm.RING)
+    assert plan.algorithm != Algorithm.MULTIAXIS
+    assert plan.topology.axes == (7,)
+    assert _counter('accl_select_decline_total{op="reduce_scatter",'
+                    'reason="declared_shape_mismatch"}') == d0 + 1
+    # the routine case: same mismatch, no shrink mark, no count
+    plain = _FakeComm([object() for _ in range(4)], parent=object())
+    synth.resolve(operation.reduce_scatter, 11 << 20, plain, cfg,
+                  Algorithm.RING)
+    assert _counter('accl_select_decline_total{op="reduce_scatter",'
+                    'reason="declared_shape_mismatch"}') == d0 + 1
+
+
 def test_declared_shape_ignored_on_sub_communicator(accl):
     """cfg.sched_mesh_shape describes the GLOBAL mesh: a split
     sub-communicator with a different world must fall back to

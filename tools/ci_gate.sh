@@ -6,11 +6,13 @@
 #
 # 1. Runs the ROADMAP tier-1 verify command (the full fast test suite on
 #    the CPU emulator rung). A failure here fails the gate immediately.
-# 2. With --chaos, re-runs the round-14 chaos matrix STANDALONE
+# 2. With --chaos, re-runs the chaos matrix STANDALONE
 #    (tests/test_fault.py: the fault-injection sweep, the cross-process
-#    transient matrix and the rank-death/recover scenario) — a clean
-#    isolated pass proves the resilience tier independent of suite
-#    ordering/fixture reuse. A failure fails the gate.
+#    transient matrix, the rank-death/recover scenario, and the round-15
+#    kill-1-of-4 survivor-subset shrink — true rank loss, 3-rank epoch,
+#    buddy-replica ZeRO restore) — a clean isolated pass proves the
+#    resilience tier independent of suite ordering/fixture reuse. A
+#    failure fails the gate.
 # 3. If at least TWO BENCH_*.json artifacts exist in the repo root, diffs
 #    the two most recent with `python -m accl_tpu.bench.compare` (base =
 #    the older of the pair) and propagates its exit code — a >threshold
