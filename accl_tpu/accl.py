@@ -853,20 +853,39 @@ class ACCL:
         from .parallel import synth
         return synth.torus_shape(comm, self.config, allow_factor2d=True)
 
+    def _pipeline_chunks(self, algo, plan):
+        """Chunk count for a MULTIAXIS program — part of its cache key
+        (a re-tuned ``sched_pipeline_chunks`` must not reuse a stale
+        program) and the builder's pipelining switch. The resolved plan
+        is authoritative (shape ``pipeline`` runs its own chunk param,
+        a sequential ``multiaxis`` plan runs unchunked, exactly what
+        the plan counters claim); an EXPLICIT ``algorithm=MULTIAXIS``
+        request carries no plan and honors the session register — the
+        bench lanes' per-arm A/B control."""
+        if algo != Algorithm.MULTIAXIS:
+            return 1
+        if plan is not None:
+            if plan.shape == "pipeline":
+                return max(1, int(plan.param("pipeline_chunks", 1)))
+            return 1
+        return max(1, int(self.config.sched_pipeline_chunks))
+
     def _spec_allgather(self, comm, count: int, dtype: dataType,
                         compress_dtype, algorithm):
         arith = self._arith(dtype, compress_dtype)
-        algo = algorithms.select(
+        algo, plan = algorithms.select_plan(
             operation.allgather, count * constants.dtype_size(dtype),
             comm, self.config, algorithm)
         seg = self.config.segment_size
         bidir = self.config.bidirectional_rings
         ms = self._mesh_shape(comm, algo)
+        pc = self._pipeline_chunks(algo, plan)
         return (self._key(comm, operation.allgather, count, dtype,
-                          compress_dtype, algo, seg, bidir, ms),
+                          compress_dtype, algo, seg, bidir, ms, pc),
                 lambda: algorithms.build_allgather(comm, algo, arith, dtype,
                                                    seg, bidir,
-                                                   mesh_shape=ms))
+                                                   mesh_shape=ms,
+                                                   pipeline_chunks=pc))
 
     def _spec_scatter(self, comm, count: int, dtype: dataType, root: int,
                       compress_dtype, algorithm):
@@ -930,7 +949,7 @@ class ACCL:
         arith = self._arith(dtype, compress_dtype)
         if arith is not None and not arith.supports(function):
             raise ACCLError(errorCode.ARITH_ERROR, f"{function} unsupported")
-        algo = algorithms.select(
+        algo, plan = algorithms.select_plan(
             operation.allreduce, count * constants.dtype_size(dtype),
             comm, self.config, algorithm)
         fanin = (self.config.gather_flat_tree_max_fanin
@@ -939,13 +958,15 @@ class ACCL:
         bidir = self.config.bidirectional_rings
         on_dcn = self.config.transport == TransportBackend.DCN
         ms = self._mesh_shape(comm, algo)
+        pc = self._pipeline_chunks(algo, plan)
         return (self._key(comm, operation.allreduce, count, dtype, function,
                           compress_dtype, algo, seg, fanin, bidir, on_dcn,
-                          ms),
+                          ms, pc),
                 lambda: algorithms.build_allreduce(comm, function, dtype,
                                                    algo, arith, seg, fanin,
                                                    bidir, on_dcn=on_dcn,
-                                                   mesh_shape=ms))
+                                                   mesh_shape=ms,
+                                                   pipeline_chunks=pc))
 
     def _spec_reduce_scatter(self, comm, count: int, dtype: dataType,
                              function: reduceFunction, compress_dtype,
@@ -953,19 +974,22 @@ class ACCL:
         arith = self._arith(dtype, compress_dtype)
         if arith is not None and not arith.supports(function):
             raise ACCLError(errorCode.ARITH_ERROR, f"{function} unsupported")
-        algo = algorithms.select(
+        algo, plan = algorithms.select_plan(
             operation.reduce_scatter,
             count * comm.world_size * constants.dtype_size(dtype),
             comm, self.config, algorithm)
         seg = self.config.segment_size
         bidir = self.config.bidirectional_rings
         ms = self._mesh_shape(comm, algo)
+        pc = self._pipeline_chunks(algo, plan)
         return (self._key(comm, operation.reduce_scatter, count, dtype,
-                          function, compress_dtype, algo, seg, bidir, ms),
+                          function, compress_dtype, algo, seg, bidir, ms,
+                          pc),
                 lambda: algorithms.build_reduce_scatter(comm, function,
                                                         dtype, algo, arith,
                                                         seg, bidir,
-                                                        mesh_shape=ms))
+                                                        mesh_shape=ms,
+                                                        pipeline_chunks=pc))
 
     # ------------------------------------------------------------------
     # primitives: copy / combine
@@ -2086,6 +2110,8 @@ class ACCL:
         ``initialize()`` (the PERFCNT readout for this session)."""
         import json as _json
 
+        from .parallel.synth import plan_cache_stats as _synth_stats
+
         progs, hits, misses = self._programs.stats()
         fresh, retry = self._sched.depths
         comms = []
@@ -2145,6 +2171,9 @@ class ACCL:
                               "misses": misses,
                               "evictions": self._programs.evictions,
                               "max_size": self._programs.maxsize},
+            # the synth schedule-plan cache, beside the program cache it
+            # feeds (module-global, reset per session by initialize())
+            "sched_plan_cache": _synth_stats(),
             "queue": {"inflight": len(self._queue.inflight)},
             "scheduler": {"parked_continuations": len(self._parked_calls),
                           "fresh_depth": fresh, "retry_depth": retry},

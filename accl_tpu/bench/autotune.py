@@ -719,13 +719,18 @@ def autotune_sched_synth(acc, cfg: Optional[ACCLConfig] = None,
     """Validate the schedule synthesizer against the live mesh: calibrate
     the α-β cost model from measured flat-ring allreduce times (a linear
     fit of t(N) — the intercept prices a hop, the slope a link
-    direction) and A/B the synthesized multi-axis schedule against the
-    ring at the largest size, writing ``sched_alpha_us`` /
-    ``sched_beta_gbps`` and the ``sched_synthesis`` go/no-go. ICI only —
-    anywhere else the fit would calibrate the emulator — and a mesh with
-    no declared or coordinate-detected torus passes through untouched
-    (AUTO never dispatches the multi-axis plan there, so there is
-    nothing to seed)."""
+    direction), A/B the synthesized multi-axis schedule against the
+    ring at the largest size (the ``sched_synthesis`` go/no-go), then
+    calibrate the PIPELINED cost formula's per-chunk startup term from
+    a two-point chunk sweep (t(C) = max_phase + (C-1)·startup, so the
+    slope over C prices one pipeline fill) and resolve the pipelined
+    go/no-go — a mesh where chunking never beats the sequential
+    multi-axis schedule writes ``sched_pipeline_chunks=1`` so AUTO
+    stops claiming the overlap. ICI only — anywhere else the fit would
+    calibrate the emulator — and a mesh with no declared or
+    coordinate-detected torus passes through untouched (AUTO never
+    dispatches the multi-axis plan there, so there is nothing to
+    seed)."""
     import jax
 
     from ..parallel import synth
@@ -760,12 +765,32 @@ def autotune_sched_synth(acc, cfg: Optional[ACCLConfig] = None,
     # must actually beat the flat ring it claims to beat
     npdt = np.dtype(to_jax_dtype(dt))
     n = counts[-1]
-    prog = algorithms.build_allreduce(
-        comm, reduceFunction.SUM, dt, Algorithm.MULTIAXIS, None,
-        bidirectional=bidir, mesh_shape=shape)
-    x = jax.device_put(np.full((W, n), 1e-6, npdt), comm.sharding())
-    t_multi = _time_prog(prog, x, reps=reps)
-    return cfg.replace(sched_synthesis=bool(t_multi <= t_ring[-1]))
+
+    def _multi(chunks: int) -> float:
+        prog = algorithms.build_allreduce(
+            comm, reduceFunction.SUM, dt, Algorithm.MULTIAXIS, None,
+            bidirectional=bidir, mesh_shape=shape,
+            pipeline_chunks=chunks)
+        x = jax.device_put(np.full((W, n), 1e-6, npdt), comm.sharding())
+        return _time_prog(prog, x, reps=reps)
+
+    t_multi = _multi(1)
+    cfg = cfg.replace(sched_synthesis=bool(t_multi <= t_ring[-1]))
+    # pipelined startup calibration: two chunk depths isolate the
+    # per-chunk fill cost (the wire/bottleneck terms cancel in the
+    # difference), then the measured best-chunk time answers the
+    # pipelined go/no-go against the sequential schedule
+    t_c2, t_c4 = _multi(2), _multi(4)
+    startup_us = max((t_c4 - t_c2) / 2 * 1e6, 0.01)
+    cfg = cfg.replace(
+        sched_pipeline_startup_us=float(round(startup_us, 3)))
+    if min(t_c2, t_c4) > t_multi:
+        # chunking never won on this mesh: retire the pipelined
+        # candidate (chunks=1 resolves the sequential schedule,
+        # byte-identical to pre-pipelining)
+        return cfg.replace(sched_pipeline_chunks=1)
+    best_chunks = 2 if t_c2 <= t_c4 else 4
+    return cfg.replace(sched_pipeline_chunks=best_chunks)
 
 
 def autotune_flash_bwd(acc, cfg: Optional[ACCLConfig] = None,

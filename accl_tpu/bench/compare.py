@@ -19,7 +19,14 @@ instead of a diff nobody reads:
   honesty flags are honored: a lane that was flagged/zeroed on either
   side is reported ``incomparable``, never a regression);
 * lanes present on only one side are reported (``added`` / ``removed``)
-  — a silently dropped lane is itself a finding.
+  — a silently dropped lane is itself a finding;
+* lanes that carry the cost model's own predictions beside their
+  measurements (``predicted_<x>_us`` next to ``<x>_us`` — the
+  sched_synth/sched_pipeline rows) are checked for **calibration
+  drift**: a prediction off by more than 3x in either direction is
+  reported as a ``calibration_warnings`` entry so the α-β/startup fit
+  stays checkable across artifacts. A warning, never a regression exit
+  — a stale fit is a tuning task, not a perf loss.
 
 CLI: ``python -m accl_tpu.bench.compare BASE.json NEW.json
 [--threshold 0.1]`` — prints one JSON document and exits 1 when any
@@ -129,13 +136,53 @@ def _direction(b_row: dict, n_row: dict) -> str:
     return "higher"
 
 
+#: prediction/measurement disagreement that flags a calibration warning
+CALIBRATION_DRIFT = 3.0
+
+
+def calibration_warnings(doc: dict,
+                         drift: float = CALIBRATION_DRIFT) -> List[dict]:
+    """Cost-model drift scan over one artifact: every lane field named
+    ``predicted_<x>_us`` is paired with its measured ``<x>_us``
+    neighbor; a ratio beyond ``drift`` (either direction) is reported.
+    Skipped/errored rows and non-positive values are ignored — a lane
+    that did not measure cannot indict the model."""
+    warnings: List[dict] = []
+    for name, row in sorted(lane_values(doc).items()):
+        if row.get("error") or row.get("skipped"):
+            continue
+        for key in sorted(row):
+            if not (key.startswith("predicted_") and key.endswith("_us")):
+                continue
+            measured_key = key[len("predicted_"):]
+            try:
+                pred = float(row[key])
+                meas = float(row.get(measured_key, 0))
+            except (TypeError, ValueError):
+                continue
+            if pred <= 0 or meas <= 0:
+                continue
+            ratio = meas / pred
+            if ratio > drift or ratio < 1.0 / drift:
+                warnings.append({
+                    "metric": name, "field": measured_key,
+                    "predicted_us": pred, "measured_us": meas,
+                    "ratio": round(ratio, 3),
+                    "note": "cost-model calibration drift >"
+                            f"{drift}x: re-run autotune_sched_synth",
+                })
+    return warnings
+
+
 def compare(base: dict, new: dict, threshold: float = 0.10) -> dict:
     """Per-lane diff of two artifacts. Returns a JSON-ready document:
     ``rows`` (one per lane present on either side, with base/new values,
     ratio, direction, and a ``status`` of ok / regression / improvement
     / incomparable / added / removed), ``regressions`` (the lane names
-    that moved > threshold in their direction's bad sense), and the
-    threshold used."""
+    that moved > threshold in their direction's bad sense),
+    ``calibration_warnings`` (the NEW artifact's predicted-vs-measured
+    drift — advisory only, never a regression), and the threshold
+    used."""
     b_rows, n_rows = lane_values(base), lane_values(new)
     rows: List[dict] = []
     regressions: List[str] = []
@@ -171,6 +218,7 @@ def compare(base: dict, new: dict, threshold: float = 0.10) -> dict:
                      "direction": direction})
     return {"metric": "bench_compare", "threshold": threshold,
             "rows": rows, "regressions": regressions,
+            "calibration_warnings": calibration_warnings(new),
             "regressed": bool(regressions)}
 
 

@@ -1542,7 +1542,11 @@ def bench_sched_synth(comm, count: int = 1 << 18, rounds: int = 5,
         pred_ring = synth._gen_ring(op, topo, n_total, model,
                                     2 if bidir and W >= 4 else 1,
                                     "kring", Algorithm.RING)
-        resolved = declared and plan.shape == "multiaxis" \
+        # AUTO dispatches the multi-axis family for both the sequential
+        # and the chunk-pipelined plan shapes — this lane measures the
+        # sequential arm; the pipelined arm has its own lane
+        # (bench_sched_pipeline)
+        resolved = declared and plan.shape in ("multiaxis", "pipeline") \
             and t_multi["med"] > 0
         speedup_med = (t_ring["med"] / t_multi["med"]
                        if t_multi["med"] > 0 else 0.0)
@@ -1564,6 +1568,125 @@ def bench_sched_synth(comm, count: int = 1 << 18, rounds: int = 5,
             "raw_multiaxis_us": round(t_multi["best"] * 1e6, 1),
             "predicted_multiaxis_us": round(pred_multi.predicted_us, 1),
             "predicted_flat_ring_us": round(pred_ring.predicted_us, 1),
+            "bytes": sel_bytes, "world": W, "rounds": rounds,
+        })
+    return rows
+
+
+def bench_sched_pipeline(comm, count: int = 1 << 18, rounds: int = 5,
+                         cfg=None,
+                         ops: Optional[Sequence[str]] = None) -> List[dict]:
+    """The chunked-phase pipelining A/B (the wafer-scale-reduce overlap,
+    arxiv 2404.15888): ``sched_pipeline_allreduce`` /
+    ``sched_pipeline_reduce_scatter`` / ``sched_pipeline_allgather``
+    time the PIPELINED multi-axis schedule (payload split into
+    ``cfg.sched_pipeline_chunks`` chunks, per-axis legs of successive
+    chunks overlapped) against the sequential multi-axis schedule AND
+    the flat logical ring on the live mesh.
+
+    Headline ``value`` = sequential-multiaxis median / pipelined median
+    (>1 means chunking the phases actually bought overlap — the win the
+    cost model's ``max(phase costs) + (chunks-1)·startup`` formula
+    claims). Honesty flags: ``plan_shape``/``plan_source`` pin what the
+    synthesizer resolves for this payload under the session config and
+    ``resolved`` is True ONLY when that resolution picked the pipelined
+    shape — a mesh with no declared/detected torus, a chunks=1 session
+    or a seeded config reports its raw A/B but zeroes the headline,
+    because AUTO would not dispatch the schedule being measured.
+    ``pipeline_chunks`` records the chunk count each arm actually ran;
+    raw best values sit beside medians, and the cost model's
+    ``predicted_pipeline_us``/``predicted_multiaxis_us`` ride the row
+    beside the measured ``pipeline_us``/``multiaxis_us`` so
+    ``bench/compare.py`` can flag α-β/startup calibration drift."""
+    from ..config import ACCLConfig, Algorithm
+    from ..constants import dataType, operation, reduceFunction
+    from ..parallel import algorithms, synth
+
+    cfg = cfg or ACCLConfig(transport=None)
+    W = comm.world_size
+    rng = np.random.default_rng(0)
+    dt = dataType.float32
+    shape = synth.torus_shape(comm, cfg, allow_factor2d=True)
+    topo = synth.topology_of(comm, cfg)
+    declared = topo.multi_axis
+    bidir = cfg.bidirectional_rings
+    # the pipelined arm's chunk count: the session register when it
+    # pipelines, else the default A/B depth (the raw measurement stays
+    # honest — `resolved` is False when AUTO would not run it)
+    chunks = max(int(cfg.sched_pipeline_chunks), 2)
+
+    ops_table = (
+        ("sched_pipeline_allreduce", operation.allreduce,
+         lambda a, ms, pc: algorithms.build_allreduce(
+             comm, reduceFunction.SUM, dt, a, None,
+             bidirectional=bidir, mesh_shape=ms, pipeline_chunks=pc),
+         (W, count), count * 4),
+        ("sched_pipeline_reduce_scatter", operation.reduce_scatter,
+         lambda a, ms, pc: algorithms.build_reduce_scatter(
+             comm, reduceFunction.SUM, dt, a, None,
+             bidirectional=bidir, mesh_shape=ms, pipeline_chunks=pc),
+         (W, W * count), W * count * 4),
+        ("sched_pipeline_allgather", operation.allgather,
+         lambda a, ms, pc: algorithms.build_allgather(
+             comm, a, None, dt, bidirectional=bidir, mesh_shape=ms,
+             pipeline_chunks=pc),
+         (W, count), count * 4),
+    )
+    rows = []
+    for name, op, build, xshape, sel_bytes in ops_table:
+        if ops is not None and name not in ops:
+            continue  # single-op A/B: skip before paying measurement
+        if shape is None:
+            rows.append({"metric": name, "unit": "ratio", "value": 0.0,
+                         "resolved": False, "plan_shape": None,
+                         "reason": f"no torus factorization for world={W}"})
+            continue
+        x = jax.device_put(
+            rng.standard_normal(xshape).astype(np.float32) * 1e-2,
+            comm.sharding())
+        t_ring = _dist(build(Algorithm.RING, None, 1), x, rounds=rounds)
+        t_seq = _dist(build(Algorithm.MULTIAXIS, shape, 1), x,
+                      rounds=rounds)
+        t_pipe = _dist(build(Algorithm.MULTIAXIS, shape, chunks), x,
+                       rounds=rounds)
+        # the honesty anchor: what would AUTO dispatch here?
+        legacy = algorithms._select_legacy(op, sel_bytes, comm, cfg)
+        plan = synth.resolve(op, sel_bytes, comm, cfg, legacy)
+        model = synth.CostModel.from_config(cfg, topo.transport)
+        topo_ab = synth.Topology(tuple(shape), topo.transport, bidir)
+        n_total = synth._payload_total(op, sel_bytes, W)
+        pred_seq = synth._gen_multiaxis(op, topo_ab, n_total, model)
+        pred_pipe = synth._gen_pipeline(
+            op, topo_ab, n_total, model, chunks,
+            cfg.sched_pipeline_startup_us)
+        resolved = declared and plan.shape == "pipeline" \
+            and t_pipe["med"] > 0
+        speedup_med = (t_seq["med"] / t_pipe["med"]
+                       if t_pipe["med"] > 0 else 0.0)
+        speedup_best = (t_seq["best"] / t_pipe["best"]
+                        if t_pipe["best"] > 0 else 0.0)
+        rows.append({
+            "metric": name, "unit": "ratio",
+            "value": round(speedup_med if resolved else 0.0, 3),
+            "resolved": resolved,
+            "plan_shape": plan.shape,
+            "plan_source": plan.source,
+            "pipeline_chunks": chunks,
+            "plan_pipeline_chunks": plan.param("pipeline_chunks"),
+            "mesh_shape": list(shape),
+            "topology_declared": declared,
+            "raw_speedup": round(speedup_best, 3),
+            "raw_speedup_med": round(speedup_med, 3),
+            "flat_ring_us": round(t_ring["med"] * 1e6, 1),
+            "raw_flat_ring_us": round(t_ring["best"] * 1e6, 1),
+            "multiaxis_us": round(t_seq["med"] * 1e6, 1),
+            "raw_multiaxis_us": round(t_seq["best"] * 1e6, 1),
+            "pipeline_us": round(t_pipe["med"] * 1e6, 1),
+            "raw_pipeline_us": round(t_pipe["best"] * 1e6, 1),
+            "vs_ring_med": (round(t_ring["med"] / t_pipe["med"], 3)
+                            if t_pipe["med"] > 0 else 0.0),
+            "predicted_multiaxis_us": round(pred_seq.predicted_us, 1),
+            "predicted_pipeline_us": round(pred_pipe.predicted_us, 1),
             "bytes": sel_bytes, "world": W, "rounds": rounds,
         })
     return rows

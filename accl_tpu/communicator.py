@@ -214,6 +214,25 @@ class Communicator:
         devs = np.array(self._devices).reshape(rows, cols)
         return Mesh(devs, axis_names)
 
+    def meshnd(self, axes: Sequence[int], axis_names: Sequence[str]) -> Mesh:
+        """N-D mesh over the same ranks — :meth:`mesh2d` at any rank,
+        for the declared multi-axis torus decompositions
+        (``parallel/synth.py``). Row-major: rank i sits at the i-th
+        row-major coordinate, so a flat ring over ``ranks`` equals
+        raster order over the N-D mesh (the reshape costs no data
+        movement)."""
+        axes = tuple(int(s) for s in axes)
+        if len(axes) != len(axis_names):
+            raise ValueError(f"{len(axes)} axes, {len(axis_names)} names")
+        p = 1
+        for s in axes:
+            p *= s
+        if p != self.world_size:
+            raise ValueError(
+                f"{'x'.join(map(str, axes))} != world {self.world_size}")
+        devs = np.array(self._devices).reshape(axes)
+        return Mesh(devs, tuple(axis_names))
+
     def split(self, indices: Sequence[int]) -> "Communicator":
         """Sub-communicator from a subset of ranks.
 

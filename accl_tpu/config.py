@@ -286,6 +286,30 @@ class ACCLConfig:
     sched_beta_gbps: float = 45.0
     sched_dcn_alpha_us: float = 25.0
     sched_dcn_beta_gbps: float = 5.0
+    # chunked phase pipelining for the multi-axis schedules (the
+    # wafer-scale-reduce overlap, arxiv 2404.15888): the payload splits
+    # into this many chunks so chunk c's axis-1 leg rides the wire while
+    # chunk c+1's axis-0 leg is still in flight — the cost model prices
+    # the pipelined candidate max(phase costs) + (chunks-1)·startup
+    # against the sequential sum and picks per (op, topology,
+    # size-bucket). 1 disables pipelining (the sequential multi-axis
+    # schedule, byte-identical to pre-pipelining resolution);
+    # sched_pipeline_startup_us is the per-chunk launch/fill cost,
+    # calibrated on real ICI by bench.autotune_sched_synth.
+    sched_pipeline_chunks: int = 4
+    sched_pipeline_startup_us: float = 2.0
+    # full-authority synthesis (the "synthesis becomes the only
+    # scheduler" migration switch): when True the α-β cost model's
+    # per-size-bucket argmin over the WHOLE candidate family (xla /
+    # flat / tree / ring / kring / multiaxis / pipeline / hier) retires
+    # the scalar threshold ladders for the bandwidth collectives on
+    # single-axis topologies too — seeds no longer pin, the latency
+    # tier dissolves into the same search. Default OFF: default-config
+    # resolution stays byte-identical to the two-stage ladder+synth
+    # pipeline (pinned by tests/test_synth.py); the DCN guard and
+    # explicit per-call algorithm= requests outrank the flag either
+    # way. Counted under accl_sched_plan_total{source="full_authority"}.
+    sched_full_authority: bool = False
 
     # compiled-program cache (parallel/compiler.py) LRU bound: a
     # long-lived serving session resolving many (shape, dtype, algo)

@@ -32,7 +32,7 @@ name                                           kind       labels
 ``accl_fabric_moves_total``                    counter    kind (single | batch)
 ``accl_cmdlist_executes_total``                counter    steps
 ``accl_sched_plan_total``                      counter    op, shape, source
-``accl_sched_plan_cache_total``                counter    event (hit | miss)
+``accl_sched_plan_cache_total``                counter    event (hit | miss | evict)
 ``accl_select_decline_total``                  counter    op, reason
 ``accl_program_cache_total``                   counter    event (hit | miss | evict)
 ``accl_program_cache_size``                    gauge      (none)

@@ -150,16 +150,37 @@ def select(
     two-stage resolution: its decision feeds the topology-aware
     schedule synthesizer (:mod:`accl_tpu.parallel.synth`), whose cached
     α-β cost-model search may upgrade it to the multi-axis torus
-    decomposition (``Algorithm.MULTIAXIS``) on meshes with a declared or
-    coordinate-detected torus shape. Non-default scalar registers are
-    autotune seeds and pin the legacy decision; single-axis meshes with
-    default config resolve exactly as the ladder alone — see
-    ``docs/scheduling.md`` for the cost model, candidate space and
-    override/migration story."""
-    algo = _select(op, nbytes, comm, cfg, requested, count)
+    decomposition (``Algorithm.MULTIAXIS``) — sequential or
+    chunk-PIPELINED (the plan's ``pipeline_chunks`` param; the per-axis
+    legs of successive chunks overlap) — on meshes with a declared or
+    coordinate-detected torus shape, including declared 3-axis shapes.
+    Non-default scalar registers are autotune seeds and pin the legacy
+    decision; single-axis meshes with default config resolve exactly as
+    the ladder alone (``cfg.sched_full_authority`` retires the ladder
+    outright when set) — see ``docs/scheduling.md`` for the cost model,
+    candidate space, pipelined-phase formula and override/migration
+    story."""
+    algo, _ = select_plan(op, nbytes, comm, cfg, requested, count)
+    return algo
+
+
+def select_plan(
+    op: operation,
+    nbytes: int,
+    comm: Communicator,
+    cfg: ACCLConfig,
+    requested: Optional[Algorithm] = None,
+    count: Optional[int] = None,
+):
+    """:func:`select` plus the resolved :class:`synth.SchedulePlan` when
+    the synthesizer owned the decision (None for explicit requests,
+    world-1, and ops outside ``synth.SYNTH_OPS``) — the dispatch layer
+    reads the plan's ``pipeline_chunks``/``shape2d`` params so the
+    program it builds matches the schedule the plan counters claim."""
+    algo, plan = _select(op, nbytes, comm, cfg, requested, count)
     _metrics.inc("accl_algorithm_selected_total",
                  labels=(("op", op.name), ("algorithm", algo.value)))
-    return algo
+    return algo, plan
 
 
 def _select(
@@ -169,11 +190,11 @@ def _select(
     cfg: ACCLConfig,
     requested: Optional[Algorithm] = None,
     count: Optional[int] = None,
-) -> Algorithm:
+):
     algo = requested or cfg.algorithm
     if algo != Algorithm.AUTO:
         if supported(op, algo):
-            return algo
+            return algo, None
         if requested is not None:
             raise ValueError(f"{algo} not supported for {op.name}")
         # a global cfg.algorithm preference that this op cannot honor falls
@@ -192,15 +213,15 @@ def _select(
                 algo.name, op.name)
     world = comm.world_size
     if world == 1:
-        return Algorithm.XLA
+        return Algorithm.XLA, None
     legacy = _select_legacy(op, nbytes, comm, cfg, count)
     if op in synth.SYNTH_OPS:
         # second stage: the schedule synthesizer may upgrade the ladder's
         # decision to the multi-axis torus decomposition (cached per
         # (op, topology, size-bucket); legacy seeds stay binding)
-        return synth.resolve(op, nbytes, comm, cfg, legacy,
-                             count=count).algorithm
-    return legacy
+        plan = synth.resolve(op, nbytes, comm, cfg, legacy, count=count)
+        return plan.algorithm, plan
+    return legacy, None
 
 
 def _select_legacy(
@@ -404,23 +425,28 @@ def build_reduce(comm, root: int, func: reduceFunction, dt: dataType,
 
 
 def _multiaxis_shape(comm, mesh_shape) -> tuple:
-    """(rows, cols) for an explicit/resolved MULTIAXIS build: the caller
-    passes the synthesizer's resolved torus shape when it has one; a
-    direct build without one falls back to the most-square split (the
-    ``_hier_shape`` discipline for explicit requests) and fails loudly
-    on prime worlds."""
+    """The axes tuple for an explicit/resolved MULTIAXIS build — any
+    rank >= 2 (a declared ``(2, 2, 2)`` dispatches a real 3-axis
+    decomposition): the caller passes the synthesizer's resolved torus
+    shape when it has one; a direct build without one falls back to the
+    most-square 2-D split (the ``_hier_shape`` discipline for explicit
+    requests) and fails loudly on prime worlds."""
     if mesh_shape is not None:
-        rows, cols = int(mesh_shape[0]), int(mesh_shape[1])
-        if rows * cols != comm.world_size:
+        axes = tuple(int(s) for s in mesh_shape)
+        p = 1
+        for s in axes:
+            p *= s
+        if p != comm.world_size:
             raise ValueError(
-                f"mesh_shape {rows}x{cols} != world {comm.world_size}")
-        return rows, cols
+                f"mesh_shape {'x'.join(map(str, axes))} != world "
+                f"{comm.world_size}")
+        return axes
     shape = hierarchical.factor2d(comm.world_size)
     if shape is None:
         raise ValueError(
             "multiaxis collective needs a composite world with a 2-D "
             f"torus factorization, got world={comm.world_size}")
-    return shape
+    return tuple(shape)
 
 
 def build_allreduce(comm, func: reduceFunction, dt: dataType, algo: Algorithm,
@@ -429,11 +455,12 @@ def build_allreduce(comm, func: reduceFunction, dt: dataType, algo: Algorithm,
                     fanin: int = 0,
                     bidirectional: bool = False,
                     on_dcn: bool = False,
-                    mesh_shape=None) -> Callable:
+                    mesh_shape=None,
+                    pipeline_chunks: int = 1) -> Callable:
     if algo == Algorithm.MULTIAXIS:
-        rows, cols = _multiaxis_shape(comm, mesh_shape)
-        return synth.build_multiaxis_allreduce(comm, rows, cols, func, dt,
-                                               arith)
+        axes = _multiaxis_shape(comm, mesh_shape)
+        return synth.build_multiaxis_allreduce(
+            comm, axes, func, dt, arith, pipeline_chunks=pipeline_chunks)
     if algo == Algorithm.PALLAS:
         return pallas_ring.build_pallas_ring_allreduce(
             comm, func, dt, segment_bytes, arith=arith,
@@ -579,10 +606,12 @@ def build_allgather(comm, algo: Algorithm,
                     dt: dataType,
                     segment_bytes: Optional[int] = None,
                     bidirectional: bool = False,
-                    mesh_shape=None) -> Callable:
+                    mesh_shape=None,
+                    pipeline_chunks: int = 1) -> Callable:
     if algo == Algorithm.MULTIAXIS:
-        rows, cols = _multiaxis_shape(comm, mesh_shape)
-        return synth.build_multiaxis_allgather(comm, rows, cols, arith)
+        axes = _multiaxis_shape(comm, mesh_shape)
+        return synth.build_multiaxis_allgather(
+            comm, axes, arith, pipeline_chunks=pipeline_chunks)
     if algo == Algorithm.PALLAS:
         return pallas_ring.build_pallas_ring_allgather(
             comm, dt, segment_bytes, arith=arith,
@@ -597,11 +626,12 @@ def build_reduce_scatter(comm, func: reduceFunction, dt: dataType,
                          arith: Optional[ArithConfig],
                          segment_bytes: Optional[int] = None,
                          bidirectional: bool = False,
-                         mesh_shape=None) -> Callable:
+                         mesh_shape=None,
+                         pipeline_chunks: int = 1) -> Callable:
     if algo == Algorithm.MULTIAXIS:
-        rows, cols = _multiaxis_shape(comm, mesh_shape)
-        return synth.build_multiaxis_reduce_scatter(comm, rows, cols, func,
-                                                    dt, arith)
+        axes = _multiaxis_shape(comm, mesh_shape)
+        return synth.build_multiaxis_reduce_scatter(
+            comm, axes, func, dt, arith, pipeline_chunks=pipeline_chunks)
     if algo == Algorithm.PALLAS:
         return pallas_ring.build_pallas_ring_reduce_scatter(
             comm, func, dt, segment_bytes, arith=arith,

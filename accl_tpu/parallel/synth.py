@@ -59,8 +59,19 @@ from ..obs import metrics as _metrics
 SYNTH_OPS = (operation.allreduce, operation.allgather,
              operation.reduce_scatter)
 
-#: candidate shape names (the ``shape`` label of the plan counters)
-SHAPES = ("xla", "flat", "tree", "ring", "kring", "multiaxis", "hier")
+#: candidate shape names (the ``shape`` label of the plan counters) —
+#: ``pipeline`` is the chunk-pipelined multi-axis schedule (same
+#: Algorithm.MULTIAXIS builders, payload split into
+#: ``sched_pipeline_chunks`` chunks whose per-axis legs overlap)
+SHAPES = ("xla", "flat", "tree", "ring", "kring", "multiaxis", "pipeline",
+          "hier")
+
+
+def _prod(axes) -> int:
+    p = 1
+    for s in axes:
+        p *= int(s)
+    return p
 
 
 # ---------------------------------------------------------------------------
@@ -154,7 +165,7 @@ def degraded_reason(comm, cfg: ACCLConfig) -> Optional[str]:
     if getattr(comm, "degraded_from", None) is None:
         return None
     ms = cfg.sched_mesh_shape
-    if ms and int(ms[0]) * int(ms[1]) != comm.world_size:
+    if ms and _prod(ms) != comm.world_size:
         return "declared_shape_mismatch"
     if _coords_degraded(getattr(comm, "_devices", None) or comm.devices):
         return "holed_grid"
@@ -180,24 +191,32 @@ def _coords_shape_cached(comm) -> Optional[Tuple[int, int]]:
 
 
 def torus_shape(comm, cfg: ACCLConfig,
-                allow_factor2d: bool = False) -> Optional[Tuple[int, int]]:
-    """The (rows, cols) torus factorization the multi-axis builders run
-    on: an explicit ``cfg.sched_mesh_shape`` wins (the emulated-topology
-    declaration), else the device-coordinate grid, else — only for
-    EXPLICIT ``Algorithm.MULTIAXIS`` requests (``allow_factor2d``) — the
+                allow_factor2d: bool = False) -> Optional[Tuple[int, ...]]:
+    """The torus factorization the multi-axis builders run on — an axes
+    tuple of ANY rank >= 2: an explicit ``cfg.sched_mesh_shape`` wins
+    (the emulated-topology declaration; a DECLARED ``[2, 2, 2]``
+    dispatches a real 3-axis decomposition), else the device-coordinate
+    grid (2-D only: :func:`_coords_shape` refuses to infer a second
+    axis from a 3-D slice), else — only for EXPLICIT
+    ``Algorithm.MULTIAXIS`` requests (``allow_factor2d``) — the
     most-square factorization, mirroring ``_hier_shape``'s fallback.
     AUTO never invents a torus: with neither declaration nor coords the
     mesh is treated as single-axis and the legacy ladder stands."""
     ms = cfg.sched_mesh_shape
     if ms:
-        rows, cols = int(ms[0]), int(ms[1])
-        if rows * cols == comm.world_size:
-            return (rows, cols)
+        axes = tuple(int(s) for s in ms)
+        if len(axes) < 2 or any(s < 2 for s in axes):
+            raise ValueError(
+                f"sched_mesh_shape needs >=2 axes of extent >=2, got "
+                f"{list(axes)}")
+        if _prod(axes) == comm.world_size:
+            return axes
         if getattr(comm, "parent", None) is None:
             # the declaration targets this (top-level) comm and is wrong:
             # fail loudly rather than silently running single-axis
             raise ValueError(
-                f"sched_mesh_shape {rows}x{cols} != world {comm.world_size}")
+                f"sched_mesh_shape {'x'.join(map(str, axes))} != world "
+                f"{comm.world_size}")
         # a sub-communicator: the declaration describes the GLOBAL mesh,
         # not this group — fall through to coords / single-axis
     shape = _coords_shape_cached(comm)
@@ -263,7 +282,11 @@ class ScheduleStep:
     per-rank sequential hop count the cost model charges; ``link_bytes``
     the traffic through the busiest link; ``channels`` the concurrently
     driven link directions. ``deps`` are indices of steps that must
-    complete first."""
+    complete first. ``chunk`` is the pipeline-chunk index for chunked
+    multi-axis schedules (None = the step operates on the whole
+    payload): the validator runs the ownership algebra once per chunk,
+    so cross-chunk aliasing — a step folding another chunk's phase —
+    is a hard error, not an accounting blur."""
 
     index: int
     kind: str                    # reduce_scatter | all_gather | allreduce
@@ -274,6 +297,7 @@ class ScheduleStep:
     link_bytes: float
     channels: int
     deps: Tuple[int, ...]
+    chunk: Optional[int] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -390,16 +414,11 @@ def _gen_flat(op, topo: Topology, N: int, model: CostModel):
     return SchedulePlan(op, "flat", Algorithm.FLAT, topo, steps, cost, "")
 
 
-def _gen_multiaxis(op, topo: Topology, N: int, model: CostModel):
-    """Axis-by-axis torus decomposition (arxiv 2404.15888): reduce-
-    scatter down every axis in order (payload shrinking by sᵢ each
-    leg), then all-gather back up in reverse — allreduce composes both
-    sweeps, allgather/reduce_scatter take one.  Per-axis leg i moves
-    Mᵢ·(sᵢ−1)/sᵢ through that AXIS's links only — the busiest link
-    carries N·(s₀−1)/s₀ < N·(P−1)/P of the flat ring, and the hop count
-    is Σ(sᵢ−1) < P−1."""
-    if not topo.multi_axis:
-        return None
+def _multiaxis_phase_specs(op, topo: Topology, N: int):
+    """The per-axis phase list of the multi-axis decomposition, shared
+    by the sequential (:func:`_gen_multiaxis`) and chunk-pipelined
+    (:func:`_gen_pipeline`) candidates — one source of truth for what
+    each leg moves and charges."""
     k = 2 if topo.bidirectional else 1
     rs_specs, ag_specs = [], []
     m = float(N)
@@ -414,15 +433,69 @@ def _gen_multiaxis(op, topo: Topology, N: int, model: CostModel):
         ag_specs.append(("all_gather", ax, s, s - 1, m * (s - 1), k))
         m *= s
     if op == operation.allreduce:
-        specs = rs_specs + ag_specs
-    elif op == operation.allgather:
-        specs = ag_specs
-    else:
-        specs = rs_specs
+        return rs_specs + ag_specs
+    if op == operation.allgather:
+        return ag_specs
+    return rs_specs
+
+
+def _gen_multiaxis(op, topo: Topology, N: int, model: CostModel):
+    """Axis-by-axis torus decomposition (arxiv 2404.15888): reduce-
+    scatter down every axis in order (payload shrinking by sᵢ each
+    leg), then all-gather back up in reverse — allreduce composes both
+    sweeps, allgather/reduce_scatter take one.  Per-axis leg i moves
+    Mᵢ·(sᵢ−1)/sᵢ through that AXIS's links only — the busiest link
+    carries N·(s₀−1)/s₀ < N·(P−1)/P of the flat ring, and the hop count
+    is Σ(sᵢ−1) < P−1."""
+    if not topo.multi_axis:
+        return None
+    specs = _multiaxis_phase_specs(op, topo, N)
     steps, cost = _mk_steps(specs, model)
     return SchedulePlan(
         op, "multiaxis", Algorithm.MULTIAXIS, topo, steps, cost, "",
         params=(("shape2d", tuple(topo.axes)),))
+
+
+def _gen_pipeline(op, topo: Topology, N: int, model: CostModel,
+                  chunks: int, startup_us: float):
+    """Chunk-pipelined multi-axis schedule (the wafer-scale-reduce
+    overlap win, arxiv 2404.15888): the payload splits into ``chunks``
+    pieces, each running the full per-axis phase chain, and chunk c's
+    phase k+1 leg overlaps chunk c+1's phase k leg — the phases ride
+    DIFFERENT axes' links, so the second axis works exactly when the
+    sequential schedule would leave it idle.  The step DAG carries the
+    per-chunk dependencies (intra-chunk phase order + the same-phase
+    link-occupancy edge to the previous chunk); predicted cost is the
+    steady-state pipeline makespan
+    ``max(phase costs) + (chunks-1)·startup`` — every non-bottleneck
+    phase hides under the bottleneck phase's wire time, and each extra
+    chunk pays one pipeline-fill ``startup_us`` (calibrated on real ICI
+    by ``bench.autotune_sched_synth``) — vs the sequential candidate's
+    ``sum(phase costs)``."""
+    if not topo.multi_axis or chunks < 2:
+        return None
+    specs = _multiaxis_phase_specs(op, topo, N)
+    n_ph = len(specs)
+    steps: List[ScheduleStep] = []
+    for c in range(chunks):
+        for k, (kind, axis, group, hops, link_bytes, channels) \
+                in enumerate(specs):
+            deps = []
+            if k:
+                deps.append(c * n_ph + k - 1)      # my previous phase
+            if c:
+                deps.append((c - 1) * n_ph + k)    # phase k's links free
+            steps.append(ScheduleStep(
+                index=c * n_ph + k, kind=kind, axis=axis, group=group,
+                hops=hops, link_bytes=float(link_bytes) / chunks,
+                channels=channels, deps=tuple(deps), chunk=c))
+    phase_costs = [model.step_us(hops, link_bytes, channels)
+                   for (_, _, _, hops, link_bytes, channels) in specs]
+    cost = max(phase_costs) + (chunks - 1) * float(startup_us)
+    return SchedulePlan(
+        op, "pipeline", Algorithm.MULTIAXIS, topo, tuple(steps), cost, "",
+        params=(("shape2d", tuple(topo.axes)),
+                ("pipeline_chunks", int(chunks))))
 
 
 def _latency_plan(op: operation, topo: Topology, nbytes: int,
@@ -472,6 +545,8 @@ def candidates(op: operation, topo: Topology, nbytes: int,
     N = _payload_total(op, nbytes, topo.world)
     out = [_gen_xla(op, topo, N, model),
            _gen_multiaxis(op, topo, N, model),
+           _gen_pipeline(op, topo, N, model, cfg.sched_pipeline_chunks,
+                         cfg.sched_pipeline_startup_us),
            _gen_hier(op, topo, N, model),
            _gen_ring(op, topo, N, model, 1, "ring", Algorithm.RING),
            (_gen_ring(op, topo, N, model, 2, "kring", Algorithm.RING)
@@ -518,6 +593,42 @@ def _plan_for_algo(algo: Algorithm, op: operation, topo: Topology,
     return p
 
 
+def _full_authority_plan(op: operation, topo: Topology, nbytes: int,
+                         cfg: ACCLConfig) -> SchedulePlan:
+    """The ``sched_full_authority`` resolution: the argmin of predicted
+    α-β cost over the WHOLE candidate family for this (op, topology,
+    payload) — no threshold ladder, no seed pins, no separate latency
+    tier (the small-payload flip to flat/tree falls out of the same
+    search).  One execution-mapping rule: the ring-family shapes run
+    the Pallas RDMA-over-ICI kernels on real chip links (the perf core
+    the legacy ladder routed large ICI payloads to) and the plain
+    ppermute ring elsewhere — the cost model prices the schedule shape,
+    the transport picks its implementation."""
+    cands = [p for p in candidates(op, topo, nbytes, cfg)
+             if p.shape != "xla"]
+    # With the ladder retired, the model must carry the fact the ladder
+    # measured: XLA's fused single shot is latency-optimal but does NOT
+    # counter-rotate segment parities, so its bandwidth term runs one
+    # link direction — exactly the 2x the explicit chunked rings buy
+    # (the reason ring_threshold existed). Priced here only; the
+    # legacy-compatible costing elsewhere keeps the ladder-deferring
+    # paths byte-identical.
+    model = CostModel.from_config(cfg, topo.transport)
+    N = _payload_total(op, nbytes, topo.world)
+    cands.append(_gen_xla(
+        op, dataclasses.replace(topo, bidirectional=False), N, model))
+    best = min(cands, key=lambda p: p.predicted_us)
+    if best.shape == "xla":
+        # restore the live topology on the winning plan (the
+        # single-direction costing is a pricing device, not a claim
+        # about the mesh)
+        best = dataclasses.replace(best, topology=topo)
+    if (best.shape in ("ring", "kring")
+            and topo.transport == TransportBackend.ICI):
+        best = dataclasses.replace(best, algorithm=Algorithm.PALLAS)
+    return best
+
+
 # ---------------------------------------------------------------------------
 # plan resolution (cached; the select() hook)
 # ---------------------------------------------------------------------------
@@ -543,8 +654,18 @@ def _seed_overridden(op: operation, cfg: ACCLConfig) -> bool:
                for f in _SEED_FIELDS.get(op, ()))
 
 
+#: memoized plan store — an insertion-ordered dict used as an LRU bound
+#: by :data:`_PLAN_CACHE_MAX` (a long-lived serving session resolving
+#: many (op, topology, bucket, seeds) keys must not grow it without
+#: limit — the ProgramCache discipline); hit/miss/evict tallies live
+#: beside the metrics counters so ``ACCL.stats()`` can report them
+#: without a metrics scan
 _plan_cache: Dict[tuple, SchedulePlan] = {}
 _plan_lock = threading.Lock()
+_PLAN_CACHE_MAX = 4096
+_plan_hits = 0
+_plan_misses = 0
+_plan_evictions = 0
 
 #: session epoch baked into every plan-cache key: bumped by
 #: ``ACCL.recover()`` so a plan synthesized before a rank death is
@@ -562,21 +683,54 @@ def set_session_epoch(epoch: int) -> None:
 
 
 def reset_plan_cache() -> None:
-    """Session hook (``ACCL.initialize()``): drop every cached plan so a
-    fresh session re-synthesizes under its own config."""
+    """Session hook (``ACCL.initialize()``): drop every cached plan —
+    and the per-config fingerprint memo — so a fresh session
+    re-synthesizes under its own config."""
+    global _plan_hits, _plan_misses, _plan_evictions
     with _plan_lock:
         _plan_cache.clear()
+        _plan_hits = _plan_misses = _plan_evictions = 0
+        _fp_cache.clear()
 
 
-def plan_cache_stats() -> Tuple[int, ...]:
+def plan_cache_stats() -> Dict[str, int]:
+    """Synth plan-cache introspection for ``ACCL.stats()`` — the
+    program-cache shape: live size, LRU bound, and the session's
+    hit/miss/evict tallies (the same events the
+    ``accl_sched_plan_cache_total`` counter exports)."""
     with _plan_lock:
-        return (len(_plan_cache),)
+        return {"plans": len(_plan_cache), "max_size": _PLAN_CACHE_MAX,
+                "hits": _plan_hits, "misses": _plan_misses,
+                "evictions": _plan_evictions}
+
+
+#: per-config memo of :func:`_cost_fingerprint` — the tuple build walks
+#: nine config fields and sits on the per-op dispatch path (every
+#: ``resolve()`` call), so it is computed once per config OBJECT per
+#: session. Keyed by id() with the config kept strongly referenced, so
+#: a recycled id can never alias a dead config; bounded (cleared at
+#: _FP_CACHE_MAX and by reset_plan_cache). Configs are value objects —
+#: every mutation path in the repo goes through ``ACCLConfig.replace``
+#: / the ``ACCL.config`` setter, which produce fresh objects; mutating
+#: a cost field IN PLACE on a config that already resolved a plan is
+#: unsupported (the seeds tuple in the resolve key is re-read each
+#: call and stays exact either way).
+_fp_cache: Dict[int, Tuple[ACCLConfig, tuple]] = {}
+_FP_CACHE_MAX = 256
 
 
 def _cost_fingerprint(cfg: ACCLConfig) -> tuple:
-    return (cfg.sched_synthesis, cfg.sched_alpha_us, cfg.sched_beta_gbps,
-            cfg.sched_dcn_alpha_us, cfg.sched_dcn_beta_gbps,
-            cfg.latency_tier_threshold)
+    entry = _fp_cache.get(id(cfg))
+    if entry is not None and entry[0] is cfg:
+        return entry[1]
+    fp = (cfg.sched_synthesis, cfg.sched_alpha_us, cfg.sched_beta_gbps,
+          cfg.sched_dcn_alpha_us, cfg.sched_dcn_beta_gbps,
+          cfg.latency_tier_threshold, cfg.sched_pipeline_chunks,
+          cfg.sched_pipeline_startup_us, cfg.sched_full_authority)
+    if len(_fp_cache) >= _FP_CACHE_MAX:
+        _fp_cache.clear()
+    _fp_cache[id(cfg)] = (cfg, fp)
+    return fp
 
 
 def resolve(op: operation, nbytes: int, comm, cfg: ACCLConfig,
@@ -595,9 +749,18 @@ def resolve(op: operation, nbytes: int, comm, cfg: ACCLConfig,
       the α-dominated small-message tier, where the latency family
       (flat / tree / xla log-depth) is searched on any topology
       (:func:`_latency_plan`, source ``latency_tier``) — OR the
-      topology has ≥ 2 axes (declared or coordinate-detected) and the
-      multi-axis candidate's predicted α-β cost beats the legacy
-      family's.
+      topology has ≥ 2 axes (declared or coordinate-detected; a
+      DECLARED 3-axis shape dispatches a real 3-axis decomposition)
+      and the multi-axis candidate — sequential or chunk-PIPELINED
+      (``cfg.sched_pipeline_chunks``; the pipelined shape wins exactly
+      where ``max(phase costs) + (chunks-1)·startup`` undercuts the
+      sequential sum) — beats the legacy family's predicted α-β cost.
+
+    ``cfg.sched_full_authority`` (default off) short-circuits the seed
+    and tier rules: the argmin over the whole candidate family decides
+    per size bucket on EVERY non-DCN topology, single-axis included
+    (source ``full_authority`` — the "synthesis becomes the only
+    scheduler" migration switch).
 
     Everything else returns the legacy decision wrapped in its plan —
     so meshes with default config resolve EXACTLY as before the
@@ -615,12 +778,22 @@ def resolve(op: operation, nbytes: int, comm, cfg: ACCLConfig,
     in_latency_tier = nbytes < cfg.latency_tier_threshold
     key = (op, topo, _metrics.size_bucket(nbytes), in_latency_tier,
            legacy, seeds, _cost_fingerprint(cfg), _session_epoch)
+    global _plan_hits, _plan_misses, _plan_evictions
     with _plan_lock:
         plan = _plan_cache.get(key)
+        if plan is not None:
+            _plan_hits += 1
+            # refresh recency (dicts iterate in insertion order, so the
+            # eviction below pops the least-recently-USED key only if
+            # hits re-insert — the ProgramCache move_to_end discipline)
+            del _plan_cache[key]
+            _plan_cache[key] = plan
     if plan is not None:
         _metrics.inc("accl_sched_plan_cache_total",
                      labels=(("event", "hit"),))
         return plan
+    with _plan_lock:
+        _plan_misses += 1
     _metrics.inc("accl_sched_plan_cache_total", labels=(("event", "miss"),))
     if not topo.multi_axis:
         # survivor-subset honesty: when this mesh HAD torus structure and
@@ -638,6 +811,15 @@ def resolve(op: operation, nbytes: int, comm, cfg: ACCLConfig,
             or op not in SYNTH_OPS):
         plan = dataclasses.replace(
             _plan_for_algo(legacy, op, topo, nbytes, cfg), source="legacy")
+    elif cfg.sched_full_authority:
+        # full authority (the migration switch): the per-size-bucket
+        # argmin over the WHOLE candidate family retires the scalar
+        # ladder on every topology — single-axis included — and seeds
+        # no longer pin (the ladder they seed is retired with them).
+        # The DCN guard above still outranks the flag.
+        plan = dataclasses.replace(
+            _full_authority_plan(op, topo, nbytes, cfg),
+            source="full_authority")
     elif in_latency_tier and not _seed_overridden(op, cfg):
         # the small-message latency tier: α dominates, so the cost model
         # searches the latency family (flat/tree/xla) on ANY topology —
@@ -653,19 +835,33 @@ def resolve(op: operation, nbytes: int, comm, cfg: ACCLConfig,
         plan = dataclasses.replace(
             _plan_for_algo(legacy, op, topo, nbytes, cfg), source="override")
     else:
+        # the multi-axis window: sequential decomposition and the
+        # chunk-pipelined variant compete against the legacy family —
+        # strict improvement required, checked in (multiaxis, pipeline)
+        # order, so the pipelined candidate wins exactly where
+        # max(phase costs) + (chunks-1)·startup < sum(phase costs)
+        # (ties keep the simpler schedule)
         legacy_plan = _plan_for_algo(legacy, op, topo, nbytes, cfg)
-        multi = _gen_multiaxis(
-            op, topo, _payload_total(op, nbytes, topo.world),
-            CostModel.from_config(cfg, topo.transport))
-        if (multi is not None and len(topo.axes) == 2
-                and multi.predicted_us < legacy_plan.predicted_us):
-            plan = dataclasses.replace(multi, source="cost_model")
-        else:
-            plan = dataclasses.replace(legacy_plan, source="cost_model")
+        model = CostModel.from_config(cfg, topo.transport)
+        N = _payload_total(op, nbytes, topo.world)
+        best = legacy_plan
+        for cand in (_gen_multiaxis(op, topo, N, model),
+                     _gen_pipeline(op, topo, N, model,
+                                   cfg.sched_pipeline_chunks,
+                                   cfg.sched_pipeline_startup_us)):
+            if cand is not None and cand.predicted_us < best.predicted_us:
+                best = cand
+        plan = dataclasses.replace(best, source="cost_model")
     _metrics.inc("accl_sched_plan_total",
                  labels=(("op", op.name), ("shape", plan.shape),
                          ("source", plan.source)))
     with _plan_lock:
+        if key not in _plan_cache and len(_plan_cache) >= _PLAN_CACHE_MAX:
+            evicted = next(iter(_plan_cache))
+            del _plan_cache[evicted]
+            _plan_evictions += 1
+            _metrics.inc("accl_sched_plan_cache_total",
+                         labels=(("event", "evict"),))
         _plan_cache[key] = plan
     return plan
 
@@ -697,7 +893,9 @@ def _axis_groups(axes: Sequence[int], axis: Optional[int],
 def _expected_hops(shape: str, kind: str, group: int) -> int:
     """What the cost model must have charged for one step of this shape
     — the validator's independent recomputation."""
-    if shape in ("ring", "kring", "multiaxis"):
+    if shape in ("ring", "kring", "multiaxis", "pipeline"):
+        # a pipeline chunk's leg walks the same per-axis ring as the
+        # sequential schedule — chunking splits bytes, never hops
         return group - 1
     if shape == "flat":
         return 1
@@ -716,14 +914,16 @@ def validate_plan(plan: SchedulePlan) -> None:
     2. running the ownership algebra over the steps covers each
        (chunk, rank) requirement EXACTLY once — no chunk is delivered
        twice, no contribution is folded twice, and the final state
-       matches the op's contract;
+       matches the op's contract. For chunk-PIPELINED plans the algebra
+       runs once per pipeline chunk over exactly that chunk's steps —
+       each (chunk, axis-phase) must appear exactly once and in phase
+       order, so a step folding another chunk's payload (cross-chunk
+       aliasing), a repeated phase (double fold), or a chunk delivered
+       out of phase order all fail its own chunk's algebra;
     3. every step's hop count matches the cost model's charge for its
        shape (α drift is a bug, not a tuning artifact).
 
     Raises ``ValueError`` with a specific message on any violation."""
-    topo, P = plan.topology, plan.topology.world
-    axes = topo.axes
-
     # -- 1. dependency DAG ------------------------------------------------
     order: List[int] = []
     done: set = set()
@@ -747,6 +947,35 @@ def validate_plan(plan: SchedulePlan) -> None:
                 f"hops {s.hops} != cost-model {want}")
 
     # -- 2. ownership algebra --------------------------------------------
+    chunk_ids = sorted({s.chunk for s in plan.steps}, key=lambda c: (c is
+                                                                     None, c))
+    if chunk_ids == [None]:
+        _validate_ownership(plan, order, steps)
+        return
+    if None in chunk_ids:
+        raise ValueError(
+            "mixed chunked and unchunked steps in one plan: a pipeline "
+            "phase outside every chunk's algebra is unaccountable")
+    declared = plan.param("pipeline_chunks")
+    if declared is not None and chunk_ids != list(range(int(declared))):
+        raise ValueError(
+            f"pipeline chunks {chunk_ids} != declared range of "
+            f"{declared}: a missing or duplicated chunk lane")
+    for c in chunk_ids:
+        sub_order = [i for i in order if steps[i].chunk == c]
+        try:
+            _validate_ownership(plan, sub_order, steps)
+        except ValueError as e:
+            raise ValueError(f"pipeline chunk {c}: {e}") from None
+
+
+def _validate_ownership(plan: SchedulePlan, order: Sequence[int],
+                        steps: Dict[int, ScheduleStep]) -> None:
+    """The ownership-algebra half of :func:`validate_plan`, run over one
+    payload lane (the whole plan, or a single pipeline chunk's steps in
+    the DAG's topological order)."""
+    topo, P = plan.topology, plan.topology.world
+    axes = topo.axes
     # state[r] maps chunk -> (frozenset of folded source ranks, times the
     # fully-formed chunk was DELIVERED to r). Chunks are the P-way
     # decomposition; owner(chunk c) == rank c (the flat convention the
@@ -859,133 +1088,398 @@ def validate_plan(plan: SchedulePlan) -> None:
 
 # ---------------------------------------------------------------------------
 # multi-axis program builders — the whole synthesized schedule traced
-# into ONE shard_map program (the cmdlist one-launch discipline)
+# into ONE shard_map program (the cmdlist one-launch discipline),
+# generalized to N axes and optionally chunk-pipelined
 # ---------------------------------------------------------------------------
 
-def build_multiaxis_allreduce(comm, rows: int, cols: int,
-                              func: reduceFunction, dt: dataType,
-                              arith=None) -> Callable:
-    """Axis-by-axis torus allreduce: reduce-scatter down the column
-    axis, reduce-scatter down the row axis on the shard, then the dual
-    all-gathers back up — four per-axis XLA collectives over the true
-    2-D mesh, compiled as one launch. Per-link traffic N(c−1)/c on the
-    heavy axis (vs N(P−1)/P for the flat ring) at Σ(sᵢ−1) hops per
-    sweep."""
+def _norm_axes(comm, axes) -> Tuple[int, ...]:
+    axes = tuple(int(s) for s in axes)
+    if len(axes) < 2:
+        raise ValueError(f"multiaxis builders need >=2 axes, got {axes}")
+    if _prod(axes) != comm.world_size:
+        raise ValueError(
+            f"{'x'.join(map(str, axes))} != world {comm.world_size}")
+    return axes
+
+
+def _axis_names(nd: int) -> Tuple[str, ...]:
+    """Mesh axis names for an N-D program. The 2-D names stay the
+    hierarchical pair (stable HLO for the AOT schedule pins); deeper
+    declarations extend the family."""
+    from .hierarchical import COL_AXIS, ROW_AXIS
+    if nd == 2:
+        return (ROW_AXIS, COL_AXIS)
+    return tuple(f"accl_ax{i}" for i in range(nd))
+
+
+def _smapnd(comm, axes: Tuple[int, ...], body) -> Callable:
+    """jit(reshape -> shard_map over the N-D mesh -> reshape back) — the
+    ``_smap2d`` discipline at any rank: ONE compiled launch regardless
+    of how many per-axis phases (or pipeline chunks) the body traces."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..compat import shard_map
+
+    names = _axis_names(len(axes))
+    mesh = comm.meshnd(axes, names)
+    inner = shard_map(
+        body, mesh=mesh,
+        in_specs=P(*names, None),
+        out_specs=P(*names, None),
+    )
+    world = _prod(axes)
+
+    @jax.jit
+    def prog(x):  # x: (world, n) sharded along the 1-D communicator axis
+        n = x.shape[-1]
+        out = inner(x.reshape(axes + (n,)))
+        return out.reshape(world, -1)
+
+    return prog
+
+
+def _wavefront(parts: list, phases: list) -> list:
+    """Trace the per-chunk phase chains in PIPELINED (wavefront) order:
+    at wave w, chunk c runs its phase w-c — so chunk c's axis-k+1 leg
+    is issued right after chunk c+1's axis-k leg, the instruction order
+    XLA's scheduler overlaps (the chunks carry no cross-chunk data
+    dependency, so the per-axis collectives of different chunks ride
+    their own axes' links concurrently). Order is observable in the
+    emitted HLO only; the dataflow — and therefore the result — is
+    bit-identical to running each chunk's chain sequentially."""
+    states = list(parts)
+    n_ph = len(phases)
+    for wave in range(n_ph + len(states) - 1):
+        for c in range(len(states)):
+            k = wave - c
+            if 0 <= k < n_ph:
+                states[c] = phases[k](states[c])
+    return states
+
+
+def build_multiaxis_allreduce(comm, axes, func: reduceFunction,
+                              dt: dataType, arith=None,
+                              pipeline_chunks: int = 1) -> Callable:
+    """Axis-by-axis torus allreduce over an N-axis declaration:
+    reduce-scatter down the last axis, then each previous axis on the
+    shrinking shard, then the dual all-gathers back up — 2N per-axis
+    XLA collectives over the true N-D mesh, compiled as one launch.
+    Per-link traffic N(s−1)/s on the heavy axis (vs N(P−1)/P for the
+    flat ring) at Σ(sᵢ−1) hops per sweep.  ``pipeline_chunks`` > 1
+    splits the payload into chunks whose phase chains are traced in
+    wavefront order (chunk c's axis-k+1 leg beside chunk c+1's axis-k
+    leg) — same one-launch program, same bits, overlapped wire time."""
     import jax.numpy as jnp
     from jax import lax
 
     from .. import ops
-    from .hierarchical import COL_AXIS, ROW_AXIS, _smap2d
     from .primitives import _unwire, _wire
 
-    if rows * cols != comm.world_size:
-        raise ValueError(f"{rows}x{cols} != world {comm.world_size}")
-    world = rows * cols
+    axes = _norm_axes(comm, axes)
+    nd, world = len(axes), _prod(axes)
+    names = _axis_names(nd)
+    C = max(1, int(pipeline_chunks))
     decompress_arith = (arith is not None and arith.decompress_before_arith)
 
-    def body(v):  # (1, 1, n)
-        n = v.shape[-1]
-        pad = (-n) % world
-        x = jnp.pad(v[0, 0], (0, pad))
-        w = _wire(x, arith)
+    # per-chunk phase chain: phases[0] wires, the middle phases are the
+    # per-axis legs, the last unwires — composition is the sequential
+    # 2-D body generalized to N axes (scatter LAST axis first, gather
+    # back in reverse; bit-identical per element at any chunking)
+    def _phases(out_dtype, x_dtype):
+        ph = [lambda t: _wire(t, arith)]
         if func == reduceFunction.SUM and not decompress_arith:
-            s1 = lax.psum_scatter(w.reshape(cols, -1), COL_AXIS,
-                                  scatter_dimension=0, tiled=False)
-            s2 = lax.psum_scatter(s1.reshape(rows, -1), ROW_AXIS,
-                                  scatter_dimension=0, tiled=False)
-            g1 = lax.all_gather(s2, ROW_AXIS, tiled=True)
-            full = lax.all_gather(g1, COL_AXIS, tiled=True)
-            out = _unwire(full, arith, v.dtype)
+            for ax in reversed(range(nd)):
+                ph.append(lambda t, ax=ax: lax.psum_scatter(
+                    t.reshape(axes[ax], -1), names[ax],
+                    scatter_dimension=0, tiled=False))
+            for ax in range(nd):
+                ph.append(lambda t, ax=ax: lax.all_gather(
+                    t, names[ax], tiled=True))
         elif func == reduceFunction.SUM:
             # decompress-before-arith wires: every hop carries the wire
-            # dtype, every fold runs at full precision (per-axis
-            # chunk exchange + local fold, the hierarchical discipline)
-            sw = lax.all_to_all(w.reshape(cols, -1), COL_AXIS,
-                                split_axis=0, concat_axis=0)
-            shard = ops.reduce_axis0(_unwire(sw, arith, x.dtype), func, dt)
-            sw2 = lax.all_to_all(_wire(shard, arith).reshape(rows, -1),
-                                 ROW_AXIS, split_axis=0, concat_axis=0)
-            shard2 = ops.reduce_axis0(_unwire(sw2, arith, x.dtype), func, dt)
-            g1 = lax.all_gather(_wire(shard2, arith), ROW_AXIS, tiled=True)
-            full = lax.all_gather(g1, COL_AXIS, tiled=True)
-            out = _unwire(full, arith, v.dtype)
+            # dtype, every fold runs at full precision (per-axis chunk
+            # exchange + local fold, the hierarchical discipline)
+            ph = []
+            for ax in reversed(range(nd)):
+                ph.append(lambda t, ax=ax: ops.reduce_axis0(
+                    _unwire(lax.all_to_all(
+                        _wire(t, arith).reshape(axes[ax], -1), names[ax],
+                        split_axis=0, concat_axis=0), arith, x_dtype),
+                    func, dt))
+            ph.append(lambda t: lax.all_gather(_wire(t, arith), names[0],
+                                               tiled=True))
+            for ax in range(1, nd):
+                ph.append(lambda t, ax=ax: lax.all_gather(
+                    t, names[ax], tiled=True))
         elif func == reduceFunction.MAX:
             # max of wire values == wire of max (monotone cast): exact
-            out = _unwire(lax.pmax(lax.pmax(w, COL_AXIS), ROW_AXIS),
-                          arith, v.dtype)
+            for ax in reversed(range(nd)):
+                ph.append(lambda t, ax=ax: lax.pmax(t, names[ax]))
         else:
             raise ValueError(func)
-        return out[:n][None, None, :] if pad else out[None, None, :]
+        ph.append(lambda t: _unwire(t, arith, out_dtype))
+        return ph
 
-    return _smap2d(comm, rows, cols, body)
+    def body(v):  # (1,)*nd + (n,)
+        n = v.shape[-1]
+        x = v.reshape(n)
+        pad = (-n) % (world * C)
+        if pad:
+            x = jnp.pad(x, (0, pad))
+        phases = _phases(v.dtype, x.dtype)
+        parts = list(x.reshape(C, -1)) if C > 1 else [x]
+        outs = _wavefront(parts, phases)
+        out = jnp.concatenate(outs) if C > 1 else outs[0]
+        out = out[:n] if pad else out
+        return out.reshape((1,) * nd + (n,))
+
+    return _smapnd(comm, axes, body)
 
 
-def build_multiaxis_reduce_scatter(comm, rows: int, cols: int,
-                                   func: reduceFunction, dt: dataType,
-                                   arith=None) -> Callable:
+def build_multiaxis_reduce_scatter(comm, axes, func: reduceFunction,
+                                   dt: dataType, arith=None,
+                                   pipeline_chunks: int = 1) -> Callable:
     """Axis-by-axis reduce-scatter: the input's world chunks are
-    pre-permuted so the two per-axis scatters land rank (r, c) exactly
-    its FLAT chunk r·cols+c — the 1-D convention every caller and the
-    flat-ring path share."""
+    pre-permuted so the per-axis scatters land rank (r₀, …, rₙ₋₁)
+    exactly its FLAT chunk (the row-major rank index) — the 1-D
+    convention every caller and the flat-ring path share. Pipeline
+    chunks split each rank's OUTPUT block; chunk c folds the strided
+    input slice that lands in output piece c."""
+    import jax.numpy as jnp
     from jax import lax
 
     from .. import ops
-    from .hierarchical import COL_AXIS, ROW_AXIS, _smap2d
     from .primitives import _unwire, _wire
 
-    if rows * cols != comm.world_size:
-        raise ValueError(f"{rows}x{cols} != world {comm.world_size}")
-    world = rows * cols
+    axes = _norm_axes(comm, axes)
+    nd, world = len(axes), _prod(axes)
+    names = _axis_names(nd)
+    C = max(1, int(pipeline_chunks))
     decompress_arith = (arith is not None and arith.decompress_before_arith)
+    # flat chunk (r0..r_{nd-1}) sits at t[r_{nd-1}, ..., r0] after the
+    # reversal below: scattering the LAST axis first then each previous
+    # one leaves rank (r0..r_{nd-1}) holding exactly its flat chunk
+    perm = tuple(reversed(range(nd))) + (nd,)
 
-    def body(v):  # (1, 1, world*count)
-        x = v[0, 0]
-        count = x.shape[-1] // world
-        # chunk (r, c) of the flat order sits at t[c, r]: after the
-        # column scatter (keep my c) then the row scatter (keep my r),
-        # rank (r, c) holds flat chunk r*cols + c
-        t = x.reshape(rows, cols, count).transpose(1, 0, 2)
-        w = _wire(t, arith)
+    def _phases(out_dtype, x_dtype, pc):
+        prep = [lambda t: _wire(
+            t.reshape(axes + (pc,)).transpose(perm).reshape(axes[-1], -1),
+            arith)]
         if func == reduceFunction.SUM and not decompress_arith:
-            s1 = lax.psum_scatter(w, COL_AXIS, scatter_dimension=0,
-                                  tiled=False)              # (rows, count)
-            out = lax.psum_scatter(s1, ROW_AXIS, scatter_dimension=0,
-                                   tiled=False)             # (count,)
-            out = _unwire(out, arith, v.dtype)
+            ph = prep
+            for ax in reversed(range(nd)):
+                ph.append(lambda t, ax=ax: lax.psum_scatter(
+                    t.reshape(axes[ax], -1), names[ax],
+                    scatter_dimension=0, tiled=False))
+            ph.append(lambda t: _unwire(t, arith, out_dtype))
         else:
             # general path (MAX, decompress-before-arith): per-axis
             # chunk exchange + rank-ordered local fold at full precision
-            sw = lax.all_to_all(w, COL_AXIS, split_axis=0, concat_axis=0)
-            part = ops.reduce_axis0(_unwire(sw, arith, x.dtype), func, dt)
-            sw2 = lax.all_to_all(_wire(part, arith), ROW_AXIS,
-                                 split_axis=0, concat_axis=0)
-            out = ops.reduce_axis0(_unwire(sw2, arith, x.dtype), func, dt)
-            out = out.astype(v.dtype)
-        return out[None, None, :]
+            ph = [lambda t: t.reshape(axes + (pc,)).transpose(perm)
+                  .reshape(axes[-1], -1)]
+            for ax in reversed(range(nd)):
+                ph.append(lambda t, ax=ax: ops.reduce_axis0(
+                    _unwire(lax.all_to_all(
+                        _wire(t, arith).reshape(axes[ax], -1), names[ax],
+                        split_axis=0, concat_axis=0), arith, x_dtype),
+                    func, dt))
+            ph.append(lambda t: t.astype(out_dtype))
+        return ph
 
-    return _smap2d(comm, rows, cols, body)
+    def body(v):  # (1,)*nd + (world*count,)
+        x = v.reshape(-1)
+        count = x.shape[-1] // world
+        pc = -(-count // C)
+        padc = pc * C - count
+        t = x.reshape(world, count)
+        if padc:
+            t = jnp.pad(t, ((0, 0), (0, padc)))
+        phases = _phases(v.dtype, x.dtype, pc)
+        # chunk c's input: piece c of every rank's destined block
+        tc = t.reshape(world, C, pc).transpose(1, 0, 2)  # (C, world, pc)
+        parts = [tc[c].reshape(-1) for c in range(C)]
+        outs = _wavefront(parts, phases)
+        out = jnp.concatenate(outs)[:count] if (C > 1 or padc) else outs[0]
+        return out.reshape((1,) * nd + (count,))
+
+    return _smapnd(comm, axes, body)
 
 
-def build_multiaxis_allgather(comm, rows: int, cols: int,
-                              arith=None) -> Callable:
-    """Axis-by-axis all-gather (the reduce-scatter dual): gather up the
-    row axis, then the column axis, then un-permute so the result is in
-    flat chunk order."""
+def build_multiaxis_allgather(comm, axes, arith=None,
+                              pipeline_chunks: int = 1) -> Callable:
+    """Axis-by-axis all-gather (the reduce-scatter dual): gather up
+    axis 0, then each next axis, then un-permute so the result is in
+    flat chunk order. Pipeline chunks split each rank's input block and
+    re-interleave per destination block on the way out."""
+    import jax.numpy as jnp
     from jax import lax
 
-    from .hierarchical import COL_AXIS, ROW_AXIS, _smap2d
     from .primitives import _unwire, _wire
 
-    if rows * cols != comm.world_size:
-        raise ValueError(f"{rows}x{cols} != world {comm.world_size}")
+    axes = _norm_axes(comm, axes)
+    nd, world = len(axes), _prod(axes)
+    names = _axis_names(nd)
+    C = max(1, int(pipeline_chunks))
+    # gathered leading dims accumulate as (s_{nd-1}, ..., s_0): reverse
+    # them so index (r0, ..., r_{nd-1}) flattens to the flat chunk order
+    perm = tuple(reversed(range(nd))) + (nd,)
 
-    def body(v):  # (1, 1, count) -> (1, 1, world*count)
-        x = v[0, 0]
-        g1 = lax.all_gather(_wire(x, arith), ROW_AXIS)     # (rows, count)
-        g2 = lax.all_gather(g1, COL_AXIS)                  # (cols, rows, ·)
-        out = _unwire(g2, arith, v.dtype)
-        # g2[c, r] is rank (r, c)'s chunk = flat chunk r*cols + c
-        out = out.transpose(1, 0, 2).reshape(-1)
-        return out[None, None, :]
+    def _phases(out_dtype, pc):
+        ph = [lambda t: lax.all_gather(_wire(t, arith), names[0])]
+        for ax in range(1, nd):
+            ph.append(lambda t, ax=ax: lax.all_gather(t, names[ax]))
+        ph.append(lambda t: _unwire(t, arith, out_dtype)
+                  .transpose(perm).reshape(world, pc))
+        return ph
 
-    return _smap2d(comm, rows, cols, body)
+    def body(v):  # (1,)*nd + (count,) -> (1,)*nd + (world*count,)
+        x = v.reshape(-1)
+        count = x.shape[-1]
+        pc = -(-count // C)
+        padc = pc * C - count
+        if padc:
+            x = jnp.pad(x, (0, padc))
+        phases = _phases(v.dtype, pc)
+        parts = list(x.reshape(C, pc))
+        outs = _wavefront(parts, phases)       # each (world, pc)
+        if C > 1 or padc:
+            out = jnp.stack(outs, axis=1).reshape(world, C * pc)
+            out = out[:, :count].reshape(-1)
+        else:
+            out = outs[0].reshape(-1)
+        return out.reshape((1,) * nd + (world * count,))
+
+    return _smapnd(comm, axes, body)
+
+
+# ---------------------------------------------------------------------------
+# plan inspection CLI — `python -m accl_tpu.parallel.synth --explain ...`
+# ---------------------------------------------------------------------------
+
+class _HypotheticalComm:
+    """Just enough communicator surface to drive the REAL resolution
+    path (``_select_legacy`` + :func:`resolve`) for a topology that is
+    not live anywhere: world size, a coordinate-free device list, no
+    parent, no shrink mark, no host alignment."""
+
+    def __init__(self, world: int):
+        self.world_size = int(world)
+        self._devices = [object()] * self.world_size
+        self.parent = None
+        self.degraded_from = None
+
+    @property
+    def devices(self):
+        return list(self._devices)
+
+    def hosts_shape(self):
+        return None
+
+
+def _explain(op_name: str, nbytes: int, shape: str,
+             cfg: ACCLConfig) -> str:
+    """The candidate table for one hypothetical (op, payload, topology):
+    every generator's plan with its cost split into the α (hops) and β
+    (bytes) terms, the argmin marked, and the decision ``resolve()``
+    would actually make under ``cfg`` (source and shape) — so a plan
+    decision is inspectable without a live session."""
+    from . import algorithms
+
+    op = {"allreduce": operation.allreduce,
+          "allgather": operation.allgather,
+          "reduce_scatter": operation.reduce_scatter}.get(op_name)
+    if op is None:
+        raise SystemExit(f"unknown op {op_name!r}: use allreduce | "
+                         "allgather | reduce_scatter")
+    axes = tuple(int(s) for s in shape.lower().split("x"))
+    world = _prod(axes)
+    comm = _HypotheticalComm(world)
+    if len(axes) >= 2:
+        cfg = cfg.replace(sched_mesh_shape=list(axes))
+    topo = topology_of(comm, cfg)
+    model = CostModel.from_config(cfg, topo.transport)
+    cands = sorted(candidates(op, topo, nbytes, cfg),
+                   key=lambda p: p.predicted_us)
+    legacy = algorithms._select_legacy(op, nbytes, comm, cfg)
+    plan = resolve(op, nbytes, comm, cfg, legacy)
+    lines = [
+        f"op={op.name} nbytes={nbytes} topology={'x'.join(map(str, axes))} "
+        f"transport={topo.transport.value} "
+        f"bidirectional={topo.bidirectional}",
+        f"alpha={model.alpha_us}us beta={model.beta_gbps}GB/s "
+        f"pipeline_chunks={cfg.sched_pipeline_chunks} "
+        f"startup={cfg.sched_pipeline_startup_us}us",
+        "",
+        f"{'shape':<10} {'algorithm':<10} {'steps':>5} {'hops':>5} "
+        f"{'alpha_us':>9} {'bw_us':>9} {'total_us':>9}",
+    ]
+    best = cands[0]
+    for p in cands:
+        hops = sum(s.hops for s in p.steps)
+        alpha_us = model.alpha_us * hops
+        if p.shape == "pipeline":
+            # the pipelined cost is NOT the per-step sum — report the
+            # makespan split as bottleneck-phase bw + fill cost
+            alpha_us = (cfg.sched_pipeline_startup_us
+                        * (cfg.sched_pipeline_chunks - 1))
+        bw_us = p.predicted_us - alpha_us
+        mark = "  <- winner (argmin cost)" if p is best else ""
+        lines.append(
+            f"{p.shape:<10} {p.algorithm.value:<10} {len(p.steps):>5} "
+            f"{hops:>5} {alpha_us:>9.2f} {bw_us:>9.2f} "
+            f"{p.predicted_us:>9.2f}{mark}")
+    lines += [
+        "",
+        f"legacy ladder decision: {legacy.value}",
+        f"resolve() decision:     shape={plan.shape} "
+        f"algorithm={plan.algorithm.value} source={plan.source} "
+        f"~{plan.predicted_us:.2f}us",
+        f"  {plan.describe()}",
+    ]
+    return "\n".join(lines)
+
+
+def _main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m accl_tpu.parallel.synth",
+        description="Inspect schedule-synthesis decisions for a "
+                    "hypothetical topology (no live session needed).")
+    ap.add_argument("--explain", action="store_true", required=True,
+                    help="print the candidate table, cost breakdown and "
+                         "resolve() decision")
+    ap.add_argument("op", help="allreduce | allgather | reduce_scatter")
+    ap.add_argument("nbytes", type=int,
+                    help="payload bytes in the op's select() convention")
+    ap.add_argument("shape",
+                    help="topology, e.g. 8 (single axis), 2x4, 2x2x2")
+    ap.add_argument("--transport", default="sim",
+                    choices=["sim", "ici", "dcn"])
+    ap.add_argument("--chunks", type=int, default=None,
+                    help="override sched_pipeline_chunks")
+    ap.add_argument("--startup-us", type=float, default=None,
+                    help="override sched_pipeline_startup_us")
+    ap.add_argument("--alpha-us", type=float, default=None)
+    ap.add_argument("--beta-gbps", type=float, default=None)
+    ap.add_argument("--full-authority", action="store_true",
+                    help="resolve under cfg.sched_full_authority")
+    args = ap.parse_args(argv)
+    cfg = ACCLConfig(transport=TransportBackend(args.transport))
+    if args.chunks is not None:
+        cfg = cfg.replace(sched_pipeline_chunks=args.chunks)
+    if args.startup_us is not None:
+        cfg = cfg.replace(sched_pipeline_startup_us=args.startup_us)
+    if args.alpha_us is not None:
+        cfg = cfg.replace(sched_alpha_us=args.alpha_us)
+    if args.beta_gbps is not None:
+        cfg = cfg.replace(sched_beta_gbps=args.beta_gbps)
+    if args.full_authority:
+        cfg = cfg.replace(sched_full_authority=True)
+    print(_explain(args.op, args.nbytes, args.shape, cfg))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

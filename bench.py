@@ -73,7 +73,7 @@ _TRACE_DIR = None
 KNOWN_LANES = (
     "sweep", "obs_overhead", "fault_overhead", "recover_time",
     "cmatmul_ag", "cmatmul_rs", "cmatmul_dw", "cmatmul_stream",
-    "moe_a2a", "moe_a2a_bwd", "zero_fsdp", "sched_synth",
+    "moe_a2a", "moe_a2a_bwd", "zero_fsdp", "sched_synth", "sched_pipeline",
     "hp_compression_cast_roundtrip", "combine_pallas_vs_jnp",
     "flash_attention", "flash_bwd", "cmdlist_chain_combine",
     "small_op_fused_latency",
@@ -460,6 +460,11 @@ def main(argv=None) -> int:
             # all_gather), with the cost model's predictions on record
             ("sched_synth",
              lambda: _lanes.bench_sched_synth(comm, cfg=acc.config)),
+            # round 16: chunked phase pipelining — pipelined vs
+            # sequential multi-axis vs flat ring, with the pipelined
+            # cost formula's predictions beside the measurements
+            ("sched_pipeline",
+             lambda: _lanes.bench_sched_pipeline(comm, cfg=acc.config)),
             # round 13 (inference serving): per-launch p50/p99 LATENCY
             # lanes, direction=lower — the token-sized allreduce under
             # the latency tier vs XLA, and the paged decode kernel

@@ -497,5 +497,10 @@ def test_autotune_sched_synth_gates(accl):
         cfg = autotune.autotune_sched_synth(accl, pows=(8, 12), reps=1)
         assert cfg.sched_alpha_us > 0 and cfg.sched_beta_gbps > 0
         assert isinstance(cfg.sched_synthesis, bool)
+        # round 16: the pipelined calibration rode along — a measured
+        # per-chunk startup term and a resolved go/no-go (chunks=1
+        # retires the pipelined candidate where chunking never won)
+        assert cfg.sched_pipeline_startup_us > 0
+        assert cfg.sched_pipeline_chunks in (1, 2, 4)
     finally:
         accl.config = orig

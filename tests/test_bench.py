@@ -454,10 +454,69 @@ def test_sched_synth_lane_resolves_on_declared_torus(accl):
     [r] = rows
     assert r["metric"] == "sched_synth_allreduce"
     assert r["topology_declared"] is True
-    assert r["plan_shape"] == "multiaxis"
+    # default config pipelines at this payload (sched_pipeline_chunks=4);
+    # both plan shapes dispatch the multi-axis family, so the lane stays
+    # resolved — the pipelined arm itself is bench_sched_pipeline's job
+    assert r["plan_shape"] == "pipeline"
     assert r["plan_source"] == "cost_model"
     assert r["resolved"] is True
     assert r["value"] == r["raw_speedup_med"] > 0
+
+
+def test_sched_pipeline_lane_schema(accl):
+    """The chunked-pipelining A/B lane: undeclared mesh -> headline
+    zeroed while the three-way raw A/B (ring / sequential multiaxis /
+    pipelined) and the cost model's predictions stay on the record."""
+    from accl_tpu.bench import lanes
+
+    comm = accl.global_comm()
+    rows = lanes.bench_sched_pipeline(comm, count=256, rounds=2,
+                                      cfg=accl.config)
+    assert [r["metric"] for r in rows] == [
+        "sched_pipeline_allreduce", "sched_pipeline_reduce_scatter",
+        "sched_pipeline_allgather"]
+    for r in rows:
+        assert r["unit"] == "ratio"
+        assert r["mesh_shape"] == [2, 4]      # the explicit-AB fallback
+        assert r["topology_declared"] is False
+        assert r["resolved"] is False and r["value"] == 0.0
+        assert r["pipeline_chunks"] >= 2
+        assert r["raw_speedup_med"] > 0       # raws always on the record
+        assert r["flat_ring_us"] > 0 and r["multiaxis_us"] > 0
+        assert r["pipeline_us"] > 0 and r["raw_pipeline_us"] > 0
+        assert r["predicted_pipeline_us"] > 0
+        assert r["predicted_multiaxis_us"] > 0
+
+
+def test_sched_pipeline_lane_resolves_on_declared_torus(accl):
+    """With the torus declared and a payload where max+startup < sum,
+    AUTO resolves the pipelined shape and the lane's honesty flag turns
+    on."""
+    from accl_tpu.bench import lanes
+
+    comm = accl.global_comm()
+    cfg = accl.config.replace(sched_mesh_shape=[2, 4])
+    rows = lanes.bench_sched_pipeline(
+        comm, count=1 << 20, rounds=2, cfg=cfg,
+        ops=("sched_pipeline_allreduce",))
+    [r] = rows
+    assert r["metric"] == "sched_pipeline_allreduce"
+    assert r["topology_declared"] is True
+    assert r["plan_shape"] == "pipeline"
+    assert r["plan_pipeline_chunks"] == cfg.sched_pipeline_chunks
+    assert r["pipeline_chunks"] == cfg.sched_pipeline_chunks
+    assert r["resolved"] is True
+    assert r["value"] == r["raw_speedup_med"] > 0
+    # a chunks=1 session never dispatches the pipelined schedule: the
+    # lane keeps measuring (raws on record) but zeroes the headline
+    seq = cfg.replace(sched_pipeline_chunks=1)
+    rows = lanes.bench_sched_pipeline(
+        comm, count=1 << 20, rounds=2, cfg=seq,
+        ops=("sched_pipeline_allreduce",))
+    [r] = rows
+    assert r["plan_shape"] == "multiaxis"
+    assert r["resolved"] is False and r["value"] == 0.0
+    assert r["raw_speedup_med"] > 0
 
 
 def test_bench_script_rejects_unknown_lane():
@@ -626,3 +685,36 @@ def test_latency_lanes_in_known_lanes():
     from bench import KNOWN_LANES
     assert "flash_decode" in KNOWN_LANES
     assert "coll_latency" in KNOWN_LANES
+
+
+def test_compare_flags_calibration_drift():
+    """Satellite: a lane carrying predicted_<x>_us beside its measured
+    <x>_us gets a calibration warning when they disagree by >3x — an
+    advisory for the α-β/startup fit, NEVER a regression exit."""
+    from accl_tpu.bench import compare as cmp
+
+    def artifact(pred):
+        return {"metric": "bench", "value": 1.0, "lanes": [{
+            "metric": "sched_pipeline_allreduce", "unit": "ratio",
+            "value": 1.2, "resolved": True,
+            "pipeline_us": 100.0, "predicted_pipeline_us": pred,
+            "multiaxis_us": 150.0, "predicted_multiaxis_us": 140.0,
+        }]}
+
+    ok = cmp.compare(artifact(90.0), artifact(90.0))
+    assert ok["calibration_warnings"] == []
+    assert not ok["regressed"]
+    drifted = cmp.compare(artifact(90.0), artifact(10.0))
+    [w] = drifted["calibration_warnings"]
+    assert w["metric"] == "sched_pipeline_allreduce"
+    assert w["field"] == "pipeline_us"
+    assert w["ratio"] == 10.0
+    assert "autotune" in w["note"]
+    assert not drifted["regressed"]       # advisory only
+    # both polarities drift (prediction 3x too high as well)
+    high = cmp.compare(artifact(90.0), artifact(400.0))
+    assert len(high["calibration_warnings"]) == 1
+    # unresolved/errored rows cannot indict the model
+    bad = artifact(10.0)
+    bad["lanes"][0]["error"] = "boom"
+    assert cmp.compare(artifact(90.0), bad)["calibration_warnings"] == []

@@ -190,14 +190,14 @@ def test_cmdlist_reselects_after_autotune(accl, monkeypatch):
     cl = accl.command_list()
     cl.allreduce(x, y, n, reduceFunction.SUM)
     seen = []
-    orig_select = alg.select
+    orig_select = alg.select_plan
 
     def spy(op, nbytes, comm, cfg, requested=None, count=None):
-        got = orig_select(op, nbytes, comm, cfg, requested, count)
+        got, plan = orig_select(op, nbytes, comm, cfg, requested, count)
         seen.append((op, got))
-        return got
+        return got, plan
 
-    monkeypatch.setattr(alg, "select", spy)
+    monkeypatch.setattr(alg, "select_plan", spy)
     cl.execute()
     first = [g for o, g in seen if o.name == "allreduce"][-1]
     # shrink the ring threshold below this payload: re-execute must

@@ -20,6 +20,7 @@ Three layers:
   one-launch path.
 """
 import dataclasses
+import os
 
 import numpy as np
 import pytest
@@ -431,8 +432,20 @@ def test_resolve_multiaxis_on_emulated_2x4(accl):
     # star beats log depth at this world size — round 13)
     assert algorithms.select(operation.allreduce, 1024, comm, cfg) \
         == Algorithm.FLAT
-    # the very top of the range ties the two-tier split -> legacy kept
+    # the very top of the range: sequential multiaxis TIES the two-tier
+    # split (legacy kept pre-pipelining), but the chunk-pipelined
+    # candidate strictly beats both — the overlap win the sequential
+    # phases could never claim
     assert algorithms.select(operation.allreduce, 128 << 20, comm, cfg) \
+        == Algorithm.MULTIAXIS
+    legacy = algorithms._select_legacy(operation.allreduce, 128 << 20,
+                                       comm, cfg)
+    top = synth.resolve(operation.allreduce, 128 << 20, comm, cfg, legacy)
+    assert top.shape == "pipeline"
+    # ... and with pipelining off (sched_pipeline_chunks=1) the tie
+    # resolves EXACTLY as pre-refactor: legacy HIERARCHICAL kept
+    seq = cfg.replace(sched_pipeline_chunks=1)
+    assert algorithms.select(operation.allreduce, 128 << 20, comm, seq) \
         == Algorithm.HIERARCHICAL
     # the dual ops ride the same window (per-op byte conventions)
     assert algorithms.select(operation.allgather, 4 << 20, comm, cfg) \
@@ -487,7 +500,7 @@ def test_resolve_caches_and_counts(accl):
                               sched_alpha_us=1.0 + 1e-9)  # fresh cache keys
     hit_k = 'accl_sched_plan_cache_total{event="hit"}'
     miss_k = 'accl_sched_plan_cache_total{event="miss"}'
-    plan_k = ('accl_sched_plan_total{op="allreduce",shape="multiaxis",'
+    plan_k = ('accl_sched_plan_total{op="allreduce",shape="pipeline",'
               'source="cost_model"}')
     h0, m0, p0 = _counter(hit_k), _counter(miss_k), _counter(plan_k)
     legacy = algorithms._select_legacy(operation.allreduce, 8 << 20, comm,
@@ -495,7 +508,10 @@ def test_resolve_caches_and_counts(accl):
     p1 = synth.resolve(operation.allreduce, 8 << 20, comm, cfg, legacy)
     p2 = synth.resolve(operation.allreduce, 8 << 20, comm, cfg, legacy)
     assert p1 is p2  # the cached object itself
-    assert p1.shape == "multiaxis" and p1.source == "cost_model"
+    # default config pipelines (sched_pipeline_chunks=4): the plan
+    # counter carries the pipelined shape label
+    assert p1.shape == "pipeline" and p1.source == "cost_model"
+    assert p1.param("pipeline_chunks") == 4
     assert _counter(miss_k) == m0 + 1
     assert _counter(hit_k) == h0 + 1
     assert _counter(plan_k) == p0 + 1  # one per synthesized plan, not per call
@@ -752,3 +768,573 @@ def test_config_roundtrip_with_sched_fields():
     assert back.sched_alpha_us == 0.5
     assert back.program_cache_size == 33
     assert back.sched_synthesis is True
+
+
+# ---------------------------------------------------------------------------
+# round 16: chunked phase pipelining + N-D declarations + full authority
+# ---------------------------------------------------------------------------
+
+def test_topology_declared_3d(accl):
+    """A DECLARED [2, 2, 2] is a real 3-axis topology (the generators
+    and validator always handled N axes; the builders now do too) —
+    while coords-inferred 3-D stays refused (test above) and malformed
+    declarations fail loudly."""
+    comm = accl.global_comm()
+    cfg = accl.config.replace(sched_mesh_shape=[2, 2, 2])
+    topo = synth.topology_of(comm, cfg)
+    assert topo.axes == (2, 2, 2) and topo.multi_axis
+    assert synth.torus_shape(comm, cfg) == (2, 2, 2)
+    with pytest.raises(ValueError, match="sched_mesh_shape"):
+        synth.torus_shape(comm, accl.config.replace(sched_mesh_shape=[8]))
+    with pytest.raises(ValueError, match="sched_mesh_shape"):
+        synth.torus_shape(comm, accl.config.replace(
+            sched_mesh_shape=[8, 1]))
+
+
+def _pipeline_plan(op=operation.allreduce, axes=(2, 4), nbytes=8 << 20,
+                   chunks=4, bidir=True):
+    topo = synth.Topology(tuple(axes), TransportBackend.SIM, bidir)
+    model = synth.CostModel.from_config(ACCLConfig(), topo.transport)
+    return synth._gen_pipeline(op, topo, synth._payload_total(
+        op, nbytes, topo.world), model, chunks, 2.0)
+
+
+@pytest.mark.parametrize("axes", [(2, 4), (4, 2), (2, 2, 2), (4, 4)])
+@pytest.mark.parametrize("op", list(synth.SYNTH_OPS))
+def test_pipeline_plans_validate(op, axes):
+    """Every pipelined plan passes the per-chunk ownership algebra:
+    each (chunk, axis-phase) folded/delivered exactly once, per-chunk
+    deps acyclic, hops matching the sequential per-axis rings."""
+    for chunks in (2, 3, 4):
+        plan = _pipeline_plan(op, axes, chunks=chunks)
+        assert plan is not None and plan.shape == "pipeline"
+        assert plan.algorithm == Algorithm.MULTIAXIS
+        assert plan.param("pipeline_chunks") == chunks
+        synth.validate_plan(plan)
+        # chunks=1 generates no pipelined candidate at all
+    assert _pipeline_plan(op, axes, chunks=1) is None
+    # ... and neither does a single-axis topology
+    assert _pipeline_plan(op, (8,), chunks=4) is None
+
+
+def test_validator_rejects_cross_chunk_double_fold():
+    """A step relabeled into another chunk's lane folds that chunk's
+    phase twice (and leaves its own lane incomplete) — the cross-chunk
+    aliasing the per-chunk algebra exists to catch."""
+    plan = _pipeline_plan(chunks=2)
+    steps = list(plan.steps)
+    n_ph = len(steps) // 2
+    # chunk 1's first phase pretends to be chunk 0's: chunk 0 now runs
+    # its reduce_scatter twice
+    steps[n_ph] = dataclasses.replace(steps[n_ph], chunk=0)
+    bad = dataclasses.replace(plan, steps=tuple(steps))
+    with pytest.raises(ValueError, match="pipeline chunk"):
+        synth.validate_plan(bad)
+
+
+def test_validator_rejects_chunk_out_of_phase_order():
+    """A chunk whose all-gather is ordered before its reduce-scatter
+    (deps flipped) delivers fully-owned chunks into ranks that already
+    hold them — phase order is provable, not stylistic."""
+    plan = _pipeline_plan(op=operation.allreduce, chunks=2)
+    steps = list(plan.steps)
+    n_ph = len(steps) // 2
+    # flip chunk 0's intra-chunk dependency chain: the last phase (an
+    # all_gather) becomes the root, the first (a reduce_scatter) waits
+    # on it — the topological order then gathers before scattering
+    head = steps[0]
+    tail = steps[n_ph - 1]
+    steps[0] = dataclasses.replace(head, deps=(tail.index,))
+    steps[n_ph - 1] = dataclasses.replace(tail, deps=())
+    for i in range(1, n_ph - 1):
+        steps[i] = dataclasses.replace(steps[i], deps=(steps[i].index - 1,))
+    bad = dataclasses.replace(plan, steps=tuple(steps))
+    with pytest.raises(ValueError, match="pipeline chunk 0"):
+        synth.validate_plan(bad)
+
+
+def test_validator_rejects_pipeline_hop_drift():
+    """A pipelined step charging hops the per-axis ring would not —
+    chunking splits bytes, never hops."""
+    plan = _pipeline_plan(chunks=3)
+    steps = list(plan.steps)
+    steps[2] = dataclasses.replace(steps[2], hops=steps[2].hops + 1)
+    bad = dataclasses.replace(plan, steps=tuple(steps))
+    with pytest.raises(ValueError, match="hops"):
+        synth.validate_plan(bad)
+
+
+def test_validator_rejects_missing_chunk_lane():
+    """A declared chunk count whose lanes do not all appear (a dropped
+    chunk would silently skip part of the payload)."""
+    plan = _pipeline_plan(chunks=3)
+    n_ph = len(plan.steps) // 3
+    bad = dataclasses.replace(plan, steps=plan.steps[:2 * n_ph])
+    with pytest.raises(ValueError, match="declared range"):
+        synth.validate_plan(bad)
+    # mixed chunked/unchunked steps are unaccountable
+    steps = list(plan.steps)
+    steps[0] = dataclasses.replace(steps[0], chunk=None)
+    bad2 = dataclasses.replace(plan, steps=tuple(steps))
+    with pytest.raises(ValueError, match="mixed"):
+        synth.validate_plan(bad2)
+
+
+def test_pipeline_cost_formula():
+    """The pipelined candidate costs exactly
+    max(phase costs) + (chunks-1)·startup, and resolve() prefers it
+    over the sequential schedule exactly where that undercuts the
+    sequential sum."""
+    topo = synth.Topology((2, 4), TransportBackend.SIM, True)
+    model = synth.CostModel.from_config(ACCLConfig(), topo.transport)
+    for nbytes in (1 << 16, 1 << 20, 8 << 20):
+        N = synth._payload_total(operation.allreduce, nbytes, topo.world)
+        seq = synth._gen_multiaxis(operation.allreduce, topo, N, model)
+        for chunks, startup in ((2, 2.0), (4, 2.0), (4, 50.0)):
+            pipe = synth._gen_pipeline(operation.allreduce, topo, N,
+                                       model, chunks, startup)
+            phase_costs = [model.step_us(s.hops, s.link_bytes, s.channels)
+                           for s in seq.steps]
+            want = max(phase_costs) + (chunks - 1) * startup
+            assert pipe.predicted_us == pytest.approx(want)
+            assert (pipe.predicted_us < seq.predicted_us) \
+                == (want < seq.predicted_us)
+    # an absurd startup term prices pipelining out: resolve keeps the
+    # sequential multiaxis schedule
+    cfg = ACCLConfig(transport=TransportBackend.SIM,
+                     sched_mesh_shape=[2, 4],
+                     sched_pipeline_startup_us=1e6)
+    comm = _FakeComm([object()] * 8)
+    plan = synth.resolve(operation.allreduce, 8 << 20, comm, cfg,
+                         Algorithm.RING)
+    assert plan.shape == "multiaxis"
+
+
+def test_pipeline_chunks_1_resolution_byte_identical(accl):
+    """THE equivalence pin: sched_pipeline_chunks=1 resolves EXACTLY
+    as the pre-pipelining refactor — sequential multiaxis in the ring
+    window, legacy at the hier tie — for every op."""
+    comm = accl.global_comm()
+    cfg = accl.config.replace(sched_mesh_shape=[2, 4],
+                              sched_pipeline_chunks=1)
+    for op, nbytes in ((operation.allreduce, 8 << 20),
+                       (operation.allgather, 4 << 20),
+                       (operation.reduce_scatter, 4 << 20)):
+        legacy = algorithms._select_legacy(op, nbytes, comm, cfg)
+        plan = synth.resolve(op, nbytes, comm, cfg, legacy)
+        assert plan.shape == "multiaxis" and plan.source == "cost_model"
+        assert plan.algorithm == Algorithm.MULTIAXIS
+    # the hier tie at the top of the range keeps legacy (pre-refactor)
+    legacy = algorithms._select_legacy(operation.allreduce, 128 << 20,
+                                       comm, cfg)
+    plan = synth.resolve(operation.allreduce, 128 << 20, comm, cfg, legacy)
+    assert plan.source == "cost_model" and plan.algorithm == legacy
+
+
+def test_resolve_pipeline_3d_declared(accl):
+    """A declared (2,2,2) resolves the pipelined 3-axis schedule in the
+    bandwidth window — the N-D dispatch the builders now honor."""
+    comm = accl.global_comm()
+    cfg = accl.config.replace(sched_mesh_shape=[2, 2, 2])
+    legacy = algorithms._select_legacy(operation.allreduce, 8 << 20, comm,
+                                       cfg)
+    plan = synth.resolve(operation.allreduce, 8 << 20, comm, cfg, legacy)
+    assert plan.shape == "pipeline"
+    assert plan.param("shape2d") == (2, 2, 2)
+    assert len({s.axis for s in plan.steps}) == 3
+    synth.validate_plan(plan)
+    assert algorithms.select(operation.allreduce, 8 << 20, comm, cfg) \
+        == Algorithm.MULTIAXIS
+
+
+def test_pipeline_seed_override_still_pins(accl):
+    """Autotune seeds outrank the pipelined candidate exactly as they
+    outrank the sequential one."""
+    comm = accl.global_comm()
+    cfg = accl.config.replace(sched_mesh_shape=[2, 4],
+                              ring_threshold=64 * 1024)
+    legacy = algorithms._select_legacy(operation.allreduce, 8 << 20, comm,
+                                       cfg)
+    plan = synth.resolve(operation.allreduce, 8 << 20, comm, cfg, legacy)
+    assert plan.source == "override" and plan.shape != "pipeline"
+
+
+# ---------------------------------------------------------------------------
+# program parity: pipelined + 3-axis builders
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("shape", [(2, 4), (4, 2), (2, 2, 2)])
+@pytest.mark.parametrize("chunks", [2, 3])
+def test_pipelined_allreduce_bit_exact(accl, rng, shape, chunks):
+    """Pipelined + N-D: bit-exact vs the flat-ring and XLA paths,
+    including the padding path (count=100 is not divisible by
+    world*chunks)."""
+    dt = dataType.float32
+    for count in (64, 100):
+        data = rng.integers(-100, 100, (WORLD, count)).astype(np.float32)
+        outs = {}
+        saved = accl.config
+        accl.config = saved.replace(sched_mesh_shape=list(shape),
+                                    sched_pipeline_chunks=chunks)
+        try:
+            for algo in (Algorithm.RING, Algorithm.XLA,
+                         Algorithm.MULTIAXIS):
+                send = accl.create_buffer(count, dt)
+                recv = accl.create_buffer(count, dt)
+                send.host[:] = data
+                accl.allreduce(send, recv, count, reduceFunction.SUM,
+                               algorithm=algo)
+                outs[algo] = recv.host.copy()
+        finally:
+            accl.config = saved
+        np.testing.assert_array_equal(outs[Algorithm.MULTIAXIS],
+                                      outs[Algorithm.RING])
+        np.testing.assert_array_equal(outs[Algorithm.MULTIAXIS],
+                                      outs[Algorithm.XLA])
+        np.testing.assert_array_equal(outs[Algorithm.MULTIAXIS][0],
+                                      data.sum(0))
+
+
+@pytest.mark.parametrize("shape", [(2, 4), (2, 2, 2)])
+def test_pipelined_duals_and_max_bit_exact(accl, rng, shape):
+    """reduce_scatter / allgather / MAX under chunking: the chunk
+    re-interleaving must land every rank exactly its flat block."""
+    saved = accl.config
+    accl.config = saved.replace(sched_mesh_shape=list(shape),
+                                sched_pipeline_chunks=3)
+    try:
+        count = 48  # not divisible by 3*world: padding inside each block
+        data = rng.integers(-50, 50, (WORLD, count * WORLD)).astype(np.int32)
+        outs = {}
+        for algo in (Algorithm.RING, Algorithm.MULTIAXIS):
+            send = accl.create_buffer(count * WORLD, dataType.int32)
+            recv = accl.create_buffer(count, dataType.int32)
+            send.host[:] = data
+            accl.reduce_scatter(send, recv, count, reduceFunction.SUM,
+                                algorithm=algo)
+            outs[algo] = recv.host.copy()
+        np.testing.assert_array_equal(outs[Algorithm.MULTIAXIS],
+                                      outs[Algorithm.RING])
+        # allgather, odd count
+        g = rng.standard_normal((WORLD, 33)).astype(np.float32)
+        outs = {}
+        for algo in (Algorithm.RING, Algorithm.MULTIAXIS):
+            send = accl.create_buffer(33, dataType.float32)
+            recv = accl.create_buffer(33 * WORLD, dataType.float32)
+            send.host[:] = g
+            accl.allgather(send, recv, 33, algorithm=algo)
+            outs[algo] = recv.host.copy()
+        np.testing.assert_array_equal(outs[Algorithm.MULTIAXIS],
+                                      outs[Algorithm.RING])
+        # MAX rides the monotone-cast fast path under chunking too
+        m = rng.integers(-100, 100, (WORLD, 40)).astype(np.int32)
+        send = accl.create_buffer(40, dataType.int32)
+        recv = accl.create_buffer(40, dataType.int32)
+        send.host[:] = m
+        accl.allreduce(send, recv, 40, reduceFunction.MAX,
+                       algorithm=Algorithm.MULTIAXIS)
+        for r in range(WORLD):
+            np.testing.assert_array_equal(recv.host[r], m.max(0))
+    finally:
+        accl.config = saved
+
+
+def test_pipelined_compressed_wire(accl, rng):
+    """bf16 wire staging through the pipelined 3-axis schedule: every
+    hop compressed, folds at full precision, tolerance bounded."""
+    saved = accl.config
+    accl.config = saved.replace(sched_mesh_shape=[2, 2, 2],
+                                sched_pipeline_chunks=2)
+    try:
+        count = 64
+        data = rng.integers(-100, 100, (WORLD, count)).astype(np.float32)
+        send = accl.create_buffer(count, dataType.float32)
+        recv = accl.create_buffer(count, dataType.float32)
+        send.host[:] = data
+        accl.allreduce(send, recv, count, reduceFunction.SUM,
+                       compress_dtype=dataType.bfloat16,
+                       algorithm=Algorithm.MULTIAXIS)
+        expect = data.astype(np.float64).sum(0)
+        for r in range(WORLD):
+            np.testing.assert_allclose(recv.host[r], expect, rtol=0.1,
+                                       atol=2.0)
+    finally:
+        accl.config = saved
+
+
+def test_auto_dispatches_pipelined_end_to_end(accl, rng):
+    """AUTO on a declared 2x4 at a ring-window payload under the default
+    chunked config: the resolved plan is the pipelined shape, the
+    dispatched program runs it (chunk count in the program key), and
+    the result is exact."""
+    count = 1 << 20  # 4 MiB f32
+    saved = accl.config
+    accl.config = saved.replace(sched_mesh_shape=[2, 4])
+    try:
+        key = ('accl_sched_plan_total{op="allreduce",shape="pipeline",'
+               'source="cost_model"}')
+        before = _counter(key)
+        data = rng.integers(-8, 8, (WORLD, count)).astype(np.float32)
+        send = accl.create_buffer(count, dataType.float32)
+        recv = accl.create_buffer(count, dataType.float32)
+        send.host[:] = data
+        accl.allreduce(send, recv, count, reduceFunction.SUM)
+        assert _counter(key) >= before  # plan may already be cached
+        np.testing.assert_array_equal(recv.host[0], data.sum(0))
+    finally:
+        accl.config = saved
+
+
+def test_world16_4x4_parity_subprocess():
+    """The (4, 4) parity leg of the acceptance matrix needs 16 devices —
+    more than this process's 9-device emulator — so it runs in a
+    subprocess with its own device-count flag: pipelined + sequential
+    multiaxis vs XLA psum for all three ops, bit-exact."""
+    import subprocess
+    import sys
+    import textwrap
+
+    script = textwrap.dedent("""
+        import os
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax, numpy as np
+        jax.config.update("jax_platforms", "cpu")
+        from accl_tpu import Algorithm, dataType, reduceFunction
+        from accl_tpu.communicator import Communicator
+        from accl_tpu.parallel import algorithms
+
+        comm = Communicator(jax.devices()[:16])
+        W, axes = 16, (4, 4)
+        rng = np.random.default_rng(0)
+        for C in (1, 3):
+            x = rng.integers(-100, 100, (W, 36)).astype(np.float32)
+            ring = algorithms.build_allreduce(
+                comm, reduceFunction.SUM, dataType.float32,
+                Algorithm.RING, None)
+            ma = algorithms.build_allreduce(
+                comm, reduceFunction.SUM, dataType.float32,
+                Algorithm.MULTIAXIS, None, mesh_shape=axes,
+                pipeline_chunks=C)
+            assert np.array_equal(np.asarray(ring(x)), np.asarray(ma(x)))
+            xr = rng.integers(-50, 50, (W, 8 * W)).astype(np.int32)
+            rs_r = algorithms.build_reduce_scatter(
+                comm, reduceFunction.SUM, dataType.int32,
+                Algorithm.RING, None)
+            rs_m = algorithms.build_reduce_scatter(
+                comm, reduceFunction.SUM, dataType.int32,
+                Algorithm.MULTIAXIS, None, mesh_shape=axes,
+                pipeline_chunks=C)
+            assert np.array_equal(np.asarray(rs_r(xr)),
+                                  np.asarray(rs_m(xr)))
+            xg = rng.standard_normal((W, 9)).astype(np.float32)
+            ag_r = algorithms.build_allgather(
+                comm, Algorithm.RING, None, dataType.float32)
+            ag_m = algorithms.build_allgather(
+                comm, Algorithm.MULTIAXIS, None, dataType.float32,
+                mesh_shape=axes, pipeline_chunks=C)
+            assert np.array_equal(np.asarray(ag_r(xg)),
+                                  np.asarray(ag_m(xg)))
+        print("OK_4x4")
+    """)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, "-c", script], timeout=300,
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "OK_4x4" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# full authority (sched_full_authority)
+# ---------------------------------------------------------------------------
+
+def test_full_authority_off_by_default_pins_equivalence(accl):
+    """The flag defaults OFF and the single-axis equivalence pins above
+    run under that default — spelled out here so the migration contract
+    is its own test."""
+    assert ACCLConfig().sched_full_authority is False
+    comm = accl.global_comm()
+    for nbytes in (64 << 10, 4 << 20, 64 << 20):
+        assert algorithms.select(operation.allreduce, nbytes, comm,
+                                 accl.config) \
+            == algorithms._select_legacy(operation.allreduce, nbytes,
+                                         comm, accl.config)
+
+
+def test_full_authority_retires_ladder_on_single_axis(accl):
+    """Flag ON: the per-size-bucket argmin rules the single-axis mesh —
+    the kring schedule wins the bandwidth regime (where the ladder said
+    RING anyway), the flat star wins the α regime, and seeds no longer
+    pin (the ladder they seed is retired)."""
+    comm = accl.global_comm()
+    cfg = accl.config.replace(sched_full_authority=True)
+    legacy = algorithms._select_legacy(operation.allreduce, 16 << 20,
+                                       comm, cfg)
+    plan = synth.resolve(operation.allreduce, 16 << 20, comm, cfg, legacy)
+    assert plan.source == "full_authority"
+    assert plan.shape in ("ring", "kring")
+    assert plan.algorithm == Algorithm.RING   # SIM transport: plain ring
+    synth.validate_plan(plan)
+    # α regime: the 2-hop flat star (the latency tier's pick) falls out
+    # of the same argmin — no separate tier needed under full authority
+    legacy2 = algorithms._select_legacy(operation.allreduce, 512, comm,
+                                        cfg)
+    plan2 = synth.resolve(operation.allreduce, 512, comm, cfg, legacy2)
+    assert plan2.source == "full_authority" and plan2.shape == "flat"
+    # a seeded register does NOT pin under full authority
+    seeded = cfg.replace(ring_threshold=64 * 1024)
+    legacy3 = algorithms._select_legacy(operation.allreduce, 16 << 20,
+                                        comm, seeded)
+    plan3 = synth.resolve(operation.allreduce, 16 << 20, comm, seeded,
+                          legacy3)
+    assert plan3.source == "full_authority"
+
+
+def test_full_authority_maps_ring_family_to_pallas_on_ici(accl):
+    """On real chip links the ring-family shapes execute via the Pallas
+    RDMA kernels — the perf core the retired ladder routed large ICI
+    payloads to."""
+    comm = accl.global_comm()
+    cfg = accl.config.replace(sched_full_authority=True,
+                              transport=TransportBackend.ICI)
+    legacy = algorithms._select_legacy(operation.allreduce, 16 << 20,
+                                       comm, cfg)
+    plan = synth.resolve(operation.allreduce, 16 << 20, comm, cfg, legacy)
+    if plan.shape in ("ring", "kring"):
+        assert plan.algorithm == Algorithm.PALLAS
+
+
+def test_full_authority_dcn_guard_outranks(accl):
+    """The DCN two-tier story outranks even full authority."""
+    comm = accl.global_comm()
+    cfg = accl.config.replace(sched_full_authority=True,
+                              transport=TransportBackend.DCN)
+    legacy = algorithms._select_legacy(operation.allreduce, 8 << 20, comm,
+                                       cfg)
+    plan = synth.resolve(operation.allreduce, 8 << 20, comm, cfg, legacy)
+    assert plan.source == "legacy" and plan.algorithm == legacy
+
+
+def test_full_authority_multiaxis_window(accl):
+    """Flag ON on a declared torus: the argmin still lands the
+    pipelined multi-axis schedule in the bandwidth window (the full
+    candidate space includes it)."""
+    comm = accl.global_comm()
+    cfg = accl.config.replace(sched_full_authority=True,
+                              sched_mesh_shape=[2, 4])
+    legacy = algorithms._select_legacy(operation.allreduce, 16 << 20,
+                                       comm, cfg)
+    plan = synth.resolve(operation.allreduce, 16 << 20, comm, cfg, legacy)
+    assert plan.source == "full_authority" and plan.shape == "pipeline"
+
+
+# ---------------------------------------------------------------------------
+# satellites: fingerprint memo, plan-cache stats, --explain CLI
+# ---------------------------------------------------------------------------
+
+def test_cost_fingerprint_memoized_per_config():
+    """_cost_fingerprint sits on the per-op dispatch path: one tuple
+    build per config OBJECT, identity-checked so a recycled id can
+    never alias, and new cost fields participate."""
+    cfg = ACCLConfig()
+    fp1 = synth._cost_fingerprint(cfg)
+    assert synth._cost_fingerprint(cfg) is fp1          # memo hit
+    cfg2 = cfg.replace(sched_pipeline_chunks=7)
+    fp2 = synth._cost_fingerprint(cfg2)
+    assert fp2 != fp1                                    # chunks in the key
+    assert synth._cost_fingerprint(
+        cfg.replace(sched_full_authority=True)) != fp1
+    assert synth._cost_fingerprint(
+        cfg.replace(sched_pipeline_startup_us=9.0)) != fp1
+    # the session hook clears the memo with the plan cache
+    synth.reset_plan_cache()
+    fp1b = synth._cost_fingerprint(cfg)
+    assert fp1b == fp1 and fp1b is not fp1
+
+
+def test_plan_cache_stats_in_accl_stats(accl):
+    """ACCL.stats() surfaces the synth plan cache beside the program
+    cache: size, bound, and hit/miss/evict tallies that move with
+    resolution traffic."""
+    synth.reset_plan_cache()
+    comm = accl.global_comm()
+    cfg = accl.config.replace(sched_alpha_us=1.0 + 3e-9)
+    legacy = algorithms._select_legacy(operation.allreduce, 8 << 20, comm,
+                                       cfg)
+    synth.resolve(operation.allreduce, 8 << 20, comm, cfg, legacy)
+    synth.resolve(operation.allreduce, 8 << 20, comm, cfg, legacy)
+    st = accl.stats()["sched_plan_cache"]
+    assert st["plans"] >= 1 and st["max_size"] == synth._PLAN_CACHE_MAX
+    assert st["hits"] >= 1 and st["misses"] >= 1
+    assert st["evictions"] == 0
+    import json
+    json.dumps(st)  # stats() stays JSON-round-trippable
+
+
+def test_plan_cache_evicts_at_bound(monkeypatch):
+    """The LRU bound evicts the oldest plan and counts it."""
+    synth.reset_plan_cache()
+    monkeypatch.setattr(synth, "_PLAN_CACHE_MAX", 2)
+    comm = _FakeComm([object()] * 8)
+    cfg = ACCLConfig(transport=TransportBackend.SIM)
+    e0 = _counter('accl_sched_plan_cache_total{event="evict"}')
+    for i in range(3):  # distinct cost params -> three distinct keys
+        synth.resolve(operation.allreduce, 9 << 20, comm,
+                      cfg.replace(sched_alpha_us=1.0 + (i + 1) * 1e-9),
+                      Algorithm.RING)
+    st = synth.plan_cache_stats()
+    assert st["plans"] == 2 and st["evictions"] == 1
+    assert _counter('accl_sched_plan_cache_total{event="evict"}') == e0 + 1
+    synth.reset_plan_cache()
+
+
+def test_synth_explain_cli_smoke():
+    """`python -m accl_tpu.parallel.synth --explain OP NBYTES SHAPE`
+    prints the candidate table (cost breakdown, winner, resolve()
+    decision) for a hypothetical topology — no live session needed."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "accl_tpu.parallel.synth", "--explain",
+         "allreduce", str(8 << 20), "2x4"],
+        timeout=180, capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = r.stdout
+    assert "winner" in out and "pipeline" in out and "multiaxis" in out
+    assert "resolve() decision" in out and "source=cost_model" in out
+    # unknown op fails fast with the menu
+    r2 = subprocess.run(
+        [sys.executable, "-m", "accl_tpu.parallel.synth", "--explain",
+         "bogus", "1024", "2x4"],
+        timeout=180, capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r2.returncode != 0
+
+
+def test_plan_cache_hit_refreshes_recency(monkeypatch):
+    """LRU, not FIFO: a hit re-inserts the plan, so the hot first-resolved
+    plan survives the bound while the cold untouched one evicts."""
+    synth.reset_plan_cache()
+    monkeypatch.setattr(synth, "_PLAN_CACHE_MAX", 2)
+    comm = _FakeComm([object()] * 8)
+    base = ACCLConfig(transport=TransportBackend.SIM)
+    cfgs = [base.replace(sched_alpha_us=1.0 + (i + 1) * 1e-9)
+            for i in range(3)]
+    hot = synth.resolve(operation.allreduce, 9 << 20, comm, cfgs[0],
+                        Algorithm.RING)
+    synth.resolve(operation.allreduce, 9 << 20, comm, cfgs[1],
+                  Algorithm.RING)
+    # touch the hot plan: it must now outlive the bound...
+    assert synth.resolve(operation.allreduce, 9 << 20, comm, cfgs[0],
+                         Algorithm.RING) is hot
+    synth.resolve(operation.allreduce, 9 << 20, comm, cfgs[2],
+                  Algorithm.RING)   # evicts cfgs[1]'s plan, not hot's
+    m0 = synth.plan_cache_stats()["misses"]
+    assert synth.resolve(operation.allreduce, 9 << 20, comm, cfgs[0],
+                         Algorithm.RING) is hot
+    assert synth.plan_cache_stats()["misses"] == m0   # still cached
+    synth.reset_plan_cache()

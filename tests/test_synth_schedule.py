@@ -49,7 +49,10 @@ def test_v5e_coords_detect_torus(tpu_comm):
 
 def test_v5e_resolution_selects_multiaxis(tpu_comm):
     """Plan pin on the real topology: large-payload allreduce resolves
-    to the synthesized multi-axis schedule over the flat ring path."""
+    to the synthesized multi-axis schedule over the flat ring path —
+    the chunk-PIPELINED shape under the default config
+    (sched_pipeline_chunks=4), the sequential one with pipelining
+    off."""
     cfg = ACCLConfig(transport=TransportBackend.ICI)
     got = algorithms.select(operation.allreduce, 8 << 20, tpu_comm, cfg)
     assert got == Algorithm.MULTIAXIS
@@ -57,9 +60,14 @@ def test_v5e_resolution_selects_multiaxis(tpu_comm):
                                        tpu_comm, cfg)
     plan = synth.resolve(operation.allreduce, 8 << 20, tpu_comm, cfg,
                          legacy)
-    assert plan.shape == "multiaxis" and plan.source == "cost_model"
+    assert plan.shape == "pipeline" and plan.source == "cost_model"
     assert plan.param("shape2d") == (ROWS, COLS)
+    assert plan.param("pipeline_chunks") == cfg.sched_pipeline_chunks
     synth.validate_plan(plan)
+    seq_cfg = cfg.replace(sched_pipeline_chunks=1)
+    seq = synth.resolve(operation.allreduce, 8 << 20, tpu_comm, seq_cfg,
+                        legacy)
+    assert seq.shape == "multiaxis" and seq.source == "cost_model"
 
 
 _COLLECTIVE = re.compile(
@@ -79,22 +87,29 @@ def _collective_group_sizes(txt: str):
     return sizes
 
 
+@pytest.mark.parametrize("chunks", [1, 4])
 @pytest.mark.parametrize("op", ["allreduce", "reduce_scatter", "allgather"])
-def test_multiaxis_program_lowers_per_axis(tpu_comm, op):
+def test_multiaxis_program_lowers_per_axis(tpu_comm, op, chunks):
     """The synthesized schedule AOT-compiles for the real 2x4 mesh as
     ONE program whose collectives are per-axis (group sizes 2 and 4) —
-    the torus decomposition survives to scheduled TPU code."""
+    the torus decomposition survives to scheduled TPU code, sequential
+    and chunk-pipelined alike (the pipelined allreduce still traces to
+    one launch: the chunks are data-parallel lanes of one jitted
+    shard_map program, not extra dispatches)."""
     n = 4096
     if op == "allreduce":
         fn = synth.build_multiaxis_allreduce(
-            tpu_comm, ROWS, COLS, reduceFunction.SUM, dataType.float32)
+            tpu_comm, (ROWS, COLS), reduceFunction.SUM, dataType.float32,
+            pipeline_chunks=chunks)
         txt = _compile_text(fn, tpu_comm, (WORLD, n))
     elif op == "reduce_scatter":
         fn = synth.build_multiaxis_reduce_scatter(
-            tpu_comm, ROWS, COLS, reduceFunction.SUM, dataType.float32)
+            tpu_comm, (ROWS, COLS), reduceFunction.SUM, dataType.float32,
+            pipeline_chunks=chunks)
         txt = _compile_text(fn, tpu_comm, (WORLD, WORLD * n))
     else:
-        fn = synth.build_multiaxis_allgather(tpu_comm, ROWS, COLS)
+        fn = synth.build_multiaxis_allgather(tpu_comm, (ROWS, COLS),
+                                             pipeline_chunks=chunks)
         txt = _compile_text(fn, tpu_comm, (WORLD, n))
     assert _COLLECTIVE.search(txt), "no collective in the lowered module"
     sizes = _collective_group_sizes(txt)
@@ -102,3 +117,17 @@ def test_multiaxis_program_lowers_per_axis(tpu_comm, op):
     assert all(s in (ROWS, COLS) for s in sizes), \
         f"expected per-axis groups of {ROWS}/{COLS}, got {sizes}"
     assert any(s == COLS for s in sizes), f"heavy axis missing: {sizes}"
+
+
+def test_declared_3axis_program_lowers_per_axis(tpu_comm):
+    """A DECLARED (2, 2, 2) on the same 8 chips compiles a real 3-axis
+    decomposition: every collective in the module runs 2-rank groups
+    (all three axes have extent 2), still one program."""
+    fn = synth.build_multiaxis_allreduce(
+        tpu_comm, (2, 2, 2), reduceFunction.SUM, dataType.float32,
+        pipeline_chunks=2)
+    txt = _compile_text(fn, tpu_comm, (WORLD, 4096))
+    assert _COLLECTIVE.search(txt), "no collective in the lowered module"
+    sizes = _collective_group_sizes(txt)
+    assert sizes and all(s == 2 for s in sizes), \
+        f"expected 2-rank per-axis groups, got {sizes}"

@@ -51,6 +51,11 @@ t1_rc=${PIPESTATUS[0]}
 echo "[ci_gate] tier-1 rc=${t1_rc} DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)" >&2
 if [[ $t1_rc -ne 0 ]]; then
     echo "[ci_gate] FAIL: tier-1 verify failed (rc=${t1_rc})" >&2
+    if grep -qaE "test_synth|sched_plan|multiaxis|pipeline chunk" /tmp/_t1.log; then
+        echo "[ci_gate] hint: plan-related failure — inspect the candidate" >&2
+        echo "[ci_gate]   table and resolve() decision for any topology with:" >&2
+        echo "[ci_gate]   python -m accl_tpu.parallel.synth --explain allreduce 8388608 2x4" >&2
+    fi
     exit "$t1_rc"
 fi
 
