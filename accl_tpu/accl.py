@@ -109,6 +109,13 @@ class ACCL:
         _zero_model.set_overlap_enabled(cfg.zero_overlap)
         _zero_model.set_prefetch_enabled(cfg.zero_prefetch)
         _zero_model.set_replicas_enabled(cfg.shard_replicas)
+        from .models import pipeline as _pp_model
+        from .ops import pipeline_relay as _pp_relay
+
+        _pp_model.set_schedule(cfg.pp_schedule)
+        _pp_model.set_interleave(cfg.pp_interleave)
+        _pp_model.set_cost_config(cfg)
+        _pp_relay.set_overlap_enabled(cfg.pp_overlap)
         # the program cache's LRU bound follows the config on every
         # assignment (the setter can run from __init__ before the cache
         # exists — construction applies the bound itself then)

@@ -712,6 +712,59 @@ def autotune_zero_fsdp(acc, cfg: Optional[ACCLConfig] = None,
     return cfg.replace(zero_overlap=times["fused"] <= times["flat"])
 
 
+def autotune_pp(acc, cfg: Optional[ACCLConfig] = None,
+                n_micro: Optional[int] = None, d_model: int = 256,
+                n_rows: int = 64, reps: int = 3) -> ACCLConfig:
+    """Measure one 1F1B pipeline train step against the GPipe baseline
+    step of the same stage stack on the live mesh and write the winner
+    to ``cfg.pp_schedule`` — the register the builders' ``schedule=None``
+    resolution consults (through ``resolve_pp_schedule``; an explicit
+    "1f1b"/"gpipe" pins, so the autotuned value replaces the "auto"
+    cost-model arbitration with a measured decision).  ICI only —
+    anywhere else the relay kernel would measure the simulator — and
+    ENGAGE-GATED: a geometry whose relay plan declines passes the
+    config through untouched (the 1F1B arm would time the ppermute
+    fallback, answering a question nobody asked)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..models import pipeline as pp
+    from ..ops import pipeline_relay as relay
+
+    cfg = cfg or acc.config
+    if acc.config.transport != TransportBackend.ICI:
+        return cfg
+    comm = acc.global_comm()
+    W = comm.world_size
+    if W == 1:
+        return cfg
+    M = n_micro if n_micro is not None else max(2 * W, 4)
+    if not relay.relay_engages(n_rows, d_model, np.float32, W,
+                               overlap=None if cfg.pp_overlap else False):
+        return cfg
+    params = pp.shard_stage_params(
+        pp.init_stage_params(jax.random.PRNGKey(0), comm, d_model), comm)
+    rng = np.random.default_rng(0)
+    x = np.zeros((W, M, n_rows, d_model), np.float32)
+    y = np.zeros((W, M, n_rows, d_model), np.float32)
+    x[0] = rng.standard_normal((M, n_rows, d_model)).astype(np.float32) * .1
+    y[-1] = rng.standard_normal((M, n_rows, d_model)).astype(np.float32) * .1
+    sh = comm.sharding(P(pp.AXIS, None, None, None))
+    xg, yg = jax.device_put(x, sh), jax.device_put(y, sh)
+    times = {}
+    for name in ("1f1b", "gpipe"):
+        step = pp.build_pp_train_step(comm, M, d_model, schedule=name)
+        jax.block_until_ready(step(params, xg, yg))  # compile + warm
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(step(params, xg, yg))
+            ts.append(time.perf_counter() - t0)
+        times[name] = float(np.min(ts))
+    winner = "1f1b" if times["1f1b"] <= times["gpipe"] else "gpipe"
+    return cfg.replace(pp_schedule=winner)
+
+
 def autotune_sched_synth(acc, cfg: Optional[ACCLConfig] = None,
                          pows: Sequence[int] = (14, 20),
                          reps: int = 3,
@@ -970,6 +1023,8 @@ def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
             acc, c, reps=reps, dt=dt)),
         ("moe_a2a", lambda c: autotune_moe_a2a(acc, c, reps=reps, dt=dt)),
         ("zero_fsdp", lambda c: autotune_zero_fsdp(acc, c, reps=reps)),
+        # round 17: the pipeline schedule go/no-go (ICI, engage-gated)
+        ("pp", lambda c: autotune_pp(acc, c, reps=reps)),
         ("sched_synth", lambda c: autotune_sched_synth(
             acc, c, reps=reps, dt=dt)),
         # round 13 (inference serving): the small-message latency-tier

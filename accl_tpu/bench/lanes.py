@@ -1015,6 +1015,84 @@ def bench_zero_fsdp(comm, n_layers: int = 2, d_model: int = 256,
     }]
 
 
+def bench_pp_1f1b(comm, n_micro: Optional[int] = None, d_model: int = 256,
+                  n_rows: int = 64, rounds: int = 5) -> List[dict]:
+    """The pipeline schedule A/B: ``pp_1f1b`` times one 1F1B train step
+    (masked-scan schedule, O(world) activation stash, the per-tick
+    bidirectional Pallas relay where its plan engages) against the
+    GPipe baseline step of the SAME stage stack (all-forward-then-all-
+    backward, cond-skipped bubbles — so the A/B measures schedule cost,
+    not wasted FLOPs).
+
+    Headline ``value`` = (best GPipe step) / (1F1B step) — above 1.0
+    the 1F1B schedule wins wall-clock; the memory win (stash_slots vs
+    n_micro stashed microbatches) rides the row either way.  Honesty
+    flags per the lane protocol: ``fused_engaged`` mirrors
+    :func:`accl_tpu.ops.pipeline_relay.relay_engages` for the traced
+    payload under the session register (False on rungs where the relay
+    kernel cannot run — the 1F1B arm then rides the counted ppermute
+    fallback and the headline zeroes), ``schedule``/``schedule_base``
+    pin what each arm actually ran, both schedules' bubble fractions
+    ride beside the measurements, and raw ratios stay on the record."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..models import pipeline as pp
+    from ..ops import pipeline_relay as relay
+
+    W = comm.world_size
+    M = n_micro if n_micro is not None else max(2 * W, 4)
+    step1 = pp.build_pp_train_step(comm, M, d_model, schedule="1f1b")
+    stepg = pp.build_pp_train_step(comm, M, d_model, schedule="gpipe")
+    params = pp.shard_stage_params(
+        pp.init_stage_params(jax.random.PRNGKey(0), comm, d_model), comm)
+    rng = np.random.default_rng(0)
+    x = np.zeros((W, M, n_rows, d_model), np.float32)
+    y = np.zeros((W, M, n_rows, d_model), np.float32)
+    x[0] = rng.standard_normal((M, n_rows, d_model)).astype(np.float32) * .1
+    y[-1] = rng.standard_normal((M, n_rows, d_model)).astype(np.float32) * .1
+    sh = comm.sharding(P(pp.AXIS, None, None, None))
+    xg, yg = jax.device_put(x, sh), jax.device_put(y, sh)
+
+    def timed(step):
+        jax.block_until_ready(step(params, xg, yg))   # compile + warm
+        ts = []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            jax.block_until_ready(step(params, xg, yg))
+            ts.append(time.perf_counter() - t0)
+        return {"best": float(np.min(ts)), "med": float(np.median(ts))}
+
+    t1 = timed(step1)
+    tg = timed(stepg)
+    engaged = relay.relay_engages(n_rows, d_model, np.float32, W)
+    resolved = engaged and t1["med"] > 0
+    ratio_best = tg["best"] / t1["best"] if t1["best"] > 0 else 0.0
+    ratio_med = tg["med"] / t1["med"] if t1["med"] > 0 else 0.0
+    tab = step1.table
+    return [{
+        "metric": "pp_1f1b", "unit": "ratio",
+        "fused_engaged": engaged,
+        "relay_reason": relay.relay_engage_reason(n_rows, d_model,
+                                                  np.float32, W),
+        "resolved": resolved,
+        "value": round(ratio_med if resolved else 0.0, 3),
+        "raw_speedup": round(ratio_best, 3),
+        "raw_speedup_med": round(ratio_med, 3),
+        "onef_us": round(t1["med"] * 1e6, 1),
+        "raw_onef_us": round(t1["best"] * 1e6, 1),
+        "gpipe_us": round(tg["med"] * 1e6, 1),
+        "raw_gpipe_us": round(tg["best"] * 1e6, 1),
+        "rounds": rounds,
+        "schedule": step1.schedule,            # what the 1F1B arm ran
+        "schedule_base": stepg.schedule,
+        "bubble_1f1b": round(tab.bubble_fraction, 4) if tab else None,
+        "bubble_gpipe": round(pp.gpipe_bubble_fraction(W, M), 4),
+        "stash_slots": step1.stash_slots,      # vs M stashed microbatches
+        "world": W, "n_micro": M, "d_model": d_model, "n_rows": n_rows,
+    }]
+
+
 def bench_cmdlist_chain(acc, nbytes: int = 128 << 20, k: int = 64,
                         rounds: int = 7) -> dict:
     """A CommandList of ``k`` chained large combines executed as ONE

@@ -238,6 +238,26 @@ class ACCLConfig:
     zero_overlap: bool = True
     zero_prefetch: bool = True
 
+    # pipeline parallelism (models/pipeline.py + ops/pipeline_relay.py):
+    # pp_schedule picks the microbatch schedule — "1f1b" (one-forward-
+    # one-backward: O(world) activation stash, the production schedule),
+    # "gpipe" (all-forward-then-all-backward: the O(M) baseline and
+    # parity oracle), or "auto" (the round-12 α-β cost model arbitrates
+    # per geometry, relay and tp collective link occupancy priced
+    # jointly — models.pipeline.resolve_pp_schedule, counted under
+    # accl_sched_plan_total{op="pipeline"}). Write-through to
+    # models.pipeline.set_schedule; per-call override on every builder;
+    # bench.autotune_pp measures the go/no-go on the live mesh.
+    # pp_overlap gates the Pallas activation-relay kernel (the double-
+    # buffered credit-semaphore bidirectional hop; ppermute pair when
+    # off or when its plan declines, counted) — write-through to
+    # ops.pipeline_relay.set_overlap_enabled, the cmatmul_overlap
+    # shape. pp_interleave is the virtual-stage count per rank
+    # (Megatron interleaved 1F1B; 1 = plain schedule, the default).
+    pp_schedule: str = "auto"
+    pp_overlap: bool = True
+    pp_interleave: int = 1
+
     # flash-attention backward: "fused" runs the single-pass dK/dV+dQ
     # kernel wherever its VMEM plan fits (two-pass beyond); "two_pass"
     # pins the classic kernel pair everywhere — the A/B switch and the

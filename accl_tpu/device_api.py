@@ -225,6 +225,22 @@ def matmul_alltoall(h, w, axis: str = AXIS, mesh_axes=None,
                               bidirectional, wire_dtype)
 
 
+def pp_relay(fwd, bwd, axis: str = AXIS, mesh_axes=None,
+             overlap: Optional[bool] = None):
+    """In-kernel pipeline-tick relay: ``fwd`` (n, d) shifts one ring hop
+    forward (stage r's activation to stage r+1) while ``bwd`` shifts one
+    hop back (the gradient's reverse hop) — ONE fused double-buffered
+    Pallas exchange when its plan engages (both directions of every ICI
+    link busy; ``ops/pipeline_relay.py``), the counted ``ppermute``
+    pair otherwise.  ``overlap=None`` follows ``ACCLConfig.pp_overlap``;
+    on a multi-axis mesh pass the axis-name order as ``mesh_axes``
+    (remote DMA needs flat device ids).  Differentiable — the VJP is
+    the same relay with the channels swapped."""
+    from .ops import pipeline_relay as pr
+    mesh_axes = tuple(mesh_axes) if mesh_axes else None
+    return pr.pp_relay(fwd, bwd, axis, mesh_axes, overlap)
+
+
 def put_next(x, axis: str = AXIS, offset: int = 1):
     """One-sided put to rank+offset on the ring — the ``stream_put`` analog
     (vadd_put.cpp:26-86 sends its stream to the next rank)."""

@@ -1,0 +1,366 @@
+"""Pipeline activation relay — the pp axis's wire (``models/pipeline.py``).
+
+A 1F1B pipeline tick moves TWO payloads at once: microbatch i's forward
+activation hops one stage *forward* while microbatch i-k's gradient hops
+one stage *backward*.  Both hops ride the same ICI links in opposite
+directions, so a bidirectional torus link can carry both simultaneously —
+exactly the counter-rotating-ring trick of the chunked collectives
+(``parallel/pallas_chunked.py``), applied to a single ring shift instead
+of a full rotation.
+
+This module is that shift as ONE Pallas kernel (``_relay_kernel``):
+
+* two *channels* — channel 0 sends the forward activation to the RIGHT
+  ring neighbor, channel 1 sends the gradient to the LEFT — interleaved
+  segment by segment so both directions of every link are busy while the
+  consuming stage's matmul runs on the MXU;
+* payload stays in HBM (``pl.ANY`` refs); per channel only two staging
+  slots (send) and two landing slots (recv) are VMEM-resident, segments
+  alternating on parity — the double-buffer lets segment c's remote DMA
+  fly while segment c+1 is being staged;
+* a credit semaphore per channel gates slot reuse (grants == gates, the
+  rx-pool backpressure discipline): the upstream writer may overwrite a
+  landing slot only after its owner flushed the slot's previous segment
+  to HBM — validated by the interpret-mode race detector like every
+  chunked kernel.
+
+Dispatch honesty follows the collective-matmul protocol: the kernel runs
+only where :func:`relay_engage_reason` resolves ``None`` (session
+``pp_overlap`` register, rung, VMEM plan); anything else runs the
+unfused ``lax.ppermute`` pair — same math, no overlap — counted under
+``accl_cmatmul_fallback_total{op="pp_relay"}`` (an explicit/session
+overlap-off is a requested baseline, never counted).
+
+:func:`pp_relay` is differentiable: the cotangent of a +1 shift is a -1
+shift, so the VJP is the SAME relay with the channels swapped — the
+backward pass's reverse hop rides the identical kernel.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..communicator import Communicator
+from ..obs import metrics as _metrics
+from ..parallel import pallas_ring as _pr
+from ..parallel.pallas_ring import _LANES
+from .collective_matmul import _flat_ids, _kernels_available, _note_fallback
+
+AXIS = Communicator.AXIS
+
+#: the fallback-counter op label (accl_cmatmul_fallback_total{op=...})
+PP_OP = "pp_relay"
+
+#: per-segment VMEM cap — 2 channels x (2 send + 2 recv) slots stay
+#: resident, so 1 MiB segments bound the kernel to ~8 MiB of VMEM
+VMEM_SEGMENT_CAP = 1 << 20
+
+#: scoped budget for the relay's resident slots (the collective-matmul
+#: discipline: leave headroom for the stage compute sharing the core)
+_VMEM_BUDGET = 12 << 20
+
+
+def _interpret_params():
+    # late-bound through pallas_ring so tests patching
+    # pallas_ring._interpret_params (the race detector) and the
+    # aot_lowering() force-compile context cover this kernel too
+    return _pr._interpret_params()
+
+
+# ---------------------------------------------------------------------------
+# session register (ACCLConfig.pp_overlap write-through, the
+# cmatmul_overlap shape); per-call override on pp_relay
+# ---------------------------------------------------------------------------
+
+_OVERLAP_DEFAULT = True
+
+
+def set_overlap_enabled(enabled: bool) -> None:
+    """Module-default relay mode (``ACCLConfig.pp_overlap`` lands here on
+    every config assignment). Per-call override: ``pp_relay(overlap=)``."""
+    global _OVERLAP_DEFAULT
+    _OVERLAP_DEFAULT = bool(enabled)
+
+
+def get_overlap_enabled() -> bool:
+    return _OVERLAP_DEFAULT
+
+
+# ---------------------------------------------------------------------------
+# geometry plan + engage policy
+# ---------------------------------------------------------------------------
+
+def pp_plan(n: int, d: int, dtype, P: int) -> Optional[dict]:
+    """Segment geometry for one (n, d) relay payload per direction.
+
+    The flat n*d payload pads to C segments of (sr, 128) lanes (sublane
+    tiling honored); resident VMEM = 2 channels x 4 slots x segment.
+    None when even the minimum sublane-aligned segment misses the scoped
+    budget — the caller falls back to the ppermute pair."""
+    if n < 1 or d < 1 or P < 2:
+        return None
+    from ..parallel.pallas_chunked import seg_rows
+    dt = jnp.dtype(dtype)
+    elems = n * d
+    seg_bytes = min(VMEM_SEGMENT_CAP, max(elems * dt.itemsize, 1))
+    sr = seg_rows(seg_bytes, dt)
+    seg_elems = sr * _LANES
+    C = max(-(-elems // seg_elems), 1)
+    vmem = 2 * 4 * seg_elems * dt.itemsize
+    if vmem > _VMEM_BUDGET:
+        return None
+    return {"C": C, "sr": sr, "seg_elems": seg_elems, "vmem_bytes": vmem}
+
+
+def relay_engage_reason(n: int, d: int, dtype, P: int,
+                        overlap: Optional[bool] = None) -> Optional[str]:
+    """None when :func:`pp_relay` would run the FUSED kernel for this
+    payload; otherwise the decline reason in the
+    ``accl_cmatmul_fallback_total`` vocabulary — ``"off"`` (explicit or
+    session overlap-off: a requested baseline, never counted),
+    ``"geometry"`` (a one-rank ring has no hop), ``"no_interpret"``, or
+    ``"vmem_miss"`` (reserved: segmentation caps residency at ~8 MiB so
+    the class is structurally unreachable today; it exists for future
+    per-dtype staging constraints). THE single resolution the dispatch
+    path and every restructuring consumer's honesty flag read (the
+    engage-reason discipline of ``fsdp_engage_reason``)."""
+    if (overlap is not None and not overlap) or \
+            (overlap is None and not _OVERLAP_DEFAULT):
+        return "off"
+    if P < 2:
+        return "geometry"
+    if not _kernels_available():
+        return "no_interpret"
+    if pp_plan(n, d, dtype, P) is None:
+        return "vmem_miss"
+    return None
+
+
+def relay_engages(n: int, d: int, dtype, P: int,
+                  overlap: Optional[bool] = None) -> bool:
+    """:func:`relay_engage_reason` collapsed to a bool."""
+    return relay_engage_reason(n, d, dtype, P, overlap) is None
+
+
+# ---------------------------------------------------------------------------
+# the kernel: bidirectional single-hop shift, double-buffered, credited
+# ---------------------------------------------------------------------------
+
+def _relay_kernel(f_ref, b_ref, fo_ref, bo_ref, send_buf, recv_buf,
+                  send_sem, recv_sem, load_sem, store_sem, cap_sem, *,
+                  C: int, axis: str, mesh_axes: Tuple[str, ...], P: int):
+    """f_ref/b_ref: (C, Sr, 128) payloads in HBM; fo_ref/bo_ref: the
+    received counterparts.  Channel 0 shifts RIGHT (+1 ring hop — the
+    forward activation), channel 1 shifts LEFT (the gradient's reverse
+    hop), so both directions of every link carry payload simultaneously.
+
+    Per channel, segment c (software pipeline over one fori_loop):
+
+    1. *drain* — segment c-2's send from this slot must have left the
+       staging buffer (per-slot send semaphores: DMA completions are
+       unordered, a shared counter could satisfy slot A's drain with
+       slot B's completion);
+    2. *stage* — load segment c from HBM into send slot c%2;
+    3. *gate* — wait one credit before writing the downstream landing
+       slot (its owner must have flushed the slot's c-2 segment);
+    4. *fly* — remote DMA send slot -> neighbor's recv slot c%2;
+    5. *land* — wait the incoming segment, flush it to HBM, then grant
+       the upstream writer a credit for this slot's c+2 reuse.
+
+    Gates fire for c in [2, C); grants for c in [0, C-2) — grants ==
+    gates, every semaphore drains to zero.
+    """
+    _, my, left, right = _flat_ids(axis, mesh_axes, P)
+    # neighbor sync before touching remote buffers (guide local_barrier)
+    bar = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(bar, inc=1, device_id=left,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_signal(bar, inc=1, device_id=right,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(bar, 2)
+
+    chans = (
+        # (chan, src HBM, dst HBM, downstream = who we send to,
+        #  upstream = who writes our landing slots = who we grant to)
+        (0, f_ref, fo_ref, right, left),
+        (1, b_ref, bo_ref, left, right),
+    )
+
+    def _rdma(chan, slot, downstream):
+        return pltpu.make_async_remote_copy(
+            src_ref=send_buf.at[chan, slot],
+            dst_ref=recv_buf.at[chan, slot],
+            send_sem=send_sem.at[chan, slot],
+            recv_sem=recv_sem.at[chan, slot],
+            device_id=downstream,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    def step(c, _):
+        c = jnp.int32(c)
+        slot = lax.rem(c, jnp.int32(2))
+        for chan, src, dst, downstream, upstream in chans:
+            # drain: this slot's c-2 send must have left the staging
+            @pl.when(c >= 2)
+            def _drain(chan=chan, slot=slot, downstream=downstream):
+                _rdma(chan, slot, downstream).wait_send()
+
+            ld = pltpu.make_async_copy(
+                src.at[c], send_buf.at[chan, slot], load_sem.at[chan])
+            ld.start()
+            ld.wait()
+
+            # credit gate: downstream's landing slot c%2 must be free
+            @pl.when(c >= 2)
+            def _gate(chan=chan):
+                pltpu.semaphore_wait(cap_sem.at[chan], 1)
+
+            _rdma(chan, slot, downstream).start()
+
+        for chan, src, dst, downstream, upstream in chans:
+            _rdma(chan, slot, downstream).wait_recv()
+            st = pltpu.make_async_copy(
+                recv_buf.at[chan, slot], dst.at[c], store_sem.at[chan])
+            st.start()
+            st.wait()
+
+            # landing slot flushed -> grant the upstream writer its c+2
+            # reuse (only when a future segment will actually use it)
+            @pl.when(c + 2 <= C - 1)
+            def _grant(chan=chan, upstream=upstream):
+                pltpu.semaphore_signal(
+                    cap_sem.at[chan], inc=1, device_id=upstream,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+        return 0
+
+    lax.fori_loop(0, C, step, 0)
+
+    # epilogue: the last two sends per channel are still undrained
+    for chan, _, _, downstream, _ in chans:
+        _rdma(chan, 0, downstream).wait_send()
+        if C >= 2:
+            _rdma(chan, 1, downstream).wait_send()
+
+
+def _relay_call(f, b, *, C: int, sr: int, dtype, axis: str,
+                mesh_axes: Tuple[str, ...], P: int):
+    shape = jax.ShapeDtypeStruct((C, sr, _LANES), dtype)
+    return pl.pallas_call(
+        functools.partial(_relay_kernel, C=C, axis=axis,
+                          mesh_axes=mesh_axes, P=P),
+        out_shape=(shape, shape),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)),
+        scratch_shapes=[
+            pltpu.VMEM((2, 2, sr, _LANES), dtype),   # send_buf
+            pltpu.VMEM((2, 2, sr, _LANES), dtype),   # recv_buf
+            pltpu.SemaphoreType.DMA((2, 2)),         # send_sem (per slot)
+            pltpu.SemaphoreType.DMA((2, 2)),         # recv_sem
+            pltpu.SemaphoreType.DMA((2,)),           # load_sem
+            pltpu.SemaphoreType.DMA((2,)),           # store_sem
+            pltpu.SemaphoreType.REGULAR((2,)),       # cap_sem (per chan)
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=15),
+        interpret=_interpret_params(),
+    )(f, b)
+
+
+def _kernel_relay(fwd, bwd, axis: str, mesh_axes: Tuple[str, ...],
+                  plan: dict):
+    """Run one fused bidirectional hop through the Pallas kernel:
+    (n, d) payloads pad into the (C, Sr, 128) segment grid and back."""
+    P = lax.axis_size(axis)
+    n, d = fwd.shape
+    C, sr, seg_elems = plan["C"], plan["sr"], plan["seg_elems"]
+
+    def grid(x):
+        flat = jnp.zeros((C * seg_elems,), x.dtype)
+        flat = lax.dynamic_update_slice(flat, x.reshape(-1), (0,))
+        return flat.reshape(C, sr, _LANES)
+
+    fo, bo = _relay_call(grid(fwd), grid(bwd), C=C, sr=sr,
+                         dtype=fwd.dtype, axis=axis,
+                         mesh_axes=mesh_axes, P=P)
+    unpack = lambda o: o.reshape(-1)[: n * d].reshape(n, d)
+    return unpack(fo), unpack(bo)
+
+
+# ---------------------------------------------------------------------------
+# the public op (differentiable; ppermute fallback counted)
+# ---------------------------------------------------------------------------
+
+def _ppermute_relay(fwd, bwd, axis: str):
+    """The unfused fallback: two ppermutes — XLA schedules them
+    independently, so the bidirectional-link overlap is best-effort.
+    Ring orientation comes from the ONE shared helper (`ring._fwd_perm`)
+    so the fallback can never relay opposite to the fused kernel."""
+    from ..parallel.ring import _fwd_perm
+    P = lax.axis_size(axis)
+    f_perm = _fwd_perm(P)
+    b_perm = [(d, s) for s, d in f_perm]     # the inverse hop
+    return lax.ppermute(fwd, axis, f_perm), lax.ppermute(bwd, axis, b_perm)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def pp_relay(fwd, bwd, axis: str = AXIS,
+             mesh_axes: Optional[Tuple[str, ...]] = None,
+             overlap: Optional[bool] = None):
+    """One pipeline tick's activation relay: ``fwd`` (n, d) shifts +1
+    ring hop along ``axis`` (stage r's activation to stage r+1), ``bwd``
+    shifts -1 (the gradient's reverse hop) — both in ONE fused Pallas
+    kernel when the plan engages (see module docstring), the ppermute
+    pair otherwise (counted).  ``overlap=None`` follows the session
+    ``ACCLConfig.pp_overlap`` register; on a multi-axis mesh pass the
+    mesh's axis-name order as ``mesh_axes`` (remote DMA needs flat
+    device ids, the collective-matmul convention).
+
+    Differentiable: the VJP is the same relay with the channels swapped
+    (the cotangent of a +1 shift is a -1 shift)."""
+    return _relay_impl(fwd, bwd, axis, mesh_axes, overlap)
+
+
+def _relay_impl(fwd, bwd, axis, mesh_axes, overlap):
+    if fwd.shape != bwd.shape or fwd.dtype != bwd.dtype:
+        raise ValueError(
+            f"pp_relay payloads must match: fwd {fwd.shape}/{fwd.dtype} "
+            f"vs bwd {bwd.shape}/{bwd.dtype}")
+    if fwd.ndim != 2:
+        raise ValueError(f"pp_relay expects (n, d) payloads, got "
+                         f"{fwd.shape}")
+    P = lax.axis_size(axis)
+    reason = relay_engage_reason(fwd.shape[0], fwd.shape[1], fwd.dtype,
+                                 P, overlap)
+    if reason is None:
+        plan = pp_plan(fwd.shape[0], fwd.shape[1], fwd.dtype, P)
+        axes = tuple(mesh_axes) if mesh_axes else (axis,)
+        _metrics.inc("accl_pp_relay_total", labels=(("path", "fused"),))
+        return _kernel_relay(fwd, bwd, axis, axes, plan)
+    if reason != "off":
+        _note_fallback(PP_OP, reason)
+    _metrics.inc("accl_pp_relay_total", labels=(("path", "ppermute"),))
+    return _ppermute_relay(fwd, bwd, axis)
+
+
+def _relay_fwd(fwd, bwd, axis, mesh_axes, overlap):
+    return _relay_impl(fwd, bwd, axis, mesh_axes, overlap), None
+
+
+def _relay_bwd(axis, mesh_axes, overlap, _res, cts):
+    ct_f, ct_b = cts
+    # reverse of a +1 shift is a -1 shift: run the SAME relay with the
+    # channels swapped — the backward hop rides the identical kernel
+    d_bwd, d_fwd = _relay_impl(ct_b, ct_f, axis, mesh_axes, overlap)
+    return d_fwd, d_bwd
+
+
+pp_relay.defvjp(_relay_fwd, _relay_bwd)

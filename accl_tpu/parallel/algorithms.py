@@ -556,6 +556,31 @@ def build_fsdp_matmul(comm, algo: Algorithm,
     return primitives._smap(comm, body, 2)
 
 
+def build_pipeline_relay(comm, algo: Algorithm) -> Callable:
+    """(world, n, d) forward payloads + (world, n, d) backward payloads
+    -> the pair after ONE pipeline tick's relay: forward shards shift +1
+    ring hop, backward shards -1 — both directions of every link at
+    once.  PALLAS runs the fused double-buffered credit-semaphore kernel
+    (``ops/pipeline_relay.py`` — the 1F1B activation relay); anything
+    else the ``ppermute`` pair.  The standalone program form the bench
+    and schedule suites exercise; the train steps compose the same op
+    through :mod:`accl_tpu.models.pipeline`."""
+    from ..ops import pipeline_relay as pr
+    if algo == Algorithm.PALLAS:
+        pallas_ring._check_multiprocess(comm)
+
+    def body(f, b):
+        fo, bo = pr.pp_relay(f[0], b[0], primitives.AXIS,
+                             (primitives.AXIS,),
+                             overlap=(algo == Algorithm.PALLAS))
+        return fo[None], bo[None]
+
+    from jax.sharding import PartitionSpec as P
+    return primitives._smap(comm, body, 2,
+                            out_specs=(P(primitives.AXIS),
+                                       P(primitives.AXIS)))
+
+
 def build_alltoall_matmul(comm, algo: Algorithm,
                           bidirectional: bool = True,
                           wire_dtype=None) -> Callable:

@@ -70,6 +70,11 @@ def _seg_rows(segment_bytes: int, dtype) -> int:
     return max(-(-rows // mult) * mult, mult)
 
 
+#: public alias — the ONE copy of the sublane-tiled segment-rows rule,
+#: shared with the pipeline activation relay (ops/pipeline_relay.py)
+seg_rows = _seg_rows
+
+
 # ---------------------------------------------------------------------------
 # segmented ring reduce-scatter
 # ---------------------------------------------------------------------------

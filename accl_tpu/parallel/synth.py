@@ -269,6 +269,26 @@ def _ceil_log2(n: int) -> int:
     return max(1, math.ceil(math.log2(n))) if n > 1 else 0
 
 
+def link_cost_us(cfg: ACCLConfig, transport, nbytes: int,
+                 hops: int = 1, channels: int = 1) -> float:
+    """Price ``hops`` sequential ring hops of ``nbytes`` each on one
+    link with the session's α-β parameters — the cost-model primitive
+    consumers OUTSIDE the plan search use to arbitrate cross-axis link
+    occupancy (the pipeline-schedule arbiter prices its per-tick
+    activation relay against the stage's tp collective through this;
+    see ``models/pipeline.resolve_pp_schedule`` and
+    docs/scheduling.md).  ``channels=2`` models a bidirectional hop
+    (both directions of the link carrying half the payload each).
+    ``transport`` accepts the enum or its string value; an unknown
+    string raises (a silent ICI default would misprice DCN links)."""
+    if not isinstance(transport, TransportBackend):
+        transport = TransportBackend(transport)
+    model = CostModel.from_config(cfg, transport)
+    # hops pay α each; the payload crosses each hop's link once
+    return model.alpha_us * hops + hops * float(nbytes) / (
+        max(channels, 1) * model.beta_gbps * 1e3)
+
+
 # ---------------------------------------------------------------------------
 # schedule plans
 # ---------------------------------------------------------------------------

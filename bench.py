@@ -73,7 +73,8 @@ _TRACE_DIR = None
 KNOWN_LANES = (
     "sweep", "obs_overhead", "fault_overhead", "recover_time",
     "cmatmul_ag", "cmatmul_rs", "cmatmul_dw", "cmatmul_stream",
-    "moe_a2a", "moe_a2a_bwd", "zero_fsdp", "sched_synth", "sched_pipeline",
+    "moe_a2a", "moe_a2a_bwd", "zero_fsdp", "pp_1f1b", "sched_synth",
+    "sched_pipeline",
     "hp_compression_cast_roundtrip", "combine_pallas_vs_jnp",
     "flash_attention", "flash_bwd", "cmdlist_chain_combine",
     "small_op_fused_latency",
@@ -455,6 +456,10 @@ def main(argv=None) -> int:
             # ZeRO/FSDP train step vs the flat-ravel baseline schedule
             ("zero_fsdp",
              lambda: _lanes.bench_zero_fsdp(comm, bidirectional=bidir)),
+            # round 17: the pipeline schedule A/B — 1F1B (O(world)
+            # stash, Pallas activation relay) vs the GPipe baseline,
+            # bubble fractions beside the measured step times
+            ("pp_1f1b", lambda: _lanes.bench_pp_1f1b(comm)),
             # round 12: the synthesized multi-axis torus schedule vs
             # the flat logical ring (allreduce / reduce_scatter /
             # all_gather), with the cost model's predictions on record

@@ -261,6 +261,65 @@ def test_recover_time_lane(accl):
         accl.config.heartbeat_timeout_s + accl.config.heartbeat_interval_s)
 
 
+def test_pp_1f1b_lane_schema(accl):
+    """The pipeline schedule A/B lane follows the resolution protocol:
+    fused_engaged mirrors the relay engage resolution (False on this
+    rung — the 1F1B arm rides the counted ppermute fallback and the
+    headline zeroes), both arms' schedules and bubble fractions are
+    pinned, the 1F1B stash is O(world) on the record, and raw ratios
+    survive either way."""
+    from bench import KNOWN_LANES
+    from accl_tpu.bench import lanes
+    from accl_tpu.ops import pipeline_relay as relay
+
+    assert "pp_1f1b" in KNOWN_LANES
+    W = accl.world_size
+    rows = lanes.bench_pp_1f1b(accl.global_comm(), n_micro=W,
+                               d_model=16, n_rows=4, rounds=2)
+    assert [r["metric"] for r in rows] == ["pp_1f1b"]
+    r = rows[0]
+    assert r["unit"] == "ratio"
+    assert r["world"] == W and r["n_micro"] == W
+    assert r["schedule"] == "1f1b" and r["schedule_base"] == "gpipe"
+    assert r["fused_engaged"] == relay.relay_engages(4, 16, "float32", W)
+    assert r["resolved"] == r["fused_engaged"]
+    assert r["raw_speedup_med"] > 0
+    assert r["onef_us"] > 0 and r["gpipe_us"] > 0
+    assert r["stash_slots"] <= W            # the 1F1B memory claim
+    assert 0 <= r["bubble_1f1b"] <= r["bubble_gpipe"] <= 1
+    if not r["resolved"]:
+        assert r["value"] == 0.0
+        assert r["relay_reason"] is not None
+
+
+def test_pp_1f1b_lane_compares(tmp_path):
+    """bench/compare.py schema coverage for the pp_1f1b lane: resolved
+    rows diff as ratios (a drop flags), unresolved rows stay
+    incomparable — the honesty-zeroed headline must never read as a
+    100% regression."""
+    import json as _json
+
+    from accl_tpu.bench import compare
+
+    base = {"metric": "allreduce_ring_algbw_8dev", "value": 10.0,
+            "lanes": [{"metric": "pp_1f1b", "value": 1.5,
+                       "resolved": True}]}
+    new_bad = {"metric": "allreduce_ring_algbw_8dev", "value": 10.0,
+               "lanes": [{"metric": "pp_1f1b", "value": 1.0,
+                          "resolved": True}]}
+    new_flagged = {"metric": "allreduce_ring_algbw_8dev", "value": 10.0,
+                   "lanes": [{"metric": "pp_1f1b", "value": 0.0,
+                              "resolved": False}]}
+    a = tmp_path / "a.json"
+    a.write_text(_json.dumps(base) + "\n")
+    out = compare.compare(compare.load_artifact(str(a)), new_bad)
+    assert out["regressions"] == ["pp_1f1b"]
+    out = compare.compare(compare.load_artifact(str(a)), new_flagged)
+    statuses = {r["metric"]: r["status"] for r in out["rows"]}
+    assert statuses["pp_1f1b"] == "incomparable"
+    assert not out["regressed"]
+
+
 def test_cmatmul_dw_and_stream_lanes_schema(accl):
     """Round-9 lanes follow the resolution protocol on every rung: the
     dw lane's honesty flag mirrors the wgrad plan + rung, the stream

@@ -56,6 +56,11 @@ if [[ $t1_rc -ne 0 ]]; then
         echo "[ci_gate]   table and resolve() decision for any topology with:" >&2
         echo "[ci_gate]   python -m accl_tpu.parallel.synth --explain allreduce 8388608 2x4" >&2
     fi
+    if grep -qaE "test_pipeline_schedule|pp_relay|pp_pipeline|resolve_pp_schedule|n_micro >= world" /tmp/_t1.log; then
+        echo "[ci_gate] hint: pipeline-plan failure — inspect the 1F1B table," >&2
+        echo "[ci_gate]   stash bound and schedule arbitration for the geometry with:" >&2
+        echo "[ci_gate]   python -m accl_tpu.models.pipeline --explain 4 8    # world n_micro [interleave]" >&2
+    fi
     exit "$t1_rc"
 fi
 
