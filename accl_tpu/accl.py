@@ -96,6 +96,9 @@ class ACCL:
 
         _flash_ops.set_flash_bwd_mode(cfg.flash_bwd)
         _flash_ops.set_flash_decode_mode(cfg.flash_decode)
+        _flash_ops.set_flash_prefill_mode(cfg.flash_prefill)
+        _flash_ops.set_kv_cache_dtype(cfg.kv_cache_dtype)
+        _flash_ops.set_kv_quant_scale(cfg.kv_quant_scale)
         _cm_ops.set_overlap_enabled(cfg.cmatmul_overlap)
         _cm_ops.set_overlap_thresholds(cfg.ag_matmul_threshold,
                                        cfg.rs_matmul_threshold)

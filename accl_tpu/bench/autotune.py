@@ -967,6 +967,128 @@ def autotune_decode(acc, cfg: Optional[ACCLConfig] = None,
     return cfg.replace(flash_decode=winner)
 
 
+def autotune_prefill(acc, cfg: Optional[ACCLConfig] = None,
+                     H: int = 8, hkv: int = 2, d: int = 128,
+                     page: int = 64, pages_max: int = 8,
+                     reps: int = 5) -> ACCLConfig:
+    """Measure the PAGED chunked-prefill kernel against the unpaged
+    gathered-chain reference over one plan-sized chunk on the live chip
+    and write the winner to ``cfg.flash_prefill`` — the
+    ``autotune_decode`` shape for the admission path.  TPU-only (the
+    interpret rung measures the emulator); single-chip, any world
+    size."""
+    import jax
+    cfg = cfg or acc.config
+    if jax.default_backend() != "tpu":
+        return cfg
+    import jax.numpy as jnp
+    from ..ops import flash
+
+    # plan with the measurement's REAL widths (f32 operands + pools) so
+    # the chunk we time is one flash_prefill's own plan admits — else
+    # the "paged" side silently measures the fallback and the A/B is
+    # noise
+    plan, _ = flash.prefill_plan(H, hkv, d, page, pages_max,
+                                 itemsize=4, kv_itemsize=4)
+    if plan is None:
+        return cfg.replace(flash_prefill="unpaged")
+    C = plan["chunk"]
+    rng = np.random.default_rng(0)
+    n_pages = 2 * pages_max
+    kp = jnp.zeros((hkv, n_pages, page, d), jnp.float32)
+    vp = jnp.zeros_like(kp)
+    bt = jnp.arange(n_pages, dtype=jnp.int32).reshape(2, pages_max)
+    lens = jnp.zeros((2,), jnp.int32)
+    q, kc, vc = (jnp.asarray(rng.standard_normal(s).astype(np.float32)
+                             * 0.1)
+                 for s in ((C, H, d), (C, hkv, d), (C, hkv, d)))
+    times = {}
+    for mode in ("paged", "unpaged"):
+        prog = jax.jit(functools.partial(flash.flash_prefill, slot=0,
+                                         prefill_mode=mode))
+        jax.block_until_ready(prog(q, kc, vc, kp, vp, bt, lens))
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(prog(q, kc, vc, kp, vp, bt, lens))
+            ts.append(time.perf_counter() - t0)
+        times[mode] = float(np.min(ts))
+    winner = "paged" if times["paged"] <= times["unpaged"] else "unpaged"
+    return cfg.replace(flash_prefill=winner)
+
+
+def autotune_spec_decode(acc, cfg: Optional[ACCLConfig] = None,
+                         B: int = 8, H: int = 8, hkv: int = 2,
+                         d: int = 128, page: int = 64,
+                         pages_max: int = 8,
+                         spans: Sequence[int] = (2, 4, 8),
+                         reps: int = 5) -> ACCLConfig:
+    """Measure ALL-ACCEPT speculative throughput per draft span k —
+    one multi-query launch vs the k sequential single-token launches
+    it replaces — and write the LARGEST winning k to
+    ``cfg.spec_decode_tokens`` (1 when no span wins: the serving loop
+    then stays on plain decode).  The all-accept ratio is the
+    UPPER bound of the speculative win; real accept rates scale it,
+    which is the serving loop's call.  TPU-only, single-chip."""
+    import jax
+    cfg = cfg or acc.config
+    if jax.default_backend() != "tpu":
+        return cfg
+    import jax.numpy as jnp
+    from ..ops import flash
+
+    rng = np.random.default_rng(0)
+    n_pages = B * pages_max
+    kp = jnp.asarray(rng.standard_normal(
+        (hkv, n_pages, page, d)).astype(np.float32) * 0.1)
+    vp = jnp.asarray(rng.standard_normal(
+        (hkv, n_pages, page, d)).astype(np.float32) * 0.1)
+    bt = jnp.arange(n_pages, dtype=jnp.int32).reshape(B, pages_max)
+    lens0 = jnp.full((B,), (pages_max * page) // 2, jnp.int32)
+
+    def best_time(prog, *args):
+        jax.block_until_ready(prog(*args))
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(prog(*args))
+            ts.append(time.perf_counter() - t0)
+        return float(np.min(ts))
+
+    winner = 1
+    for k in spans:
+        plan, _ = flash.decode_plan(B, H, hkv, d, page, pages_max,
+                                    4, span=k)
+        if plan is None or (pages_max * page) // 2 + k > pages_max * page:
+            continue
+        q = jnp.asarray(rng.standard_normal((B, k, H, d))
+                        .astype(np.float32) * 0.1)
+        kn = jnp.asarray(rng.standard_normal((B, k, hkv, d))
+                         .astype(np.float32) * 0.1)
+        vn = jnp.asarray(rng.standard_normal((B, k, hkv, d))
+                         .astype(np.float32) * 0.1)
+
+        def spec(q, kn, vn, kp, vp, lens):
+            kp2, vp2, l2 = flash.kv_cache_append_multi(kp, vp, bt, lens,
+                                                       kn, vn)
+            return flash.flash_decode_multi(q, kp2, vp2, bt, l2)
+
+        def seq(q, kn, vn, kp, vp, lens, k=k):
+            outs = []
+            for j in range(k):
+                kp, vp, lens = flash.kv_cache_append(kp, vp, bt, lens,
+                                                     kn[:, j], vn[:, j])
+                outs.append(flash.flash_decode(q[:, j], kp, vp, bt,
+                                               lens))
+            return jnp.stack(outs, axis=1)
+
+        t_spec = best_time(jax.jit(spec), q, kn, vn, kp, vp, lens0)
+        t_seq = best_time(jax.jit(seq), q, kn, vn, kp, vp, lens0)
+        if t_spec < t_seq:
+            winner = k
+    return cfg.replace(spec_decode_tokens=winner)
+
+
 def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
                      reps: int = 3,
                      dt: dataType = dataType.float32) -> ACCLConfig:
@@ -991,9 +1113,11 @@ def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
         get_logger("accl").info(
             "autotune: world=1 — collective crossovers are degenerate; "
             "keeping default thresholds (the single-chip flash bwd and "
-            "decode crossovers still run)")
-        return autotune_decode(acc, autotune_flash_bwd(acc, reps=reps),
-                               reps=reps)
+            "serving-datapath crossovers still run)")
+        cfg = autotune_decode(acc, autotune_flash_bwd(acc, reps=reps),
+                              reps=reps)
+        cfg = autotune_prefill(acc, cfg, reps=reps)
+        return autotune_spec_decode(acc, cfg, reps=reps)
     from ..obs import trace as _trace
 
     with _trace.span("autotune.allreduce", cat="autotune"):
@@ -1032,6 +1156,12 @@ def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
         ("latency_tier", lambda c: autotune_latency_tier(
             acc, c, reps=reps, dt=dt)),
         ("decode", lambda c: autotune_decode(acc, c, reps=reps)),
+        # round 18 (serving throughput): the chunked-prefill paged/
+        # unpaged go/no-go and the speculative draft-span sweep
+        # (TPU-backend-gated, any world size)
+        ("prefill", lambda c: autotune_prefill(acc, c, reps=reps)),
+        ("spec_decode", lambda c: autotune_spec_decode(
+            acc, c, reps=reps)),
         ("flash_bwd", lambda c: autotune_flash_bwd(acc, c, reps=reps)),
     ]
     try:

@@ -275,6 +275,43 @@ class ACCLConfig:
     # bench.autotune_decode.
     flash_decode: str = "paged"
 
+    # chunked PREFILL (round 18): "paged" runs the chunked-prefill
+    # kernel — the flash forward writing its K/V tiles straight into
+    # the paged block-table layout, page-granular chunks sharing the
+    # decode kernel's scalar-prefetch page walk — wherever
+    # ``flash.prefill_plan`` admits the geometry; "unpaged" pins the
+    # gathered-chain lax reference.  Written through to
+    # ops.flash.set_flash_prefill_mode; per-call override via
+    # ``prefill_mode``.  Seeded on the live chip by
+    # bench.autotune_prefill.
+    flash_prefill: str = "paged"
+
+    # speculative multi-token decode: the default draft span k for the
+    # serving loop (S_q = k query rows per step through the paged
+    # kernel, verify-and-accept in the epilogue). 1 = plain one-token
+    # decode (the round-13 step, byte-identical). The register is the
+    # measured go/no-go bench.autotune_spec_decode writes: the largest
+    # swept k whose all-accept tokens/s beats k sequential steps, else
+    # 1. Builders take k explicitly; this is the session default the
+    # serving loop reads.
+    spec_decode_tokens: int = 1
+
+    # paged-KV quantization AT REST (round 18): the at-rest codec of
+    # the decode page pools — "off" stores the model dtype (bit-exact
+    # writes, the pre-quantization contract), "bf16" halves f32 pools,
+    # "bf16_sr" is the stochastic-rounding bf16 write lane (TPU-only
+    # SR), "int8" the 2x-vs-bf16 headline: the registry's fixed-scale
+    # quantized-integer codec applied at rest with IN-KERNEL dequant on
+    # the K/V read sweep and quant on every append/prefill write.
+    # Write-through to ops.flash.set_kv_cache_dtype; reads are dtype-
+    # driven off the pool, so a register change never strands an
+    # existing pool. kv_quant_scale is the int8 codec's fixed scale
+    # (wire value = clip(round(x*scale), ±127) — the
+    # arithconfig.quant_scale discipline: no overflow signalling, size
+    # it to the K/V value range).
+    kv_cache_dtype: str = "off"
+    kv_quant_scale: float = 32.0
+
     # small-message latency tier (parallel/synth.py + the eager
     # protocol): below this many payload bytes (each op's select() byte
     # convention) the α-dominated regime rules — the schedule

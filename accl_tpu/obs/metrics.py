@@ -37,8 +37,10 @@ name                                           kind       labels
 ``accl_select_decline_total``                  counter    op, reason
 ``accl_program_cache_total``                   counter    event (hit | miss | evict)
 ``accl_program_cache_size``                    gauge      (none)
-``accl_latency_dispatch_seconds``              histogram  path (µs-resolution buckets)
+``accl_latency_dispatch_seconds``              histogram  path (µs-resolution buckets; eager_send | collective | prefill | decode | verify)
 ``accl_flash_decode_fallback_total``           counter    reason (mode | geometry | vmem_miss)
+``accl_flash_prefill_fallback_total``          counter    reason (mode | geometry | vmem_miss)
+``accl_serving_tokens_total``                  counter    phase (prefill | decode | verify), accepted (true | false)
 ``accl_fault_injected_total``                  counter    point, kind (fault.py chaos harness)
 ``accl_rpc_retry_total``                       counter    point (RetryPolicy absorbed transients)
 ``accl_peer_death_total``                      counter    proc (heartbeat-lease death verdicts)
@@ -339,8 +341,11 @@ def note_latency_dispatch(path: str, t0: float) -> None:
     4x-spaced buckets cannot resolve a p99 for ops whose whole budget is
     tens of µs). ``path`` names the fast path that ran (``eager_send`` —
     the single-segment eager fast path; ``collective`` — a bandwidth
-    collective below ``latency_tier_threshold``). No-op when disabled or
-    when ``t0`` is 0.0 (the disabled :func:`tick` sentinel)."""
+    collective below ``latency_tier_threshold``; ``prefill`` /
+    ``decode`` / ``verify`` — the serving tier's step-dispatch phases,
+    observed by the ``models.decode`` step wrappers). No-op when
+    disabled or when ``t0`` is 0.0 (the disabled :func:`tick`
+    sentinel)."""
     if not ENABLED or not t0:
         return
     REGISTRY.observe("accl_latency_dispatch_seconds",

@@ -1347,6 +1347,29 @@ def flash_attention_packed(q, k, v, causal: bool = False,
 _DECODE_MODES = ("paged", "unpaged")
 _DECODE_MODE = "paged"
 
+#: prefill-path mode, same contract (``ACCLConfig.flash_prefill`` via
+#: ``set_flash_prefill_mode``; per-call override ``prefill_mode``):
+#: "paged" runs the chunked-prefill Pallas kernel wherever
+#: ``prefill_plan`` admits the geometry, "unpaged" pins the gathered-
+#: chain lax reference everywhere.
+_PREFILL_MODES = ("paged", "unpaged")
+_PREFILL_MODE = "paged"
+
+#: KV-at-rest codec for the page pools (``ACCLConfig.kv_cache_dtype``
+#: via ``set_kv_cache_dtype``): "off" stores pages in the model dtype
+#: (bit-exact writes — the pre-quantization contract), "bf16" halves
+#: f32 pools, "bf16_sr" is the stochastic-rounding bf16 write lane
+#: (TPU-only SR; deterministic cast elsewhere — the compression.py
+#: contract), "int8" is the 2x-vs-bf16 headline codec: the registry's
+#: fixed-scale quantized-integer lane (clip(round(x*scale))) applied at
+#: rest, dequantized IN-KERNEL on the K/V read sweep.
+_KV_DTYPES = ("off", "bf16", "int8", "bf16_sr")
+_KV_DTYPE = "off"
+#: fixed quantization scale of the int8 at-rest codec (the
+#: ``arithconfig.quant_scale`` discipline: wire value = clip(round(
+#: x*scale)), no overflow signalling — size it to the K/V value range).
+_KV_QUANT_SCALE = 32.0
+
 
 def set_flash_decode_mode(mode: str) -> None:
     """Set the module-default decode mode (``ACCLConfig.flash_decode``
@@ -1361,14 +1384,126 @@ def get_flash_decode_mode() -> str:
     return _DECODE_MODE
 
 
+def set_flash_prefill_mode(mode: str) -> None:
+    """Set the module-default prefill mode (``ACCLConfig.flash_prefill``
+    lands here at session init). Per-call override: ``prefill_mode``."""
+    global _PREFILL_MODE
+    if mode not in _PREFILL_MODES:
+        raise ValueError(
+            f"flash_prefill mode {mode!r} not in {_PREFILL_MODES}")
+    _PREFILL_MODE = mode
+
+
+def get_flash_prefill_mode() -> str:
+    return _PREFILL_MODE
+
+
+def set_kv_cache_dtype(mode: str) -> None:
+    """Set the at-rest KV codec (``ACCLConfig.kv_cache_dtype`` lands
+    here at session init). Write-path only: reads infer the codec from
+    the pool's storage dtype, so existing pools stay readable across a
+    register change."""
+    global _KV_DTYPE
+    if mode not in _KV_DTYPES:
+        raise ValueError(f"kv_cache_dtype {mode!r} not in {_KV_DTYPES}")
+    _KV_DTYPE = mode
+
+
+def get_kv_cache_dtype() -> str:
+    return _KV_DTYPE
+
+
+def set_kv_quant_scale(scale: float) -> None:
+    """Set the int8 at-rest codec's fixed scale
+    (``ACCLConfig.kv_quant_scale``). Must be positive — the dequant
+    divides by it."""
+    global _KV_QUANT_SCALE
+    if not scale > 0:
+        raise ValueError(f"kv_quant_scale must be > 0, got {scale}")
+    _KV_QUANT_SCALE = float(scale)
+
+
+def get_kv_quant_scale() -> float:
+    return _KV_QUANT_SCALE
+
+
+def kv_storage_dtype(compute_dtype, mode: Optional[str] = None):
+    """The page pools' at-rest dtype under codec ``mode`` (None = the
+    session register): what ``init_decode_state`` allocates and the
+    write paths cast to."""
+    mode = mode or _KV_DTYPE
+    if mode not in _KV_DTYPES:
+        raise ValueError(f"kv_cache_dtype {mode!r} not in {_KV_DTYPES}")
+    if mode == "off":
+        return compute_dtype
+    if mode == "int8":
+        return jnp.int8
+    return jnp.bfloat16        # bf16 / bf16_sr store the same width
+
+
+def quantize_kv(x, pool_dtype, mode: Optional[str] = None, seed=None):
+    """Cast new K/V rows to the pool's at-rest dtype. Codec selection is
+    dtype-driven (int8 pools quantize with the fixed scale; float pools
+    cast), with ``mode`` (None = session register) only distinguishing
+    the bf16 deterministic/stochastic-rounding write lanes. ``mode ==
+    "off"`` is the plain ``astype`` — BIT-EXACT for same-dtype pools,
+    the pre-quantization write."""
+    mode = mode or _KV_DTYPE
+    pool_dtype = jnp.dtype(pool_dtype)
+    if pool_dtype == jnp.int8:
+        s = jnp.asarray(x, _F32) * _KV_QUANT_SCALE
+        return jnp.clip(jnp.round(s), -127, 127).astype(jnp.int8)
+    if (mode == "bf16_sr" and pool_dtype == jnp.bfloat16
+            and jnp.dtype(x.dtype) == jnp.float32):
+        from . import compression
+        if seed is None:
+            # per-execution seed folded over the payload's bits (the
+            # collective_matmul._wire_cast idiom): the append paths run
+            # inside ONE compiled step per session, so a constant seed
+            # would replay the identical PRNG stream every token —
+            # each lane rounding the same way each step re-introduces
+            # exactly the accumulated bias SR exists to kill. The
+            # wrapping int32 sum sees every bit flip anywhere in the
+            # new rows.
+            bits = jax.lax.bitcast_convert_type(
+                x.astype(_F32).reshape(-1), jnp.int32)
+            seed = jnp.sum(bits, dtype=jnp.int32)
+        return compression.pallas_compress_stochastic(x, jnp.bfloat16,
+                                                      seed)
+    return x.astype(pool_dtype)
+
+
+def dequantize_kv(pages, compute_dtype=_F32):
+    """Inverse of :func:`quantize_kv` for host/reference reads: int8
+    pools divide the fixed scale back out; float pools widen."""
+    if jnp.dtype(pages.dtype) == jnp.int8:
+        return pages.astype(compute_dtype) / _KV_QUANT_SCALE
+    return pages.astype(compute_dtype)
+
+
+def _kv_inv_scale(pool_dtype) -> Optional[float]:
+    """The in-kernel dequant multiplier for a pool dtype (None = no
+    dequant needed: float pools feed the MXU directly)."""
+    if jnp.dtype(pool_dtype) == jnp.int8:
+        return 1.0 / _KV_QUANT_SCALE
+    return None
+
+
 def _count_decode_fallback(reason: str) -> None:
     from ..obs import metrics as _metrics
     _metrics.inc("accl_flash_decode_fallback_total",
                  labels=(("reason", reason),))
 
 
+def _count_prefill_fallback(reason: str) -> None:
+    from ..obs import metrics as _metrics
+    _metrics.inc("accl_flash_prefill_fallback_total",
+                 labels=(("reason", reason),))
+
+
 def decode_plan(B: int, H: int, H_kv: int, d: int, page: int,
-                pages_max: int, itemsize: int = 2):
+                pages_max: int, itemsize: int = 2, span: int = 1,
+                kv_itemsize: Optional[int] = None):
     """Block-geometry policy of the paged decode kernel: the (gp, dp)
     tile it runs at, or ``(None, reason)`` when the paged path must
     decline (caller falls back to the unpaged lax reference).
@@ -1376,24 +1511,32 @@ def decode_plan(B: int, H: int, H_kv: int, d: int, page: int,
     * ``geometry``: the paged tile wants lane-exact head dims (d a
       128-lane multiple — decode never pays the `_pad_head_dim` pass,
       padding the whole PAGE POOL per step would defeat the layout) and
-      sublane-tiled pages (page % 8);
+      sublane-tiled pages (page % 8; int8 at-rest pools pack 32
+      sublanes per tile, so ``kv_itemsize == 1`` tightens the rule to
+      page % 32);
     * ``vmem_miss``: double-buffered k/v pages + the (gp, dp) q/out/acc
       tiles + the (gp, page) score/prob pair must fit the scoped-VMEM
       budget.
 
-    ``gp`` is the GQA group size g = H/H_kv rounded up to the 8-sublane
-    tile (dense attention runs g = 1 on a padded tile — the pad rows
-    are zero queries whose output is sliced away).  Returns
-    ``({"gp", "dp", "vmem"}, "ok")`` on success."""
-    if H % H_kv or B < 1 or pages_max < 1:
+    ``span`` is the number of query rows PER GQA GROUP sharing the page
+    sweep — 1 for plain decode, k for speculative multi-token decode,
+    the chunk length for prefill tiles. ``gp`` is g·span (g = H/H_kv)
+    rounded up to the 8-sublane tile (dense single-token attention runs
+    g = 1 on a padded tile — the pad rows are zero queries whose output
+    is sliced away). ``kv_itemsize`` is the PAGE POOL's at-rest element
+    width when it differs from the operand's (the quantized-KV case).
+    Returns ``({"gp", "dp", "vmem"}, "ok")`` on success."""
+    if H % H_kv or B < 1 or pages_max < 1 or span < 1:
         return None, "geometry"
     if d % 128 or d == 0:
         return None, "geometry"
-    if page % 8 or page == 0:
+    kvi = kv_itemsize if kv_itemsize is not None else itemsize
+    sub = 32 if kvi == 1 else 8
+    if page % sub or page == 0:
         return None, "geometry"
     g = H // H_kv
-    gp = -(-g // 8) * 8
-    est = (4 * page * d * itemsize        # k/v pages, double-buffered
+    gp = -(-g * span // 8) * 8
+    est = (4 * page * d * kvi             # k/v pages, double-buffered
            + 3 * gp * d * 4               # q + out + acc tiles
            + 2 * gp * 128 * 4             # m/l carry
            + 2 * gp * page * 4)           # s/p tiles
@@ -1410,7 +1553,8 @@ def _resolve_decode(decode_mode: Optional[str]) -> str:
 
 
 def _decode_kernel(lens_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
-                   acc_ref, m_ref, l_ref, *, page: int, scale: float):
+                   acc_ref, m_ref, l_ref, *, page: int, scale: float,
+                   kv_inv: Optional[float] = None):
     b = pl.program_id(0)
     j = pl.program_id(2)          # page sweep (innermost: scratch carries)
     npg = pl.num_programs(2)
@@ -1424,10 +1568,18 @@ def _decode_kernel(lens_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
 
     def _block():
         q = q_ref[0, 0]                                     # (gp, dp)
+        # quantized-at-rest pools dequant ON the read sweep: one f32
+        # widen + scale multiply per page tile, never a materialized
+        # full-precision cache (kv_inv None = float pools ride the MXU
+        # mixed-precision path unchanged — the pre-quantization trace)
+        kb, vb = k_ref[0, 0], v_ref[0, 0]
+        if kv_inv is not None:
+            kb = kb.astype(_F32) * kv_inv
+            vb = vb.astype(_F32) * kv_inv
         # exp2-domain online softmax — the forward's carry loop with the
         # page sweep as the only k axis (see _kernel)
         s = jax.lax.dot_general(
-            q, k_ref[0, 0], (((1,), (1,)), ((), ())),
+            q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=_F32) * (scale * _LOG2E)  # (gp, page)
         cols = j * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         # causal mask at the page boundary: the tail page's columns past
@@ -1440,7 +1592,7 @@ def _decode_kernel(lens_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
         alpha = jnp.exp2(m_prev - m_new)
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, -1, keepdims=True)
         pv = jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
             preferred_element_type=_F32)
         acc_ref[:] = acc_ref[:] * alpha[:, :1] + pv
         m_ref[:] = m_new
@@ -1458,12 +1610,86 @@ def _decode_kernel(lens_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
 
 
+def _decode_span_kernel(lens_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
+                        acc_ref, m_ref, l_ref, *, page: int, scale: float,
+                        span: int, kv_inv: Optional[float] = None):
+    """Multi-query-row page sweep: S_q = span > 1 query rows per GQA
+    group share ONE walk of the slot's page chain — the speculative-
+    decode and chunked-prefill tile. Row layout is (g, span) row-major,
+    so row r's query is the slot's token at position ``len - span +
+    (r % span)`` (``lens_ref`` holds the length AFTER the span's tokens
+    landed) and its causal horizon is per ROW: ``cols <= pos`` — the
+    page-boundary mask of :func:`_decode_kernel` generalized from one
+    scalar length to a per-row vector. Everything else (exp2 online-
+    softmax carry, dead-page whole-block skip against the TILE's max
+    length, zero-length exact zeros, in-sweep dequant) is the single-
+    query kernel verbatim; span == 1 collapses to the same mask values,
+    but callers route span == 1 through :func:`_decode_kernel` so the
+    plain decode step stays byte-identical to round 13."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    npg = pl.num_programs(2)
+    length = lens_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def _block():
+        q = q_ref[0, 0]                                     # (gp, dp)
+        kb, vb = k_ref[0, 0], v_ref[0, 0]
+        if kv_inv is not None:
+            kb = kb.astype(_F32) * kv_inv
+            vb = vb.astype(_F32) * kv_inv
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=_F32) * (scale * _LOG2E)  # (gp, page)
+        cols = j * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        # per-row causal horizon: row r (layout (g, span) row-major,
+        # pad rows past g*span recycle the modulus harmlessly — their
+        # output is sliced away) is the token at len - span + r%span,
+        # attending columns 0..pos inclusive
+        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        row_len = length - span + 1 + rows % span
+        s = jnp.where(cols < row_len, s, _NEG_INF)
+        m_prev = m_ref[:]
+        row_max = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, row_max)
+        p = jnp.exp2(s - m_new[:, :1])
+        alpha = jnp.exp2(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, -1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=_F32)
+        acc_ref[:] = acc_ref[:] * alpha[:, :1] + pv
+        m_ref[:] = m_new
+
+    # dead pages: fully past even the LAST row's horizon (length is the
+    # tile max — earlier rows' extra blocks are exact no-ops under the
+    # full -inf mask: p underflows to 0.0, m/l/acc carry unchanged)
+    pl.when(j * page < length)(_block)
+
+    @pl.when(j == npg - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l > 0, l, 1.0)
+        o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+
+
 def _flash_decode_paged(q4, k_pages, v_pages, block_tables, seq_lens,
-                        sc: float, gp: int):
+                        sc: float, gp: int, span: int = 1):
     B, hkv, _, dp = q4.shape
     page = k_pages.shape[2]
     pages_max = block_tables.shape[1]
-    kernel = functools.partial(_decode_kernel, page=page, scale=sc)
+    kv_inv = _kv_inv_scale(k_pages.dtype)
+    if span == 1:
+        kernel = functools.partial(_decode_kernel, page=page, scale=sc,
+                                   kv_inv=kv_inv)
+    else:
+        kernel = functools.partial(_decode_span_kernel, page=page,
+                                   scale=sc, span=span, kv_inv=kv_inv)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, hkv, pages_max),
@@ -1510,25 +1736,36 @@ def _gather_pages(pages, block_tables):
 
 
 def _decode_reference(q, k_pages, v_pages, block_tables, seq_lens,
-                      sc: float):
+                      sc: float, span: int = 1):
     """Unpaged lax decode reference — the honest fallback (same math:
-    gather the page chains, one dense masked softmax per slot)."""
-    B, H, d = q.shape
+    gather the page chains, one dense masked softmax per slot). With
+    ``span > 1``, ``q`` is (B, span, H, d) and row j's causal horizon is
+    ``seq_lens - span + 1 + j`` (the multi-query kernel's per-row mask);
+    quantized pools dequantize on the gathered chains."""
+    if span == 1:
+        B, H, d = q.shape
+        q = q[:, None]
+    else:
+        B, _, H, d = q.shape
     hkv = k_pages.shape[0]
     g = H // hkv
-    k = _gather_pages(k_pages, block_tables).astype(_F32)  # (B, hkv, S, d)
-    v = _gather_pages(v_pages, block_tables).astype(_F32)
-    qg = q.reshape(B, hkv, g, d).astype(_F32)
-    s = jnp.einsum("bhgd,bhsd->bhgs", qg, k) * sc
-    live = (jnp.arange(k.shape[2])[None, :]
-            < seq_lens[:, None])[:, None, None, :]
+    k = dequantize_kv(_gather_pages(k_pages, block_tables))  # (B,hkv,S,d)
+    v = dequantize_kv(_gather_pages(v_pages, block_tables))
+    qg = q.reshape(B, span, hkv, g, d).astype(_F32)
+    s = jnp.einsum("bjhgd,bhsd->bjhgs", qg, k) * sc
+    row_len = (seq_lens[:, None] - span + 1
+               + jnp.arange(span)[None, :])              # (B, span)
+    live = (jnp.arange(k.shape[2])[None, None, :]
+            < row_len[:, :, None])[:, :, None, None, :]
     s = jnp.where(live, s, _NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     p = jnp.where(live, p, 0.0)   # a fully-masked (retired) slot -> zeros
     l = jnp.sum(p, axis=-1, keepdims=True)
-    out = jnp.einsum("bhgs,bhsd->bhgd", p / jnp.where(l > 0, l, 1.0), v)
-    return out.reshape(B, H, d).astype(q.dtype)
+    out = jnp.einsum("bjhgs,bhsd->bjhgd",
+                     p / jnp.where(l > 0, l, 1.0), v)
+    out = out.reshape(B, span, H, d).astype(q.dtype)
+    return out[:, 0] if span == 1 else out
 
 
 def flash_decode(q, k_pages, v_pages, block_tables, seq_lens,
@@ -1576,7 +1813,8 @@ def flash_decode(q, k_pages, v_pages, block_tables, seq_lens,
                                  seq_lens, sc)
     page = k_pages.shape[2]
     plan, reason = decode_plan(B, H, hkv, d, page,
-                               block_tables.shape[1], q.dtype.itemsize)
+                               block_tables.shape[1], q.dtype.itemsize,
+                               kv_itemsize=k_pages.dtype.itemsize)
     if plan is None:
         _count_decode_fallback(reason)
         return _decode_reference(q, k_pages, v_pages, block_tables,
@@ -1592,6 +1830,74 @@ def flash_decode(q, k_pages, v_pages, block_tables, seq_lens,
     return out[:, :, :g, :].reshape(B, H, d)
 
 
+def flash_decode_multi(q, k_pages, v_pages, block_tables, seq_lens,
+                       scale: Optional[float] = None,
+                       decode_mode: Optional[str] = None):
+    """Speculative / batched multi-token attention over the paged cache:
+    ``q`` is (B, k, H, d) — k > 1 query rows per slot in ONE launch, row
+    j the slot's token at position ``seq_lens[b] - k + j`` (``seq_lens``
+    counts the cache AFTER the k draft tokens landed — append the span
+    with :func:`kv_cache_append_multi` FIRST, exactly the single-token
+    contract). Each row's causal horizon is its own position, so the
+    result is bit-identical to k sequential :func:`flash_decode` steps
+    over the growing cache — the verify-and-accept epilogue can compare
+    draft streams against it row for row.
+
+    Returns (B, k, H, d). k == 1 delegates to :func:`flash_decode`
+    (the round-13 single-query kernel, byte-identical by construction).
+    The paged path shares the decode kernel's page walk with the causal
+    mask generalized to a per-row vector (``_decode_span_kernel``); the
+    same ``decode_plan`` policy gates it at ``span = k`` (k query rows
+    multiply the q/out/acc tile sublanes) with the same counted unpaged
+    fallback. Quantized pools dequant on the read sweep, as in decode."""
+    B, span, H, d = q.shape
+    if span == 1:
+        return flash_decode(q[:, 0], k_pages, v_pages, block_tables,
+                            seq_lens, scale=scale,
+                            decode_mode=decode_mode)[:, None]
+    if k_pages.shape != v_pages.shape or k_pages.ndim != 4 \
+            or k_pages.shape[3] != d:
+        raise ValueError(
+            f"k/v pages {k_pages.shape}/{v_pages.shape} incompatible with "
+            f"q {q.shape}: need (H_kv, n_pages, page, d)")
+    hkv = k_pages.shape[0]
+    if H % hkv:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {hkv}")
+    if block_tables.shape[0] != B or seq_lens.shape != (B,):
+        raise ValueError(
+            f"block_tables {block_tables.shape} / seq_lens "
+            f"{seq_lens.shape} must lead with the slot dim B={B}")
+    sc = scale if scale is not None else 1.0 / (d ** 0.5)
+    mode = _resolve_decode(decode_mode)
+    if mode != "paged":
+        _count_decode_fallback("mode")
+        return _decode_reference(q, k_pages, v_pages, block_tables,
+                                 seq_lens, sc, span=span)
+    page = k_pages.shape[2]
+    plan, reason = decode_plan(B, H, hkv, d, page,
+                               block_tables.shape[1], q.dtype.itemsize,
+                               span=span,
+                               kv_itemsize=k_pages.dtype.itemsize)
+    if plan is None:
+        _count_decode_fallback(reason)
+        return _decode_reference(q, k_pages, v_pages, block_tables,
+                                 seq_lens, sc, span=span)
+    g = H // hkv
+    gp = plan["gp"]
+    # row layout (g, span) row-major per kv head — the kernel's r%span
+    # position arithmetic
+    q4 = q.reshape(B, span, hkv, g, d).transpose(0, 2, 3, 1, 4)
+    q4 = q4.reshape(B, hkv, g * span, d)
+    if gp != g * span:
+        q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, gp - g * span), (0, 0)))
+    lens = seq_lens.astype(jnp.int32)
+    bt = block_tables.astype(jnp.int32)
+    out = _flash_decode_paged(q4, k_pages, v_pages, bt, lens, sc, gp,
+                              span=span)
+    out = out[:, :, :g * span, :].reshape(B, hkv, g, span, d)
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, span, H, d)
+
+
 def kv_cache_append(k_pages, v_pages, block_tables, seq_lens,
                     k_new, v_new, active=None):
     """Write each slot's NEW token into its page chain in place and
@@ -1600,33 +1906,272 @@ def kv_cache_append(k_pages, v_pages, block_tables, seq_lens,
     ``block_tables[b, pos // page]``, row ``pos % page``.  Returns
     ``(k_pages', v_pages', seq_lens')``.
 
-    ``active`` (optional (B,) bool) masks retired slots: an inactive
-    slot's cache and length are left untouched (its target row is
-    written back unchanged — a scatter lane must name SOME row, so
-    block-table rows stay valid-for-writing even while retired, which
-    slot disjointness guarantees).  Callers own two invariants: block
-    tables name DISJOINT pool pages across slots, and ``seq_lens`` never
-    grows past ``pages_max * page``.  Fully functional (jit/scan-safe):
-    XLA's donation turns the ``.at[].set`` into an in-place update in a
-    compiled step."""
-    B = k_new.shape[0]
+    Page-boundary contract (the round-18 edge fix): the page walk is
+    positional, so the token that exactly fills a page (``pos % page ==
+    page - 1``) — including the one that fills the slot's LAST page —
+    ADVANCES through the block table and is written; only a token one
+    past capacity (``pos == pages_max·page``) is masked, and that guard
+    now lives IN here: the write lane is dropped (``mode="drop"`` — no
+    clamped gather silently redirecting the row into an earlier page,
+    which is what the old caller-owned guard protected against) and the
+    length stays pinned at capacity.  ``active`` (optional (B,) bool)
+    masks retired slots the same way: cache and length untouched, no
+    write lane emitted at all.
+
+    New rows are cast through :func:`quantize_kv` to the pool's at-rest
+    dtype — a plain ``astype`` when ``kv_cache_dtype`` is off (bit-exact
+    for same-dtype pools, the pre-quantization behavior), the fixed-
+    scale int8 quant for int8 pools, stochastic rounding on the bf16_sr
+    write lane.  Callers still own one invariant: block tables name
+    DISJOINT pool pages across slots.  Fully functional (jit/scan-
+    safe): XLA's donation turns the ``.at[].set`` into an in-place
+    update in a compiled step."""
     page = k_pages.shape[2]
+    pages_max = block_tables.shape[1]
     pos = seq_lens.astype(jnp.int32)
-    pidx = jnp.take_along_axis(block_tables.astype(jnp.int32),
-                               (pos // page)[:, None], axis=1)[:, 0]
-    off = pos % page
-    kn = jnp.swapaxes(k_new, 0, 1).astype(k_pages.dtype)   # (hkv, B, d)
-    vn = jnp.swapaxes(v_new, 0, 1).astype(v_pages.dtype)
+    ok = pos < pages_max * page
     if active is not None:
-        keep = active[None, :, None]
-        kn = jnp.where(keep, kn, k_pages[:, pidx, off, :])
-        vn = jnp.where(keep, vn, v_pages[:, pidx, off, :])
-        new_lens = seq_lens + active.astype(seq_lens.dtype)
-    else:
-        new_lens = seq_lens + 1
-    return (k_pages.at[:, pidx, off, :].set(kn),
-            v_pages.at[:, pidx, off, :].set(vn),
+        ok = ok & active
+    pidx = jnp.take_along_axis(
+        block_tables.astype(jnp.int32),
+        jnp.clip(pos // page, 0, pages_max - 1)[:, None], axis=1)[:, 0]
+    # masked lanes point one past the pool and DROP in the scatter —
+    # never a write-back dance that could collide with a live lane
+    pidx = jnp.where(ok, pidx, k_pages.shape[1])
+    off = pos % page
+    kn = quantize_kv(jnp.swapaxes(k_new, 0, 1), k_pages.dtype)
+    vn = quantize_kv(jnp.swapaxes(v_new, 0, 1), v_pages.dtype)
+    new_lens = seq_lens + ok.astype(seq_lens.dtype)
+    return (k_pages.at[:, pidx, off, :].set(kn, mode="drop"),
+            v_pages.at[:, pidx, off, :].set(vn, mode="drop"),
             new_lens)
+
+
+def kv_cache_append_multi(k_pages, v_pages, block_tables, seq_lens,
+                          k_new, v_new, count=None, active=None):
+    """Append UP TO T tokens per slot in one scatter: ``k_new``/
+    ``v_new`` are (B, T, H_kv, d), token j of slot b lands at logical
+    position ``seq_lens[b] + j`` — pool page ``block_tables[b,
+    (pos+j) // page]``, row ``(pos+j) % page``: a PER-TOKEN page walk,
+    so a span crossing a page boundary (or exactly filling the slot's
+    last page) advances through the block table mid-span instead of
+    folding every token into the first page's index.  Returns
+    ``(k_pages', v_pages', seq_lens')``.
+
+    ``count`` (optional (B,) int) appends only the first ``count[b]``
+    tokens of each slot's span (the speculative-decode accept length /
+    a prefill chunk's live tail); ``active`` masks whole slots.  Writes
+    past capacity are dropped and the length is capped — the
+    :func:`kv_cache_append` guard, per token.  New rows quantize to the
+    pool's at-rest dtype like the single-token append; at
+    ``kv_cache_dtype="off"`` the pool bytes are BIT-identical to T
+    sequential :func:`kv_cache_append` calls."""
+    B, T = k_new.shape[:2]
+    page = k_pages.shape[2]
+    pages_max = block_tables.shape[1]
+    cap = pages_max * page
+    pos = (seq_lens.astype(jnp.int32)[:, None]
+           + jnp.arange(T, dtype=jnp.int32)[None, :])       # (B, T)
+    ok = pos < cap
+    if count is not None:
+        ok = ok & (jnp.arange(T)[None, :] < count[:, None])
+    if active is not None:
+        ok = ok & active[:, None]
+    pidx = jnp.take_along_axis(block_tables.astype(jnp.int32),
+                               jnp.clip(pos // page, 0, pages_max - 1),
+                               axis=1)                      # (B, T)
+    pidx = jnp.where(ok, pidx, k_pages.shape[1])
+    off = pos % page
+    # (hkv, B, T, d) rows to match the pools' leading head axis
+    kn = quantize_kv(jnp.moveaxis(k_new, 2, 0), k_pages.dtype)
+    vn = quantize_kv(jnp.moveaxis(v_new, 2, 0), v_pages.dtype)
+    new_lens = seq_lens + jnp.sum(ok, axis=1).astype(seq_lens.dtype)
+    return (k_pages.at[:, pidx, off, :].set(kn, mode="drop"),
+            v_pages.at[:, pidx, off, :].set(vn, mode="drop"),
+            new_lens)
+
+
+def kv_cache_rollback(k_pages, v_pages, block_tables, seq_lens,
+                      saved_k, saved_v, accept, span: int):
+    """Undo the rejected tail of a speculative span: positions
+    ``seq_lens - span + accept[b] ..`` (``seq_lens`` counts the cache
+    AFTER the span landed) get their pre-append page rows restored from
+    ``saved_k``/``saved_v`` ((B, span, H_kv, d) — what
+    :func:`kv_cache_read_rows` captured before the append), and the
+    lengths roll back to ``seq_lens - span + accept``.  Block-table
+    VALUE changes only: no shape moves, the compiled step invariant.
+    ``accept == span`` restores nothing — an all-accept span is
+    untouched, so the rollback is exact-identity there."""
+    B = accept.shape[0]
+    page = k_pages.shape[2]
+    pages_max = block_tables.shape[1]
+    base = seq_lens.astype(jnp.int32) - span
+    pos = base[:, None] + jnp.arange(span, dtype=jnp.int32)[None, :]
+    # restore lanes: rejected (j >= accept) AND actually written (the
+    # append's own capacity guard — never "restore" an unwritten row)
+    ok = ((jnp.arange(span)[None, :] >= accept[:, None])
+          & (pos >= 0) & (pos < pages_max * page))
+    pidx = jnp.take_along_axis(block_tables.astype(jnp.int32),
+                               jnp.clip(pos // page, 0, pages_max - 1),
+                               axis=1)
+    pidx = jnp.where(ok, pidx, k_pages.shape[1])
+    off = pos % page
+    kn = jnp.moveaxis(saved_k, 2, 0).astype(k_pages.dtype)
+    vn = jnp.moveaxis(saved_v, 2, 0).astype(v_pages.dtype)
+    new_lens = (base + jnp.clip(accept, 0, span)).astype(seq_lens.dtype)
+    return (k_pages.at[:, pidx, off, :].set(kn, mode="drop"),
+            v_pages.at[:, pidx, off, :].set(vn, mode="drop"),
+            new_lens)
+
+
+def kv_cache_read_rows(k_pages, v_pages, block_tables, seq_lens,
+                       span: int):
+    """Gather the ``span`` page rows each slot's next append would
+    overwrite (positions ``seq_lens[b] .. seq_lens[b]+span-1``, clamped
+    in-pool) — the speculative step's rollback snapshot, captured
+    BEFORE :func:`kv_cache_append_multi`.  Returns (saved_k, saved_v),
+    each (B, span, H_kv, d) in the POOL dtype (the restore must be
+    bit-exact, so no dequant round-trip)."""
+    page = k_pages.shape[2]
+    pages_max = block_tables.shape[1]
+    pos = (seq_lens.astype(jnp.int32)[:, None]
+           + jnp.arange(span, dtype=jnp.int32)[None, :])
+    pos = jnp.clip(pos, 0, pages_max * page - 1)
+    pidx = jnp.take_along_axis(block_tables.astype(jnp.int32),
+                               pos // page, axis=1)
+    off = pos % page
+    saved_k = jnp.moveaxis(k_pages[:, pidx, off, :], 0, 2)  # (B,span,hkv,d)
+    saved_v = jnp.moveaxis(v_pages[:, pidx, off, :], 0, 2)
+    return saved_k, saved_v
+
+
+def prefill_plan(H: int, H_kv: int, d: int, page: int, pages_max: int,
+                 itemsize: int = 2, chunk: Optional[int] = None,
+                 kv_itemsize: Optional[int] = None):
+    """Block-geometry policy of the chunked-prefill kernel — the
+    ``decode_plan`` discipline at ``span = chunk``: the chunk is the
+    query-row span sharing one scalar-prefetch page walk, so the plan is
+    the decode plan with g·chunk query rows per tile. With ``chunk``
+    given, validates that geometry (PAGE-GRANULAR chunks only —
+    ``chunk % page == 0`` keeps every kernel launch's write/read
+    footprint whole pages, and the q tile sublane-aligned whenever page
+    % 8 is); with ``chunk=None``, picks the LARGEST page-multiple chunk
+    ≤ 512 whose tile fits the scoped-VMEM budget (the admission loop's
+    chunk size — bigger chunks amortize the page sweep, the budget caps
+    the q/out/acc tiles).  Returns ``({"chunk", "gp", "dp", "vmem"},
+    "ok")`` or ``(None, reason)`` in the house style."""
+    if chunk is not None:
+        if chunk < 1 or chunk % page:
+            return None, "geometry"
+        plan, reason = decode_plan(1, H, H_kv, d, page, pages_max,
+                                   itemsize, span=chunk,
+                                   kv_itemsize=kv_itemsize)
+        if plan is None:
+            return None, reason
+        return {"chunk": chunk, **plan}, "ok"
+    best = None
+    c = page
+    while c <= 512:
+        plan, _ = decode_plan(1, H, H_kv, d, page, pages_max, itemsize,
+                              span=c, kv_itemsize=kv_itemsize)
+        if plan is not None:
+            best = {"chunk": c, **plan}
+        c += page
+    if best is None:
+        # even a one-page chunk misses: report the one-page reason
+        _, reason = decode_plan(1, H, H_kv, d, page, pages_max, itemsize,
+                                span=page, kv_itemsize=kv_itemsize)
+        return None, reason
+    return best, "ok"
+
+
+def _resolve_prefill(prefill_mode: Optional[str]) -> str:
+    mode = prefill_mode or _PREFILL_MODE
+    if mode not in _PREFILL_MODES:
+        raise ValueError(
+            f"prefill_mode {mode!r} not in {_PREFILL_MODES}")
+    return mode
+
+
+def flash_prefill(q, k, v, k_pages, v_pages, block_tables, seq_lens,
+                  slot, live=None, scale: Optional[float] = None,
+                  prefill_mode: Optional[str] = None):
+    """One chunk of one slot's prompt, admitted STRAIGHT into the paged
+    layout: the chunk's K/V rows land in the slot's page chain (per-
+    token page walk, quantized to the pool's at-rest dtype — at
+    ``kv_cache_dtype="off"`` the pool bytes are bit-identical to a
+    :func:`kv_cache_append` token loop) and the chunk's causal
+    attention runs over EVERYTHING written so far — earlier chunks'
+    pages plus the chunk itself — in one multi-query page sweep, so a
+    prompt enters the batch without ever materializing a monolithic
+    unpaged cache.
+
+    ``q``: (C, H, d) — the chunk's query rows; ``k``/``v``: (C, H_kv,
+    d); ``slot`` the target slot index (python int or traced); the
+    chunk starts at the slot's current ``seq_lens[slot]`` (the online-
+    softmax carry across chunks is POSITIONAL: chunk n's rows attend
+    chunk 0..n's pages through the same per-row causal horizon the
+    speculative kernel uses, so no inter-chunk state is carried on the
+    host).  ``live`` (default C) marks a final partial chunk: only the
+    first ``live`` rows are written/counted, rows past it are padding
+    whose outputs the caller slices away.  Returns ``(out, k_pages',
+    v_pages', seq_lens')`` with ``out``: (C, H, d).
+
+    The paged path (``prefill_plan`` admits, ``ACCLConfig.
+    flash_prefill``/"paged") runs the decode kernel family's scalar-
+    prefetch page walk at span = C; anything less falls back to the
+    gathered-chain lax reference — same math, counted per reason under
+    ``accl_flash_prefill_fallback_total``.  Chunks are page-granular
+    (C % page == 0) on the paged path; capacity overflow is guarded
+    like every append (over-cap rows dropped, length capped)."""
+    C, H, d = q.shape
+    if k.shape != v.shape or k.shape != (C, k.shape[1], d):
+        raise ValueError(
+            f"k/v chunk {k.shape}/{v.shape} incompatible with q "
+            f"{q.shape}: need (C, H_kv, d)")
+    hkv = k.shape[1]
+    if H % hkv:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {hkv}")
+    sc = scale if scale is not None else 1.0 / (d ** 0.5)
+    page = k_pages.shape[2]
+    pages_max = block_tables.shape[1]
+    slot = jnp.asarray(slot, jnp.int32)
+    bt_row = jax.lax.dynamic_slice_in_dim(
+        block_tables.astype(jnp.int32), slot, 1, axis=0)    # (1, pmax)
+    lens_row = jax.lax.dynamic_slice_in_dim(seq_lens, slot, 1, axis=0)
+    count = (None if live is None
+             else jnp.asarray(live, jnp.int32).reshape(1))
+    kp2, vp2, lens_row2 = kv_cache_append_multi(
+        k_pages, v_pages, bt_row, lens_row, k[None], v[None],
+        count=count)
+    new_lens = jax.lax.dynamic_update_slice(
+        seq_lens, lens_row2.astype(seq_lens.dtype), (slot,))
+    # attention runs at the FULL chunk geometry (base = start + C):
+    # rows past `live` are padding — their horizons reach unwritten
+    # rows and their outputs are sliced by the caller
+    attn_lens = (lens_row.astype(jnp.int32) + C)
+    mode = _resolve_prefill(prefill_mode)
+    plan, reason = (None, "mode")
+    if mode == "paged":
+        plan, reason = prefill_plan(H, hkv, d, page, pages_max,
+                                    q.dtype.itemsize, chunk=C,
+                                    kv_itemsize=k_pages.dtype.itemsize)
+    if plan is None:
+        _count_prefill_fallback(reason)
+        out = _decode_reference(q[None], kp2, vp2, bt_row, attn_lens,
+                                sc, span=C)[0]
+        return out, kp2, vp2, new_lens
+    g = H // hkv
+    gp = plan["gp"]
+    q4 = q.reshape(1, C, hkv, g, d).transpose(0, 2, 3, 1, 4)
+    q4 = q4.reshape(1, hkv, g * C, d)
+    if gp != g * C:
+        q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, gp - g * C), (0, 0)))
+    out = _flash_decode_paged(q4, kp2, vp2, bt_row, attn_lens, sc, gp,
+                              span=C)
+    out = out[:, :, :g * C, :].reshape(1, hkv, g, C, d)
+    out = out.transpose(0, 3, 1, 2, 4).reshape(C, H, d)
+    return out, kp2, vp2, new_lens
 
 
 def _flash_bwd_kv(q, k, v, do, lse, dd, causal, sc, block_q, block_k):

@@ -81,6 +81,10 @@ KNOWN_LANES = (
     # round 13 (inference serving): the first LATENCY lanes — p50/p99
     # per launch, direction=lower (bench/compare.py inverts)
     "flash_decode", "coll_latency",
+    # round 18 (serving throughput): chunked prefill vs the token-loop
+    # admission path, speculative multi-token decode (tokens-accepted/s)
+    # and the at-rest KV quantization bytes/latency A/B
+    "prefill_chunk", "decode_spec", "kv_quant",
 )
 
 
@@ -482,6 +486,23 @@ def main(argv=None) -> int:
              lambda: (_lanes.bench_flash_decode() if on_tpu
                       else _lanes.bench_flash_decode(
                           B=2, H=4, page=8, pages_max=2, rounds=3))),
+            # round 18 (serving throughput): single-chip kernel lanes,
+            # same tiny-smoke policy off-silicon
+            ("prefill_chunk",
+             lambda: (_lanes.bench_prefill_chunk() if on_tpu
+                      else _lanes.bench_prefill_chunk(
+                          H=4, hkv=2, page=8, pages_max=2, chunk=16,
+                          rounds=2))),
+            ("decode_spec",
+             lambda: (_lanes.bench_decode_spec() if on_tpu
+                      else _lanes.bench_decode_spec(
+                          B=2, H=4, hkv=2, page=8, pages_max=2, k=2,
+                          rounds=2))),
+            ("kv_quant",
+             lambda: (_lanes.bench_kv_quant() if on_tpu
+                      else _lanes.bench_kv_quant(
+                          B=2, H=4, hkv=2, page=32, pages_max=2,
+                          rounds=2))),
         ):
             if not _lane_selected(lanes_filter, name):
                 continue
@@ -529,6 +550,11 @@ def main(argv=None) -> int:
                 # round 13: the paged decode kernel's p50/p99 latency
                 # (direction=lower; single-chip — per-chip kernel)
                 ("flash_decode", lanes.bench_flash_decode),
+                # round 18 (serving throughput): chunked prefill,
+                # speculative multi-token decode, KV quantization
+                ("prefill_chunk", lanes.bench_prefill_chunk),
+                ("decode_spec", lanes.bench_decode_spec),
+                ("kv_quant", lanes.bench_kv_quant),
                 ("cmdlist_chain_combine",
                  lambda: lanes.bench_cmdlist_chain(acc)),
                 ("small_op_fused_latency",

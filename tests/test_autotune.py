@@ -504,3 +504,19 @@ def test_autotune_sched_synth_gates(accl):
         assert cfg.sched_pipeline_chunks in (1, 2, 4)
     finally:
         accl.config = orig
+
+
+def test_autotune_serving_throughput_gates(accl):
+    """Round-18 serving autotunes measure only on a real TPU backend
+    (the interpret rung would tune the emulator): on this rung both
+    pass the config through untouched, and both are wired into
+    autotune_session's stage list + the world-1 single-chip chain."""
+    import inspect
+
+    cfg = autotune.autotune_prefill(accl)
+    assert cfg.flash_prefill == accl.config.flash_prefill
+    cfg = autotune.autotune_spec_decode(accl)
+    assert cfg.spec_decode_tokens == accl.config.spec_decode_tokens
+    src = inspect.getsource(autotune.autotune_session)
+    assert "autotune_prefill" in src
+    assert "autotune_spec_decode" in src

@@ -777,3 +777,88 @@ def test_compare_flags_calibration_drift():
     bad = artifact(10.0)
     bad["lanes"][0]["error"] = "boom"
     assert cmp.compare(artifact(90.0), bad)["calibration_warnings"] == []
+
+
+def test_prefill_chunk_lane_schema():
+    """Round-18 serving lane: the chunked-prefill latency row follows
+    the flash_decode protocol (direction=lower, honesty flags, zeroed
+    headline off-silicon) and carries the token-loop A/B."""
+    from accl_tpu.bench import lanes
+
+    [r] = lanes.bench_prefill_chunk(H=4, hkv=2, page=8, pages_max=2,
+                                    chunk=16, rounds=2)
+    assert r["metric"] == "prefill_chunk"
+    assert r["unit"] == "us" and r["direction"] == "lower"
+    assert r["plan_mode"] == "paged" and r["plan_reason"] == "ok"
+    assert r["fused_engaged"] is False        # no TPU backend here
+    assert r["resolved"] is False and r["value"] == 0.0
+    assert r["p50_us"] > 0 and r["p99_us"] >= r["p50_us"]
+    assert r["loop_p50_us"] > 0 and r["speedup_p50"] is not None
+    assert r["chunk"] == 16 and r["tokens_per_s"] > 0
+    assert r["prefill_plan"]["chunk"] == 16
+
+
+def test_decode_spec_lane_schema():
+    """Round-18 serving lane: tokens-accepted/s headline (higher-
+    better, the default compare polarity — no direction tag), the
+    k-sequential A/B on record, honesty-zeroed off-silicon."""
+    from accl_tpu.bench import lanes
+
+    [r] = lanes.bench_decode_spec(B=2, H=4, hkv=2, page=8, pages_max=2,
+                                  k=2, rounds=2)
+    assert r["metric"] == "decode_spec"
+    assert r["unit"] == "tokens/s" and "direction" not in r
+    assert r["plan_mode"] == "paged" and r["plan_reason"] == "ok"
+    assert r["fused_engaged"] is False and r["resolved"] is False
+    assert r["value"] == 0.0 and r["tokens_per_s"] > 0
+    assert r["p50_us"] > 0 and r["seq_p50_us"] > 0
+    assert r["speedup_p50"] is not None and r["k"] == 2
+
+
+def test_kv_quant_lane_schema():
+    """Round-18 serving lane: the bytes/slot reduction headline is an
+    exact layout fact (resolved when the quantized plan admits — int8
+    vs the bf16 baseline is 2x by construction); the latency A/B rides
+    beside it gated by its own timing_engaged flag."""
+    from accl_tpu.bench import lanes
+
+    [r] = lanes.bench_kv_quant(B=2, H=4, hkv=2, page=32, pages_max=2,
+                               rounds=2)
+    assert r["metric"] == "kv_quant_int8"
+    assert r["kv_cache_dtype"] == "int8" and r["plan_reason"] == "ok"
+    assert r["resolved"] is True
+    assert r["value"] == r["kv_bytes_ratio"] == 2.0
+    assert r["kv_bytes_per_slot_base"] == 2 * r["kv_bytes_per_slot"]
+    assert r["timing_engaged"] is False       # CPU rung times itself
+    assert r["p50_us"] > 0 and r["base_p50_us"] > 0
+    assert 0 < r["max_err_vs_base"] < 0.1     # codec tolerance, nonzero
+    assert r["quant_scale"] == 32.0
+
+
+def test_serving_lanes_in_known_lanes_and_compare():
+    """bench.py --lanes accepts the round-18 lanes, and compare.py
+    applies the right polarity to each: prefill_chunk inverts
+    (direction=lower), decode_spec and kv_quant keep higher-better."""
+    from bench import KNOWN_LANES
+    from accl_tpu.bench import compare
+
+    for name in ("prefill_chunk", "decode_spec", "kv_quant"):
+        assert name in KNOWN_LANES
+
+    def art(pre, spec, quant):
+        return {"metric": "m", "value": 1.0, "lanes": [
+            {"metric": "prefill_chunk", "value": pre,
+             "resolved": True, "direction": "lower"},
+            {"metric": "decode_spec", "value": spec, "resolved": True},
+            {"metric": "kv_quant_int8", "value": quant,
+             "resolved": True}]}
+
+    names = ("prefill_chunk", "decode_spec", "kv_quant_int8")
+    base = art(100.0, 5000.0, 2.0)
+    out = compare.compare(base, art(130.0, 4000.0, 1.0))
+    st = {r["metric"]: r["status"] for r in out["rows"]}
+    assert all(st[n] == "regression" for n in names)
+    out = compare.compare(base, art(80.0, 6000.0, 4.0))
+    st = {r["metric"]: r["status"] for r in out["rows"]}
+    assert all(st[n] == "improvement" for n in names)
+    assert not out["regressed"]

@@ -61,6 +61,11 @@ if [[ $t1_rc -ne 0 ]]; then
         echo "[ci_gate]   stash bound and schedule arbitration for the geometry with:" >&2
         echo "[ci_gate]   python -m accl_tpu.models.pipeline --explain 4 8    # world n_micro [interleave]" >&2
     fi
+    if grep -qaE "test_serving|flash_prefill|spec_decode|kv_quant|kv_cache_append|decode_span" /tmp/_t1.log; then
+        echo "[ci_gate] hint: serving-throughput failure — isolate the tier with:" >&2
+        echo "[ci_gate]   JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q" >&2
+        echo "[ci_gate]   and A/B the kernels with: python bench.py --lanes prefill_chunk,decode_spec,kv_quant" >&2
+    fi
     exit "$t1_rc"
 fi
 
