@@ -107,6 +107,11 @@ class ACCL:
         _cm_ops.set_wire_dtype(cfg.cmatmul_wire_dtype)
         _a2a_ops.set_overlap_enabled(cfg.moe_overlap)
         _a2a_ops.set_overlap_threshold(cfg.a2a_matmul_threshold)
+        # the DCN cross-slice wire dtype (two-tier schedules) validates
+        # and writes through like the cmatmul wire register
+        from .parallel import hierarchical as _hier
+
+        _hier.set_dcn_wire_dtype(cfg.dcn_wire_dtype)
         from .models import zero as _zero_model
 
         _zero_model.set_overlap_enabled(cfg.zero_overlap)
@@ -880,22 +885,53 @@ class ACCL:
             return 1
         return max(1, int(self.config.sched_pipeline_chunks))
 
+
+    @staticmethod
+    def _dcn_wire_inert(dtype: dataType, arith) -> bool:
+        """Delegates to ``hierarchical.dcn_wire_inert`` — the ONE
+        predicate, defined beside the codec it describes, for whether
+        the DCN cross-slice wire can actually compress this call."""
+        from .parallel import hierarchical as _hier
+        return _hier.dcn_wire_inert(dtype, arith)
+
+    def _twotier_params(self, comm, algo, plan):
+        """(slices x per-slice shape, cross-slice wire dtype) for a
+        TWOTIER program — both part of its cache key (a re-declared
+        slice split or a re-tuned ``dcn_wire_dtype`` must not reuse a
+        stale program) and the builder's arguments. The resolved plan
+        is authoritative (the program built matches exactly what the
+        plan counters and the wire-bytes accounting claim); an EXPLICIT
+        ``algorithm=TWOTIER`` request carries no plan and resolves the
+        physical ``hosts_shape`` (factor2d on single-host rigs — the
+        bench A/B control) plus the session wire register."""
+        if algo != Algorithm.TWOTIER:
+            return (None, None)
+        if plan is not None:
+            return (plan.param("shape2d"),
+                    plan.param("dcn_wire_dtype", "off"))
+        return (algorithms._twotier_shape(comm, None),
+                self.config.dcn_wire_dtype)
+
     def _spec_allgather(self, comm, count: int, dtype: dataType,
                         compress_dtype, algorithm):
         arith = self._arith(dtype, compress_dtype)
         algo, plan = algorithms.select_plan(
             operation.allgather, count * constants.dtype_size(dtype),
-            comm, self.config, algorithm)
+            comm, self.config, algorithm, count=count,
+            wire_inert=self._dcn_wire_inert(dtype, arith))
         seg = self.config.segment_size
         bidir = self.config.bidirectional_rings
         ms = self._mesh_shape(comm, algo)
         pc = self._pipeline_chunks(algo, plan)
+        ts, dw = self._twotier_params(comm, algo, plan)
         return (self._key(comm, operation.allgather, count, dtype,
-                          compress_dtype, algo, seg, bidir, ms, pc),
+                          compress_dtype, algo, seg, bidir, ms, pc, ts,
+                          dw),
                 lambda: algorithms.build_allgather(comm, algo, arith, dtype,
                                                    seg, bidir,
-                                                   mesh_shape=ms,
-                                                   pipeline_chunks=pc))
+                                                   mesh_shape=ms or ts,
+                                                   pipeline_chunks=pc,
+                                                   dcn_wire_dtype=dw))
 
     def _spec_scatter(self, comm, count: int, dtype: dataType, root: int,
                       compress_dtype, algorithm):
@@ -961,7 +997,8 @@ class ACCL:
             raise ACCLError(errorCode.ARITH_ERROR, f"{function} unsupported")
         algo, plan = algorithms.select_plan(
             operation.allreduce, count * constants.dtype_size(dtype),
-            comm, self.config, algorithm)
+            comm, self.config, algorithm, count=count,
+            wire_inert=self._dcn_wire_inert(dtype, arith))
         fanin = (self.config.gather_flat_tree_max_fanin
                  if algo == Algorithm.FLAT else 0)
         seg = self.config.segment_size
@@ -969,14 +1006,16 @@ class ACCL:
         on_dcn = self.config.transport == TransportBackend.DCN
         ms = self._mesh_shape(comm, algo)
         pc = self._pipeline_chunks(algo, plan)
+        ts, dw = self._twotier_params(comm, algo, plan)
         return (self._key(comm, operation.allreduce, count, dtype, function,
                           compress_dtype, algo, seg, fanin, bidir, on_dcn,
-                          ms, pc),
+                          ms, pc, ts, dw),
                 lambda: algorithms.build_allreduce(comm, function, dtype,
                                                    algo, arith, seg, fanin,
                                                    bidir, on_dcn=on_dcn,
-                                                   mesh_shape=ms,
-                                                   pipeline_chunks=pc))
+                                                   mesh_shape=ms or ts,
+                                                   pipeline_chunks=pc,
+                                                   dcn_wire_dtype=dw))
 
     def _spec_reduce_scatter(self, comm, count: int, dtype: dataType,
                              function: reduceFunction, compress_dtype,
@@ -987,19 +1026,23 @@ class ACCL:
         algo, plan = algorithms.select_plan(
             operation.reduce_scatter,
             count * comm.world_size * constants.dtype_size(dtype),
-            comm, self.config, algorithm)
+            comm, self.config, algorithm,
+            count=count * comm.world_size,
+            wire_inert=self._dcn_wire_inert(dtype, arith))
         seg = self.config.segment_size
         bidir = self.config.bidirectional_rings
         ms = self._mesh_shape(comm, algo)
         pc = self._pipeline_chunks(algo, plan)
+        ts, dw = self._twotier_params(comm, algo, plan)
         return (self._key(comm, operation.reduce_scatter, count, dtype,
                           function, compress_dtype, algo, seg, bidir, ms,
-                          pc),
+                          pc, ts, dw),
                 lambda: algorithms.build_reduce_scatter(comm, function,
                                                         dtype, algo, arith,
                                                         seg, bidir,
-                                                        mesh_shape=ms,
-                                                        pipeline_chunks=pc))
+                                                        mesh_shape=ms or ts,
+                                                        pipeline_chunks=pc,
+                                                        dcn_wire_dtype=dw))
 
     # ------------------------------------------------------------------
     # primitives: copy / combine
@@ -2120,6 +2163,7 @@ class ACCL:
         ``initialize()`` (the PERFCNT readout for this session)."""
         import json as _json
 
+        from .parallel.synth import dcn_wire_totals as _dcn_totals
         from .parallel.synth import plan_cache_stats as _synth_stats
 
         progs, hits, misses = self._programs.stats()
@@ -2184,6 +2228,7 @@ class ACCL:
             # the synth schedule-plan cache, beside the program cache it
             # feeds (module-global, reset per session by initialize())
             "sched_plan_cache": _synth_stats(),
+            "dcn_wire": _dcn_totals(),
             "queue": {"inflight": len(self._queue.inflight)},
             "scheduler": {"parked_continuations": len(self._parked_calls),
                           "fresh_depth": fresh, "retry_depth": retry},

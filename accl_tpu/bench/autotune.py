@@ -846,6 +846,73 @@ def autotune_sched_synth(acc, cfg: Optional[ACCLConfig] = None,
     return cfg.replace(sched_pipeline_chunks=best_chunks)
 
 
+def autotune_dcn_twotier(acc, cfg: Optional[ACCLConfig] = None,
+                         pows: Sequence[int] = (14, 20),
+                         reps: int = 3,
+                         dt: dataType = dataType.float32) -> ACCLConfig:
+    """Calibrate the DCN tier of the cost model and resolve the
+    compressed cross-slice go/no-go on the live multi-slice mesh.
+
+    Two stages, both DCN-gated (anywhere else the fit would price the
+    emulator, and a mesh with no host-aligned slice boundary has no
+    two-tier schedule to tune — the config passes through untouched):
+
+    1. **DCN α/β seed**: a linear fit of measured flat-ring allreduce
+       times t(N) = a + b·N over the sweep — every ring hop crosses the
+       slice boundary's bandwidth wall, so the intercept amortizes the
+       2(P−1) hops into ``sched_dcn_alpha_us`` and the slope prices one
+       DCN link direction into ``sched_dcn_beta_gbps`` (the
+       ``autotune_sched_synth`` fit, pointed at the slow tier).
+    2. **Compressed go/no-go**: the two-tier schedule at
+       ``dcn_wire_dtype="bf16"`` vs its full-precision twin at the
+       largest size — the winner writes ``cfg.dcn_wire_dtype`` ("off"
+       when compression never beats full precision wall-clock: halving
+       wire bytes is free in the model but the cast is not free on the
+       chip, so the register records the MEASURED verdict)."""
+    import jax
+
+    cfg = cfg or acc.config
+    if acc.config.transport != TransportBackend.DCN:
+        return cfg
+    comm = acc.global_comm()
+    W = comm.world_size
+    if W == 1 or comm.hosts_shape() is None:
+        return cfg
+    shape = tuple(comm.hosts_shape())
+    counts = [2 ** p for p in pows]
+    elem = np.dtype(to_jax_dtype(dt)).itemsize
+    t_ring = measure_allreduce(comm, counts, [Algorithm.RING], dt, reps,
+                               bidirectional=cfg.bidirectional_rings
+                               )[Algorithm.RING]
+    ns = np.array([c * elem for c in counts], dtype=np.float64)
+    ts = np.array(t_ring, dtype=np.float64)
+    b, a = np.polyfit(ns, ts, 1) if len(ns) >= 2 else (0.0, ts[0])
+    k = 2 if (cfg.bidirectional_rings and W >= 4) else 1
+    if b > 0:
+        alpha_us = max(a / (2 * (W - 1)) * 1e6, 1e-3)
+        beta_gbps = (2 * (W - 1) / W) / (b * k * 1e9)
+        cfg = cfg.replace(sched_dcn_alpha_us=float(round(alpha_us, 4)),
+                          sched_dcn_beta_gbps=float(round(beta_gbps, 3)))
+    # compressed go/no-go at the largest size: the session's codec —
+    # an operator's "bf16_sr" opt-in is measured as the SR lane it
+    # would actually run, never silently downgraded to the
+    # deterministic cast — vs the bit-exact full-precision exchange
+    npdt = np.dtype(to_jax_dtype(dt))
+    n = counts[-1]
+    wire = cfg.dcn_wire_dtype if cfg.dcn_wire_dtype != "off" else "bf16"
+
+    def _twotier(w: str) -> float:
+        prog = algorithms.build_allreduce(
+            comm, reduceFunction.SUM, dt, Algorithm.TWOTIER, None,
+            mesh_shape=shape, dcn_wire_dtype=w)
+        x = jax.device_put(np.full((W, n), 1e-6, npdt), comm.sharding())
+        return _time_prog(prog, x, reps=reps)
+
+    t_full, t_wire = _twotier("off"), _twotier(wire)
+    return cfg.replace(dcn_wire_dtype=wire if t_wire < t_full
+                       else "off")
+
+
 def autotune_flash_bwd(acc, cfg: Optional[ACCLConfig] = None,
                        H: int = 8, S: int = 2048, d: int = 128,
                        reps: int = 3) -> ACCLConfig:
@@ -1150,6 +1217,10 @@ def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
         # round 17: the pipeline schedule go/no-go (ICI, engage-gated)
         ("pp", lambda c: autotune_pp(acc, c, reps=reps)),
         ("sched_synth", lambda c: autotune_sched_synth(
+            acc, c, reps=reps, dt=dt)),
+        # round 19: the DCN tier's α/β fit + the compressed cross-slice
+        # go/no-go (DCN-only and host-aligned-only — self-gated)
+        ("dcn_twotier", lambda c: autotune_dcn_twotier(
             acc, c, reps=reps, dt=dt)),
         # round 13 (inference serving): the small-message latency-tier
         # crossover (ICI) and the paged/unpaged decode A/B (TPU backend)

@@ -41,10 +41,13 @@ writes each time). Algorithm selection is re-resolved at every
 compiled composite is cached per resolved selection). The same
 re-resolution picks up the schedule synthesizer's plans
 (``parallel/synth.py``): a bandwidth collective recorded here and
-resolved to ``Algorithm.MULTIAXIS`` compiles its whole multi-step
-axis-by-axis schedule into the one-launch composite — a synthesized
-collective is one cached cmdlist step like any other program (see
-``docs/scheduling.md``).
+resolved to ``Algorithm.MULTIAXIS`` — or, on a host-aligned DCN mesh
+with ``dcn_wire_dtype`` set, to the two-tier ``Algorithm.TWOTIER``
+schedule with its compressed cross-slice leg — compiles its whole
+multi-step schedule into the one-launch composite, keyed by the
+resolved shape and wire dtype, so a re-tuned ``dcn_wire_dtype`` never
+reuses a stale program — a synthesized collective is one cached
+cmdlist step like any other program (see ``docs/scheduling.md``).
 """
 from __future__ import annotations
 

@@ -188,7 +188,19 @@ class Communicator:
         on a multi-host (DCN) mesh: with ``mesh2d(n_hosts, per_host)`` each
         row is one host, so the bandwidth-heavy phases ride intra-host ICI
         and only the shard-sized exchange crosses the DCN — the "lay out
-        shardings so collectives ride ICI" rule made automatic."""
+        shardings so collectives ride ICI" rule made automatic. The
+        two-tier DCN schedules (``synth.topology_of`` on a DCN
+        transport) read this as the (slices, per-slice) split on EVERY
+        plan resolution, so the O(world) scan memoizes — the device
+        list is immutable after construction."""
+        cached = getattr(self, "_hosts_shape_cache", False)
+        if cached is not False:
+            return cached
+        shape = self._hosts_shape_scan()
+        self._hosts_shape_cache = shape
+        return shape
+
+    def _hosts_shape_scan(self) -> Optional[Tuple[int, int]]:
         groups: List[List[int]] = []  # [process_index, count] runs
         for d in self._devices:
             p = getattr(d, "process_index", 0)

@@ -43,6 +43,10 @@ class Algorithm(enum.Enum):
     PALLAS = "pallas"      # Pallas ring kernels over async remote DMA
     MULTIAXIS = "multiaxis"  # axis-by-axis torus decomposition
     #                        # (parallel/synth.py schedule synthesis)
+    TWOTIER = "twotier"    # DCN two-tier schedule: intra-slice
+    #                      # reduce-scatter -> compressed cross-slice
+    #                      # exchange -> intra-slice all-gather
+    #                      # (parallel/hierarchical.py build_twotier_*)
 
 
 @dataclasses.dataclass
@@ -355,6 +359,27 @@ class ACCLConfig:
     # calibrated on real ICI by bench.autotune_sched_synth.
     sched_pipeline_chunks: int = 4
     sched_pipeline_startup_us: float = 2.0
+    # DCN cross-slice wire dtype (the two-tier schedule family,
+    # parallel/hierarchical.py build_twotier_*): the per-LEG codec of
+    # the two-tier schedule's cross-slice exchange — intra-slice legs
+    # always run full precision on ICI; only the shard-sized DCN leg
+    # stages compressed. "off" (default) keeps the exchange bit-exact
+    # AND keeps every DCN resolution byte-identical to the legacy
+    # ladder (the pre-two-tier contract, pinned by tests/test_synth.py);
+    # "bf16" casts the travelling shard via compression.pallas_cast
+    # (folds decompress to full precision first — non-sum folds
+    # included); "bf16_sr" routes the cast through the
+    # stochastic-rounding lane with per-leg seed derivation
+    # (compression.derive_seed — decorrelated across a schedule's
+    # steps). Setting a wire dtype is the OPT-IN that opens the DCN
+    # two-tier window in synth.resolve(): on a host-aligned multi-slice
+    # mesh the per-tier cost model then arbitrates two-tier-compressed
+    # vs two-tier-full vs flat vs legacy per (op, size-bucket), with
+    # the compressed leg priced at effective wire bytes
+    # (synth.dcn_wire_bytes — the cmatmul_wire_bytes discipline).
+    # Write-through to hierarchical.set_dcn_wire_dtype; seeded by
+    # bench.autotune_dcn_twotier (the measured compressed go/no-go).
+    dcn_wire_dtype: str = "off"
     # full-authority synthesis (the "synthesis becomes the only
     # scheduler" migration switch): when True the α-β cost model's
     # per-size-bucket argmin over the WHOLE candidate family (xla /

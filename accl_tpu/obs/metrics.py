@@ -35,6 +35,7 @@ name                                           kind       labels
 ``accl_sched_plan_total``                      counter    op, shape, source
 ``accl_sched_plan_cache_total``                counter    event (hit | miss | evict)
 ``accl_select_decline_total``                  counter    op, reason
+``accl_dcn_wire_bytes_total``                  counter    op, dtype, stage (pre | post: two-tier cross-slice leg bytes before/after compression, per dispatch resolution)
 ``accl_program_cache_total``                   counter    event (hit | miss | evict)
 ``accl_program_cache_size``                    gauge      (none)
 ``accl_latency_dispatch_seconds``              histogram  path (µs-resolution buckets; eager_send | collective | prefill | decode | verify)

@@ -93,8 +93,36 @@ def pallas_cast(x, dst_dtype):
     return out.reshape(shape)
 
 
+def derive_seed(base, step: int):
+    """Per-step seed for multi-leg schedules: a splitmix-style integer
+    mix of ``(base seed, step index)``.
+
+    A multi-step schedule (the two-tier DCN exchange, a pipelined
+    chunk sweep) that passes the SAME seed to every compressed leg
+    rounds every leg with the SAME PRNG pattern — boundary elements
+    round identically on each hop, re-introducing exactly the
+    correlated bias stochastic rounding exists to kill. Deriving each
+    leg's seed from (base, step) decorrelates them while keeping the
+    schedule deterministic for a given base. Works on Python ints and
+    traced scalars alike (the twotier builders derive ``base`` from
+    the payload's bits per execution, the ``_wire_cast`` discipline)."""
+    h = jnp.asarray(base).astype(jnp.uint32)
+    h = h ^ jnp.uint32((int(step) * 0x9E3779B9 + 0x7F4A7C15) & 0xFFFFFFFF)
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> jnp.uint32(16))
+    return h.astype(jnp.int32)
+
+
 def _sr_kernel(x_ref, seed_ref, o_ref, *, dst):
-    pltpu.prng_seed(seed_ref[0])
+    # fold the grid position into the seed: one seed for the whole
+    # launch would replay the SAME random pattern in every (W, row)
+    # block — neighboring chunks of one payload rounding in lockstep,
+    # the correlated-bias failure derive_seed exists to prevent at the
+    # schedule level, reproduced at the tile level
+    pltpu.prng_seed(seed_ref[0], pl.program_id(0), pl.program_id(1))
     bits = pltpu.bitcast(pltpu.prng_random_bits(x_ref.shape), jnp.uint32)
     o_ref[:] = pltpu.stochastic_round(x_ref[:], bits, target_dtype=dst)
 
@@ -124,7 +152,10 @@ def pallas_compress_stochastic(x, dst_dtype, seed=0):
     ``seed`` may be a Python int or a traced scalar — callers running
     inside a compiled step should derive it per execution (a constant
     replays the same PRNG stream every step, defeating the
-    unbiasedness; see ``collective_matmul._wire_cast``)."""
+    unbiasedness; see ``collective_matmul._wire_cast``), and callers
+    compressing MULTIPLE legs of one schedule should decorrelate them
+    via :func:`derive_seed` (each grid tile already folds its own grid
+    position into the stream)."""
     if jax.default_backend() != "tpu":  # stochastic_round is TPU-only
         return x.astype(dst_dtype)
     shape = x.shape

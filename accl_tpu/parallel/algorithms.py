@@ -59,11 +59,13 @@ _SUPPORTED = {
                        Algorithm.RING, Algorithm.PALLAS},
     operation.allreduce: {Algorithm.XLA, Algorithm.FLAT, Algorithm.TREE,
                           Algorithm.RING, Algorithm.HIERARCHICAL,
-                          Algorithm.PALLAS, Algorithm.MULTIAXIS},
+                          Algorithm.PALLAS, Algorithm.MULTIAXIS,
+                          Algorithm.TWOTIER},
     operation.allgather: {Algorithm.XLA, Algorithm.RING, Algorithm.PALLAS,
-                          Algorithm.MULTIAXIS},
+                          Algorithm.MULTIAXIS, Algorithm.TWOTIER},
     operation.reduce_scatter: {Algorithm.XLA, Algorithm.RING,
-                               Algorithm.PALLAS, Algorithm.MULTIAXIS},
+                               Algorithm.PALLAS, Algorithm.MULTIAXIS,
+                               Algorithm.TWOTIER},
     operation.scatter: {Algorithm.XLA, Algorithm.FLAT, Algorithm.PALLAS},
     operation.gather: {Algorithm.XLA, Algorithm.FLAT, Algorithm.RING,
                        Algorithm.PALLAS},
@@ -154,6 +156,13 @@ def select(
     chunk-PIPELINED (the plan's ``pipeline_chunks`` param; the per-axis
     legs of successive chunks overlap) — on meshes with a declared or
     coordinate-detected torus shape, including declared 3-axis shapes.
+    On a host-aligned multi-slice DCN mesh with ``cfg.dcn_wire_dtype``
+    set, the synthesizer's per-tier cost model (DCN α/β for cross-slice
+    steps, ICI α/β intra-slice) may instead upgrade to the TWO-TIER
+    schedule (``Algorithm.TWOTIER``: intra-slice reduce-scatter →
+    compressed cross-slice exchange → intra-slice all-gather;
+    ``dcn_wire_dtype="off"`` keeps every DCN resolution byte-identical
+    to the ladder — the opt-in contract, docs/scheduling.md §two-tier).
     Non-default scalar registers are autotune seeds and pin the legacy
     decision; single-axis meshes with default config resolve exactly as
     the ladder alone (``cfg.sched_full_authority`` retires the ladder
@@ -171,15 +180,27 @@ def select_plan(
     cfg: ACCLConfig,
     requested: Optional[Algorithm] = None,
     count: Optional[int] = None,
+    wire_inert: bool = False,
 ):
     """:func:`select` plus the resolved :class:`synth.SchedulePlan` when
     the synthesizer owned the decision (None for explicit requests,
     world-1, and ops outside ``synth.SYNTH_OPS``) — the dispatch layer
     reads the plan's ``pipeline_chunks``/``shape2d`` params so the
-    program it builds matches the schedule the plan counters claim."""
-    algo, plan = _select(op, nbytes, comm, cfg, requested, count)
+    program it builds matches the schedule the plan counters claim.
+    ``wire_inert`` marks a call the DCN cross-slice codec cannot
+    actually compress — an ArithConfig wire already narrowing every
+    hop, or a payload dtype the codec refuses (ints, bf16/f16): the
+    two-tier window stays closed there (the builders stand the
+    per-leg codec down, so pricing or counting it would describe an
+    exchange that never runs)."""
+    algo, plan = _select(op, nbytes, comm, cfg, requested, count,
+                         wire_inert)
     _metrics.inc("accl_algorithm_selected_total",
                  labels=(("op", op.name), ("algorithm", algo.value)))
+    if plan is not None and plan.shape == "twotier":
+        # per-dispatch accounting of the cross-slice leg's pre/post
+        # compression bytes (accl_dcn_wire_bytes_total{op,dtype,stage})
+        synth.note_dcn_wire_bytes(op, plan, nbytes, count)
     return algo, plan
 
 
@@ -190,6 +211,7 @@ def _select(
     cfg: ACCLConfig,
     requested: Optional[Algorithm] = None,
     count: Optional[int] = None,
+    wire_inert: bool = False,
 ):
     algo = requested or cfg.algorithm
     if algo != Algorithm.AUTO:
@@ -219,7 +241,8 @@ def _select(
         # second stage: the schedule synthesizer may upgrade the ladder's
         # decision to the multi-axis torus decomposition (cached per
         # (op, topology, size-bucket); legacy seeds stay binding)
-        plan = synth.resolve(op, nbytes, comm, cfg, legacy, count=count)
+        plan = synth.resolve(op, nbytes, comm, cfg, legacy, count=count,
+                             wire_inert=wire_inert)
         return plan.algorithm, plan
     return legacy, None
 
@@ -449,6 +472,29 @@ def _multiaxis_shape(comm, mesh_shape) -> tuple:
     return tuple(shape)
 
 
+def _twotier_shape(comm, mesh_shape) -> tuple:
+    """(slices, per_slice) for a two-tier build: the resolved plan's
+    shape when the synthesizer picked it, else the PHYSICAL slice
+    boundary (``comm.hosts_shape()``), else — for explicit requests on
+    single-host rigs (the bench A/B, the emulator) — the most-square
+    factorization, failing loudly on prime worlds."""
+    if mesh_shape is not None:
+        s = tuple(int(v) for v in mesh_shape)
+        if len(s) != 2 or s[0] * s[1] != comm.world_size:
+            raise ValueError(
+                f"two-tier shape {s} != world {comm.world_size}")
+        return s
+    hs = comm.hosts_shape()
+    if hs is not None:
+        return tuple(hs)
+    shape = hierarchical.factor2d(comm.world_size)
+    if shape is None:
+        raise ValueError(
+            "two-tier collective needs a composite world with a "
+            f"(slices, per_slice) split, got world={comm.world_size}")
+    return tuple(shape)
+
+
 def build_allreduce(comm, func: reduceFunction, dt: dataType, algo: Algorithm,
                     arith: Optional[ArithConfig],
                     segment_bytes: Optional[int] = None,
@@ -456,7 +502,13 @@ def build_allreduce(comm, func: reduceFunction, dt: dataType, algo: Algorithm,
                     bidirectional: bool = False,
                     on_dcn: bool = False,
                     mesh_shape=None,
-                    pipeline_chunks: int = 1) -> Callable:
+                    pipeline_chunks: int = 1,
+                    dcn_wire_dtype=None) -> Callable:
+    if algo == Algorithm.TWOTIER:
+        s2 = _twotier_shape(comm, mesh_shape)
+        return hierarchical.build_twotier_allreduce(
+            comm, s2[0], s2[1], func, dt, arith,
+            dcn_wire_dtype=dcn_wire_dtype)
     if algo == Algorithm.MULTIAXIS:
         axes = _multiaxis_shape(comm, mesh_shape)
         return synth.build_multiaxis_allreduce(
@@ -632,7 +684,12 @@ def build_allgather(comm, algo: Algorithm,
                     segment_bytes: Optional[int] = None,
                     bidirectional: bool = False,
                     mesh_shape=None,
-                    pipeline_chunks: int = 1) -> Callable:
+                    pipeline_chunks: int = 1,
+                    dcn_wire_dtype=None) -> Callable:
+    if algo == Algorithm.TWOTIER:
+        s2 = _twotier_shape(comm, mesh_shape)
+        return hierarchical.build_twotier_allgather(
+            comm, s2[0], s2[1], arith, dcn_wire_dtype=dcn_wire_dtype)
     if algo == Algorithm.MULTIAXIS:
         axes = _multiaxis_shape(comm, mesh_shape)
         return synth.build_multiaxis_allgather(
@@ -652,7 +709,13 @@ def build_reduce_scatter(comm, func: reduceFunction, dt: dataType,
                          segment_bytes: Optional[int] = None,
                          bidirectional: bool = False,
                          mesh_shape=None,
-                         pipeline_chunks: int = 1) -> Callable:
+                         pipeline_chunks: int = 1,
+                         dcn_wire_dtype=None) -> Callable:
+    if algo == Algorithm.TWOTIER:
+        s2 = _twotier_shape(comm, mesh_shape)
+        return hierarchical.build_twotier_reduce_scatter(
+            comm, s2[0], s2[1], func, dt, arith,
+            dcn_wire_dtype=dcn_wire_dtype)
     if algo == Algorithm.MULTIAXIS:
         axes = _multiaxis_shape(comm, mesh_shape)
         return synth.build_multiaxis_reduce_scatter(

@@ -62,9 +62,32 @@ SYNTH_OPS = (operation.allreduce, operation.allgather,
 #: candidate shape names (the ``shape`` label of the plan counters) —
 #: ``pipeline`` is the chunk-pipelined multi-axis schedule (same
 #: Algorithm.MULTIAXIS builders, payload split into
-#: ``sched_pipeline_chunks`` chunks whose per-axis legs overlap)
+#: ``sched_pipeline_chunks`` chunks whose per-axis legs overlap);
+#: ``twotier`` is the DCN two-tier schedule (intra-slice reduce-scatter
+#: → cross-slice exchange, optionally compressed to
+#: ``cfg.dcn_wire_dtype`` — → intra-slice all-gather)
 SHAPES = ("xla", "flat", "tree", "ring", "kring", "multiaxis", "pipeline",
-          "hier")
+          "hier", "twotier")
+
+#: effective wire itemsize of each DCN cross-slice wire dtype
+#: (``ACCLConfig.dcn_wire_dtype``); "off" compresses nothing
+DCN_WIRE_ITEMSIZE = {"bf16": 2, "bf16_sr": 2}
+
+
+def dcn_wire_bytes(nbytes: int, wire: Optional[str],
+                   count: Optional[int] = None) -> int:
+    """Effective cross-slice bytes for a payload of ``nbytes`` under the
+    DCN wire dtype — the ``algorithms.cmatmul_wire_bytes`` discipline:
+    ``count`` (elements) resolves the operand width exactly, without it
+    the f32 default is assumed, and the wire never upcasts (operands at
+    or below the wire width move unchanged)."""
+    wisz = DCN_WIRE_ITEMSIZE.get(wire or "off")
+    if wisz is None:
+        return nbytes
+    op_isz = (nbytes // count) if count else 4
+    if op_isz <= wisz or op_isz <= 0:
+        return nbytes
+    return (nbytes // op_isz) * wisz
 
 
 def _prod(axes) -> int:
@@ -83,11 +106,18 @@ class Topology:
     """What the synthesizer knows about the mesh: per-axis sizes (product
     == world; a single entry means "no torus structure known"), the
     transport the links ride, and whether both link directions are
-    drivable concurrently (counter-rotating rings)."""
+    drivable concurrently (counter-rotating rings).  ``dcn_axis`` marks
+    the axis whose links cross slices over DCN (the host boundary of a
+    multi-slice mesh, from ``Communicator.hosts_shape``): steps on that
+    axis are priced with the DCN α/β pair, every other axis rides
+    intra-slice ICI — the per-tier pricing a two-tier schedule needs
+    (one transport pricing a mixed plan would misprice it by
+    construction)."""
 
     axes: Tuple[int, ...]
     transport: TransportBackend
     bidirectional: bool
+    dcn_axis: Optional[int] = None
 
     @property
     def world(self) -> int:
@@ -229,8 +259,23 @@ def torus_shape(comm, cfg: ACCLConfig,
 
 
 def topology_of(comm, cfg: ACCLConfig) -> Topology:
-    """Resolve the mesh's :class:`Topology` for plan synthesis."""
+    """Resolve the mesh's :class:`Topology` for plan synthesis.
+
+    On a DCN transport the two-tier split comes from the PHYSICAL slice
+    boundary — ``comm.hosts_shape()`` (slices, per-slice), axis 0
+    marked as the DCN axis — never from a declared ``sched_mesh_shape``
+    (declarations describe ICI tori; inventing a slice boundary would
+    put the bandwidth-heavy intra-slice legs on DCN links, the ADVICE
+    r2 #4 trap). A non-host-aligned DCN mesh stays single-axis."""
     transport = cfg.transport or TransportBackend.SIM
+    if transport == TransportBackend.DCN:
+        hs = comm.hosts_shape()
+        if hs is not None:
+            return Topology(axes=tuple(hs), transport=transport,
+                            bidirectional=bool(cfg.bidirectional_rings),
+                            dcn_axis=0)
+        return Topology(axes=(comm.world_size,), transport=transport,
+                        bidirectional=bool(cfg.bidirectional_rings))
     shape = torus_shape(comm, cfg)
     axes = tuple(shape) if shape is not None else (comm.world_size,)
     return Topology(axes=axes, transport=transport,
@@ -246,10 +291,20 @@ class CostModel:
     """Per-transport α-β parameters: ``alpha_us`` is one hop's fixed
     latency (launch + link), ``beta_gbps`` one link direction's
     bandwidth. Seeded from config (autotune calibrates them on the live
-    mesh — ``bench.autotune_sched_synth``)."""
+    mesh — ``bench.autotune_sched_synth``; the DCN pair by
+    ``bench.autotune_dcn_twotier``).
+
+    A TIERED model (:meth:`tiered`) additionally carries the DCN α/β
+    pair so each step is priced by its OWN transport
+    (``step_us(..., transport=)``): on a two-tier multi-slice topology
+    the intra-slice steps ride the default (ICI) parameters and the
+    cross-slice steps the DCN pair — one transport pricing every step
+    of a mixed plan would misprice it by construction."""
 
     alpha_us: float
     beta_gbps: float
+    dcn_alpha_us: Optional[float] = None
+    dcn_beta_gbps: Optional[float] = None
 
     @classmethod
     def from_config(cls, cfg: ACCLConfig,
@@ -260,9 +315,40 @@ class CostModel:
         return cls(alpha_us=cfg.sched_alpha_us,
                    beta_gbps=cfg.sched_beta_gbps)
 
-    def step_us(self, hops: int, link_bytes: float, channels: int) -> float:
-        bw = link_bytes / (max(channels, 1) * self.beta_gbps * 1e3)
-        return self.alpha_us * hops + bw
+    @classmethod
+    def tiered(cls, cfg: ACCLConfig) -> "CostModel":
+        """Both tiers at once: default = the ICI pair (intra-slice
+        steps), plus the DCN pair for steps marked ``transport=DCN``."""
+        return cls(alpha_us=cfg.sched_alpha_us,
+                   beta_gbps=cfg.sched_beta_gbps,
+                   dcn_alpha_us=cfg.sched_dcn_alpha_us,
+                   dcn_beta_gbps=cfg.sched_dcn_beta_gbps)
+
+    def for_transport(self, transport) -> "CostModel":
+        """The single-tier parameters pricing ``transport`` under this
+        model (identity unless this is a tiered model and the step
+        crosses slices)."""
+        if (transport == TransportBackend.DCN
+                and self.dcn_alpha_us is not None):
+            return CostModel(alpha_us=self.dcn_alpha_us,
+                             beta_gbps=self.dcn_beta_gbps)
+        return self
+
+    def step_us(self, hops: int, link_bytes: float, channels: int,
+                transport: Optional[TransportBackend] = None) -> float:
+        m = self.for_transport(transport)
+        bw = link_bytes / (max(channels, 1) * m.beta_gbps * 1e3)
+        return m.alpha_us * hops + bw
+
+
+def model_for(cfg: ACCLConfig, topo: Topology) -> CostModel:
+    """THE cost model for one topology: tiered (per-step ICI/DCN
+    pricing) when the topology carries a DCN axis, the single
+    transport's parameters otherwise — byte-identical to the
+    pre-two-tier pricing everywhere a mesh has only one tier."""
+    if topo.dcn_axis is not None:
+        return CostModel.tiered(cfg)
+    return CostModel.from_config(cfg, topo.transport)
 
 
 def _ceil_log2(n: int) -> int:
@@ -306,7 +392,12 @@ class ScheduleStep:
     multi-axis schedules (None = the step operates on the whole
     payload): the validator runs the ownership algebra once per chunk,
     so cross-chunk aliasing — a step folding another chunk's phase —
-    is a hard error, not an accounting blur."""
+    is a hard error, not an accounting blur. ``transport`` is the tier
+    THIS step's links ride (None = the topology's transport): on a
+    two-tier topology cross-slice steps carry ``DCN`` and are priced
+    with the DCN α/β pair while intra-slice steps carry ``ICI`` — the
+    per-step pricing that keeps a mixed ICI/DCN plan honest (one
+    transport pricing every step would misprice it by construction)."""
 
     index: int
     kind: str                    # reduce_scatter | all_gather | allreduce
@@ -318,6 +409,7 @@ class ScheduleStep:
     channels: int
     deps: Tuple[int, ...]
     chunk: Optional[int] = None
+    transport: Optional[TransportBackend] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -361,14 +453,28 @@ def _payload_total(op: operation, nbytes: int, world: int) -> int:
     return nbytes
 
 
-def _mk_steps(specs, model: CostModel):
+def _step_transport(topo: Optional[Topology],
+                    axis) -> Optional[TransportBackend]:
+    """The tier one step's links ride: on a two-tier topology, steps on
+    the DCN axis — and whole-communicator steps, whose rings must cross
+    slices — are DCN, every other axis is intra-slice ICI. Topologies
+    without a DCN axis mark nothing (single-transport pricing)."""
+    if topo is None or topo.dcn_axis is None:
+        return None
+    if axis is None or axis == topo.dcn_axis:
+        return TransportBackend.DCN
+    return TransportBackend.ICI
+
+
+def _mk_steps(specs, model: CostModel, topo: Optional[Topology] = None):
     steps = []
     for i, (kind, axis, group, hops, link_bytes, channels) in enumerate(specs):
         steps.append(ScheduleStep(
             index=i, kind=kind, axis=axis, group=group, hops=hops,
             link_bytes=float(link_bytes), channels=channels,
-            deps=(i - 1,) if i else ()))
-    cost = sum(model.step_us(s.hops, s.link_bytes, s.channels)
+            deps=(i - 1,) if i else (),
+            transport=_step_transport(topo, axis)))
+    cost = sum(model.step_us(s.hops, s.link_bytes, s.channels, s.transport)
                for s in steps)
     return tuple(steps), cost
 
@@ -387,7 +493,7 @@ def _gen_xla(op, topo: Topology, N: int, model: CostModel):
         specs = [("all_gather", None, P, lg, per, k)]
     else:
         specs = [("reduce_scatter", None, P, lg, per, k)]
-    steps, cost = _mk_steps(specs, model)
+    steps, cost = _mk_steps(specs, model, topo)
     return SchedulePlan(op, "xla", Algorithm.XLA, topo, steps, cost, "")
 
 
@@ -405,7 +511,7 @@ def _gen_ring(op, topo: Topology, N: int, model: CostModel,
         specs = [("all_gather", None, P, P - 1, per, channels)]
     else:
         specs = [("reduce_scatter", None, P, P - 1, per, channels)]
-    steps, cost = _mk_steps(specs, model)
+    steps, cost = _mk_steps(specs, model, topo)
     return SchedulePlan(op, shape, algorithm, topo, steps, cost, "")
 
 
@@ -419,7 +525,7 @@ def _gen_tree(op, topo: Topology, N: int, model: CostModel):
     lg = _ceil_log2(P)
     specs = [("reduce", None, P, lg, N * lg, k),
              ("bcast", None, P, lg, N * lg, k)]
-    steps, cost = _mk_steps(specs, model)
+    steps, cost = _mk_steps(specs, model, topo)
     return SchedulePlan(op, "tree", Algorithm.TREE, topo, steps, cost, "")
 
 
@@ -430,7 +536,7 @@ def _gen_flat(op, topo: Topology, N: int, model: CostModel):
     P = topo.world
     specs = [("reduce", None, P, 1, N * (P - 1), 1),
              ("bcast", None, P, 1, N * (P - 1), 1)]
-    steps, cost = _mk_steps(specs, model)
+    steps, cost = _mk_steps(specs, model, topo)
     return SchedulePlan(op, "flat", Algorithm.FLAT, topo, steps, cost, "")
 
 
@@ -470,7 +576,7 @@ def _gen_multiaxis(op, topo: Topology, N: int, model: CostModel):
     if not topo.multi_axis:
         return None
     specs = _multiaxis_phase_specs(op, topo, N)
-    steps, cost = _mk_steps(specs, model)
+    steps, cost = _mk_steps(specs, model, topo)
     return SchedulePlan(
         op, "multiaxis", Algorithm.MULTIAXIS, topo, steps, cost, "",
         params=(("shape2d", tuple(topo.axes)),))
@@ -508,9 +614,11 @@ def _gen_pipeline(op, topo: Topology, N: int, model: CostModel,
             steps.append(ScheduleStep(
                 index=c * n_ph + k, kind=kind, axis=axis, group=group,
                 hops=hops, link_bytes=float(link_bytes) / chunks,
-                channels=channels, deps=tuple(deps), chunk=c))
-    phase_costs = [model.step_us(hops, link_bytes, channels)
-                   for (_, _, _, hops, link_bytes, channels) in specs]
+                channels=channels, deps=tuple(deps), chunk=c,
+                transport=_step_transport(topo, axis)))
+    phase_costs = [model.step_us(hops, link_bytes, channels,
+                                 _step_transport(topo, axis))
+                   for (_, axis, _, hops, link_bytes, channels) in specs]
     cost = max(phase_costs) + (chunks - 1) * float(startup_us)
     return SchedulePlan(
         op, "pipeline", Algorithm.MULTIAXIS, topo, tuple(steps), cost, "",
@@ -531,7 +639,7 @@ def _latency_plan(op: operation, topo: Topology, nbytes: int,
     for allreduce (the rooted builders); allgather/reduce_scatter keep
     the log-depth single shot, still resolved (and counted) through
     the tier so the decision is attributable."""
-    model = CostModel.from_config(cfg, topo.transport)
+    model = model_for(cfg, topo)
     N = _payload_total(op, nbytes, topo.world)
     cands = [p for p in (_gen_xla(op, topo, N, model),
                          _gen_flat(op, topo, N, model),
@@ -552,16 +660,74 @@ def _gen_hier(op, topo: Topology, N: int, model: CostModel):
     specs = [("reduce_scatter", 1, cols, cols - 1, N * (cols - 1) / cols, k),
              ("allreduce", 0, rows, 2 * lg, 2 * m * (rows - 1) / rows, k),
              ("all_gather", 1, cols, cols - 1, N * (cols - 1) / cols, k)]
-    steps, cost = _mk_steps(specs, model)
+    steps, cost = _mk_steps(specs, model, topo)
     return SchedulePlan(op, "hier", Algorithm.HIERARCHICAL, topo, steps,
                         cost, "")
 
 
+def _gen_twotier(op, topo: Topology, N: int, model: CostModel,
+                 wire: str, wire_ratio: float = 1.0):
+    """The DCN two-tier schedule (``hierarchical.build_twotier_*``):
+    intra-slice reduce-scatter (full precision, per-slice ICI rings) →
+    ONE cross-slice exchange over DCN with the shard staged in the
+    ``dcn_wire_dtype`` codec (gather + full-precision decompress-fold
+    for the reducing ops — each contribution rounds exactly once; a
+    direct exchange, so α is paid once while the slice NIC serializes
+    the (S−1) shard payloads) → full-precision intra-slice all-gather.
+    ``wire_ratio`` scales the DCN leg to effective wire bytes (1.0 =
+    full precision, the ``"off"``/two-tier-full candidate); the ICI
+    legs never compress.  Requires a topology whose axis 0 is the DCN
+    axis (``topology_of`` on a host-aligned multi-slice mesh)."""
+    if topo.dcn_axis != 0 or len(topo.axes) != 2:
+        return None
+    S, L = topo.axes
+    k = 2 if topo.bidirectional else 1
+    m = N / L                      # the per-slice shard on the DCN leg
+    block = N / (S * L)            # one rank's allgather block
+    if op == operation.allreduce:
+        specs = [("reduce_scatter", 1, L, L - 1, N * (L - 1) / L, k),
+                 ("allreduce", 0, S, 1, m * (S - 1) * wire_ratio, 1),
+                 ("all_gather", 1, L, L - 1, N * (L - 1) / L, k)]
+    elif op == operation.allgather:
+        specs = [("all_gather", 0, S, 1, block * (S - 1) * wire_ratio, 1),
+                 ("all_gather", 1, L, L - 1, N * (L - 1) / L, k)]
+    else:
+        specs = [("reduce_scatter", 1, L, L - 1, N * (L - 1) / L, k),
+                 ("reduce_scatter", 0, S, 1,
+                  m * (S - 1) / S * wire_ratio, 1)]
+    steps, cost = _mk_steps(specs, model, topo)
+    return SchedulePlan(
+        op, "twotier", Algorithm.TWOTIER, topo, steps, cost, "",
+        params=(("shape2d", tuple(topo.axes)),
+                ("dcn_wire_dtype", wire)))
+
+
+def _twotier_candidates(op, topo: Topology, nbytes: int, N: int,
+                        model: CostModel, cfg: ACCLConfig,
+                        count: Optional[int] = None) -> List[SchedulePlan]:
+    """The two-tier pair for a DCN multi-slice topology: the COMPRESSED
+    schedule (DCN leg at effective ``dcn_wire_dtype`` wire bytes — the
+    ``cmatmul_wire_bytes`` pricing; ``count`` resolves the operand
+    width from the call's ``nbytes`` convention) and the full-precision
+    twin (wire ratio 1.0, the bit-exact ``"off"`` contract), so
+    ``resolve()`` can honestly arbitrate two-tier-compressed vs
+    two-tier-full vs flat vs legacy. Empty off two-tier topologies and
+    at ``dcn_wire_dtype`` off for the compressed arm."""
+    out = [_gen_twotier(op, topo, N, model, "off", 1.0)]
+    wire = getattr(cfg, "dcn_wire_dtype", "off") or "off"
+    if wire != "off" and nbytes > 0:
+        ratio = dcn_wire_bytes(nbytes, wire, count) / nbytes
+        if ratio < 1.0:
+            out.append(_gen_twotier(op, topo, N, model, wire, ratio))
+    return [p for p in out if p is not None]
+
+
 def candidates(op: operation, topo: Topology, nbytes: int,
-               cfg: ACCLConfig) -> List[SchedulePlan]:
+               cfg: ACCLConfig,
+               count: Optional[int] = None) -> List[SchedulePlan]:
     """The full candidate space for one (op, topology, payload):
     every applicable generator's plan, cost-annotated."""
-    model = CostModel.from_config(cfg, topo.transport)
+    model = model_for(cfg, topo)
     N = _payload_total(op, nbytes, topo.world)
     out = [_gen_xla(op, topo, N, model),
            _gen_multiaxis(op, topo, N, model),
@@ -573,7 +739,10 @@ def candidates(op: operation, topo: Topology, nbytes: int,
             if topo.world >= 4 else None),
            _gen_tree(op, topo, N, model),
            _gen_flat(op, topo, N, model)]
-    return [p for p in out if p is not None]
+    out = [p for p in out if p is not None]
+    out.extend(_twotier_candidates(op, topo, nbytes, N, model, cfg,
+                                   count=count))
+    return out
 
 
 def _plan_for_algo(algo: Algorithm, op: operation, topo: Topology,
@@ -581,7 +750,7 @@ def _plan_for_algo(algo: Algorithm, op: operation, topo: Topology,
     """The plan describing what a LEGACY Algorithm choice executes —
     used when an override or disabled synthesis pins the old decision,
     so the observability tier still names the shape that ran."""
-    model = CostModel.from_config(cfg, topo.transport)
+    model = model_for(cfg, topo)
     N = _payload_total(op, nbytes, topo.world)
     kring = topo.bidirectional and topo.world >= 4
     if algo in (Algorithm.RING, Algorithm.PALLAS):
@@ -711,6 +880,8 @@ def reset_plan_cache() -> None:
         _plan_cache.clear()
         _plan_hits = _plan_misses = _plan_evictions = 0
         _fp_cache.clear()
+        _dcn_wire_totals["pre_bytes"] = 0.0
+        _dcn_wire_totals["post_bytes"] = 0.0
 
 
 def plan_cache_stats() -> Dict[str, int]:
@@ -724,8 +895,60 @@ def plan_cache_stats() -> Dict[str, int]:
                 "evictions": _plan_evictions}
 
 
+#: running per-session totals of the two-tier cross-slice leg's bytes
+#: (pre- and post-compression), kept beside the
+#: ``accl_dcn_wire_bytes_total`` counters so ``ACCL.stats()`` reports
+#: them without a metrics scan (the plan-cache-stats shape)
+_dcn_wire_totals = {"pre_bytes": 0.0, "post_bytes": 0.0}
+
+
+def note_dcn_wire_bytes(op: operation, plan: SchedulePlan, nbytes: int,
+                        count: Optional[int] = None) -> None:
+    """Account one dispatch of a two-tier plan's CROSS-SLICE leg:
+    per-rank DCN bytes before compression (the full-precision payload
+    the leg would move at ``dcn_wire_dtype="off"``) and after (the
+    effective wire bytes the compressed schedule actually moves) —
+    ``accl_dcn_wire_bytes_total{op,dtype,stage}``. Called by
+    ``algorithms.select_plan`` once per dispatch resolution, so the
+    pre/post ratio over a workload is readable straight off the
+    counters (and summed into ``dcn_wire_totals`` for stats())."""
+    if plan.shape != "twotier":
+        return
+    shape = plan.param("shape2d")
+    wire = plan.param("dcn_wire_dtype", "off") or "off"
+    if not shape or len(shape) != 2:
+        return
+    S, L = shape
+    N = _payload_total(op, nbytes, S * L)
+    if op == operation.allgather:
+        pre = (N / (S * L)) * (S - 1)
+    elif op == operation.reduce_scatter:
+        pre = (N / L) * (S - 1) / S
+    else:
+        pre = (N / L) * (S - 1)
+    ratio = (dcn_wire_bytes(nbytes, wire, count) / nbytes
+             if nbytes > 0 else 1.0)
+    post = pre * ratio
+    _metrics.inc("accl_dcn_wire_bytes_total", value=pre,
+                 labels=(("op", op.name), ("dtype", wire),
+                         ("stage", "pre")))
+    _metrics.inc("accl_dcn_wire_bytes_total", value=post,
+                 labels=(("op", op.name), ("dtype", wire),
+                         ("stage", "post")))
+    with _plan_lock:
+        _dcn_wire_totals["pre_bytes"] += pre
+        _dcn_wire_totals["post_bytes"] += post
+
+
+def dcn_wire_totals() -> Dict[str, float]:
+    """Session totals of the two-tier cross-slice leg's pre/post
+    compression bytes — the ``ACCL.stats()`` surface."""
+    with _plan_lock:
+        return dict(_dcn_wire_totals)
+
+
 #: per-config memo of :func:`_cost_fingerprint` — the tuple build walks
-#: nine config fields and sits on the per-op dispatch path (every
+#: ten config fields and sits on the per-op dispatch path (every
 #: ``resolve()`` call), so it is computed once per config OBJECT per
 #: session. Keyed by id() with the config kept strongly referenced, so
 #: a recycled id can never alias a dead config; bounded (cleared at
@@ -746,7 +969,8 @@ def _cost_fingerprint(cfg: ACCLConfig) -> tuple:
     fp = (cfg.sched_synthesis, cfg.sched_alpha_us, cfg.sched_beta_gbps,
           cfg.sched_dcn_alpha_us, cfg.sched_dcn_beta_gbps,
           cfg.latency_tier_threshold, cfg.sched_pipeline_chunks,
-          cfg.sched_pipeline_startup_us, cfg.sched_full_authority)
+          cfg.sched_pipeline_startup_us, cfg.sched_full_authority,
+          cfg.dcn_wire_dtype)
     if len(_fp_cache) >= _FP_CACHE_MAX:
         _fp_cache.clear()
     _fp_cache[id(cfg)] = (cfg, fp)
@@ -754,15 +978,25 @@ def _cost_fingerprint(cfg: ACCLConfig) -> tuple:
 
 
 def resolve(op: operation, nbytes: int, comm, cfg: ACCLConfig,
-            legacy: Algorithm, count: Optional[int] = None) -> SchedulePlan:
+            legacy: Algorithm, count: Optional[int] = None,
+            wire_inert: bool = False) -> SchedulePlan:
     """Resolve THE schedule plan for one call — the cost-model search,
     memoized per (op, topology, size-bucket, legacy decision, cost
     params).  ``legacy`` is what the scalar-threshold ladder chose; the
     plan deviates from it only when
 
     * synthesis is enabled (``cfg.sched_synthesis``),
-    * the transport is single-slice (the DCN two-tier story stays with
-      the host-aligned hierarchical path),
+    * the transport is single-slice — UNLESS ``cfg.dcn_wire_dtype``
+      opts a host-aligned multi-slice mesh into the DCN two-tier
+      window, where the per-tier cost model arbitrates the compressed
+      two-tier schedule against its full-precision twin, the flat ring
+      and the legacy ladder (``dcn_wire_dtype="off"``, calls whose
+      wire is inert (``wire_inert``: an arith wire already owns the
+      hops, or a payload dtype the codec refuses to narrow) and
+      non-host-aligned DCN meshes resolve the legacy ladder
+      byte-identically, pinned; inside the window the opt-in register
+      outranks generic seeds — a seeded ladder pins the BASELINE the
+      two-tier candidates must strictly beat, not the window),
     * no governing legacy register carries an autotune seed
       (:data:`_SEED_FIELDS` — seeds are explicit overrides), and
     * EITHER the payload sits below ``cfg.latency_tier_threshold`` —
@@ -796,8 +1030,22 @@ def resolve(op: operation, nbytes: int, comm, cfg: ACCLConfig,
     # sub-threshold payload must never be served the legacy plan its
     # above-threshold bucket-mate cached (and vice versa)
     in_latency_tier = nbytes < cfg.latency_tier_threshold
+    # DCN with the wire register SET only: the operand itemsize prices
+    # the wire ratio (a f64 payload compresses 4:1 where f32 does 2:1)
+    # and an inert wire closes the two-tier window — both cut inside a
+    # size bucket, so both join the key there (f32 assumed when the
+    # call's element count is unknown, the cmatmul_wire_bytes
+    # convention). Everywhere else — non-DCN transports AND default
+    # "off" DCN sessions — neither can affect the plan, and keying on
+    # them would only split cache entries for nothing.
+    if (topo.transport == TransportBackend.DCN
+            and getattr(cfg, "dcn_wire_dtype", "off") not in (None, "off")):
+        wire_key = ((nbytes // count) if count else 4, bool(wire_inert))
+    else:
+        wire_key = None
     key = (op, topo, _metrics.size_bucket(nbytes), in_latency_tier,
-           legacy, seeds, _cost_fingerprint(cfg), _session_epoch)
+           legacy, seeds, _cost_fingerprint(cfg), wire_key,
+           _session_epoch)
     global _plan_hits, _plan_misses, _plan_evictions
     with _plan_lock:
         plan = _plan_cache.get(key)
@@ -826,11 +1074,57 @@ def resolve(op: operation, nbytes: int, comm, cfg: ACCLConfig,
             _metrics.inc("accl_select_decline_total",
                          labels=(("op", op.name), ("reason", reason)))
 
-    if (not cfg.sched_synthesis
-            or topo.transport == TransportBackend.DCN
-            or op not in SYNTH_OPS):
+    if not cfg.sched_synthesis or op not in SYNTH_OPS:
         plan = dataclasses.replace(
             _plan_for_algo(legacy, op, topo, nbytes, cfg), source="legacy")
+    elif topo.transport == TransportBackend.DCN:
+        # the DCN two-tier window — OPT-IN via ``cfg.dcn_wire_dtype``:
+        # with the register off (the default) every DCN resolution is
+        # the legacy ladder's decision, byte-identical to pre-refactor
+        # (pinned by tests/test_synth.py) — which also covers calls
+        # whose wire is INERT: an ARITH wire already compressing every
+        # hop, or a payload dtype the codec refuses to narrow (ints,
+        # bf16/f16) — the builders stand the per-leg codec down for
+        # both, and pricing or accounting a codec that will not run
+        # would be dishonest. With a wire
+        # dtype set on a host-aligned multi-slice topology, the
+        # per-tier cost model arbitrates two-tier-compressed vs
+        # two-tier-full vs the flat ring vs the legacy plan (strict
+        # improvement; ties keep the baseline). The wire register is
+        # ITSELF a non-default opt-in and outranks generic autotune
+        # seeds here — seeds pin the legacy BASELINE the two-tier
+        # candidates must strictly beat, not the window (otherwise
+        # ``autotune_session``'s own threshold stages would make its
+        # ``dcn_twotier`` go/no-go unreachable in the very config it
+        # produces; a tuned deployment that never sets the register
+        # stays exactly pre-refactor). A wire request on a mesh with
+        # no slice boundary declines visibly (counted once per
+        # synthesized plan, the degraded-decline discipline).
+        wire = "off" if wire_inert \
+            else (getattr(cfg, "dcn_wire_dtype", "off") or "off")
+        if wire != "off" and topo.dcn_axis is None:
+            _metrics.inc("accl_select_decline_total",
+                         labels=(("op", op.name),
+                                 ("reason", "dcn_no_host_shape")))
+        if wire == "off" or topo.dcn_axis is None:
+            plan = dataclasses.replace(
+                _plan_for_algo(legacy, op, topo, nbytes, cfg),
+                source="legacy")
+        else:
+            model = model_for(cfg, topo)
+            N = _payload_total(op, nbytes, topo.world)
+            best = _plan_for_algo(legacy, op, topo, nbytes, cfg)
+            kring = topo.bidirectional and topo.world >= 4
+            flat_ring = _gen_ring(
+                op, topo, N, model, 2 if kring else 1,
+                "kring" if kring else "ring", Algorithm.RING)
+            for cand in ([flat_ring]
+                         + _twotier_candidates(op, topo, nbytes, N,
+                                               model, cfg, count=count)):
+                if cand is not None \
+                        and cand.predicted_us < best.predicted_us:
+                    best = cand
+            plan = dataclasses.replace(best, source="cost_model")
     elif cfg.sched_full_authority:
         # full authority (the migration switch): the per-size-bucket
         # argmin over the WHOLE candidate family retires the scalar
@@ -910,9 +1204,15 @@ def _axis_groups(axes: Sequence[int], axis: Optional[int],
     return list(groups.values())
 
 
-def _expected_hops(shape: str, kind: str, group: int) -> int:
+def _expected_hops(shape: str, kind: str, group: int,
+                   transport=None) -> int:
     """What the cost model must have charged for one step of this shape
     — the validator's independent recomputation."""
+    if shape == "twotier":
+        # intra-slice legs walk per-slice rings; the cross-slice leg is
+        # ONE direct DCN exchange (α paid once, every shard straight to
+        # its destination while the slice NIC serializes the payloads)
+        return 1 if transport == TransportBackend.DCN else group - 1
     if shape in ("ring", "kring", "multiaxis", "pipeline"):
         # a pipeline chunk's leg walks the same per-axis ring as the
         # sequential schedule — chunking splits bytes, never hops
@@ -960,7 +1260,7 @@ def validate_plan(plan: SchedulePlan) -> None:
 
     # -- 3. hop counts ----------------------------------------------------
     for s in plan.steps:
-        want = _expected_hops(plan.shape, s.kind, s.group)
+        want = _expected_hops(plan.shape, s.kind, s.group, s.transport)
         if s.hops != want:
             raise ValueError(
                 f"step {s.index} ({plan.shape}/{s.kind}, group {s.group}): "
@@ -1381,20 +1681,23 @@ class _HypotheticalComm:
     """Just enough communicator surface to drive the REAL resolution
     path (``_select_legacy`` + :func:`resolve`) for a topology that is
     not live anywhere: world size, a coordinate-free device list, no
-    parent, no shrink mark, no host alignment."""
+    parent, no shrink mark. ``hosts`` emulates a host-aligned
+    multi-slice mesh ((slices, per-slice) from ``hosts_shape``) so DCN
+    two-tier decisions are inspectable offline too."""
 
-    def __init__(self, world: int):
+    def __init__(self, world: int, hosts: Optional[Tuple[int, int]] = None):
         self.world_size = int(world)
         self._devices = [object()] * self.world_size
         self.parent = None
         self.degraded_from = None
+        self._hosts = tuple(hosts) if hosts else None
 
     @property
     def devices(self):
         return list(self._devices)
 
     def hosts_shape(self):
-        return None
+        return self._hosts
 
 
 def _explain(op_name: str, nbytes: int, shape: str,
@@ -1414,30 +1717,56 @@ def _explain(op_name: str, nbytes: int, shape: str,
                          "allgather | reduce_scatter")
     axes = tuple(int(s) for s in shape.lower().split("x"))
     world = _prod(axes)
-    comm = _HypotheticalComm(world)
-    if len(axes) >= 2:
-        cfg = cfg.replace(sched_mesh_shape=list(axes))
+    on_dcn = cfg.transport == TransportBackend.DCN
+    if on_dcn and len(axes) == 2:
+        # a 2-D shape on a DCN transport IS the slice split: emulate a
+        # host-aligned (slices, per-slice) mesh so the two-tier window
+        # (and its per-tier cost split) is inspectable offline
+        comm = _HypotheticalComm(world, hosts=axes)
+    elif on_dcn and len(axes) > 2:
+        # topology_of ignores declared tori on DCN (the slice boundary
+        # is physical) — silently pricing a 1-D table under a header
+        # claiming the declared shape would mislead; refuse instead
+        raise SystemExit(
+            "DCN topologies are 2-D (slices x per-slice): declared "
+            f"{'x'.join(map(str, axes))} has no DCN interpretation "
+            "(N-D tori are ICI declarations)")
+    else:
+        comm = _HypotheticalComm(world)
+        if len(axes) >= 2:
+            cfg = cfg.replace(sched_mesh_shape=list(axes))
     topo = topology_of(comm, cfg)
-    model = CostModel.from_config(cfg, topo.transport)
+    model = model_for(cfg, topo)
     cands = sorted(candidates(op, topo, nbytes, cfg),
                    key=lambda p: p.predicted_us)
     legacy = algorithms._select_legacy(op, nbytes, comm, cfg)
     plan = resolve(op, nbytes, comm, cfg, legacy)
+    tiered = topo.dcn_axis is not None
+    param_line = (f"alpha={model.alpha_us}us beta={model.beta_gbps}GB/s "
+                  f"pipeline_chunks={cfg.sched_pipeline_chunks} "
+                  f"startup={cfg.sched_pipeline_startup_us}us")
+    if tiered:
+        param_line = (
+            f"ici: alpha={model.alpha_us}us beta={model.beta_gbps}GB/s | "
+            f"dcn: alpha={model.dcn_alpha_us}us "
+            f"beta={model.dcn_beta_gbps}GB/s | "
+            f"dcn_wire_dtype={getattr(cfg, 'dcn_wire_dtype', 'off')}")
     lines = [
         f"op={op.name} nbytes={nbytes} topology={'x'.join(map(str, axes))} "
         f"transport={topo.transport.value} "
-        f"bidirectional={topo.bidirectional}",
-        f"alpha={model.alpha_us}us beta={model.beta_gbps}GB/s "
-        f"pipeline_chunks={cfg.sched_pipeline_chunks} "
-        f"startup={cfg.sched_pipeline_startup_us}us",
+        f"bidirectional={topo.bidirectional}"
+        + (" dcn_axis=0 (slices x per-slice)" if tiered else ""),
+        param_line,
         "",
-        f"{'shape':<10} {'algorithm':<10} {'steps':>5} {'hops':>5} "
-        f"{'alpha_us':>9} {'bw_us':>9} {'total_us':>9}",
+        f"{'shape':<13} {'algorithm':<10} {'steps':>5} {'hops':>5} "
+        f"{'alpha_us':>9} {'bw_us':>9} {'total_us':>9}"
+        + ("  per-tier split" if tiered else ""),
     ]
     best = cands[0]
     for p in cands:
         hops = sum(s.hops for s in p.steps)
-        alpha_us = model.alpha_us * hops
+        alpha_us = sum(model.for_transport(s.transport).alpha_us * s.hops
+                       for s in p.steps)
         if p.shape == "pipeline":
             # the pipelined cost is NOT the per-step sum — report the
             # makespan split as bottleneck-phase bw + fill cost
@@ -1445,10 +1774,22 @@ def _explain(op_name: str, nbytes: int, shape: str,
                         * (cfg.sched_pipeline_chunks - 1))
         bw_us = p.predicted_us - alpha_us
         mark = "  <- winner (argmin cost)" if p is best else ""
+        label = p.shape
+        if p.shape == "twotier":
+            label = f"twotier/{p.param('dcn_wire_dtype', 'off')}"
+        split = ""
+        if tiered and p.shape != "pipeline":
+            # the per-tier cost split: which tier the predicted time
+            # actually sits on (DCN steps at the dcn α/β, the rest ici)
+            dcn_us = sum(
+                model.step_us(s.hops, s.link_bytes, s.channels, s.transport)
+                for s in p.steps if s.transport == TransportBackend.DCN)
+            split = (f"  [ici={p.predicted_us - dcn_us:.2f}us "
+                     f"dcn={dcn_us:.2f}us]")
         lines.append(
-            f"{p.shape:<10} {p.algorithm.value:<10} {len(p.steps):>5} "
+            f"{label:<13} {p.algorithm.value:<10} {len(p.steps):>5} "
             f"{hops:>5} {alpha_us:>9.2f} {bw_us:>9.2f} "
-            f"{p.predicted_us:>9.2f}{mark}")
+            f"{p.predicted_us:>9.2f}{mark}{split}")
     lines += [
         "",
         f"legacy ladder decision: {legacy.value}",

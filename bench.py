@@ -74,7 +74,7 @@ KNOWN_LANES = (
     "sweep", "obs_overhead", "fault_overhead", "recover_time",
     "cmatmul_ag", "cmatmul_rs", "cmatmul_dw", "cmatmul_stream",
     "moe_a2a", "moe_a2a_bwd", "zero_fsdp", "pp_1f1b", "sched_synth",
-    "sched_pipeline",
+    "sched_pipeline", "dcn_twotier",
     "hp_compression_cast_roundtrip", "combine_pallas_vs_jnp",
     "flash_attention", "flash_bwd", "cmdlist_chain_combine",
     "small_op_fused_latency",
@@ -474,6 +474,12 @@ def main(argv=None) -> int:
             # cost formula's predictions beside the measurements
             ("sched_pipeline",
              lambda: _lanes.bench_sched_pipeline(comm, cfg=acc.config)),
+            # round 19: the DCN two-tier compression A/B — the
+            # cross-slice exchange at bf16 wire bytes vs full
+            # precision, with the exact wire-byte ratio and the
+            # resolution honesty flags on record
+            ("dcn_twotier",
+             lambda: _lanes.bench_dcn_twotier(comm, cfg=acc.config)),
             # round 13 (inference serving): per-launch p50/p99 LATENCY
             # lanes, direction=lower — the token-sized allreduce under
             # the latency tier vs XLA, and the paged decode kernel

@@ -506,6 +506,39 @@ def test_autotune_sched_synth_gates(accl):
         accl.config = orig
 
 
+def test_autotune_dcn_twotier_gates(accl, monkeypatch):
+    """The DCN tier's calibration stage measures only where it means
+    something: off DCN (this rung) the config passes through untouched;
+    on DCN without a host-aligned slice boundary it also passes through
+    (there is no two-tier schedule to tune); on DCN WITH a slice
+    boundary the α/β fit runs and the compressed go/no-go resolves
+    from a real A/B into dcn_wire_dtype."""
+    from accl_tpu.config import TransportBackend
+
+    cfg = autotune.autotune_dcn_twotier(accl)       # SIM transport
+    assert cfg.sched_dcn_alpha_us == accl.config.sched_dcn_alpha_us
+    assert cfg.dcn_wire_dtype == accl.config.dcn_wire_dtype
+    orig = accl.config
+    comm = accl.global_comm()
+    try:
+        # DCN but no slice boundary: untouched
+        accl.config = accl.config.replace(transport=TransportBackend.DCN)
+        assert comm.hosts_shape() is None
+        cfg = autotune.autotune_dcn_twotier(accl)
+        assert cfg.sched_dcn_beta_gbps == accl.config.sched_dcn_beta_gbps
+        assert cfg.dcn_wire_dtype == accl.config.dcn_wire_dtype
+        # DCN with a (monkeypatched) host-aligned boundary: the fit
+        # runs, the DCN pair becomes measured values and the go/no-go
+        # records a real verdict
+        monkeypatch.setattr(type(comm), "hosts_shape",
+                            lambda self: (2, 4))
+        cfg = autotune.autotune_dcn_twotier(accl, pows=(8, 12), reps=1)
+        assert cfg.sched_dcn_alpha_us > 0 and cfg.sched_dcn_beta_gbps > 0
+        assert cfg.dcn_wire_dtype in ("off", "bf16")
+    finally:
+        accl.config = orig
+
+
 def test_autotune_serving_throughput_gates(accl):
     """Round-18 serving autotunes measure only on a real TPU backend
     (the interpret rung would tune the emulator): on this rung both

@@ -522,6 +522,61 @@ def test_sched_synth_lane_resolves_on_declared_torus(accl):
     assert r["value"] == r["raw_speedup_med"] > 0
 
 
+def test_dcn_twotier_lane_schema(accl):
+    """The DCN two-tier compression A/B lane (ISSUE 15): on this
+    single-host rig there is no slice boundary, so the explicit
+    factor2d A/B runs with the headline zeroed (AUTO would never
+    dispatch what is measured here) while the raw compressed-vs-full
+    times, the exact wire-byte ratio and the real resolution stay on
+    the record."""
+    from accl_tpu.bench import lanes
+
+    comm = accl.global_comm()
+    rows = lanes.bench_dcn_twotier(comm, count=256, rounds=2,
+                                   cfg=accl.config)
+    assert [r["metric"] for r in rows] == [
+        "dcn_twotier_allreduce", "dcn_twotier_reduce_scatter",
+        "dcn_twotier_allgather"]
+    for r in rows:
+        assert r["unit"] == "ratio"
+        assert r["mesh_shape"] == [2, 4]      # the explicit-AB fallback
+        assert r["host_aligned"] is False
+        assert r["resolved"] is False and r["value"] == 0.0
+        assert r["dcn_wire_dtype"] == "bf16"  # "off" session -> bf16 A/B
+        assert r["wire_bytes_ratio"] == 0.5   # f32 -> bf16, a layout fact
+        assert r["raw_speedup_med"] > 0       # raws always on the record
+        assert r["full_precision_us"] > 0 and r["compressed_us"] > 0
+        assert r["best_full_precision_us"] > 0
+        assert r["plan_shape"] is not None and r["plan_source"]
+    # the lane rides KNOWN_LANES / --lanes like every other stage
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import KNOWN_LANES
+    assert "dcn_twotier" in KNOWN_LANES
+
+
+def test_dcn_twotier_lane_resolves_when_host_aligned(accl, monkeypatch):
+    """With a (monkeypatched) slice boundary the honesty flag turns on:
+    resolution under the wire register picks the two-tier schedule and
+    the headline carries the measured compressed-vs-full speedup."""
+    from accl_tpu.bench import lanes
+
+    comm = accl.global_comm()
+    monkeypatch.setattr(type(comm), "hosts_shape", lambda self: (2, 4))
+    rows = lanes.bench_dcn_twotier(comm, count=1 << 18, rounds=2,
+                                   cfg=accl.config,
+                                   ops=("dcn_twotier_allreduce",))
+    [r] = rows
+    assert r["metric"] == "dcn_twotier_allreduce"
+    assert r["host_aligned"] is True
+    assert r["plan_shape"] == "twotier"
+    assert r["plan_source"] == "cost_model"
+    assert r["resolved"] is True
+    assert r["value"] == r["raw_speedup_med"] > 0
+
+
 def test_sched_pipeline_lane_schema(accl):
     """The chunked-pipelining A/B lane: undeclared mesh -> headline
     zeroed while the three-way raw A/B (ring / sequential multiaxis /

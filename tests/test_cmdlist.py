@@ -192,8 +192,8 @@ def test_cmdlist_reselects_after_autotune(accl, monkeypatch):
     seen = []
     orig_select = alg.select_plan
 
-    def spy(op, nbytes, comm, cfg, requested=None, count=None):
-        got, plan = orig_select(op, nbytes, comm, cfg, requested, count)
+    def spy(op, nbytes, comm, cfg, requested=None, **kw):
+        got, plan = orig_select(op, nbytes, comm, cfg, requested, **kw)
         seen.append((op, got))
         return got, plan
 

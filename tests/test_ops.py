@@ -99,6 +99,35 @@ def test_stochastic_compress_cpu_fallback(rng):
     assert out.dtype == jnp.bfloat16  # deterministic astype off-TPU
 
 
+def test_derive_seed_decorrelates_neighboring_steps(rng):
+    """The per-step seed derivation (ISSUE 15 satellite): a multi-step
+    schedule compressing several legs from one base seed must NOT round
+    every leg with the same PRNG pattern — derive_seed(base, step) maps
+    neighboring step indices (and neighboring bases) to well-separated
+    seeds, deterministically."""
+    import jax
+
+    base = 1234567
+    seeds = [int(compression.derive_seed(base, i)) for i in range(64)]
+    # all distinct — neighboring legs never share a stream
+    assert len(set(seeds)) == len(seeds)
+    # deterministic: same (base, step) -> same seed
+    assert seeds[3] == int(compression.derive_seed(base, 3))
+    # neighboring steps land far apart (an avalanche mix, not base+step:
+    # the SR kernel folds the seed into its PRNG state linearly enough
+    # that adjacent integers would produce correlated tile patterns)
+    diffs = [abs(seeds[i + 1] - seeds[i]) for i in range(len(seeds) - 1)]
+    assert min(diffs) > 1 << 16
+    # traced scalars derive identically to Python ints (the builders
+    # derive the base from payload bits inside a compiled program)
+    traced = jax.jit(lambda b: compression.derive_seed(b, 7))(
+        jnp.int32(base))
+    assert int(traced) == int(compression.derive_seed(base, 7))
+    # distinct bases decorrelate too (two different payloads/steps of a
+    # training run)
+    assert int(compression.derive_seed(base + 1, 7)) != int(traced)
+
+
 def test_combine_via_accl_pallas_lane(accl, rng):
     """ACCL.combine with use_pallas routes through the Pallas lane and
     agrees with the fused path."""

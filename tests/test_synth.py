@@ -1338,3 +1338,477 @@ def test_plan_cache_hit_refreshes_recency(monkeypatch):
                          Algorithm.RING) is hot
     assert synth.plan_cache_stats()["misses"] == m0   # still cached
     synth.reset_plan_cache()
+
+
+# ---------------------------------------------------------------------------
+# two-tier DCN schedules (ISSUE 15): per-tier cost model, resolution
+# window, parity suite, equivalence pins
+# ---------------------------------------------------------------------------
+
+def _host_aligned(monkeypatch, comm, shape=(2, 4)):
+    monkeypatch.setattr(type(comm), "hosts_shape", lambda self: shape)
+
+
+@pytest.mark.parametrize("op", synth.SYNTH_OPS)
+@pytest.mark.parametrize("wire,ratio", [("off", 1.0), ("bf16", 0.5)])
+def test_twotier_candidates_validate(accl, op, wire, ratio):
+    """Every two-tier candidate passes the ownership algebra — including
+    the decompress-fold exchange step (1 DCN hop, per-slice coverage)
+    — for compressed and full-precision arms at multiple sizes."""
+    cfg = accl.config.replace(transport=TransportBackend.DCN,
+                              dcn_wire_dtype=wire)
+    for axes in ((2, 4), (4, 2)):
+        topo = synth.Topology(axes, TransportBackend.DCN, True, dcn_axis=0)
+        model = synth.model_for(cfg, topo)
+        for nbytes in (4 << 10, 4 << 20):
+            N = synth._payload_total(op, nbytes, topo.world)
+            plan = synth._gen_twotier(op, topo, N, model, wire, ratio)
+            assert plan is not None and plan.shape == "twotier"
+            synth.validate_plan(plan)
+            assert plan.param("dcn_wire_dtype") == wire
+
+
+def test_twotier_cost_per_tier_pinned(accl):
+    """THE per-tier pricing pin: a two-tier plan's predicted cost uses
+    the DCN α/β pair for the cross-slice step ONLY and the ICI pair for
+    the intra-slice steps — exact to the unit, for all three ops."""
+    cfg = accl.config.replace(transport=TransportBackend.DCN,
+                              dcn_wire_dtype="bf16")
+    topo = synth.Topology((2, 4), TransportBackend.DCN, True, dcn_axis=0)
+    model = synth.model_for(cfg, topo)
+    ici = synth.CostModel.from_config(cfg, TransportBackend.ICI)
+    dcn = synth.CostModel.from_config(cfg, TransportBackend.DCN)
+    assert (ici.alpha_us, ici.beta_gbps) == (cfg.sched_alpha_us,
+                                             cfg.sched_beta_gbps)
+    assert (dcn.alpha_us, dcn.beta_gbps) == (cfg.sched_dcn_alpha_us,
+                                             cfg.sched_dcn_beta_gbps)
+    S, L, k, r = 2, 4, 2, 0.5
+    N = 8 << 20
+    ici_leg = ici.step_us(L - 1, N * (L - 1) / L, k)
+    pins = {
+        operation.allreduce:
+            2 * ici_leg + dcn.step_us(1, (N / L) * (S - 1) * r, 1),
+        operation.reduce_scatter:
+            ici_leg + dcn.step_us(1, (N / L) * (S - 1) / S * r, 1),
+        operation.allgather:
+            ici_leg + dcn.step_us(1, (N / (S * L)) * (S - 1) * r, 1),
+    }
+    for op, want in pins.items():
+        plan = synth._gen_twotier(op, topo, N, model, "bf16", r)
+        assert plan.predicted_us == pytest.approx(want, abs=1e-9)
+        # the step transports themselves are marked per tier
+        dcn_steps = [s for s in plan.steps
+                     if s.transport == TransportBackend.DCN]
+        ici_steps = [s for s in plan.steps
+                     if s.transport == TransportBackend.ICI]
+        assert len(dcn_steps) == 1 and dcn_steps[0].axis == 0
+        assert all(s.axis == 1 for s in ici_steps)
+        assert len(dcn_steps) + len(ici_steps) == len(plan.steps)
+
+
+def test_resolve_twotier_on_host_aligned_dcn(accl, monkeypatch):
+    """THE acceptance pin: with ``dcn_wire_dtype`` set, resolution on a
+    host-aligned multi-slice DCN topology picks the COMPRESSED two-tier
+    schedule at large payloads, counted under accl_sched_plan_total."""
+    comm = accl.global_comm()
+    _host_aligned(monkeypatch, comm)
+    cfg = accl.config.replace(transport=TransportBackend.DCN,
+                              dcn_wire_dtype="bf16",
+                              sched_alpha_us=1.0 + 5e-9)  # fresh keys
+    key = ('accl_sched_plan_total{op="allreduce",shape="twotier",'
+           'source="cost_model"}')
+    before = _counter(key)
+    for nbytes in (1 << 20, 8 << 20, 64 << 20):
+        assert algorithms.select(operation.allreduce, nbytes, comm, cfg) \
+            == Algorithm.TWOTIER
+    assert _counter(key) > before
+    legacy = algorithms._select_legacy(operation.allreduce, 8 << 20, comm,
+                                       cfg)
+    plan = synth.resolve(operation.allreduce, 8 << 20, comm, cfg, legacy)
+    assert plan.shape == "twotier" and plan.source == "cost_model"
+    assert plan.param("dcn_wire_dtype") == "bf16"  # the COMPRESSED arm
+    assert plan.param("shape2d") == (2, 4)
+    synth.validate_plan(plan)
+    # the duals ride the window too (per-op byte conventions)
+    assert algorithms.select(operation.allgather, 4 << 20, comm, cfg) \
+        == Algorithm.TWOTIER
+    assert algorithms.select(operation.reduce_scatter, 32 << 20, comm,
+                             cfg) == Algorithm.TWOTIER
+
+
+def test_dcn_wire_off_resolution_byte_identical(accl, monkeypatch):
+    """The "off" contract (equivalence pin): with the default
+    ``dcn_wire_dtype="off"`` EVERY DCN resolution — host-aligned or not
+    — is byte-identical to the legacy scalar ladder, exactly as before
+    the two-tier refactor."""
+    comm = accl.global_comm()
+    _host_aligned(monkeypatch, comm)
+    cfg = accl.config.replace(transport=TransportBackend.DCN)
+    assert cfg.dcn_wire_dtype == "off"
+    for op in synth.SYNTH_OPS:
+        for nbytes in (1024, 64 << 10, 4 << 20, 64 << 20):
+            got = algorithms.select(op, nbytes, comm, cfg)
+            assert got == algorithms._select_legacy(op, nbytes, comm, cfg)
+            legacy = algorithms._select_legacy(op, nbytes, comm, cfg)
+            plan = synth.resolve(op, nbytes, comm, cfg, legacy)
+            assert plan.source == "legacy" and plan.algorithm == legacy
+
+
+def test_single_slice_resolution_ignores_dcn_wire(accl):
+    """The wire register must not perturb single-slice resolution: SIM
+    and ICI decisions are identical with and without it (the register
+    is in the cost fingerprint, so this is a behavior pin, not a
+    caching accident)."""
+    comm = accl.global_comm()
+    for transport in (TransportBackend.SIM, TransportBackend.ICI):
+        base = accl.config.replace(transport=transport)
+        wired = base.replace(dcn_wire_dtype="bf16")
+        for op in synth.SYNTH_OPS:
+            for nbytes in (1024, 4 << 20, 64 << 20):
+                assert algorithms.select(op, nbytes, comm, base) \
+                    == algorithms.select(op, nbytes, comm, wired)
+
+
+def test_twotier_seeds_pin_baseline_not_window(accl, monkeypatch):
+    """Seed semantics in the two-tier window: the wire register is
+    ITSELF a non-default opt-in and outranks generic autotune seeds —
+    a seeded ladder pins the BASELINE the two-tier candidates must
+    strictly beat, never the window (otherwise autotune_session's own
+    threshold stages would make its dcn_twotier go/no-go unreachable
+    in the very config it produces). With the wire OFF, seeds keep the
+    full pre-refactor pinning."""
+    comm = accl.global_comm()
+    _host_aligned(monkeypatch, comm)
+    seeded = accl.config.replace(transport=TransportBackend.DCN,
+                                 dcn_wire_dtype="bf16",
+                                 dcn_hier_threshold=128 * 1024,
+                                 ring_threshold=2 << 20)
+    legacy = algorithms._select_legacy(operation.allreduce, 8 << 20, comm,
+                                       seeded)
+    plan = synth.resolve(operation.allreduce, 8 << 20, comm, seeded,
+                         legacy)
+    # the window opened: the compressed two-tier schedule beat the
+    # seeded ladder's baseline on the per-tier model
+    assert plan.shape == "twotier" and plan.source == "cost_model"
+    # wire off + seeds: byte-identical to the ladder (the tuned
+    # deployment that never opted in stays exactly pre-refactor)
+    off = seeded.replace(dcn_wire_dtype="off")
+    for nbytes in (64 << 10, 8 << 20):
+        got = algorithms.select(operation.allreduce, nbytes, comm, off)
+        assert got == algorithms._select_legacy(operation.allreduce,
+                                                nbytes, comm, off)
+
+
+def test_twotier_window_closed_for_inert_wires(accl, rng, monkeypatch):
+    """A call the cross-slice codec cannot actually compress — an
+    ArithConfig wire already narrowing every hop, or an INTEGER payload
+    the codec refuses — keeps the legacy resolution (the builders stand
+    the per-leg codec down there; pricing/counting it would describe an
+    exchange that never runs), and no DCN wire bytes are accounted."""
+    comm = accl.global_comm()
+    _host_aligned(monkeypatch, comm)
+    cfg = accl.config.replace(transport=TransportBackend.DCN,
+                              dcn_wire_dtype="bf16")
+    legacy = algorithms._select_legacy(operation.allreduce, 8 << 20, comm,
+                                       cfg)
+    t0 = synth.dcn_wire_totals()
+    plan = synth.resolve(operation.allreduce, 8 << 20, comm, cfg, legacy,
+                         count=2 << 20, wire_inert=True)
+    assert plan.source == "legacy" and plan.shape != "twotier"
+    # AUTO int32 at a window payload: the spec layer marks the wire
+    # inert from the dtype, so the phantom-compressed candidate never
+    # prices in and the legacy program dispatches (exact)
+    count32 = 1 << 20
+    idata = rng.integers(-50, 50, (WORLD, count32)).astype(np.int32)
+    saved = accl.config
+    accl.config = cfg
+    try:
+        si = accl.create_buffer(count32, dataType.int32)
+        ri = accl.create_buffer(count32, dataType.int32)
+        si.host[:] = idata
+        accl.allreduce(si, ri, count32, reduceFunction.SUM)
+        np.testing.assert_array_equal(ri.host[0], idata.sum(0))
+    finally:
+        accl.config = saved
+    assert synth.dcn_wire_totals() == t0  # ints never falsely accounted
+    # ...and the full e2e path: a compress_dtype call on the DCN
+    # session dispatches the legacy program, correctly
+    count = 1 << 10
+    data = rng.integers(-50, 50, (WORLD, count)).astype(np.float32)
+    saved = accl.config
+    accl.config = cfg
+    try:
+        send = accl.create_buffer(count, dataType.float32)
+        recv = accl.create_buffer(count, dataType.float32)
+        send.host[:] = data
+        accl.allreduce(send, recv, count, reduceFunction.SUM,
+                       compress_dtype=dataType.bfloat16)
+        np.testing.assert_allclose(recv.host[0],
+                                   data.astype(np.float64).sum(0),
+                                   rtol=0.1, atol=2.0)
+    finally:
+        accl.config = saved
+    assert synth.dcn_wire_totals() == t0  # nothing falsely accounted
+
+
+def test_twotier_decline_counted_without_host_shape(accl):
+    """A dcn_wire_dtype request on a DCN mesh with NO slice boundary
+    declines visibly (once per synthesized plan) instead of silently
+    resolving legacy."""
+    comm = accl.global_comm()
+    assert comm.hosts_shape() is None
+    cfg = accl.config.replace(transport=TransportBackend.DCN,
+                              dcn_wire_dtype="bf16",
+                              sched_alpha_us=1.0 + 7e-9)  # fresh keys
+    key = ('accl_select_decline_total{op="allgather",'
+           'reason="dcn_no_host_shape"}')
+    before = _counter(key)
+    got = algorithms.select(operation.allgather, 8 << 20, comm, cfg)
+    assert got != Algorithm.TWOTIER
+    assert _counter(key) - before == 1.0
+    # cached second resolution does not re-count (per plan, not per call)
+    algorithms.select(operation.allgather, 8 << 20, comm, cfg)
+    assert _counter(key) - before == 1.0
+
+
+def test_twotier_wire_bytes_counted(accl, monkeypatch):
+    """Each dispatch resolution of a two-tier plan accounts the
+    cross-slice leg pre/post compression —
+    accl_dcn_wire_bytes_total{op,dtype,stage} and the stats() totals."""
+    comm = accl.global_comm()
+    _host_aligned(monkeypatch, comm)
+    cfg = accl.config.replace(transport=TransportBackend.DCN,
+                              dcn_wire_dtype="bf16")
+    pre_k = ('accl_dcn_wire_bytes_total{op="allreduce",dtype="bf16",'
+             'stage="pre"}')
+    post_k = ('accl_dcn_wire_bytes_total{op="allreduce",dtype="bf16",'
+              'stage="post"}')
+    p0, q0 = _counter(pre_k), _counter(post_k)
+    t0 = synth.dcn_wire_totals()
+    nbytes = 8 << 20
+    algorithms.select_plan(operation.allreduce, nbytes, comm, cfg,
+                           count=nbytes // 4)
+    # allreduce on (2,4): the DCN leg carries (N/4)*(2-1) pre bytes,
+    # half that at bf16
+    want_pre = (nbytes / 4) * 1
+    assert _counter(pre_k) - p0 == pytest.approx(want_pre)
+    assert _counter(post_k) - q0 == pytest.approx(want_pre / 2)
+    t1 = synth.dcn_wire_totals()
+    assert t1["pre_bytes"] - t0["pre_bytes"] == pytest.approx(want_pre)
+    assert t1["post_bytes"] - t0["post_bytes"] \
+        == pytest.approx(want_pre / 2)
+
+
+# -- program layer: two-tier parity --------------------------------------
+
+@pytest.mark.parametrize("count", [64, 100])  # incl. the padding path
+def test_twotier_allreduce_bit_exact(accl, rng, count):
+    """dcn_wire_dtype="off" (the default) is BIT-exact against the flat
+    baselines — integer-valued operands, padding path included."""
+    dt = dataType.float32
+    data = rng.integers(-100, 100, (WORLD, count)).astype(np.float32)
+    outs = {}
+    for algo in (Algorithm.RING, Algorithm.XLA, Algorithm.TWOTIER):
+        send = accl.create_buffer(count, dt)
+        recv = accl.create_buffer(count, dt)
+        send.host[:] = data
+        accl.allreduce(send, recv, count, reduceFunction.SUM,
+                       algorithm=algo)
+        outs[algo] = recv.host.copy()
+    np.testing.assert_array_equal(outs[Algorithm.TWOTIER],
+                                  outs[Algorithm.RING])
+    np.testing.assert_array_equal(outs[Algorithm.TWOTIER],
+                                  outs[Algorithm.XLA])
+    np.testing.assert_array_equal(outs[Algorithm.TWOTIER][0], data.sum(0))
+
+
+def test_twotier_allreduce_max(accl, rng):
+    """MAX rides the general decompress-fold path (a non-sum fold must
+    decompress before folding); int32 payloads never compress."""
+    count, dt = 48, dataType.int32
+    data = rng.integers(-100, 100, (WORLD, count)).astype(np.int32)
+    for wire in (None, "bf16"):
+        saved = accl.config
+        if wire:
+            accl.config = saved.replace(dcn_wire_dtype=wire)
+        try:
+            send = accl.create_buffer(count, dt)
+            recv = accl.create_buffer(count, dt)
+            send.host[:] = data
+            accl.allreduce(send, recv, count, reduceFunction.MAX,
+                           algorithm=Algorithm.TWOTIER)
+            for r in range(WORLD):
+                np.testing.assert_array_equal(recv.host[r], data.max(0))
+        finally:
+            accl.config = saved
+
+
+def test_twotier_reduce_scatter_bit_exact(accl, rng):
+    """Chunk realignment: rank (i, j) of the (slices, per_slice) mesh
+    must land FLAT chunk i*L+j — bit-identical to the 1-D ring path."""
+    count, dt = 48, dataType.int32
+    data = rng.integers(-50, 50, (WORLD, count * WORLD)).astype(np.int32)
+    outs = {}
+    for algo in (Algorithm.RING, Algorithm.TWOTIER):
+        send = accl.create_buffer(count * WORLD, dt)
+        recv = accl.create_buffer(count, dt)
+        send.host[:] = data
+        accl.reduce_scatter(send, recv, count, reduceFunction.SUM,
+                            algorithm=algo)
+        outs[algo] = recv.host.copy()
+    np.testing.assert_array_equal(outs[Algorithm.TWOTIER],
+                                  outs[Algorithm.RING])
+    for r in range(WORLD):
+        np.testing.assert_array_equal(
+            outs[Algorithm.TWOTIER][r],
+            data[:, r * count:(r + 1) * count].sum(0))
+
+
+def test_twotier_allgather_bit_exact(accl, rng):
+    count, dt = 33, dataType.float32
+    data = rng.standard_normal((WORLD, count)).astype(np.float32)
+    outs = {}
+    for algo in (Algorithm.RING, Algorithm.TWOTIER):
+        send = accl.create_buffer(count, dt)
+        recv = accl.create_buffer(count * WORLD, dt)
+        send.host[:] = data
+        accl.allgather(send, recv, count, algorithm=algo)
+        outs[algo] = recv.host.copy()
+    np.testing.assert_array_equal(outs[Algorithm.TWOTIER],
+                                  outs[Algorithm.RING])
+    for r in range(WORLD):
+        np.testing.assert_array_equal(outs[Algorithm.TWOTIER][r],
+                                      data.reshape(-1))
+
+
+@pytest.mark.parametrize("wire", ["bf16", "bf16_sr"])
+def test_twotier_wire_tolerance(accl, rng, wire):
+    """Compressed cross-slice legs are tolerance-bounded: the shard
+    crosses the DCN once in bf16 (~2^-8 relative), every fold runs at
+    full precision after decompression. bf16_sr degrades to the
+    deterministic cast off-TPU — same bound either way."""
+    count, dt = 96, dataType.float32
+    data = (rng.standard_normal((WORLD, count)) * 100).astype(np.float32)
+    saved = accl.config
+    accl.config = saved.replace(dcn_wire_dtype=wire)
+    try:
+        send = accl.create_buffer(count, dt)
+        recv = accl.create_buffer(count, dt)
+        send.host[:] = data
+        accl.allreduce(send, recv, count, reduceFunction.SUM,
+                       algorithm=Algorithm.TWOTIER)
+        expect = data.astype(np.float64).sum(0)
+        for r in range(WORLD):
+            np.testing.assert_allclose(recv.host[r], expect,
+                                       rtol=0.02, atol=3.0)
+        # the duals: allgather's DCN leg rounds each block once
+        send2 = accl.create_buffer(count, dt)
+        recv2 = accl.create_buffer(count * WORLD, dt)
+        send2.host[:] = data
+        accl.allgather(send2, recv2, count, algorithm=Algorithm.TWOTIER)
+        np.testing.assert_allclose(
+            recv2.host[0].reshape(WORLD, count), data, rtol=0.01,
+            atol=0.5)
+    finally:
+        accl.config = saved
+
+
+def test_twotier_auto_dispatch_end_to_end(accl, rng, monkeypatch):
+    """AUTO on a host-aligned DCN session with the wire register set:
+    the call dispatches the two-tier schedule (selection counter) and
+    the result lands within the bf16 tolerance class."""
+    comm = accl.global_comm()
+    _host_aligned(monkeypatch, comm)
+    count = 1 << 20  # 4 MiB f32 — deep in the two-tier window
+    dt = dataType.float32
+    saved = accl.config
+    accl.config = saved.replace(transport=TransportBackend.DCN,
+                                dcn_wire_dtype="bf16")
+    try:
+        key = ('accl_algorithm_selected_total{op="allreduce",'
+               'algorithm="twotier"}')
+        before = _counter(key)
+        data = rng.integers(-8, 8, (WORLD, count)).astype(np.float32)
+        send = accl.create_buffer(count, dt)
+        recv = accl.create_buffer(count, dt)
+        send.host[:] = data
+        accl.allreduce(send, recv, count, reduceFunction.SUM)
+        assert _counter(key) > before
+        np.testing.assert_allclose(recv.host[0],
+                                   data.astype(np.float64).sum(0),
+                                   rtol=0.02, atol=2.0)
+    finally:
+        accl.config = saved
+
+
+def test_twotier_explicit_needs_composite_world(accl):
+    comm = accl.global_comm().split(range(7))
+    with pytest.raises(ValueError, match="composite world"):
+        algorithms.build_allreduce(comm, reduceFunction.SUM,
+                                   dataType.float32, Algorithm.TWOTIER,
+                                   None)
+
+
+def test_dcn_wire_dtype_write_through_and_validation(accl):
+    """The config setter writes the register through to the
+    hierarchical session default; a typo fails loudly."""
+    from accl_tpu.parallel import hierarchical
+    saved = accl.config
+    try:
+        accl.config = saved.replace(dcn_wire_dtype="bf16_sr")
+        assert hierarchical.get_dcn_wire_dtype() == "bf16_sr"
+        with pytest.raises(ValueError, match="dcn_wire_dtype"):
+            accl.config = saved.replace(dcn_wire_dtype="fp8")
+    finally:
+        accl.config = saved
+        assert hierarchical.get_dcn_wire_dtype() == "off"
+
+
+def test_synth_explain_cli_dcn_smoke(capsys):
+    """--explain on a DCN topology prints the per-tier cost split and
+    the twotier candidates."""
+    rc = synth._main(["--explain", "allreduce", str(8 << 20), "2x4",
+                      "--transport", "dcn"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "twotier/off" in out
+    assert "per-tier split" in out and "dcn=" in out and "ici=" in out
+    assert "dcn_axis=0" in out
+
+
+def test_cmdlist_twotier_one_launch_and_reresolution(accl, rng,
+                                                     monkeypatch):
+    """A two-tier schedule recorded in a CommandList compiles into the
+    ONE-launch composite, and execute()-time re-resolution picks up the
+    wire register: the same recorded list dispatches the compressed
+    schedule once the session config flips dcn_wire_dtype on."""
+    comm = accl.global_comm()
+    _host_aligned(monkeypatch, comm)
+    count, dt = 64, dataType.float32
+    data = rng.integers(-100, 100, (WORLD, count)).astype(np.float32)
+    send = accl.create_buffer(count, dt)
+    recv = accl.create_buffer(count, dt)
+    send.host[:] = data
+    key = 'accl_cmdlist_executes_total{steps="2"}'
+    before = _counter(key)
+    cl = accl.command_list()
+    cl.allreduce(send, recv, count, reduceFunction.SUM,
+                 algorithm=Algorithm.TWOTIER)
+    cl.allgather(recv, accl.create_buffer(count * WORLD, dt), count,
+                 algorithm=Algorithm.TWOTIER)
+    cl.execute()
+    assert _counter(key) == before + 1
+    np.testing.assert_array_equal(recv.host[0], data.sum(0))
+    # flip the wire register and re-execute the SAME list: the
+    # re-resolution keys a fresh program (compressed leg) and the
+    # result moves to the bf16 tolerance class, still correct
+    saved = accl.config
+    accl.config = saved.replace(dcn_wire_dtype="bf16")
+    try:
+        send.host[:] = data
+        cl.execute()
+        np.testing.assert_allclose(recv.host[0],
+                                   data.astype(np.float64).sum(0),
+                                   rtol=0.02, atol=2.0)
+    finally:
+        accl.config = saved

@@ -30,9 +30,10 @@ class _FakeComm:
     def world_size(self):
         return len(self._devices)
 
-    # borrow the real implementation
+    # borrow the real implementation (memo wrapper + scan)
     from accl_tpu.communicator import Communicator as _C
     hosts_shape = _C.hosts_shape
+    _hosts_shape_scan = _C._hosts_shape_scan
 
 
 def test_hosts_shape_detection():
