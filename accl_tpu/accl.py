@@ -105,8 +105,10 @@ class ACCL:
         _cm_ops.set_overlap_class_thresholds(
             cfg.ag_matmul_class_thresholds, cfg.rs_matmul_class_thresholds)
         _cm_ops.set_wire_dtype(cfg.cmatmul_wire_dtype)
+        _cm_ops.set_nblock_enabled(cfg.cmatmul_nblock)
         _a2a_ops.set_overlap_enabled(cfg.moe_overlap)
         _a2a_ops.set_overlap_threshold(cfg.a2a_matmul_threshold)
+        _a2a_ops.set_dw_overlap_enabled(cfg.moe_dw_overlap)
         # the DCN cross-slice wire dtype (two-tier schedules) validates
         # and writes through like the cmatmul wire register
         from .parallel import hierarchical as _hier

@@ -653,6 +653,109 @@ def autotune_moe_a2a(acc, cfg: Optional[ACCLConfig] = None,
         a2a_matmul_threshold=at if at is not None else DISABLED)
 
 
+def autotune_cmatmul_nblock(acc, cfg: Optional[ACCLConfig] = None,
+                            m: int = 2048, k: int = 256, n: int = 1024,
+                            reps: int = 3,
+                            dt: dataType = dataType.float32) -> ACCLConfig:
+    """Measure the accumulator-floor n-block arm (round 20) against the
+    unfused XLA pair at a shape whose agmm plan N-BLOCKS on the live
+    mesh, and write the go/no-go to ``cfg.cmatmul_nblock`` — the
+    register gating all three n-block arms (agmm ``mb``, mmrs ``nb``,
+    wgrad ``ctb``). The arm is measured with the register forced ON
+    (a previously-disabled session must not veto its own remeasure);
+    ICI only, and a geometry that does not n-block at this world
+    passes the config through untouched (resident/k-blocked shapes
+    are ``autotune_collective_matmul``'s crossover, not this one)."""
+    import jax
+    from ..ops import collective_matmul as cm
+
+    cfg = cfg or acc.config
+    if acc.config.transport != TransportBackend.ICI:
+        return cfg
+    comm = acc.global_comm()
+    W = comm.world_size
+    if W == 1:
+        return cfg
+    bidir = acc.config.bidirectional_rings
+    npdt = to_jax_dtype(dt)
+    wire = cfg.cmatmul_wire_dtype or "off"
+    saved = cm.get_nblock_enabled()
+    cm.set_nblock_enabled(True)
+    try:
+        plan = cm.agmm_plan(m, k, n, W, npdt, bidir,
+                            wire_dtype=cm._resolve_wire(wire, npdt))
+        if plan is None or plan.get("nmb", 1) <= 1:
+            return cfg
+        x = jax.device_put(np.full((W, m, k), 1e-3, np.dtype(npdt)),
+                           comm.sharding())
+        wt = jax.device_put(np.full((W, k, n), 1e-3, np.dtype(npdt)),
+                            comm.sharding())
+        times = {}
+        for name, algo in (("fused", Algorithm.PALLAS),
+                           ("xla", Algorithm.XLA)):
+            prog = algorithms.build_allgather_matmul(
+                comm, algo, bidirectional=bidir, wire_dtype=wire)
+            times[name] = _time_prog(prog, x, wt, reps=reps)
+    finally:
+        cm.set_nblock_enabled(saved)
+    return cfg.replace(cmatmul_nblock=times["fused"] <= times["xla"])
+
+
+def autotune_moe_a2a_dw(acc, cfg: Optional[ACCLConfig] = None,
+                        e_local: int = 2, C: int = 128, ct: int = 256,
+                        cl: int = 512, reps: int = 3,
+                        dt: dataType = dataType.float32) -> ACCLConfig:
+    """Measure the fused a2a-wgrad dw kernel (round 20) against the
+    unfused ``lax.all_to_all`` + einsum pair on the live mesh and
+    write the go/no-go to ``cfg.moe_dw_overlap`` — the register the
+    a2a VJPs' dw legs consult. Measured with the register forced ON
+    (see ``autotune_cmatmul_nblock``); ICI only, and a geometry whose
+    ``a2a_wgrad_plan`` misses VMEM passes the config through
+    untouched."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops import collective_alltoall as ca
+    from ..ops import collective_matmul as cm
+    from ..parallel.primitives import AXIS, _smap
+
+    cfg = cfg or acc.config
+    if acc.config.transport != TransportBackend.ICI:
+        return cfg
+    comm = acc.global_comm()
+    W = comm.world_size
+    if W == 1:
+        return cfg
+    bidir = acc.config.bidirectional_rings
+    npdt = to_jax_dtype(dt)
+    wire = cfg.cmatmul_wire_dtype or "off"
+    wdt = cm._resolve_wire(wire, npdt)
+    if ca.a2a_wgrad_plan(e_local, C, ct, cl, W, npdt, bidir,
+                         wire_dtype=wdt) is None:
+        return cfg
+    E = W * e_local
+    trav = jax.device_put(np.full((W, E, C, ct), 1e-3, np.dtype(npdt)),
+                          comm.sharding())
+    loc = jax.device_put(np.full((W, e_local, W * C, cl), 1e-3,
+                                 np.dtype(npdt)), comm.sharding())
+    fused = _smap(comm, lambda tv, lo: ca.a2a_gathered_wgrad_body(
+        tv[0], lo[0], axis=AXIS, overlap=True, bidirectional=bidir,
+        wire_dtype=wire, travel_lhs=True)[None], 2,
+        in_specs=(P(AXIS), P(AXIS)))
+    unfused = _smap(comm, lambda tv, lo: ca.a2a_gathered_wgrad_body(
+        tv[0], lo[0], axis=AXIS, overlap=False, bidirectional=bidir,
+        wire_dtype=wire, travel_lhs=True)[None], 2,
+        in_specs=(P(AXIS), P(AXIS)))
+    saved = ca.get_dw_overlap_enabled()
+    ca.set_dw_overlap_enabled(True)
+    try:
+        t_fused = _time_prog(fused, trav, loc, reps=reps)
+        t_unfused = _time_prog(unfused, trav, loc, reps=reps)
+    finally:
+        ca.set_dw_overlap_enabled(saved)
+    return cfg.replace(moe_dw_overlap=t_fused <= t_unfused)
+
+
 def autotune_zero_fsdp(acc, cfg: Optional[ACCLConfig] = None,
                        n_layers: int = 2, d_model: int = 256,
                        d_hidden: int = 1024, n_heads: int = 4,
@@ -1163,7 +1266,9 @@ def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
     ring/hier(/pallas), allgather + reduce_scatter ring crossovers, the
     flat-tree rank/count/fan-in registers (accl.cpp:1214-1224 analog,
     measured instead of frozen), the collective-matmul overlap-vs-XLA
-    crossovers (ICI), the layerwise ZeRO/FSDP fused-vs-flat schedule
+    crossovers (ICI) plus the round-20 n-block (``cmatmul_nblock``)
+    and fused a2a-wgrad (``moe_dw_overlap``) go/no-gos, the layerwise
+    ZeRO/FSDP fused-vs-flat schedule
     register (ICI), the small-message latency-tier crossover (ICI —
     ``latency_tier_threshold``), and the single-chip flash backward and
     decode paged/unpaged crossovers (any world size)."""
@@ -1212,7 +1317,14 @@ def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
             acc, c, reps=reps, dt=dt)),
         ("collective_matmul", lambda c: autotune_collective_matmul(
             acc, c, reps=reps, dt=dt)),
+        # round 20: the accumulator-floor n-block go/no-go (ICI,
+        # engage-gated — only shapes past the k-block arm reach it)
+        ("cmatmul_nblock", lambda c: autotune_cmatmul_nblock(
+            acc, c, reps=reps, dt=dt)),
         ("moe_a2a", lambda c: autotune_moe_a2a(acc, c, reps=reps, dt=dt)),
+        # round 20: the fused a2a-wgrad dw go/no-go (ICI, engage-gated)
+        ("moe_a2a_dw", lambda c: autotune_moe_a2a_dw(
+            acc, c, reps=reps, dt=dt)),
         ("zero_fsdp", lambda c: autotune_zero_fsdp(acc, c, reps=reps)),
         # round 17: the pipeline schedule go/no-go (ICI, engage-gated)
         ("pp", lambda c: autotune_pp(acc, c, reps=reps)),

@@ -740,6 +740,86 @@ def bench_cmatmul_stream(comm, m: int = 128, n: int = 512,
     return [row]
 
 
+def bench_cmatmul_nblock(comm, shapes: Sequence[Tuple[int, int, int]] =
+                         ((2048, 256, 1024), (4096, 256, 1024),
+                          (1024, 256, 2048)),
+                         rounds: int = 5,
+                         bidirectional: bool = True) -> List[dict]:
+    """The accumulator-floor streaming lane (round 20):
+    ``cmatmul_nblock`` runs the agmm overlap A/B at a shape whose plan
+    resolves through the n-BLOCK arm (``mb``/``nmb`` keys — the
+    double-buffered f32 accumulators dominate, so even the 128-lane
+    k-block misses and the traveller's rows split; before round 20
+    exactly these shapes silently degraded to the unfused pair).
+
+    The first ``shapes`` entry whose plan n-blocks at the live world is
+    measured; ``fused_engaged`` is false when no candidate n-blocks,
+    the register (``ACCLConfig.cmatmul_nblock``) is off, or the rung
+    cannot execute kernels — the "fused" time then measures the
+    fallback and the headline zeroes. ``m_block``/``n_m_blocks`` pin
+    the chosen geometry (the body unrolls one streaming kernel per
+    block, so n_m_blocks is also the per-call pallas count)."""
+    import jax
+    from jax import lax as jlax
+    from jax.sharding import PartitionSpec as P
+
+    from ..config import Algorithm
+    from ..ops import collective_matmul as cm
+    from ..parallel import algorithms
+    from ..parallel.primitives import AXIS, _smap
+
+    W = comm.world_size
+    m = k = n = None
+    plan = None
+    for cand in shapes:
+        p_ = cm.agmm_plan(*cand, W, jnp.float32, bidirectional)
+        if p_ is not None and p_.get("nmb", 1) > 1:
+            (m, k, n), plan = cand, p_
+            break
+    if m is None:
+        # no candidate n-blocks at this world/budget — keep the lane on
+        # the record as unresolved rather than measuring the wrong arm
+        (m, k, n) = shapes[0]
+        plan = cm.agmm_plan(m, k, n, W, jnp.float32, bidirectional)
+
+    rng = np.random.default_rng(0)
+    x = jax.device_put(
+        rng.standard_normal((W, m, k)).astype(np.float32) * 1e-2,
+        comm.sharding())
+    wt = jax.device_put(
+        rng.standard_normal((W, k, n)).astype(np.float32) * 1e-2,
+        comm.sharding())
+
+    fused = algorithms.build_allgather_matmul(
+        comm, Algorithm.PALLAS, bidirectional=bidirectional,
+        wire_dtype="off")
+    ag_only = _smap(comm, lambda xs: jlax.all_gather(
+        xs[0], AXIS, axis=0, tiled=True)[None], 1)
+    mm_only = _smap(comm, lambda xs, ws: jnp.dot(
+        jnp.tile(xs[0], (W, 1)), ws[0],
+        preferred_element_type=jnp.float32)[None], 2,
+        in_specs=(P(AXIS), P(AXIS)))
+
+    nblocked = plan is not None and plan.get("nmb", 1) > 1
+    t_fused = _dist(fused, x, wt, rounds=rounds)
+    t_ag = _dist(ag_only, x, rounds=rounds)
+    t_mm = _dist(mm_only, x, wt, rounds=rounds)
+    row = _overlap_row(
+        "cmatmul_nblock", t_fused, t_mm, t_ag,
+        cm._kernels_available() and nblocked and cm.get_nblock_enabled(),
+        rounds)
+    row.update({
+        "m": m, "k": k, "n": n, "world": W,
+        "bidirectional": bool(bidirectional and W >= 4),
+        "nblock_enabled": cm.get_nblock_enabled(),
+        "overlap_plan": plan,
+        "plan_mode": plan["mode"] if plan is not None else None,
+        "m_block": plan["mb"] if nblocked else None,
+        "n_m_blocks": plan["nmb"] if nblocked else None,
+    })
+    return [row]
+
+
 def bench_moe_a2a(comm, e_local: int = 2, C: int = 128, d: int = 256,
                   h: int = 512, rounds: int = 5,
                   bidirectional: bool = True) -> List[dict]:
@@ -917,15 +997,90 @@ def bench_moe_a2a_bwd(comm, e_local: int = 2, C: int = 128, d: int = 256,
     return [row]
 
 
+def bench_moe_a2a_dw(comm, e_local: int = 2, C: int = 128, ct: int = 256,
+                     cl: int = 512, rounds: int = 5,
+                     bidirectional: bool = True) -> List[dict]:
+    """The fused a2a-wgrad A/B (round 20): ``moe_a2a_dw`` times the dw
+    kernel of the a2a VJPs (:func:`accl_tpu.ops.collective_alltoall.
+    a2a_gathered_wgrad_body` — the traveller's all-to-all folded into
+    dw's per-expert contraction sweep) against its sequential pieces:
+    the ``lax.all_to_all`` alone and the per-expert dim-0 contraction
+    alone on a pre-received tensor. Before round 20 this was the ONE
+    unfused collective left in the MoE backward.
+
+    Honesty flags per the lane protocol: ``fused_engaged`` needs the
+    rung, the ``a2a_wgrad_plan`` AND the ``ACCLConfig.moe_dw_overlap``
+    register (off is a requested baseline — the "fused" time then
+    measures the unfused pair and the headline zeroes)."""
+    import jax
+    from jax import lax as jlax
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops import collective_alltoall as ca
+    from ..ops import collective_matmul as cm
+    from ..parallel.primitives import AXIS, _smap
+
+    W = comm.world_size
+    E = W * e_local
+    rng = np.random.default_rng(0)
+    trav = jax.device_put(
+        rng.standard_normal((W, E, C, ct)).astype(np.float32) * 1e-2,
+        comm.sharding())
+    loc = jax.device_put(
+        rng.standard_normal((W, e_local, W * C, cl)).astype(np.float32)
+        * 1e-2, comm.sharding())
+    recv = jax.device_put(
+        rng.standard_normal((W, e_local, W * C, ct)).astype(np.float32)
+        * 1e-2, comm.sharding())
+
+    # resolve the session wire once: the plan check must judge the
+    # program the lane actually times (see bench_moe_a2a)
+    wire = cm.get_wire_dtype() or "off"
+    wdt = cm._resolve_wire(wire, np.float32)
+
+    fused = _smap(comm, lambda tv, lo: ca.a2a_gathered_wgrad_body(
+        tv[0], lo[0], axis=AXIS, overlap=True,
+        bidirectional=bidirectional, wire_dtype=wire,
+        travel_lhs=True)[None], 2, in_specs=(P(AXIS), P(AXIS)))
+    a2a_only = _smap(comm, lambda tv: jlax.all_to_all(
+        tv[0], AXIS, split_axis=0, concat_axis=1, tiled=True)[None], 1)
+    # the unfused pair's contraction runs on the RECEIVED
+    # (e_local, W*C, ct) traveller; a pre-received tensor reproduces
+    # its shape/flops without paying the collective in the matmul time
+    mm_only = _smap(comm, lambda rs, lo: jnp.einsum(
+        "ept,epl->etl", rs[0], lo[0],
+        preferred_element_type=jnp.float32)[None], 2,
+        in_specs=(P(AXIS), P(AXIS)))
+
+    plan = ca.a2a_wgrad_plan(e_local, C, ct, cl, W, jnp.float32,
+                             bidirectional, wire_dtype=wdt)
+    engaged = (cm._kernels_available() and plan is not None
+               and ca.get_dw_overlap_enabled())
+    t_fused = _dist(fused, trav, loc, rounds=rounds)
+    t_coll = _dist(a2a_only, trav, rounds=rounds)
+    t_mm = _dist(mm_only, recv, loc, rounds=rounds)
+    row = _overlap_row("moe_a2a_dw", t_fused, t_mm, t_coll, engaged,
+                       rounds)
+    row.update({
+        "e_local": e_local, "C": C, "ct": ct, "cl": cl, "world": W,
+        "bidirectional": bool(bidirectional and W >= 4),
+        "wire_dtype": wire,
+        "dw_overlap_enabled": ca.get_dw_overlap_enabled(),
+        "overlap_plan": plan,
+        "plan_mode": plan["mode"] if plan is not None else None,
+    })
+    return [row]
+
+
 def bench_zero_fsdp(comm, n_layers: int = 2, d_model: int = 256,
                     d_hidden: int = 1024, n_heads: int = 4,
                     batch_per_rank: int = 128, rounds: int = 5,
                     bidirectional: bool = True) -> List[dict]:
     """The flagship end-to-end overlap A/B: ``zero_fsdp`` times one
-    LAYERWISE fused ZeRO/FSDP train step (parameter gathers riding
-    ``allgather_matmul``, gradient reductions riding
-    ``matmul_reduce_scatter`` + the fused wgrad, prefetched attention
-    buckets, flash attention — the first program composing flash,
+    LAYERWISE fused ZeRO/FSDP train step (every parameter gather —
+    attention AND MLP, round 20 — riding ``allgather_matmul``,
+    gradient reductions riding ``matmul_reduce_scatter`` + the fused
+    wgrad, flash attention — the first program composing flash,
     cmatmul and the wire codecs) against the FLAT-RAVEL baseline step
     of the SAME model (one monolithic all_gather, compute, one
     monolithic psum_scatter).
@@ -935,10 +1090,14 @@ def bench_zero_fsdp(comm, n_layers: int = 2, d_model: int = 256,
     schedule. Honesty flags per the lane protocol: ``fused_engaged``
     mirrors :func:`accl_tpu.models.zero.fsdp_engages` (False on rungs
     where the kernels cannot run — the "fused" time then measures the
-    committed flat fallback and the headline zeroes), ``plan_mode``
-    pins what the per-layer agmm plans resolved, the MEDIAN round
-    carries the ``resolved`` flag, and raw best/median ratios stay on
-    the record either way."""
+    committed flat fallback and the headline zeroes), ``attn_fused``
+    mirrors :func:`accl_tpu.models.zero.fsdp_attn_engages` (False on a
+    tier-2 run, where attention gathers through the prefetched bucket
+    baseline — a tier-2 run must never masquerade as fully fused, so
+    ``kernels_per_layer`` drops with it), ``plan_mode`` pins what the
+    per-layer agmm plans resolved, the MEDIAN round carries the
+    ``resolved`` flag, and raw best/median ratios stay on the record
+    either way."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -983,6 +1142,9 @@ def bench_zero_fsdp(comm, n_layers: int = 2, d_model: int = 256,
     engaged = zero.fsdp_engages(d_model, d_hidden, batch_per_rank, dp, tp,
                                 overlap=True, bidirectional=bidirectional,
                                 wire_dtype=wdt)
+    attn_fused = zero.fsdp_attn_engages(
+        d_model, batch_per_rank, dp, tp, overlap=True,
+        bidirectional=bidirectional, wire_dtype=wdt)
     resolved = engaged and t_fused["med"] > 0
     eff_best = (t_flat["best"] / t_fused["best"]
                 if t_fused["best"] > 0 else 0.0)
@@ -1011,7 +1173,11 @@ def bench_zero_fsdp(comm, n_layers: int = 2, d_model: int = 256,
         "wire_dtype": wire,
         "plan_mode": p1["mode"] if p1 is not None else None,
         "plan_mode_w2": p2["mode"] if p2 is not None else None,
-        "kernels_per_layer": 6,  # 2 agmm fwd + 2 mmrs + 2 wgrad bwd
+        "attn_fused": attn_fused,
+        # tier 1: 4 agmm fwd + 4 mmrs + 4 wgrad bwd (attention on
+        # agmm); tier 2: the MLP's 6, attention through the prefetched
+        # bucket baseline
+        "kernels_per_layer": 12 if attn_fused else 6,
     }]
 
 

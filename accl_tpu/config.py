@@ -210,6 +210,17 @@ class ACCLConfig:
     # entry point ("off" forces full precision for one call). The
     # select()/engage size registers see EFFECTIVE wire bytes.
     cmatmul_wire_dtype: Optional[str] = None
+    # accumulator-blocking go/no-go for the streaming cmatmul plans:
+    # when even the minimum k-block misses the scoped-VMEM budget (the
+    # (m, n) f32 accumulator floor), the plans split the accumulator
+    # itself along a lane-aligned block of its own dim and run the
+    # existing streaming kernel once per block (wire-neutral; see
+    # docs/kernels.md §n-blocked streaming). Write-through to
+    # collective_matmul.set_nblock_enabled; False restores the
+    # pre-blocking declines (counted vmem_miss). Seeded by
+    # bench.autotune_collective_matmul when its sweep reaches an
+    # accumulator-floor size.
+    cmatmul_nblock: bool = True
 
     # expert-parallel fused all-to-all x expert matmul
     # (ops/collective_alltoall.py): the MoE dispatch/combine datapath
@@ -222,6 +233,17 @@ class ACCLConfig:
     # by bench.autotune_moe_a2a on the live mesh.
     moe_overlap: bool = True
     a2a_matmul_threshold: int = 256 * 1024
+    # fused MoE dw go/no-go: both a2a VJPs' weight gradients fold their
+    # all_to_all (x for d(dispatch), dy for d(combine)) into the
+    # per-expert contraction sweep of a gathered-wgrad-style kernel
+    # with in-kernel f32 accumulate, so the MoE backward traces zero
+    # unfused collectives when plans engage. Write-through to
+    # collective_alltoall.set_dw_overlap_enabled; False keeps the
+    # unfused lax.all_to_all + einsum dw (a requested baseline, never
+    # counted); plan/rung declines count under
+    # accl_cmatmul_fallback_total{op="moe_a2a_dw"}. Seeded by
+    # bench.autotune_moe_a2a alongside the forward crossover.
+    moe_dw_overlap: bool = True
 
     # layerwise overlapped ZeRO/FSDP (models/zero.py): the training-step
     # datapath whose per-layer parameter gather IS allgather_matmul and

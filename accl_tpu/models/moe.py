@@ -78,7 +78,14 @@ def build_moe_forward(comm: Communicator, n_experts: int,
     ``top_k`` routes each token to its k best experts with renormalized
     gates (GShard-style top-2 is ``top_k=2``); choice priority is strict —
     every token's first choice is slotted before any second choices, so
-    capacity pressure drops second choices first.
+    capacity pressure drops second choices first. The gate weighting
+    lives entirely in the local dispatch/combine tensors (``disp`` /
+    ``comb``) BEFORE the exchange, so every ``top_k`` — not just
+    top-1 — rides the fused a2a×matmul datapath unchanged: the kernels
+    see the same (E, C, d) slot tensors either way, and the fused
+    backward (dual dx kernels + the fused a2a-wgrad dw kernels) carries
+    the renormalized-gate gradients through the identical einsum
+    closure.
 
     ``overlap`` selects the dispatch/combine datapath (the A/B the
     ``moe_a2a`` bench lane measures):

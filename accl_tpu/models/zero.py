@@ -22,16 +22,20 @@ Two generations of the same idea live here:
     the dp-summed weight gradient (ZeRO's defining move), with the
     backward parameter RE-gather folded into dx's contraction by the
     round-9 fused wgrad kernel;
-  - the attention/bucket leg (parameters with no adjacent matmul to
-    fuse into) gathers per layer with **cross-layer prefetch**: layer
-    l+1's bucket ``all_gather`` is issued under layer l's compute —
-    the double-buffered two-slot schedule, the ``pallas_chunked``
-    credit idiom lifted to the schedule level (two gathered buckets
-    live at any time; XLA's latency-hiding scheduler overlaps the
-    independent collective).  Its GRADIENT rides the wire bucketized
-    and compressed via the ``cmatmul_wire_dtype`` machinery (bf16 /
-    bf16_sr; rounded once before the wire — tolerance-bounded like the
-    mm×rs travelling accumulator).
+  - the attention projections ride the SAME agmm family: Wqkvᵀ and
+    Woᵀ are stored as travel shards (the decode step's fused-qkv shape
+    ported back into training), so with plans engaged the whole step
+    traces ZERO unfused collectives.  When the attention plans alone
+    decline (:func:`fsdp_attn_engage_reason`), the travel blocks
+    gather per layer with **cross-layer prefetch**: layer l+1's
+    ``all_gather`` is issued under layer l's compute — the
+    double-buffered two-slot schedule, the ``pallas_chunked`` credit
+    idiom lifted to the schedule level (two gathered layers live at
+    any time; XLA's latency-hiding scheduler overlaps the independent
+    collective), the decline counted once.  That leg's GRADIENT rides
+    the wire bucketized and compressed via the ``cmatmul_wire_dtype``
+    machinery (bf16 / bf16_sr; rounded once before the wire —
+    tolerance-bounded like the mm×rs travelling accumulator).
 
 The flagship workload is a multi-layer transformer-block train step
 (attention via ``ops/flash.py``, MLP via the collective-matmul family)
@@ -449,11 +453,17 @@ def restore_zero_state(new_comm: Communicator, state: ZeroState,
 
 class FSDPParams(NamedTuple):
     """Per-layer ZeRO shards over a (dp, tp) mesh, one entry per layer.
+    EVERY matrix — attention included — is stored in agmm travel
+    layout, so each device's block IS the fused kernel's travelling
+    shard and the forward contains no unfused parameter gather.
 
-    * ``attn``: (tp, n_attn_pad) — the flat attention bucket (Wqkv ‖ Wo
-      raveled + pad) per tp rank, dp-sharded along the flat dim
-      (spec ``P(tp, dp)``). Gathered unfused with cross-layer prefetch;
-      its gradient rides the bucketized wire-staged reduce-scatter.
+    * ``wqkvt``: (tp·q_rows_pad, d_model) — Wqkvᵀ per tp rank (the
+      fused [q‖k‖v] column block of that rank, transposed; rows padded
+      3·dtp → q_rows_pad for dp divisibility), rows split tp-major
+      then dp (spec ``P((tp, dp), None)``) — the w1t shape.
+    * ``wot``: (d_model, d_model) — Woᵀ; rows dp, cols tp (spec
+      ``P(dp, tp)``) — the w2t shape: each device holds the travelling
+      row block of its tp rank's Woᵀ column slice.
     * ``w1t``: (d_hidden, d_model) — W1ᵀ in travel layout; rows split
       tp-major then dp (spec ``P((tp, dp), None)``), so each device's
       block IS the agmm travelling shard of its tp column block.
@@ -461,7 +471,8 @@ class FSDPParams(NamedTuple):
       cols tp (spec ``P(dp, tp)``).
     """
 
-    attn: Tuple[jax.Array, ...]
+    wqkvt: Tuple[jax.Array, ...]
+    wot: Tuple[jax.Array, ...]
     w1t: Tuple[jax.Array, ...]
     w2t: Tuple[jax.Array, ...]
 
@@ -475,15 +486,30 @@ class ZeroFSDPState(NamedTuple):
 
 def _attn_sizes(d_model: int, tp: int) -> Tuple[int, int]:
     """(dtp, n_attn): per-tp-rank attention column width d/tp and the
-    unpadded flat bucket length 4·d·dtp (Wqkv (d, 3·dtp) + Wo (dtp, d))."""
+    unpadded flat bucket length 4·d·dtp (Wqkv (d, 3·dtp) + Wo (dtp, d))
+    — the pipeline stack's bucket layout (``models/pipeline.py``); the
+    FSDP step itself stores attention in travel layout
+    (:func:`_attn_travel_sizes`)."""
     dtp = d_model // tp
     return dtp, 4 * d_model * dtp
+
+
+def _attn_travel_sizes(d_model: int, tp: int,
+                       dp: int) -> Tuple[int, int, int]:
+    """(dtp, q_rows, q_rows_pad): per-tp-rank column width d/tp, the
+    Wqkvᵀ travel row count 3·dtp, and that count padded up for dp
+    divisibility (the agmm shard geometry — pad rows are zero and
+    their outputs are sliced off before attention)."""
+    dtp = d_model // tp
+    q_rows = 3 * dtp
+    return dtp, q_rows, q_rows + (-q_rows) % dp
 
 
 def fsdp_param_specs(n_layers: int) -> FSDPParams:
     per = lambda s: tuple(s for _ in range(n_layers))
     return FSDPParams(
-        attn=per(P(TP_AXIS, DP_AXIS)),
+        wqkvt=per(P((TP_AXIS, DP_AXIS), None)),
+        wot=per(P(DP_AXIS, TP_AXIS)),
         w1t=per(P((TP_AXIS, DP_AXIS), None)),
         w2t=per(P(DP_AXIS, TP_AXIS)),
     )
@@ -511,13 +537,12 @@ def init_zero_fsdp(key, mesh, n_layers: int, d_model: int, d_hidden: int,
     moments — no rank ever holds a full optimizer state."""
     dp, tp = mesh.shape[DP_AXIS], mesh.shape[TP_AXIS]
     _validate_geometry(dp, tp, d_model, d_hidden, n_heads)
-    dtp, n_attn = _attn_sizes(d_model, tp)
-    n_attn_pad = n_attn + (-n_attn) % dp
+    dtp, q_rows, q_rows_pad = _attn_travel_sizes(d_model, tp, dp)
     s_attn = d_model ** -0.5
     s1 = (2.0 / d_model) ** 0.5
     s2 = (2.0 / d_hidden) ** 0.5
 
-    attn, w1t, w2t = [], [], []
+    wqkvt, wot, w1t, w2t = [], [], [], []
     for lk in jax.random.split(key, n_layers):
         kq, kk, kv, ko, k1, k2 = jax.random.split(lk, 6)
         wq, wk, wv = (np.asarray(jax.random.normal(
@@ -530,11 +555,14 @@ def init_zero_fsdp(key, mesh, n_layers: int, d_model: int, d_hidden: int,
             cols = slice(s * dtp, (s + 1) * dtp)
             wqkv_s = np.concatenate([wq[:, cols], wk[:, cols], wv[:, cols]],
                                     axis=1)              # (d, 3·dtp)
-            wo_s = wo[cols, :]                           # (dtp, d)
-            rows.append(np.concatenate(
-                [wqkv_s.ravel(), wo_s.ravel(),
-                 np.zeros(n_attn_pad - n_attn, np.float32)]))
-        attn.append(np.stack(rows))                      # (tp, n_attn_pad)
+            blk = np.ascontiguousarray(wqkv_s.T)         # (3·dtp, d) travel
+            if q_rows_pad != q_rows:
+                blk = np.concatenate(
+                    [blk, np.zeros((q_rows_pad - q_rows, d_model),
+                                   np.float32)])
+            rows.append(blk)
+        wqkvt.append(np.concatenate(rows))      # (tp·q_rows_pad, d) travel
+        wot.append(np.ascontiguousarray(wo.T))  # (d, d) = Woᵀ travel
         w1 = np.asarray(jax.random.normal(
             k1, (d_model, d_hidden), jnp.float32)) * s1
         w2 = np.asarray(jax.random.normal(
@@ -545,7 +573,8 @@ def init_zero_fsdp(key, mesh, n_layers: int, d_model: int, d_hidden: int,
     specs = fsdp_param_specs(n_layers)
     put = lambda a, s: jax.device_put(a, NamedSharding(mesh, s))
     p = FSDPParams(
-        attn=tuple(put(a, s) for a, s in zip(attn, specs.attn)),
+        wqkvt=tuple(put(a, s) for a, s in zip(wqkvt, specs.wqkvt)),
+        wot=tuple(put(a, s) for a, s in zip(wot, specs.wot)),
         w1t=tuple(put(a, s) for a, s in zip(w1t, specs.w1t)),
         w2t=tuple(put(a, s) for a, s in zip(w2t, specs.w2t)),
     )
@@ -630,6 +659,65 @@ def fsdp_engages(d_model: int, d_hidden: int, batch: int, dp: int, tp: int,
         wire_dtype) is None
 
 
+def fsdp_attn_engage_reason(d_model: int, batch: int, dp: int, tp: int,
+                            overlap: Optional[bool] = None,
+                            bidirectional: bool = True,
+                            wire_dtype=None) -> Optional[str]:
+    """None when the ATTENTION leg of the layerwise step rides the agmm
+    family too — the Wqkvᵀ and Woᵀ travel shards' forward gathers, dual
+    mmrs gradient reductions and fused gathered-wgrad activation
+    gradients all resolve.  A non-None verdict does NOT demote the
+    whole step: the MLP legs (:func:`fsdp_engage_reason`) keep the
+    fused schedule and attention commits honestly to the prefetched
+    travel-block gather baseline (the ``_bucket_gather`` discipline on
+    the SAME travel-layout shards), the decline counted once under
+    ``accl_cmatmul_fallback_total{op="zero_fsdp"}``.  Same vocabulary
+    as :func:`fsdp_engage_reason`."""
+    from ..ops import collective_matmul as cm
+
+    dtp, _, qrp = _attn_travel_sizes(d_model, tp, dp)
+    f32 = jnp.float32
+    checks = (
+        # forward gathers: trav = (qrp/dp, d) and (d/dp, dtp) shards
+        lambda: cm.agmm_engage_reason(
+            qrp // dp, d_model, batch, dp, f32, overlap, bidirectional,
+            wire_dtype=wire_dtype, w_dtype=f32),
+        lambda: cm.agmm_engage_reason(
+            d_model // dp, dtp, batch, dp, f32, overlap, bidirectional,
+            wire_dtype=wire_dtype, w_dtype=f32),
+        # gradient reductions: the custom_vjp duals
+        lambda: cm.mmrs_engage_reason(
+            qrp, batch, d_model, dp, f32, overlap, bidirectional,
+            wire_dtype=wire_dtype, w_dtype=f32),
+        lambda: cm.mmrs_engage_reason(
+            d_model, batch, dtp, dp, f32, overlap, bidirectional,
+            wire_dtype=wire_dtype, w_dtype=f32),
+        # activation gradients: the agmm VJPs' fused gathered-wgrad
+        lambda: cm.wgrad_engage_reason(
+            qrp // dp, d_model, batch, dp, f32, overlap, bidirectional,
+            wire_dtype=wire_dtype, loc_dtype=f32),
+        lambda: cm.wgrad_engage_reason(
+            d_model // dp, dtp, batch, dp, f32, overlap, bidirectional,
+            wire_dtype=wire_dtype, loc_dtype=f32),
+    )
+    for check in checks:
+        reason = check()
+        if reason is not None:
+            return reason
+    return None
+
+
+def fsdp_attn_engages(d_model: int, batch: int, dp: int, tp: int,
+                      overlap: Optional[bool] = None,
+                      bidirectional: bool = True,
+                      wire_dtype=None) -> bool:
+    """:func:`fsdp_attn_engage_reason` collapsed to a bool (the bench
+    lane's ``attn_fused`` honesty flag)."""
+    return dp > 1 and fsdp_attn_engage_reason(
+        d_model, batch, dp, tp, overlap, bidirectional,
+        wire_dtype) is None
+
+
 # ---------------------------------------------------------------------------
 # the bucket gather: unfused all_gather whose GRADIENT is the bucketized
 # wire-staged reduce-scatter (rounded once before the wire, accumulated
@@ -704,6 +792,33 @@ def _attn_sublayer(x, bucket, d_model: int, tp: int, n_heads: int):
     return x + a
 
 
+def _attn_sublayer_t(x, mmqkv, mmo, d_model: int, tp: int, n_heads: int,
+                     dp: int = 1):
+    """x (b, d) -> x + attn(x) with the two projections supplied by the
+    schedule in TRAVEL layout (fused agmm closures over the wqkvt/wot
+    shards, or plain dots over gathered travel blocks) — the
+    ``_mlp_sublayer`` shape applied to attention.  ``mmqkv`` maps the
+    (d, b) activation panel to the (q_rows_pad, b) fused qkvᵀ panel
+    (pad rows sliced off here), ``mmo`` maps the (dtp, b) head-output
+    panel to the (d, b) projection panel.  Heads stay tp-sharded
+    (Megatron) with the one output psum."""
+    dtp, _, _ = _attn_travel_sizes(d_model, tp, dp)
+    qkvt = mmqkv(x.T)                            # (q_rows_pad, b) f32
+    q, k, v = (qkvt[i * dtp:(i + 1) * dtp].T for i in range(3))
+    heads_tp = n_heads // tp
+    dh = dtp // heads_tp
+
+    def to_heads(t):
+        return t.reshape(-1, heads_tp, dh).transpose(1, 0, 2)
+
+    o = _attention(to_heads(q), to_heads(k), to_heads(v))
+    o = o.transpose(1, 0, 2).reshape(-1, dtp).astype(jnp.float32)
+    at = mmo(o.T)                                # (d, b) f32
+    if tp > 1:
+        at = lax.psum(at, TP_AXIS)
+    return x + at.T
+
+
 def _mlp_sublayer(x, mm1, mm2, tp: int):
     """x (b, d) -> x + W2(gelu(W1 x)) with the two matmuls supplied by
     the schedule (fused agmm closures or plain dots over gathered
@@ -742,9 +857,14 @@ def build_zero_fsdp_train_step(mesh, n_layers: int, d_model: int,
     (None: session ``ACCLConfig.cmatmul_wire_dtype``; "off": full
     precision) — the flat baseline always runs full precision.
 
-    The commit decision is honest and counted: the fused datapath runs
-    only when :func:`fsdp_engage_reason` resolves None at the traced
-    batch shape; otherwise the flat schedule runs unchanged and the
+    The commit decision is honest, counted and TIERED: the fused
+    datapath runs only when :func:`fsdp_engage_reason` resolves None at
+    the traced batch shape — and within it, attention rides the agmm
+    family too only when :func:`fsdp_attn_engage_reason` also resolves
+    (zero unfused collectives in the whole step); a declined attention
+    plan commits to the prefetched travel-block gather baseline for
+    attention alone (MLP stays fused), counted once. Anything less
+    than the MLP commit runs the flat schedule unchanged and the
     decline lands in ``accl_cmatmul_fallback_total{op="zero_fsdp"}``
     (an explicit/session overlap-off is a requested baseline — never
     counted)."""
@@ -752,81 +872,127 @@ def build_zero_fsdp_train_step(mesh, n_layers: int, d_model: int,
     _validate_geometry(dp, tp, d_model, d_hidden, n_heads)
     axes = tuple(mesh.axis_names)
     L = n_layers
-    dtp, n_attn = _attn_sizes(d_model, tp)
-    n_attn_pad = n_attn + (-n_attn) % dp
+    dtp, q_rows, q_rows_pad = _attn_travel_sizes(d_model, tp, dp)
     h_tp = d_hidden // tp
-    la, l1, l2 = n_attn_pad // dp, (h_tp // dp) * d_model, \
-        (d_model // dp) * h_tp
-    per = la + l1 + l2
+    lq, lo = (q_rows_pad // dp) * d_model, (d_model // dp) * dtp
+    l1, l2 = (h_tp // dp) * d_model, (d_model // dp) * h_tp
+    per = lq + lo + l1 + l2
 
     def _resolved_overlap():
         if overlap is None:
             return None if _OVERLAP_DEFAULT else False
         return overlap
 
-    def _fused_loss(p: FSDPParams, x, y, do_prefetch: bool, ov):
+    def _fused_loss(p: FSDPParams, x, y, do_prefetch: bool, ov,
+                    attn_fused: bool):
         from ..ops import collective_matmul as cm
 
         def agmm(trav, panel):
             return cm.all_gather_matmul(trav, panel, DP_AXIS, axes, ov,
                                         bidirectional, wire_dtype)
 
-        def gather(l):
-            return _bucket_gather(p.attn[l][0], DP_AXIS, wire_dtype)
-
-        h = x
-        nxt = gather(0)
-        for l in range(L):
-            bucket = nxt
-            if l + 1 < L and do_prefetch:
-                # cross-layer prefetch: layer l+1's bucket gather is
-                # issued BEFORE layer l's compute — independent of h, so
-                # the collective overlaps flash + the fused matmuls
-                # (double-buffered: at most two gathered buckets live)
-                nxt = gather(l + 1)
-            h = _attn_sublayer(h, bucket, d_model, tp, n_heads)
-            h = _mlp_sublayer(
+        def mlp(h, l):
+            return _mlp_sublayer(
                 h,
                 lambda xt, l=l: agmm(p.w1t[l], xt),
                 lambda u, l=l: agmm(p.w2t[l], u),
                 tp)
+
+        if attn_fused:
+            # attention-on-agmm: the Wqkv/Wo travel shards ride the
+            # SAME fused gather×matmul as the MLP — the step contains
+            # no unfused parameter collective at all, so there is no
+            # bucket to prefetch
+            h = x
+            for l in range(L):
+                h = _attn_sublayer_t(
+                    h,
+                    lambda xt, l=l: agmm(p.wqkvt[l], xt),
+                    lambda ot, l=l: agmm(p.wot[l], ot),
+                    d_model, tp, n_heads, dp)
+                h = mlp(h, l)
+            return jnp.mean((h - y) ** 2)
+
+        # attention plan declined: the travel blocks gather per layer
+        # with cross-layer prefetch (the bucket baseline on the same
+        # shards — gradient bucketized + wire-staged), MLP stays fused
+        def gather(l, tie=None):
+            def shard(a):
+                if tie is None:
+                    return a
+                # prefetch declined: tie the gather's operand to the
+                # previous layer's output (a zero-valued scalar
+                # dependency — this jax's optimization_barrier has no
+                # AD rule) so the collective cannot be hoisted above
+                # the layer boundary
+                return a + (tie[0, 0] * 0.0).astype(a.dtype)
+            return (_bucket_gather(shard(p.wqkvt[l]), DP_AXIS, wire_dtype),
+                    _bucket_gather(shard(p.wot[l]), DP_AXIS, wire_dtype))
+
+        h = x
+        nxt = gather(0)
+        for l in range(L):
+            wq_f, wo_f = nxt
+            if l + 1 < L and do_prefetch:
+                # cross-layer prefetch: layer l+1's travel-block gather
+                # is issued BEFORE layer l's compute — independent of
+                # h, so the collective overlaps flash + the fused
+                # matmuls (double-buffered: at most two gathered layers
+                # live)
+                nxt = gather(l + 1)
+            h = _attn_sublayer_t(
+                h,
+                lambda xt, w=wq_f: jnp.dot(
+                    w, xt, preferred_element_type=jnp.float32),
+                lambda ot, w=wo_f: jnp.dot(
+                    w, ot, preferred_element_type=jnp.float32),
+                d_model, tp, n_heads, dp)
+            h = mlp(h, l)
             if l + 1 < L and not do_prefetch:
-                # prefetch declined: tie the next gather's operand to
-                # this layer's output (a zero-valued scalar dependency —
-                # this jax's optimization_barrier has no AD rule) so the
-                # collective cannot be hoisted above the layer boundary
-                shard = p.attn[l + 1][0] \
-                    + (h[0, 0] * 0.0).astype(p.attn[l + 1].dtype)
-                nxt = _bucket_gather(shard, DP_AXIS, wire_dtype)
+                nxt = gather(l + 1, tie=h)
         return jnp.mean((h - y) ** 2)
 
     def _flat_step_grads(p: FSDPParams, x, y):
         """The flat-ravel schedule: ONE monolithic all_gather of every
         layer's shards, compute with fully materialized weights, ONE
         monolithic psum_scatter of the raveled gradient — the baseline
-        the fused step's overlap efficiency is measured against."""
+        the fused step's overlap efficiency is measured against. Same
+        block math as the fused schedules (``_attn_sublayer_t`` over
+        the gathered travel blocks), so the two datapaths agree on
+        every non-collective op."""
         flat = jnp.concatenate(
             [seg for l in range(L)
-             for seg in (p.attn[l][0], p.w1t[l].ravel(),
-                         p.w2t[l].ravel())])
+             for seg in (p.wqkvt[l].ravel(), p.wot[l].ravel(),
+                         p.w1t[l].ravel(), p.w2t[l].ravel())])
         full = lax.all_gather(flat, DP_AXIS, axis=0,
                               tiled=True).reshape(dp, L * per)
-        af, w1f, w2f = [], [], []
+        wqf, wof, w1f, w2f = [], [], [], []
         for l in range(L):
             off = l * per
-            af.append(full[:, off:off + la].reshape(-1))
-            w1f.append(full[:, off + la:off + la + l1]
+            wqf.append(full[:, off:off + lq]
+                       .reshape(dp, q_rows_pad // dp, d_model)
+                       .reshape(q_rows_pad, d_model))
+            wof.append(full[:, off + lq:off + lq + lo]
+                       .reshape(dp, d_model // dp, dtp)
+                       .reshape(d_model, dtp))
+            w1f.append(full[:, off + lq + lo:off + lq + lo + l1]
                        .reshape(dp, h_tp // dp, d_model)
                        .reshape(h_tp, d_model))
-            w2f.append(full[:, off + la + l1:off + per]
+            w2f.append(full[:, off + lq + lo + l1:off + per]
                        .reshape(dp, d_model // dp, h_tp)
                        .reshape(d_model, h_tp))
 
         def loss_fn(fulls):
-            afl, w1l, w2l = fulls
+            wql, wol, w1l, w2l = fulls
             h = x
             for l in range(L):
-                h = _attn_sublayer(h, afl[l], d_model, tp, n_heads)
+                h = _attn_sublayer_t(
+                    h,
+                    lambda xt, l=l: jnp.dot(
+                        wql[l], xt, preferred_element_type=jnp.float32),
+                    lambda ot, l=l: jnp.dot(
+                        wol[l], ot, preferred_element_type=jnp.float32),
+                    d_model, tp, n_heads, dp)
                 h = _mlp_sublayer(
                     h,
                     lambda xt, l=l: jnp.dot(
@@ -836,11 +1002,14 @@ def build_zero_fsdp_train_step(mesh, n_layers: int, d_model: int,
                     tp)
             return jnp.mean((h - y) ** 2)
 
-        loss, (ga, g1, g2) = jax.value_and_grad(loss_fn)(
-            (tuple(af), tuple(w1f), tuple(w2f)))
+        loss, (gq, go, g1, g2) = jax.value_and_grad(loss_fn)(
+            (tuple(wqf), tuple(wof), tuple(w1f), tuple(w2f)))
         segs = []
         for l in range(L):
-            segs.append(ga[l].reshape(dp, la))
+            segs.append(gq[l].reshape(dp, q_rows_pad // dp, d_model)
+                        .reshape(dp, lq))
+            segs.append(go[l].reshape(dp, d_model // dp, dtp)
+                        .reshape(dp, lo))
             segs.append(g1[l].reshape(dp, h_tp // dp, d_model)
                         .reshape(dp, l1))
             segs.append(g2[l].reshape(dp, d_model // dp, h_tp)
@@ -848,33 +1017,48 @@ def build_zero_fsdp_train_step(mesh, n_layers: int, d_model: int,
         flatg = jnp.concatenate(segs, axis=1).reshape(-1)
         gsh = lax.psum_scatter(flatg, DP_AXIS, scatter_dimension=0,
                                tiled=True)
-        gattn, gw1t, gw2t = [], [], []
+        gwqt, gwot, gw1t, gw2t = [], [], [], []
         for l in range(L):
             off = l * per
-            gattn.append(gsh[off:off + la].reshape(1, la))
-            gw1t.append(gsh[off + la:off + la + l1]
+            gwqt.append(gsh[off:off + lq]
+                        .reshape(q_rows_pad // dp, d_model))
+            gwot.append(gsh[off + lq:off + lq + lo]
+                        .reshape(d_model // dp, dtp))
+            gw1t.append(gsh[off + lq + lo:off + lq + lo + l1]
                         .reshape(h_tp // dp, d_model))
-            gw2t.append(gsh[off + la + l1:off + per]
+            gw2t.append(gsh[off + lq + lo + l1:off + per]
                         .reshape(d_model // dp, h_tp))
-        return loss, FSDPParams(tuple(gattn), tuple(gw1t), tuple(gw2t))
+        return loss, FSDPParams(tuple(gwqt), tuple(gwot),
+                                tuple(gw1t), tuple(gw2t))
 
     def local_step(state: ZeroFSDPState, x, y):
         p, m, v, t = state
         b = x.shape[0]
         ov = _resolved_overlap()
         reason = None
+        attn_reason = None
         if dp > 1:
             reason = fsdp_engage_reason(d_model, d_hidden, b, dp, tp, ov,
                                         bidirectional, wire_dtype)
+            attn_reason = fsdp_attn_engage_reason(d_model, b, dp, tp, ov,
+                                                  bidirectional, wire_dtype)
         fused = dp > 1 and reason is None
+        attn_fused = fused and attn_reason is None
         if fused:
+            if not attn_fused and attn_reason != "off":
+                # attention alone declined the agmm commit: the step
+                # stays fused for the MLP legs but attention runs the
+                # prefetched-gather baseline — counted once, honestly
+                from ..ops.collective_matmul import _note_fallback
+                _note_fallback(FSDP_OP, attn_reason)
             do_prefetch = (_PREFETCH_DEFAULT if prefetch is None
                            else bool(prefetch))
-            if L > 1:
+            if not attn_fused and L > 1:
                 _metrics.note_zero_prefetch(
                     "hit" if do_prefetch else "decline", L - 1)
             loss, grads = jax.value_and_grad(
-                _fused_loss, argnums=0)(p, x, y, do_prefetch, ov)
+                _fused_loss, argnums=0)(p, x, y, do_prefetch, ov,
+                                        attn_fused)
         else:
             if dp > 1 and reason != "off":
                 from ..ops.collective_matmul import _note_fallback

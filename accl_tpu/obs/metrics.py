@@ -26,7 +26,7 @@ name                                           kind       labels
 ``accl_rx_pool_exhausted_total``               counter    (none)
 ``accl_algorithm_fallback_total``              counter    op, algorithm
 ``accl_algorithm_selected_total``              counter    op, algorithm
-``accl_cmatmul_fallback_total``                counter    op, reason (vmem_miss | no_interpret | threshold | geometry)
+``accl_cmatmul_fallback_total``                counter    op (cmatmul pair + ``_dw`` siblings, a2a pair, ``moe_a2a_dw``, ``moe_alltoall``, ``zero_fsdp``, ``pp_relay``, ``pp_pipeline``), reason (vmem_miss — no arm fits, n-blocked streaming included | no_interpret | threshold | geometry)
 ``accl_pp_relay_total``                        counter    path (fused | ppermute; pipeline relay dispatch)
 ``accl_kv_seconds``                            histogram  kvop (get | set | incr)
 ``accl_session_handshake_retries_total``       counter    (none)

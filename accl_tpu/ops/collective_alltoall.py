@@ -43,9 +43,19 @@ combine are transposes of each other), registered as ``jax.custom_vjp``:
 
 * d(alltoall_matmul):  dx = matmul_alltoall(dy, w_inᵀ)  — each source's
   cotangent block routed home through the fused combine kernel;
-  dw_in[e] = all_to_all(x)[e]ᵀ @ dy[e] (one unfused a2a);
+  dw_in[e] = all_to_all(x)[e]ᵀ @ dy[e] rides the fused a2a-wgrad
+  kernel (:func:`a2a_gathered_wgrad_body`): the x gather folded into
+  dw's per-expert contraction sweep, f32-accumulated in VMEM;
 * d(matmul_alltoall):  dh = alltoall_matmul(dy, w_outᵀ) — the fused
-  dispatch kernel; dw_out[e] = h[e]ᵀ @ all_to_all(dy)[e].
+  dispatch kernel; dw_out[e] = h[e]ᵀ @ all_to_all(dy)[e] — the SAME
+  a2a-wgrad kernel with the roles flipped (dy travels, h resident).
+
+With plans engaged the MoE backward therefore traces ZERO unfused
+collectives.  A dw plan miss falls back to the unfused
+``lax.all_to_all`` + einsum pair, counted under
+``accl_cmatmul_fallback_total{op="moe_a2a_dw"}``;
+``ACCLConfig.moe_dw_overlap=False`` requests that baseline outright
+(never counted).
 
 A block-geometry policy (:func:`a2a_plan`) sizes the resident working
 set (payload blocks, expert weights, output panel, staging slots)
@@ -127,6 +137,23 @@ def set_overlap_threshold(nbytes: int) -> None:
 
 def get_overlap_threshold() -> int:
     return _A2A_THRESHOLD
+
+
+_DW_OVERLAP_DEFAULT = True
+
+
+def set_dw_overlap_enabled(enabled: bool) -> None:
+    """Module default for the fused a2a-wgrad (dw) path
+    (``ACCLConfig.moe_dw_overlap`` lands here at every config
+    assignment).  False keeps the unfused ``lax.all_to_all`` + einsum
+    dw pair in both a2a VJPs — a requested baseline, never counted as
+    a fallback."""
+    global _DW_OVERLAP_DEFAULT
+    _DW_OVERLAP_DEFAULT = bool(enabled)
+
+
+def get_dw_overlap_enabled() -> bool:
+    return _DW_OVERLAP_DEFAULT
 
 
 def _resolve(overlap: Optional[bool], nbytes: int) -> bool:
@@ -433,6 +460,121 @@ def _mm_a2a_call(hp_, wp, *, P: int, axis: str, mesh_axes: Tuple[str, ...],
 
 
 # ---------------------------------------------------------------------------
+# wgrad kernel: all-to-all x per-expert dim-0 contraction (the dw legs)
+# ---------------------------------------------------------------------------
+
+def _a2a_wgrad_kernel(t_ref, l_ref, o_ref, buf, send_sem, recv_sem, cap_sem,
+                      *, P: int, axis: str, mesh_axes: Tuple[str, ...],
+                      bidirectional: bool, e_local: int, travel_lhs: bool):
+    """t_ref: (P, e_local, cp, ctp) TRAVELLING blocks by destination rank
+    (x for d(dispatch), dy for d(combine)); l_ref: (e_local, P*cp, clp)
+    the resident LOCAL operand (dy resp. h), source-rank-major; o_ref:
+    (e_local, ctp, clp) f32 dw panels (``travel_lhs=False`` transposes
+    to (e_local, clp, ctp)) — all VMEM.  ``buf``: (nchan, 2, e_local,
+    cp, ctp) double-buffered recv slots.
+
+    The flat exchange is ``_a2a_mm_kernel`` verbatim — same per-STEP
+    credit slots, same double buffering — but the consumer ACCUMULATES:
+    each arrival from source rank ``src`` contracts per expert over the
+    token rows (dim 0 both sides) against ``l_ref``'s ``src`` row block
+    and adds into the dw panel in f32.  The local block's contraction
+    initializes the accumulator while step 1's wire flies (output VMEM
+    is uninitialized — the prologue must assign, not add).  Wire dtypes
+    on the traveller up-convert at the MXU, so the sum stays f32
+    on-chip end to end."""
+    nchan = 2 if bidirectional else 1
+    cp = buf.shape[3]
+    pos = lax.axis_index(axis)
+    _flat_barrier(axis, mesh_axes, P)
+
+    def peer(off):
+        return _flat_of(axis, mesh_axes, P, off)
+
+    def ringpos(off):
+        return lax.rem(pos + jnp.int32(off) + jnp.int32(2 * P),
+                       jnp.int32(P))
+
+    def _rdma(chan, sign, u):
+        return pltpu.make_async_remote_copy(
+            src_ref=t_ref.at[ringpos(sign * u)],
+            dst_ref=buf.at[chan, u % 2],
+            send_sem=send_sem.at[chan, u % 2],
+            recv_sem=recv_sem.at[chan, u % 2],
+            device_id=peer(sign * u),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    def fold(block, src, first):
+        # per-expert dim-0 contraction of the arrival against the source
+        # rank's row block of the resident operand, f32-accumulated into
+        # the dw panel
+        for e in range(e_local):
+            a = block[e]
+            b = l_ref[e, pl.ds(src * cp, cp), :]
+            dt = jnp.promote_types(a.dtype, b.dtype)
+            lhs, rhs = (a, b) if travel_lhs else (b, a)
+            part = lax.dot_general(
+                lhs.astype(dt), rhs.astype(dt),
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            o_ref[e] = part if first else o_ref[e] + part
+
+    chans = _chan_steps(P, nchan)
+    # prologue: every channel's step-1 send goes out first; the LOCAL
+    # block's contraction then hides the first wire time and seeds the
+    # accumulator
+    for chan, (sign, T) in enumerate(chans):
+        if T >= 1:
+            _rdma(chan, sign, 1).start()
+    fold(t_ref[pos], pos, first=True)
+
+    for u in range(1, max(T for _, T in chans) + 1):
+        for chan, (sign, T) in enumerate(chans):
+            if u > T:
+                continue
+            _rdma(chan, sign, u).wait_recv()
+            if u + 1 <= T:
+                # credit gate keyed per step — see _a2a_mm_kernel
+                if u + 1 >= 3:
+                    pltpu.semaphore_wait(cap_sem.at[chan, u + 1], 1)
+                _rdma(chan, sign, u + 1).start()
+            fold(buf[chan, u % 2], ringpos(-sign * u), first=False)
+            _rdma(chan, sign, u).wait_send()
+            if u + 2 <= T:
+                pltpu.semaphore_signal(
+                    cap_sem.at[chan, u + 2], inc=1,
+                    device_id=peer(-sign * (u + 2)),
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+
+def _a2a_wgrad_call(tp_, lp, *, P: int, axis: str,
+                    mesh_axes: Tuple[str, ...], bidirectional: bool,
+                    e_local: int, travel_lhs: bool):
+    _, _, cp, ctp = tp_.shape
+    clp = lp.shape[2]
+    nchan = 2 if bidirectional else 1
+    oshape = (e_local, ctp, clp) if travel_lhs else (e_local, clp, ctp)
+    return pl.pallas_call(
+        functools.partial(_a2a_wgrad_kernel, P=P, axis=axis,
+                          mesh_axes=mesh_axes, bidirectional=bidirectional,
+                          e_local=e_local, travel_lhs=travel_lhs),
+        out_shape=jax.ShapeDtypeStruct(oshape, jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((nchan, 2, e_local, cp, ctp), tp_.dtype),  # buf
+            pltpu.SemaphoreType.DMA((nchan, 2)),                  # send_sem
+            pltpu.SemaphoreType.DMA((nchan, 2)),                  # recv_sem
+            pltpu.SemaphoreType.REGULAR((nchan, P + 1)),          # cap_sem
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=16),
+        interpret=_interpret_params(),
+    )(tp_, lp)
+
+
+# ---------------------------------------------------------------------------
 # block-geometry policy
 # ---------------------------------------------------------------------------
 
@@ -540,6 +682,69 @@ def a2a_matmul_engages(e_local: int, C: int, d: int, h: int, P: int, dtype,
     return a2a_engage_reason(e_local, C, d, h, P, dtype, overlap,
                              bidirectional, wire_dtype, w_dtype,
                              direction) is None
+
+
+def a2a_wgrad_plan(e_local: int, C: int, ct: int, cl: int, P: int, dtype,
+                   bidirectional: bool, loc_dtype=None,
+                   wire_dtype=None) -> Optional[dict]:
+    """Geometry for the fused a2a-wgrad direction: travelling blocks
+    (e_local, C, ct) by destination, resident local operand (e_local,
+    P·C, cl), f32 dw panels (e_local, ct, cl) — everything VMEM-resident
+    like :func:`a2a_plan` (the dw payload is capacity-bounded by the
+    same construction), None on a 12 MiB scoped-VMEM miss (→ the
+    unfused ``lax.all_to_all`` + einsum pair; counted ``vmem_miss``
+    under ``op="moe_a2a_dw"``).  ``dtype`` is the traveller dtype;
+    ``wire_dtype`` sizes the staged traveller terms when set."""
+    if e_local < 1 or C < 1 or ct < 1 or cl < 1 or P < 1:
+        return None
+    ldt = jnp.dtype(loc_dtype) if loc_dtype is not None else jnp.dtype(dtype)
+    tdt = jnp.dtype(wire_dtype) if wire_dtype is not None else jnp.dtype(dtype)
+    nchan = 2 if (bidirectional and P >= 4) else 1
+    # the token rows are the CONTRACTION dim: pad to the coarser sublane
+    # of the two operand dtypes (both sides slice at cp granularity)
+    cp = cm._pad_to(max(C, 1), max(_sublane(tdt), _sublane(ldt)))
+    ctp = cm._pad_to(max(ct, 1), _LANES)
+    clp = cm._pad_to(max(cl, 1), _LANES)
+    ti = tdt.itemsize
+    est = (P * e_local * cp * ctp * ti            # traveller blocks by dest
+           + nchan * 2 * e_local * cp * ctp * ti  # recv slots
+           + e_local * P * cp * clp * ldt.itemsize  # resident local operand
+           + e_local * ctp * clp * 4)             # f32 dw panels
+    if est > _VMEM_BUDGET:
+        return None
+    return {"mode": "resident", "cp": cp, "ctp": ctp, "clp": clp,
+            "nchan": nchan, "bidirectional": nchan == 2,
+            "vmem_bytes": est}
+
+
+def a2a_wgrad_engage_reason(e_local: int, C: int, ct: int, cl: int, P: int,
+                            dtype, overlap: Optional[bool] = None,
+                            bidirectional: bool = True,
+                            wire_dtype=None,
+                            loc_dtype=None) -> Optional[str]:
+    """None when the fused a2a-wgrad kernel would actually run in the
+    VJP dw legs; otherwise the decline reason — ``"off"`` covers the
+    per-call/session overlap-off request AND the dedicated
+    ``ACCLConfig.moe_dw_overlap=False`` baseline switch (requested
+    baselines, never counted); ``"no_interpret"`` / ``"threshold"`` /
+    ``"vmem_miss"`` count under ``op="moe_a2a_dw"`` exactly where the
+    body declines.  Like :func:`a2a_engage_reason`, P=1 worlds never
+    reach a kernel (the body shortcuts to the plain einsum)."""
+    wdt = cm._resolve_wire(wire_dtype, dtype)
+    nbytes = e_local * C * ct * jnp.dtype(
+        wdt if wdt is not None else dtype).itemsize
+    if not _DW_OVERLAP_DEFAULT or \
+            (overlap is not None and not overlap) or \
+            (overlap is None and not _OVERLAP_DEFAULT):
+        return "off"
+    if not cm._kernels_available():
+        return "no_interpret"
+    if overlap is None and nbytes < _A2A_THRESHOLD:
+        return "threshold"
+    if a2a_wgrad_plan(e_local, C, ct, cl, P, dtype, bidirectional,
+                      loc_dtype=loc_dtype, wire_dtype=wdt) is None:
+        return "vmem_miss"
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -666,6 +871,77 @@ def matmul_alltoall_body(h, w, *, axis: str = AXIS,
     return out[:, :, :C, :d].reshape(P * el, C, d)
 
 
+def a2a_gathered_wgrad_body(trav, loc, *, axis: str = AXIS,
+                            mesh_axes: Optional[Tuple[str, ...]] = None,
+                            overlap: Optional[bool] = None,
+                            bidirectional: bool = True,
+                            wire_dtype=None,
+                            travel_lhs: bool = True):
+    """Per-rank fused dw body for both a2a VJPs: ``trav`` (E, C, ct)
+    blocks by destination ride the flat exchange while each arrival's
+    per-expert contraction against ``loc`` (e_local, P·C, cl) — the
+    source rank's row block, token rows contracted — accumulates f32
+    into the dw panel.  ``travel_lhs=True`` returns (e_local, ct, cl)
+    (d(dispatch): trav=x, loc=dy → dwᵢₙ), False returns (e_local, cl,
+    ct) (d(combine): trav=dy, loc=h → dwₒᵤₜ); both are f32 and exactly
+    ``einsum`` of the gathered traveller against ``loc``.  Declines
+    fall back to the unfused ``lax.all_to_all`` + einsum pair, counted
+    under ``accl_cmatmul_fallback_total{op="moe_a2a_dw"}``;
+    ``ACCLConfig.moe_dw_overlap=False`` pins that baseline without
+    counting."""
+    E, C, ct = trav.shape
+    el, PC, cl = loc.shape
+    P = lax.axis_size(axis)
+    if E % P or el != E // P:
+        raise ValueError(
+            f"traveller blocks {E} must be world {P} x local experts {el}")
+    if PC != P * C:
+        raise ValueError(
+            f"local rows {PC} must be world {P} x block rows {C}")
+    mesh_axes = tuple(mesh_axes) if mesh_axes else (axis,)
+
+    def _unfused(g):
+        b = loc.astype(g.dtype)
+        if travel_lhs:
+            return jnp.einsum("ept,epl->etl", g, b,
+                              preferred_element_type=jnp.float32)
+        return jnp.einsum("epl,ept->elt", b, g,
+                          preferred_element_type=jnp.float32)
+
+    if P == 1:
+        return _unfused(trav)
+    wdt, sr = cm._resolve_wire_codec(wire_dtype, trav.dtype)
+    block_bytes = el * C * ct * jnp.dtype(
+        wdt if wdt is not None else trav.dtype).itemsize
+    plan = None
+    if _DW_OVERLAP_DEFAULT:
+        if _resolve(overlap, block_bytes):
+            plan = a2a_wgrad_plan(el, C, ct, cl, P, trav.dtype,
+                                  bidirectional, loc_dtype=loc.dtype,
+                                  wire_dtype=wdt)
+            if plan is None:
+                cm._note_fallback("moe_a2a_dw", "vmem_miss")
+        else:
+            _fallback_reason(overlap, "moe_a2a_dw")
+    # moe_dw_overlap=False: a requested baseline, never counted
+    if plan is None:
+        return _unfused(lax.all_to_all(trav, axis, split_axis=0,
+                                       concat_axis=1, tiled=True))
+    cp, ctp, clp = plan["cp"], plan["ctp"], plan["clp"]
+    tw = cm._wire_cast(trav, wdt, stochastic=sr)
+    tp_ = jnp.zeros((P, el, cp, ctp), tw.dtype)
+    tp_ = lax.dynamic_update_slice(tp_, tw.reshape(P, el, C, ct),
+                                   (0, 0, 0, 0))
+    lp = jnp.zeros((el, P, cp, clp), loc.dtype)
+    lp = lax.dynamic_update_slice(lp, loc.reshape(el, P, C, cl),
+                                  (0, 0, 0, 0))
+    out = _a2a_wgrad_call(tp_, lp.reshape(el, P * cp, clp), P=P, axis=axis,
+                          mesh_axes=mesh_axes,
+                          bidirectional=plan["bidirectional"], e_local=el,
+                          travel_lhs=travel_lhs)
+    return out[:, :ct, :cl] if travel_lhs else out[:, :cl, :ct]
+
+
 # ---------------------------------------------------------------------------
 # differentiable entry points (dispatch and combine are transposes)
 # ---------------------------------------------------------------------------
@@ -705,11 +981,13 @@ def _a2amm_bwd(axis, mesh_axes, overlap, bidirectional, wire_dtype, res, dy):
         dy.astype(x.dtype), jnp.transpose(w, (0, 2, 1)).astype(x.dtype),
         axis=axis, mesh_axes=mesh_axes, overlap=overlap,
         bidirectional=bidirectional, wire_dtype=wire_dtype).astype(x.dtype)
-    # dw[e] = all_to_all(x)[e]ᵀ @ dy[e]: the gather is the plain a2a —
-    # the dw payload moves exactly once either way
-    recv = lax.all_to_all(x, axis, split_axis=0, concat_axis=1, tiled=True)
-    dw = jnp.einsum("epd,eph->edh", recv, dy.astype(recv.dtype),
-                    preferred_element_type=jnp.float32).astype(w.dtype)
+    # dw[e] = all_to_all(x)[e]ᵀ @ dy[e]: the x gather folded into dw's
+    # per-expert contraction sweep (the fused a2a-wgrad kernel; the dw
+    # payload still moves exactly once)
+    dw = a2a_gathered_wgrad_body(
+        x, dy, axis=axis, mesh_axes=mesh_axes, overlap=overlap,
+        bidirectional=bidirectional, wire_dtype=wire_dtype,
+        travel_lhs=True).astype(w.dtype)
     return dx, dw
 
 
@@ -748,11 +1026,12 @@ def _mma2a_bwd(axis, mesh_axes, overlap, bidirectional, wire_dtype, res, dy):
         dy.astype(h.dtype), jnp.transpose(w, (0, 2, 1)).astype(h.dtype),
         axis=axis, mesh_axes=mesh_axes, overlap=overlap,
         bidirectional=bidirectional, wire_dtype=wire_dtype).astype(h.dtype)
-    # dw[e] = h[e]ᵀ @ all_to_all(dy)[e] (one unfused a2a)
-    recv_dy = lax.all_to_all(dy.astype(h.dtype), axis, split_axis=0,
-                             concat_axis=1, tiled=True)
-    dw = jnp.einsum("eph,epd->ehd", h, recv_dy,
-                    preferred_element_type=jnp.float32).astype(w.dtype)
+    # dw[e] = h[e]ᵀ @ all_to_all(dy)[e]: the SAME fused a2a-wgrad
+    # kernel with the roles flipped — dy travels, h stays resident
+    dw = a2a_gathered_wgrad_body(
+        dy.astype(h.dtype), h, axis=axis, mesh_axes=mesh_axes,
+        overlap=overlap, bidirectional=bidirectional,
+        wire_dtype=wire_dtype, travel_lhs=False).astype(w.dtype)
     return dh, dw
 
 

@@ -45,10 +45,17 @@ it picks a ``k_block`` and the **streaming** kernels run (``mode:
 stream``) — the ``pallas_chunked`` segmentation discipline applied to
 the matmul operand.  The per-hop shard pipelines HBM→VMEM in k-blocks
 through the same double-buffered credit-semaphore staging; only the
-k-BLOCK (not the shard) must fit the scoped-VMEM budget.  The unfused
-XLA pair remains only for kernels-unavailable rungs, thresholds, and
-degenerate geometries (every fallback is counted in
-``accl_cmatmul_fallback_total`` by reason).
+k-BLOCK (not the shard) must fit the scoped-VMEM budget.  When even
+the minimum k-block misses — the (m, n) f32 ACCUMULATOR floor — the
+plans grow an accumulator-blocking arm (the k-block idiom rotated,
+gated by ``ACCLConfig.cmatmul_nblock``): the accumulator splits along
+a lane-aligned block of its own dim (traveller rows for agmm, output
+columns for mm×rs, traveller columns for the fused wgrad) and the body
+runs the existing streaming kernel once per block over disjoint output
+slices — wire-neutral, since the blocks' payloads sum to the unsplit
+payload.  The unfused XLA pair remains only for kernels-unavailable
+rungs, thresholds, and degenerate geometries (every fallback is
+counted in ``accl_cmatmul_fallback_total`` by reason).
 
 **Fused dgrad/wgrad** (round 9): both ``custom_vjp`` backward rules now
 overlap BOTH gradients.  dx was already the dual kernel; dw — formerly
@@ -183,6 +190,33 @@ def _ag_threshold(k: int, n: int) -> int:
 
 def _rs_threshold(k: int, n: int) -> int:
     return int(_RS_CLASS_THRESHOLDS.get(aspect_class(k, n), _RS_THRESHOLD))
+
+
+#: accumulator-blocking register (``ACCLConfig.cmatmul_nblock``
+#: write-through): when the k-blocked streaming sweep still misses the
+#: VMEM budget — the irreducible (m, n) f32 accumulator floor — the
+#: plans grow a SECOND halving sweep that splits the accumulator itself
+#: along a lane-aligned block of its own dim (traveller rows for agmm,
+#: output columns for mm×rs, traveller columns for the fused wgrad) and
+#: the bodies run the existing kernels once per block over disjoint
+#: output slices (wire-neutral: the blocks' payloads sum to the unsplit
+#: payload). False pins the pre-blocking behavior: accumulator-floor
+#: shapes decline to the unfused pair (counted ``vmem_miss``).
+_NBLOCK_DEFAULT = True
+
+
+def set_nblock_enabled(enabled: bool) -> None:
+    """Set the module-default accumulator-blocking mode
+    (``ACCLConfig.cmatmul_nblock`` lands here at every config
+    assignment). Existing-shape plan resolution is unaffected either
+    way — the blocked arms run only after the resident and k-block
+    sweeps both miss."""
+    global _NBLOCK_DEFAULT
+    _NBLOCK_DEFAULT = bool(enabled)
+
+
+def get_nblock_enabled() -> bool:
+    return _NBLOCK_DEFAULT
 
 
 # ---------------------------------------------------------------------------
@@ -1169,14 +1203,23 @@ def _pad_to(v: int, mult: int) -> int:
     return -(-v // mult) * mult
 
 
+def _shrink_block(bp: int, mult: int, fits) -> Optional[int]:
+    """Largest ``mult``-aligned block (halving sweep from ``bp``)
+    accepted by ``fits``; None when even the minimum (one ``mult``)
+    block misses. The k-block sweep with the alignment generalized —
+    the accumulator-blocking arms sweep dims whose quantum is the
+    sublane-group (traveller rows) rather than always the lane."""
+    b = bp
+    while b > mult and not fits(b):
+        b = max(mult, _pad_to(b // 2, mult))
+    return b if fits(b) else None
+
+
 def _shrink_kb(kp: int, fits) -> Optional[int]:
     """Largest lane-aligned k-block (halving sweep from the full padded
     k) accepted by ``fits``; None when even the 128-lane minimum
     misses."""
-    kb = kp
-    while kb > _LANES and not fits(kb):
-        kb = max(_LANES, _pad_to(kb // 2, _LANES))
-    return kb if fits(kb) else None
+    return _shrink_block(kp, _LANES, fits)
 
 
 def agmm_plan(m: int, k: int, n: int, P: int, dtype,
@@ -1190,9 +1233,13 @@ def agmm_plan(m: int, k: int, n: int, P: int, dtype,
     shard pipelines through VMEM in lane-aligned ``kb`` k-blocks
     (payload, weights and output stay in HBM; only 2 send + 2 recv
     (mh, kb) slots, one (kb, n) weight block and 2 (mh, n) f32
-    accumulators per channel are resident). None only when even the
-    128-lane k-block misses (the irreducible m×n accumulator floor) —
-    the caller falls back to the unfused XLA pair.
+    accumulators per channel are resident). When even the 128-lane
+    k-block misses — the m×n accumulator floor — the accumulator-
+    blocking arm (``cmatmul_nblock``) splits the traveller's rows into
+    ``mb``-blocks (keys ``mb``/``nmb``; the body runs the streaming
+    kernel once per block). None only when the lane-floor weight block
+    alone exceeds the budget — the caller falls back to the unfused
+    XLA pair.
 
     ``wire_dtype`` sizes the staged/transferred x terms (wire staging
     halves them under bf16); ``w_dtype`` sizes the weight terms when it
@@ -1224,12 +1271,38 @@ def agmm_plan(m: int, k: int, n: int, P: int, dtype,
                 + kb * np_ * wisz)     # staged w k-block
 
     kb = _shrink_kb(kp, lambda b: est_stream(b) <= _VMEM_BUDGET)
-    if kb is None:
+    if kb is not None:
+        nkb = -(-kp // kb)
+        return {"mode": "stream", "mp": mp, "kp": nkb * kb, "np": np_,
+                "nchan": nchan, "bidirectional": nchan == 2,
+                "kb": kb, "nkb": nkb, "vmem_bytes": est_stream(kb)}
+    if not _NBLOCK_DEFAULT:
         return None
+
+    # accumulator-floor arm (the k-block idiom rotated onto the f32
+    # accumulator): even the 128-lane k-block missed because the
+    # double-buffered (mp, np) accumulators dominate, so split the
+    # TRAVELLER'S ROWS into sublane-aligned mb-blocks — each block runs
+    # the streaming kernel over its own disjoint output rows, and the
+    # blocks' wire payloads sum to the unsplit shard (wire-neutral).
+    def est_block(mb, kb):
+        return (4 * mb * kb * isz      # 2 send + 2 recv slots
+                + 2 * mb * np_ * 4     # double-buffered f32 accumulators
+                + kb * np_ * wisz)     # staged w k-block
+
+    mb = _shrink_block(mp, sub * nchan,
+                       lambda b: est_block(b, _LANES) <= _VMEM_BUDGET)
+    if mb is None:
+        # a (kb_min, n) w-block alone over budget: the lane floor on the
+        # weight staging is irreducible by row blocking — honest decline
+        return None
+    kb = _shrink_kb(kp, lambda b: est_block(mb, b) <= _VMEM_BUDGET)
+    nmb = -(-mp // mb)
     nkb = -(-kp // kb)
-    return {"mode": "stream", "mp": mp, "kp": nkb * kb, "np": np_,
+    return {"mode": "stream", "mp": nmb * mb, "kp": nkb * kb, "np": np_,
             "nchan": nchan, "bidirectional": nchan == 2,
-            "kb": kb, "nkb": nkb, "vmem_bytes": est_stream(kb)}
+            "kb": kb, "nkb": nkb, "mb": mb, "nmb": nmb,
+            "vmem_bytes": est_block(mb, kb)}
 
 
 def mmrs_plan(m: int, k: int, n: int, P: int, dtype,
@@ -1243,9 +1316,12 @@ def mmrs_plan(m: int, k: int, n: int, P: int, dtype,
     per-hop partial's k-sweep streams (cp, kb) x-blocks and (kb, n)
     w-blocks from HBM while the travelling accumulator is on the wire
     (the accumulator, recv slots, partial buffer and output chunk stay
-    VMEM-resident — they are the wire payload). ``wire_dtype`` sizes
-    the travelling-accumulator wire terms (staged/transferred as the
-    wire dtype, folded in f32)."""
+    VMEM-resident — they are the wire payload). When even the 128-lane
+    k-block misses — the accumulator floor — the accumulator-blocking
+    arm (``cmatmul_nblock``) splits the travelling accumulator's
+    lane-aligned columns into ``nb``-blocks (keys ``nb``/``nnb``).
+    ``wire_dtype`` sizes the travelling-accumulator wire terms
+    (staged/transferred as the wire dtype, folded in f32)."""
     if m < 1 or k < 1 or n < 1 or P < 1 or m % P:
         return None
     isz = jnp.dtype(dtype).itemsize
@@ -1279,12 +1355,41 @@ def mmrs_plan(m: int, k: int, n: int, P: int, dtype,
                 + kb * np_ * wisz)          # streamed w block
 
     kb = _shrink_kb(kp, lambda b: est_stream(b) <= _VMEM_BUDGET)
-    if kb is None:
+    if kb is not None:
+        nkb = -(-kp // kb)
+        return {"mode": "stream", "cp": cp, "kp": nkb * kb, "np": np_,
+                "nchan": nchan, "bidirectional": nchan == 2,
+                "kb": kb, "nkb": nkb, "vmem_bytes": est_stream(kb)}
+    if not _NBLOCK_DEFAULT:
         return None
+
+    # accumulator-floor arm: here the travelling accumulator IS the
+    # (cp, np) payload, so split its lane-aligned COLUMNS — each
+    # nb-block's accumulator rides its own ring over the same streamed
+    # x grid and a w column slice, folding into disjoint output
+    # columns; the blocks' wire payloads sum to the unsplit
+    # accumulator (wire-neutral).
+    def est_block(nb, kb):
+        wx = cp * nb * acc_wisz if wire_dtype is not None else 0
+        return (3 * cp * nb * 4            # out chunk + acc + pacc
+                + 2 * cp * nb * acc_wisz   # recv slots
+                + wx                       # wire staging buffer
+                + (cp // nchan) * kb * isz  # streamed x block
+                + kb * nb * wisz)          # streamed w block
+
+    nb = _shrink_block(np_, _LANES,
+                       lambda b: est_block(b, _LANES) <= _VMEM_BUDGET)
+    if nb is None:
+        # the (cp, nb_min) lane-floor column still misses: cp is pinned
+        # by the scatter geometry (m/P), not shrinkable here
+        return None
+    kb = _shrink_kb(kp, lambda b: est_block(nb, b) <= _VMEM_BUDGET)
     nkb = -(-kp // kb)
-    return {"mode": "stream", "cp": cp, "kp": nkb * kb, "np": np_,
+    nnb = -(-np_ // nb)
+    return {"mode": "stream", "cp": cp, "kp": nkb * kb, "np": nnb * nb,
             "nchan": nchan, "bidirectional": nchan == 2,
-            "kb": kb, "nkb": nkb, "vmem_bytes": est_stream(kb)}
+            "kb": kb, "nkb": nkb, "nb": nb, "nnb": nnb,
+            "vmem_bytes": est_block(nb, kb)}
 
 
 def wgrad_plan(ms: int, ct: int, cl: int, P: int, trav_dtype, loc_dtype,
@@ -1293,8 +1398,11 @@ def wgrad_plan(ms: int, ct: int, cl: int, P: int, trav_dtype, loc_dtype,
     contribution(shard_p, loc_block_p)``): the travelling shard
     (ms, ct), its double-buffered recv slots, one per-channel local
     block (ms/nchan, cl) and the f32 (ct, cl) accumulator output must
-    be VMEM-resident together. None -> the VJP keeps the unfused
-    gathered dw (same math, no overlap)."""
+    be VMEM-resident together. When that misses, the streaming arm
+    (``cmatmul_nblock``) splits the traveller's lane-aligned columns
+    into ``ctb``-blocks (keys ``ctb``/``nctb``), each riding its own
+    ring pass into a disjoint dw block. None -> the VJP keeps the
+    unfused gathered dw (same math, no overlap)."""
     if ms < 1 or ct < 1 or cl < 1 or P < 1:
         return None
     tisz = jnp.dtype(trav_dtype).itemsize
@@ -1310,10 +1418,33 @@ def wgrad_plan(ms: int, ct: int, cl: int, P: int, trav_dtype, loc_dtype,
            + 2 * msp * ctp * tisz    # recv slots (nchan halves sum)
            + msp * clp * lisz        # per-channel local blocks
            + ctp * clp * 4)          # f32 dw accumulator
-    if est > _VMEM_BUDGET:
+    if est <= _VMEM_BUDGET:
+        return {"msp": msp, "ctp": ctp, "clp": clp, "nchan": nchan,
+                "bidirectional": nchan == 2, "vmem_bytes": est}
+    if not _NBLOCK_DEFAULT:
         return None
-    return {"msp": msp, "ctp": ctp, "clp": clp, "nchan": nchan,
-            "bidirectional": nchan == 2, "vmem_bytes": est}
+
+    # streaming arm (the k-block idiom rotated onto the dw panel): the
+    # whole travelling shard over budget, so split the traveller's
+    # lane-aligned COLUMNS — each ctb-block rides its own ring pass and
+    # folds into a disjoint (ctb, cl) dw row block (column block when
+    # the traveller is the RHS); the per-block wires sum to the
+    # unsplit gather (wire-neutral). The local blocks and the lane
+    # floor on ctb are the irreducible terms — shapes where they alone
+    # exceed the budget stay honest declines.
+    def est_block(ctb):
+        return (3 * msp * ctb * tisz   # trav block + recv slots
+                + msp * clp * lisz     # per-channel local blocks
+                + ctb * clp * 4)       # f32 dw block accumulator
+
+    ctb = _shrink_block(ctp, _LANES,
+                        lambda b: est_block(b) <= _VMEM_BUDGET)
+    if ctb is None:
+        return None
+    nctb = -(-ctp // ctb)
+    return {"msp": msp, "ctp": nctb * ctb, "clp": clp, "nchan": nchan,
+            "bidirectional": nchan == 2, "ctb": ctb, "nctb": nctb,
+            "vmem_bytes": est_block(ctb)}
 
 
 # ---------------------------------------------------------------------------
@@ -1539,14 +1670,23 @@ def all_gather_matmul_body(x, w, *, axis: str = AXIS,
                          bidirectional=plan["bidirectional"])
     else:
         kb, nkb = plan["kb"], plan["nkb"]
-        # segment-major split of the contraction dim: every staged DMA
-        # in the streaming kernel becomes a leading-index copy
-        xseg = xp.reshape(mp, nkb, kb).transpose(1, 0, 2)
+        mb, nmb = plan.get("mb", mp), plan.get("nmb", 1)
         wseg = wp.reshape(nkb, kb, np_)
-        out = _agmm_stream_call(xseg, wseg, P=P, axis=axis,
-                                mesh_axes=mesh_axes,
-                                bidirectional=plan["bidirectional"],
-                                nkb=nkb, mp=mp, np_=np_)
+        blocks = []
+        for i in range(nmb):
+            # accumulator-floor arm: each sublane-aligned row block of
+            # the traveller rides its own ring pass into a disjoint
+            # output row slice (one iteration == the unblocked kernel)
+            xb = xp if nmb == 1 else \
+                lax.dynamic_slice_in_dim(xp, i * mb, mb, axis=0)
+            # segment-major split of the contraction dim: every staged
+            # DMA in the streaming kernel becomes a leading-index copy
+            xseg = xb.reshape(mb, nkb, kb).transpose(1, 0, 2)
+            blocks.append(_agmm_stream_call(
+                xseg, wseg, P=P, axis=axis, mesh_axes=mesh_axes,
+                bidirectional=plan["bidirectional"],
+                nkb=nkb, mp=mb, np_=np_))
+        out = blocks[0] if nmb == 1 else jnp.concatenate(blocks, axis=1)
     return out[:, :m, :n].reshape(P * m, n)
 
 
@@ -1599,13 +1739,24 @@ def matmul_reduce_scatter_body(x, w, *, axis: str = AXIS,
                          bidirectional=plan["bidirectional"], wire=wdt)
     else:
         kb, nkb = plan["kb"], plan["nkb"]
+        nb, nnb = plan.get("nb", np_), plan.get("nnb", 1)
         xseg = grid.reshape(P, cp, nkb, kb).transpose(0, 2, 1, 3)
-        wseg = wp.reshape(nkb, kb, np_)
-        out = _mmrs_stream_call(xseg, wseg, P=P, axis=axis,
-                                mesh_axes=mesh_axes,
-                                out_dtype=jnp.float32,
-                                bidirectional=plan["bidirectional"],
-                                nkb=nkb, cp=cp, np_=np_, wire=wdt)
+        blocks = []
+        for j in range(nnb):
+            # accumulator-floor arm: each lane-aligned column block of
+            # the travelling accumulator rides its own ring over the
+            # same x grid and a w column slice (one iteration == the
+            # unblocked kernel); the single realignment hop below acts
+            # on the concatenated chunk
+            wb = wp if nnb == 1 else \
+                lax.dynamic_slice_in_dim(wp, j * nb, nb, axis=1)
+            wseg = wb.reshape(nkb, kb, nb)
+            blocks.append(_mmrs_stream_call(
+                xseg, wseg, P=P, axis=axis, mesh_axes=mesh_axes,
+                out_dtype=jnp.float32,
+                bidirectional=plan["bidirectional"],
+                nkb=nkb, cp=cp, np_=nb, wire=wdt))
+        out = blocks[0] if nnb == 1 else jnp.concatenate(blocks, axis=1)
     fwd = [(i, (i + 1) % P) for i in range(P)]
     if plan["bidirectional"]:
         # channel 0 (top half rows) ended at chunk (pos+1), channel 1
@@ -1684,9 +1835,23 @@ def gathered_wgrad_body(trav, loc, *, axis: str = AXIS,
     tp_ = lax.dynamic_update_slice(tp_, tw, (0, 0))
     lp = jnp.zeros((P, msp, clp), loc.dtype)
     lp = lax.dynamic_update_slice(lp, loc.reshape(P, ms, cl), (0, 0, 0))
-    out = _wgrad_call(tp_, lp, P=P, axis=axis, mesh_axes=mesh_axes,
-                      bidirectional=plan["bidirectional"],
-                      travel_lhs=travel_lhs)
+    ctb, nctb = plan.get("ctb", ctp), plan.get("nctb", 1)
+    if nctb == 1:
+        out = _wgrad_call(tp_, lp, P=P, axis=axis, mesh_axes=mesh_axes,
+                          bidirectional=plan["bidirectional"],
+                          travel_lhs=travel_lhs)
+    else:
+        # streaming arm: each lane-aligned column block of the
+        # traveller rides its own ring pass into a disjoint dw row
+        # (resp. column) block
+        blocks = []
+        for j in range(nctb):
+            tb = lax.dynamic_slice_in_dim(tp_, j * ctb, ctb, axis=1)
+            blocks.append(_wgrad_call(
+                tb, lp, P=P, axis=axis, mesh_axes=mesh_axes,
+                bidirectional=plan["bidirectional"],
+                travel_lhs=travel_lhs))
+        out = jnp.concatenate(blocks, axis=0 if travel_lhs else 1)
     return out[:ct, :cl] if travel_lhs else out[:cl, :ct]
 
 

@@ -73,6 +73,9 @@ _TRACE_DIR = None
 KNOWN_LANES = (
     "sweep", "obs_overhead", "fault_overhead", "recover_time",
     "cmatmul_ag", "cmatmul_rs", "cmatmul_dw", "cmatmul_stream",
+    # round 20: the accumulator-floor n-block arm and the fused
+    # a2a-wgrad dw kernel, each with its own overlap A/B
+    "cmatmul_nblock", "moe_a2a_dw",
     "moe_a2a", "moe_a2a_bwd", "zero_fsdp", "pp_1f1b", "sched_synth",
     "sched_pipeline", "dcn_twotier",
     "hp_compression_cast_roundtrip", "combine_pallas_vs_jnp",
@@ -452,10 +455,18 @@ def main(argv=None) -> int:
             ("cmatmul_stream",
              lambda: _lanes.bench_cmatmul_stream(comm,
                                                  bidirectional=bidir)),
+            # round 20: the accumulator-floor n-block arm — the shape
+            # class that degraded to the unfused pair before it
+            ("cmatmul_nblock",
+             lambda: _lanes.bench_cmatmul_nblock(comm,
+                                                 bidirectional=bidir)),
             ("moe_a2a",
              lambda: _lanes.bench_moe_a2a(comm, bidirectional=bidir)),
             ("moe_a2a_bwd",
              lambda: _lanes.bench_moe_a2a_bwd(comm, bidirectional=bidir)),
+            # round 20: the fused a2a-wgrad dw kernel of both a2a VJPs
+            ("moe_a2a_dw",
+             lambda: _lanes.bench_moe_a2a_dw(comm, bidirectional=bidir)),
             # round 11: the flagship end-to-end lane — layerwise fused
             # ZeRO/FSDP train step vs the flat-ravel baseline schedule
             ("zero_fsdp",

@@ -83,6 +83,50 @@ def test_autotune_session_covers_every_knob(accl):
         algorithms.select(operation.reduce, nbytes, comm, tuned, count=64)
 
 
+def test_autotune_round20_registers_on_ici(accl, monkeypatch):
+    """The round-20 go/no-go stages write their registers from the
+    measured A/B on ICI — ``cmatmul_nblock`` from the n-block arm vs
+    the unfused pair, ``moe_dw_overlap`` from the fused a2a-wgrad vs
+    its pair — and pass the config through untouched when the geometry
+    never reaches the arm (engage-gated, like autotune_zero_fsdp)."""
+    from accl_tpu.config import TransportBackend
+
+    calls = {"n": 0}
+    fused_wins = {"v": True}
+
+    def fake_time(prog, *args, reps):
+        # each stage times fused first, baseline second
+        calls["n"] += 1
+        first = calls["n"] % 2 == 1
+        return 1.0 if first == fused_wins["v"] else 2.0
+
+    monkeypatch.setattr(autotune, "_time_prog", fake_time)
+    orig = accl.config
+    try:
+        accl.config = accl.config.replace(transport=TransportBackend.ICI)
+        tuned = autotune.autotune_cmatmul_nblock(accl, accl.config, reps=1)
+        assert tuned.cmatmul_nblock is True
+        tuned = autotune.autotune_moe_a2a_dw(accl, accl.config, reps=1)
+        assert tuned.moe_dw_overlap is True
+
+        fused_wins["v"] = False
+        tuned = autotune.autotune_cmatmul_nblock(accl, accl.config, reps=1)
+        assert tuned.cmatmul_nblock is False
+        tuned = autotune.autotune_moe_a2a_dw(accl, accl.config, reps=1)
+        assert tuned.moe_dw_overlap is False
+
+        # a geometry that stays resident never reaches the n-block arm:
+        # the stage must pass the config through untouched rather than
+        # writing a register from the wrong measurement
+        base = accl.config.replace(cmatmul_nblock=True)
+        calls_before = calls["n"]
+        tuned = autotune.autotune_cmatmul_nblock(accl, base, m=16, k=32,
+                                                 n=32, reps=1)
+        assert tuned is base and calls["n"] == calls_before
+    finally:
+        accl.config = orig
+
+
 def test_tuned_config_changes_selection(accl, monkeypatch):
     """Deterministic: synthetic timings where RING wins from 2^9 elements
     on flip the allgather selection relative to the defaults."""
@@ -367,10 +411,19 @@ def test_autotune_collective_matmul_crossover_on_ici(accl, monkeypatch):
     orig = accl.config
     try:
         accl.config = accl.config.replace(transport=TransportBackend.ICI)
-        # pows include 2^13 = 8192 rows: agmm plan needs ~P*m*n*4 VMEM
-        # for the output panel alone -> far over budget, must be dropped
+        # pows include 2^13 = 8192 rows: the mmrs accumulator misses
+        # every plan arm there and must be dropped; the agmm side now
+        # resolves through the round-20 n-block arm (mb/nmb) so 8192
+        # stays IN its sweep — but only while the register allows the
+        # arm: with cmatmul_nblock off the old drop must come back
         tuned = autotune.autotune_collective_matmul(accl, pows=(7, 13),
                                                     reps=1)
+        assert seen["agmm"] == [128, 8192] and seen["mmrs"] == [128]
+        cm.set_nblock_enabled(False)
+        try:
+            autotune.autotune_collective_matmul(accl, pows=(7, 13), reps=1)
+        finally:
+            cm.set_nblock_enabled(True)
         assert seen["agmm"] == [128] and seen["mmrs"] == [128]
         assert tuned.ag_matmul_threshold == 128 * 512 * 4
         assert tuned.rs_matmul_threshold == 128 * 512 * 4

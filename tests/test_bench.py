@@ -359,6 +359,93 @@ def test_cmatmul_dw_and_stream_lanes_schema(accl):
         assert r["value"] == 0.0 and r["wire_speedup"] is None
 
 
+def test_cmatmul_nblock_lane_schema(accl, monkeypatch):
+    """The round-20 accumulator-floor lane follows the resolution
+    protocol: under a pinched budget the shape n-blocks and the flag
+    mirrors rung + register + plan arm; with the register off (or no
+    candidate n-blocking, as at the default budget with tiny shapes)
+    the lane stays on the record unresolved — never measuring the
+    wrong arm under a streaming headline."""
+    from accl_tpu.bench import lanes
+    from accl_tpu.ops import collective_matmul as cm
+
+    monkeypatch.setattr(cm, "_VMEM_BUDGET", 128 << 10)
+    rows = lanes.bench_cmatmul_nblock(
+        accl.global_comm(), shapes=((256, 256, 128),), rounds=2)
+    assert [r["metric"] for r in rows] == ["cmatmul_nblock"]
+    r = rows[0]
+    assert r["unit"] == "ratio"
+    assert r["plan_mode"] == "stream"
+    assert r["m_block"] is not None and r["n_m_blocks"] > 1
+    assert r["nblock_enabled"]
+    assert r["fused_engaged"] == cm._kernels_available()
+    assert r["resolved"] == r["fused_engaged"]
+    assert r["raw_overlap_eff_med"] > 0
+    assert r["fused_us"] > 0 and r["matmul_us"] > 0
+    if not r["resolved"]:
+        assert r["value"] == 0.0
+
+    # register off: the plan loses its n-block arm and the lane
+    # reports itself unresolved (honest, not a zero-time win)
+    saved = cm.get_nblock_enabled()
+    cm.set_nblock_enabled(False)
+    try:
+        rows = lanes.bench_cmatmul_nblock(
+            accl.global_comm(), shapes=((256, 256, 128),), rounds=2)
+    finally:
+        cm.set_nblock_enabled(saved)
+    r = rows[0]
+    assert not r["nblock_enabled"]
+    assert not r["fused_engaged"] and not r["resolved"]
+    assert r["value"] == 0.0 and r["m_block"] is None
+
+
+def test_moe_a2a_dw_lane_schema(accl):
+    """The round-20 fused a2a-wgrad lane follows the resolution
+    protocol on every rung: the honesty flag needs rung + plan + the
+    ``moe_dw_overlap`` register (off is a requested baseline — the
+    lane then measures the unfused pair and zeroes its headline)."""
+    from accl_tpu.bench import lanes
+    from accl_tpu.ops import collective_alltoall as ca
+    from accl_tpu.ops import collective_matmul as cm
+
+    rows = lanes.bench_moe_a2a_dw(accl.global_comm(), e_local=2, C=8,
+                                  ct=32, cl=48, rounds=2)
+    assert [r["metric"] for r in rows] == ["moe_a2a_dw"]
+    r = rows[0]
+    assert r["unit"] == "ratio"
+    assert r["overlap_plan"] is not None     # tiny shapes fit VMEM
+    assert r["plan_mode"] == "resident"
+    assert r["dw_overlap_enabled"]
+    assert r["fused_engaged"] == cm._kernels_available()
+    assert r["resolved"] == r["fused_engaged"]
+    assert r["raw_overlap_eff_med"] > 0
+    assert r["fused_us"] > 0 and r["matmul_us"] > 0
+    if not r["resolved"]:
+        assert r["value"] == 0.0
+
+    ca.set_dw_overlap_enabled(False)
+    try:
+        rows = lanes.bench_moe_a2a_dw(accl.global_comm(), e_local=2,
+                                      C=8, ct=32, cl=48, rounds=2)
+    finally:
+        ca.set_dw_overlap_enabled(True)
+    r = rows[0]
+    assert not r["dw_overlap_enabled"]
+    assert not r["fused_engaged"] and not r["resolved"]
+    assert r["value"] == 0.0
+
+
+def test_round20_lanes_in_known_lanes():
+    """The round-20 lanes are selectable via --lanes (rows carry no
+    ``direction`` tag, so compare treats them as overlap ratios —
+    higher is better)."""
+    import bench as bench_script
+
+    assert "cmatmul_nblock" in bench_script.KNOWN_LANES
+    assert "moe_a2a_dw" in bench_script.KNOWN_LANES
+
+
 def test_zero_fsdp_lane_schema(accl):
     """The flagship end-to-end lane follows the resolution protocol on
     every rung: the honesty flag mirrors the layerwise engage
@@ -383,7 +470,12 @@ def test_zero_fsdp_lane_schema(accl):
     assert r["raw_overlap_eff_med"] > 0
     assert r["fused_us"] > 0 and r["flat_us"] > 0
     assert r["plan_mode"] in ("resident", "stream", None)
-    assert r["kernels_per_layer"] == 6
+    # round 20: the attn_fused honesty flag mirrors the attention
+    # engage resolution, and the kernel count tiers with it (a tier-2
+    # run must never report the fully-fused 12)
+    assert r["attn_fused"] == zero.fsdp_attn_engages(
+        16, 8, r["dp"], r["tp"], overlap=True)
+    assert r["kernels_per_layer"] == (12 if r["attn_fused"] else 6)
     if not r["resolved"]:
         assert r["value"] == 0.0
 

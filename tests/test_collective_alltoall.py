@@ -540,9 +540,11 @@ def test_a2a_wire_traces_cast_and_kernel(accl, monkeypatch):
 
 
 def test_a2a_vjp_traces_fused_dual(accl, monkeypatch):
-    """Both custom VJPs trace TWO fused kernels — the forward and the
+    """Both custom VJPs trace THREE fused kernels — the forward, the
     dual dx kernel (dispatch's dx is the combine kernel and vice
-    versa); dw rides one unfused a2a."""
+    versa), and the fused a2a-wgrad dw kernel (the gradient exchange
+    folded into the per-expert contraction sweep). No unfused
+    ``all_to_all`` survives in the backward."""
     from accl_tpu.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
@@ -562,9 +564,11 @@ def test_a2a_vjp_traces_fused_dual(accl, monkeypatch):
             jnp.zeros(xshape, jnp.float32), jnp.zeros(wshape, jnp.float32)))
 
     t = grad_trace(ca.alltoall_matmul, (4 * 4 * el, C, d), (el, d, h))
-    assert t.count("pallas_call") == 2
+    assert t.count("pallas_call") == 3
+    assert "all_to_all" not in t
     t = grad_trace(ca.matmul_alltoall, (4 * el, 4 * C, h), (el, h, d))
-    assert t.count("pallas_call") == 2
+    assert t.count("pallas_call") == 3
+    assert "all_to_all" not in t
 
 
 # ---------------------------------------------------------------------------
@@ -718,3 +722,83 @@ def test_moe_loss_trajectory_overlap_ab(accl, rng, W):
     np.testing.assert_allclose(traj[True], traj[False],
                                rtol=1e-5, atol=1e-7)
     assert traj[True][-1] < traj[True][0]   # it actually trains
+
+
+# ---------------------------------------------------------------------------
+# round 20: the fused a2a-wgrad (dw) leg — parity on every rung, plan pins
+# ---------------------------------------------------------------------------
+
+def test_a2a_wgrad_body_both_orientations(accl, rng):
+    """a2a_gathered_wgrad_body vs host math on every rung: dispatch's
+    dw contracts the exchanged tokens against the local dy (travel_lhs)
+    and combine's dw contracts the local h against the exchanged dy —
+    the kernel-less rung runs the unfused ``all_to_all`` + einsum
+    fallback, same math by construction, so this pins BOTH datapaths to
+    the same integers."""
+    from jax.sharding import PartitionSpec as P
+
+    from accl_tpu.parallel.primitives import AXIS, _smap
+
+    comm = _comm(4)
+    W, el, C, ct, cl = 4, 2, 8, 32, 16
+    trav = _ints(rng, (W, W * el, C, ct), lo=-3, hi=4)
+    loc = _ints(rng, (W, el, W * C, cl), lo=-3, hi=4)
+
+    def run(travel_lhs):
+        def body(ts, ls):
+            return ca.a2a_gathered_wgrad_body(
+                ts[0], ls[0], axis=AXIS, travel_lhs=travel_lhs)[None]
+
+        return np.asarray(_smap(comm, body, 2,
+                                in_specs=(P(AXIS), P(AXIS)))(
+            _put(comm, trav), _put(comm, loc)))
+
+    for lhs in (True, False):
+        got = run(lhs)
+        for r in range(W):
+            for e in range(el):
+                recv = np.concatenate(
+                    [trav[p, r * el + e] for p in range(W)],
+                    axis=0).astype(np.float64)          # (W*C, ct)
+                lo_ = loc[r, e].astype(np.float64)      # (W*C, cl)
+                want = recv.T @ lo_ if lhs else lo_.T @ recv
+                np.testing.assert_array_equal(
+                    got[r, e], want.astype(np.float32))
+
+
+def test_a2a_wgrad_plan_pins():
+    """The fused a2a-wgrad geometry contract: capacity rows padded by
+    the stricter sublane, lane-padded panels, the f32 (ct, cl) dw
+    accumulators resident — None beyond the budget (the VJP keeps the
+    unfused dw pair there, counted under ``moe_a2a_dw``)."""
+    p = ca.a2a_wgrad_plan(2, 8, 32, 64, 4, jnp.float32, True)
+    assert p is not None and p["mode"] == "resident"
+    assert (p["cp"], p["ctp"], p["clp"], p["nchan"]) == (8, 128, 128, 2)
+    assert p["vmem_bytes"] <= cm._VMEM_BUDGET
+    # unidirectional / small world: one channel
+    p = ca.a2a_wgrad_plan(2, 8, 32, 64, 2, jnp.float32, True)
+    assert p is not None and p["nchan"] == 1
+    # a dw panel set beyond the budget declines honestly
+    assert ca.a2a_wgrad_plan(64, 512, 4096, 4096, 8, jnp.float32,
+                             True) is None
+    # engage vocabulary: "off" when the session dw register is down
+    saved = ca.get_dw_overlap_enabled()
+    try:
+        ca.set_dw_overlap_enabled(False)
+        assert ca.a2a_wgrad_engage_reason(
+            2, 8, 32, 64, 4, jnp.float32, overlap=True) == "off"
+    finally:
+        ca.set_dw_overlap_enabled(saved)
+
+
+def test_a2a_dw_config_write_through(accl):
+    """ACCLConfig.moe_dw_overlap lands in the a2a module at every
+    config assignment (the cmatmul_overlap write-through shape)."""
+    saved = accl.config
+    try:
+        accl.config = accl.config.replace(moe_dw_overlap=False)
+        assert ca.get_dw_overlap_enabled() is False
+        accl.config = accl.config.replace(moe_dw_overlap=True)
+        assert ca.get_dw_overlap_enabled() is True
+    finally:
+        accl.config = saved

@@ -191,9 +191,13 @@ def test_plan_geometry_pins():
 
 
 def test_plan_vmem_budget_fallback():
-    """Geometry that misses the scoped-VMEM budget returns None — the
-    unfused-XLA fallback trigger (the flash bwd policy's shape)."""
-    assert cm.agmm_plan(4096, 4096, 4096, 8, jnp.float32, False) is None
+    """Geometry that misses the scoped-VMEM budget — in EVERY arm,
+    resident and n-blocked streaming — returns None, the unfused-XLA
+    fallback trigger. The irreducible term is the lane-aligned weight
+    panel: at (8, 128, 32768) one (kp, nb) f32 column block alone
+    exceeds the budget, so no amount of accumulator blocking saves
+    it."""
+    assert cm.agmm_plan(8, 128, 32768, 8, jnp.float32, False) is None
     assert cm.mmrs_plan(8 * 4096, 4096, 4096, 8, jnp.float32, False) is None
     # m not divisible by world is never a kernel plan
     assert cm.mmrs_plan(13, 64, 64, 4, jnp.float32, False) is None
@@ -223,8 +227,10 @@ def test_overlap_off_never_traces_kernels(accl, monkeypatch):
             jnp.zeros((k, n), jnp.float32)))
 
     assert "pallas_call" not in trace(16, 64, 64, overlap=False)
-    # oversized: overlap requested but the plan misses the budget
-    assert "pallas_call" not in trace(4096, 4096, 4096, overlap=True)
+    # oversized: overlap requested but the plan misses the budget in
+    # every arm (the irreducible weight-panel shape — 4096³ now rides
+    # the n-blocked streaming plan instead of declining)
+    assert "pallas_call" not in trace(8, 128, 32768, overlap=True)
 
 
 def test_session_config_write_through(accl):
@@ -477,9 +483,16 @@ def test_plan_streaming_engages():
     # bidirectional streaming keeps the channel split
     p = cm.agmm_plan(256, 8192, 512, 8, jnp.float32, True)
     assert p["mode"] == "stream" and p["nchan"] == 2
-    # the m x n accumulator floor is irreducible by k-blocking: those
-    # shapes still return None (the only remaining vmem_miss class)
-    assert cm.agmm_plan(4096, 4096, 4096, 8, jnp.float32, False) is None
+    # the m x n accumulator floor is no longer irreducible: the
+    # n-blocked streaming arm (round 20) splits the accumulator's lane
+    # columns and 4096³ resolves to a stream plan with both blockings
+    p = cm.agmm_plan(4096, 4096, 4096, 8, jnp.float32, False)
+    assert p is not None and p["mode"] == "stream"
+    assert (p["mb"], p["nmb"], p["kb"], p["nkb"]) == (256, 16, 128, 32)
+    assert p["vmem_bytes"] <= cm._VMEM_BUDGET
+    # the lane-aligned weight panel IS irreducible: one (kp, nb) f32
+    # column block alone busts the budget — still an honest decline
+    assert cm.agmm_plan(8, 128, 32768, 8, jnp.float32, False) is None
 
 
 def test_plan_wire_sizing():
@@ -758,8 +771,9 @@ def test_fallback_counter_reasons(accl, monkeypatch):
         assert d.get(key % "threshold") == 1
     finally:
         cm.set_overlap_thresholds(*saved_th)
-    # overlap requested but no geometry fits even a k-block -> vmem_miss
-    d = delta(lambda: trace(True, True, shape=(4096, 4096, 4096)))
+    # overlap requested but no geometry fits ANY arm — k-blocked or
+    # n-blocked streaming (the irreducible weight panel) -> vmem_miss
+    d = delta(lambda: trace(True, True, shape=(8, 128, 32768)))
     assert d.get(key % "vmem_miss") == 1
     # an explicit overlap=False is a REQUEST, not a fallback — per call
     d = delta(lambda: trace(False, True))
@@ -1203,3 +1217,143 @@ def test_wire_sr_threads_through_kernels(accl, monkeypatch):
             jnp.zeros((4 * 16, 64), jnp.float32),
             jnp.zeros((4 * 16, 32), jnp.float32)))
         assert t.count("pallas_call") == 1 + casts
+
+
+# ---------------------------------------------------------------------------
+# round 20: n-blocked streaming plans — the accumulator-floor arm
+# (parity needs simulated remote DMA; the trace/plan tests run anywhere)
+# ---------------------------------------------------------------------------
+
+@requires_interpret_rdma
+@pytest.mark.parametrize("W", [2, 4, 8])
+@pytest.mark.parametrize("bidir", [False, True])
+def test_agmm_nblock_parity_bit_exact(accl, rng, monkeypatch, W, bidir):
+    """m-blocked streaming agmm (the accumulator-floor arm) is
+    bit-exact vs the unfused pair: the budget is pinched so even the
+    128-lane k-block misses and the plan splits the traveller's rows
+    (nmb blocks, each its own ring pass over nkb k-segments)."""
+    if bidir and W < 4:
+        pytest.skip("bidirectional needs P >= 4")
+    m, k, n = 256, 256, 128
+    _budget(monkeypatch, 128 << 10)
+    plan = cm.agmm_plan(m, k, n, W, jnp.float32, bidir)
+    assert plan is not None and plan["mode"] == "stream"
+    assert plan["nmb"] >= 2 and plan["nkb"] >= 2
+    x = _ints(rng, (W, m, k), lo=-2, hi=3)
+    w = _ints(rng, (W, k, n), lo=-2, hi=3)
+    comm = _comm(W)
+    fused = _run_agmm(comm, x, w, Algorithm.PALLAS, bidir)
+    ref = _run_agmm(comm, x, w, Algorithm.XLA, bidir)
+    np.testing.assert_array_equal(fused, ref)
+
+
+@requires_interpret_rdma
+@pytest.mark.parametrize("W", [2, 4, 8])
+@pytest.mark.parametrize("bidir", [False, True])
+def test_mmrs_nblock_parity_bit_exact(accl, rng, monkeypatch, W, bidir):
+    """n-blocked streaming mmrs: the travelling accumulator's columns
+    split into nnb blocks, each riding its own ring over the streamed
+    x grid and a w column slice — bit-exact vs the unfused pair."""
+    if bidir and W < 4:
+        pytest.skip("bidirectional needs P >= 4")
+    m, k, n = 16, 256, 512
+    _budget(monkeypatch, 128 << 10)
+    plan = cm.mmrs_plan(W * m, k, n, W, jnp.float32, bidir)
+    assert plan is not None and plan["mode"] == "stream"
+    assert plan["nnb"] >= 2 and plan["nkb"] >= 2
+    x = _ints(rng, (W, W * m, k), lo=-2, hi=3)
+    w = _ints(rng, (W, k, n), lo=-2, hi=3)
+    comm = _comm(W)
+    fused = _run_mmrs(comm, x, w, Algorithm.PALLAS, bidir)
+    ref = _run_mmrs(comm, x, w, Algorithm.XLA, bidir)
+    np.testing.assert_array_equal(fused, ref)
+
+
+@requires_interpret_rdma
+@pytest.mark.parametrize("W", [2, 4, 8])
+def test_wgrad_nblock_parity_bit_exact(accl, rng, monkeypatch, W):
+    """ct-blocked streaming wgrad: each ctb column block of the
+    travelling shard rides its own ring pass into a disjoint dw block —
+    bit-exact vs host math in both orientations."""
+    from jax.sharding import PartitionSpec as P
+
+    from accl_tpu.parallel.primitives import AXIS, _smap
+
+    ms, ct, cl = 16, 1024, 128
+    _budget(monkeypatch, 128 << 10)
+    plan = cm.wgrad_plan(ms, ct, cl, W, jnp.float32, jnp.float32, True)
+    assert plan is not None and plan["nctb"] >= 2
+    comm = _comm(W)
+    trav = _ints(rng, (W, ms, ct), lo=-2, hi=3)
+    loc = _ints(rng, (W, W * ms, cl), lo=-2, hi=3)
+    for lhs in (True, False):
+        def body(ts, ls, lhs=lhs):
+            return cm.gathered_wgrad_body(
+                ts[0], ls[0], axis=AXIS, overlap=True,
+                travel_lhs=lhs)[None]
+
+        got = np.asarray(_smap(comm, body, 2,
+                               in_specs=(P(AXIS), P(AXIS)))(
+            _put(comm, trav), _put(comm, loc)))
+        gathered = trav.reshape(W * ms, ct).astype(np.float64)
+        for r in range(W):
+            want = (gathered.T @ loc[r].astype(np.float64) if lhs
+                    else loc[r].astype(np.float64).T @ gathered)
+            np.testing.assert_array_equal(got[r], want.astype(np.float32))
+
+
+def test_nblock_traces_one_kernel_per_block(accl, monkeypatch):
+    """The accumulator-floor arm runs the streaming kernel once per
+    block: the traced program carries exactly nmb pallas_calls (agmm)
+    / nnb (mmrs) — the block loop is unrolled at trace time, so the
+    count is the plan's, not a rounding accident."""
+    from accl_tpu.compat import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    monkeypatch.setattr(cm, "_kernels_available", lambda: True)
+    _budget(monkeypatch, 128 << 10)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("accl",))
+
+    m, k, n = 256, 256, 128
+    plan = cm.agmm_plan(m, k, n, 4, jnp.float32, False)
+    assert plan["mode"] == "stream" and plan["nmb"] >= 2
+    t = str(jax.make_jaxpr(shard_map(
+        lambda xs, ws: cm.all_gather_matmul_body(
+            xs, ws, axis="accl", overlap=True, bidirectional=False),
+        mesh=mesh, in_specs=(P("accl"), P(None)),
+        out_specs=P("accl"), check_vma=False))(
+        jnp.zeros((4 * m, k), jnp.float32),
+        jnp.zeros((k, n), jnp.float32)))
+    assert t.count("pallas_call") == plan["nmb"]
+
+    m, k, n = 16, 256, 512
+    plan = cm.mmrs_plan(4 * m, k, n, 4, jnp.float32, False)
+    assert plan["mode"] == "stream" and plan["nnb"] >= 2
+    t = str(jax.make_jaxpr(shard_map(
+        lambda xs, ws: cm.matmul_reduce_scatter_body(
+            xs, ws, axis="accl", overlap=True, bidirectional=False),
+        mesh=mesh, in_specs=(P("accl"), P(None)),
+        out_specs=P("accl"), check_vma=False))(
+        jnp.zeros((4 * 4 * m, k), jnp.float32),
+        jnp.zeros((k, n), jnp.float32)))
+    assert t.count("pallas_call") == plan["nnb"]
+
+
+def test_nblock_session_register(accl):
+    """ACCLConfig.cmatmul_nblock write-through: the accumulator-floor
+    arm is a session-selectable register — off pins the honest decline
+    (None) for shapes only that arm resolves, the resident and
+    k-blocked arms unaffected."""
+    shape = (4096, 4096, 4096, 8)
+    assert cm.agmm_plan(*shape, jnp.float32, False)["mode"] == "stream"
+    saved = accl.config
+    try:
+        accl.config = accl.config.replace(cmatmul_nblock=False)
+        assert not cm.get_nblock_enabled()
+        assert cm.agmm_plan(*shape, jnp.float32, False) is None
+        # k-blocked streaming (no accumulator floor) stays available
+        p = cm.agmm_plan(256, 8192, 512, 8, jnp.float32, False)
+        assert p is not None and p["mode"] == "stream"
+    finally:
+        accl.config = saved
+    assert cm.get_nblock_enabled()

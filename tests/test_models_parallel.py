@@ -155,6 +155,37 @@ def test_moe_top2_capacity_pressure_strict_priority(accl, rng):
                                        rtol=2e-5, atol=2e-5)
 
 
+def test_moe_top2_rides_fused_path(accl, rng, monkeypatch):
+    """top-k>1 is NOT a fused-path carve-out: the gate weighting lives
+    in the local disp/comb tensors before the exchange, so a top_k=2
+    build with the kernels engaged traces the SAME fused schedule as
+    top-1 — two exchange kernels forward, six through the backward
+    (fwd + dual dx + fused a2a-wgrad dw per direction) — and ZERO
+    unfused ``lax.all_to_all`` anywhere, capacity pressure included."""
+    from accl_tpu.ops import collective_matmul as cm
+
+    monkeypatch.setattr(cm, "_kernels_available", lambda: True)
+    comm = accl.global_comm()
+    n, d, h, E, C = 16, 32, 64, 8, 2        # C=2: pressure at top_k=2
+    gp = moe.init_params(jax.random.PRNGKey(7), comm, d, h, E)
+    params = moe.shard_params(gp, comm)
+    fwd = moe.build_moe_forward(comm, n_experts=E, capacity=C, top_k=2,
+                                overlap=True)
+    x = jax.device_put(
+        rng.standard_normal((WORLD, n, d)).astype(np.float32),
+        comm.sharding())
+    t = str(jax.make_jaxpr(fwd)(params, x))
+    assert t.count("pallas_call") == 2      # dispatch + combine
+    assert "all_to_all" not in t
+
+    def loss(p, xs):
+        return jax.numpy.sum(fwd(p, xs) ** 2)
+
+    t = str(jax.make_jaxpr(jax.grad(loss))(params, x))
+    assert t.count("pallas_call") == 6      # + dual dx + fused dw each
+    assert "all_to_all" not in t
+
+
 @pytest.mark.parametrize("n_micro", [1, 4, 8])
 def test_pipeline_matches_sequential(accl, rng, n_micro):
     comm = accl.global_comm()

@@ -473,3 +473,81 @@ def test_latency_histogram_us_bucket_geometry():
             if ln.startswith("accl_latency_dispatch_seconds_bucket")
             and 'path="test"' in ln and 'le="0.000128"' in ln]
     assert line and line[0].rstrip().endswith(" 2")
+
+
+# ---------------------------------------------------------------------------
+# round 20: fallback-counter completeness — every plan-decline site in a
+# fused family counts EXACTLY once per traced program
+# ---------------------------------------------------------------------------
+
+def test_fallback_counter_counts_every_decline_site_once(monkeypatch):
+    """A full backward through each fused custom-VJP family on a
+    kernel-less rung hits every decline site the family owns — the
+    forward, the dual dx kernel, and the fused dw kernel — and each
+    counts exactly ONCE under its own op label, nothing more and
+    nothing less. A missing label here means a decline went silent; a
+    doubled one means a site counts per-leg instead of per-program."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from accl_tpu.compat import shard_map
+    from accl_tpu.ops import collective_alltoall as ca
+    from accl_tpu.ops import collective_matmul as cm
+
+    monkeypatch.setattr(cm, "_kernels_available", lambda: False)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("accl",))
+    key = 'accl_cmatmul_fallback_total{op="%s",reason="no_interpret"}'
+
+    def fb_delta(fn):
+        before = metrics.snapshot()
+        fn()
+        d = metrics.delta(before)["counters"]
+        return {k: v for k, v in d.items()
+                if k.startswith("accl_cmatmul_fallback_total")}
+
+    def grad_trace(entry, xshape, wshape, overlap=True):
+        def body(xs, ws):
+            return jax.grad(
+                lambda args: jnp.sum(entry(args[0], args[1], "accl",
+                                           None, overlap)))((xs, ws))
+
+        f = shard_map(body, mesh=mesh, in_specs=(P("accl"), P(None)),
+                      out_specs=(P("accl"), P(None)), check_vma=False)
+        jax.make_jaxpr(f)(jnp.zeros(xshape, jnp.float32),
+                          jnp.zeros(wshape, jnp.float32))
+
+    # collective-matmul family: fwd + dual dx + fused dw, once each
+    d = fb_delta(lambda: grad_trace(cm.all_gather_matmul,
+                                    (4 * 8, 32), (32, 16)))
+    assert d == {key % "allgather_matmul": 1,
+                 key % "matmul_reduce_scatter": 1,
+                 key % "allgather_matmul_dw": 1}
+    d = fb_delta(lambda: grad_trace(cm.matmul_reduce_scatter,
+                                    (4 * 8, 32), (32, 16)))
+    assert d == {key % "matmul_reduce_scatter": 1,
+                 key % "allgather_matmul": 1,
+                 key % "matmul_reduce_scatter_dw": 1}
+    # MoE a2a family: both directions share the fused-dw site
+    el, C, dm, h = 2, 16, 32, 64
+    d = fb_delta(lambda: grad_trace(ca.alltoall_matmul,
+                                    (4 * 4 * el, C, dm), (el, dm, h)))
+    assert d == {key % "alltoall_matmul": 1,
+                 key % "matmul_alltoall": 1,
+                 key % "moe_a2a_dw": 1}
+    d = fb_delta(lambda: grad_trace(ca.matmul_alltoall,
+                                    (4 * el, 4 * C, h), (el, h, dm)))
+    assert d == {key % "matmul_alltoall": 1,
+                 key % "alltoall_matmul": 1,
+                 key % "moe_a2a_dw": 1}
+    # a requested baseline counts NOTHING at any site in the family:
+    # overlap=False covers fwd + dx, moe_dw_overlap=False covers dw
+    saved = ca.get_dw_overlap_enabled()
+    try:
+        ca.set_dw_overlap_enabled(False)
+        d = fb_delta(lambda: grad_trace(ca.alltoall_matmul,
+                                        (4 * 4 * el, C, dm),
+                                        (el, dm, h), overlap=False))
+        assert d == {}
+    finally:
+        ca.set_dw_overlap_enabled(saved)

@@ -142,15 +142,17 @@ def test_init_zero_fsdp_layout(accl):
     mesh = _mesh(dp, tp)
     L, d, h, H = 2, 16, 32, 4
     st = zero.init_zero_fsdp(jax.random.PRNGKey(0), mesh, L, d, h, H)
-    dtp, n_attn = zero._attn_sizes(d, tp)
-    n_attn_pad = n_attn + (-n_attn) % dp
-    assert len(st.p.attn) == L
-    assert st.p.attn[0].shape == (tp, n_attn_pad)
+    dtp, q_rows, q_rows_pad = zero._attn_travel_sizes(d, tp, dp)
+    assert len(st.p.wqkvt) == L
+    assert st.p.wqkvt[0].shape == (tp * q_rows_pad, d)
+    assert st.p.wot[0].shape == (d, d)
     assert st.p.w1t[0].shape == (h, d)
     assert st.p.w2t[0].shape == (d, h)
     # device blocks: the travel shards
-    assert st.p.attn[0].addressable_shards[0].data.shape == \
-        (1, n_attn_pad // dp)
+    assert st.p.wqkvt[0].addressable_shards[0].data.shape == \
+        (q_rows_pad // dp, d)
+    assert st.p.wot[0].addressable_shards[0].data.shape == \
+        (d // dp, d // tp)
     assert st.p.w1t[0].addressable_shards[0].data.shape == \
         (h // (tp * dp), d)
     assert st.p.w2t[0].addressable_shards[0].data.shape == \
@@ -230,13 +232,14 @@ def test_fsdp_commit_honesty(accl, monkeypatch):
 
 def test_fsdp_engage_covers_wgrad_plans(accl, monkeypatch):
     """The commit resolution consults ALL SIX per-layer kernel plans: a
-    geometry whose agmm/mmrs plans fit VMEM but whose fused-wgrad dw
-    panel misses (the (ct, cl) f32 accumulator alone over the budget;
-    wgrad is resident-only) must decline the WHOLE commit — the step
-    would otherwise run a "fused" schedule with its activation
-    gradients silently unfused, against the never-degraded policy."""
+    geometry whose agmm/mmrs plans fit VMEM (resident or n-blocked
+    streaming) but whose fused-wgrad dw panel misses even the
+    ctb-streaming arm (the per-channel local block is its irreducible
+    term) must decline the WHOLE commit — the step would otherwise run
+    a "fused" schedule with its activation gradients silently unfused,
+    against the never-degraded policy."""
     monkeypatch.setattr(cm, "_kernels_available", lambda: True)
-    d, h, b, dp = 2048, 1024, 2048, 4
+    d, h, b, dp = 2048, 1024, 8192, 4
     f32 = jnp.float32
     assert cm.agmm_engage_reason(h // dp, d, b, dp, f32, True) is None
     assert cm.agmm_engage_reason(d // dp, h, b, dp, f32, True) is None
@@ -246,9 +249,15 @@ def test_fsdp_engage_covers_wgrad_plans(accl, monkeypatch):
                                   True) == "vmem_miss"
     assert zero.fsdp_engage_reason(d, h, b, dp, 1,
                                    overlap=True) == "vmem_miss"
-    # the flagship AOT geometry clears all six resolutions
+    # the attention resolver runs the same six-plan discipline over the
+    # Wqkvᵀ/Woᵀ travel shards — the same wgrad panel miss declines it
+    assert zero.fsdp_attn_engage_reason(d, b, dp, 1,
+                                        overlap=True) == "vmem_miss"
+    # the flagship AOT geometry clears all twelve resolutions
     assert zero.fsdp_engage_reason(256, 1024, 128, 4, 2,
                                    overlap=True) is None
+    assert zero.fsdp_attn_engage_reason(256, 128, 4, 2,
+                                        overlap=True) is None
 
 
 def test_fsdp_config_write_through(accl):
@@ -337,22 +346,27 @@ def _fused_trace(monkeypatch, L=2, d=16, h=32, H=4, rows=16, **kw):
     return str(jax.make_jaxpr(lambda s, a, b: step(s, a, b))(st, x, x))
 
 
-def test_fsdp_traces_six_kernels_per_layer(accl, monkeypatch):
-    """The fused train step traces SIX collective-matmul kernels per
-    layer: 2 forward agmm parameter gathers, their 2 dual mmrs gradient
-    reductions, and 2 fused gathered-wgrad activation-gradient kernels
-    (the backward parameter re-gather folded into the contraction)."""
+def test_fsdp_traces_twelve_kernels_per_layer(accl, monkeypatch):
+    """The fully-fused train step traces TWELVE collective-matmul
+    kernels per layer — the attention projections ride the SAME agmm
+    family as the MLP: 4 forward agmm parameter gathers (Wqkvᵀ, Woᵀ,
+    W1ᵀ, W2ᵀ), their 4 dual mmrs gradient reductions, and 4 fused
+    gathered-wgrad activation-gradient kernels (the backward parameter
+    re-gather folded into the contraction). No unfused collective
+    survives in the traced program."""
     L = 2
     t = _fused_trace(monkeypatch, L=L)
-    assert t.count("pallas_call") == 6 * L
+    assert t.count("pallas_call") == 12 * L
+    assert "all_gather" not in t
+    assert "all_to_all" not in t
 
 
 def test_fsdp_traces_flash_kernels(accl, monkeypatch):
     """At a flash-tileable sequence (S % 128 == 0) the step composes
     flash and cmatmul in ONE program: + fwd and fused-bwd flash kernels
-    per layer on top of the 6 collective matmuls."""
+    per layer on top of the 12 collective matmuls."""
     t = _fused_trace(monkeypatch, L=1, rows=256)   # 128 rows per dp rank
-    assert t.count("pallas_call") == 6 + 2
+    assert t.count("pallas_call") == 12 + 2
 
 
 def test_fsdp_wire_traces_more_kernels(accl, monkeypatch):
@@ -365,24 +379,45 @@ def test_fsdp_wire_traces_more_kernels(accl, monkeypatch):
 
 
 def test_fsdp_prefetch_counters(accl, monkeypatch):
-    """Cross-layer prefetch accounting: a fused build counts L-1 hits
-    (layer l+1's bucket gather issued under layer l's compute) or L-1
-    declines when prefetch is off — at trace/build time, like the
-    fallback counters."""
+    """Cross-layer prefetch accounting rides the PREFETCHED-BUCKET
+    attention tier: when the attention plans decline (here a session
+    size threshold the smaller Wqkvᵀ payload misses while the MLP legs
+    clear it) the build counts one hit (layer l+1's bucket gather
+    issued under layer l's compute) or one decline when prefetch is
+    off — at trace/build time, like the fallback counters. The
+    fully-fused tier has no gathers left to prefetch and counts
+    nothing."""
     from accl_tpu.obs import metrics as obs_metrics
 
-    def delta(**kw):
+    monkeypatch.setattr(cm, "_kernels_available", lambda: True)
+    mesh = _mesh(4, 2)
+    d, h, H = 256, 512, 4
+
+    def delta(L=2, **kw):
+        st = zero.init_zero_fsdp(jax.random.PRNGKey(0), mesh, L, d, h,
+                                 H)
+        step = zero.build_zero_fsdp_train_step(mesh, L, d, h, H, **kw)
+        x = jnp.zeros((128, d), jnp.float32)
         before = obs_metrics.snapshot()
-        _fused_trace(monkeypatch, **kw)
+        jax.make_jaxpr(lambda s, a, b: step(s, a, b))(st, x, x)
         d_ = obs_metrics.delta(before)["counters"]
         return {k: v for k, v in d_.items()
                 if k.startswith("accl_zero_prefetch_total")}
 
     hit = 'accl_zero_prefetch_total{event="hit"}'
     dec = 'accl_zero_prefetch_total{event="decline"}'
-    assert delta(L=2) == {hit: 1}
-    assert delta(L=2, prefetch=False) == {dec: 1}
-    assert delta(L=1) == {}                 # nothing to prefetch
+    saved = cm.get_overlap_thresholds()
+    try:
+        # attention agmm payloads sit under 40 KB at this geometry, the
+        # MLP legs above it: the step commits to the tier-2 schedule
+        cm.set_overlap_thresholds(40000, 0)
+        assert delta(L=2) == {hit: 1}
+        assert delta(L=2, prefetch=False) == {dec: 1}
+        assert delta(L=1) == {}             # nothing to prefetch
+    finally:
+        cm.set_overlap_thresholds(*saved)
+    # fully-fused tier: attention rides agmm, nothing to prefetch
+    assert delta(L=2, overlap=True) == {}
 
 
 # ---------------------------------------------------------------------------

@@ -1,16 +1,16 @@
 """Multi-host AOT lowering proof for the layerwise ZeRO/FSDP step.
 
 Mirrors ``test_cmatmul_schedule.py``: the flagship train step — flash
-attention + per-layer agmm parameter gathers + their dual mmrs/wgrad
-backward kernels + the prefetched bucket gathers — AOT-compiles against
-a real ``v5e:2x4`` TPU topology on a (dp=4, tp=2) mesh. A successful
+attention + per-layer agmm parameter gathers (attention AND MLP, round
+20) + their dual mmrs/wgrad backward kernels — AOT-compiles against a
+real ``v5e:2x4`` TPU topology on a (dp=4, tp=2) mesh. A successful
 compile proves Mosaic accepted every fused kernel the layerwise
 schedule traces and XLA scheduled the composed program for a 2-host
-mesh; the kernel COUNT pins the acceptance bar (>= 6 collective-matmul
-kernels per transformer layer: 2 forward agmm gathers, 2 dual mmrs
-gradient reductions, 2 fused gathered-wgrad kernels — the ISSUE's
-">= 2 fused kernels per layer" with the full backward on top — plus
-the per-layer flash fwd/bwd pair)."""
+mesh; the kernel COUNT pins the acceptance bar (>= 12 collective-matmul
+kernels per transformer layer: 4 forward agmm gathers — Wqkvᵀ, Woᵀ,
+W1ᵀ, W2ᵀ — their 4 dual mmrs gradient reductions and 4 fused
+gathered-wgrad kernels; no unfused parameter collective survives —
+plus the per-layer flash fwd/bwd pair)."""
 import jax
 import jax.numpy as jnp
 import pytest
@@ -34,15 +34,15 @@ def fsdp_mesh():
 
 def _state_structs(mesh, n_layers):
     specs = zero.fsdp_param_specs(n_layers)
-    _, n_attn = zero._attn_sizes(D, TP)
-    n_attn_pad = n_attn + (-n_attn) % DP
+    _, _, q_rows_pad = zero._attn_travel_sizes(D, TP, DP)
 
     def leaf(shape, spec):
         return jax.ShapeDtypeStruct(shape, jnp.float32,
                                     sharding=NamedSharding(mesh, spec))
 
     p = zero.FSDPParams(
-        attn=tuple(leaf((TP, n_attn_pad), s) for s in specs.attn),
+        wqkvt=tuple(leaf((TP * q_rows_pad, D), s) for s in specs.wqkvt),
+        wot=tuple(leaf((D, D), s) for s in specs.wot),
         w1t=tuple(leaf((HID, D), s) for s in specs.w1t),
         w2t=tuple(leaf((D, HID), s) for s in specs.w2t),
     )
@@ -67,28 +67,33 @@ def _compile(mesh, n_layers, **kw):
 
 
 def test_fsdp_plans_resident():
-    """Geometry pin: both per-layer gather plans resolve VMEM-resident
-    at the flagship shapes (a padding/budget change is a visible diff,
-    not a silicon surprise)."""
+    """Geometry pin: all four per-layer gather plans — attention and
+    MLP travel shards — resolve VMEM-resident at the flagship shapes
+    (a padding/budget change is a visible diff, not a silicon
+    surprise)."""
     h_tp = HID // TP
-    p1 = cm.agmm_plan(h_tp // DP, D, B_RANK, DP, jnp.float32, True)
-    p2 = cm.agmm_plan(D // DP, h_tp, B_RANK, DP, jnp.float32, True)
-    assert p1 is not None and p1["mode"] == "resident"
-    assert p2 is not None and p2["mode"] == "resident"
+    dtp, _, qrp = zero._attn_travel_sizes(D, TP, DP)
+    for m, k in ((h_tp // DP, D), (D // DP, h_tp),
+                 (qrp // DP, D), (D // DP, dtp)):
+        p = cm.agmm_plan(m, k, B_RANK, DP, jnp.float32, True)
+        assert p is not None and p["mode"] == "resident"
     with pallas_ring.aot_lowering():
         # kernels-available is forced, as at compile: the whole engage
         # resolution (plans + registers) must say yes for these shapes
         assert zero.fsdp_engages(D, HID, B_RANK, DP, TP, overlap=True)
+        assert zero.fsdp_attn_engages(D, B_RANK, DP, TP, overlap=True)
 
 
 def test_fsdp_train_step_lowers_multihost(fsdp_mesh):
     """The flagship workload end to end: TWO transformer layers of
-    (flash fwd/bwd + 6 collective-matmul kernels each) in ONE jitted
-    program lower for the 2-host (dp=4, tp=2) mesh."""
+    (flash fwd/bwd + 12 collective-matmul kernels each — the attention
+    projections on the agmm family too, round 20) in ONE jitted
+    program lower for the 2-host (dp=4, tp=2) mesh with ZERO unfused
+    parameter collectives."""
     L = 2
     compiled = _compile(fsdp_mesh, L)
-    # >= 6 cmatmul + 2 flash Mosaic kernels per layer
-    assert_aot_lowered(compiled, 8 * L)
+    # >= 12 cmatmul + 2 flash Mosaic kernels per layer
+    assert_aot_lowered(compiled, 14 * L)
 
 
 def test_fsdp_train_step_wire_lowers_multihost(fsdp_mesh):
@@ -96,4 +101,4 @@ def test_fsdp_train_step_wire_lowers_multihost(fsdp_mesh):
     the bytes plus the hp_compression cast lanes (shard casts + the
     bucketized gradient leg)."""
     compiled = _compile(fsdp_mesh, 1, wire_dtype="bf16")
-    assert_aot_lowered(compiled, 9)
+    assert_aot_lowered(compiled, 15)
