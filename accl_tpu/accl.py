@@ -1538,6 +1538,70 @@ class ACCL:
         return self._finish(operation.send, None, data, True, False,
                             matcher.comm)
 
+    def send_page_batch(self, srcbuf: BufLike, counts, src: int,
+                        dst: int, tag: int = 0,
+                        comm: Optional[Communicator] = None):
+        """Ship N page-sized payloads from ``srcbuf`` as N single-
+        segment eager messages with ONE batched rx-slot reservation —
+        the disaggregated KV handoff's page-send path.  ``counts`` is
+        the per-page element count; page i occupies elements
+        ``[sum(counts[:i]), sum(counts[:i+1]))`` of ``srcbuf`` and
+        arrives as its own message (the receiver posts one recv per
+        page, so pages drain — and free their slots — independently,
+        instead of one monolithic message parking every segment until
+        the final recv).  All-or-nothing: the batch reserves every slot
+        up front (:meth:`RxBufPool.reserve_batch`) or FALLS BACK to one
+        plain :meth:`send` of the whole buffer — also the path when a
+        page exceeds the rx-buffer segment size — counted per outcome
+        in ``accl_sendrecv_page_batch_total{outcome}``, never a silent
+        behavior switch."""
+        counts = [int(c) for c in counts]
+        total = sum(counts)
+        comm = self._comm(comm)
+        if comm.is_multiprocess and not (
+                comm.rank_is_local(src) and comm.rank_is_local(dst)):
+            # the cross-process fabric has its own segmentation; the
+            # batched reservation is a local-matcher optimization
+            _metrics.inc("accl_sendrecv_page_batch_total",
+                         labels=(("outcome", "fallback"),))
+            return self.send(srcbuf, total, src, dst, tag=tag, comm=comm)
+        self._pump()
+        self._check_count(srcbuf, total, "send")
+        esize = constants.dtype_size(srcbuf.dtype)
+        matcher = self.matcher(comm)
+        slots = None
+        if counts and max(counts) * esize <= min(
+                self.config.eager_rx_buffer_size,
+                self.config.max_eager_size):
+            slots = matcher.rx_pool.reserve_batch(
+                src, dst, tag, matcher.outbound_seq(src, dst), counts)
+        if slots is None:
+            _metrics.inc("accl_sendrecv_page_batch_total",
+                         labels=(("outcome", "fallback"),))
+            return self.send(srcbuf, total, src, dst, tag=tag, comm=comm)
+        _metrics.inc("accl_sendrecv_page_batch_total",
+                     labels=(("outcome", "batched"),))
+        _metrics.inc("accl_sendrecv_protocol_total", labels=_L_EAGER)
+        _metrics.note_call(operation.send, total * esize, srcbuf.dtype)
+        data = self._input(srcbuf, total, False)
+        off = 0
+        for i, (c, slot) in enumerate(zip(counts, slots)):
+            post = SendPost(src=src, dst=dst, tag=tag,
+                            data=data[:, off:off + c], count=c,
+                            rx_slot=slot)
+            try:
+                matcher.post_send(post)
+            except Exception:
+                # the failed page's slot plus every unposted page's:
+                # posted pages keep theirs (the engine releases on
+                # delivery), the rest roll back
+                for s in slots[i:]:
+                    matcher.rx_pool.release(s)
+                raise
+            off += c
+        return self._finish(operation.send, None, data, True, False,
+                            comm)
+
     def _eager_send(self, matcher, data, count: int, dt: dataType,
                     src: int, dst: int, tag: int,
                     run_async: bool) -> Optional[Request]:

@@ -1,3 +1,3 @@
-from . import decode, mlp, vadd
+from . import decode, mlp, serving, vadd
 
-__all__ = ["decode", "mlp", "vadd"]
+__all__ = ["decode", "mlp", "serving", "vadd"]

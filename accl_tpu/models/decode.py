@@ -75,6 +75,8 @@ __all__ = [
     "decode_step_reference", "spec_step_reference", "decode_engages",
     "decode_engage_reasons", "accept_lengths", "note_serving_tokens",
     "make_decode_mesh", "shard_decode", "publish_tokens",
+    "publish_tokens_batch", "pack_token_records", "unpack_token_records",
+    "used_pages", "extract_session", "install_session",
 ]
 
 
@@ -813,3 +815,110 @@ def publish_tokens(acc, tokens, src: int, tag: int = 0, comm=None):
         acc.recv(rbuf, n, src=src, dst=dst, tag=tag, comm=comm)
         out.append(np.asarray(rbuf.host[dst]))
     return out
+
+
+def pack_token_records(sessions) -> np.ndarray:
+    """Flatten multiple sessions' token vectors into ONE int32 record
+    stream: ``[n_sessions, (sid, count, tokens...)...]`` — the batched
+    fan-out's wire format.  ``sessions``: dict ``{session_id: tokens}``
+    or iterable of ``(session_id, tokens)`` pairs."""
+    items = sessions.items() if hasattr(sessions, "items") else sessions
+    items = [(int(s), np.asarray(t, np.int32).reshape(-1))
+             for s, t in items]
+    recs = [np.asarray([len(items)], np.int32)]
+    for sid, toks in items:
+        recs.append(np.asarray([sid, toks.shape[0]], np.int32))
+        recs.append(toks)
+    return np.concatenate(recs)
+
+
+def unpack_token_records(flat) -> dict:
+    """Inverse of :func:`pack_token_records`: the per-session token
+    dict a receiver reads back out of one batched message."""
+    flat = np.asarray(flat, np.int32)
+    n, i, out = int(flat[0]), 1, {}
+    for _ in range(n):
+        sid, cnt = int(flat[i]), int(flat[i + 1])
+        out[sid] = flat[i + 2:i + 2 + cnt].copy()
+        i += 2 + cnt
+    return out
+
+
+def publish_tokens_batch(acc, sessions, src: int, tag: int = 0,
+                         comm=None):
+    """Fan MULTIPLE sessions' sampled tokens out from rank ``src`` in
+    ONE sub-threshold eager send per (src, dst) pair — the batched
+    :func:`publish_tokens`: where a per-session loop posts
+    ``n_sessions`` messages per destination (each parking its own
+    rx-pool slot, each paying its own dispatch), the batch packs the
+    records (:func:`pack_token_records`) into a single token-sized
+    message, so the match engine sees ONE send_parked/recv_matched pair
+    per destination per decode step regardless of how many sessions
+    published.  Returns the per-destination list of unpacked
+    ``{session_id: tokens}`` dicts (each == the input)."""
+    flat = pack_token_records(sessions)
+    n = flat.shape[0]
+    comm = comm or acc.global_comm()
+    world = comm.world_size
+    sbuf = acc.create_buffer(n, dataType.int32)
+    sbuf.host[src] = flat
+    dsts = [d for d in range(world) if d != src]
+    for dst in dsts:                       # one burst, one post per dst
+        acc.send(sbuf, n, src=src, dst=dst, tag=tag, comm=comm)
+    out = []
+    for dst in dsts:
+        rbuf = acc.create_buffer(n, dataType.int32)
+        acc.recv(rbuf, n, src=src, dst=dst, tag=tag, comm=comm)
+        out.append(unpack_token_records(rbuf.host[dst]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# session handoff entry points (the disaggregated-serving datapath)
+# ---------------------------------------------------------------------------
+
+def used_pages(state: DecodeState, slot: int) -> int:
+    """Host-side page count of ``slot``'s live chain:
+    ``ceil(seq_len / page)`` — what a handoff must ship."""
+    page = state.k_pages.shape[2]
+    return -(-int(state.seq_lens[slot]) // page)
+
+
+def extract_session(state: DecodeState, slot: int):
+    """Read ``slot``'s session out of the pools for a handoff /
+    migration: ``(k_rows, v_rows, length)`` with the rows
+    (H_kv, used, page, hd) in the POOL's at-rest dtype — int8 sessions
+    ship 1-byte pages, and the install is bit-exact because the bytes
+    never round-trip through a dequant.  Host-driven (``slot`` is a
+    python int, ``length`` comes back as one)."""
+    from ..ops import flash
+
+    length = int(state.seq_lens[slot])
+    if length <= 0:
+        raise ValueError(f"slot {slot} has no live session to extract")
+    k_rows, v_rows = flash.kv_cache_extract_pages(
+        state.k_pages, state.v_pages, state.block_tables, slot,
+        used_pages(state, slot))
+    return k_rows, v_rows, length
+
+
+def install_session(state: DecodeState, slot: int, k_rows, v_rows,
+                    length: int) -> DecodeState:
+    """Land a handed-off session in ``slot``: the received page rows
+    are written into the pool pages the slot's block-table row names
+    (:func:`flash.kv_cache_install_pages` — dtype-checked, a codec
+    mismatch raises rather than casts), the table row is committed
+    back, and ``seq_lens``/``active`` advance to the session's length —
+    the receiver-side block-table rewrite.  After this, decoding from
+    ``slot`` is bit-exact with having prefilled the session here."""
+    from ..ops import flash
+
+    k_pages, v_pages = flash.kv_cache_install_pages(
+        state.k_pages, state.v_pages, state.block_tables, slot,
+        k_rows, v_rows)
+    row = state.block_tables[slot]
+    return DecodeState(
+        k_pages, v_pages,
+        state.block_tables.at[slot].set(row),
+        state.seq_lens.at[slot].set(jnp.asarray(length, jnp.int32)),
+        state.active.at[slot].set(True))

@@ -1,0 +1,667 @@
+"""Disaggregated prefill/decode serving — the fleet layer over the
+round-13/18 single-replica datapath.
+
+A colocated replica head-of-line-blocks every decode step behind a long
+prompt: one program stream, so a 2k-token prefill chunk sits squarely in
+the decode batch's per-token budget.  This module splits serving onto
+**M prefill workers + N decode replicas on one mesh** and makes the KV
+handoff a first-class wire protocol:
+
+* **prefill workers** (:class:`PrefillWorker`) run
+  :func:`decode.build_prefill_step` into their own local paged pools —
+  admission never touches a decode replica's program stream;
+* the **handoff** (:func:`send_session` / :func:`recv_session`) moves a
+  finished session to a decode replica as *eager page sends*: a small
+  int32 control header that must resolve through the round-13 latency
+  tier (:func:`synth.in_latency_tier` — asserted, not assumed), then
+  the slot's used KV pages in the pool's **at-rest dtype** (an int8
+  session ships 2x fewer bytes than bf16 and the install is bit-exact
+  because the bytes never round-trip a dequant), batched onto the rx
+  pool with ONE reservation (:meth:`ACCL.send_page_batch`), then the
+  per-(head,page) scales when the source carries the paged int8 codec.
+  The receiver lands the pages with a block-table rewrite
+  (:func:`decode.install_session`) — decoding there is bit-identical
+  to having prefilled in place, pinned per codec by the tests;
+* the **admission/routing front end** (:class:`ServingRouter`) admits
+  sessions to the least-loaded prefill worker, routes handoffs to the
+  decode replica with free slots and a matching codec, and supports
+  **cross-replica slot migration** (same page-send machinery, mid-
+  decode) for load rebalancing and drain.  Every decline — no free
+  slots, dead replica, codec mismatch — is COUNTED
+  (``accl_serving_router_declines_total{reason}``) and surfaced,
+  never silently absorbed;
+* **observability**: handoffs and migrations time into the µs-
+  resolution dispatch histogram (``accl_latency_dispatch_seconds{path=
+  "handoff"|"migrate"}``), page bytes count into
+  ``accl_serving_handoff_bytes_total{dtype}``, and the fleet's
+  occupancy rides the ``accl_serving_sessions{replica, phase}`` gauge
+  beside the existing ``accl_serving_tokens_total`` throughput feed;
+* **failure**: a decode replica dying mid-session surfaces
+  ``PEER_FAILED`` to the router (:meth:`ServingRouter.note_peer_failed`
+  — fed by the round-14 heartbeat verdicts), which re-prefills the dead
+  replica's sessions from their retained prompts onto a surviving
+  replica and can migrate survivors off a draining one — composing
+  with the round-15 ``recover()`` shrink, proven end to end by the
+  ``ACCL_CHAOS=serve`` launcher scenario.
+
+See ``docs/serving.md`` §Disaggregation for the wire format and the
+router state machine.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import constants
+from ..constants import dataType
+from . import decode
+
+__all__ = [
+    "Session", "PrefillWorker", "DecodeReplica", "ServingRouter",
+    "send_session", "recv_session", "HandoffTicket", "HANDOFF_MAGIC",
+    "HEADER_WORDS", "codec_id", "RoutingDeclined",
+]
+
+#: control-header magic ('KV' | protocol rev 1) — a receiver matching a
+#: stray message on the handoff tag fails loudly, not with garbage pages
+HANDOFF_MAGIC = 0x4B5601
+
+#: header layout (int32 x 8): [magic, kind, session_id, length,
+#: used_pages, codec_id, page_elems, n_scale_words]
+HEADER_WORDS = 8
+
+_KIND_HANDOFF = 0
+_KIND_MIGRATE = 1
+
+#: pinned wire ids of the at-rest codecs — the header's codec word.
+#: Wire-stable: new codecs append, ids never renumber.
+_CODEC_IDS = {"float32": 0, "bfloat16": 1, "int8": 2, "float16": 3}
+
+
+def codec_id(pool_dtype) -> int:
+    """The handoff header's pinned id for a pool's at-rest dtype."""
+    name = jnp.dtype(pool_dtype).name
+    if name not in _CODEC_IDS:
+        raise ValueError(f"no handoff codec id for pool dtype {name}")
+    return _CODEC_IDS[name]
+
+
+def _pool_data_type(pool_dtype) -> dataType:
+    return constants.from_jax_dtype(jnp.dtype(pool_dtype))
+
+
+class RoutingDeclined(RuntimeError):
+    """The router could not place a session; ``reasons`` carries every
+    candidate's counted decline verdict — the caller decides whether to
+    queue, shed, or raise capacity."""
+
+    def __init__(self, msg: str, reasons: List[str]):
+        super().__init__(msg)
+        self.reasons = reasons
+
+
+@dataclasses.dataclass
+class Session:
+    """One serving session's host-side record.  ``prompt`` is retained
+    (hidden states, (L, d_model)) so a decode-replica death can
+    re-prefill without the client resubmitting — the round-15 recovery
+    composition."""
+
+    sid: int
+    prompt: Optional[np.ndarray] = None
+    phase: str = "queued"          # queued | prefill | decode | done
+    worker: Optional[str] = None   # prefill worker name while prefilling
+    replica: Optional[str] = None  # decode replica name while decoding
+    slot: Optional[int] = None
+    length: int = 0
+
+
+@dataclasses.dataclass
+class HandoffTicket:
+    """What :func:`send_session` actually put on the wire — the local
+    orchestration contract :func:`recv_session` consumes (framing is
+    the sender's call; cross-process receivers use the deterministic
+    single-message framing instead)."""
+
+    sid: int
+    kind: int
+    length: int
+    used: int
+    page_elems: int
+    n_scale_words: int
+    page_batch: bool
+    payload_bytes: int
+
+
+def _steps_mesh(devices=None):
+    devs = list(devices) if devices is not None else jax.devices()[:1]
+    return decode.make_decode_mesh(devs[:1], 1)
+
+
+class _Endpoint:
+    """Shared replica plumbing: a rank on the serving mesh owning its
+    own params + paged DecodeState and lazily-built jitted steps."""
+
+    def __init__(self, name: str, rank: int, params, slots: int,
+                 pages_max: int, page: int, n_kv_heads: int,
+                 head_dim: int, dtype=jnp.float32,
+                 kv_dtype: Optional[str] = None, devices=None):
+        self.name = name
+        self.rank = rank
+        self._mesh = _steps_mesh(devices)
+        self.params, self.state = decode.shard_decode(
+            params,
+            decode.init_decode_state(slots, pages_max, page, n_kv_heads,
+                                     head_dim, dtype=dtype,
+                                     kv_dtype=kv_dtype),
+            self._mesh)
+        #: optional per-(head,page) int8 scales carried BESIDE the block
+        #: table ((k_scales, v_scales), each (H_kv, n_pages) np.float32)
+        #: — shipped with a session's pages on handoff/migration
+        self.kv_scales: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        self.alive = True
+        self._steps: Dict[str, object] = {}
+
+    @property
+    def pool_dtype(self):
+        return self.state.k_pages.dtype
+
+    def free_slots(self) -> List[int]:
+        return decode.free_slots(self.state)
+
+    def live_slots(self) -> int:
+        return int(np.sum(np.asarray(self.state.active)))
+
+
+class PrefillWorker(_Endpoint):
+    """A prefill-only endpoint: prompts chunk straight into its local
+    paged pools via the round-18 prefill step; finished sessions leave
+    through the handoff, freeing the slot for the next admission."""
+
+    def __init__(self, *args, chunk: int = 8, **kw):
+        super().__init__(*args, **kw)
+        if chunk < 1:
+            raise ValueError(f"prefill chunk must be >= 1, got {chunk}")
+        self.chunk = chunk
+        self.pending_tokens = 0    # the router's least-loaded signal
+
+    def _prefill_step(self):
+        if "prefill" not in self._steps:
+            self._steps["prefill"] = decode.build_prefill_step(self._mesh)
+        return self._steps["prefill"]
+
+    def prefill(self, slot: int, x_prompt) -> np.ndarray:
+        """Run one prompt through the chunked prefill into ``slot``.
+        ``x_prompt``: (L, d_model) hidden states.  Returns the (L,
+        d_model) attention-block outputs (the decode loop's seed)."""
+        x_prompt = np.asarray(x_prompt)
+        L = x_prompt.shape[0]
+        step = self._prefill_step()
+        self.state = decode.admit(self.state, slot)
+        outs = []
+        for lo in range(0, L, self.chunk):
+            xc = x_prompt[lo:lo + self.chunk]
+            live = xc.shape[0]
+            if live < self.chunk:    # pad the tail chunk, keep ONE program
+                xc = np.pad(xc, ((0, self.chunk - live), (0, 0)))
+            y, self.state = step(self.params, self.state,
+                                 jnp.asarray(xc), slot, live=live)
+            outs.append(np.asarray(y)[:live])
+        return np.concatenate(outs) if outs else np.zeros_like(x_prompt)
+
+
+class DecodeReplica(_Endpoint):
+    """A decode-only endpoint: sessions arrive pre-filled through the
+    handoff and advance one (or k speculative) token(s) per tick."""
+
+    def decode_step(self):
+        if "decode" not in self._steps:
+            self._steps["decode"] = decode.build_decode_step(self._mesh)
+        return self._steps["decode"]
+
+    def spec_step(self, k: int):
+        key = f"spec{k}"
+        if key not in self._steps:
+            self._steps[key] = decode.build_spec_decode_step(self._mesh, k)
+        return self._steps[key]
+
+    def decode_tick(self, x) -> np.ndarray:
+        """One continuous-batching decode step over ALL slots; returns
+        the (slots, d_model) outputs (retired slots: zeros)."""
+        y, self.state = self.decode_step()(self.params, self.state,
+                                           jnp.asarray(x))
+        return np.asarray(y)
+
+    def spec_tick(self, x, draft_ok) -> np.ndarray:
+        k = np.asarray(x).shape[1]
+        y, self.state = self.spec_step(k)(self.params, self.state,
+                                          jnp.asarray(x), draft_ok)
+        return np.asarray(y)
+
+
+# ---------------------------------------------------------------------------
+# the handoff wire protocol
+# ---------------------------------------------------------------------------
+
+def _pack_pages(k_rows, v_rows) -> Tuple[np.ndarray, int, int]:
+    """(H_kv, used, page, d) k/v rows -> (2·used, page_elems) page
+    payload matrix in the POOL dtype: page i of the chain is one wire
+    message (all kv heads together), k pages first then v pages."""
+    used = k_rows.shape[1]
+    k2 = np.asarray(k_rows).transpose(1, 0, 2, 3).reshape(used, -1)
+    v2 = np.asarray(v_rows).transpose(1, 0, 2, 3).reshape(used, -1)
+    return np.concatenate([k2, v2]), used, k2.shape[1]
+
+
+def _unpack_pages(flat, used: int, shape) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    hkv, page, d = shape
+    m = np.asarray(flat).reshape(2 * used, hkv, page, d)
+    k_rows = jnp.asarray(m[:used].transpose(1, 0, 2, 3))
+    v_rows = jnp.asarray(m[used:].transpose(1, 0, 2, 3))
+    return k_rows, v_rows
+
+
+def _send_control(acc, words, src: int, dst: int, tag: int, comm) -> None:
+    """Post the handoff's control header — token-sized, and REQUIRED to
+    resolve through the latency tier (the round-13 fast path is the
+    handoff control transport; a header that outgrew the tier would
+    silently demote every handoff to the segmented path)."""
+    from ..parallel import synth
+
+    hdr = np.asarray(words, np.int32)
+    if not synth.in_latency_tier(hdr.nbytes, acc.config):
+        raise ValueError(
+            f"handoff control header ({hdr.nbytes}B) does not resolve "
+            f"through the latency tier (threshold "
+            f"{acc.config.latency_tier_threshold}B)")
+    buf = acc.create_buffer(hdr.shape[0], dataType.int32, comm=comm)
+    buf.host[src] = hdr
+    acc.send(buf, hdr.shape[0], src=src, dst=dst, tag=tag, comm=comm)
+
+
+def _recv_control(acc, nwords: int, src: int, dst: int, tag: int,
+                  comm) -> np.ndarray:
+    buf = acc.create_buffer(nwords, dataType.int32, comm=comm)
+    acc.recv(buf, nwords, src=src, dst=dst, tag=tag, comm=comm)
+    return np.asarray(buf.host[dst])
+
+
+def send_session(acc, state, slot: int, sid: int, src: int, dst: int,
+                 tag: int = 0, comm=None, kind: str = "handoff",
+                 kv_scales=None, page_batch: Optional[bool] = None
+                 ) -> HandoffTicket:
+    """SEND side of the KV handoff: ship ``slot``'s session from rank
+    ``src``'s pools to rank ``dst`` — control header through the
+    latency tier, then the used pages in the pool's at-rest dtype
+    (page-batched eager sends with one rx-slot reservation where the
+    geometry allows; the deterministic single-message framing
+    otherwise), then the per-(head,page) scales when ``kv_scales``
+    carries the paged int8 codec.  Returns the :class:`HandoffTicket`
+    the local :func:`recv_session` consumes.  ``page_batch=None``
+    resolves the framing automatically (False is forced cross-process —
+    both sides must agree without a side channel)."""
+    from ..obs import metrics
+
+    comm = comm or acc.global_comm()
+    k_rows, v_rows, length = decode.extract_session(state, slot)
+    payload, used, page_elems = _pack_pages(k_rows, v_rows)
+    pool_dt = _pool_data_type(k_rows.dtype)
+    esize = constants.dtype_size(pool_dt)
+    scale_words = np.zeros((0,), np.float32)
+    if kv_scales is not None:
+        ks, vs = kv_scales
+        row = np.asarray(state.block_tables)[slot, :used]
+        scale_words = np.concatenate(
+            [np.asarray(ks, np.float32)[:, row].reshape(-1),
+             np.asarray(vs, np.float32)[:, row].reshape(-1)])
+    if page_batch is None:
+        matcher = acc.matcher(comm)
+        need = 2 * used + (2 if scale_words.size else 1)
+        page_batch = (
+            not (comm.is_multiprocess
+                 and not (comm.rank_is_local(src)
+                          and comm.rank_is_local(dst)))
+            and page_elems * esize <= min(acc.config.eager_rx_buffer_size,
+                                          acc.config.max_eager_size)
+            and matcher.rx_pool.free_slots >= need)
+    header = [HANDOFF_MAGIC,
+              _KIND_MIGRATE if kind == "migrate" else _KIND_HANDOFF,
+              sid, length, used, codec_id(k_rows.dtype), page_elems,
+              int(scale_words.size)]
+    _send_control(acc, header, src, dst, tag, comm)
+    total = 2 * used * page_elems
+    pbuf = acc.create_buffer(total, pool_dt, comm=comm)
+    pbuf.host[src] = payload.reshape(-1)
+    if page_batch:
+        acc.send_page_batch(pbuf, [page_elems] * (2 * used), src=src,
+                            dst=dst, tag=tag + 1, comm=comm)
+    else:
+        acc.send(pbuf, total, src=src, dst=dst, tag=tag + 1, comm=comm)
+    if scale_words.size:
+        sbuf = acc.create_buffer(scale_words.size, dataType.float32,
+                                 comm=comm)
+        sbuf.host[src] = scale_words
+        acc.send(sbuf, scale_words.size, src=src, dst=dst, tag=tag + 2,
+                 comm=comm)
+    payload_bytes = total * esize
+    metrics.inc("accl_serving_handoff_bytes_total", float(payload_bytes),
+                (("dtype", jnp.dtype(k_rows.dtype).name),))
+    return HandoffTicket(sid=sid, kind=header[1], length=length,
+                         used=used, page_elems=page_elems,
+                         n_scale_words=int(scale_words.size),
+                         page_batch=page_batch,
+                         payload_bytes=payload_bytes)
+
+
+def recv_session(acc, state, slot: int, src: int, dst: int,
+                 tag: int = 0, comm=None,
+                 ticket: Optional[HandoffTicket] = None,
+                 kv_scales=None):
+    """RECV side of the KV handoff: land a session in ``slot`` of
+    ``state`` (rank ``dst``'s pools) — header validated (magic AND
+    codec against the local pool dtype: a mismatch raises, it never
+    casts), pages installed through the block-table rewrite, scales
+    scattered into the local per-page arrays when both sides carry the
+    paged codec.  ``ticket`` (the local sender's return) pins the
+    framing; cross-process receivers omit it and use the deterministic
+    single-message framing.  Returns ``(state', sid, length)`` —
+    ``kv_scales`` is updated IN PLACE when given."""
+    hdr = _recv_control(acc, HEADER_WORDS, src, dst, tag, comm)
+    comm = comm or acc.global_comm()
+    if int(hdr[0]) != HANDOFF_MAGIC:
+        raise ValueError(
+            f"handoff header magic {hdr[0]:#x} != {HANDOFF_MAGIC:#x}")
+    sid, length, used = int(hdr[2]), int(hdr[3]), int(hdr[4])
+    page_elems, n_scale = int(hdr[6]), int(hdr[7])
+    local_codec = codec_id(state.k_pages.dtype)
+    if int(hdr[5]) != local_codec:
+        raise ValueError(
+            f"handoff codec id {int(hdr[5])} != local pool codec "
+            f"{local_codec} ({jnp.dtype(state.k_pages.dtype).name}) — "
+            f"the router must decline codec mismatches upstream")
+    pool_dt = _pool_data_type(state.k_pages.dtype)
+    total = 2 * used * page_elems
+    page_batch = bool(ticket.page_batch) if ticket is not None else False
+    if page_batch:
+        chunks = []
+        for _ in range(2 * used):
+            rb = acc.create_buffer(page_elems, pool_dt, comm=comm)
+            acc.recv(rb, page_elems, src=src, dst=dst, tag=tag + 1,
+                     comm=comm)
+            chunks.append(np.asarray(rb.host[dst]))
+        flat = np.concatenate(chunks)
+    else:
+        rb = acc.create_buffer(total, pool_dt, comm=comm)
+        acc.recv(rb, total, src=src, dst=dst, tag=tag + 1, comm=comm)
+        flat = np.asarray(rb.host[dst])
+    hkv, _, page, d = state.k_pages.shape
+    k_rows, v_rows = _unpack_pages(flat, used, (hkv, page, d))
+    if n_scale:
+        sb = acc.create_buffer(n_scale, dataType.float32, comm=comm)
+        acc.recv(sb, n_scale, src=src, dst=dst, tag=tag + 2, comm=comm)
+        if kv_scales is not None:
+            row = np.asarray(state.block_tables)[slot, :used]
+            sw = np.asarray(sb.host[dst]).reshape(2, hkv, used)
+            kv_scales[0][:, row] = sw[0]
+            kv_scales[1][:, row] = sw[1]
+    state = decode.install_session(state, slot, k_rows, v_rows, length)
+    return state, sid, length
+
+
+# ---------------------------------------------------------------------------
+# the admission/routing front end
+# ---------------------------------------------------------------------------
+
+def _count_decline(reason: str) -> None:
+    from ..obs import metrics
+    metrics.inc("accl_serving_router_declines_total",
+                labels=(("reason", reason),))
+
+
+class ServingRouter:
+    """Host-side admission/routing state machine over M prefill workers
+    and N decode replicas sharing one ACCL session.
+
+    State per session: ``queued -> prefill(worker) -> decode(replica)
+    -> done``, with ``migrate`` (decode -> decode, same page-send
+    machinery) and ``re-prefill`` (a dead replica's sessions replay
+    their retained prompts) as the lateral edges.  Every transition
+    updates the ``accl_serving_sessions{replica, phase}`` gauge; every
+    decline is counted by reason and raised as
+    :class:`RoutingDeclined` — the absorbing-silently failure mode is
+    designed out."""
+
+    def __init__(self, acc, workers: List[PrefillWorker],
+                 replicas: List[DecodeReplica], tag_base: int = 7000):
+        if not workers or not replicas:
+            raise ValueError("need at least one prefill worker and one "
+                             "decode replica")
+        self.acc = acc
+        self.workers = {w.name: w for w in workers}
+        self.replicas = {r.name: r for r in replicas}
+        self.sessions: Dict[int, Session] = {}
+        self._tag = tag_base
+        self._note_sessions()
+
+    # -- observability ----------------------------------------------------
+
+    def _note_sessions(self) -> None:
+        from ..obs import metrics
+
+        counts: Dict[Tuple[str, str], int] = {}
+        for w in self.workers.values():
+            counts[(w.name, "prefill")] = 0
+        for r in self.replicas.values():
+            counts[(r.name, "decode")] = 0
+        for s in self.sessions.values():
+            if s.phase == "prefill" and s.worker:
+                counts[(s.worker, "prefill")] += 1
+            elif s.phase == "decode" and s.replica:
+                counts[(s.replica, "decode")] += 1
+        for (name, phase), n in counts.items():
+            metrics.set_gauge("accl_serving_sessions", float(n),
+                              (("replica", name), ("phase", phase)))
+
+    def _next_tag(self) -> int:
+        t = self._tag
+        self._tag += 4           # header / pages / scales + headroom
+        return t
+
+    # -- admission --------------------------------------------------------
+
+    def admit(self, sid: int, prompt) -> Session:
+        """Admit a session to the LEAST-LOADED prefill worker (pending
+        prompt tokens, then live slots) and run its chunked prefill.
+        Declines (every worker full) are counted and raised."""
+        prompt = np.asarray(prompt)
+        if sid in self.sessions:
+            raise ValueError(f"session {sid} already admitted")
+        ranked = sorted(
+            self.workers.values(),
+            key=lambda w: (w.pending_tokens, w.live_slots(), w.name))
+        worker = next((w for w in ranked if w.alive and w.free_slots()),
+                      None)
+        if worker is None:
+            _count_decline("no_free_slots")
+            raise RoutingDeclined(
+                f"no prefill worker has a free slot for session {sid}",
+                ["no_free_slots"])
+        slot = worker.free_slots()[0]
+        sess = Session(sid=sid, prompt=prompt, phase="prefill",
+                       worker=worker.name, slot=slot,
+                       length=prompt.shape[0])
+        self.sessions[sid] = sess
+        worker.pending_tokens += prompt.shape[0]
+        try:
+            worker.prefill(slot, prompt)
+        finally:
+            worker.pending_tokens -= prompt.shape[0]
+        self._note_sessions()
+        return sess
+
+    # -- routing / handoff ------------------------------------------------
+
+    def route(self, sess: Session,
+              pool_dtype) -> Tuple[Optional[DecodeReplica], List[str]]:
+        """Pick the decode replica for ``sess``: alive, codec-matching,
+        most free slots.  Returns ``(replica, counted decline reasons
+        of the candidates that were rejected)`` — ``replica`` None when
+        nothing can take the session."""
+        reasons: List[str] = []
+        best, best_free = None, -1
+        for r in sorted(self.replicas.values(), key=lambda r: r.name):
+            if not r.alive:
+                reasons.append("dead_replica")
+                _count_decline("dead_replica")
+                continue
+            if jnp.dtype(r.pool_dtype) != jnp.dtype(pool_dtype):
+                reasons.append("codec_mismatch")
+                _count_decline("codec_mismatch")
+                continue
+            free = len(r.free_slots())
+            if free == 0:
+                reasons.append("no_free_slots")
+                _count_decline("no_free_slots")
+                continue
+            if free > best_free:
+                best, best_free = r, free
+        return best, reasons
+
+    def handoff(self, sid: int,
+                replica: Optional[str] = None) -> DecodeReplica:
+        """Move a prefilled session from its worker to a decode replica
+        via the eager page handoff; frees the worker slot.  Timed into
+        ``accl_latency_dispatch_seconds{path="handoff"}``."""
+        sess = self.sessions[sid]
+        if sess.phase != "prefill":
+            raise ValueError(f"session {sid} is {sess.phase}, not "
+                             f"prefill — nothing to hand off")
+        worker = self.workers[sess.worker]
+        dst_r = self._resolve_target(sess, worker.pool_dtype, replica)
+        dst_slot = self._transfer(sess, worker, dst_r, kind="handoff")
+        worker.state = decode.retire(worker.state, sess.slot)
+        sess.worker, sess.slot = None, dst_slot
+        sess.replica, sess.phase = dst_r.name, "decode"
+        self._note_sessions()
+        return dst_r
+
+    def migrate(self, sid: int,
+                replica: Optional[str] = None) -> DecodeReplica:
+        """Move a DECODING session between decode replicas — load
+        rebalancing and drain ride the same page-send machinery as the
+        handoff, mid-decode (the speculative rollback snapshot is
+        state, so a post-verify migration lands it correctly).  Timed
+        into ``accl_latency_dispatch_seconds{path="migrate"}``."""
+        sess = self.sessions[sid]
+        if sess.phase != "decode":
+            raise ValueError(f"session {sid} is {sess.phase}, not "
+                             f"decode — nothing to migrate")
+        src_r = self.replicas[sess.replica]
+        dst_r = self._resolve_target(sess, src_r.pool_dtype, replica,
+                                     exclude=src_r.name)
+        dst_slot = self._transfer(sess, src_r, dst_r, kind="migrate")
+        src_r.state = decode.retire(src_r.state, sess.slot)
+        sess.slot = dst_slot
+        sess.replica = dst_r.name
+        self._note_sessions()
+        return dst_r
+
+    def _resolve_target(self, sess: Session, pool_dtype,
+                        replica: Optional[str],
+                        exclude: Optional[str] = None) -> DecodeReplica:
+        if replica is not None:
+            r = self.replicas[replica]
+            if not r.alive:
+                _count_decline("dead_replica")
+                raise RoutingDeclined(
+                    f"replica {replica} is dead", ["dead_replica"])
+            if jnp.dtype(r.pool_dtype) != jnp.dtype(pool_dtype):
+                _count_decline("codec_mismatch")
+                raise RoutingDeclined(
+                    f"replica {replica} pool {r.pool_dtype} != session "
+                    f"codec {pool_dtype}", ["codec_mismatch"])
+            if not r.free_slots():
+                _count_decline("no_free_slots")
+                raise RoutingDeclined(
+                    f"replica {replica} has no free slot",
+                    ["no_free_slots"])
+            return r
+        cands = dict(self.replicas)
+        if exclude is not None:
+            cands.pop(exclude, None)
+        saved, self.replicas = self.replicas, cands
+        try:
+            r, reasons = self.route(sess, pool_dtype)
+        finally:
+            self.replicas = saved
+        if r is None:
+            raise RoutingDeclined(
+                f"no decode replica can take session {sess.sid}",
+                reasons)
+        return r
+
+    def _transfer(self, sess: Session, src_ep: _Endpoint,
+                  dst_r: DecodeReplica, kind: str) -> int:
+        from ..obs import metrics
+
+        dst_slot = dst_r.free_slots()[0]
+        tag = self._next_tag()
+        t0 = metrics.tick()
+        ticket = send_session(
+            self.acc, src_ep.state, sess.slot, sess.sid,
+            src=src_ep.rank, dst=dst_r.rank, tag=tag, kind=kind,
+            kv_scales=src_ep.kv_scales)
+        dst_r.state, _, length = recv_session(
+            self.acc, dst_r.state, dst_slot, src=src_ep.rank,
+            dst=dst_r.rank, tag=tag, ticket=ticket,
+            kv_scales=dst_r.kv_scales)
+        metrics.note_latency_dispatch(kind, t0)
+        sess.length = length
+        return dst_slot
+
+    # -- failure ----------------------------------------------------------
+
+    def note_peer_failed(self, rank: int) -> List[int]:
+        """A heartbeat/PEER_FAILED verdict for ``rank``: mark its
+        replica dead and RE-ROUTE its sessions — each re-prefills from
+        its retained prompt on a live worker and hands off to a
+        surviving replica (the round-15 recovery composition: the
+        caller runs ``acc.recover()`` for the fabric, this runs the
+        serving tier's half).  Returns the re-routed session ids."""
+        lost = [r for r in self.replicas.values() if r.rank == rank]
+        for r in lost:
+            r.alive = False
+        for w in self.workers.values():
+            if w.rank == rank:
+                w.alive = False
+        moved: List[int] = []
+        for sess in list(self.sessions.values()):
+            if (sess.phase == "decode" and sess.replica
+                    and not self.replicas[sess.replica].alive):
+                sid = sess.sid
+                prompt = sess.prompt
+                if prompt is None:
+                    raise RoutingDeclined(
+                        f"session {sid} lost with no retained prompt",
+                        ["dead_replica"])
+                del self.sessions[sid]
+                self.admit(sid, prompt)
+                self.handoff(sid)
+                moved.append(sid)
+        self._note_sessions()
+        return moved
+
+    def drain(self, replica: str) -> List[int]:
+        """Migrate every session off ``replica`` (rolling maintenance):
+        the migration path under load, counted per decline like any
+        other routing."""
+        moved = []
+        for sess in list(self.sessions.values()):
+            if sess.phase == "decode" and sess.replica == replica:
+                self.migrate(sess.sid)
+                moved.append(sess.sid)
+        return moved

@@ -38,10 +38,15 @@ name                                           kind       labels
 ``accl_dcn_wire_bytes_total``                  counter    op, dtype, stage (pre | post: two-tier cross-slice leg bytes before/after compression, per dispatch resolution)
 ``accl_program_cache_total``                   counter    event (hit | miss | evict)
 ``accl_program_cache_size``                    gauge      (none)
-``accl_latency_dispatch_seconds``              histogram  path (µs-resolution buckets; eager_send | collective | prefill | decode | verify)
+``accl_latency_dispatch_seconds``              histogram  path (µs-resolution buckets; eager_send | collective | prefill | decode | verify | handoff | migrate)
 ``accl_flash_decode_fallback_total``           counter    reason (mode | geometry | vmem_miss)
 ``accl_flash_prefill_fallback_total``          counter    reason (mode | geometry | vmem_miss)
 ``accl_serving_tokens_total``                  counter    phase (prefill | decode | verify), accepted (true | false)
+``accl_serving_sessions``                      gauge      replica, phase (prefill | decode: fleet occupancy per endpoint)
+``accl_serving_handoff_bytes_total``           counter    dtype (KV page bytes shipped by handoffs/migrations, in the pool's at-rest dtype)
+``accl_serving_router_declines_total``         counter    reason (no_free_slots | dead_replica | codec_mismatch)
+``accl_rx_pool_batch_total``                   counter    outcome (reserved | exhausted: all-or-nothing page-batch claims)
+``accl_sendrecv_page_batch_total``             counter    outcome (batched | fallback: page-batch eager sends vs per-payload fallback)
 ``accl_fault_injected_total``                  counter    point, kind (fault.py chaos harness)
 ``accl_rpc_retry_total``                       counter    point (RetryPolicy absorbed transients)
 ``accl_peer_death_total``                      counter    proc (heartbeat-lease death verdicts)

@@ -1473,12 +1473,47 @@ def quantize_kv(x, pool_dtype, mode: Optional[str] = None, seed=None):
     return x.astype(pool_dtype)
 
 
-def dequantize_kv(pages, compute_dtype=_F32):
+def dequantize_kv(pages, compute_dtype=_F32, scales=None):
     """Inverse of :func:`quantize_kv` for host/reference reads: int8
-    pools divide the fixed scale back out; float pools widen."""
+    pools divide the fixed scale back out; float pools widen.
+    ``scales`` (optional (H_kv, n_pages) f32 from
+    :func:`quantize_kv_paged`) switches the int8 path to the
+    per-(head,page) codec — each pool page divides ITS scale out."""
     if jnp.dtype(pages.dtype) == jnp.int8:
+        if scales is not None:
+            return pages.astype(compute_dtype) / scales[:, :, None, None]
         return pages.astype(compute_dtype) / _KV_QUANT_SCALE
     return pages.astype(compute_dtype)
+
+
+#: amax floor of the per-(head,page) scale: an all-zero page (fresh
+#: pool) would otherwise divide by zero; any floor works because the
+#: quantized values on such a page are exact zeros either way.
+_KV_SCALE_EPS = 1e-6
+
+
+def quantize_kv_paged(x, mode: Optional[str] = None):
+    """Quantize a WHOLE pool ``x`` ((H_kv, n_pages, page, d) f32/bf16)
+    to int8 with PER-(head,page) scales — the satellite codec over the
+    fixed-scale :func:`quantize_kv`: each pool page p of kv head h gets
+    ``scale[h,p] = 127 / amax(|x[h,p]|)`` computed AT QUANTIZE time, so
+    a page of small values keeps its whole int8 range instead of
+    rounding into the fixed global scale's coarse grid.  Returns
+    ``(pool_int8, scales)`` with ``scales`` (H_kv, n_pages) f32 —
+    carried beside the block table (the handoff ships a slot's used
+    pages' scales with the page bytes) and divided back out in-kernel
+    (:func:`flash_decode` ``kv_scales=``) or by :func:`dequantize_kv`.
+
+    Non-int8 modes have no scale to pick: the pool casts through
+    :func:`quantize_kv` and ``scales`` is None."""
+    mode = mode or _KV_DTYPE
+    store = kv_storage_dtype(x.dtype, mode)
+    if jnp.dtype(store) != jnp.int8:
+        return quantize_kv(x, store, mode=mode), None
+    amax = jnp.max(jnp.abs(jnp.asarray(x, _F32)), axis=(2, 3))
+    scales = 127.0 / jnp.maximum(amax, _KV_SCALE_EPS)      # (hkv, n_pages)
+    s = jnp.asarray(x, _F32) * scales[:, :, None, None]
+    return jnp.clip(jnp.round(s), -127, 127).astype(jnp.int8), scales
 
 
 def _kv_inv_scale(pool_dtype) -> Optional[float]:
@@ -1552,10 +1587,19 @@ def _resolve_decode(decode_mode: Optional[str]) -> str:
     return mode
 
 
-def _decode_kernel(lens_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
-                   acc_ref, m_ref, l_ref, *, page: int, scale: float,
-                   kv_inv: Optional[float] = None):
+def _decode_kernel(lens_ref, bt_ref, *refs, page: int, scale: float,
+                   kv_inv: Optional[float] = None,
+                   per_page: bool = False):
+    if per_page:
+        # per-(head,page) codec: a third scalar-prefetch operand carries
+        # the pool's INVERSE scales (H_kv, n_pages) — page j of head h
+        # dequants with its own multiplier, looked up through the same
+        # block-table indirection the dataflow prefetches with
+        inv_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
     b = pl.program_id(0)
+    h = pl.program_id(1)
     j = pl.program_id(2)          # page sweep (innermost: scratch carries)
     npg = pl.num_programs(2)
     length = lens_ref[b]
@@ -1573,7 +1617,11 @@ def _decode_kernel(lens_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
         # full-precision cache (kv_inv None = float pools ride the MXU
         # mixed-precision path unchanged — the pre-quantization trace)
         kb, vb = k_ref[0, 0], v_ref[0, 0]
-        if kv_inv is not None:
+        if per_page:
+            inv = inv_ref[h, bt_ref[b, j]]
+            kb = kb.astype(_F32) * inv
+            vb = vb.astype(_F32) * inv
+        elif kv_inv is not None:
             kb = kb.astype(_F32) * kv_inv
             vb = vb.astype(_F32) * kv_inv
         # exp2-domain online softmax — the forward's carry loop with the
@@ -1610,9 +1658,9 @@ def _decode_kernel(lens_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
 
 
-def _decode_span_kernel(lens_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
-                        acc_ref, m_ref, l_ref, *, page: int, scale: float,
-                        span: int, kv_inv: Optional[float] = None):
+def _decode_span_kernel(lens_ref, bt_ref, *refs, page: int, scale: float,
+                        span: int, kv_inv: Optional[float] = None,
+                        per_page: bool = False):
     """Multi-query-row page sweep: S_q = span > 1 query rows per GQA
     group share ONE walk of the slot's page chain — the speculative-
     decode and chunked-prefill tile. Row layout is (g, span) row-major,
@@ -1626,7 +1674,12 @@ def _decode_span_kernel(lens_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
     query kernel verbatim; span == 1 collapses to the same mask values,
     but callers route span == 1 through :func:`_decode_kernel` so the
     plain decode step stays byte-identical to round 13."""
+    if per_page:
+        inv_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
     b = pl.program_id(0)
+    h = pl.program_id(1)
     j = pl.program_id(2)
     npg = pl.num_programs(2)
     length = lens_ref[b]
@@ -1640,7 +1693,11 @@ def _decode_span_kernel(lens_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
     def _block():
         q = q_ref[0, 0]                                     # (gp, dp)
         kb, vb = k_ref[0, 0], v_ref[0, 0]
-        if kv_inv is not None:
+        if per_page:
+            inv = inv_ref[h, bt_ref[b, j]]
+            kb = kb.astype(_F32) * inv
+            vb = vb.astype(_F32) * inv
+        elif kv_inv is not None:
             kb = kb.astype(_F32) * kv_inv
             vb = vb.astype(_F32) * kv_inv
         s = jax.lax.dot_general(
@@ -1679,33 +1736,47 @@ def _decode_span_kernel(lens_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _flash_decode_paged(q4, k_pages, v_pages, block_tables, seq_lens,
-                        sc: float, gp: int, span: int = 1):
+                        sc: float, gp: int, span: int = 1,
+                        kv_scales=None):
     B, hkv, _, dp = q4.shape
     page = k_pages.shape[2]
     pages_max = block_tables.shape[1]
-    kv_inv = _kv_inv_scale(k_pages.dtype)
+    per_page = kv_scales is not None
+    kv_inv = None if per_page else _kv_inv_scale(k_pages.dtype)
     if span == 1:
         kernel = functools.partial(_decode_kernel, page=page, scale=sc,
-                                   kv_inv=kv_inv)
+                                   kv_inv=kv_inv, per_page=per_page)
     else:
         kernel = functools.partial(_decode_span_kernel, page=page,
-                                   scale=sc, span=span, kv_inv=kv_inv)
+                                   scale=sc, span=span, kv_inv=kv_inv,
+                                   per_page=per_page)
+    if per_page:
+        # third scalar-prefetch operand: the pool's INVERSE per-
+        # (head,page) scales — one SMEM f32 per (h, pool page), read by
+        # the kernel through the same bt[b, j] indirection the page
+        # tiles prefetch with (the scale travels WITH its page)
+        inv = (1.0 / jnp.asarray(kv_scales, _F32))
+        npf = 3
+        ins = (seq_lens, block_tables, inv, q4, k_pages, v_pages)
+        q_map = lambda b, h, j, lens, bt, inv: (b, h, 0, 0)
+        kv_map = lambda b, h, j, lens, bt, inv: (h, bt[b, j], 0, 0)
+    else:
+        npf = 2
+        ins = (seq_lens, block_tables, q4, k_pages, v_pages)
+        q_map = lambda b, h, j, lens, bt: (b, h, 0, 0)
+        # the paged dataflow: page j of slot b is whichever pool page
+        # the block table names — fetched while step j-1 computes
+        # (scalar-prefetch index map)
+        kv_map = lambda b, h, j, lens, bt: (h, bt[b, j], 0, 0)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=npf,
         grid=(B, hkv, pages_max),
         in_specs=[
-            pl.BlockSpec((1, 1, gp, dp),
-                         lambda b, h, j, lens, bt: (b, h, 0, 0)),
-            # the paged dataflow: page j of slot b is whichever pool
-            # page the block table names — fetched while step j-1
-            # computes (scalar-prefetch index map)
-            pl.BlockSpec((1, 1, page, dp),
-                         lambda b, h, j, lens, bt: (h, bt[b, j], 0, 0)),
-            pl.BlockSpec((1, 1, page, dp),
-                         lambda b, h, j, lens, bt: (h, bt[b, j], 0, 0)),
+            pl.BlockSpec((1, 1, gp, dp), q_map),
+            pl.BlockSpec((1, 1, page, dp), kv_map),
+            pl.BlockSpec((1, 1, page, dp), kv_map),
         ],
-        out_specs=pl.BlockSpec((1, 1, gp, dp),
-                               lambda b, h, j, lens, bt: (b, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, gp, dp), q_map),
         scratch_shapes=[
             pltpu.VMEM((gp, dp), _F32),     # acc
             pltpu.VMEM((gp, 128), _F32),    # running max (lane-replicated)
@@ -1721,7 +1792,7 @@ def _flash_decode_paged(q4, k_pages, v_pages, block_tables, seq_lens,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret_params() or False,
-    )(seq_lens, block_tables, q4, k_pages, v_pages)
+    )(*ins)
 
 
 def _gather_pages(pages, block_tables):
@@ -1736,12 +1807,14 @@ def _gather_pages(pages, block_tables):
 
 
 def _decode_reference(q, k_pages, v_pages, block_tables, seq_lens,
-                      sc: float, span: int = 1):
+                      sc: float, span: int = 1, kv_scales=None):
     """Unpaged lax decode reference — the honest fallback (same math:
     gather the page chains, one dense masked softmax per slot). With
     ``span > 1``, ``q`` is (B, span, H, d) and row j's causal horizon is
     ``seq_lens - span + 1 + j`` (the multi-query kernel's per-row mask);
-    quantized pools dequantize on the gathered chains."""
+    quantized pools dequantize on the gathered chains (per-(head,page)
+    when ``kv_scales`` carries the paged codec's scales: dequant BEFORE
+    the gather so each page divides its own scale out)."""
     if span == 1:
         B, H, d = q.shape
         q = q[:, None]
@@ -1749,8 +1822,10 @@ def _decode_reference(q, k_pages, v_pages, block_tables, seq_lens,
         B, _, H, d = q.shape
     hkv = k_pages.shape[0]
     g = H // hkv
-    k = dequantize_kv(_gather_pages(k_pages, block_tables))  # (B,hkv,S,d)
-    v = dequantize_kv(_gather_pages(v_pages, block_tables))
+    k = _gather_pages(dequantize_kv(k_pages, scales=kv_scales),
+                      block_tables)                          # (B,hkv,S,d)
+    v = _gather_pages(dequantize_kv(v_pages, scales=kv_scales),
+                      block_tables)
     qg = q.reshape(B, span, hkv, g, d).astype(_F32)
     s = jnp.einsum("bjhgd,bhsd->bjhgs", qg, k) * sc
     row_len = (seq_lens[:, None] - span + 1
@@ -1770,7 +1845,8 @@ def _decode_reference(q, k_pages, v_pages, block_tables, seq_lens,
 
 def flash_decode(q, k_pages, v_pages, block_tables, seq_lens,
                  scale: Optional[float] = None,
-                 decode_mode: Optional[str] = None):
+                 decode_mode: Optional[str] = None,
+                 kv_scales=None):
     """Single-query attention over a paged KV cache — one decode step.
 
     ``q``: (B, H, d) — the current token's query per slot; ``k_pages``/
@@ -1791,7 +1867,13 @@ def flash_decode(q, k_pages, v_pages, block_tables, seq_lens,
     reference runs over the gathered chains, with the decline COUNTED
     per reason (``accl_flash_decode_fallback_total``).  Cache growth
     never recompiles: every shape is static in (pages, page), only
-    ``seq_lens``/``block_tables`` values change step to step."""
+    ``seq_lens``/``block_tables`` values change step to step.
+
+    ``kv_scales`` (optional (H_kv, n_pages) f32 from
+    :func:`quantize_kv_paged`) switches int8 pools to the per-
+    (head,page) codec: the kernel dequants each page with its own
+    inverse scale (prefetched beside the block table), the reference
+    path divides per page before the gather."""
     B, H, d = q.shape
     if k_pages.shape != v_pages.shape or k_pages.ndim != 4 \
             or k_pages.shape[3] != d:
@@ -1805,12 +1887,13 @@ def flash_decode(q, k_pages, v_pages, block_tables, seq_lens,
         raise ValueError(
             f"block_tables {block_tables.shape} / seq_lens "
             f"{seq_lens.shape} must lead with the slot dim B={B}")
+    _check_kv_scales(kv_scales, k_pages)
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
     mode = _resolve_decode(decode_mode)
     if mode != "paged":
         _count_decode_fallback("mode")
         return _decode_reference(q, k_pages, v_pages, block_tables,
-                                 seq_lens, sc)
+                                 seq_lens, sc, kv_scales=kv_scales)
     page = k_pages.shape[2]
     plan, reason = decode_plan(B, H, hkv, d, page,
                                block_tables.shape[1], q.dtype.itemsize,
@@ -1818,7 +1901,7 @@ def flash_decode(q, k_pages, v_pages, block_tables, seq_lens,
     if plan is None:
         _count_decode_fallback(reason)
         return _decode_reference(q, k_pages, v_pages, block_tables,
-                                 seq_lens, sc)
+                                 seq_lens, sc, kv_scales=kv_scales)
     g = H // hkv
     gp = plan["gp"]
     q4 = q.reshape(B, hkv, g, d)
@@ -1826,13 +1909,32 @@ def flash_decode(q, k_pages, v_pages, block_tables, seq_lens,
         q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
     lens = seq_lens.astype(jnp.int32)
     bt = block_tables.astype(jnp.int32)
-    out = _flash_decode_paged(q4, k_pages, v_pages, bt, lens, sc, gp)
+    out = _flash_decode_paged(q4, k_pages, v_pages, bt, lens, sc, gp,
+                              kv_scales=kv_scales)
     return out[:, :, :g, :].reshape(B, H, d)
+
+
+def _check_kv_scales(kv_scales, k_pages) -> None:
+    """Per-(head,page) scales are an int8-pool codec only, one scale per
+    (kv head, pool page) — anything else is a caller slip the kernel
+    could only misread."""
+    if kv_scales is None:
+        return
+    if jnp.dtype(k_pages.dtype) != jnp.int8:
+        raise ValueError(
+            f"kv_scales given but the pool dtype is {k_pages.dtype} — "
+            f"the per-(head,page) codec is int8-at-rest only")
+    want = (k_pages.shape[0], k_pages.shape[1])
+    if tuple(kv_scales.shape) != want:
+        raise ValueError(
+            f"kv_scales shape {tuple(kv_scales.shape)} != (H_kv, n_pages) "
+            f"{want}")
 
 
 def flash_decode_multi(q, k_pages, v_pages, block_tables, seq_lens,
                        scale: Optional[float] = None,
-                       decode_mode: Optional[str] = None):
+                       decode_mode: Optional[str] = None,
+                       kv_scales=None):
     """Speculative / batched multi-token attention over the paged cache:
     ``q`` is (B, k, H, d) — k > 1 query rows per slot in ONE launch, row
     j the slot's token at position ``seq_lens[b] - k + j`` (``seq_lens``
@@ -1854,7 +1956,8 @@ def flash_decode_multi(q, k_pages, v_pages, block_tables, seq_lens,
     if span == 1:
         return flash_decode(q[:, 0], k_pages, v_pages, block_tables,
                             seq_lens, scale=scale,
-                            decode_mode=decode_mode)[:, None]
+                            decode_mode=decode_mode,
+                            kv_scales=kv_scales)[:, None]
     if k_pages.shape != v_pages.shape or k_pages.ndim != 4 \
             or k_pages.shape[3] != d:
         raise ValueError(
@@ -1867,12 +1970,14 @@ def flash_decode_multi(q, k_pages, v_pages, block_tables, seq_lens,
         raise ValueError(
             f"block_tables {block_tables.shape} / seq_lens "
             f"{seq_lens.shape} must lead with the slot dim B={B}")
+    _check_kv_scales(kv_scales, k_pages)
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
     mode = _resolve_decode(decode_mode)
     if mode != "paged":
         _count_decode_fallback("mode")
         return _decode_reference(q, k_pages, v_pages, block_tables,
-                                 seq_lens, sc, span=span)
+                                 seq_lens, sc, span=span,
+                                 kv_scales=kv_scales)
     page = k_pages.shape[2]
     plan, reason = decode_plan(B, H, hkv, d, page,
                                block_tables.shape[1], q.dtype.itemsize,
@@ -1881,7 +1986,8 @@ def flash_decode_multi(q, k_pages, v_pages, block_tables, seq_lens,
     if plan is None:
         _count_decode_fallback(reason)
         return _decode_reference(q, k_pages, v_pages, block_tables,
-                                 seq_lens, sc, span=span)
+                                 seq_lens, sc, span=span,
+                                 kv_scales=kv_scales)
     g = H // hkv
     gp = plan["gp"]
     # row layout (g, span) row-major per kv head — the kernel's r%span
@@ -1893,7 +1999,7 @@ def flash_decode_multi(q, k_pages, v_pages, block_tables, seq_lens,
     lens = seq_lens.astype(jnp.int32)
     bt = block_tables.astype(jnp.int32)
     out = _flash_decode_paged(q4, k_pages, v_pages, bt, lens, sc, gp,
-                              span=span)
+                              span=span, kv_scales=kv_scales)
     out = out[:, :, :g * span, :].reshape(B, hkv, g, span, d)
     return out.transpose(0, 3, 1, 2, 4).reshape(B, span, H, d)
 
@@ -2043,6 +2149,50 @@ def kv_cache_read_rows(k_pages, v_pages, block_tables, seq_lens,
     saved_k = jnp.moveaxis(k_pages[:, pidx, off, :], 0, 2)  # (B,span,hkv,d)
     saved_v = jnp.moveaxis(v_pages[:, pidx, off, :], 0, 2)
     return saved_k, saved_v
+
+
+def kv_cache_extract_pages(k_pages, v_pages, block_tables, slot: int,
+                           used: int):
+    """Read the first ``used`` pages of ``slot``'s chain out of the
+    pools — the disaggregated handoff's SEND side: whole page rows in
+    the POOL's at-rest dtype (no dequant round-trip, so an int8 session
+    ships 1-byte elements and the install is bit-exact by
+    construction).  ``slot``/``used`` are host ints (the serving tier
+    is host-driven; ``used = ceil(seq_len / page)`` is host-known at
+    handoff time).  Returns ``(k_rows, v_rows)``, each
+    (H_kv, used, page, d)."""
+    if not 0 < used <= block_tables.shape[1]:
+        raise ValueError(
+            f"used pages {used} out of range 1..{block_tables.shape[1]}")
+    row = jnp.asarray(block_tables)[slot, :used].astype(jnp.int32)
+    return jnp.take(k_pages, row, axis=1), jnp.take(v_pages, row, axis=1)
+
+
+def kv_cache_install_pages(k_pages, v_pages, block_tables, slot: int,
+                           k_rows, v_rows):
+    """Write received page rows into ``slot``'s chain — the handoff's
+    RECV side: the first ``k_rows.shape[1]`` pages the block-table row
+    names take the wire bytes VERBATIM (dtype must match the pool — a
+    codec mismatch is the router's decline, never a silent cast that
+    would break the bit-exactness contract).  Returns ``(k_pages',
+    v_pages')``; the caller advances ``seq_lens[slot]``/``active`` (the
+    block-table rewrite lives in the serving tier, which picked the
+    target row).  Rows past the session's live length within the tail
+    page carry the SENDER's bytes — unreachable either way, same as
+    prefill-in-place leaves the receiver's old bytes unreachable."""
+    if k_rows.dtype != k_pages.dtype or v_rows.dtype != v_pages.dtype:
+        raise ValueError(
+            f"install dtype {k_rows.dtype}/{v_rows.dtype} != pool "
+            f"{k_pages.dtype}/{v_pages.dtype}: the handoff ships at-rest "
+            f"bytes — route a codec mismatch, don't cast it")
+    used = k_rows.shape[1]
+    if not 0 < used <= block_tables.shape[1]:
+        raise ValueError(
+            f"install of {used} pages out of range "
+            f"1..{block_tables.shape[1]}")
+    row = jnp.asarray(block_tables)[slot, :used].astype(jnp.int32)
+    return (k_pages.at[:, row].set(k_rows),
+            v_pages.at[:, row].set(v_rows))
 
 
 def prefill_plan(H: int, H_kv: int, d: int, page: int, pages_max: int,

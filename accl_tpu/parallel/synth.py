@@ -626,6 +626,16 @@ def _gen_pipeline(op, topo: Topology, N: int, model: CostModel,
                 ("pipeline_chunks", int(chunks))))
 
 
+def in_latency_tier(nbytes: int, cfg: ACCLConfig) -> bool:
+    """Whether a payload of ``nbytes`` resolves through the latency
+    tier — THE membership test, shared by :func:`resolve`'s plan keying
+    and the serving tier's control-message sizing (a disaggregation
+    handoff header must ride the eager fast path, and asserting it
+    through this helper keeps the two layers from drifting on what
+    "sub-threshold" means)."""
+    return nbytes < cfg.latency_tier_threshold
+
+
 def _latency_plan(op: operation, topo: Topology, nbytes: int,
                   cfg: ACCLConfig) -> SchedulePlan:
     """The α-dominated small-message regime ("Optimizing Communication
@@ -1029,7 +1039,7 @@ def resolve(op: operation, nbytes: int, comm, cfg: ACCLConfig,
     # <=16KiB bin), so the tier membership must be part of the key — a
     # sub-threshold payload must never be served the legacy plan its
     # above-threshold bucket-mate cached (and vice versa)
-    in_latency_tier = nbytes < cfg.latency_tier_threshold
+    in_tier = in_latency_tier(nbytes, cfg)
     # DCN with the wire register SET only: the operand itemsize prices
     # the wire ratio (a f64 payload compresses 4:1 where f32 does 2:1)
     # and an inert wire closes the two-tier window — both cut inside a
@@ -1043,7 +1053,7 @@ def resolve(op: operation, nbytes: int, comm, cfg: ACCLConfig,
         wire_key = ((nbytes // count) if count else 4, bool(wire_inert))
     else:
         wire_key = None
-    key = (op, topo, _metrics.size_bucket(nbytes), in_latency_tier,
+    key = (op, topo, _metrics.size_bucket(nbytes), in_tier,
            legacy, seeds, _cost_fingerprint(cfg), wire_key,
            _session_epoch)
     global _plan_hits, _plan_misses, _plan_evictions
@@ -1134,7 +1144,7 @@ def resolve(op: operation, nbytes: int, comm, cfg: ACCLConfig,
         plan = dataclasses.replace(
             _full_authority_plan(op, topo, nbytes, cfg),
             source="full_authority")
-    elif in_latency_tier and not _seed_overridden(op, cfg):
+    elif in_tier and not _seed_overridden(op, cfg):
         # the small-message latency tier: α dominates, so the cost model
         # searches the latency family (flat/tree/xla) on ANY topology —
         # single-axis meshes included (the one place synthesis deviates

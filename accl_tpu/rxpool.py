@@ -99,6 +99,40 @@ class RxBufPool:
             _metrics.inc("accl_rx_pool_exhausted_total")
         return slot
 
+    def reserve_batch(self, src: int, dst: int, tag: int, seq0: int,
+                      counts) -> Optional[List[int]]:
+        """All-or-nothing claim of ``len(counts)`` slots for a page
+        batch — the disaggregated KV handoff's eager page sends: one
+        free-slot precheck, then per-slot claims at CONSECUTIVE seqns
+        (``seq0 + i`` — the posts that follow consume them in order).
+        Returns the slot list, or None when the pool cannot hold the
+        whole batch — with any claimed prefix rolled back, so a partial
+        batch never strands slots (the all-or-nothing discipline of the
+        multi-segment eager path, one accounting op instead of N
+        prechecks).  Outcomes counted:
+        ``accl_rx_pool_batch_total{outcome="reserved"|"exhausted"}``."""
+        n = len(counts)
+        if n == 0 or self.free_slots < n:
+            if _metrics.ENABLED:
+                _metrics.inc("accl_rx_pool_batch_total",
+                             labels=(("outcome", "exhausted"),))
+            return None
+        slots: List[int] = []
+        for i, c in enumerate(counts):
+            s = self.reserve(src, dst, tag, seq0 + i, c)
+            if s < 0:
+                for claimed in slots:
+                    self.release(claimed)
+                if _metrics.ENABLED:
+                    _metrics.inc("accl_rx_pool_batch_total",
+                                 labels=(("outcome", "exhausted"),))
+                return None
+            slots.append(s)
+        if _metrics.ENABLED:
+            _metrics.inc("accl_rx_pool_batch_total",
+                         labels=(("outcome", "reserved"),))
+        return slots
+
     def mark_reserved(self, slot: int) -> bool:
         if self._native is not None:
             return self._native.mark_reserved(slot)

@@ -88,6 +88,9 @@ KNOWN_LANES = (
     # admission path, speculative multi-token decode (tokens-accepted/s)
     # and the at-rest KV quantization bytes/latency A/B
     "prefill_chunk", "decode_spec", "kv_quant",
+    # this round (disaggregated serving): decode p99 with a concurrent
+    # long prefill, colocated vs disaggregated, plus the KV handoff µs
+    "serve_disagg",
 )
 
 
@@ -520,6 +523,12 @@ def main(argv=None) -> int:
                       else _lanes.bench_kv_quant(
                           B=2, H=4, hkv=2, page=32, pages_max=2,
                           rounds=2))),
+            # this round: the disaggregated-serving A/B — builds its
+            # own 3-endpoint fleet on the session's devices
+            ("serve_disagg",
+             lambda: (_lanes.bench_serve_disagg() if on_tpu
+                      else _lanes.bench_serve_disagg(
+                          prefill_len=32, rounds=2))),
         ):
             if not _lane_selected(lanes_filter, name):
                 continue
@@ -572,6 +581,9 @@ def main(argv=None) -> int:
                 ("prefill_chunk", lanes.bench_prefill_chunk),
                 ("decode_spec", lanes.bench_decode_spec),
                 ("kv_quant", lanes.bench_kv_quant),
+                # this round: disaggregated serving — decode p99 under
+                # a concurrent long prefill, plus the handoff itself
+                ("serve_disagg", lanes.bench_serve_disagg),
                 ("cmdlist_chain_combine",
                  lambda: lanes.bench_cmdlist_chain(acc)),
                 ("small_op_fused_latency",

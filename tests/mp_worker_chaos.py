@@ -374,12 +374,165 @@ def shrink() -> int:
     return 0
 
 
+def serve() -> int:
+    """Disaggregated-serving failure drill (3 controllers, 1 device
+    each): rank 0 is the router + prefill worker, ranks 1-2 are decode
+    replicas.  Two sessions prefill on rank 0 and hand off over the
+    REAL cross-process wire (single-message framing — the deterministic
+    cross-process handoff); each replica's decode is proven bit-exact
+    against a local prefill-in-place mirror (the handoff contract).
+    Then rank 2 dies mid-session: the survivors latch PEER_FAILED
+    within the heartbeat bound, ``recover()`` shrinks the session to
+    {0, 1}, and the router half re-prefills the LOST session from its
+    retained prompt and hands it off to the survivor — whose next ticks
+    stay bit-exact against a mirror that never saw a failure.  The
+    round-15 recovery machinery composed with the serving tier."""
+    import accl_tpu.multiproc as mp
+    from accl_tpu.models import decode as dmod
+    from accl_tpu.models import serving as smod
+
+    me = jax.process_index()
+    # lenient staleness window for the compile-heavy handoff phase:
+    # heartbeats only refresh on fabric progress, and the replicas spend
+    # many seconds inside jit compiles with no ACCL calls — a tight
+    # window would false-positive them dead before rank 2 even "dies".
+    # The window is TIGHTENED to 2.5 s around the actual death drill.
+    cfg = accl_tpu.ACCLConfig(timeout=60.0, heartbeat_interval_s=0.2,
+                              heartbeat_timeout_s=30.0)
+    acc = accl_tpu.ACCL(config=cfg)
+    W = acc.world_size
+    assert W == 3, "serve scenario is a 3-controller, 1-device/proc script"
+    DEAD = 2
+    DONE_KEY = "accl/chaos_serve/done"
+
+    # every controller derives the SAME params/prompts/tick inputs
+    d_model, H, hkv, hd, page, pmax, slots = 16, 2, 1, 8, 8, 2, 2
+    params = dmod.init_decode_params(jax.random.PRNGKey(0), d_model, H,
+                                     hkv, hd)
+    rngp = np.random.default_rng(11)
+    prompts = {sid: rngp.standard_normal((5, d_model))
+               .astype(np.float32) * 0.1 for sid in (1, 2)}
+    rngx = np.random.default_rng(13)
+    xs = [rngx.standard_normal((slots, d_model)).astype(np.float32) * 0.1
+          for _ in range(4)]
+    local = jax.local_devices()
+
+    if me == 0:
+        # ---- router + prefill worker: prefill both, hand off ----------
+        w = smod.PrefillWorker("pw", 0, params, slots, pmax, page, hkv,
+                               hd, chunk=4, devices=local)
+        for sid, dst in ((1, 1), (2, 2)):
+            slot = w.free_slots()[0]
+            w.prefill(slot, prompts[sid])
+            smod.send_session(acc, w.state, slot, sid, src=0, dst=dst,
+                              tag=100 + 10 * sid, page_batch=False)
+            w.state = dmod.retire(w.state, slot)
+        snapc = metrics.snapshot()["counters"]
+        shipped = sum(v for k, v in snapc.items()
+                      if k.startswith("accl_serving_handoff_bytes_total"))
+        assert shipped > 0, "handoff bytes not counted"
+        print(f"[p{me}] handed off 2 sessions ({shipped:.0f}B)",
+              flush=True)
+    elif me in (1, 2):
+        # ---- decode replica: land the session, decode 2 ticks ---------
+        rep = smod.DecodeReplica(f"dr{me}", me, params, slots, pmax,
+                                 page, hkv, hd, devices=local)
+        sid = me
+        rep.state, got_sid, length = smod.recv_session(
+            acc, rep.state, 0, src=0, dst=me, tag=100 + 10 * sid)
+        assert (got_sid, length) == (sid, 5), (got_sid, length)
+        # prefill-in-place mirror: the bit-exactness oracle
+        mir = smod.PrefillWorker("mir", me, params, slots, pmax, page,
+                                 hkv, hd, chunk=4, devices=local)
+        mir.prefill(0, prompts[sid])
+        mstep = dmod.build_decode_step(mir._mesh)
+        for x in xs[:2]:
+            y = rep.decode_tick(x)
+            my, mir.state = mstep(mir.params, mir.state,
+                                  np.asarray(x))
+            assert np.array_equal(y[0], np.asarray(my)[0]), \
+                "handoff decode diverged from prefill-in-place"
+        print(f"[p{me}] SERVE-HANDOFF-OK", flush=True)
+
+    acc.barrier()
+    # every replica compiled and synced: arm the FAST liveness bound for
+    # the death drill (the lease verdict must land well inside 20 s)
+    acc._fabric.heartbeat_timeout = 2.5
+    t0 = time.monotonic()
+    nb = 16
+    rb = acc.create_buffer(nb, dataType.float32)
+
+    if me == DEAD:
+        # die mid-session — the replica's sessions are LOST
+        fault.install(FaultPlan([FaultSpec("rank.death", kind="die")]))
+        try:
+            acc.recv(rb, nb, src=0, dst=DEAD, tag=5)
+            raise AssertionError("injected rank death did not fire")
+        except RankDeath:
+            pass
+        fault.clear()
+        print(f"[p{me}] decode replica dead mid-session", flush=True)
+        mp._client().blocking_key_value_get(DONE_KEY, 300_000)
+        print(f"[p{me}] CHAOS-SERVE-DEAD-OK", flush=True)
+        return 0
+
+    # ---- survivors: PEER_FAILED surfaces to the router ----------------
+    deadline = time.monotonic() + 20.0
+    while DEAD not in acc._fabric.dead_peers:
+        acc._pump()
+        acc._fabric.check_peers()
+        assert time.monotonic() < deadline, "death never detected"
+        time.sleep(0.05)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 20.0, f"death detection took {elapsed:.1f}s"
+    print(f"[p{me}] PEER_FAILED({DEAD}) in {elapsed:.1f}s", flush=True)
+
+    epoch = acc.recover()
+    assert epoch == 1 and acc.world_size == 2, (epoch, acc.world_size)
+    print(f"[p{me}] shrunk to {{0, 1}} epoch {epoch}", flush=True)
+    # the re-route phase compiles asymmetrically (rank 0 builds a fresh
+    # prefill worker while rank 1 waits in recv): loosen the window back
+    acc._fabric.heartbeat_timeout = 30.0
+
+    # ---- re-route: the lost session re-prefills onto the survivor -----
+    if me == 0:
+        w2 = smod.PrefillWorker("pw", 0, params, slots, pmax, page, hkv,
+                                hd, chunk=4, devices=local)
+        slot = w2.free_slots()[0]
+        w2.prefill(slot, prompts[2])       # the RETAINED prompt replays
+        smod.send_session(acc, w2.state, slot, 2, src=0, dst=1, tag=300,
+                          page_batch=False)
+        print(f"[p{me}] re-prefilled lost session 2 -> survivor",
+              flush=True)
+    else:
+        dst_slot = rep.free_slots()[0]
+        rep.state, got_sid, _ = smod.recv_session(
+            acc, rep.state, dst_slot, src=0, dst=1, tag=300)
+        assert got_sid == 2
+        # mirror the re-route as prefill-in-place; ticks stay bit-exact
+        mir.prefill(dst_slot, prompts[2])
+        for x in xs[2:]:
+            y = rep.decode_tick(x)
+            my, mir.state = mstep(mir.params, mir.state, np.asarray(x))
+            assert np.array_equal(y, np.asarray(my)), \
+                "post-recovery decode diverged"
+        print(f"[p{me}] survivor decodes both sessions bit-exact",
+              flush=True)
+    acc.barrier()
+    if me == 0:
+        mp._client().key_value_set(DONE_KEY, "1")
+    print(f"[p{me}] CHAOS-SERVE-OK", flush=True)
+    return 0
+
+
 def main() -> int:
     scenario = os.environ.get("ACCL_CHAOS", "transient")
     if scenario == "death":
         return death()
     if scenario == "shrink":
         return shrink()
+    if scenario == "serve":
+        return serve()
     return transient()
 
 

@@ -982,6 +982,44 @@ def test_kv_quant_lane_schema():
     assert r["quant_scale"] == 32.0
 
 
+def test_serve_disagg_lane_schema():
+    """Disaggregated-serving lane: the decode row follows the latency
+    protocol (direction=lower, headline zeroed off-silicon) and carries
+    the colocated A/B; the handoff row's resolved gates on the
+    bit-exactness fact (the kv_quant pattern) with the engaged framing
+    on record."""
+    from accl_tpu.bench import lanes
+
+    rows = lanes.bench_serve_disagg(prefill_len=32, rounds=2)
+    by = {r["metric"]: r for r in rows}
+    d = by["serve_disagg_decode"]
+    assert d["unit"] == "us" and d["direction"] == "lower"
+    assert d["timing_engaged"] is False       # no TPU backend here
+    assert d["resolved"] is False and d["value"] == 0.0
+    assert d["p50_us"] > 0 and d["colo_p50_us"] > 0
+    assert d["p99_colo_over_disagg"] > 0
+    assert d["tokens_per_s"] > 0 and d["kv_cache_dtype"] == "int8"
+    h = by["serve_disagg_handoff"]
+    assert h["unit"] == "us" and h["direction"] == "lower"
+    assert h["bit_exact"] is True and h["resolved"] is True
+    assert h["value"] == h["p50_us"] > 0
+    assert h["page_batch_engaged"] is True
+    assert h["handoff_bytes"] > 0 and h["used_pages"] == 1
+    assert h["timing_engaged"] is False
+
+
+def test_serve_disagg_lane_needs_three_devices(monkeypatch):
+    """Fleet honesty: on a rig with fewer than 3 devices the lane emits
+    skipped stubs instead of half-running the A/B."""
+    from accl_tpu.bench import lanes
+
+    monkeypatch.setattr(lanes.jax, "devices", lambda *a, **k: [object()])
+    rows = lanes.bench_serve_disagg()
+    assert all(r["skipped"] and not r["resolved"] for r in rows)
+    assert {r["metric"] for r in rows} == {"serve_disagg_decode",
+                                           "serve_disagg_handoff"}
+
+
 def test_serving_lanes_in_known_lanes_and_compare():
     """bench.py --lanes accepts the round-18 lanes, and compare.py
     applies the right polarity to each: prefill_chunk inverts
@@ -989,7 +1027,8 @@ def test_serving_lanes_in_known_lanes_and_compare():
     from bench import KNOWN_LANES
     from accl_tpu.bench import compare
 
-    for name in ("prefill_chunk", "decode_spec", "kv_quant"):
+    for name in ("prefill_chunk", "decode_spec", "kv_quant",
+                 "serve_disagg"):
         assert name in KNOWN_LANES
 
     def art(pre, spec, quant):

@@ -832,3 +832,22 @@ def test_chaos_kill_one_of_four_survivor_subset():
     assert res.returncode == 0, f"launcher rc={res.returncode}"
     assert res.stdout.count("CHAOS-SHRINK-OK") == 3
     assert res.stdout.count("CHAOS-SHRINK-DEAD-OK") == 1
+
+
+def test_chaos_serving_replica_death_reroutes_sessions():
+    """Disaggregated-serving acceptance: a decode replica killed
+    mid-session surfaces PEER_FAILED to the router half, which — after
+    the round-15 shrink — re-prefills the lost session from its
+    retained prompt and hands it off to the surviving replica over the
+    real cross-process wire; the survivor's decode stays bit-exact
+    against a prefill-in-place mirror that never saw a failure."""
+    res = _run_launcher(
+        ["-np", "3", "--devices-per-proc", "1",
+         os.path.join("tests", "mp_worker_chaos.py")],
+        extra_env={"ACCL_CHAOS": "serve"})
+    sys.stdout.write(res.stdout)
+    sys.stderr.write(res.stderr)
+    assert res.returncode == 0, f"launcher rc={res.returncode}"
+    assert res.stdout.count("SERVE-HANDOFF-OK") == 2
+    assert res.stdout.count("CHAOS-SERVE-OK") == 2
+    assert res.stdout.count("CHAOS-SERVE-DEAD-OK") == 1

@@ -1,0 +1,535 @@
+"""Disaggregated prefill/decode serving: the KV handoff wire protocol,
+the admission/routing front end, and slot migration under load.
+
+Five layers:
+
+* **handoff layer** — prefill-on-A -> eager page handoff -> decode-on-B
+  is bit-identical (tokens AND pool state) to prefill+decode on one
+  replica, at EVERY at-rest KV codec (off/bf16/bf16_sr/int8); the wire
+  format's guards (magic, codec pinning) fail loudly; int8 sessions
+  ship 2x fewer bytes than bf16 (counted, not claimed);
+* **scales layer** — the per-(head,page) int8 scales travel beside the
+  pages and land in the receiver's scale arrays at ITS page rows
+  (dequantized content identical across the transfer), and the
+  per-page codec beats the fixed global scale on outlier-heavy data
+  (the accuracy A/B);
+* **router layer** — least-loaded admission, free-slot/codec/liveness
+  routing with every decline COUNTED and raised
+  (``accl_serving_router_declines_total{reason}``), migration and
+  drain riding the same page-send machinery mid-decode (including
+  mid-speculation: the rollback snapshot is state, so a post-verify
+  migration lands it), occupancy gauges;
+* **failure layer** — a dead decode replica's sessions re-prefill from
+  their retained prompts onto a survivor, token streams unbroken
+  (the in-process half of the ``ACCL_CHAOS=serve`` scenario);
+* **fan-out layer** — ``publish_tokens_batch`` packs N sessions into
+  ONE eager message per destination: match counts and delivered bytes
+  regression-pinned against the per-session loop.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accl_tpu.models import decode as dm
+from accl_tpu.models import serving as sv
+from accl_tpu.obs import metrics
+from accl_tpu.ops import flash
+
+CODECS = ("off", "bf16", "bf16_sr", "int8")
+
+D_MODEL, H, HKV, HD, PAGE, PMAX, SLOTS = 64, 8, 4, 128, 8, 4, 4
+
+
+def _counter(key: str) -> float:
+    return metrics.snapshot()["counters"].get(key, 0.0)
+
+
+def _params():
+    return dm.init_decode_params(jax.random.PRNGKey(0), D_MODEL, H,
+                                 HKV, HD)
+
+
+def _fleet(accl, params, kv_dtype, n_replicas=2, slots=SLOTS,
+           ranks=(0, 1, 2, 3)):
+    mode = None if kv_dtype == "off" else kv_dtype
+    w = sv.PrefillWorker("pw0", ranks[0], params, slots, PMAX, PAGE,
+                         HKV, HD, kv_dtype=mode, chunk=4)
+    reps = [sv.DecodeReplica(f"dr{i}", ranks[1 + i], params, slots,
+                             PMAX, PAGE, HKV, HD, kv_dtype=mode)
+            for i in range(n_replicas)]
+    return w, reps, sv.ServingRouter(accl, [w], reps)
+
+
+def _oracle(params, kv_dtype, prompt, slot, slots=SLOTS):
+    """Colocated baseline: the same prompt prefilled IN PLACE on one
+    replica (same slot index the handoff lands in)."""
+    mode = None if kv_dtype == "off" else kv_dtype
+    ow = sv.PrefillWorker("ow", 7, params, slots, PMAX, PAGE, HKV, HD,
+                          kv_dtype=mode, chunk=4)
+    orc = sv.DecodeReplica("orc", 7, params, slots, PMAX, PAGE, HKV,
+                           HD, kv_dtype=mode)
+    ow.prefill(slot, prompt)
+    orc.state = ow.state
+    return orc
+
+
+# ---------------------------------------------------------------------------
+# handoff layer
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kv_dtype", CODECS)
+def test_handoff_bit_exact_per_codec(accl, rng, kv_dtype):
+    """THE acceptance pin: prefill-on-A -> handoff -> decode-on-B is
+    bit-identical — tokens and pool state — to prefill+decode on one
+    replica, at every at-rest codec."""
+    params = _params()
+    _, _, router = _fleet(accl, params, kv_dtype)
+    L = 11
+    prompt = rng.standard_normal((L, D_MODEL)).astype(np.float32) * 0.1
+    sess = router.admit(1, prompt)
+    dst = router.handoff(1)
+    orc = _oracle(params, kv_dtype, prompt, sess.slot)
+
+    kA, vA, lenA = dm.extract_session(dst.state, sess.slot)
+    kB, vB, lenB = dm.extract_session(orc.state, sess.slot)
+    assert lenA == lenB == L
+    np.testing.assert_array_equal(np.asarray(kA), np.asarray(kB))
+    np.testing.assert_array_equal(np.asarray(vA), np.asarray(vB))
+
+    for _ in range(3):
+        x = rng.standard_normal((SLOTS, D_MODEL)).astype(np.float32) * 0.1
+        np.testing.assert_array_equal(
+            dst.decode_tick(x)[sess.slot],
+            orc.decode_tick(x)[sess.slot])
+
+
+def test_handoff_wire_guards(accl, rng):
+    """The wire format fails loudly: a wrong magic raises, a codec
+    mismatch at install raises (never casts), and an oversized control
+    header is rejected before it demotes off the latency tier."""
+    from accl_tpu.constants import dataType
+
+    params = _params()
+    w, reps, router = _fleet(accl, params, "int8")
+    prompt = rng.standard_normal((6, D_MODEL)).astype(np.float32) * 0.1
+    sess = router.admit(1, prompt)
+
+    # wrong magic on the header tag
+    bogus = accl.create_buffer(sv.HEADER_WORDS, dataType.int32)
+    bogus.host[0] = np.arange(sv.HEADER_WORDS, dtype=np.int32)
+    accl.send(bogus, sv.HEADER_WORDS, src=0, dst=1, tag=9900)
+    with pytest.raises(ValueError, match="magic"):
+        sv.recv_session(accl, reps[0].state, 0, src=0, dst=1, tag=9900)
+
+    # codec pinning: int8 pages into an f32 pool must raise, not cast
+    f32_rep = sv.DecodeReplica("f32", 3, params, SLOTS, PMAX, PAGE,
+                               HKV, HD, kv_dtype=None)
+    ticket = sv.send_session(accl, w.state, sess.slot, 1, src=0, dst=3,
+                             tag=9904)
+    with pytest.raises(ValueError, match="codec"):
+        sv.recv_session(accl, f32_rep.state, 0, src=0, dst=3, tag=9904,
+                        ticket=ticket)
+    # drain the declined transfer's parked page payload — an abandoned
+    # eager message would poison the (0, 3) channel for later tests
+    n_msgs = 2 * ticket.used if ticket.page_batch else 1
+    per = (ticket.page_elems if ticket.page_batch
+           else 2 * ticket.used * ticket.page_elems)
+    for _ in range(n_msgs):
+        junk = accl.create_buffer(per, dataType.int8)
+        accl.recv(junk, per, src=0, dst=3, tag=9905)
+
+
+def test_handoff_int8_ships_half_the_bytes_of_bf16(accl, rng):
+    """Pages travel in the pool's at-rest dtype: the SAME session costs
+    2x fewer wire bytes at int8 than at bf16 — counted into
+    ``accl_serving_handoff_bytes_total{dtype}``, not claimed."""
+    params = _params()
+    prompt = rng.standard_normal((9, D_MODEL)).astype(np.float32) * 0.1
+    shipped = {}
+    for kv_dtype in ("bf16", "int8"):
+        key = ("accl_serving_handoff_bytes_total"
+               f'{{dtype="{ "bfloat16" if kv_dtype == "bf16" else "int8"}"}}')
+        before = _counter(key)
+        _, _, router = _fleet(accl, params, kv_dtype)
+        router.admit(1, prompt)
+        router.handoff(1)
+        shipped[kv_dtype] = _counter(key) - before
+    assert shipped["bf16"] == 2 * shipped["int8"] > 0
+
+
+def test_handoff_uses_page_batch_and_times_dispatch(accl, rng):
+    """The fast path engages: a local handoff rides ONE all-or-nothing
+    rx-pool batch reservation (outcome=reserved counted) and lands in
+    the µs dispatch histogram under path=handoff."""
+    params = _params()
+    res_key = 'accl_rx_pool_batch_total{outcome="reserved"}'
+    hist_key = 'accl_latency_dispatch_seconds{path="handoff"}'
+    res0 = _counter(res_key)
+    h0 = metrics.snapshot()["histograms"].get(hist_key, {}).get("count", 0)
+    _, _, router = _fleet(accl, params, "int8")
+    prompt = rng.standard_normal((9, D_MODEL)).astype(np.float32) * 0.1
+    router.admit(1, prompt)
+    router.handoff(1)
+    assert _counter(res_key) == res0 + 1
+    h1 = metrics.snapshot()["histograms"][hist_key]["count"]
+    assert h1 == h0 + 1
+
+
+# ---------------------------------------------------------------------------
+# scales layer
+# ---------------------------------------------------------------------------
+
+def test_per_page_scales_travel_with_the_pages(accl, rng):
+    """The per-(head,page) scales ship beside the block table: after a
+    handoff the receiver's scale arrays hold the sender's values at the
+    RECEIVER's page rows, and the dequantized pool content is identical
+    across the transfer."""
+    params = _params()
+    w, reps, _ = _fleet(accl, params, "int8")
+    n_pages = SLOTS * PMAX
+    src = jnp.asarray(rng.standard_normal((HKV, n_pages, PAGE, HD))
+                      .astype(np.float32) * 0.1)
+    kq, ks = flash.quantize_kv_paged(src, mode="int8")
+    vq, vs = flash.quantize_kv_paged(src * 0.5, mode="int8")
+    slot, L = 1, 13
+    used = -(-L // PAGE)
+    krows = jnp.take(kq, jnp.asarray(
+        np.asarray(w.state.block_tables)[slot, :used]), axis=1)
+    vrows = jnp.take(vq, jnp.asarray(
+        np.asarray(w.state.block_tables)[slot, :used]), axis=1)
+    w.state = dm.install_session(w.state, slot, krows, vrows, L)
+    w.kv_scales = (np.asarray(ks), np.asarray(vs))
+
+    rep = reps[0]
+    rep.kv_scales = (np.ones((HKV, n_pages), np.float32),
+                     np.ones((HKV, n_pages), np.float32))
+    dst_slot = 2
+    ticket = sv.send_session(accl, w.state, slot, 1, src=w.rank,
+                             dst=rep.rank, tag=9908,
+                             kv_scales=w.kv_scales)
+    assert ticket.n_scale_words == 2 * HKV * used
+    rep.state, _, _ = sv.recv_session(
+        accl, rep.state, dst_slot, src=w.rank, dst=rep.rank, tag=9908,
+        ticket=ticket, kv_scales=rep.kv_scales)
+
+    src_row = np.asarray(w.state.block_tables)[slot, :used]
+    dst_row = np.asarray(rep.state.block_tables)[dst_slot, :used]
+    np.testing.assert_array_equal(rep.kv_scales[0][:, dst_row],
+                                  w.kv_scales[0][:, src_row])
+    np.testing.assert_array_equal(rep.kv_scales[1][:, dst_row],
+                                  w.kv_scales[1][:, src_row])
+    # dequantized content identical across the transfer
+    deq_src = np.asarray(flash.dequantize_kv(
+        jnp.take(w.state.k_pages, jnp.asarray(src_row), axis=1),
+        scales=jnp.asarray(w.kv_scales[0][:, src_row])))
+    deq_dst = np.asarray(flash.dequantize_kv(
+        jnp.take(rep.state.k_pages, jnp.asarray(dst_row), axis=1),
+        scales=jnp.asarray(rep.kv_scales[0][:, dst_row])))
+    np.testing.assert_array_equal(deq_src, deq_dst)
+
+
+def test_per_page_scales_beat_fixed_scale(rng):
+    """The accuracy A/B the satellite names: on outlier-heavy content
+    the per-(head,page) codec's decode output lands closer to the f32
+    reference than the fixed global scale."""
+    B, pages_max, page = 4, 2, 32
+    n_pages = B * pages_max
+    x = rng.standard_normal((HKV, n_pages, page, HD)) * 0.1
+    x[:, ::3] *= 8.0                       # per-page dynamic range
+    kv = jnp.asarray(x.astype(np.float32))
+    bt = jnp.arange(n_pages, dtype=jnp.int32).reshape(B, pages_max)
+    lens = jnp.full((B,), pages_max * page, jnp.int32)
+    q = jnp.asarray(rng.standard_normal((B, H, HD))
+                    .astype(np.float32) * 0.1)
+
+    ref = np.asarray(flash.flash_decode(q, kv, kv, bt, lens), np.float64)
+    g = flash.quantize_kv(kv, jnp.int8, mode="int8")
+    err_global = np.abs(np.asarray(
+        flash.flash_decode(q, g, g, bt, lens), np.float64) - ref).max()
+    pq, scales = flash.quantize_kv_paged(kv, mode="int8")
+    err_paged = np.abs(np.asarray(
+        flash.flash_decode(q, pq, pq, bt, lens, kv_scales=scales),
+        np.float64) - ref).max()
+    assert err_paged < err_global
+
+
+# ---------------------------------------------------------------------------
+# router layer
+# ---------------------------------------------------------------------------
+
+def test_router_least_loaded_admission(accl, rng):
+    params = _params()
+    mode = "int8"
+    w0 = sv.PrefillWorker("pwA", 0, params, SLOTS, PMAX, PAGE, HKV, HD,
+                          kv_dtype=mode, chunk=4)
+    w1 = sv.PrefillWorker("pwB", 1, params, SLOTS, PMAX, PAGE, HKV, HD,
+                          kv_dtype=mode, chunk=4)
+    rep = sv.DecodeReplica("dr", 2, params, SLOTS, PMAX, PAGE, HKV, HD,
+                           kv_dtype=mode)
+    router = sv.ServingRouter(accl, [w0, w1], [rep])
+    p = rng.standard_normal((5, D_MODEL)).astype(np.float32) * 0.1
+    s0 = router.admit(1, p)
+    s1 = router.admit(2, p)    # pwA holds a live slot now -> pwB wins
+    assert {s0.worker, s1.worker} == {"pwA", "pwB"}
+
+
+def test_router_declines_counted_and_raised(accl, rng):
+    """Decline honesty: no free slots, dead replica and codec mismatch
+    are each COUNTED by reason and raised — never silently absorbed."""
+    params = _params()
+    p = rng.standard_normal((5, D_MODEL)).astype(np.float32) * 0.1
+
+    def declines():
+        snap = metrics.snapshot()["counters"]
+        return {r: snap.get(
+            f'accl_serving_router_declines_total{{reason="{r}"}}', 0.0)
+            for r in ("no_free_slots", "dead_replica", "codec_mismatch")}
+
+    before = declines()
+    _, reps, router = _fleet(accl, params, "int8", n_replicas=1,
+                             slots=2)
+    for sid in (1, 2):
+        router.admit(sid, p)
+        router.handoff(sid)
+    router.admit(3, p)
+    with pytest.raises(sv.RoutingDeclined) as ei:
+        router.handoff(3)
+    assert "no_free_slots" in ei.value.reasons
+
+    reps[0].alive = False
+    with pytest.raises(sv.RoutingDeclined) as ei:
+        router.handoff(3, replica="dr0")
+    assert ei.value.reasons == ["dead_replica"]
+
+    # codec mismatch: int8 prefill against a bf16-only fleet
+    mism = sv.DecodeReplica("bf", 3, params, SLOTS, PMAX, PAGE, HKV,
+                            HD, kv_dtype="bf16")
+    router.replicas["bf"] = mism
+    with pytest.raises(sv.RoutingDeclined) as ei:
+        router.handoff(3, replica="bf")
+    assert ei.value.reasons == ["codec_mismatch"]
+
+    after = declines()
+    for r in ("no_free_slots", "dead_replica", "codec_mismatch"):
+        assert after[r] > before[r], r
+
+
+def test_migration_mid_decode_bit_exact(accl, rng):
+    """Cross-replica slot migration mid-decode: same page-send
+    machinery, decode continues bit-identically on the new replica."""
+    params = _params()
+    _, _, router = _fleet(accl, params, "int8")
+    prompt = rng.standard_normal((9, D_MODEL)).astype(np.float32) * 0.1
+    router.admit(5, prompt)
+    dst = router.handoff(5)
+    sess = router.sessions[5]
+    orc = _oracle(params, "int8", prompt, sess.slot)
+
+    xs = [rng.standard_normal((SLOTS, D_MODEL)).astype(np.float32) * 0.1
+          for _ in range(4)]
+    np.testing.assert_array_equal(dst.decode_tick(xs[0])[sess.slot],
+                                  orc.decode_tick(xs[0])[sess.slot])
+    old_slot = sess.slot
+    new_r = router.migrate(5)
+    assert new_r.name != dst.name
+    for x in xs[1:]:
+        np.testing.assert_array_equal(new_r.decode_tick(x)[sess.slot],
+                                      orc.decode_tick(x)[old_slot])
+    hist = metrics.snapshot()["histograms"]
+    assert hist['accl_latency_dispatch_seconds{path="migrate"}'][
+        "count"] >= 1
+
+
+def test_mid_spec_migration_lands_rollback(accl, rng):
+    """Mid-speculation migration: a spec step with REJECTED tokens runs
+    on replica A (its in-step rollback restores the page bytes), the
+    session migrates, and decoding on B stays bit-identical to the
+    never-migrated oracle — the rollback snapshot is state, so the
+    handoff carries it like any other page bytes."""
+    k = 3
+    params = _params()
+    _, reps, router = _fleet(accl, params, "int8")
+    prompt = rng.standard_normal((9, D_MODEL)).astype(np.float32) * 0.1
+    router.admit(5, prompt)
+    dst = router.handoff(5)
+    sess = router.sessions[5]
+    orc = _oracle(params, "int8", prompt, sess.slot)
+
+    xs = jnp.asarray(rng.standard_normal((SLOTS, k, D_MODEL))
+                     .astype(np.float32) * 0.1)
+    draft_ok = np.ones((SLOTS, k), bool)
+    draft_ok[:, 1:] = False               # reject after the first token
+    ya = dst.spec_tick(xs, draft_ok)
+    yb = orc.spec_tick(xs, draft_ok)
+    np.testing.assert_array_equal(ya[sess.slot], yb[sess.slot])
+
+    old_slot = sess.slot
+    new_r = router.migrate(5)
+    for _ in range(3):
+        x = rng.standard_normal((SLOTS, D_MODEL)).astype(np.float32) * 0.1
+        np.testing.assert_array_equal(new_r.decode_tick(x)[sess.slot],
+                                      orc.decode_tick(x)[old_slot])
+
+
+def test_drain_and_gauges(accl, rng):
+    """Drain empties a replica through migrations; the occupancy gauge
+    tracks every transition."""
+    params = _params()
+    _, reps, router = _fleet(accl, params, "int8")
+    p = rng.standard_normal((5, D_MODEL)).astype(np.float32) * 0.1
+    for sid in (1, 2):
+        router.admit(sid, p)
+        router.handoff(sid, replica="dr0")
+    moved = router.drain("dr0")
+    assert sorted(moved) == [1, 2]
+    assert all(s.replica == "dr1" for s in router.sessions.values())
+    g = metrics.snapshot()["gauges"]
+    assert g['accl_serving_sessions{replica="dr0",phase="decode"}'] == 0.0
+    assert g['accl_serving_sessions{replica="dr1",phase="decode"}'] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# failure layer
+# ---------------------------------------------------------------------------
+
+def test_peer_failed_reroutes_sessions(accl, rng):
+    """The round-15 composition, in-process half: a PEER_FAILED verdict
+    for a decode replica re-prefills its sessions from their retained
+    prompts onto a survivor; the token stream continues bit-identically
+    to a run that never lost the replica."""
+    params = _params()
+    _, reps, router = _fleet(accl, params, "int8")
+    prompts = {sid: rng.standard_normal((7, D_MODEL))
+               .astype(np.float32) * 0.1 for sid in (1, 2)}
+    for sid in (1, 2):
+        router.admit(sid, prompts[sid])
+        router.handoff(sid, replica="dr0")
+
+    moved = router.note_peer_failed(reps[0].rank)
+    assert sorted(moved) == [1, 2]
+    assert not reps[0].alive
+    # ONE tick advances every surviving session; compare each slot
+    # against its own never-failed oracle
+    x = rng.standard_normal((SLOTS, D_MODEL)).astype(np.float32) * 0.1
+    y = reps[1].decode_tick(x)
+    for sid in (1, 2):
+        sess = router.sessions[sid]
+        assert sess.replica == "dr1"
+        orc = _oracle(params, "int8", prompts[sid], sess.slot)
+        np.testing.assert_array_equal(y[sess.slot],
+                                      orc.decode_tick(x)[sess.slot])
+
+
+# ---------------------------------------------------------------------------
+# fan-out layer
+# ---------------------------------------------------------------------------
+
+def test_publish_tokens_batch_matches_and_bytes(accl):
+    """The batched fan-out regression: identical delivered content, ONE
+    eager message per destination instead of one per session — match
+    counts and delivered bytes pinned against the per-session loop."""
+    sessions = {3: np.array([10, 11, 12], np.int32),
+                7: np.array([99], np.int32),
+                9: np.array([5, 6], np.int32)}
+    world = accl.global_comm().world_size
+    n_dsts = world - 1
+
+    flat = dm.pack_token_records(sessions)
+    back = dm.unpack_token_records(flat)
+    assert set(back) == set(sessions)
+    for sid in sessions:
+        np.testing.assert_array_equal(back[sid], sessions[sid])
+
+    eager_key = 'accl_sendrecv_protocol_total{protocol="eager"}'
+    match_key = 'accl_match_events_total{event="recv_matched"}'
+
+    e0, m0 = _counter(eager_key), _counter(match_key)
+    out = dm.publish_tokens_batch(accl, sessions, src=0, tag=42)
+    e1, m1 = _counter(eager_key), _counter(match_key)
+    assert len(out) == n_dsts
+    for d in out:
+        assert set(d) == set(sessions)
+        for sid in sessions:
+            np.testing.assert_array_equal(d[sid], sessions[sid])
+    batch_sends = e1 - e0
+    assert batch_sends == n_dsts                  # ONE per (src, dst)
+    assert m1 - m0 == n_dsts
+
+    # the per-session loop pays n_sessions messages per destination
+    e0 = _counter(eager_key)
+    for sid, toks in sessions.items():
+        dm.publish_tokens(accl, toks, src=0, tag=50 + sid)
+    loop_sends = _counter(eager_key) - e0
+    assert loop_sends == len(sessions) * n_dsts == 3 * batch_sends
+    # wire bytes: the batch ships each record stream once per dst
+    assert flat.nbytes * n_dsts == batch_sends * flat.nbytes
+
+
+def test_send_page_batch_counters_and_fallback(accl, rng):
+    """The page-batch eager send: one all-or-nothing reservation on the
+    happy path (outcome=batched), counted fallback to the plain send
+    when a chunk outgrows the eager geometry — and the rx pool drains
+    back to fully free either way."""
+    from accl_tpu.constants import dataType
+
+    pool = accl.matcher(accl.global_comm()).rx_pool
+    free0 = pool.free_slots
+    n, count = 4, 64
+    payload = rng.standard_normal((n * count,)).astype(np.float32)
+    sbuf = accl.create_buffer(n * count, dataType.float32)
+    sbuf.host[0] = payload
+    b0 = _counter('accl_sendrecv_page_batch_total{outcome="batched"}')
+    accl.send_page_batch(sbuf, [count] * n, src=0, dst=1, tag=9930)
+    assert _counter(
+        'accl_sendrecv_page_batch_total{outcome="batched"}') == b0 + 1
+    got = []
+    for _ in range(n):
+        rb = accl.create_buffer(count, dataType.float32)
+        accl.recv(rb, count, src=0, dst=1, tag=9930)
+        got.append(np.asarray(rb.host[1]))
+    np.testing.assert_array_equal(np.concatenate(got), payload)
+    assert pool.free_slots == free0
+
+    # a chunk bigger than the eager rx buffer: counted fallback
+    big = accl.config.eager_rx_buffer_size // 4 + 1
+    f0 = _counter('accl_sendrecv_page_batch_total{outcome="fallback"}')
+    sb = accl.create_buffer(big, dataType.float32)
+    sb.host[0] = rng.standard_normal((big,)).astype(np.float32)
+    accl.send_page_batch(sb, [big], src=0, dst=1, tag=9931)
+    assert _counter(
+        'accl_sendrecv_page_batch_total{outcome="fallback"}') == f0 + 1
+    rb = accl.create_buffer(big, dataType.float32)
+    accl.recv(rb, big, src=0, dst=1, tag=9931)
+    np.testing.assert_array_equal(np.asarray(rb.host[1]),
+                                  np.asarray(sb.host[0]))
+    assert pool.free_slots == free0
+
+
+def test_extract_install_roundtrip_and_codec_guard(rng):
+    """The handoff's pool entry points: extract -> install round-trips
+    bit-exactly through a fresh state, and a dtype mismatch at install
+    raises (the in-kernel half of the codec pinning)."""
+    state = dm.init_decode_state(SLOTS, PMAX, PAGE, HKV, HD,
+                                 kv_dtype="int8")
+    pool = jnp.asarray(rng.integers(-127, 128,
+                                    (HKV, SLOTS * PMAX, PAGE, HD),
+                                    dtype=np.int8))
+    state = state._replace(k_pages=pool, v_pages=pool)
+    L = 2 * PAGE - 3
+    state = state._replace(seq_lens=state.seq_lens.at[1].set(L),
+                           active=state.active.at[1].set(True))
+    k, v, length = dm.extract_session(state, 1)
+    assert length == L and k.shape[1] == dm.used_pages(state, 1) == 2
+    fresh = dm.init_decode_state(SLOTS, PMAX, PAGE, HKV, HD,
+                                 kv_dtype="int8")
+    fresh = dm.install_session(fresh, 3, k, v, length)
+    k2, v2, l2 = dm.extract_session(fresh, 3)
+    assert l2 == L
+    np.testing.assert_array_equal(np.asarray(k), np.asarray(k2))
+    np.testing.assert_array_equal(np.asarray(v), np.asarray(v2))
+
+    f32 = dm.init_decode_state(SLOTS, PMAX, PAGE, HKV, HD)
+    with pytest.raises(ValueError, match="dtype"):
+        dm.install_session(f32, 0, k, v, length)

@@ -66,6 +66,12 @@ if [[ $t1_rc -ne 0 ]]; then
         echo "[ci_gate]   JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q" >&2
         echo "[ci_gate]   and A/B the kernels with: python bench.py --lanes prefill_chunk,decode_spec,kv_quant" >&2
     fi
+    if grep -qaE "test_serving_disagg|handoff|ServingRouter|send_page_batch|install_session|publish_tokens_batch" /tmp/_t1.log; then
+        echo "[ci_gate] hint: disaggregated-serving failure — isolate the tier with:" >&2
+        echo "[ci_gate]   JAX_PLATFORMS=cpu python -m pytest tests/test_serving_disagg.py -q" >&2
+        echo "[ci_gate]   and A/B the topology with: python bench.py --lanes serve_disagg" >&2
+        echo "[ci_gate]   (handoff bit-exactness is per KV codec — check which kv_cache_dtype row broke)" >&2
+    fi
     if grep -qaE "nblock|a2a_wgrad|dw_overlap|attn_fused|fsdp_attn" /tmp/_t1.log; then
         echo "[ci_gate] hint: round-20 fusion failure — A/B the n-blocked plans and" >&2
         echo "[ci_gate]   the fused MoE dw with: python bench.py --lanes cmatmul_nblock,moe_a2a_dw" >&2
