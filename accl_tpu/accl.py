@@ -27,7 +27,11 @@ import numpy as np
 
 from . import constants
 from .arithconfig import DEFAULT_ARITH_CONFIG, ArithConfig
+from .obs import cluster as _cluster
+from .obs import correlate as _correlate
+from .obs import flight as _flight
 from .obs import metrics as _metrics
+from .obs import recal as _recal
 from .obs import trace as _trace
 from .buffer import BaseBuffer, Buffer, BufferSlice, DummyBuffer
 from .communicator import Communicator
@@ -131,6 +135,10 @@ class ACCL:
         # exists — construction applies the bound itself then)
         if hasattr(self, "_programs"):
             self._programs.set_maxsize(cfg.program_cache_size)
+        # the online-recalibration arm follows the config the same way:
+        # arming installs the metrics-side sample hook, disarming removes
+        # it (default-off keeps the timed hot path at one None read)
+        _recal.set_enabled(cfg.sched_online_recal)
         # resilience registers write through to the live fabric (the
         # flash_bwd pattern): the retry/backoff policy and the heartbeat
         # lease cadence/staleness window follow every config assignment
@@ -192,6 +200,14 @@ class ACCL:
         # even where the rest of the key collides (docs/resilience.md §5)
         self._epoch = 0
         _synth.set_session_epoch(0)
+        # correlation ids (obs/correlate): armed by $ACCL_CORRELATE.
+        # Every controller of a launch shares the environment, so the
+        # wire-framing change (the optional eager header key / serving
+        # control words) is symmetric across the mesh by construction.
+        if _correlate.env_armed():
+            _correlate.enable()
+        _correlate.set_epoch(0)
+        _correlate.set_proc(jax.process_index())
         if self.config.transport is None:
             from .utils.bringup import detect_backend
 
@@ -313,6 +329,10 @@ class ACCL:
         """Drain outstanding work and drop state (``ACCL::deinit``, accl.cpp:71-89)."""
         self._queue.cancel_externals()
         self._queue.drain(timeout=self.config.timeout)
+        if _flight.had_fatal():
+            # fatal teardown: the session saw a death/invalidation
+            # verdict — preserve the protocol history before state drops
+            _flight.dump("teardown")
         self._programs.clear()
         self._matchers.clear()
         self.comms.clear()
@@ -419,6 +439,12 @@ class ACCL:
         self._epoch += 1
         _synth.set_session_epoch(self._epoch)
         _metrics.inc("accl_recover_total", labels=(("mode", mode),))
+        # the recovery itself is a flight-dump trigger: the dump holds
+        # the death verdict / invalidation events that led here
+        _flight.record("recover", mode=mode, fabric_epoch=epoch,
+                       session_epoch=self._epoch,
+                       dead_procs=sorted(dead_procs))
+        _flight.dump("recover")
         log.info("recovered: session epoch %d (%s)", epoch, mode)
         return epoch
 
@@ -470,9 +496,15 @@ class ACCL:
                     f"process(es) {sorted(dead_procs)}; re-create the "
                     f"group from the shrunk global communicator")
                 _metrics.inc("accl_comm_invalidated_total")
+                _flight.record("comm_invalidated",
+                               world_size=comm.world_size,
+                               dead_procs=sorted(dead_procs))
                 self._matchers.pop(id(comm), None)
             else:
                 keep.append(comm)
+        # one dump at verdict-creation time (the per-comm events above
+        # are in it); the recover() caller dumps again post-convergence
+        _flight.dump("comm_invalidated")
         self.comms = [new_global] + keep
         # the shrunk mesh IS the session's world now: scan(), world_size
         # and default-comm dispatch all follow it
@@ -1356,6 +1388,17 @@ class ACCL:
         delivered: list = []
 
         def deliver(shard, header) -> None:
+            c = header.get("c")
+            if c is not None:
+                # receiver-side correlation: the sender stamped
+                # (epoch, proc, seq) into the announce header, so this
+                # rank's span/flight event can NAME its sender instead
+                # of guessing from timing
+                _flight.record("recv_correlated", src=src, dst=dst,
+                               sender_epoch=c[0], sender_proc=c[1],
+                               sender_seq=c[2])
+                _trace.instant("xrecv.corr", cat="fabric",
+                               corr=f"{c[0]}.{c[1]}.{c[2]}")
             x = shard
             if arith is not None and arith.is_compressing:
                 from . import ops as _ops
@@ -2282,6 +2325,9 @@ class ACCL:
             }
         return {
             "schema": _metrics.SCHEMA_VERSION,
+            # explicit top-level alias (r18): downstream tooling keys on
+            # the unambiguous name; "schema" stays for old readers
+            "schema_version": _metrics.SCHEMA_VERSION,
             "hwid": self.parse_hwid(),
             # local recovery count — the epoch baked into program/plan
             # cache keys (the fabric's epoch is under "fabric" below)
@@ -2300,8 +2346,58 @@ class ACCL:
                           "fresh_depth": fresh, "retry_depth": retry},
             "comms": comms,
             "fabric": fabric,
+            "flight": _flight.stats(),
+            "cluster": _cluster.stats(),
             "metrics": _metrics.delta(self._metrics_baseline),
         }
+
+    def flight_dump(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the flight-recorder ring now (reason ``"manual"``).
+        With ``path`` the file lands exactly there; otherwise under
+        ``$ACCL_FLIGHT_DIR`` (None returned when neither names a
+        destination — the ring stays inspectable via ``stats()``)."""
+        return _flight.dump("manual", path=path)
+
+    def cluster_stats(self) -> dict:
+        """Merged cluster-wide metrics view (docs/observability.md):
+        every controller's last published snapshot folded into one —
+        counters summed, gauges maxed, histograms bucket-merged — with
+        per-rank publish lag and explicit ``stale_ranks`` /
+        ``missing_ranks`` verdicts. This controller's own snapshot is
+        taken fresh (never stale by its own cadence); peers are read
+        from the coordination KV where the fabric's progress loop
+        publishes them. Works degraded without a fabric: the merge is
+        then just this process."""
+        me = jax.process_index()
+        blobs: dict = {}
+        if self._fabric is not None:
+            procs = sorted({getattr(d, "process_index", 0)
+                            for d in self.comms[0].devices})
+            blobs = self._fabric.collect_obs(procs)
+        blobs[me] = _cluster.payload(me)
+        return _cluster.merge(blobs)
+
+    def recalibrate(self) -> dict:
+        """One online α/β recalibration pass (obs/recal): refit the
+        scheduler cost registers from the accumulated dispatch-latency
+        histograms and, when ``config.sched_online_recal`` is on AND
+        some tier drifted beyond ``recal.DRIFT_RATIO``, write the
+        fitted registers back through the config setter and bump the
+        synth plan-cache recal generation so every plan re-resolves at
+        the new prices. Sub-threshold or disarmed passes are advisory:
+        the fit is returned, nothing changes. Outcome counted
+        ``accl_recal_total{outcome}`` exactly once per call."""
+        result = _recal.maybe_recalibrate(self.config)
+        if result["outcome"] == "applied":
+            from .parallel import synth as _synth
+
+            self.config = self.config.replace(**result["registers"])
+            gen = _synth.bump_recal_generation()
+            result["recal_generation"] = gen
+            _flight.record("recal_applied", generation=gen,
+                           worst_drift=result.get("worst_drift"),
+                           registers=dict(result["registers"]))
+        return result
 
     def dump_state(self) -> str:
         progs, hits, misses = self._programs.stats()

@@ -1382,8 +1382,15 @@ def bench_obs_overhead(acc, count: int = 1 << 14, calls: int = 64,
     only code a no-obs build would not run). The guard cost over the
     measured dispatch latency is the precise "added host latency with
     telemetry disabled" figure the 1% budget is about; the enabled delta
-    prices the registry bumps for always-on deployments."""
+    prices the registry bumps for always-on deployments.
+
+    The flight-recorder arm (ISSUE r18) rides the same interleaved
+    discipline: dispatch latency with the flight ring disabled vs armed
+    (metrics enabled both sides — the arm isolates the ring append),
+    priced as its own delta so the always-on-recorder claim is a
+    measured number, not a design assertion."""
     from ..constants import dataType, operation, reduceFunction
+    from ..obs import flight as _fl
     from ..obs import metrics as _m
 
     a = acc.create_buffer(count, dataType.float32)
@@ -1399,19 +1406,32 @@ def bench_obs_overhead(acc, count: int = 1 << 14, calls: int = 64,
         return (time.perf_counter() - t0) / calls
 
     was = _m.ENABLED
+    fl_was = _fl.ENABLED
     try:
         per_call_s()   # compile + warm the cached program
         # interleave the accountings per round: back-to-back blocks read
         # machine drift (GC, clocks, co-tenants) as telemetry overhead
+        _fl.disable()
         dis, ena = [], []
         for _ in range(rounds):
             _m.disable()
             dis.append(per_call_s())
             _m.enable()
             ena.append(per_call_s())
+        # flight-recorder arm: metrics enabled on BOTH sides so the
+        # delta isolates the ring append (the recorder's only hot-path
+        # cost), same per-round interleaving
+        _m.enable()
+        fl_dis, fl_arm = [], []
+        for _ in range(rounds):
+            _fl.disable()
+            fl_dis.append(per_call_s())
+            _fl.enable()
+            fl_arm.append(per_call_s())
         # the disabled guard alone, in isolation: exactly the calls the
         # instrumented dispatch path makes per collective
         _m.disable()
+        _fl.disable()
         n = 20000
         nbytes = count * 4
         t0 = time.perf_counter()
@@ -1421,9 +1441,12 @@ def bench_obs_overhead(acc, count: int = 1 << 14, calls: int = 64,
         guard_s = (time.perf_counter() - t0) / n
     finally:
         (_m.enable if was else _m.disable)()
+        (_fl.enable if fl_was else _fl.disable)()
 
     d_med = float(np.median(dis))
     e_med = float(np.median(ena))
+    fd_med = float(np.median(fl_dis))
+    fa_med = float(np.median(fl_arm))
     return {
         "metric": "obs_overhead", "unit": "us", "bytes": count * 4,
         "calls": calls, "rounds": rounds,
@@ -1433,6 +1456,9 @@ def bench_obs_overhead(acc, count: int = 1 << 14, calls: int = 64,
         "disabled_guard_ns": round(guard_s * 1e9, 1),
         "disabled_guard_pct_of_dispatch": round(
             guard_s / d_med * 100, 4),
+        "flight_disabled_us": round(fd_med * 1e6, 2),
+        "flight_armed_us": round(fa_med * 1e6, 2),
+        "flight_delta_pct": round((fa_med - fd_med) / fd_med * 100, 2),
     }
 
 

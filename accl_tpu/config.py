@@ -414,6 +414,17 @@ class ACCLConfig:
     # explicit per-call algorithm= requests outrank the flag either
     # way. Counted under accl_sched_plan_total{source="full_authority"}.
     sched_full_authority: bool = False
+    # online α/β recalibration (obs/recal.py, the record → act loop):
+    # when True, every timed dispatch also accumulates into the
+    # per-(op, size-bucket, tier) latency histograms and
+    # ``ACCL.recalibrate()`` may ACT on a fitted drift > 3x — write the
+    # refitted sched_alpha_us/sched_beta_gbps (per tier) back and bump
+    # the synth plan-cache recal generation so every plan re-resolves
+    # at the new prices (counted accl_recal_total{applied}). Default
+    # OFF: no extra series are recorded and synth resolution stays
+    # byte-identical (the equivalence pins); recalibrate() then only
+    # reports advisory numbers. Write-through to obs.recal.set_enabled.
+    sched_online_recal: bool = False
 
     # compiled-program cache (parallel/compiler.py) LRU bound: a
     # long-lived serving session resolving many (shape, dtype, algo)

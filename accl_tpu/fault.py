@@ -43,6 +43,7 @@ import random
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from .obs import flight as _flight
 from .obs import metrics as _metrics
 
 #: THE module-level hot-path guard (the ``obs.metrics.ENABLED`` pattern):
@@ -225,6 +226,8 @@ def point(name: str, kinds: Optional[Tuple[str, ...]] = None) -> None:
         _fires[i] += 1
         _metrics.inc("accl_fault_injected_total",
                      labels=(("point", name), ("kind", spec.kind)))
+        _flight.record("fault_injected", point=name,
+                       fault_kind=spec.kind, hit=n)
         if spec.kind == "delay":
             time.sleep(spec.delay_ms / 1e3)
             continue
@@ -322,6 +325,7 @@ class RetryPolicy:
                     raise
                 _metrics.inc("accl_rpc_retry_total",
                              labels=(("point", point),))
+                _flight.record("retry", point=point, attempt=attempt)
                 sleep(self.interval(attempt, rng))
                 attempt += 1
 

@@ -58,6 +58,8 @@ import numpy as np
 
 from .. import constants
 from ..constants import dataType
+from ..obs import correlate as _correlate
+from ..obs import flight as _flight
 from . import decode
 
 __all__ = [
@@ -332,6 +334,12 @@ def send_session(acc, state, slot: int, sid: int, src: int, dst: int,
               _KIND_MIGRATE if kind == "migrate" else _KIND_HANDOFF,
               sid, length, used, codec_id(k_rows.dtype), page_elems,
               int(scale_words.size)]
+    if _correlate.ENABLED:
+        # correlation id: 3 extra int32 words (epoch, proc, seq). Both
+        # endpoints share the launch environment, so the receiver reads
+        # the widened header symmetrically; disabled framing is
+        # byte-identical to the 8-word wire.
+        header.extend(int(v) for v in _correlate.stamp())
     _send_control(acc, header, src, dst, tag, comm)
     total = 2 * used * page_elems
     pbuf = acc.create_buffer(total, pool_dt, comm=comm)
@@ -370,12 +378,20 @@ def recv_session(acc, state, slot: int, src: int, dst: int,
     framing; cross-process receivers omit it and use the deterministic
     single-message framing.  Returns ``(state', sid, length)`` —
     ``kv_scales`` is updated IN PLACE when given."""
-    hdr = _recv_control(acc, HEADER_WORDS, src, dst, tag, comm)
+    nwords = HEADER_WORDS + (3 if _correlate.ENABLED else 0)
+    hdr = _recv_control(acc, nwords, src, dst, tag, comm)
     comm = comm or acc.global_comm()
     if int(hdr[0]) != HANDOFF_MAGIC:
         raise ValueError(
             f"handoff header magic {hdr[0]:#x} != {HANDOFF_MAGIC:#x}")
     sid, length, used = int(hdr[2]), int(hdr[3]), int(hdr[4])
+    if len(hdr) > HEADER_WORDS:
+        # receiver-side correlation: the sender's (epoch, proc, seq)
+        # names this handoff's origin in the flight ring
+        _flight.record("handoff_correlated", sid=sid, src=src, dst=dst,
+                       sender_epoch=int(hdr[HEADER_WORDS]),
+                       sender_proc=int(hdr[HEADER_WORDS + 1]),
+                       sender_seq=int(hdr[HEADER_WORDS + 2]))
     page_elems, n_scale = int(hdr[6]), int(hdr[7])
     local_codec = codec_id(state.k_pages.dtype)
     if int(hdr[5]) != local_codec:
@@ -420,6 +436,7 @@ def _count_decline(reason: str) -> None:
     from ..obs import metrics
     metrics.inc("accl_serving_router_declines_total",
                 labels=(("reason", reason),))
+    _flight.record("router_decline", reason=reason)
 
 
 class ServingRouter:
@@ -495,6 +512,8 @@ class ServingRouter:
                        worker=worker.name, slot=slot,
                        length=prompt.shape[0])
         self.sessions[sid] = sess
+        _flight.record("router_admit", sid=sid, worker=worker.name,
+                       slot=slot, tokens=int(prompt.shape[0]))
         worker.pending_tokens += prompt.shape[0]
         try:
             worker.prefill(slot, prompt)
@@ -610,6 +629,8 @@ class ServingRouter:
 
         dst_slot = dst_r.free_slots()[0]
         tag = self._next_tag()
+        _flight.record(f"router_{kind}", sid=sess.sid,
+                       src=src_ep.name, dst=dst_r.name, slot=dst_slot)
         t0 = metrics.tick()
         ticket = send_session(
             self.acc, src_ep.state, sess.slot, sess.sid,

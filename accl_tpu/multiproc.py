@@ -79,6 +79,9 @@ from . import constants
 from . import fault as _fault
 from .constants import (ACCLError, ACCLPeerFailedError, ACCLTimeoutError,
                         errorCode)
+from .obs import cluster as _cluster
+from .obs import correlate as _correlate
+from .obs import flight as _flight
 from .obs import metrics as _metrics
 from .obs import trace as _trace
 
@@ -308,6 +311,15 @@ class CrossProcessFabric:
         # name -> (target count still owed, participant count) — consumed
         # by the next call, which must use the same participant set
         self._barrier_pending: Dict[str, Tuple[int, int]] = {}
+        # cluster metrics plane (obs/cluster.py): monotonic of the last
+        # snapshot publish — the heartbeat cadence discipline
+        self._obs_last = 0.0
+        # death-verdict sets already flight-dumped: raise_if_peer_failed
+        # fires on EVERY wait iteration once a verdict latches, and the
+        # black box must dump once per verdict, not once per poll
+        self._flight_dumped_deaths: set = set()
+        # correlation ids carry this process index when armed
+        _correlate.set_proc(self._me)
         # lease the session EAGERLY: a controller that dies before its
         # first wait loop ever runs must still be detectable — the lease
         # exists from bring-up, frozen the moment progress stops
@@ -698,6 +710,12 @@ class CrossProcessFabric:
             self._staged_segs[k] = self._staged_segs.get(k, 0) + credits
         header = {"tag": int(tag), "dt": str(payload.dtype),
                   "n": int(payload.shape[-1]), "k": kind, "g": int(nseg)}
+        if _correlate.ENABLED:
+            # sender-side correlation id (epoch, proc, seq) — a fresh
+            # sender-scoped seq, NOT the per-pair wire seq, so the id is
+            # unique across pairs. Key absent entirely when disarmed:
+            # the announce header stays byte-identical on the wire.
+            header["c"] = list(_correlate.stamp())
         # the header publish carries its own injection point: a dropped
         # announce is THE canonical eager-protocol fault (the header is
         # the message as far as the control plane knows) — absorbed by
@@ -1191,6 +1209,7 @@ class CrossProcessFabric:
             _fault.point("rank.death", kinds=("die", "delay"))
         client = _client()
         self._maybe_heartbeat(client)
+        self._maybe_publish_obs(client)
         progressed = False
         while True:
             v = self._try_get(client, f"{self.ns}/s/{self._cursor}")
@@ -1236,6 +1255,34 @@ class CrossProcessFabric:
         self._kset_force(client, f"{self.ns}/hb/{self._me}",
                          str(self._hb_count))
 
+    def _maybe_publish_obs(self, client) -> None:
+        """Publish this rank's metrics snapshot to the epoch namespace
+        at most once per ``cluster.PUBLISH_INTERVAL_S`` — the heartbeat
+        cadence discipline: progress-driven (a rank that stops pumping
+        goes stale, which the merge annotates), never blocking dispatch
+        (one rate-limit check per drive() on the common path). Counted
+        ``accl_cluster_snapshot_total{published}``."""
+        if not _metrics.ENABLED:
+            return
+        now = time.monotonic()
+        if now - self._obs_last < _cluster.PUBLISH_INTERVAL_S:
+            return
+        self._obs_last = now
+        self._kset_force(client,
+                         _cluster.KEY_FMT.format(ns=self.ns, proc=self._me),
+                         _cluster.payload(self._me))
+
+    def collect_obs(self, procs) -> Dict[int, Optional[str]]:
+        """Pull every rank's latest published snapshot blob from the
+        epoch namespace (None for a rank that has not published in this
+        epoch) — the read side ``ACCL.cluster_stats()`` merges."""
+        client = _client()
+        out: Dict[int, Optional[str]] = {}
+        for p in procs:
+            out[int(p)] = self._try_get(
+                client, _cluster.KEY_FMT.format(ns=self.ns, proc=p))
+        return out
+
     def check_peers(self, procs: Optional[list] = None) -> List[int]:
         """Poll peer heartbeat leases (rate-limited to one sweep per
         ``heartbeat_interval``); returns the known-dead process ids among
@@ -1276,6 +1323,12 @@ class CrossProcessFabric:
                     self._dead_peers.add(p)
                     _metrics.inc("accl_peer_death_total",
                                  labels=(("proc", str(p)),))
+                    # the verdict LATCH is the flight event — a survivor
+                    # that never blocks on the dead rank (so never takes
+                    # raise_if_peer_failed) still carries the death in
+                    # its ring when recover() dumps it
+                    _flight.record("peer_failed", what="lease_expired",
+                                   dead=[p], epoch=self.epoch)
         if not self._dead_peers:
             return []
         if procs is None:
@@ -1290,6 +1343,15 @@ class CrossProcessFabric:
         timeout. The no-death fast path costs one monotonic read."""
         dead = self.check_peers(procs)
         if dead:
+            # black-box the verdict ONCE per dead set per epoch (this
+            # raise fires on every wait iteration once a verdict is
+            # latched — the flight dump must not)
+            mark = (self.epoch, tuple(dead))
+            if mark not in self._flight_dumped_deaths:
+                self._flight_dumped_deaths.add(mark)
+                _flight.record("peer_failed", what=what, dead=list(dead),
+                               epoch=self.epoch)
+                _flight.dump("peer_failed")
             raise ACCLPeerFailedError(dead, what)
 
     @property
@@ -1355,6 +1417,11 @@ class CrossProcessFabric:
         # moment it arrives, not one progress-loop later
         self._maybe_heartbeat(_client())
         _metrics.inc("accl_session_epoch_total")
+        _flight.record("epoch_bump", epoch=self.epoch)
+        _correlate.set_epoch(self.epoch)
+        # fresh epoch namespace: re-publish the snapshot promptly so the
+        # cluster plane never goes dark across a recovery
+        self._obs_last = 0.0
         return self.epoch
 
     # -- barrier -----------------------------------------------------------
@@ -1433,3 +1500,8 @@ class CrossProcessFabric:
                     f"barrier {name!r}: {self._kcount(client, key)}/"
                     f"{target} arrivals within {self.timeout}s")
         del self._barrier_pending[key]
+        if name == "epoch":
+            # the epoch-entry handshake: every participant exits this
+            # round within one KV poll of each other, so its exit is the
+            # cross-rank clock anchor the trace --merge CLI aligns on
+            _trace.sync_mark(f"epoch{self.epoch}")

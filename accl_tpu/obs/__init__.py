@@ -14,6 +14,18 @@ introspection calls; the TPU re-expression's analog is this package
   device timeline inside an ``ACCL.profile()`` xprof capture.
 * ``ACCL.stats()`` (accl.py) — the firmware ``dump_*`` analog as one
   structured, JSON-serializable snapshot.
+* :mod:`accl_tpu.obs.flight` — the always-on bounded flight-recorder
+  ring, auto-dumped as schema-versioned JSON on the death paths
+  (PEER_FAILED, COMM_INVALIDATED, ``recover()``, fatal teardown).
+* :mod:`accl_tpu.obs.cluster` — per-rank snapshot publication to the
+  coordination KV (the heartbeat idiom) and the counters-sum /
+  histograms-bucket-merge / gauges-max fold behind
+  ``ACCL.cluster_stats()``.
+* :mod:`accl_tpu.obs.correlate` — the (epoch, proc, seq) correlation
+  ids the eager/serving wire headers stamp when armed (byte-identical
+  framing when off).
+* :mod:`accl_tpu.obs.recal` — online α/β refit from the accumulated
+  dispatch histograms, gated by ``ACCLConfig.sched_online_recal``.
 
 Both modules are guarded by ONE module-level flag each and allocate
 nothing on the hot path while disabled: a disabled call site costs a
@@ -28,6 +40,7 @@ import it without cycles.
 """
 from __future__ import annotations
 
-from . import metrics, trace
+from . import cluster, correlate, flight, metrics, recal, trace
 
-__all__ = ["metrics", "trace"]
+__all__ = ["cluster", "correlate", "flight", "metrics", "recal",
+           "trace"]

@@ -1,0 +1,135 @@
+"""Cluster metrics plane: per-rank snapshot publication + merge.
+
+Each rank publishes its :mod:`accl_tpu.obs.metrics` snapshot to the
+coordination KV under the **epoch namespace** on a progress-driven
+cadence (the heartbeat idiom: the fabric's progress loop calls
+:func:`payload` and writes the result — publication never blocks
+dispatch, and a rank that stops pumping simply goes stale).
+``ACCL.cluster_stats()`` pulls every rank's latest snapshot and
+:func:`merge` folds them into one cluster view:
+
+* **counters** sum across ranks (the cluster total);
+* **histograms** bucket-merge (per-edge counts, sum and count all add —
+  valid because every rank shares one bucket geometry per metric name);
+* **gauges** take the max (high-water semantics — the registry's only
+  gauge kind that merges meaningfully; a per-rank breakdown is in
+  ``per_rank``).
+
+Staleness is annotated per rank, never enforced: a snapshot older than
+``stale_after_s`` (on the merger's clock, against the publisher's
+embedded wall time) is still merged — its counters are real events —
+but the rank lands in ``stale_ranks`` so the reader knows the totals
+may lag. Counted ``accl_cluster_snapshot_total{published|merged|stale}``.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, Optional
+
+from . import metrics as _metrics
+
+#: KV subkey (under the fabric's epoch namespace) each rank publishes to
+KEY_FMT = "{ns}/obs/{proc}"
+
+#: default publish cadence in seconds (progress-driven: an idle rank
+#: publishes nothing — same contract as the heartbeat lease)
+PUBLISH_INTERVAL_S = 2.0
+
+#: a rank whose last publish is older than this many publish intervals
+#: is annotated stale in the merge
+STALE_INTERVALS = 3.0
+
+_last_publish_ts: Optional[float] = None
+_publishes = 0
+_last_merge_ts: Optional[float] = None
+_merges = 0
+_last_stale_ranks: list = []
+
+
+def payload(proc: int) -> str:
+    """The JSON blob one rank publishes: its snapshot plus the envelope
+    the merger needs (publisher id and wall time for staleness)."""
+    global _last_publish_ts, _publishes
+    _last_publish_ts = time.time()
+    _publishes += 1
+    _metrics.inc("accl_cluster_snapshot_total", 1.0,
+                 (("event", "published"),))
+    return json.dumps({"proc": int(proc), "wall": _last_publish_ts,
+                       "snapshot": _metrics.snapshot()})
+
+
+def _merge_hist(into: dict, h: dict) -> None:
+    for le, n in h.get("buckets", {}).items():
+        into["buckets"][le] = into["buckets"].get(le, 0) + n
+    into["sum"] += h.get("sum", 0.0)
+    into["count"] += h.get("count", 0)
+
+
+def merge(blobs: Dict[int, Optional[str]],
+          stale_after_s: float = PUBLISH_INTERVAL_S * STALE_INTERVALS,
+          now: Optional[float] = None) -> dict:
+    """Fold per-rank published blobs (proc -> JSON string or None for a
+    rank with nothing published yet) into the cluster view. Corrupt or
+    absent blobs are reported under ``missing_ranks``, never fatal."""
+    global _last_merge_ts, _merges, _last_stale_ranks
+    if now is None:
+        now = time.time()
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    hists: Dict[str, dict] = {}
+    per_rank: Dict[int, dict] = {}
+    stale, missing = [], []
+    for proc, blob in sorted(blobs.items()):
+        if blob is None:
+            missing.append(proc)
+            continue
+        try:
+            doc = json.loads(blob)
+            snap = doc["snapshot"]
+            wall = float(doc["wall"])
+        except (ValueError, KeyError, TypeError):
+            missing.append(proc)
+            continue
+        lag = now - wall
+        if lag > stale_after_s:
+            stale.append(proc)
+            _metrics.inc("accl_cluster_snapshot_total", 1.0,
+                         (("event", "stale"),))
+        per_rank[proc] = {"wall": wall, "lag_s": lag,
+                          "schema": snap.get("schema")}
+        for k, v in snap.get("counters", {}).items():
+            counters[k] = counters.get(k, 0.0) + v
+        for k, v in snap.get("gauges", {}).items():
+            gauges[k] = max(gauges.get(k, float("-inf")), v)
+        for k, h in snap.get("histograms", {}).items():
+            into = hists.setdefault(
+                k, {"buckets": {}, "sum": 0.0, "count": 0})
+            _merge_hist(into, h)
+        _metrics.inc("accl_cluster_snapshot_total", 1.0,
+                     (("event", "merged"),))
+    _last_merge_ts = now
+    _merges += 1
+    _last_stale_ranks = stale
+    return {
+        "schema": _metrics.SCHEMA_VERSION,
+        "ranks_merged": len(per_rank),
+        "stale_ranks": stale,
+        "missing_ranks": missing,
+        "per_rank": per_rank,
+        "counters": counters,
+        "gauges": gauges,
+        "histograms": hists,
+    }
+
+
+def stats() -> dict:
+    """The ``ACCL.stats()["cluster"]`` section."""
+    return {
+        "publishes": _publishes,
+        "last_publish_ts": _last_publish_ts,
+        "merges": _merges,
+        "last_merge_ts": _last_merge_ts,
+        "stale_ranks": list(_last_stale_ranks),
+        "publish_interval_s": PUBLISH_INTERVAL_S,
+    }

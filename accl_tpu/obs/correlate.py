@@ -1,0 +1,85 @@
+"""Cross-rank correlation ids for the wire paths.
+
+A correlation id is the compact triple ``(epoch, proc, seq)`` — the
+session epoch the sender dispatched under, the sender's process index,
+and a sender-scoped monotonic sequence number. Cross-process eager
+announces and serving control headers stamp it when armed, so the
+receiver's flight events and trace spans can name their sender instead
+of guessing from tags.
+
+Disabled by default and **byte-identical on the wire when disabled**:
+the eager announce header carries no extra key and the serving control
+message keeps its exact pre-change word count (the acceptance pin).
+Arm with :func:`enable` (what ``ACCL.initialize`` does when
+``$ACCL_CORRELATE`` is set) — both ends of a session share the launch
+environment, so enablement is symmetric by construction.
+
+The module is deliberately tiny state: the epoch/proc are written
+through by the session machinery (``ACCL.initialize`` / ``recover()``
+own the epoch; the fabric owns the proc index), and :func:`next_seq`
+is the only mutation on a send path.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+from typing import Optional, Tuple
+
+#: hot-path guard (the obs.metrics pattern): one boolean read per
+#: disabled stamp site. Default off — correlation changes wire bytes.
+ENABLED = False
+
+#: env var that arms correlation at session bring-up (symmetric across
+#: a launcher's ranks by construction)
+CORRELATE_ENV = "ACCL_CORRELATE"
+
+_epoch = 0
+_proc = 0
+_counter = itertools.count(1)
+_lock = threading.Lock()
+
+
+def enable() -> None:
+    global ENABLED
+    ENABLED = True
+
+
+def disable() -> None:
+    global ENABLED
+    ENABLED = False
+
+
+def enabled() -> bool:
+    return ENABLED
+
+
+def env_armed() -> bool:
+    """True when $ACCL_CORRELATE is set to a truthy value."""
+    return os.environ.get(CORRELATE_ENV, "") not in ("", "0", "false")
+
+
+def set_epoch(epoch: int) -> None:
+    global _epoch
+    _epoch = int(epoch)
+
+
+def set_proc(proc: int) -> None:
+    global _proc
+    _proc = int(proc)
+
+
+def next_seq() -> int:
+    with _lock:
+        return next(_counter)
+
+
+def stamp(seq: Optional[int] = None) -> Optional[Tuple[int, int, int]]:
+    """The sender-side id for one wire message: ``(epoch, proc, seq)``,
+    or None when disabled (the caller then emits nothing — the
+    byte-identical contract). ``seq`` reuses an existing wire sequence
+    number when the protocol already has one (the eager announce's
+    fabric seq); otherwise a fresh module-scoped number is drawn."""
+    if not ENABLED:
+        return None
+    return (_epoch, _proc, next_seq() if seq is None else int(seq))

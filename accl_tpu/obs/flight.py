@@ -1,0 +1,217 @@
+"""Flight recorder: the always-on bounded ring of structured events.
+
+The black box the chaos drills lacked (ISSUE r18): every rank keeps the
+last :data:`CAPACITY` structured events — op dispatches with their
+resolved algorithm/plan source, matcher park/match, retries and fault
+injections, epoch bumps, router admit/decline/migrate, PEER_FAILED
+verdicts — in a bounded deque, and dumps them as schema-versioned JSON
+on the death paths (PEER_FAILED, COMM_INVALIDATED, ``recover()``, fatal
+teardown) so a postmortem has the last seconds of protocol history even
+when the process that died can no longer answer.
+
+Cost discipline is the metrics tier's: :func:`record` checks
+:data:`ENABLED` first (a disabled site is one boolean read and a
+return), and an enabled record is one small dict plus a lock-guarded
+deque append — the same order of work as one ``metrics.inc``. The ring
+is bounded by construction (``collections.deque(maxlen=...)``), so an
+always-on recorder can never grow the heap.
+
+Dump destinations resolve in order: an explicit ``path`` argument, else
+``$ACCL_FLIGHT_DIR/accl_flight_p{proc}_{reason}_{n}.json``, else no
+file is written (the ring stays inspectable via :func:`events` /
+``ACCL.stats()["flight"]``). Dump schema (version
+:data:`FLIGHT_SCHEMA_VERSION`)::
+
+    {"schema": 1, "reason": str, "proc": int, "wall_time": float,
+     "seq": int, "dumps_written": int, "events": [
+        {"seq": int, "ts": float, "wall": float, "kind": str, ...}]}
+
+``ts`` is ``time.perf_counter()`` (monotonic, for intra-rank ordering
+and deltas), ``wall`` is ``time.time()`` (for cross-rank eyeballing);
+``seq`` is a per-process monotonic event number so a dump names exactly
+which window of history it holds. Event kinds and their fields are
+catalogued in docs/observability.md.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+from . import metrics as _metrics
+
+#: dump-file schema version, embedded in every dump
+FLIGHT_SCHEMA_VERSION = 1
+
+#: THE module-level hot-path guard (the obs.metrics pattern): flipped by
+#: :func:`enable` / :func:`disable`; a disabled :func:`record` is one
+#: boolean read. Always-on by default — the ring is the point.
+ENABLED = True
+
+#: default ring capacity (events); override via $ACCL_FLIGHT_CAPACITY
+#: before first import or :func:`set_capacity` at runtime
+DEFAULT_CAPACITY = 2048
+
+#: env var naming the dump directory; unset = no files written
+FLIGHT_DIR_ENV = "ACCL_FLIGHT_DIR"
+
+
+def _env_capacity() -> int:
+    try:
+        n = int(os.environ.get("ACCL_FLIGHT_CAPACITY", DEFAULT_CAPACITY))
+        return n if n > 0 else DEFAULT_CAPACITY
+    except ValueError:
+        return DEFAULT_CAPACITY
+
+
+_lock = threading.Lock()
+_ring: collections.deque = collections.deque(maxlen=_env_capacity())
+_seq = 0                 # per-process monotonic event number
+_dumps_written = 0
+_last_dump_path: Optional[str] = None
+_last_dump_reason: Optional[str] = None
+_fatal_seen = False      # set by peer_failed / comm_invalidated events
+
+
+def _proc() -> int:
+    env = os.environ.get("ACCL_PROC_ID")
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    return os.getpid()
+
+
+def enable() -> None:
+    global ENABLED
+    ENABLED = True
+
+
+def disable() -> None:
+    global ENABLED
+    ENABLED = False
+
+
+def enabled() -> bool:
+    return ENABLED
+
+
+def set_capacity(n: int) -> None:
+    """Rebound the ring (keeps the newest events that fit)."""
+    global _ring
+    with _lock:
+        _ring = collections.deque(_ring, maxlen=max(1, int(n)))
+
+
+def clear() -> None:
+    global _fatal_seen
+    with _lock:
+        _ring.clear()
+        _fatal_seen = False
+
+
+def record(kind: str, **fields) -> None:
+    """Append one structured event to the ring (hot-path entry: one
+    boolean read when disabled). ``kind`` is the catalogued event name;
+    ``fields`` must be JSON-safe scalars (callers own that contract —
+    the recorder never walks the values on the hot path). Counts
+    ``accl_flight_events_total{kind}`` exactly once per event."""
+    global _seq, _fatal_seen
+    if not ENABLED:
+        return
+    ev = fields
+    ev["kind"] = kind
+    ev["ts"] = time.perf_counter()
+    ev["wall"] = time.time()
+    with _lock:
+        _seq += 1
+        ev["seq"] = _seq
+        _ring.append(ev)
+        if kind in ("peer_failed", "comm_invalidated"):
+            _fatal_seen = True
+    _metrics.inc("accl_flight_events_total", 1.0, (("kind", kind),))
+
+
+def had_fatal() -> bool:
+    """True once a peer_failed / comm_invalidated event was recorded —
+    what makes a teardown 'fatal' for the auto-dump trigger."""
+    return _fatal_seen
+
+
+def events() -> List[dict]:
+    """Copy of the ring, oldest first (postmortem/inspection read)."""
+    with _lock:
+        return [dict(e) for e in _ring]
+
+
+def stats() -> dict:
+    """The ``ACCL.stats()["flight"]`` section: ring occupancy and dump
+    accounting."""
+    with _lock:
+        return {
+            "enabled": ENABLED,
+            "capacity": _ring.maxlen,
+            "occupancy": len(_ring),
+            "events_recorded": _seq,
+            "dumps_written": _dumps_written,
+            "last_dump_path": _last_dump_path,
+            "last_dump_reason": _last_dump_reason,
+        }
+
+
+def dump(reason: str, path: Optional[str] = None) -> Optional[str]:
+    """Write the ring as one schema-versioned JSON file and return its
+    path. With no explicit ``path`` and no $ACCL_FLIGHT_DIR the dump is
+    skipped (returns None) — the death paths call this unconditionally,
+    so an unconfigured process must stay silent, not crash. A dump
+    failure is swallowed (telemetry never breaks the error path it is
+    documenting) but still counted as attempted via the flight event."""
+    global _dumps_written, _last_dump_path, _last_dump_reason
+    with _lock:
+        n = _dumps_written
+        doc = {
+            "schema": FLIGHT_SCHEMA_VERSION,
+            "reason": reason,
+            "proc": _proc(),
+            "wall_time": time.time(),
+            "seq": _seq,
+            "dumps_written": n,
+            "events": [dict(e) for e in _ring],
+        }
+    if path is None:
+        d = os.environ.get(FLIGHT_DIR_ENV)
+        if not d:
+            return None
+        path = os.path.join(
+            d, f"accl_flight_p{_proc()}_{reason}_{n}.json")
+    try:
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+    except OSError:
+        return None
+    with _lock:
+        _dumps_written += 1
+        _last_dump_path = path
+        _last_dump_reason = reason
+    record("dump", reason=reason, path=path)
+    return path
+
+
+def _note_dispatch(op: str, algorithm: str, bucket: str) -> None:
+    record("dispatch", op=op, algorithm=algorithm, bucket=bucket)
+
+
+# dispatch events ride the one call-accounting site every collective
+# already passes through (metrics.note_call) instead of N per-op hooks;
+# the resolved algorithm is read off the program-cache key there, so the
+# flight event names selection exactly as dispatched
+_metrics.FLIGHT_NOTE = _note_dispatch

@@ -54,6 +54,9 @@ name                                           kind       labels
 ``accl_recover_total``                         counter    mode (full | shrink: survivor-subset recoveries)
 ``accl_comm_invalidated_total``                counter    (none; communicators spanning a dead rank)
 ``accl_zero_replica_total``                    counter    event (write: per replicate-PROGRAM built, trace-time like the prefetch counter; restore: per restore call)
+``accl_flight_events_total``                   counter    kind (obs/flight.py ring events — one bump per recorded event; catalog in docs/observability.md)
+``accl_cluster_snapshot_total``                counter    event (published: per rank snapshot pushed to the KV | merged: per rank folded by ``cluster_stats()`` | stale: per merged rank past the staleness bound)
+``accl_recal_total``                           counter    outcome (applied | advisory | insufficient_data: one per ``maybe_recalibrate`` pass — obs/recal.py)
 =============================================  =========  =================
 
 Export formats: :meth:`MetricsRegistry.snapshot` (flat, JSON-safe dict),
@@ -267,6 +270,18 @@ SCHEMA_VERSION = 1
 #: the process-wide registry every helper below writes into
 REGISTRY = MetricsRegistry()
 
+#: recalibration sample hook (obs/recal.py installs it when
+#: ``sched_online_recal`` arms): called as ``(op_name, nbytes,
+#: seconds)`` for every timed :func:`note_call`. None when disarmed —
+#: the default hot path pays one ``is None`` read.
+RECAL_NOTE = None
+
+#: flight-recorder dispatch hook (obs/flight.py installs it at import):
+#: called as ``(op_name, algorithm, size_bucket)`` for every
+#: :func:`note_call`, so the flight ring sees op dispatches with their
+#: resolved algorithm without a per-op hook in accl.py.
+FLIGHT_NOTE = None
+
 
 def enable() -> None:
     global ENABLED
@@ -328,16 +343,22 @@ def note_call(op, nbytes: int, dtype=None, key: Optional[Iterable] = None,
             if v is not None and part.__class__.__name__ == "Algorithm":
                 algo = v
                 break
-    labels = (("op", getattr(op, "name", str(op))),
+    op_name = getattr(op, "name", str(op))
+    bucket = size_bucket(int(nbytes))
+    labels = (("op", op_name),
               ("algorithm", algo),
               ("dtype", getattr(dtype, "name", str(dtype))),
-              ("bucket", size_bucket(int(nbytes))))
+              ("bucket", bucket))
     REGISTRY.inc("accl_calls_total", 1.0, labels)
     REGISTRY.inc("accl_bytes_total", float(nbytes), labels)
+    if FLIGHT_NOTE is not None:
+        FLIGHT_NOTE(op_name, algo, bucket)
     if t0:
-        REGISTRY.observe("accl_dispatch_seconds",
-                         time.perf_counter() - t0,
-                         (("op", getattr(op, "name", str(op))),))
+        dt = time.perf_counter() - t0
+        REGISTRY.observe("accl_dispatch_seconds", dt,
+                         (("op", op_name),))
+        if RECAL_NOTE is not None:
+            RECAL_NOTE(op_name, int(nbytes), dt)
 
 
 def note_latency_dispatch(path: str, t0: float) -> None:

@@ -1,0 +1,247 @@
+"""Online α/β recalibration: close the record → act loop.
+
+The synth cost model prices every schedule off four registers
+(``sched_alpha_us`` / ``sched_beta_gbps`` per transport tier —
+config.py). They are seeded by autotune once; a fabric that drifts
+(co-tenants, a degraded link, a different pod) leaves the scheduler
+arguing from stale prices. This module refits the registers from the
+dispatch latencies the obs tier already accumulates and — behind
+``ACCLConfig.sched_online_recal``, default **off** — lets the session
+act on a large drift: bump the synth plan-cache recal generation and
+re-resolve every plan at the new prices.
+
+Data path: when armed, :func:`install` hooks ``metrics.note_call`` (one
+``is None`` check on the disarmed hot path) so every timed dispatch
+also lands in ``accl_latency_dispatch_seconds`` under
+``(op, size-bucket, tier, path="recal")`` — the per-(op, size-bucket)
+histograms the refit reads — plus a side table of exact mean payload
+bytes per series (the regression abscissa). Default-off records
+nothing: no new series, no new keys, resolution byte-identical.
+
+Refit: per (tier, op), weighted least squares over the per-bucket
+points ``(mean bytes, mean µs)`` of the linear cost model
+``t_us = α + 8e-3 · bytes / β`` — α is the intercept, β falls out of
+the slope. Ops with only α-dominated samples (slope ≤ 0) contribute an
+α estimate only. Per tier, the fitted α/β are the count-weighted
+medians across ops. An op needs ≥ :data:`MIN_POINTS` distinct size
+buckets and ≥ :data:`MIN_SAMPLES` samples to contribute.
+
+State machine (docs/observability.md): every
+:func:`maybe_recalibrate` call lands in exactly ONE counted outcome —
+``insufficient_data`` (no tier produced a fit), ``advisory`` (fit
+produced, drift ≤ :data:`DRIFT_RATIO` — or the register is off:
+numbers reported, nothing changed), ``applied`` (register on AND some
+tier drifted > :data:`DRIFT_RATIO`: the returned register values are
+meant to be written back and the plan cache re-keyed —
+``ACCL.recalibrate()`` does both). Counted
+``accl_recal_total{outcome=...}``.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional, Tuple
+
+from . import metrics as _metrics
+
+#: drift threshold: a fitted register more than this factor away from
+#: the live one (either direction) is actionable
+DRIFT_RATIO = 3.0
+
+#: an op needs this many distinct size buckets to fit a slope
+MIN_POINTS = 2
+
+#: ... and this many total samples to be trusted at all
+MIN_SAMPLES = 8
+
+#: registry series the armed hook feeds (the per-(op,bucket,tier)
+#: accumulation the refit reads)
+_SERIES = "accl_latency_dispatch_seconds"
+
+#: config registers per transport tier
+TIER_REGISTERS = {
+    "ici": ("sched_alpha_us", "sched_beta_gbps"),
+    "dcn": ("sched_dcn_alpha_us", "sched_dcn_beta_gbps"),
+}
+
+#: armed-state guard (the obs.metrics pattern); driven by the
+#: ``sched_online_recal`` config write-through, not flipped directly
+ENABLED = False
+
+#: side table: (tier, op, bucket) -> [sum_bytes, n] — exact mean payload
+#: bytes per series, the regression abscissa (bucket labels are too
+#: coarse to invert)
+_bytes: Dict[Tuple[str, str, str], list] = {}
+
+_KEY_RE = re.compile(
+    r'^accl_latency_dispatch_seconds\{bucket="([^"]+)",op="([^"]+)",'
+    r'path="recal",tier="([^"]+)"\}$')
+
+
+def _note(op_name: str, nbytes: int, seconds: float,
+          tier: str = "ici") -> None:
+    """The hook ``metrics.note_call`` fires per timed dispatch when
+    armed: one histogram observe under the recal label set plus the
+    bytes side-table bump."""
+    bucket = _metrics.size_bucket(int(nbytes))
+    _metrics.observe(_SERIES, seconds,
+                     (("bucket", bucket), ("op", op_name),
+                      ("path", "recal"), ("tier", tier)))
+    key = (tier, op_name, bucket)
+    ent = _bytes.get(key)
+    if ent is None:
+        _bytes[key] = [float(nbytes), 1]
+    else:
+        ent[0] += nbytes
+        ent[1] += 1
+
+
+def install() -> None:
+    """Arm sample capture (idempotent)."""
+    global ENABLED
+    ENABLED = True
+    _metrics.RECAL_NOTE = _note
+
+
+def uninstall() -> None:
+    global ENABLED
+    ENABLED = False
+    _metrics.RECAL_NOTE = None
+
+
+def set_enabled(on: bool) -> None:
+    """Config write-through target for ``sched_online_recal``."""
+    (install if on else uninstall)()
+
+
+def clear() -> None:
+    _bytes.clear()
+
+
+def _fit_op(points) -> Optional[Tuple[float, Optional[float], int]]:
+    """Weighted least squares over [(bytes, us, weight)] →
+    (alpha_us, beta_gbps | None, n_samples)."""
+    n = sum(w for _, _, w in points)
+    if n < MIN_SAMPLES:
+        return None
+    if len(points) < MIN_POINTS:
+        # one bucket: α-only estimate (the whole latency is intercept)
+        y = sum(y * w for _, y, w in points) / n
+        return (max(y, 1e-3), None, n)
+    sw = float(n)
+    sx = sum(x * w for x, _, w in points)
+    sy = sum(y * w for _, y, w in points)
+    sxx = sum(x * x * w for x, _, w in points)
+    sxy = sum(x * y * w for x, y, w in points)
+    denom = sw * sxx - sx * sx
+    if denom <= 0:
+        return None
+    slope = (sw * sxy - sx * sy) / denom       # µs per byte
+    alpha = (sy - slope * sx) / sw
+    alpha = max(alpha, 1e-3)
+    if slope <= 0:
+        return (alpha, None, n)
+    beta = 8e-3 / slope                        # Gbps from µs/byte
+    return (alpha, beta, n)
+
+
+def _wmedian(vals) -> Optional[float]:
+    """Weighted median of [(value, weight)]."""
+    if not vals:
+        return None
+    vals = sorted(vals)
+    half = sum(w for _, w in vals) / 2.0
+    acc = 0.0
+    for v, w in vals:
+        acc += w
+        if acc >= half:
+            return v
+    return vals[-1][0]
+
+
+def refit(snapshot: Optional[dict] = None) -> Dict[str, dict]:
+    """Fit α/β per transport tier from the accumulated recal histograms.
+    Returns ``{tier: {"alpha_us", "beta_gbps", "samples", "ops"}}`` for
+    every tier with at least one qualifying op; β may be None when no
+    op resolved a positive slope (α-dominated data)."""
+    if snapshot is None:
+        snapshot = _metrics.snapshot()
+    # (tier, op) -> [(bytes, us, weight)]
+    per_op: Dict[Tuple[str, str], list] = {}
+    for key, h in snapshot.get("histograms", {}).items():
+        m = _KEY_RE.match(key)
+        if not m or not h.get("count"):
+            continue
+        bucket, op, tier = m.group(1), m.group(2), m.group(3)
+        ent = _bytes.get((tier, op, bucket))
+        if ent is None or ent[1] == 0:
+            continue
+        mean_bytes = ent[0] / ent[1]
+        mean_us = h["sum"] / h["count"] * 1e6
+        per_op.setdefault((tier, op), []).append(
+            (mean_bytes, mean_us, h["count"]))
+    out: Dict[str, dict] = {}
+    fits: Dict[str, dict] = {}
+    for (tier, op), points in per_op.items():
+        fit = _fit_op(points)
+        if fit is None:
+            continue
+        alpha, beta, n = fit
+        t = fits.setdefault(tier, {"alphas": [], "betas": [],
+                                   "samples": 0, "ops": []})
+        t["alphas"].append((alpha, n))
+        if beta is not None:
+            t["betas"].append((beta, n))
+        t["samples"] += n
+        t["ops"].append(op)
+    for tier, t in fits.items():
+        out[tier] = {
+            "alpha_us": _wmedian(t["alphas"]),
+            "beta_gbps": _wmedian(t["betas"]),
+            "samples": t["samples"],
+            "ops": sorted(t["ops"]),
+        }
+    return out
+
+
+def _drift(fit: Optional[float], live: float) -> float:
+    if fit is None or fit <= 0 or live <= 0:
+        return 1.0
+    return max(fit / live, live / fit)
+
+
+def maybe_recalibrate(cfg) -> dict:
+    """One recalibration pass against the live config registers. Pure
+    decision — the caller (``ACCL.recalibrate``) writes registers back
+    and bumps the synth recal generation on ``"applied"``. Exactly one
+    ``accl_recal_total{outcome}`` count per call."""
+    fits = refit()
+    result = {"outcome": "insufficient_data", "tiers": {},
+              "registers": {}, "drift_ratio": DRIFT_RATIO}
+    worst = 1.0
+    for tier, fit in fits.items():
+        a_reg, b_reg = TIER_REGISTERS[tier]
+        live_a = getattr(cfg, a_reg)
+        live_b = getattr(cfg, b_reg)
+        da = _drift(fit["alpha_us"], live_a)
+        db = _drift(fit["beta_gbps"], live_b)
+        result["tiers"][tier] = {
+            **fit, "live_alpha_us": live_a, "live_beta_gbps": live_b,
+            "alpha_drift": da, "beta_drift": db,
+        }
+        worst = max(worst, da, db)
+        if fit["alpha_us"] is not None:
+            result["registers"][a_reg] = round(fit["alpha_us"], 4)
+        if fit["beta_gbps"] is not None:
+            result["registers"][b_reg] = round(fit["beta_gbps"], 4)
+    if result["tiers"]:
+        actionable = worst > DRIFT_RATIO
+        if actionable and getattr(cfg, "sched_online_recal", False):
+            result["outcome"] = "applied"
+        else:
+            result["outcome"] = "advisory"
+        result["worst_drift"] = worst
+    if result["outcome"] != "applied":
+        result["registers"] = {}
+    _metrics.inc("accl_recal_total", 1.0,
+                 (("outcome", result["outcome"]),))
+    return result

@@ -19,6 +19,18 @@ per rank group; ``tid`` is a densified thread id.
 Disabled by default (span records allocate): :func:`start` flips the one
 module-level flag; a disabled :func:`span` returns a shared null context
 — no clock read, no allocation.
+
+Multi-rank merge: ``python -m accl_tpu.obs.trace --merge out.json
+rank*.json`` stitches per-rank trace files into ONE time-aligned
+timeline. Alignment rides the epoch-entry KV handshake: the fabric
+calls :func:`sync_mark` as each rank exits the epoch barrier, which
+embeds an ``accl_sync`` record (label, tracer-relative ts, wall time)
+in that rank's written trace; the merger shifts each rank's timestamps
+so the latest common sync label coincides across files (barrier exits
+are simultaneous to within the KV round-trip — the offset estimate's
+honest error bar, reported per rank in the merged metadata). Missing
+or corrupt inputs are reported and skipped; unknown arguments exit
+rc=2 with usage.
 """
 from __future__ import annotations
 
@@ -60,6 +72,9 @@ class SpanTracer:
         # one epoch per tracer: Chrome-trace ts is relative anyway, and a
         # perf_counter epoch keeps span math monotonic and cheap
         self._epoch = time.perf_counter()
+        # cross-rank alignment anchors: label -> {"ts": us, "wall": s},
+        # written by sync_mark() as the fabric exits an epoch barrier
+        self._syncs: Dict[str, dict] = {}
 
     # -- recording ---------------------------------------------------------
 
@@ -115,11 +130,22 @@ class SpanTracer:
         with self._lock:
             self._events.append(ev)
 
+    def sync_mark(self, label: str) -> None:
+        """Record a cross-rank alignment anchor: every rank calls this
+        at the SAME protocol point (epoch-barrier exit), so the merger
+        can equate the anchors across files. Recorded even while span
+        collection is disabled — alignment must not depend on whether
+        the user traced."""
+        with self._lock:
+            self._syncs[label] = {"ts": self._now_us(),
+                                  "wall": time.time()}
+
     # -- export ------------------------------------------------------------
 
     def clear(self) -> None:
         with self._lock:
             self._events.clear()
+            self._syncs.clear()
 
     def __len__(self) -> int:
         with self._lock:
@@ -134,13 +160,15 @@ class SpanTracer:
         with self._lock:
             events = self._events[since:]
             tids = dict(self._tids)
+            syncs = {k: dict(v) for k, v in self._syncs.items()}
         pid = _pid()
         meta = [{"name": "process_name", "ph": "M", "pid": pid,
                  "args": {"name": f"accl host p{pid}"}}]
         for ident, tid in tids.items():
             meta.append({"name": "thread_name", "ph": "M", "pid": pid,
                          "tid": tid, "args": {"name": f"lane {tid}"}})
-        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms",
+                "accl_sync": {"proc": pid, "marks": syncs}}
 
     def write(self, path: str, since: int = 0) -> str:
         """Write the standalone Chrome-trace JSON; returns ``path``."""
@@ -197,6 +225,12 @@ def write(path: str) -> Optional[str]:
     return TRACER.write(path)
 
 
+def sync_mark(label: str) -> None:
+    """Record a cross-rank alignment anchor in the process tracer (the
+    fabric calls this as it exits an epoch barrier — see --merge)."""
+    TRACER.sync_mark(label)
+
+
 @contextlib.contextmanager
 def capture(path: str):
     """Trace a region and write ONLY that region's spans on exit (events
@@ -214,3 +248,123 @@ def capture(path: str):
         if not was:
             stop()
         TRACER.write(path, since=mark)
+
+
+# ---------------------------------------------------------------------------
+# multi-rank merge CLI: python -m accl_tpu.obs.trace --merge out.json r*.json
+# ---------------------------------------------------------------------------
+
+_USAGE = """usage: python -m accl_tpu.obs.trace --merge OUT.json RANK.json [RANK.json ...]
+
+Stitch per-rank Chrome traces (SpanTracer.write output) into ONE
+time-aligned timeline. Ranks are aligned on the latest sync mark label
+(the epoch-entry KV handshake anchor) present in every readable input;
+inputs without a common mark merge unshifted (offset 0, flagged in the
+output metadata). Missing or corrupt files are reported and skipped.
+Exit codes: 0 merged (>= 1 input readable), 1 nothing merged, 2 usage.
+"""
+
+
+def _load_rank_trace(path: str):
+    """One input file -> (doc, sync_marks) or None (reported, skipped)."""
+    import sys
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        events = doc["traceEvents"]
+        if not isinstance(events, list):
+            raise ValueError("traceEvents is not a list")
+    except (OSError, ValueError, KeyError) as e:
+        print(f"[trace --merge] skipping {path}: {e}", file=sys.stderr)
+        return None
+    return doc
+
+
+def merge_traces(paths) -> dict:
+    """The --merge core, importable for tests: returns the merged
+    Chrome-trace document with per-rank offset metadata under
+    ``accl_merge``. Unreadable inputs are skipped (reported on stderr);
+    an empty readable set yields a document with no events."""
+    docs = []
+    for p in paths:
+        doc = _load_rank_trace(p)
+        if doc is not None:
+            docs.append((p, doc))
+    # latest sync label common to every readable input (labels are
+    # epoch-ordered by construction: "epoch0", "epoch1", ...)
+    common = None
+    marksets = [doc.get("accl_sync", {}).get("marks", {})
+                for _, doc in docs]
+    if docs:
+        shared = set(marksets[0])
+        for m in marksets[1:]:
+            shared &= set(m)
+        if shared:
+            common = max(shared)
+    out_events = []
+    ranks = {}
+    # the first rank with the common mark anchors the merged clock
+    ref_ts = None
+    if common is not None:
+        ref_ts = marksets[0][common]["ts"]
+    for (path, doc), marks in zip(docs, marksets):
+        offset = 0.0
+        aligned = False
+        if common is not None and common in marks:
+            offset = ref_ts - marks[common]["ts"]
+            aligned = True
+        proc = doc.get("accl_sync", {}).get("proc")
+        for ev in doc["traceEvents"]:
+            ev = dict(ev)
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] + offset
+            out_events.append(ev)
+        ranks[path] = {"proc": proc, "offset_us": offset,
+                       "aligned": aligned,
+                       "sync_label": common if aligned else None}
+    return {"traceEvents": out_events, "displayTimeUnit": "ms",
+            "accl_merge": {"inputs": len(paths), "merged": len(docs),
+                           "ranks": ranks}}
+
+
+def _main(argv) -> int:
+    import sys
+    args = list(argv)
+    if not args or args[0] in ("-h", "--help"):
+        print(_USAGE, end="")
+        return 0 if args else 2
+    if args[0] != "--merge":
+        print(f"[trace] unknown argument: {args[0]}", file=sys.stderr)
+        print(_USAGE, end="", file=sys.stderr)
+        return 2
+    rest = args[1:]
+    for a in rest:
+        if a.startswith("-"):
+            print(f"[trace] unknown argument: {a}", file=sys.stderr)
+            print(_USAGE, end="", file=sys.stderr)
+            return 2
+    if len(rest) < 2:
+        print("[trace] --merge needs OUT.json and >=1 input",
+              file=sys.stderr)
+        print(_USAGE, end="", file=sys.stderr)
+        return 2
+    out, inputs = rest[0], rest[1:]
+    doc = merge_traces(inputs)
+    if doc["accl_merge"]["merged"] == 0:
+        print("[trace] nothing merged (no readable inputs)",
+              file=sys.stderr)
+        return 1
+    d = os.path.dirname(out)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(doc, f)
+    m = doc["accl_merge"]
+    print(f"[trace] merged {m['merged']}/{m['inputs']} rank traces "
+          f"-> {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    raise SystemExit(_main(sys.argv[1:]))

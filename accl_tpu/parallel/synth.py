@@ -881,6 +881,27 @@ def set_session_epoch(epoch: int) -> None:
     _session_epoch = int(epoch)
 
 
+#: recalibration generation, also baked into every plan-cache key:
+#: bumped by ``ACCL.recalibrate()`` when an online α/β refit is APPLIED
+#: (obs/recal.py), so every plan priced at the stale registers becomes
+#: unreachable and re-resolves at the new prices. Deliberately separate
+#: from the session epoch — a recal must not collide with recover()'s
+#: epoch machinery, and survives reset_plan_cache().
+_recal_gen = 0
+
+
+def bump_recal_generation() -> int:
+    """Invalidate every cached plan priced at pre-refit α/β; returns the
+    new generation."""
+    global _recal_gen
+    _recal_gen += 1
+    return _recal_gen
+
+
+def recal_generation() -> int:
+    return _recal_gen
+
+
 def reset_plan_cache() -> None:
     """Session hook (``ACCL.initialize()``): drop every cached plan —
     and the per-config fingerprint memo — so a fresh session
@@ -902,7 +923,8 @@ def plan_cache_stats() -> Dict[str, int]:
     with _plan_lock:
         return {"plans": len(_plan_cache), "max_size": _PLAN_CACHE_MAX,
                 "hits": _plan_hits, "misses": _plan_misses,
-                "evictions": _plan_evictions}
+                "evictions": _plan_evictions,
+                "recal_generation": _recal_gen}
 
 
 #: running per-session totals of the two-tier cross-slice leg's bytes
@@ -1055,7 +1077,7 @@ def resolve(op: operation, nbytes: int, comm, cfg: ACCLConfig,
         wire_key = None
     key = (op, topo, _metrics.size_bucket(nbytes), in_tier,
            legacy, seeds, _cost_fingerprint(cfg), wire_key,
-           _session_epoch)
+           _session_epoch, _recal_gen)
     global _plan_hits, _plan_misses, _plan_evictions
     with _plan_lock:
         plan = _plan_cache.get(key)

@@ -30,6 +30,7 @@ import jax
 from . import fault as _fault
 from .communicator import Communicator
 from .constants import TAG_ANY, ACCLError, errorCode
+from .obs import flight as _flight
 from .obs import metrics as _metrics
 from .utils.logging import get_logger
 
@@ -153,10 +154,14 @@ class MatchingEngine:
                     post.on_matched()
                 _metrics.inc("accl_match_events_total",
                              labels=_L_SEND_MATCHED)
+                _flight.record("match", event="send_matched", src=post.src,
+                               dst=post.dst, tag=post.tag)
                 return True
             self._posts[sid] = post
             post._native_id = sid
             _metrics.inc("accl_match_events_total", labels=_L_SEND_PARKED)
+            _flight.record("match", event="send_parked", src=post.src,
+                           dst=post.dst, tag=post.tag)
             return False
         prospective = self.comm.peek_outbound_seq(post.src, post.dst)
         candidate = None
@@ -183,9 +188,13 @@ class MatchingEngine:
             if post.on_matched:
                 post.on_matched()
             _metrics.inc("accl_match_events_total", labels=_L_SEND_MATCHED)
+            _flight.record("match", event="send_matched", src=post.src,
+                           dst=post.dst, tag=post.tag)
             return True
         self._pending_sends.append(post)
         _metrics.inc("accl_match_events_total", labels=_L_SEND_PARKED)
+        _flight.record("match", event="send_parked", src=post.src,
+                       dst=post.dst, tag=post.tag)
         return False
 
     def post_recv(self, post: RecvPost) -> bool:
@@ -215,6 +224,10 @@ class MatchingEngine:
             _metrics.inc("accl_match_events_total",
                          labels=(_L_RECV_MATCHED if rem == 0
                                  else _L_RECV_PARKED))
+            _flight.record("match",
+                           event=("recv_matched" if rem == 0
+                                  else "recv_parked"),
+                           src=post.src, dst=post.dst, tag=post.tag)
             return rem == 0
         # pre-scan: refuse upfront if an eligible segment would straddle
         # this recv's boundary (consuming a prefix then parking forever
@@ -256,8 +269,12 @@ class MatchingEngine:
         if post.remaining > 0:
             self._pending_recvs.append(post)
             _metrics.inc("accl_match_events_total", labels=_L_RECV_PARKED)
+            _flight.record("match", event="recv_parked", src=post.src,
+                           dst=post.dst, tag=post.tag)
             return False
         _metrics.inc("accl_match_events_total", labels=_L_RECV_MATCHED)
+        _flight.record("match", event="recv_matched", src=post.src,
+                       dst=post.dst, tag=post.tag)
         return True
 
     def recv_capacity(self, src: int, dst: int, tag: int) -> int:

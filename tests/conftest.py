@@ -116,15 +116,18 @@ def aot_topology_devices(topology_name: str = "v5e:2x4"):
                 "topology_name='v5e:2x4'); print('AOT_OK')")
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"   # only the topology call may load libtpu
+        # deadline sized to bound the HANG case, not the healthy one: a
+        # good libtpu answers in seconds, a sick tunnel never answers —
+        # every second here is pure tier-1 tax on rigs with no TPU
         try:
-            r = subprocess.run([sys.executable, "-c", code], timeout=60,
+            r = subprocess.run([sys.executable, "-c", code], timeout=25,
                                capture_output=True, text=True, env=env)
             _AOT_PROBE["state"] = (
                 "ok" if "AOT_OK" in r.stdout
                 else f"error: {(r.stderr or r.stdout)[-300:]}")
         except subprocess.TimeoutExpired:
             _AOT_PROBE["state"] = ("hung: libtpu topology init exceeded "
-                                   "60s (sick TPU tunnel?)")
+                                   "25s (sick TPU tunnel?)")
     if _AOT_PROBE["state"] != "ok":
         pytest.skip(
             f"TPU AOT topology unavailable ({_AOT_PROBE['state']})")

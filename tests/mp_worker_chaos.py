@@ -40,7 +40,45 @@ def _counters_total(prefix: str) -> float:
                if k.startswith(prefix))
 
 
+def _flight_dump_dir() -> str:
+    """Per-process flight-dump directory, armed BEFORE the session so
+    every death-path auto-dump lands somewhere the drill can parse."""
+    import tempfile
+
+    d = os.environ.get("ACCL_FLIGHT_DIR")
+    if not d:
+        d = tempfile.mkdtemp(prefix=f"accl_flight_p{jax.process_index()}_")
+        os.environ["ACCL_FLIGHT_DIR"] = d
+    return d
+
+
+def _assert_death_dump(flight_dir: str, dead: int, epoch: int) -> None:
+    """The r18 chaos assertion: the death path wrote a parseable flight
+    dump whose ring holds the PEER_FAILED verdict naming the dead
+    process AND the recovery's final epoch bump."""
+    import glob
+    import json
+
+    dumps = [p for p in sorted(glob.glob(os.path.join(flight_dir,
+                                                      "*.json")))
+             if "_recover_" in p]
+    assert dumps, f"no recover flight dump in {flight_dir}"
+    with open(dumps[-1]) as f:
+        doc = json.load(f)
+    assert doc["schema"] == 1 and doc["events"], doc.get("schema")
+    kinds = [e["kind"] for e in doc["events"]]
+    assert "peer_failed" in kinds, kinds
+    assert "epoch_bump" in kinds, kinds
+    pf = [e for e in doc["events"] if e["kind"] == "peer_failed"][-1]
+    assert dead in pf["dead"], pf
+    eb = [e for e in doc["events"] if e["kind"] == "epoch_bump"][-1]
+    assert eb["epoch"] == epoch, (eb, epoch)
+
+
 def transient() -> int:
+    # correlation ids armed for the whole scenario: both controllers
+    # share the env, so the widened eager header is symmetric
+    os.environ["ACCL_CORRELATE"] = "1"
     me = jax.process_index()
     acc = accl_tpu.ACCL()
     comm = acc.global_comm()
@@ -68,6 +106,16 @@ def transient() -> int:
     if comm.rank_is_local(dst):
         acc.recv(rb, n, src=src, dst=dst, tag=7)
         assert np.array_equal(rb.host[dst], payload), "eager corrupted"
+        # correlation round-trip: the delivered message's flight event
+        # names its sender's (epoch, proc, seq) read off the wire header
+        from accl_tpu.obs import flight
+        corr = [e for e in flight.events()
+                if e["kind"] == "recv_correlated"]
+        assert corr, "no recv_correlated flight event on the receiver"
+        assert corr[-1]["sender_proc"] == 0, corr[-1]
+        assert corr[-1]["sender_epoch"] == 0, corr[-1]
+        assert corr[-1]["sender_seq"] >= 1, corr[-1]
+        print(f"[p{me}] CHAOS-CORR-OK", flush=True)
     print(f"[p{me}] chaos eager ok", flush=True)
 
     # ---- rendezvous (payload > max_eager_size) -------------------------
@@ -206,6 +254,7 @@ def shrink() -> int:
     from accl_tpu.parallel.primitives import AXIS, _smap
 
     me = jax.process_index()
+    fdir = _flight_dump_dir()     # armed BEFORE the session: the death
     cfg = accl_tpu.ACCLConfig(timeout=60.0, heartbeat_interval_s=0.2,
                               heartbeat_timeout_s=2.5, shard_replicas=True)
     acc = accl_tpu.ACCL(config=cfg)
@@ -242,6 +291,35 @@ def shrink() -> int:
                           .addressable_shards[0].data).reshape(-1)[:n]
             for t in ("w", "m", "v")}
     print(f"[p{me}] zero warmup ok (2 replicated steps)", flush=True)
+
+    # ---- cluster metrics plane: 4-rank exact-totals drill --------------
+    # force-publish every rank's snapshot, then prove the merge equals
+    # the per-rank sums EXACTLY for every counter key (no sampling, no
+    # loss) — the ISSUE acceptance for the aggregation leg
+    import json as _json
+
+    from accl_tpu.obs import cluster as _clus
+    acc._fabric._obs_last = 0.0
+    acc._fabric._maybe_publish_obs(mp._client())
+    acc.barrier()
+    blobs = acc._fabric.collect_obs(range(W))
+    assert all(blobs.get(p) for p in range(W)), \
+        f"missing cluster snapshots: {[p for p in range(W) if not blobs.get(p)]}"
+    per_rank = {p: _json.loads(blobs[p])["snapshot"]["counters"]
+                for p in range(W)}
+    merged = _clus.merge(blobs)
+    assert merged["ranks_merged"] == W and not merged["missing_ranks"]
+    every_key = set().union(*(c.keys() for c in per_rank.values()))
+    assert every_key, "no counters published"
+    for key in every_key:
+        want = sum(c.get(key, 0.0) for c in per_rank.values())
+        assert merged["counters"][key] == want, (key,
+                                                merged["counters"][key],
+                                                want)
+    cs = acc.cluster_stats()
+    assert cs["ranks_merged"] == W, cs["ranks_merged"]
+    print(f"[p{me}] CHAOS-CLUSTER-OK ({len(every_key)} keys exact)",
+          flush=True)
 
     acc.barrier()
     t0 = time.monotonic()
@@ -311,6 +389,10 @@ def shrink() -> int:
     except accl_tpu.ACCLError as e:
         assert e.code == accl_tpu.errorCode.COMM_INVALIDATED, e
     me_new = new_comm.local_ranks[0]
+    # every survivor's death path auto-dumped its flight ring — even the
+    # ranks that never blocked on the dead peer carry the latched verdict
+    _assert_death_dump(fdir, DEAD, acc._fabric.epoch)
+    print(f"[p{me}] CHAOS-FLIGHT-OK", flush=True)
     print(f"[p{me}] shrunk epoch {epoch}: new rank {me_new}/3", flush=True)
 
     # ---- send/recv bit-exact across the shrunk mesh (new ranks) --------
@@ -392,6 +474,7 @@ def serve() -> int:
     from accl_tpu.models import serving as smod
 
     me = jax.process_index()
+    fdir = _flight_dump_dir()
     # lenient staleness window for the compile-heavy handoff phase:
     # heartbeats only refresh on fabric progress, and the replicas spend
     # many seconds inside jit compiles with no ACCL calls — a tight
@@ -489,6 +572,8 @@ def serve() -> int:
 
     epoch = acc.recover()
     assert epoch == 1 and acc.world_size == 2, (epoch, acc.world_size)
+    _assert_death_dump(fdir, DEAD, acc._fabric.epoch)
+    print(f"[p{me}] CHAOS-FLIGHT-OK", flush=True)
     print(f"[p{me}] shrunk to {{0, 1}} epoch {epoch}", flush=True)
     # the re-route phase compiles asymmetrically (rank 0 builds a fresh
     # prefill worker while rank 1 waits in recv): loosen the window back

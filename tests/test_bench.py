@@ -207,17 +207,21 @@ def test_bw_fields_resolution_protocol(monkeypatch):
 
 def test_obs_overhead_lane(accl):
     """The telemetry-overhead lane reports disabled/enabled dispatch
-    latency plus the raw disabled-guard cost, and restores the metrics
-    flag it toggles."""
+    latency plus the raw disabled-guard cost AND the flight-recorder
+    disabled/armed A/B arm (r18), and restores the flags it toggles."""
     from accl_tpu.bench import lanes
-    from accl_tpu.obs import metrics
+    from accl_tpu.obs import flight, metrics
 
     r = lanes.bench_obs_overhead(accl, count=1 << 10, calls=4, rounds=2)
     assert r["metric"] == "obs_overhead" and r["unit"] == "us"
     assert r["dispatch_disabled_us"] > 0
     assert r["dispatch_enabled_us"] > 0
     assert r["disabled_guard_ns"] >= 0
-    assert metrics.ENABLED        # the lane restores the flag
+    assert r["flight_disabled_us"] > 0
+    assert r["flight_armed_us"] > 0
+    assert isinstance(r["flight_delta_pct"], float)
+    assert metrics.ENABLED        # the lane restores the flags
+    assert flight.ENABLED
 
 
 def test_fault_overhead_lane(accl):

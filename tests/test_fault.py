@@ -793,6 +793,8 @@ def test_chaos_matrix_cross_process():
     sys.stderr.write(res.stderr)
     assert res.returncode == 0, f"launcher rc={res.returncode}"
     assert res.stdout.count("CHAOS-OK") == 2
+    # armed correlation ids round-tripped the eager wire (receiver only)
+    assert res.stdout.count("CHAOS-CORR-OK") == 1
 
 
 def test_chaos_rank_death_peer_failed_and_recover():
@@ -832,6 +834,10 @@ def test_chaos_kill_one_of_four_survivor_subset():
     assert res.returncode == 0, f"launcher rc={res.returncode}"
     assert res.stdout.count("CHAOS-SHRINK-OK") == 3
     assert res.stdout.count("CHAOS-SHRINK-DEAD-OK") == 1
+    # cluster plane: all 4 ranks proved merge == exact per-rank sums
+    assert res.stdout.count("CHAOS-CLUSTER-OK") == 4
+    # every survivor parsed a flight dump carrying the death verdict
+    assert res.stdout.count("CHAOS-FLIGHT-OK") == 3
 
 
 def test_chaos_serving_replica_death_reroutes_sessions():
@@ -851,3 +857,5 @@ def test_chaos_serving_replica_death_reroutes_sessions():
     assert res.stdout.count("SERVE-HANDOFF-OK") == 2
     assert res.stdout.count("CHAOS-SERVE-OK") == 2
     assert res.stdout.count("CHAOS-SERVE-DEAD-OK") == 1
+    # both survivors parsed a flight dump carrying the death verdict
+    assert res.stdout.count("CHAOS-FLIGHT-OK") == 2

@@ -1,0 +1,416 @@
+"""Observability-loop tests (ISSUE r18): the flight recorder ring and
+its dump schema, the cluster snapshot merge, the online α/β
+recalibration state machine, the trace --merge CLI, and the stats()
+sections that close record → aggregate → act."""
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+import accl_tpu
+from accl_tpu import dataType
+from accl_tpu.constants import operation
+from accl_tpu.obs import cluster, flight, metrics, recal, trace
+from accl_tpu.parallel import synth
+
+
+@pytest.fixture(autouse=True)
+def _obs_defaults():
+    """Default loop state (metrics on, flight on at default capacity,
+    recal disarmed) restored around every test — all three registries
+    are process-global."""
+    metrics.enable()
+    flight.enable()
+    recal.uninstall()
+    recal.clear()
+    yield
+    metrics.enable()
+    flight.enable()
+    flight.set_capacity(flight.DEFAULT_CAPACITY)
+    recal.uninstall()
+    recal.clear()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: bounded ring, exactly-once counting, dump schema
+# ---------------------------------------------------------------------------
+
+def test_flight_ring_is_bounded_and_ordered():
+    flight.clear()
+    flight.set_capacity(8)
+    for i in range(20):
+        flight.record("drill", i=i)
+    evs = [e for e in flight.events() if e["kind"] == "drill"]
+    assert len(evs) == 8                      # deque(maxlen) bound
+    assert [e["i"] for e in evs] == list(range(12, 20))  # newest kept
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs)               # oldest-first export
+    st = flight.stats()
+    assert st["capacity"] == 8 and st["occupancy"] == 8
+    assert st["events_recorded"] >= 20
+
+
+def test_flight_record_counts_exactly_once():
+    before = metrics.snapshot()
+    flight.record("drill_count")
+    d = metrics.delta(before)["counters"]
+    assert d.get('accl_flight_events_total{kind="drill_count"}') == 1.0
+    assert sum(v for k, v in d.items()
+               if k.startswith("accl_flight_events_total")) == 1.0
+
+
+def test_flight_disabled_is_silent():
+    flight.clear()
+    before = metrics.snapshot()
+    flight.disable()
+    try:
+        flight.record("drill_silent")
+    finally:
+        flight.enable()
+    assert not [e for e in flight.events() if e["kind"] == "drill_silent"]
+    assert "accl_flight_events_total{kind=\"drill_silent\"}" \
+        not in metrics.delta(before)["counters"]
+
+
+def test_flight_fatal_latch_and_clear():
+    flight.clear()
+    assert not flight.had_fatal()
+    flight.record("comm_invalidated", world_size=4)
+    assert flight.had_fatal()
+    flight.clear()
+    assert not flight.had_fatal() and flight.events() == []
+
+
+def test_flight_dump_roundtrip(tmp_path, monkeypatch):
+    flight.clear()
+    flight.record("peer_failed", what="lease_expired", dead=[2], epoch=0)
+    flight.record("epoch_bump", epoch=1)
+    path = tmp_path / "dump.json"
+    got = flight.dump("unit", path=str(path))
+    assert got == str(path)
+    doc = json.loads(path.read_text())
+    assert doc["schema"] == flight.FLIGHT_SCHEMA_VERSION == 1
+    assert doc["reason"] == "unit"
+    kinds = [e["kind"] for e in doc["events"]]
+    assert kinds == ["peer_failed", "epoch_bump"]
+    pf = doc["events"][0]
+    assert pf["dead"] == [2] and pf["what"] == "lease_expired"
+    # the write itself lands in the ring (self-documenting dump trail)
+    assert [e for e in flight.events() if e["kind"] == "dump"]
+    # unconfigured process: no dir, no explicit path -> silent no-op
+    monkeypatch.delenv(flight.FLIGHT_DIR_ENV, raising=False)
+    assert flight.dump("unit") is None
+
+
+def test_flight_dump_env_dir_naming(tmp_path, monkeypatch):
+    monkeypatch.setenv(flight.FLIGHT_DIR_ENV, str(tmp_path))
+    flight.clear()
+    flight.record("drill_env")
+    p = flight.dump("unitenv")
+    assert p is not None and "_unitenv_" in p
+    assert json.loads(open(p).read())["reason"] == "unitenv"
+
+
+def test_flight_dispatch_hook_rides_note_call():
+    """Every metrics.note_call lands a dispatch flight event (the one
+    call-accounting site all collectives pass through) with the op,
+    resolved algorithm label, and size bucket."""
+    assert metrics.FLIGHT_NOTE is not None
+    flight.clear()
+    metrics.note_call(operation.allreduce, 4096, dataType.float32)
+    evs = [e for e in flight.events() if e["kind"] == "dispatch"]
+    assert len(evs) == 1
+    assert evs[0]["op"] == "allreduce"
+    assert evs[0]["bucket"] == metrics.size_bucket(4096)
+
+
+# ---------------------------------------------------------------------------
+# cluster plane: the pure merge function + exactly-once snapshot counts
+# ---------------------------------------------------------------------------
+
+def _blob(proc, counters=None, gauges=None, hists=None, wall=None):
+    return json.dumps({
+        "proc": proc,
+        "wall": time.time() if wall is None else wall,
+        "snapshot": {"schema": metrics.SCHEMA_VERSION,
+                     "counters": counters or {},
+                     "gauges": gauges or {},
+                     "histograms": hists or {}},
+    })
+
+
+def test_cluster_merge_exact_totals():
+    h = {"buckets": {"0.001": 2, "inf": 3}, "sum": 0.5, "count": 3}
+    blobs = {
+        0: _blob(0, counters={"a": 1.0, "b": 2.0}, gauges={"g": 5.0},
+                 hists={"lat": h}),
+        1: _blob(1, counters={"a": 10.0}, gauges={"g": 7.0},
+                 hists={"lat": h}),
+        2: _blob(2, counters={"b": 0.5}),
+    }
+    m = cluster.merge(blobs)
+    assert m["ranks_merged"] == 3
+    assert m["missing_ranks"] == [] and m["stale_ranks"] == []
+    assert m["counters"] == {"a": 11.0, "b": 2.5}      # exact sums
+    assert m["gauges"] == {"g": 7.0}                   # high-water max
+    lat = m["histograms"]["lat"]                       # bucket-merge
+    assert lat["buckets"] == {"0.001": 4, "inf": 6}
+    assert lat["sum"] == 1.0 and lat["count"] == 6
+    assert sorted(m["per_rank"]) == [0, 1, 2]
+    assert all(r["lag_s"] < 60 for r in m["per_rank"].values())
+
+
+def test_cluster_merge_tolerates_missing_and_corrupt():
+    blobs = {0: _blob(0, counters={"a": 1.0}), 1: None,
+             2: "definitely not json", 3: json.dumps({"nope": 1})}
+    m = cluster.merge(blobs)
+    assert m["ranks_merged"] == 1
+    assert m["missing_ranks"] == [1, 2, 3]             # never fatal
+    assert m["counters"] == {"a": 1.0}
+
+
+def test_cluster_merge_annotates_stale_but_still_merges():
+    old = time.time() - 10 * cluster.PUBLISH_INTERVAL_S
+    blobs = {0: _blob(0, counters={"a": 1.0}),
+             1: _blob(1, counters={"a": 2.0}, wall=old)}
+    m = cluster.merge(blobs)
+    assert m["stale_ranks"] == [1]
+    assert m["counters"]["a"] == 3.0                   # stale != dropped
+    assert m["per_rank"][1]["lag_s"] > cluster.PUBLISH_INTERVAL_S
+
+
+def test_cluster_snapshot_counters_exactly_once():
+    before = metrics.snapshot()
+    blob = cluster.payload(0)
+    d = metrics.delta(before)["counters"]
+    assert d.get('accl_cluster_snapshot_total{event="published"}') == 1.0
+    before = metrics.snapshot()
+    cluster.merge({0: blob, 1: _blob(1), 2: None})
+    d = metrics.delta(before)["counters"]
+    assert d.get('accl_cluster_snapshot_total{event="merged"}') == 2.0
+    st = cluster.stats()
+    assert st["publishes"] >= 1 and st["merges"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# online recalibration: hook arming, the three counted outcomes, and the
+# synth plan-cache generation the applied path bumps
+# ---------------------------------------------------------------------------
+
+def _feed_drift(op, alpha_us, beta_gbps, n_each=4):
+    """Synthesize exact linear cost-model samples for one op at two
+    size buckets: t_us = alpha + 8e-3 * bytes / beta."""
+    for nbytes in (4096, 1 << 20):
+        secs = (alpha_us + 8e-3 * nbytes / beta_gbps) * 1e-6
+        for _ in range(n_each):
+            recal._note(op, nbytes, secs)
+
+
+def test_recal_default_off_records_nothing():
+    """sched_online_recal default-off safety: the hook slot is empty, a
+    timed dispatch adds NO recal series, and refit sees nothing."""
+    assert metrics.RECAL_NOTE is None
+    before = metrics.snapshot()
+    metrics.note_call(operation.allreduce, 4096, dataType.float32,
+                      t0=time.perf_counter())
+    new = [k for k in metrics.delta(before)["histograms"]
+           if 'path="recal"' in k]
+    assert new == []
+
+
+def test_recal_set_enabled_write_through():
+    recal.set_enabled(True)
+    assert recal.ENABLED and metrics.RECAL_NOTE is recal._note
+    recal.set_enabled(False)
+    assert not recal.ENABLED and metrics.RECAL_NOTE is None
+
+
+def test_recal_insufficient_data_counted_once():
+    cfg = accl_tpu.ACCLConfig()
+    before = metrics.snapshot()
+    res = recal.maybe_recalibrate(cfg)   # side table empty after clear
+    assert res["outcome"] == "insufficient_data"
+    assert res["registers"] == {}
+    d = metrics.delta(before)["counters"]
+    assert d.get(
+        'accl_recal_total{outcome="insufficient_data"}') == 1.0
+    assert sum(v for k, v in d.items()
+               if k.startswith("accl_recal_total")) == 1.0
+
+
+def test_recal_subthreshold_drift_stays_advisory():
+    cfg = accl_tpu.ACCLConfig(sched_online_recal=True)
+    _feed_drift("drill_sub", cfg.sched_alpha_us * 2.0,
+                cfg.sched_beta_gbps)
+    before = metrics.snapshot()
+    res = recal.maybe_recalibrate(cfg)
+    assert res["outcome"] == "advisory"            # 2x <= DRIFT_RATIO=3
+    assert res["registers"] == {}                  # nothing to write
+    assert 1.5 < res["worst_drift"] <= recal.DRIFT_RATIO + 0.5
+    d = metrics.delta(before)["counters"]
+    assert d.get('accl_recal_total{outcome="advisory"}') == 1.0
+
+
+def test_recal_large_drift_advisory_when_disarmed():
+    """5x drift with the config register OFF: numbers reported, nothing
+    applied — the act leg never fires without the opt-in."""
+    cfg = accl_tpu.ACCLConfig()                    # sched_online_recal off
+    _feed_drift("drill_off", cfg.sched_alpha_us * 5.0,
+                cfg.sched_beta_gbps)
+    res = recal.maybe_recalibrate(cfg)
+    assert res["outcome"] == "advisory"
+    assert res["registers"] == {}
+    assert res["worst_drift"] > recal.DRIFT_RATIO
+
+
+def test_recal_applied_on_5x_drift():
+    cfg = accl_tpu.ACCLConfig(sched_online_recal=True)
+    target = cfg.sched_alpha_us * 5.0
+    _feed_drift("drill_5x", target, cfg.sched_beta_gbps)
+    before = metrics.snapshot()
+    res = recal.maybe_recalibrate(cfg)
+    assert res["outcome"] == "applied"
+    assert res["registers"]["sched_alpha_us"] == pytest.approx(
+        target, rel=0.05)
+    assert res["registers"]["sched_beta_gbps"] == pytest.approx(
+        cfg.sched_beta_gbps, rel=0.05)
+    tier = res["tiers"]["ici"]
+    assert tier["alpha_drift"] == pytest.approx(5.0, rel=0.05)
+    d = metrics.delta(before)["counters"]
+    assert d.get('accl_recal_total{outcome="applied"}') == 1.0
+
+
+def test_synth_recal_generation_rekeys_plan_cache():
+    st = synth.plan_cache_stats()
+    g0 = st["recal_generation"]
+    assert synth.recal_generation() == g0
+    g1 = synth.bump_recal_generation()
+    assert g1 == g0 + 1
+    assert synth.plan_cache_stats()["recal_generation"] == g1
+
+
+def test_accl_recalibrate_applies_and_bumps_generation(accl):
+    """The full act leg on a live session: injected 5x α drift + the
+    config opt-in -> exactly one counted applied refit, registers
+    written back, plan-cache recal generation bumped. Sub-threshold and
+    disarmed paths never mutate the session (asserted above)."""
+    orig = accl.config
+    recal.clear()
+    try:
+        accl.config = orig.replace(sched_online_recal=True)
+        target = orig.sched_alpha_us * 5.0
+        _feed_drift("drill_session", target, orig.sched_beta_gbps)
+        g0 = synth.recal_generation()
+        before = metrics.snapshot()
+        res = accl.recalibrate()
+        assert res["outcome"] == "applied"
+        assert res["recal_generation"] == g0 + 1
+        assert synth.recal_generation() == g0 + 1
+        assert accl.config.sched_alpha_us == pytest.approx(
+            target, rel=0.05)
+        d = metrics.delta(before)["counters"]
+        assert d.get('accl_recal_total{outcome="applied"}') == 1.0
+        assert [e for e in flight.events()
+                if e["kind"] == "recal_applied"]
+    finally:
+        accl.config = orig        # restores registers, disarms the hook
+    assert metrics.RECAL_NOTE is None
+
+
+# ---------------------------------------------------------------------------
+# trace --merge CLI: alignment, skip-and-report, exit codes
+# ---------------------------------------------------------------------------
+
+def _rank_trace(path, proc, sync_ts, ev_ts, label="epoch0"):
+    doc = {"traceEvents": [
+        {"name": "work", "cat": "host", "ph": "X", "ts": ev_ts,
+         "dur": 10.0, "pid": proc, "tid": 0}],
+        "displayTimeUnit": "ms",
+        "accl_sync": {"proc": proc,
+                      "marks": {label: {"ts": sync_ts,
+                                        "wall": time.time()}}}}
+    path.write_text(json.dumps(doc))
+    return str(path)
+
+
+def test_trace_merge_aligns_on_common_sync_mark(tmp_path):
+    r0 = _rank_trace(tmp_path / "r0.json", 0, sync_ts=1000.0,
+                     ev_ts=1500.0)
+    r1 = _rank_trace(tmp_path / "r1.json", 1, sync_ts=5000.0,
+                     ev_ts=5600.0)
+    doc = trace.merge_traces([r0, r1])
+    m = doc["accl_merge"]
+    assert m["inputs"] == 2 and m["merged"] == 2
+    assert m["ranks"][r1]["aligned"] and m["ranks"][r1]["offset_us"] == \
+        pytest.approx(-4000.0)
+    assert m["ranks"][r0]["sync_label"] == "epoch0"
+    ts = sorted(e["ts"] for e in doc["traceEvents"] if e["ph"] == "X")
+    # r1's event lands 100us after r0's on the ALIGNED clock
+    assert ts == [pytest.approx(1500.0), pytest.approx(1600.0)]
+
+
+def test_trace_merge_skips_corrupt_inputs(tmp_path, capsys):
+    good = _rank_trace(tmp_path / "good.json", 0, 100.0, 200.0)
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    doc = trace.merge_traces([good, str(bad), str(tmp_path / "gone.json")])
+    assert doc["accl_merge"]["inputs"] == 3
+    assert doc["accl_merge"]["merged"] == 1            # skipped, not fatal
+    err = capsys.readouterr().err
+    assert "bad.json" in err and "gone.json" in err
+
+
+def test_trace_merge_cli_exit_codes(tmp_path, capsys):
+    assert trace._main(["--frob"]) == 2                # unknown arg
+    assert trace._main(["--merge", "--frob", "x"]) == 2
+    assert trace._main(["--merge", "out.json"]) == 2   # missing inputs
+    assert trace._main([]) == 2
+    assert trace._main(["--help"]) == 0
+    capsys.readouterr()
+    out = tmp_path / "merged.json"
+    assert trace._main(["--merge", str(out),
+                        str(tmp_path / "missing.json")]) == 1
+    r0 = _rank_trace(tmp_path / "r0.json", 0, 100.0, 200.0)
+    assert trace._main(["--merge", str(out), r0]) == 0
+    assert json.loads(out.read_text())["accl_merge"]["merged"] == 1
+
+
+def test_trace_merge_module_entrypoint(tmp_path):
+    """python -m accl_tpu.obs.trace is a real console entrypoint."""
+    r0 = _rank_trace(tmp_path / "r0.json", 0, 100.0, 200.0)
+    out = tmp_path / "merged.json"
+    res = subprocess.run(
+        [sys.executable, "-m", "accl_tpu.obs.trace", "--merge",
+         str(out), r0],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    assert out.exists()
+    # (the rc=2/rc=1 matrix is covered in-process above — one spawn
+    # keeps the tier-1 cost of this smoke to a single interpreter boot)
+
+
+# ---------------------------------------------------------------------------
+# stats(): the new sections round-trip as JSON
+# ---------------------------------------------------------------------------
+
+def test_stats_has_flight_and_cluster_sections(accl):
+    s = accl.stats()
+    json.dumps(s)                                      # JSON-safe whole
+    assert s["schema_version"] == metrics.SCHEMA_VERSION
+    fl = s["flight"]
+    assert fl["enabled"] and fl["capacity"] >= 1
+    assert {"occupancy", "events_recorded", "dumps_written"} <= set(fl)
+    cl = s["cluster"]
+    assert {"publishes", "merges", "publish_interval_s"} <= set(cl)
+
+
+def test_cluster_stats_degrades_to_local_single_controller(accl):
+    """Single-controller session (no fabric): cluster_stats() merges
+    exactly this rank's fresh payload."""
+    metrics.note_call(operation.allreduce, 4096, dataType.float32)
+    m = accl.cluster_stats()
+    assert m["ranks_merged"] == 1
+    assert m["missing_ranks"] == [] and m["stale_ranks"] == []
+    assert any(k.startswith("accl_calls_total") for k in m["counters"])

@@ -82,6 +82,9 @@ if [[ $t1_rc -ne 0 ]]; then
 fi
 
 if [[ $CHAOS -eq 1 ]]; then
+    # includes the r18 flight-recorder drill: the shrink/serve scenarios
+    # assert every survivor's death-path dump parses and carries the
+    # PEER_FAILED verdict + final epoch bump (CHAOS-FLIGHT-OK markers)
     echo "[ci_gate] chaos matrix (tests/test_fault.py standalone)..." >&2
     timeout -k 10 450 env JAX_PLATFORMS=cpu python -m pytest \
         tests/test_fault.py -q --continue-on-collection-errors \
