@@ -123,6 +123,9 @@ class ACCL:
         _zero_model.set_overlap_enabled(cfg.zero_overlap)
         _zero_model.set_prefetch_enabled(cfg.zero_prefetch)
         _zero_model.set_replicas_enabled(cfg.shard_replicas)
+        from .models import publish as _publish_model
+
+        _publish_model.set_fused_enabled(cfg.publish_fused)
         from .models import pipeline as _pp_model
         from .ops import pipeline_relay as _pp_relay
 

@@ -815,6 +815,51 @@ def autotune_zero_fsdp(acc, cfg: Optional[ACCLConfig] = None,
     return cfg.replace(zero_overlap=times["fused"] <= times["flat"])
 
 
+def autotune_publish(acc, cfg: Optional[ACCLConfig] = None,
+                     n_layers: int = 2, d_model: int = 256,
+                     n_heads: int = 4, reps: int = 3) -> ACCLConfig:
+    """Measure one fused weight-publication re-shard (the ONE-program
+    train→serve collective, ``models/publish.py``) against the
+    host-gather baseline of the same trainer state on the live mesh
+    (dp = world, tp = 1) and write the winner to ``cfg.publish_fused``
+    — the session A/B register the publisher's ``fused=None``
+    resolution consults.  ICI only — anywhere else the gathers would
+    measure the simulator — and ENGAGE-GATED: a geometry the fused
+    program declines passes the config through untouched (the "fused"
+    arm would time the very baseline it is judged against)."""
+    import jax
+
+    from ..models import publish, zero
+
+    cfg = cfg or acc.config
+    if acc.config.transport != TransportBackend.ICI:
+        return cfg
+    comm = acc.global_comm()
+    W = comm.world_size
+    if W == 1:
+        return cfg
+    if not publish.publish_engages(d_model, n_heads, W, 1, fused=True):
+        return cfg
+    mesh = zero.make_mesh(comm.devices, W, 1)
+    state = zero.init_zero_fsdp(jax.random.PRNGKey(0), mesh, n_layers,
+                                d_model, d_model * 4, n_heads)
+    wire = cfg.dcn_wire_dtype or "off"
+    prog = publish.build_publish_program(mesh, n_layers, d_model,
+                                         n_heads, wire_dtype=wire)
+    times = {}
+    for name, run in (("fused", lambda: prog(state.p)),
+                      ("host", lambda: publish.host_gather_publish(
+                          state.p, d_model, 1, W))):
+        jax.block_until_ready(run())  # compile + warm
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(run())
+            ts.append(time.perf_counter() - t0)
+        times[name] = float(np.min(ts))
+    return cfg.replace(publish_fused=times["fused"] <= times["host"])
+
+
 def autotune_pp(acc, cfg: Optional[ACCLConfig] = None,
                 n_micro: Optional[int] = None, d_model: int = 256,
                 n_rows: int = 64, reps: int = 3) -> ACCLConfig:
@@ -1326,6 +1371,9 @@ def autotune_session(acc, pows: Sequence[int] = (10, 14, 18, 21),
         ("moe_a2a_dw", lambda c: autotune_moe_a2a_dw(
             acc, c, reps=reps, dt=dt)),
         ("zero_fsdp", lambda c: autotune_zero_fsdp(acc, c, reps=reps)),
+        # this round: the weight-publication fused-vs-host-gather
+        # go/no-go (ICI, engage-gated)
+        ("publish", lambda c: autotune_publish(acc, c, reps=reps)),
         # round 17: the pipeline schedule go/no-go (ICI, engage-gated)
         ("pp", lambda c: autotune_pp(acc, c, reps=reps)),
         ("sched_synth", lambda c: autotune_sched_synth(

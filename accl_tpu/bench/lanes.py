@@ -2058,6 +2058,76 @@ def bench_serve_disagg(acc=None, slots: int = 4, d_model: int = 64,
     return rows
 
 
+def bench_weights_publish(comm, cfg=None, n_layers: int = 2,
+                          d_model: int = 256, n_heads: int = 4,
+                          rounds: int = 10) -> List[dict]:
+    """The weight-publication lane (this round): ``weights_publish``
+    times one full train→serve re-shard — the trainer's dp-partitioned
+    travel-layout attention shards into the decode tp layout — as the
+    ONE fused collective program (``models/publish.py``) A/B'd against
+    the host-gather baseline of the SAME state (``np.asarray`` every
+    travel bucket + invert on the controller, the round-trip the
+    collective deletes).  A latency lane: the headline is the fused p50
+    in µs, ``direction: "lower"`` (a publication stalls the version
+    cadence, not the bandwidth), ``host_gather_*`` percentiles and the
+    ``host_over_fused`` speedup ride beside it.
+
+    Honesty flags per the lane protocol: ``fused_engaged`` mirrors
+    :func:`accl_tpu.models.publish.publish_engages` (False zeroes the
+    headline — the timing then measures the committed baseline, on
+    record via ``engage_reason``); ``plan_source``/``plan_shape`` pin
+    what ``synth.resolve_publish_route`` actually resolved for the
+    per-bucket gather leg; ``wire_bytes_ratio`` is the effective
+    cross-slice compression of the session's ``dcn_wire_dtype`` over
+    the full decode-layout payload (1.0 at "off" — the bit-exact
+    pinned default)."""
+    from ..models import publish, zero
+    from ..parallel import synth
+
+    W = comm.world_size
+    tp = 2 if (W >= 4 and W % 2 == 0) else 1
+    dp = W // tp
+    mesh = zero.make_mesh(comm.devices, dp, tp)
+    state = zero.init_zero_fsdp(jax.random.PRNGKey(0), mesh, n_layers,
+                                d_model, d_model * 4, n_heads)
+    wire = (getattr(cfg, "dcn_wire_dtype", "off") or "off") if cfg \
+        else "off"
+    reason = publish.publish_engage_reason(d_model, n_heads, dp, tp)
+    engaged = reason is None
+
+    prog = publish.build_publish_program(mesh, n_layers, d_model,
+                                         n_heads, wire_dtype=wire)
+    t_fused = _latency_dist(prog, state.p, rounds=rounds)
+    t_host = _latency_dist(publish.host_gather_publish, state.p,
+                           d_model, tp, dp, rounds=rounds)
+
+    dtp, _, qrp = zero._attn_travel_sizes(d_model, tp, dp)
+    blk = (qrp // dp) * d_model
+    plan = synth.resolve_publish_route(comm, cfg, blk * 4, count=blk)
+    nbytes = publish.publication_bytes(n_layers, d_model)
+    wire_bytes = synth.dcn_wire_bytes(
+        nbytes, wire if wire != "off" else None, count=nbytes // 4)
+
+    r = {"metric": "weights_publish",
+         "fused_engaged": engaged,
+         "engage_reason": reason,
+         "host_over_fused": round(t_host["p50"] / t_fused["p50"], 3)
+         if t_fused["p50"] > 0 else 0.0,
+         "host_p50_us": round(t_host["p50"] * 1e6, 1),
+         "host_p99_us": round(t_host["p99"] * 1e6, 1),
+         "publish_bytes": nbytes,
+         "wire_bytes_ratio": round(wire_bytes / nbytes, 3)
+         if nbytes else 1.0,
+         "wire_dtype": wire,
+         "plan_source": plan.source if plan is not None else None,
+         "plan_shape": plan.shape if plan is not None else None,
+         "world": W, "dp": dp, "tp": tp,
+         "layers": n_layers, "d_model": d_model, "n_heads": n_heads,
+         "rounds": rounds}
+    r.update(_pctl_fields(t_fused, engaged))
+    return [r]
+
+
 def bench_coll_latency(comm, cfg=None, nbytes: int = 1024,
                        rounds: int = 30) -> List[dict]:
     """The small-message collective latency lane (round 13):

@@ -426,6 +426,21 @@ class ACCLConfig:
     # reports advisory numbers. Write-through to obs.recal.set_enabled.
     sched_online_recal: bool = False
 
+    # fused weight publication (models/publish.py): when True (default)
+    # the train→serve re-shard runs as ONE jitted collective program —
+    # per-travel-bucket dp all-gathers landing directly in the decode
+    # tp layout, wire-staged in dcn_wire_dtype, n-blocked past the
+    # staging budget — with zero unfused collectives and no host
+    # materialization of the full weight. False pins the host-gather
+    # baseline (np.asarray every travel bucket + invert on the
+    # controller — the honest, COUNTED fallback the fused program is
+    # benched against; a requested baseline is never counted). Geometry
+    # or VMEM declines fall back identically, counted once per
+    # publisher build under accl_cmatmul_fallback_total{op="publish"}.
+    # Write-through to models.publish.set_fused_enabled; seeded by
+    # bench.autotune_publish (the measured fused-vs-host go/no-go).
+    publish_fused: bool = True
+
     # compiled-program cache (parallel/compiler.py) LRU bound: a
     # long-lived serving session resolving many (shape, dtype, algo)
     # keys must not grow the cache without limit. Generous by default —

@@ -64,6 +64,11 @@ POINTS = (
     "barrier.arrive",     # barrier arrival (fabric.barrier, pre-increment)
     "handshake.confirm",  # session-nonce confirm read (_resolve_session)
     "rank.death",         # progress loops (fabric.drive, Request.wait)
+    "publish.commit",     # weight-publication landing window (between the
+    #                     # re-shard and the replica staging loop —
+    #                     # models/publish.py WeightPublisher.publish; a
+    #                     # fail/prob hit stales the publication, a die
+    #                     # kills the trainer rank mid-publication)
 )
 
 KINDS = ("fail", "prob", "delay", "drop", "die")

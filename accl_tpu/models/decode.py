@@ -77,6 +77,7 @@ __all__ = [
     "make_decode_mesh", "shard_decode", "publish_tokens",
     "publish_tokens_batch", "pack_token_records", "unpack_token_records",
     "used_pages", "extract_session", "install_session",
+    "assert_swappable",
 ]
 
 
@@ -138,6 +139,23 @@ def init_decode_params(key, d_model: int, n_heads: int, n_kv_heads: int,
 def param_specs() -> DecodeParams:
     return DecodeParams(wq=P(None, TP_AXIS), wk=P(None, TP_AXIS),
                         wv=P(None, TP_AXIS), wo=P(TP_AXIS, None))
+
+
+def assert_swappable(old: DecodeParams, new: DecodeParams) -> None:
+    """The no-recompile invariant of a live weight publication
+    (``models/publish.py``): a staged version must match the serving
+    version leaf-for-leaf in shape and dtype, so the replica's jitted
+    decode step — keyed on abstract values only — survives the pointer
+    swap without retracing.  Raises ``ValueError`` naming the first
+    mismatched projection; a publication that would force a recompile
+    must fail at STAGING time, never between two decode ticks."""
+    for name, a, b in zip(DecodeParams._fields, old, new):
+        if tuple(a.shape) != tuple(b.shape) or a.dtype != b.dtype:
+            raise ValueError(
+                f"staged weights not swappable: {name} is "
+                f"{tuple(b.shape)}/{b.dtype} vs serving "
+                f"{tuple(a.shape)}/{a.dtype} — a version swap must "
+                f"never retrace the decode step")
 
 
 def state_specs() -> DecodeState:

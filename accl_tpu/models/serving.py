@@ -52,9 +52,13 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional, Tuple
 
+import collections
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding
 
 from .. import constants
 from ..constants import dataType
@@ -218,7 +222,65 @@ class PrefillWorker(_Endpoint):
 
 class DecodeReplica(_Endpoint):
     """A decode-only endpoint: sessions arrive pre-filled through the
-    handoff and advance one (or k speculative) token(s) per tick."""
+    handoff and advance one (or k speculative) token(s) per tick.
+
+    Weights are DOUBLE-BUFFERED for live publication
+    (``models/publish.py``): :meth:`stage_weights` lands version N+1
+    into a shadow slot while version N keeps serving every tick, and
+    :meth:`swap_weights` — a host-side pointer exchange the caller runs
+    BETWEEN ticks — promotes it without draining or migrating a single
+    session.  The jitted decode step takes params per call, so the swap
+    never retraces (:func:`decode.assert_swappable`, checked at staging
+    time); no interleaving can observe a torn version."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        #: the SERVING weight version (0 = the cold-start params the
+        #: replica was constructed with; N>0 = publication N landed
+        #: and was swapped in)
+        self.weight_version = 0
+        self._staged: Optional[Tuple[decode.DecodeParams, int]] = None
+
+    def stage_weights(self, params: decode.DecodeParams,
+                      version: int) -> None:
+        """Land publication ``version`` into the shadow slot.  The
+        payload is re-sharded onto this replica's mesh under
+        :func:`decode.param_specs` and swappability is checked HERE —
+        the serving version is untouched whether this succeeds or
+        raises."""
+        from ..obs import metrics
+        decode.assert_swappable(self.params, params)
+        mesh = self._mesh
+        specs = decode.param_specs()
+        staged = decode.DecodeParams(*(
+            jax.device_put(a, NamedSharding(mesh, s))
+            for a, s in zip(params, specs)))
+        self._staged = (staged, int(version))
+        metrics.set_gauge("accl_publish_version", float(version),
+                          labels=(("replica", self.name),
+                                  ("slot", "staged")))
+
+    def staged_version(self) -> Optional[int]:
+        return self._staged[1] if self._staged is not None else None
+
+    def swap_weights(self) -> Optional[int]:
+        """Promote the staged version between decode ticks: a host-side
+        pointer swap — zero drain, zero migration, no retrace.  Returns
+        the new serving version, or None when nothing is staged (an
+        idempotent no-op: calling twice after one publication swaps
+        once)."""
+        from ..obs import metrics
+        if self._staged is None:
+            return None
+        self.params, version = self._staged
+        self._staged = None
+        self.weight_version = version
+        metrics.set_gauge("accl_publish_version", float(version),
+                          labels=(("replica", self.name),
+                                  ("slot", "live")))
+        _flight.record("version_swap", replica=self.name,
+                       version=version)
+        return version
 
     def decode_step(self):
         if "decode" not in self._steps:
@@ -453,7 +515,9 @@ class ServingRouter:
     designed out."""
 
     def __init__(self, acc, workers: List[PrefillWorker],
-                 replicas: List[DecodeReplica], tag_base: int = 7000):
+                 replicas: List[DecodeReplica], tag_base: int = 7000,
+                 queue_depth: int = 0,
+                 queue_timeout_s: Optional[float] = None):
         if not workers or not replicas:
             raise ValueError("need at least one prefill worker and one "
                              "decode replica")
@@ -462,6 +526,17 @@ class ServingRouter:
         self.replicas = {r.name: r for r in replicas}
         self.sessions: Dict[int, Session] = {}
         self._tag = tag_base
+        #: bounded FIFO admission queue: up to ``queue_depth`` sessions
+        #: PARK when every prefill worker is full (a sub-capacity burst
+        #: absorbs instead of shedding) and re-admit in arrival order as
+        #: slots free (:meth:`pump_queue` — run automatically after
+        #: every handoff).  Depth 0 (the default) keeps the original
+        #: immediate-decline behavior; a FULL queue still sheds via
+        #: :class:`RoutingDeclined` — the overflow signal is unchanged,
+        #: it just fires ``queue_depth`` admissions later.
+        self.queue_depth = int(queue_depth)
+        self.queue_timeout_s = queue_timeout_s
+        self._queue: "collections.deque" = collections.deque()
         self._note_sessions()
 
     # -- observability ----------------------------------------------------
@@ -493,20 +568,35 @@ class ServingRouter:
     def admit(self, sid: int, prompt) -> Session:
         """Admit a session to the LEAST-LOADED prefill worker (pending
         prompt tokens, then live slots) and run its chunked prefill.
-        Declines (every worker full) are counted and raised."""
+        With every worker full, the session PARKS in the bounded FIFO
+        when one is configured (``queue_depth``; phase stays "queued"
+        until :meth:`pump_queue` re-admits it) — declines (queue full,
+        or no queue) are counted and raised."""
         prompt = np.asarray(prompt)
         if sid in self.sessions:
             raise ValueError(f"session {sid} already admitted")
+        worker = self._pick_worker()
+        if worker is None:
+            if self.queue_depth and len(self._queue) < self.queue_depth:
+                return self._park(sid, prompt)
+            reason = "queue_full" if self.queue_depth else "no_free_slots"
+            _count_decline(reason)
+            raise RoutingDeclined(
+                f"no prefill worker has a free slot for session {sid}"
+                + (" and the admission queue is full"
+                   if self.queue_depth else ""),
+                [reason])
+        return self._admit_to(sid, prompt, worker)
+
+    def _pick_worker(self) -> Optional[PrefillWorker]:
         ranked = sorted(
             self.workers.values(),
             key=lambda w: (w.pending_tokens, w.live_slots(), w.name))
-        worker = next((w for w in ranked if w.alive and w.free_slots()),
-                      None)
-        if worker is None:
-            _count_decline("no_free_slots")
-            raise RoutingDeclined(
-                f"no prefill worker has a free slot for session {sid}",
-                ["no_free_slots"])
+        return next((w for w in ranked if w.alive and w.free_slots()),
+                    None)
+
+    def _admit_to(self, sid: int, prompt,
+                  worker: PrefillWorker) -> Session:
         slot = worker.free_slots()[0]
         sess = Session(sid=sid, prompt=prompt, phase="prefill",
                        worker=worker.name, slot=slot,
@@ -521,6 +611,58 @@ class ServingRouter:
             worker.pending_tokens -= prompt.shape[0]
         self._note_sessions()
         return sess
+
+    # -- the bounded FIFO admission queue ---------------------------------
+
+    def _park(self, sid: int, prompt) -> Session:
+        from ..obs import metrics
+        sess = Session(sid=sid, prompt=prompt, phase="queued",
+                       length=int(prompt.shape[0]))
+        self.sessions[sid] = sess
+        self._queue.append((sid, time.monotonic()))
+        metrics.set_gauge("accl_serving_router_queue_depth",
+                          float(len(self._queue)))
+        _flight.record("router_park", sid=sid,
+                       depth=len(self._queue))
+        return sess
+
+    def queue_len(self) -> int:
+        return len(self._queue)
+
+    def pump_queue(self) -> List[int]:
+        """Drain the admission queue as far as capacity allows: expire
+        entries parked past ``queue_timeout_s`` (counted into
+        ``accl_serving_router_queue_timeouts_total``, session dropped),
+        then re-admit survivors IN ARRIVAL ORDER while a prefill worker
+        has a free slot.  Runs automatically after every handoff (the
+        moment a worker slot frees); callers under burst can also pump
+        explicitly.  Returns the re-admitted session ids."""
+        from ..obs import metrics
+        admitted: List[int] = []
+        keep: "collections.deque" = collections.deque()
+        now = time.monotonic()
+        while self._queue:
+            sid, t0 = self._queue.popleft()
+            if (self.queue_timeout_s is not None
+                    and now - t0 > self.queue_timeout_s):
+                metrics.inc("accl_serving_router_queue_timeouts_total")
+                _flight.record("router_queue_timeout", sid=sid,
+                               waited_s=round(now - t0, 3))
+                self.sessions.pop(sid, None)
+                continue
+            worker = self._pick_worker()
+            if worker is None:
+                keep.append((sid, t0))
+                keep.extend(self._queue)
+                self._queue.clear()
+                break
+            sess = self.sessions.pop(sid)
+            self._admit_to(sid, sess.prompt, worker)
+            admitted.append(sid)
+        self._queue = keep
+        metrics.set_gauge("accl_serving_router_queue_depth",
+                          float(len(self._queue)))
+        return admitted
 
     # -- routing / handoff ------------------------------------------------
 
@@ -566,6 +708,9 @@ class ServingRouter:
         sess.worker, sess.slot = None, dst_slot
         sess.replica, sess.phase = dst_r.name, "decode"
         self._note_sessions()
+        # the handoff just freed a prefill slot — give the head of the
+        # admission queue first claim on it
+        self.pump_queue()
         return dst_r
 
     def migrate(self, sid: int,

@@ -571,7 +571,13 @@ def init_zero_fsdp(key, mesh, n_layers: int, d_model: int, d_hidden: int,
         w2t.append(np.ascontiguousarray(w2.T))           # (d, h) travel
 
     specs = fsdp_param_specs(n_layers)
-    put = lambda a, s: jax.device_put(a, NamedSharding(mesh, s))
+    # every process computed the identical full host value above, so
+    # each can place its own shards locally — device_put with a global
+    # sharding would demand process-addressability of every shard (the
+    # multi-controller hazard the helpers at the top of this file
+    # document) and hang on a survivor submesh after a shrink
+    put = lambda a, s: jax.make_array_from_callback(
+        a.shape, NamedSharding(mesh, s), lambda idx, a=a: a[idx])
     p = FSDPParams(
         wqkvt=tuple(put(a, s) for a, s in zip(wqkvt, specs.wqkvt)),
         wot=tuple(put(a, s) for a, s in zip(wot, specs.wot)),
@@ -580,12 +586,37 @@ def init_zero_fsdp(key, mesh, n_layers: int, d_model: int, d_hidden: int,
     )
     def zeros_like_sharded():
         return jax.tree_util.tree_map(
-            lambda a: jax.device_put(np.zeros(a.shape, a.dtype),
-                                     a.sharding), p)
+            lambda a: jax.make_array_from_callback(
+                a.shape, a.sharding,
+                lambda idx, sh=a.shape, dt=a.dtype:
+                    np.zeros(sh, dt)[idx]), p)
 
     return ZeroFSDPState(p=p, m=zeros_like_sharded(),
                          v=zeros_like_sharded(),
                          t=jnp.zeros((), jnp.int32))
+
+
+def attn_from_travel(wqkvt: np.ndarray, wot: np.ndarray, d_model: int,
+                     tp: int, dp: int):
+    """Invert one layer's attention travel construction on the host:
+    ``(wqkvt (tp·q_rows_pad, d), wot (d, d)) -> (wq, wk, wv, wo)`` all
+    (d, d) — the EXACT inverse of the :func:`init_zero_fsdp` block
+    build (per tp rank: un-pad, un-concat, un-transpose).  This is the
+    ONE copy of the inversion math: the publication module's
+    host-gather baseline and the fused re-shard program's parity tests
+    both call it, so the two paths can never drift
+    (``models/publish.py``)."""
+    dtp, q_rows, q_rows_pad = _attn_travel_sizes(d_model, tp, dp)
+    wq = np.empty((d_model, d_model), wqkvt.dtype)
+    wk = np.empty_like(wq)
+    wv = np.empty_like(wq)
+    for s in range(tp):
+        cols = slice(s * dtp, (s + 1) * dtp)
+        blk = wqkvt[s * q_rows_pad:s * q_rows_pad + q_rows]  # (3·dtp, d)
+        wq[:, cols] = blk[0:dtp].T
+        wk[:, cols] = blk[dtp:2 * dtp].T
+        wv[:, cols] = blk[2 * dtp:3 * dtp].T
+    return wq, wk, wv, np.ascontiguousarray(wot.T)
 
 
 # ---------------------------------------------------------------------------

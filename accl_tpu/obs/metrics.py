@@ -38,13 +38,15 @@ name                                           kind       labels
 ``accl_dcn_wire_bytes_total``                  counter    op, dtype, stage (pre | post: two-tier cross-slice leg bytes before/after compression, per dispatch resolution)
 ``accl_program_cache_total``                   counter    event (hit | miss | evict)
 ``accl_program_cache_size``                    gauge      (none)
-``accl_latency_dispatch_seconds``              histogram  path (µs-resolution buckets; eager_send | collective | prefill | decode | verify | handoff | migrate)
+``accl_latency_dispatch_seconds``              histogram  path (µs-resolution buckets; eager_send | collective | prefill | decode | verify | handoff | migrate | publish)
 ``accl_flash_decode_fallback_total``           counter    reason (mode | geometry | vmem_miss)
 ``accl_flash_prefill_fallback_total``          counter    reason (mode | geometry | vmem_miss)
 ``accl_serving_tokens_total``                  counter    phase (prefill | decode | verify), accepted (true | false)
 ``accl_serving_sessions``                      gauge      replica, phase (prefill | decode: fleet occupancy per endpoint)
 ``accl_serving_handoff_bytes_total``           counter    dtype (KV page bytes shipped by handoffs/migrations, in the pool's at-rest dtype)
-``accl_serving_router_declines_total``         counter    reason (no_free_slots | dead_replica | codec_mismatch)
+``accl_serving_router_declines_total``         counter    reason (no_free_slots | dead_replica | codec_mismatch | queue_full: admission-queue overflow shed)
+``accl_serving_router_queue_depth``            gauge      (none; parked sessions in the bounded FIFO admission queue)
+``accl_serving_router_queue_timeouts_total``   counter    (none; parked sessions expired past queue_timeout_s)
 ``accl_rx_pool_batch_total``                   counter    outcome (reserved | exhausted: all-or-nothing page-batch claims)
 ``accl_sendrecv_page_batch_total``             counter    outcome (batched | fallback: page-batch eager sends vs per-payload fallback)
 ``accl_fault_injected_total``                  counter    point, kind (fault.py chaos harness)
@@ -57,6 +59,9 @@ name                                           kind       labels
 ``accl_flight_events_total``                   counter    kind (obs/flight.py ring events — one bump per recorded event; catalog in docs/observability.md)
 ``accl_cluster_snapshot_total``                counter    event (published: per rank snapshot pushed to the KV | merged: per rank folded by ``cluster_stats()`` | stale: per merged rank past the staleness bound)
 ``accl_recal_total``                           counter    outcome (applied | advisory | insufficient_data: one per ``maybe_recalibrate`` pass — obs/recal.py)
+``accl_publish_total``                         counter    outcome (committed: version landed on every replica's shadow slot | stale: epoch bump / death verdict / injected fault during the landing window — NOTHING landed; models/publish.py)
+``accl_publish_bytes_total``                   counter    dtype (decode-layout payload bytes of each committed publication)
+``accl_publish_version``                       gauge      replica, slot (staged | live: the weight version each replica holds in its shadow vs serving slot)
 =============================================  =========  =================
 
 Export formats: :meth:`MetricsRegistry.snapshot` (flat, JSON-safe dict),
@@ -370,7 +375,9 @@ def note_latency_dispatch(path: str, t0: float) -> None:
     the single-segment eager fast path; ``collective`` — a bandwidth
     collective below ``latency_tier_threshold``; ``prefill`` /
     ``decode`` / ``verify`` — the serving tier's step-dispatch phases,
-    observed by the ``models.decode`` step wrappers). No-op when
+    observed by the ``models.decode`` step wrappers; ``handoff`` /
+    ``migrate`` — the router's page transfers; ``publish`` — one full
+    weight publication, re-shard through landing). No-op when
     disabled or when ``t0`` is 0.0 (the disabled :func:`tick`
     sentinel)."""
     if not ENABLED or not t0:

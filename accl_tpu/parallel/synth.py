@@ -1212,6 +1212,29 @@ def resolve(op: operation, nbytes: int, comm, cfg: ACCLConfig,
     return plan
 
 
+def resolve_publish_route(comm, cfg: ACCLConfig, nbytes: int,
+                          count: Optional[int] = None
+                          ) -> Optional[SchedulePlan]:
+    """Price the weight-publication re-shard route
+    (``models/publish.py``): the fused program's per-bucket dp
+    all-gather leg, resolved through the SAME ladder + cost-model
+    arbitration as any other collective (``_select_legacy`` →
+    :func:`resolve`) so the ticket's ``plan_source``/``plan_shape``
+    honesty pair means exactly what it means on the dispatch path —
+    including the DCN two-tier window, where the cross-slice hop of a
+    multi-slice publication is priced at the effective
+    :func:`dcn_wire_bytes`.  ``nbytes`` is the per-block gather payload
+    (the allgather byte convention).  Returns None when no communicator
+    is live (single-process bring-up prices nothing)."""
+    if comm is None or cfg is None:
+        return None
+    from . import algorithms
+    legacy = algorithms._select_legacy(operation.allgather, nbytes, comm,
+                                       cfg, count=count)
+    return resolve(operation.allgather, nbytes, comm, cfg, legacy,
+                   count=count)
+
+
 # ---------------------------------------------------------------------------
 # schedule validation: the ownership algebra
 # ---------------------------------------------------------------------------

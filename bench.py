@@ -91,6 +91,9 @@ KNOWN_LANES = (
     # this round (disaggregated serving): decode p99 with a concurrent
     # long prefill, colocated vs disaggregated, plus the KV handoff µs
     "serve_disagg",
+    # this round (live weight publication): the fused train→serve
+    # re-shard collective vs the host-gather baseline, p50/p99 µs
+    "weights_publish",
 )
 
 
@@ -529,6 +532,14 @@ def main(argv=None) -> int:
              lambda: (_lanes.bench_serve_disagg() if on_tpu
                       else _lanes.bench_serve_disagg(
                           prefill_len=32, rounds=2))),
+            # this round: the weight-publication A/B — the fused
+            # re-shard collective vs the host-gather round-trip, with
+            # the synth route and the wire-byte ratio on record
+            ("weights_publish",
+             lambda: (_lanes.bench_weights_publish(comm, cfg=acc.config)
+                      if on_tpu
+                      else _lanes.bench_weights_publish(
+                          comm, cfg=acc.config, d_model=64, rounds=3))),
         ):
             if not _lane_selected(lanes_filter, name):
                 continue

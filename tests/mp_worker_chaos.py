@@ -1,7 +1,7 @@
 """Chaos-matrix worker driven by ``python -m accl_tpu.launch`` (the mpirun
 rung of tests/test_fault.py).
 
-Two scenarios, selected by ``ACCL_CHAOS``:
+Scenarios, selected by ``ACCL_CHAOS``:
 
 * ``transient`` — every controller arms the SAME seeded :class:`FaultPlan`
   (3 transient failures at each KV injection point, a dropped eager
@@ -20,6 +20,20 @@ Two scenarios, selected by ``ACCL_CHAOS``:
   ``ACCL.recover()`` — the elastic epoch re-handshake — and proves the
   fresh epoch with bit-exact send/recv round-trips both ways plus the
   collective matrix.
+
+* ``shrink`` — kill 1 of 4, TRUE rank loss: no-argument ``recover()``
+  converges the survivor subset, the mesh shrinks, and ZeRO training
+  resumes from the buddy replica bit-exactly (docstring on the
+  function).
+
+* ``serve`` — disaggregated-serving drill: real cross-process KV
+  handoffs, a decode replica killed mid-session, the lost session
+  re-prefilled onto the survivor bit-exactly.
+
+* ``publish`` — weight-publication drill: a trainer rank killed AT the
+  publication commit point; the in-flight publication goes stale (no
+  torn swap), serving keeps decoding the landed version, and the next
+  publication commits on the shrunk mesh.
 """
 import os
 import sys
@@ -610,6 +624,189 @@ def serve() -> int:
     return 0
 
 
+def publish_drill() -> int:
+    """Weight-publication failure drill (3 controllers, 1 device each):
+    every controller is a trainer dp rank on one (dp=3, tp=1) ZeRO
+    mesh; rank 0 ALSO hosts a decode replica (+ a never-faulted mirror,
+    the bit-exactness oracle) on its local devices — the two fault
+    domains of ``models/publish.py`` in one script.  Publication v1
+    lands and swaps cleanly; then rank 2 dies AT the publication commit
+    point (``publish.commit`` armed ``die``) while the survivors hold
+    the same publication open until the death verdict latches — their
+    attempt goes STALE (counted, nothing staged, no torn swap) and the
+    replica keeps decoding version 1 bit-exact against the mirror.
+    ``recover()`` shrinks the session to {0, 1}, the publisher rebinds
+    onto the (dp=2, tp=1) survivor mesh with its version counter
+    intact, and publication v2 commits — decode at v2 bit-identical to
+    a cold-start replica built from the same weights."""
+    import accl_tpu.multiproc as mp
+    from accl_tpu.models import decode as dmod
+    from accl_tpu.models import publish as pmod
+    from accl_tpu.models import serving as smod
+    from accl_tpu.models import zero as zmod
+    from accl_tpu.models.mlp import make_mesh
+
+    me = jax.process_index()
+    fdir = _flight_dump_dir()
+    # lenient staleness window for the compile-heavy warmup (heartbeats
+    # only refresh on fabric progress; the fused publication program
+    # compiles cross-process with no ACCL calls), tightened to 2.5 s
+    # around the actual death drill.
+    cfg = accl_tpu.ACCLConfig(timeout=60.0, heartbeat_interval_s=0.2,
+                              heartbeat_timeout_s=30.0)
+    acc = accl_tpu.ACCL(config=cfg)
+    W = acc.world_size
+    assert W == 3, "publish scenario is a 3-controller, 1-device/proc script"
+    DEAD = 2
+    DONE_KEY = "accl/chaos_publish/done"
+
+    # one trainer geometry that stays valid on BOTH the full (dp=3) and
+    # the shrunk (dp=2) mesh: d_model % dp for dp in {3, 2}
+    L, d_model, d_hidden, n_heads = 1, 12, 24, 4
+    slots, pmax, page = 2, 2, 8
+    hkv, hd = n_heads, d_model // n_heads
+    comm = acc.global_comm()
+    mesh = make_mesh(comm.devices, W, 1)
+    state = zmod.init_zero_fsdp(jax.random.PRNGKey(0), mesh, L,
+                                d_model, d_hidden, n_heads)
+    pub = pmod.WeightPublisher(acc, mesh, L, d_model, d_hidden,
+                               n_heads)
+    assert pub.fused, pub.reason
+
+    def host_params(params):
+        # tp=1: every decode-layout leaf is dp-replicated, so the local
+        # shard IS the full matrix — the replica staging hop reads it
+        # host-side (the serving tier lives on rank 0's own devices)
+        return dmod.DecodeParams(*[
+            np.asarray(leaf.addressable_shards[0].data)
+            for leaf in params[0]])
+
+    # ---- publication v1 lands; the replica swaps between ticks --------
+    p1 = host_params(pub.reshard(state))     # SPMD: all ranks execute
+    ticket = pub.publish(state)
+    assert ticket.outcome == "committed" and pub.version == 1, ticket
+    print(f"[p{me}] publication v1 committed ({ticket.route})",
+          flush=True)
+
+    local = jax.local_devices()
+    rngx = np.random.default_rng(13)
+    xs = [rngx.standard_normal((slots, d_model)).astype(np.float32)
+          * 0.1 for _ in range(6)]
+    if me == 0:
+        params0 = dmod.init_decode_params(jax.random.PRNGKey(5),
+                                          d_model, n_heads, hkv, hd)
+        rep = smod.DecodeReplica("live", 0, params0, slots, pmax, page,
+                                 hkv, hd, devices=local)
+        mir = smod.DecodeReplica("mir", 0, params0, slots, pmax, page,
+                                 hkv, hd, devices=local)
+        for r in (rep, mir):
+            r.stage_weights(p1, 1)
+            assert r.swap_weights() == 1
+        for x in xs[:2]:
+            assert np.array_equal(rep.decode_tick(x),
+                                  mir.decode_tick(x))
+        print(f"[p{me}] PUBLISH-V1-OK (replica swapped, bit-exact)",
+              flush=True)
+
+    acc.barrier()
+    # warmup compiled and synced: arm the FAST liveness bound
+    acc._fabric.heartbeat_timeout = 2.5
+    t0 = time.monotonic()
+
+    if me == DEAD:
+        # die AT the commit point of publication v2 — mid-publication:
+        # the re-shard collective completed, the landing never happens
+        fault.install(FaultPlan([FaultSpec("publish.commit",
+                                           kind="die")]))
+        try:
+            pub.publish(state)
+            raise AssertionError("injected publish death did not fire")
+        except RankDeath:
+            pass
+        fault.clear()
+        print(f"[p{me}] trainer rank dead mid-publication", flush=True)
+        mp._client().blocking_key_value_get(DONE_KEY, 300_000)
+        print(f"[p{me}] CHAOS-PUBLISH-DEAD-OK", flush=True)
+        return 0
+
+    # ---- survivors: the SAME publication attempt goes stale -----------
+    # hold the commit open until the death verdict latches (the DCN
+    # window the epoch/death guard exists for): the re-shard completes
+    # — every rank executed the program before the commit point — but
+    # the view moved, so NOTHING lands
+    real_reshard = pub.reshard
+
+    def reshard_then_latch(st):
+        out = real_reshard(st)
+        jax.block_until_ready(out)
+        deadline = time.monotonic() + 20.0
+        while DEAD not in acc._fabric.dead_peers:
+            acc._pump()
+            acc._fabric.check_peers()
+            assert time.monotonic() < deadline, "death never detected"
+            time.sleep(0.05)
+        return out
+
+    pub.reshard = reshard_then_latch
+    t2 = pub.publish(state)
+    pub.reshard = real_reshard
+    elapsed = time.monotonic() - t0
+    assert elapsed < 20.0, f"death detection took {elapsed:.1f}s"
+    assert t2.outcome == "stale" and pub.version == 1, t2
+    snapc = metrics.snapshot()["counters"]
+    assert snapc.get('accl_publish_total{outcome="stale"}', 0) == 1
+    print(f"[p{me}] PEER_FAILED({DEAD}) in {elapsed:.1f}s -> "
+          f"publication stale", flush=True)
+
+    if me == 0:
+        # no torn swap: version 1 keeps serving, bit-exact, nothing
+        # staged underneath it
+        assert rep.weight_version == 1 and rep.staged_version() is None
+        for x in xs[2:4]:
+            assert np.array_equal(rep.decode_tick(x),
+                                  mir.decode_tick(x))
+        print(f"[p{me}] PUBLISH-STALE-OK (v1 serving untouched)",
+              flush=True)
+
+    # ---- shrink, rebind, publish v2 on the survivor mesh --------------
+    epoch = acc.recover()
+    assert epoch == 1 and acc.world_size == 2, (epoch, acc.world_size)
+    _assert_death_dump(fdir, DEAD, acc._fabric.epoch)
+    print(f"[p{me}] CHAOS-FLIGHT-OK", flush=True)
+    acc._fabric.heartbeat_timeout = 30.0
+
+    new_comm = acc.global_comm()
+    mesh2 = make_mesh(new_comm.devices, 2, 1)
+    pub.rebind(mesh2)
+    assert pub.version == 1      # the counter carries across the shrink
+    state2 = zmod.init_zero_fsdp(jax.random.PRNGKey(1), mesh2, L,
+                                 d_model, d_hidden, n_heads)
+    p2 = host_params(pub.reshard(state2))
+    t3 = pub.publish(state2)
+    assert t3.outcome == "committed" and pub.version == 2, t3
+    snapc = metrics.snapshot()["counters"]
+    assert snapc.get('accl_publish_total{outcome="committed"}', 0) == 2
+    print(f"[p{me}] publication v2 committed on the shrunk mesh",
+          flush=True)
+
+    if me == 0:
+        rep.stage_weights(p2, 2)
+        assert rep.swap_weights() == 2 and rep.weight_version == 2
+        cold = smod.DecodeReplica("cold", 0, p2, slots, pmax, page,
+                                  hkv, hd, devices=local)
+        for x in xs[4:]:
+            assert np.array_equal(rep.decode_tick(x),
+                                  cold.decode_tick(x))
+        print(f"[p{me}] v2 decode bit-identical to cold start",
+              flush=True)
+
+    acc.barrier()
+    if me == 0:
+        mp._client().key_value_set(DONE_KEY, "1")
+    print(f"[p{me}] CHAOS-PUBLISH-OK", flush=True)
+    return 0
+
+
 def main() -> int:
     scenario = os.environ.get("ACCL_CHAOS", "transient")
     if scenario == "death":
@@ -618,6 +815,8 @@ def main() -> int:
         return shrink()
     if scenario == "serve":
         return serve()
+    if scenario == "publish":
+        return publish_drill()
     return transient()
 
 

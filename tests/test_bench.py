@@ -1052,3 +1052,78 @@ def test_serving_lanes_in_known_lanes_and_compare():
     st = {r["metric"]: r["status"] for r in out["rows"]}
     assert all(st[n] == "improvement" for n in names)
     assert not out["regressed"]
+
+
+def test_weights_publish_lane_schema(accl):
+    """The weight-publication lane follows the latency-lane protocol on
+    every rung: direction=lower µs headline, fused-vs-host-gather A/B
+    fields always on record, the honesty flag mirroring the publish
+    engage resolution, and the synth route + wire-byte ratio pinned."""
+    from accl_tpu.bench import lanes
+    from accl_tpu.models import publish
+
+    rows = lanes.bench_weights_publish(accl.global_comm(),
+                                       cfg=accl.config, n_layers=1,
+                                       d_model=16, n_heads=4, rounds=2)
+    assert [r["metric"] for r in rows] == ["weights_publish"]
+    r = rows[0]
+    assert r["unit"] == "us" and r["direction"] == "lower"
+    assert r["world"] == accl.world_size
+    assert r["dp"] * r["tp"] == r["world"]
+    assert r["fused_engaged"] == publish.publish_engages(
+        16, 4, r["dp"], r["tp"])
+    assert r["resolved"] == r["fused_engaged"]
+    assert r["p50_us"] > 0 and r["p99_us"] >= r["p50_us"] > 0 \
+        or r["p99_us"] >= 0
+    assert r["host_p50_us"] > 0 and r["host_over_fused"] > 0
+    assert r["publish_bytes"] == publish.publication_bytes(1, 16)
+    assert r["wire_dtype"] == (accl.config.dcn_wire_dtype or "off")
+    if r["wire_dtype"] == "off":
+        assert r["wire_bytes_ratio"] == 1.0
+    assert r["plan_source"] in ("legacy", "cost_model", "latency_tier",
+                                "override", "full_authority")
+    assert r["plan_shape"] is not None
+    if not r["resolved"]:
+        assert r["value"] == 0.0
+        assert r["engage_reason"] is not None
+
+
+def test_weights_publish_in_known_lanes_and_compare():
+    """bench.py --lanes accepts the publish lane, and compare.py
+    applies the LOWER-is-better polarity: a publication latency going
+    up is the regression, an honesty-zeroed row stays incomparable."""
+    from bench import KNOWN_LANES
+    from accl_tpu.bench import compare
+
+    assert "weights_publish" in KNOWN_LANES
+
+    def art(v, resolved=True):
+        return {"metric": "m", "value": 1.0, "lanes": [
+            {"metric": "weights_publish", "value": v,
+             "resolved": resolved, "direction": "lower"}]}
+
+    base = art(100.0)
+    out = compare.compare(base, art(130.0))
+    assert out["regressions"] == ["weights_publish"]
+    out = compare.compare(base, art(80.0))
+    st = {r["metric"]: r["status"] for r in out["rows"]}
+    assert st["weights_publish"] == "improvement"
+    out = compare.compare(base, art(0.0, resolved=False))
+    st = {r["metric"]: r["status"] for r in out["rows"]}
+    assert st["weights_publish"] == "incomparable"
+    assert not out["regressed"]
+
+
+def test_autotune_publish_gates(accl):
+    """autotune_publish is ICI-gated (the emulator rung passes the
+    config through untouched) and rides autotune_session's stage list —
+    the go/no-go writes cfg.publish_fused only where the fused program
+    can actually be measured."""
+    import inspect
+
+    from accl_tpu.bench import autotune
+
+    cfg = autotune.autotune_publish(accl, accl.config, reps=1)
+    assert cfg.publish_fused == accl.config.publish_fused
+    src = inspect.getsource(autotune.autotune_session)
+    assert "autotune_publish" in src

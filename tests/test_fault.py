@@ -859,3 +859,27 @@ def test_chaos_serving_replica_death_reroutes_sessions():
     assert res.stdout.count("CHAOS-SERVE-DEAD-OK") == 1
     # both survivors parsed a flight dump carrying the death verdict
     assert res.stdout.count("CHAOS-FLIGHT-OK") == 2
+
+
+def test_chaos_trainer_death_mid_publication():
+    """Weight-publication fault-domain acceptance: a trainer rank
+    killed AT the publication commit point stales the in-flight
+    publication on every survivor (counted, NOTHING staged — the
+    no-torn-swap contract), the serving replica keeps decoding
+    version N bit-exact against a never-faulted mirror, and after the
+    round-15 shrink the publisher rebinds onto the survivor mesh —
+    version counter intact — and lands version N+1 whose decode is
+    bit-identical to a cold start."""
+    res = _run_launcher(
+        ["-np", "3", "--devices-per-proc", "1",
+         os.path.join("tests", "mp_worker_chaos.py")],
+        extra_env={"ACCL_CHAOS": "publish"})
+    sys.stdout.write(res.stdout)
+    sys.stderr.write(res.stderr)
+    assert res.returncode == 0, f"launcher rc={res.returncode}"
+    assert res.stdout.count("PUBLISH-V1-OK") == 1
+    assert res.stdout.count("PUBLISH-STALE-OK") == 1
+    assert res.stdout.count("CHAOS-PUBLISH-OK") == 2
+    assert res.stdout.count("CHAOS-PUBLISH-DEAD-OK") == 1
+    # both survivors parsed a flight dump carrying the death verdict
+    assert res.stdout.count("CHAOS-FLIGHT-OK") == 2

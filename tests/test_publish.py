@@ -1,0 +1,420 @@
+"""Live weight publication (models/publish.py): the fused train→serve
+re-shard collective and its version/fault protocol.
+
+Four pin layers:
+
+* **parity** — the ONE-program fused re-shard equals the host-gather
+  baseline bit-for-bit at ``dcn_wire_dtype="off"`` across worlds
+  {2, 4, 8} and (dp, tp) composed meshes (both paths share
+  ``zero.attn_from_travel``, so this pins the COLLECTIVE route, not
+  the inversion math twice);
+* **trace** — one jitted program, exactly one dp all-gather per travel
+  bucket, zero unfused all_to_all/psum, n-blocking value-neutral;
+* **versioning** — staged landing + between-tick swap is bit-identical
+  to a cold start from the same weights, never retraces, and survives
+  wire-staged (bf16/bf16_sr) publications within codec tolerance;
+* **fault domains** — an injected ``publish.commit`` fault or an
+  epoch/death movement stales the publication with NOTHING landed
+  (version N keeps serving), counted exactly once; a shrink rebind
+  republishes with the version counter intact.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accl_tpu import fault
+from accl_tpu.fault import FaultPlan, FaultSpec
+from accl_tpu.models import decode, publish, serving, zero
+from accl_tpu.models.mlp import make_mesh
+from accl_tpu.obs import metrics as obs_metrics
+from accl_tpu.ops import collective_matmul as cm
+
+L, D, H = 2, 16, 4      # layers, d_model, n_heads (d_hidden = 2·D)
+
+
+def _mesh(dp, tp):
+    return make_mesh(jax.devices()[:dp * tp], dp, tp)
+
+
+def _state(dp, tp, seed=0):
+    mesh = _mesh(dp, tp)
+    return mesh, zero.init_zero_fsdp(jax.random.PRNGKey(seed), mesh, L,
+                                     D, 2 * D, H)
+
+
+def _replica(params, name="r0", slots=2):
+    return serving.DecodeReplica(name, 0, params, slots, 2, 8, H, D // H)
+
+
+class _AccStub:
+    """The publisher's view of a session: config + comm + epoch/death
+    observation, with the latter two mutable so the stale protocol is
+    testable at exact interleavings."""
+
+    def __init__(self, acc=None):
+        self._acc = acc
+        self._epoch = 0
+        self._fabric = None
+
+    @property
+    def config(self):
+        return self._acc.config if self._acc is not None else None
+
+    def global_comm(self):
+        return self._acc.global_comm() if self._acc is not None else None
+
+
+# ---------------------------------------------------------------------------
+# parity: fused == host-gather at wire "off", every geometry
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dp,tp", [(2, 1), (4, 1), (8, 1), (2, 2),
+                                   (4, 2)])
+def test_fused_reshard_matches_host_gather(accl, dp, tp):
+    """The fused program's outputs are BIT-IDENTICAL to the host-gather
+    baseline at wire "off" — worlds {2, 4, 8} plus the (dp, tp)
+    composed meshes the acceptance pins."""
+    mesh, st = _state(dp, tp)
+    prog = publish.build_publish_program(mesh, L, D, H)
+    fused = prog(st.p)
+    base = publish.host_gather_publish(st.p, D, tp, dp)
+    for f, b in zip(fused, base):
+        for name, a, c in zip(decode.DecodeParams._fields, f, b):
+            assert np.array_equal(np.asarray(a), np.asarray(c)), name
+
+
+def test_attn_from_travel_inverts_construction(accl):
+    """attn_from_travel really is the inverse: rebuilding the travel
+    blocks from its outputs reproduces the trainer shards exactly."""
+    dp, tp = 2, 2
+    mesh, st = _state(dp, tp)
+    dtp, q_rows, qrp = zero._attn_travel_sizes(D, tp, dp)
+    wqkvt = np.asarray(st.p.wqkvt[0])
+    wq, wk, wv, wo = zero.attn_from_travel(wqkvt, np.asarray(st.p.wot[0]),
+                                           D, tp, dp)
+    for s in range(tp):
+        cols = slice(s * dtp, (s + 1) * dtp)
+        blk = np.concatenate([wq[:, cols], wk[:, cols], wv[:, cols]],
+                             axis=1).T
+        pad = np.zeros((qrp - q_rows, D), blk.dtype)
+        np.testing.assert_array_equal(
+            np.concatenate([blk, pad]),
+            wqkvt[s * qrp:(s + 1) * qrp])
+
+
+def test_published_layout_matches_decode_specs(accl):
+    """The fused outputs land SHARDED per decode.param_specs — columns
+    over tp for q/k/v, rows over tp for o — straight off the program,
+    no re-shard on the way into a replica."""
+    mesh, st = _state(2, 2)
+    params = publish.build_publish_program(mesh, L, D, H)(st.p)
+    specs = decode.param_specs()
+    for p in params:
+        for a, s in zip(p, specs):
+            assert a.shape == (D, D)
+            want = jax.sharding.NamedSharding(mesh, s)
+            assert a.sharding.is_equivalent_to(want, a.ndim)
+
+
+# ---------------------------------------------------------------------------
+# trace: ONE program, only the planned dp gathers
+# ---------------------------------------------------------------------------
+
+def _trace(mesh, st, **kw):
+    prog = publish.build_publish_program(mesh, L, D, H, **kw)
+    return str(jax.make_jaxpr(prog)(st.p))
+
+
+def test_trace_pins_one_gather_per_bucket(accl):
+    """The traced publication program contains EXACTLY one dp
+    all-gather per travel bucket (Wqkvᵀ + Woᵀ per layer) and zero
+    unfused all_to_all / psum — the acceptance's trace-level pin."""
+    mesh, st = _state(2, 2)
+    t = _trace(mesh, st)
+    assert t.count("= all_gather[") == 2 * L
+    assert "all_to_all" not in t
+    assert "psum(" not in t
+
+
+def test_trace_nblock_splits_gathers(accl, monkeypatch):
+    """Past the staging budget the gather n-blocks INSIDE the same
+    program (more, smaller gathers — round-20 discipline), and the
+    outputs stay bit-identical to the unblocked program."""
+    mesh, st = _state(2, 2)
+    base = publish.build_publish_program(mesh, L, D, H)(st.p)
+    monkeypatch.setattr(publish, "_STAGE_BUDGET", 512)
+    assert cm.get_nblock_enabled()
+    t = _trace(mesh, st)
+    assert t.count("= all_gather[") > 2 * L
+    blocked = publish.build_publish_program(mesh, L, D, H)(st.p)
+    for f, b in zip(base, blocked):
+        for a, c in zip(f, b):
+            assert np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_wire_staged_trace_casts_payload(accl):
+    """A bf16 wire publication stages the gather payload through the
+    wire codec (convert_element_type / cast lanes appear); "off" stays
+    cast-free on the gather legs."""
+    mesh, st = _state(2, 2)
+    t_off = _trace(mesh, st)
+    t_bf16 = _trace(mesh, st, wire_dtype="bf16")
+    assert t_bf16.count("bf16") > t_off.count("bf16")
+
+
+# ---------------------------------------------------------------------------
+# engage policy + fallback honesty
+# ---------------------------------------------------------------------------
+
+def test_engage_reasons(accl):
+    assert publish.publish_engage_reason(D, H, 2, 2) is None
+    assert publish.publish_engage_reason(D, H, 2, 2,
+                                         fused=False) == "off"
+    # d_model not divisible by n_heads / tp not dividing heads
+    assert publish.publish_engage_reason(18, 4, 2, 2) == "geometry"
+    assert publish.publish_engage_reason(D, 3, 2, 3) == "geometry"
+
+
+def test_vmem_miss_requires_nblock(accl, monkeypatch):
+    """A bucket past the staging budget engages via n-blocking; with
+    blocking disabled it declines ``vmem_miss`` — and the publisher
+    then COMMITS to the host-gather baseline, counted exactly once per
+    build under accl_cmatmul_fallback_total{op="publish"}."""
+    monkeypatch.setattr(publish, "_STAGE_BUDGET", 512)
+    assert publish.publish_engage_reason(D, H, 2, 2) is None
+    saved = cm.get_nblock_enabled()
+    cm.set_nblock_enabled(False)
+    try:
+        assert publish.publish_engage_reason(D, H, 2, 2) == "vmem_miss"
+        cm.reset_fallback_warnings()
+        mesh, st = _state(2, 2)
+        before = obs_metrics.snapshot()
+        pub = publish.WeightPublisher(_AccStub(), mesh, L, D, 2 * D, H)
+        assert not pub.fused and pub.reason == "vmem_miss"
+        t1 = pub.publish(st)
+        t2 = pub.publish(st)
+        assert (t1.route, t2.route) == ("host_gather", "host_gather")
+        d = obs_metrics.delta(before)["counters"]
+        key = ('accl_cmatmul_fallback_total{op="publish",'
+               'reason="vmem_miss"}')
+        assert d.get(key) == 1   # once per BUILD, not per publish
+    finally:
+        cm.set_nblock_enabled(saved)
+
+
+def test_requested_baseline_not_counted(accl):
+    """fused=False is a REQUESTED baseline — route host_gather, reason
+    "off", and no fallback counter moves (the cmatmul discipline)."""
+    mesh, st = _state(2, 2)
+    before = obs_metrics.snapshot()
+    pub = publish.WeightPublisher(_AccStub(), mesh, L, D, 2 * D, H,
+                                  fused=False)
+    assert pub.reason == "off" and not pub.fused
+    t = pub.publish(st)
+    assert t.route == "host_gather" and t.outcome == "committed"
+    d = obs_metrics.delta(before)["counters"]
+    assert not any(k.startswith("accl_cmatmul_fallback_total"
+                                '{op="publish"') for k in d)
+
+
+def test_register_write_through(accl):
+    """ACCLConfig.publish_fused writes through to the module register on
+    every config assignment (the zero_overlap pattern)."""
+    saved = accl.config
+    try:
+        accl.config = saved.replace(publish_fused=False)
+        assert publish.get_fused_enabled() is False
+        assert publish.publish_engage_reason(D, H, 2, 2) == "off"
+        accl.config = saved.replace(publish_fused=True)
+        assert publish.get_fused_enabled() is True
+    finally:
+        accl.config = saved
+
+
+# ---------------------------------------------------------------------------
+# versioning: staged landing, between-tick swap, cold-start identity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("wire", ["off", "bf16", "bf16_sr"])
+def test_decode_after_swap_matches_cold_start(accl, wire):
+    """Decode after the between-tick swap is BIT-IDENTICAL to a cold
+    start from the same published weights at wire "off", and the wire
+    codecs stay within bf16 tolerance of the f32 reference — the
+    acceptance's identity pin."""
+    mesh, st = _state(2, 2, seed=3)
+    pub = publish.WeightPublisher(_AccStub(accl), mesh, L, D, 2 * D, H,
+                                  wire_dtype=wire)
+    old = decode.init_decode_params(jax.random.PRNGKey(99), D, H, H,
+                                    D // H)
+    swapped = _replica(old, name=f"swap_{wire}")
+    ticket = pub.publish(st, replicas=[swapped], layer=0)
+    assert ticket.outcome == "committed" and ticket.version == 1
+    assert swapped.weight_version == 0           # N keeps serving
+    assert swapped.staged_version() == 1
+    assert swapped.swap_weights() == 1
+    assert swapped.swap_weights() is None        # idempotent no-op
+    cold_params = decode.DecodeParams(
+        *(np.asarray(a) for a in pub.reshard(st)[0]))
+    cold = _replica(cold_params, name=f"cold_{wire}")
+    rng = np.random.default_rng(0)
+    for _ in range(2):
+        x = rng.standard_normal((2, D)).astype(np.float32) * 0.1
+        np.testing.assert_array_equal(swapped.decode_tick(x),
+                                      cold.decode_tick(x))
+    if wire == "off":
+        # and the "off" publication is bit-identical to the host path
+        base = publish.host_gather_publish(st.p, D, 2, 2)[0]
+        for a, c in zip(cold_params, base):
+            assert np.array_equal(np.asarray(a), np.asarray(c))
+    else:
+        # wire-staged weights: bounded by the bf16 mantissa step
+        f32 = publish.host_gather_publish(st.p, D, 2, 2)[0]
+        for a, c in zip(cold_params, f32):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                       rtol=1e-2, atol=1e-2)
+
+
+def test_swap_never_retraces(accl):
+    """The swap is a pointer exchange under the SAME compiled decode
+    step: the cached program object is identical before and after."""
+    mesh, st = _state(2, 2)
+    pub = publish.WeightPublisher(_AccStub(accl), mesh, L, D, 2 * D, H)
+    r = _replica(decode.init_decode_params(jax.random.PRNGKey(1), D, H,
+                                           H, D // H))
+    step_before = r.decode_step()
+    r.decode_tick(np.zeros((2, D), np.float32))
+    pub.publish(st, replicas=[r])
+    r.swap_weights()
+    assert r.decode_step() is step_before
+    r.decode_tick(np.zeros((2, D), np.float32))   # runs, no rebuild
+
+
+def test_stage_rejects_unswappable(accl):
+    """A publication that would force a recompile fails at STAGING —
+    the serving version and the shadow slot are both untouched."""
+    r = _replica(decode.init_decode_params(jax.random.PRNGKey(1), D, H,
+                                           H, D // H))
+    bad = decode.init_decode_params(jax.random.PRNGKey(2), 2 * D, H, H,
+                                    2 * D // H)
+    with pytest.raises(ValueError, match="not swappable"):
+        r.stage_weights(bad, 1)
+    assert r.staged_version() is None and r.weight_version == 0
+
+
+# ---------------------------------------------------------------------------
+# fault domains: stale publications land NOTHING
+# ---------------------------------------------------------------------------
+
+def test_injected_fault_stales_publication(accl):
+    """A publish.commit fault inside the landing window: outcome
+    "stale", version NOT bumped, nothing staged on any replica, the
+    stale counter moves — and the NEXT publication succeeds."""
+    mesh, st = _state(2, 2)
+    pub = publish.WeightPublisher(_AccStub(accl), mesh, L, D, 2 * D, H)
+    r = _replica(decode.init_decode_params(jax.random.PRNGKey(1), D, H,
+                                           H, D // H))
+    before = obs_metrics.snapshot()
+    fault.install(FaultPlan([FaultSpec("publish.commit", kind="fail",
+                                       times=1)]))
+    try:
+        t = pub.publish(st, replicas=[r])
+    finally:
+        fault.clear()
+    assert t.outcome == "stale"
+    assert pub.version == 0 and r.staged_version() is None
+    assert r.weight_version == 0
+    d = obs_metrics.delta(before)["counters"]
+    assert d.get('accl_publish_total{outcome="stale"}') == 1
+    assert 'accl_publish_total{outcome="committed"}' not in d
+    # the next publication lands version 1 — no version ever skipped
+    t2 = pub.publish(st, replicas=[r])
+    assert t2.outcome == "committed" and t2.version == 1
+    assert r.staged_version() == 1
+
+
+def test_epoch_move_stales_publication(accl):
+    """An epoch bump between the re-shard and the landing (a trainer
+    recover() racing the publication) stales it: version N untouched,
+    no torn swap at this interleaving."""
+    mesh, st = _state(2, 2)
+    stub = _AccStub(accl)
+    pub = publish.WeightPublisher(stub, mesh, L, D, 2 * D, H)
+    r = _replica(decode.init_decode_params(jax.random.PRNGKey(1), D, H,
+                                           H, D // H))
+    orig = pub.reshard
+
+    def racing_reshard(state):
+        out = orig(state)
+        stub._epoch += 1          # recover() lands mid-publication
+        return out
+
+    pub.reshard = racing_reshard
+    t = pub.publish(st, replicas=[r])
+    assert t.outcome == "stale"
+    assert pub.version == 0 and r.staged_version() is None
+    pub.reshard = orig
+    assert pub.publish(st, replicas=[r]).outcome == "committed"
+
+
+def test_rebind_preserves_version_counter(accl):
+    """A post-shrink rebind re-resolves the route on the surviving mesh
+    while the version counter carries over — the serving tier never
+    sees a version number reused."""
+    mesh, st = _state(4, 2)
+    pub = publish.WeightPublisher(_AccStub(accl), mesh, L, D, 2 * D, H)
+    assert pub.publish(st).version == 1
+    mesh2, st2 = _state(2, 2, seed=7)      # the shrunk world
+    pub.rebind(mesh2)
+    assert (pub.dp, pub.tp) == (2, 2)
+    t = pub.publish(st2)
+    assert t.outcome == "committed" and t.version == 2
+    # and the shrunk-mesh publication still matches its host baseline
+    for f, b in zip(pub.reshard(st2),
+                    publish.host_gather_publish(st2.p, D, 2, 2)):
+        for a, c in zip(f, b):
+            assert np.array_equal(np.asarray(a), np.asarray(c))
+
+
+# ---------------------------------------------------------------------------
+# observability: exactly-once accounting per publication
+# ---------------------------------------------------------------------------
+
+def test_publish_metrics_exactly_once(accl):
+    mesh, st = _state(2, 2)
+    pub = publish.WeightPublisher(_AccStub(accl), mesh, L, D, 2 * D, H)
+    r = _replica(decode.init_decode_params(jax.random.PRNGKey(1), D, H,
+                                           H, D // H))
+    before = obs_metrics.snapshot()
+    t = pub.publish(st, replicas=[r])
+    r.swap_weights()
+    d = obs_metrics.delta(before)
+    c = d["counters"]
+    assert c.get('accl_publish_total{outcome="committed"}') == 1
+    assert c.get('accl_publish_bytes_total{dtype="float32"}') \
+        == t.nbytes
+    assert c.get('accl_flight_events_total{kind="publish"}') == 1
+    assert c.get('accl_flight_events_total{kind="version_swap"}') == 1
+    [(k, h)] = [(k, h) for k, h in d["histograms"].items()
+                if k.startswith("accl_latency_dispatch_seconds")
+                and 'path="publish"' in k]
+    assert h["count"] == 1 and h["sum"] > 0
+    g = obs_metrics.snapshot()["gauges"]
+    assert g.get('accl_publish_version{replica="r0",slot="live"}') == 1.0
+
+
+def test_ticket_honesty_fields(accl):
+    """The ticket carries the synth route (plan_source/plan_shape from
+    resolve_publish_route on the session comm) and the wire-byte
+    accounting the bench lane reports."""
+    mesh, st = _state(2, 2)
+    pub = publish.WeightPublisher(_AccStub(accl), mesh, L, D, 2 * D, H)
+    t = pub.publish(st)
+    assert t.fused and t.route == "fused" and t.reason is None
+    assert t.plan_source in ("legacy", "cost_model", "latency_tier",
+                             "override", "full_authority")
+    assert t.plan_shape in ("xla", "flat", "tree", "ring", "kring",
+                            "multiaxis", "pipeline", "hier", "twotier")
+    assert t.nbytes == publish.publication_bytes(L, D)
+    assert t.wire_bytes == t.nbytes        # "off" compresses nothing
+    assert (t.dp, t.tp, t.n_layers) == (2, 2, L)

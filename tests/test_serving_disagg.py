@@ -533,3 +533,88 @@ def test_extract_install_roundtrip_and_codec_guard(rng):
     f32 = dm.init_decode_state(SLOTS, PMAX, PAGE, HKV, HD)
     with pytest.raises(ValueError, match="dtype"):
         dm.install_session(f32, 0, k, v, length)
+
+
+# ---------------------------------------------------------------------------
+# admission queue layer
+# ---------------------------------------------------------------------------
+
+def test_queue_parks_fifo_and_pumps_on_handoff(accl, rng):
+    """The bounded FIFO admission queue: a burst past worker capacity
+    PARKS (phase stays "queued", depth gauge tracks), the queue drains
+    in ARRIVAL order the moment a handoff frees a worker slot, and
+    overflow past ``queue_depth`` still sheds via RoutingDeclined —
+    with reason ``queue_full``, counted like every other decline."""
+    params = _params()
+    p = rng.standard_normal((3, D_MODEL)).astype(np.float32) * 0.1
+    w, reps, _ = _fleet(accl, params, "off", n_replicas=2, slots=1)
+    router = sv.ServingRouter(accl, [w], reps, queue_depth=2)
+
+    s1 = router.admit(1, p)
+    assert s1.phase == "prefill"
+
+    s2 = router.admit(2, p)
+    s3 = router.admit(3, p)
+    assert s2.phase == s3.phase == "queued"
+    assert router.queue_len() == 2
+    g = metrics.snapshot()["gauges"]
+    assert g.get("accl_serving_router_queue_depth") == 2.0
+
+    before = _counter(
+        'accl_serving_router_declines_total{reason="queue_full"}')
+    with pytest.raises(sv.RoutingDeclined) as ei:
+        router.admit(4, p)
+    assert ei.value.reasons == ["queue_full"]
+    assert _counter(
+        'accl_serving_router_declines_total{reason="queue_full"}') \
+        == before + 1
+
+    # handoff frees pw0's slot -> pump re-admits sid 2 FIRST (FIFO)
+    router.handoff(1)
+    assert router.sessions[2].phase == "prefill"
+    assert router.sessions[3].phase == "queued"
+    assert router.queue_len() == 1
+
+    router.handoff(2)
+    assert router.sessions[3].phase == "prefill"
+    assert router.queue_len() == 0
+    g = metrics.snapshot()["gauges"]
+    assert g.get("accl_serving_router_queue_depth") == 0.0
+
+
+def test_queue_timeout_expires_counted(accl, rng):
+    """A session parked past ``queue_timeout_s`` is dropped at the next
+    pump — counted into accl_serving_router_queue_timeouts_total and
+    flight-logged, never re-admitted."""
+    params = _params()
+    p = rng.standard_normal((3, D_MODEL)).astype(np.float32) * 0.1
+    w, reps, _ = _fleet(accl, params, "off", n_replicas=1, slots=1)
+    router = sv.ServingRouter(accl, [w], reps, queue_depth=4,
+                              queue_timeout_s=0.0)
+    router.admit(1, p)
+    router.admit(2, p)
+    assert router.queue_len() == 1
+    before = _counter("accl_serving_router_queue_timeouts_total")
+    import time as _time
+    _time.sleep(0.01)
+    assert router.pump_queue() == []
+    assert _counter("accl_serving_router_queue_timeouts_total") \
+        == before + 1
+    assert 2 not in router.sessions
+    assert router.queue_len() == 0
+
+
+def test_queue_disabled_keeps_shed_behavior(accl, rng):
+    """queue_depth=0 (the default) preserves the original contract:
+    capacity overflow is an IMMEDIATE RoutingDeclined with reason
+    no_free_slots — nothing is parked."""
+    params = _params()
+    p = rng.standard_normal((3, D_MODEL)).astype(np.float32) * 0.1
+    w, reps, router = _fleet(accl, params, "off", n_replicas=1,
+                             slots=1)
+    router.admit(1, p)
+    with pytest.raises(sv.RoutingDeclined) as ei:
+        router.admit(2, p)
+    assert ei.value.reasons == ["no_free_slots"]
+    assert router.queue_len() == 0
+    assert 2 not in router.sessions

@@ -78,6 +78,13 @@ if [[ $t1_rc -ne 0 ]]; then
         echo "[ci_gate]   (register go/no-gos: ACCLConfig.cmatmul_nblock / moe_dw_overlap," >&2
         echo "[ci_gate]   re-seeded by the autotune session's cmatmul_nblock + moe_a2a_dw stages)" >&2
     fi
+    if grep -qaE "test_publish|weights_publish|publish_engage|version_swap|WeightPublisher" /tmp/_t1.log; then
+        echo "[ci_gate] hint: weight-publication failure — isolate the tier with:" >&2
+        echo "[ci_gate]   JAX_PLATFORMS=cpu python -m pytest tests/test_publish.py -q" >&2
+        echo "[ci_gate]   and A/B fused vs host-gather with: python bench.py --lanes weights_publish" >&2
+        echo "[ci_gate]   (parity is bit-exact only at dcn_wire_dtype=off; the fused go/no-go is" >&2
+        echo "[ci_gate]   ACCLConfig.publish_fused, re-seeded by the autotune session's publish stage)" >&2
+    fi
     exit "$t1_rc"
 fi
 
